@@ -1,0 +1,113 @@
+"""CSR topology container.
+
+Counterpart of reference `data/graph.py:28-122` (``CSRTopo``): accepts a
+COO edge list, CSR, or CSC and canonicalizes to CSR on the host.  The
+device-resident handle lives in :mod:`graphlearn_tpu.data.graph`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from ..utils import (convert_to_array, coo_to_csr, csr_to_coo,
+                     degrees_from_indptr)
+
+
+class CSRTopo:
+  """Canonical topology in CSR form (host numpy arrays).
+
+  Args:
+    edge_index: ``(rows, cols)`` pair or ``[2, E]`` array when
+      ``layout='COO'``; ``(indptr, indices)`` when ``layout`` is
+      ``'CSR'``/``'CSC'``.
+    edge_ids: optional per-edge global ids; fabricated as consecutive
+      ints when absent (matching reference semantics).
+    layout: one of ``'COO' | 'CSR' | 'CSC'``.
+    num_nodes: optional node-count override (ids may exceed max seen).
+  """
+
+  def __init__(
+      self,
+      edge_index: Union[np.ndarray, Tuple[np.ndarray, np.ndarray]],
+      edge_ids: Optional[np.ndarray] = None,
+      layout: str = 'COO',
+      num_nodes: Optional[int] = None,
+  ):
+    layout = layout.upper()
+    if layout == 'COO':
+      edge_index = convert_to_array(edge_index)
+      if isinstance(edge_index, (tuple, list)):
+        rows, cols = np.asarray(edge_index[0]), np.asarray(edge_index[1])
+      else:
+        rows, cols = edge_index[0], edge_index[1]
+      self._indptr, self._indices, self._edge_ids = coo_to_csr(
+          rows, cols, num_nodes, edge_ids)
+    elif layout in ('CSR', 'CSC'):
+      indptr, indices = edge_index
+      self._indptr = np.asarray(convert_to_array(indptr), dtype=np.int64)
+      self._indices = np.asarray(convert_to_array(indices))
+      if edge_ids is None:
+        edge_ids = np.arange(len(self._indices), dtype=np.int64)
+      self._edge_ids = np.asarray(convert_to_array(edge_ids))
+      if layout == 'CSC':
+        # Reference accepts CSC by transposing into CSR (data/graph.py).
+        # The node count encoded in len(indptr)-1 must survive the
+        # round-trip even when trailing nodes are isolated.
+        n = max(num_nodes or 0, len(self._indptr) - 1)
+        rows, cols = csr_to_coo(self._indptr, self._indices)
+        self._indptr, self._indices, self._edge_ids = coo_to_csr(
+            cols, rows, n, self._edge_ids)
+      else:
+        # Re-sort columns within rows: downstream binary-search ops
+        # (`ops/negative.py:edge_in_csr`) require sorted-CSR, which
+        # user-provided CSR input does not guarantee.
+        rows, cols = csr_to_coo(self._indptr, self._indices)
+        n = max(num_nodes or 0, len(self._indptr) - 1)
+        self._indptr, self._indices, self._edge_ids = coo_to_csr(
+            rows, cols, n, self._edge_ids)
+    else:
+      raise ValueError(f'Unsupported layout {layout!r}')
+    self._indices = self._indices.astype(np.int32, copy=False)
+
+  @property
+  def indptr(self) -> np.ndarray:
+    return self._indptr
+
+  @property
+  def indices(self) -> np.ndarray:
+    return self._indices
+
+  @property
+  def edge_ids(self) -> np.ndarray:
+    return self._edge_ids
+
+  @property
+  def num_nodes(self) -> int:
+    return len(self._indptr) - 1
+
+  @property
+  def num_edges(self) -> int:
+    return len(self._indices)
+
+  @property
+  def degrees(self) -> np.ndarray:
+    """Out-degree of every node (reference `CSRTopo.degrees`)."""
+    return degrees_from_indptr(self._indptr)
+
+  @property
+  def max_degree(self) -> int:
+    d = self.degrees
+    return int(d.max()) if len(d) else 0
+
+  def to_coo(self) -> Tuple[np.ndarray, np.ndarray]:
+    return csr_to_coo(self._indptr, self._indices)
+
+  def to_csc(self) -> 'CSRTopo':
+    rows, cols = self.to_coo()
+    return CSRTopo((cols, rows), edge_ids=self._edge_ids, layout='COO',
+                   num_nodes=self.num_nodes)
+
+  def __repr__(self):
+    return (f'CSRTopo(num_nodes={self.num_nodes}, '
+            f'num_edges={self.num_edges})')

@@ -1,0 +1,112 @@
+"""Random negative edge sampling on device.
+
+TPU-native replacement for the reference's curand negative sampler
+(`csrc/cuda/random_negative_sampler.cu:37-120`, CPU twin
+`csrc/cpu/random_negative_sampler.cc`).  The CUDA code draws (row, col)
+pairs per thread, rejects existing edges via warp binary search in CSR,
+retries up to ``trials_num`` times and compacts with thrust; here the
+retry loop becomes a static ``[trials, R]`` batch of draws with a
+vectorized branchless binary search, and compaction becomes a validity
+mask (static shapes for XLA).
+
+Requires within-row-sorted CSR columns (guaranteed by
+`utils.topo.coo_to_csr`).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.padding import INVALID_ID
+
+
+@jax.jit
+def edge_in_csr(
+    indptr: jax.Array,
+    indices: jax.Array,
+    rows: jax.Array,
+    cols: jax.Array,
+) -> jax.Array:
+  """Vectorized membership test: is (rows[i], cols[i]) an edge?
+
+  Counterpart of ``EdgeInCSR`` (`csrc/cuda/random_negative_sampler.cu:
+  37-54`); the warp-cooperative binary search becomes a data-parallel
+  fixed-depth (32-step) binary search over each row's sorted column
+  slice.
+  """
+  num_edges = indices.shape[0]
+  valid = rows >= 0
+  r = jnp.where(valid, rows, 0)
+  lo = indptr[r].astype(jnp.int32)
+  hi = indptr[r + 1].astype(jnp.int32)
+  hi0 = hi
+  # ceil(log2(E+1)) static iterations; branchless lower_bound.  A slice
+  # of length L needs bit_length(L) halvings to converge, and the
+  # longest row can hold all E edges.
+  for _ in range(max(num_edges, 1).bit_length()):
+    active = lo < hi
+    mid = (lo + hi) // 2
+    v = indices[jnp.clip(mid, 0, max(num_edges - 1, 0))]
+    go_right = v < cols
+    lo = jnp.where(active & go_right, mid + 1, lo)
+    hi = jnp.where(active & ~go_right, mid, hi)
+  at = jnp.clip(lo, 0, max(num_edges - 1, 0))
+  return valid & (lo < hi0) & (indices[at] == cols)
+
+
+class NegativeSampleResult(NamedTuple):
+  """``rows``/``cols``: ``[R]`` sampled pairs (INVALID_ID when masked);
+  ``mask``: pair validity (always all-true when ``padding=True``)."""
+  rows: jax.Array
+  cols: jax.Array
+  mask: jax.Array
+
+
+@functools.partial(
+    jax.jit, static_argnames=('req_num', 'trials', 'strict', 'padding'))
+def sample_negative(
+    indptr: jax.Array,
+    indices: jax.Array,
+    req_num: int,
+    key: jax.Array,
+    *,
+    trials: int = 5,
+    strict: bool = True,
+    padding: bool = True,
+) -> NegativeSampleResult:
+  """Draw ``req_num`` node pairs that are (in strict mode) non-edges.
+
+  Mirrors the reference contract (`sampler/negative_sampler.py:21-51`):
+  ``strict`` rejects existing edges with up to ``trials`` redraws per
+  slot; ``padding`` falls back to the final (possibly invalid) draw so
+  the output is always full.
+  """
+  num_nodes = indptr.shape[0] - 1
+  kr, kc = jax.random.split(key)
+  rows = jax.random.randint(kr, (trials, req_num), 0, num_nodes,
+                            dtype=jnp.int32)
+  cols = jax.random.randint(kc, (trials, req_num), 0, num_nodes,
+                            dtype=jnp.int32)
+  if not strict:
+    return NegativeSampleResult(rows[0], cols[0],
+                                jnp.ones((req_num,), bool))
+
+  exists = edge_in_csr(indptr, indices, rows.reshape(-1),
+                       cols.reshape(-1)).reshape(trials, req_num)
+  ok = ~exists
+  any_ok = jnp.any(ok, axis=0)
+  first_ok = jnp.argmax(ok, axis=0)                  # first valid trial
+  pick = jnp.where(any_ok, first_ok, trials - 1)     # padding fallback
+  slot = jnp.arange(req_num)
+  out_rows = rows[pick, slot]
+  out_cols = cols[pick, slot]
+  if padding:
+    mask = jnp.ones((req_num,), bool)
+  else:
+    mask = any_ok
+    out_rows = jnp.where(mask, out_rows, INVALID_ID)
+    out_cols = jnp.where(mask, out_cols, INVALID_ID)
+  return NegativeSampleResult(out_rows, out_cols, mask)

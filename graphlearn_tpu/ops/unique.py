@@ -1,0 +1,166 @@
+"""Capacity-bounded, order-preserving unique & relabel.
+
+TPU-native replacement for the reference's GPU hash-table "inducer"
+(`csrc/cuda/inducer.cu:94-141`, `csrc/cuda/hash_table.cu`,
+`include/hash_table.cuh:24-150`): the CUDA code deduplicates node ids
+and assigns local indices with atomicCAS open addressing.  TPUs have no
+device-atomics idiom, so we use a sort-based unique instead — fully
+static shapes, no data-dependent sizes, jit/vmap/shard_map friendly.
+
+Semantics match the inducer contract: the *first occurrence order* of
+ids is preserved (seeds keep local indices ``0..B-1``, newly discovered
+nodes are appended in arrival order), which PyG-style batches rely on.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.padding import INVALID_ID
+
+
+class UniqueResult(NamedTuple):
+  """Result of a capacity-bounded unique.
+
+  Attributes:
+    values: ``[capacity]`` unique ids in first-occurrence order, padded
+      with ``fill_value``.
+    inverse: ``[n]`` local index of each input element in ``values``
+      (-1 for invalid/padded inputs or overflow past capacity).
+    count: scalar — number of valid unique ids (clamped to capacity).
+  """
+  values: jax.Array
+  inverse: jax.Array
+  count: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=('capacity', 'fill_value'))
+def unique_stable(
+    x: jax.Array,
+    capacity: int,
+    fill_value: int = INVALID_ID,
+    valid: Optional[jax.Array] = None,
+) -> UniqueResult:
+  """Order-preserving unique with a static output capacity.
+
+  Algorithm (all O(n log n), static shapes):
+    1. stable-sort ids (invalid ids mapped to a +inf sentinel),
+    2. mark segment heads, segment-min the original positions to find
+       each unique id's first occurrence,
+    3. rank unique ids by first occurrence to recover appearance order,
+    4. scatter appearance ranks back through the sort permutation to
+       build the inverse map.
+  """
+  n = x.shape[0]
+  if valid is None:
+    valid = x != fill_value
+  else:
+    valid = valid & (x != fill_value)
+  big = jnp.iinfo(x.dtype).max
+  xv = jnp.where(valid, x, big)
+
+  order = jnp.argsort(xv, stable=True)          # positions sorted by value
+  xs = xv[order]
+  head = jnp.concatenate([jnp.ones((1,), bool), xs[1:] != xs[:-1]])
+  head = head & (xs != big)
+  # unique id (in sorted order) of each sorted element; invalids -> n.
+  # Up to n distinct segments exist; overflow past `capacity` must drop
+  # the *latest-appearing* ids (preserving earlier local indices), so
+  # ranking happens over all n segments before truncation.
+  uid = jnp.where(xs != big, jnp.cumsum(head) - 1, n)
+
+  # first occurrence (original position) and value of each sorted-unique id
+  first_pos = jax.ops.segment_min(order, uid, num_segments=n + 1,
+                                  indices_are_sorted=True)[:n]
+  seg_val = jax.ops.segment_min(xs, uid, num_segments=n + 1,
+                                indices_are_sorted=True)[:n]
+
+  count = jnp.minimum(jnp.sum(head), capacity)
+
+  # appearance order: sort unique segments by first occurrence; empty
+  # segments have first_pos = int-max and sink to the end.
+  rank_order = jnp.argsort(first_pos)           # appearance rank -> uid
+  vals_by_rank = seg_val[rank_order]            # [n]
+  slot = jnp.arange(capacity)
+  values = jnp.where(slot < count,
+                     vals_by_rank[jnp.clip(slot, 0, n - 1)].astype(x.dtype),
+                     fill_value)
+
+  appearance_rank = jnp.zeros((n,), jnp.int32).at[rank_order].set(
+      jnp.arange(n, dtype=jnp.int32))
+  inv_sorted = jnp.where(uid < n,
+                         appearance_rank[jnp.clip(uid, 0, n - 1)], -1)
+  inv_sorted = jnp.where(inv_sorted < capacity, inv_sorted, -1)
+  inverse = jnp.full((n,), -1, jnp.int32).at[order].set(inv_sorted)
+  return UniqueResult(values=values, inverse=inverse, count=count)
+
+
+class InducerState(NamedTuple):
+  """Functional inducer state: the node table accumulated across hops.
+
+  Attributes:
+    nodes: ``[capacity]`` global node ids in insertion order (padded).
+    count: scalar number of valid entries.
+  """
+  nodes: jax.Array
+  count: jax.Array
+
+
+def init_node(seeds: jax.Array, capacity: int) -> Tuple[InducerState,
+                                                        jax.Array]:
+  """Seed the inducer table; counterpart of ``InitNode``
+  (`csrc/cuda/inducer.cu:74`).  Seeds are deduplicated preserving order
+  (reference seeds are assumed unique per batch; we dedup defensively).
+
+  Returns the state and the seeds' local indices.
+  """
+  res = unique_stable(seeds, capacity)
+  return InducerState(nodes=res.values, count=res.count), res.inverse
+
+
+def induce_next(
+    state: InducerState,
+    src_local: jax.Array,
+    nbrs: jax.Array,
+    nbr_mask: jax.Array,
+) -> Tuple[InducerState, jax.Array, jax.Array, jax.Array]:
+  """Insert newly sampled neighbors; counterpart of ``InduceNext``
+  (`csrc/cuda/inducer.cu:94-141`).
+
+  Args:
+    state: current node table.
+    src_local: ``[B]`` local indices of the source nodes (-1 invalid).
+    nbrs: ``[B, k]`` sampled neighbor global ids (-1 invalid).
+    nbr_mask: ``[B, k]`` validity of each sampled neighbor.
+
+  Returns:
+    ``(new_state, rows, cols, frontier_start)`` where ``rows``/``cols``
+    are the ``[B*k]`` local COO of the sampled edges — ``rows`` is the
+    *neighbor* local index and ``cols`` the *source* local index,
+    matching the reference's transposed emission for PyG message
+    passing (`sampler/neighbor_sampler.py:159-166`) — and
+    ``frontier_start`` is the previous node count (new frontier =
+    ``state.nodes[frontier_start:new_count]``).
+  """
+  capacity = state.nodes.shape[0]
+  b, k = nbrs.shape
+  flat_nbrs = nbrs.reshape(-1)
+  flat_mask = nbr_mask.reshape(-1)
+
+  # Combined table: existing nodes first (so their indices are stable),
+  # then the new candidates in arrival order.
+  combined = jnp.concatenate([state.nodes, flat_nbrs])
+  valid = jnp.concatenate(
+      [jnp.arange(capacity) < state.count, flat_mask])
+  res = unique_stable(combined, capacity, valid=valid)
+
+  new_state = InducerState(nodes=res.values, count=res.count)
+  nbr_local = res.inverse[capacity:]            # [B*k]
+  src_flat = jnp.broadcast_to(src_local[:, None], (b, k)).reshape(-1)
+  edge_valid = flat_mask & (src_flat >= 0) & (nbr_local >= 0)
+  rows = jnp.where(edge_valid, nbr_local, -1)
+  cols = jnp.where(edge_valid, src_flat, -1)
+  return new_state, rows, cols, state.count

@@ -1,0 +1,9 @@
+from .device import (assign_device, ensure_device, get_available_devices,
+                     is_tpu_available)
+from .mixin import CastMixin
+from .padding import (INVALID_ID, bucket_size, max_sampled_edges,
+                      max_sampled_nodes, next_power_of_two, pad_1d, round_up)
+from .tensor import convert_to_array, id2idx, to_device, to_host
+from .topo import (coo_to_csc, coo_to_csr, csr_to_coo, degrees_from_indptr,
+                   ptr2ind)
+from .units import format_size, parse_size

@@ -1,0 +1,44 @@
+"""Device discovery / assignment helpers.
+
+Counterpart of reference `utils/device.py:21-53`
+(get_available_device/assign_device/ensure_device) for the JAX backend.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+
+def get_available_devices(platform: Optional[str] = None) -> List[jax.Device]:
+  """All visible accelerator devices (TPU chips, or CPU fallback)."""
+  try:
+    if platform is not None:
+      return jax.devices(platform)
+    return jax.devices()
+  except RuntimeError:
+    return jax.devices('cpu')
+
+
+def assign_device(rank: int = 0) -> jax.Device:
+  """Round-robin assignment of a device to a worker rank."""
+  devs = get_available_devices()
+  return devs[rank % len(devs)]
+
+
+def ensure_device(device=None) -> jax.Device:
+  """Normalize a device argument: None -> default device."""
+  if device is None:
+    return get_available_devices()[0]
+  if isinstance(device, jax.Device):
+    return device
+  if isinstance(device, int):
+    return assign_device(device)
+  raise ValueError(f'Unrecognized device: {device!r}')
+
+
+def is_tpu_available() -> bool:
+  try:
+    return any(d.platform == 'tpu' for d in jax.devices())
+  except RuntimeError:
+    return False

@@ -1,0 +1,21 @@
+"""CastMixin. Counterpart of reference `utils/mixin.py`."""
+from __future__ import annotations
+
+
+class CastMixin:
+  """Allows flexible construction: ``T.cast(x)`` accepts an existing
+  instance, a tuple of args, a dict of kwargs, or a single value."""
+
+  @classmethod
+  def cast(cls, *args, **kwargs):
+    if len(args) == 1 and len(kwargs) == 0:
+      elem = args[0]
+      if elem is None:
+        return None
+      if isinstance(elem, CastMixin):
+        return elem
+      if isinstance(elem, tuple):
+        return cls(*elem)
+      if isinstance(elem, dict):
+        return cls(**elem)
+    return cls(*args, **kwargs)

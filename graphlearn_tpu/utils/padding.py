@@ -1,0 +1,71 @@
+"""Static-shape padding helpers — the backbone of the TPU design.
+
+XLA traces a program once per shape; the reference's ragged outputs
+(variable neighbor counts, growing unique-node sets) become fixed
+capacities with validity masks here.  These helpers centralize the
+pad/mask/bucket conventions used by every op.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: Sentinel for an invalid/padded node or edge id.
+INVALID_ID = -1
+
+
+def round_up(x: int, multiple: int) -> int:
+  return -(-int(x) // int(multiple)) * int(multiple)
+
+
+def next_power_of_two(x: int) -> int:
+  if x <= 1:
+    return 1
+  return 1 << (int(x) - 1).bit_length()
+
+
+def pad_1d(arr: np.ndarray, size: int, fill=INVALID_ID) -> np.ndarray:
+  """Pad (or truncate) a host 1-D array to a static size."""
+  arr = np.asarray(arr)
+  out = np.full((size,), fill, dtype=arr.dtype)
+  n = min(len(arr), size)
+  out[:n] = arr[:n]
+  return out
+
+
+def bucket_size(n: int, buckets: Optional[Sequence[int]] = None,
+                multiple: int = 128) -> int:
+  """Pick a padded size for `n`: smallest bucket >= n, or round up to a
+  lane multiple.  Bucketing bounds the number of distinct compiled
+  programs when batch tails vary."""
+  if buckets:
+    for b in sorted(buckets):
+      if n <= b:
+        return int(b)
+  return round_up(max(n, 1), multiple)
+
+
+def max_sampled_nodes(batch_size: int, num_neighbors: Sequence[int]) -> int:
+  """Worst-case unique-node capacity of a multi-hop sample.
+
+  The reference computes the same bound to size its inducer
+  (`sampler/neighbor_sampler.py:595-612`); here it fixes the static
+  shape of the relabeled node set.
+  """
+  total = batch_size
+  frontier = batch_size
+  for k in num_neighbors:
+    frontier = frontier * int(k)
+    total += frontier
+  return total
+
+
+def max_sampled_edges(batch_size: int, num_neighbors: Sequence[int]) -> int:
+  """Worst-case sampled-edge capacity of a multi-hop sample."""
+  total = 0
+  frontier = batch_size
+  for k in num_neighbors:
+    frontier = frontier * int(k)
+    total += frontier
+  return total

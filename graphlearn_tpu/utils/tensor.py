@@ -1,0 +1,62 @@
+"""Host/device tensor helpers.
+
+TPU-native counterpart of reference `utils/tensor.py` (convert_to_tensor,
+share_memory, id2idx).  Host arrays are numpy; device arrays are jax.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def convert_to_array(data: Any, dtype: Optional[np.dtype] = None):
+  """Convert input (nested dicts / lists / tuples / arrays, or torch
+  tensors if torch happens to be importable) into numpy arrays.
+
+  Mirrors reference `utils/tensor.py:convert_to_tensor` but lands on the
+  host (numpy): graph construction is a host-side activity; arrays move
+  to TPU HBM explicitly via `jnp.asarray` / `jax.device_put` at
+  `Graph`/`Feature` init time.
+  """
+  if data is None:
+    return None
+  if isinstance(data, dict):
+    return {k: convert_to_array(v, dtype) for k, v in data.items()}
+  if isinstance(data, (list, tuple)) and len(data) > 0 and (
+      hasattr(data[0], '__array__') or isinstance(data[0], (list, tuple))):
+    return type(data)(convert_to_array(v, dtype) for v in data)
+  if hasattr(data, 'detach'):  # torch tensor without importing torch
+    data = data.detach().cpu().numpy()
+  arr = np.asarray(data)
+  if dtype is not None:
+    arr = arr.astype(dtype, copy=False)
+  return arr
+
+
+def id2idx(ids: Union[np.ndarray, jax.Array], max_id: Optional[int] = None):
+  """Build a dense id->index map: ``out[ids[i]] = i``, -1 elsewhere.
+
+  Mirrors reference `utils/tensor.py:28-36` (id2idx), used by `Feature`
+  to map global ids onto storage rows.
+  """
+  ids = np.asarray(ids)
+  n = int(max_id) + 1 if max_id is not None else (int(ids.max()) + 1
+                                                  if ids.size else 0)
+  out = np.full((n,), -1, dtype=np.int64)
+  out[ids] = np.arange(len(ids), dtype=np.int64)
+  return out
+
+
+def to_device(tree, device: Optional[jax.Device] = None):
+  """Move a pytree of host arrays onto a device (default: first device)."""
+  if device is None:
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+  return jax.device_put(tree, device)
+
+
+def to_host(tree) -> Any:
+  """Move a pytree of jax arrays back to host numpy."""
+  return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

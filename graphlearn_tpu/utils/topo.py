@@ -1,0 +1,69 @@
+"""Topology conversions (COO <-> CSR/CSC) on the host.
+
+Counterpart of reference `utils/topo.py:22-75` (coo_to_csr/csc, ptr2ind)
+but numpy-based: topology construction is an offline/host step; the
+device consumes the resulting static CSR arrays.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def coo_to_csr(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    num_nodes: Optional[int] = None,
+    edge_ids: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+  """Sort a COO edge list into CSR.
+
+  Returns ``(indptr[num_nodes+1], indices[E], edge_ids[E])``.  If
+  ``edge_ids`` is None the original COO positions are used, matching the
+  reference semantics where `CSRTopo` fabricates consecutive edge ids
+  (`data/graph.py:28-122`).
+  """
+  rows = np.asarray(rows)
+  cols = np.asarray(cols)
+  if num_nodes is None:
+    num_nodes = int(max(rows.max(initial=-1), cols.max(initial=-1))) + 1
+  if edge_ids is None:
+    edge_ids = np.arange(len(rows), dtype=np.int64)
+  else:
+    edge_ids = np.asarray(edge_ids)
+  # Sort by (row, col): within-row-sorted columns let the negative
+  # sampler and subgraph op use binary search for edge membership
+  # (`ops/negative.py:edge_in_csr`).  Original edge order is preserved
+  # through `edge_ids`.
+  perm = np.lexsort((cols, rows))
+  sorted_rows = rows[perm]
+  indices = cols[perm]
+  edge_ids = edge_ids[perm]
+  counts = np.bincount(sorted_rows, minlength=num_nodes)
+  indptr = np.zeros(num_nodes + 1, dtype=np.int64)
+  np.cumsum(counts, out=indptr[1:])
+  return indptr, indices, edge_ids
+
+
+def coo_to_csc(rows, cols, num_nodes=None, edge_ids=None):
+  """CSC = CSR of the transposed graph."""
+  return coo_to_csr(cols, rows, num_nodes, edge_ids)
+
+
+def ptr2ind(indptr: np.ndarray) -> np.ndarray:
+  """Expand a CSR ptr array into per-edge row ids.
+
+  Counterpart of reference `utils/topo.py:ptr2ind`.
+  """
+  indptr = np.asarray(indptr)
+  n = len(indptr) - 1
+  return np.repeat(np.arange(n, dtype=indptr.dtype), np.diff(indptr))
+
+
+def csr_to_coo(indptr, indices) -> Tuple[np.ndarray, np.ndarray]:
+  return ptr2ind(indptr), np.asarray(indices)
+
+
+def degrees_from_indptr(indptr: np.ndarray) -> np.ndarray:
+  return np.diff(np.asarray(indptr))

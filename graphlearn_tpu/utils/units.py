@@ -1,0 +1,29 @@
+"""Size-string parsing. Counterpart of reference `utils/units.py`."""
+from __future__ import annotations
+
+from typing import Union
+
+UNITS = {
+    'KB': 2**10, 'MB': 2**20, 'GB': 2**30, 'TB': 2**40,
+    'K': 2**10, 'M': 2**20, 'G': 2**30, 'T': 2**40,
+    'B': 1,
+}
+
+
+def parse_size(size: Union[int, float, str]) -> int:
+  """Parse '512MB' / '4GB' / 1024 / '10%'-free numbers into bytes."""
+  if isinstance(size, (int, float)):
+    return int(size)
+  s = size.strip().upper().replace(' ', '')
+  for unit in ('KB', 'MB', 'GB', 'TB', 'K', 'M', 'G', 'T', 'B'):
+    if s.endswith(unit):
+      return int(float(s[:-len(unit)]) * UNITS[unit])
+  return int(float(s))
+
+
+def format_size(num_bytes: int) -> str:
+  for unit, scale in (('TB', 2**40), ('GB', 2**30), ('MB', 2**20),
+                      ('KB', 2**10)):
+    if num_bytes >= scale:
+      return f'{num_bytes / scale:.2f}{unit}'
+  return f'{num_bytes}B'

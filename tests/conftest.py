@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's test strategy of running the real distributed
+stack all-locally (`test/python/dist_test_utils.py`): multi-chip
+sharding paths compile and execute on 8 virtual CPU devices; the same
+code runs unchanged on a real TPU slice.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+  os.environ['XLA_FLAGS'] = (
+      _flags + ' --xla_force_host_platform_device_count=8').strip()
