@@ -1,0 +1,2 @@
+from .topology import CSRTopo
+from .graph import Graph

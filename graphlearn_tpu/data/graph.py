@@ -1,0 +1,110 @@
+"""Device-resident graph handle.
+
+TPU-native counterpart of reference `data/graph.py:125-239` + the
+native CSR holder (`csrc/cuda/graph.cu`, `include/graph.h:36-130`).
+The reference's three residency modes (CPU / ZERO_COPY UVA / CUDA HBM)
+collapse into two on TPU: topology as `jax.Array`s in device HBM
+(``'device'``, the fast path — what DMA mode is on GPU), or pinned on
+the TPU-VM host (``'host'``, for graphs larger than HBM; gathers are
+then staged per batch).  There is no UVA on TPU; the ZERO_COPY
+equivalent is host-resident arrays + explicit async `device_put`.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .topology import CSRTopo
+
+
+class Graph:
+  """A graph object holding topology ready for device sampling.
+
+  Args:
+    csr_topo: canonical CSR topology.
+    mode: ``'device'`` (HBM-resident, default) or ``'host'``.
+    device: optional explicit `jax.Device`.
+    with_edge_ids: materialize edge ids on device (needed when
+      downstream wants edge features / provenance).
+  """
+
+  def __init__(self, csr_topo: CSRTopo, mode: str = 'device',
+               device: Optional[jax.Device] = None,
+               with_edge_ids: bool = True):
+    mode = mode.lower()
+    if mode not in ('device', 'host'):
+      raise ValueError(f'Unsupported graph mode {mode!r}')
+    self.csr_topo = csr_topo
+    self.mode = mode
+    self._device = device
+    self.with_edge_ids = with_edge_ids
+    self._indptr = None
+    self._indices = None
+    self._edge_ids = None
+
+  # Lazy init mirrors reference `data/graph.py:160-188` (`lazy_init`).
+  def lazy_init(self):
+    if self._indptr is not None:
+      return
+    if self.mode == 'host':
+      dev = _host_device()
+    else:
+      dev = self._device or jax.devices()[0]
+    # indptr entries index edges: narrow to int32 only when safe.
+    ptr_dtype = (np.int32 if self.csr_topo.num_edges < np.iinfo(np.int32).max
+                 else np.int64)
+    self._indptr = jax.device_put(
+        np.asarray(self.csr_topo.indptr, dtype=ptr_dtype), dev)
+    self._indices = jax.device_put(
+        np.asarray(self.csr_topo.indices, dtype=np.int32), dev)
+    if self.with_edge_ids:
+      eids = np.asarray(self.csr_topo.edge_ids)
+      # int32 when the id space allows — halves HBM footprint.
+      if eids.size == 0 or eids.max() < np.iinfo(np.int32).max:
+        eids = eids.astype(np.int32)
+      self._edge_ids = jax.device_put(eids, dev)
+
+  @property
+  def indptr(self) -> jax.Array:
+    self.lazy_init()
+    return self._indptr
+
+  @property
+  def indices(self) -> jax.Array:
+    self.lazy_init()
+    return self._indices
+
+  @property
+  def edge_ids(self) -> Optional[jax.Array]:
+    self.lazy_init()
+    return self._edge_ids
+
+  @property
+  def num_nodes(self) -> int:
+    return self.csr_topo.num_nodes
+
+  @property
+  def num_edges(self) -> int:
+    return self.csr_topo.num_edges
+
+  @property
+  def max_degree(self) -> int:
+    return self.csr_topo.max_degree
+
+  def __repr__(self):
+    return (f'Graph(num_nodes={self.num_nodes}, num_edges={self.num_edges}, '
+            f'mode={self.mode!r})')
+
+
+def _host_device() -> jax.Device:
+  """Best-effort host (CPU) device for host-resident topology."""
+  for d in jax.devices():
+    if d.platform == 'cpu':
+      return d
+  try:
+    return jax.devices('cpu')[0]
+  except RuntimeError:
+    return jax.devices()[0]

@@ -53,8 +53,10 @@ class CSRTopo:
       if layout == 'CSC':
         # Reference accepts CSC by transposing into CSR (data/graph.py).
         # The node count encoded in len(indptr)-1 must survive the
-        # round-trip even when trailing nodes are isolated.
-        n = max(num_nodes or 0, len(self._indptr) - 1)
+        # round-trip even when trailing nodes are isolated, and source
+        # ids (the CSC indices) may exceed the destination count.
+        n = max(num_nodes or 0, len(self._indptr) - 1,
+                int(self._indices.max(initial=-1)) + 1)
         rows, cols = csr_to_coo(self._indptr, self._indices)
         self._indptr, self._indices, self._edge_ids = coo_to_csr(
             cols, rows, n, self._edge_ids)
@@ -97,16 +99,21 @@ class CSRTopo:
 
   @property
   def max_degree(self) -> int:
-    d = self.degrees
-    return int(d.max()) if len(d) else 0
+    if not hasattr(self, '_max_degree'):
+      d = self.degrees
+      self._max_degree = int(d.max()) if len(d) else 0
+    return self._max_degree
 
   def to_coo(self) -> Tuple[np.ndarray, np.ndarray]:
     return csr_to_coo(self._indptr, self._indices)
 
   def to_csc(self) -> 'CSRTopo':
     rows, cols = self.to_coo()
+    # Bipartite-style topologies may reference column ids beyond the
+    # row count; the transpose must cover them.
+    n = max(self.num_nodes, int(self._indices.max(initial=-1)) + 1)
     return CSRTopo((cols, rows), edge_ids=self._edge_ids, layout='COO',
-                   num_nodes=self.num_nodes)
+                   num_nodes=n)
 
   def __repr__(self):
     return (f'CSRTopo(num_nodes={self.num_nodes}, '

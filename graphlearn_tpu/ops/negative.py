@@ -40,8 +40,8 @@ def edge_in_csr(
   num_edges = indices.shape[0]
   valid = rows >= 0
   r = jnp.where(valid, rows, 0)
-  lo = indptr[r].astype(jnp.int32)
-  hi = indptr[r + 1].astype(jnp.int32)
+  lo = indptr[r]
+  hi = indptr[r + 1]
   hi0 = hi
   # ceil(log2(E+1)) static iterations; branchless lower_bound.  A slice
   # of length L needs bit_length(L) halvings to converge, and the

@@ -88,8 +88,10 @@ def sample_one_hop(
 
   valid_seed = seeds >= 0
   s = jnp.where(valid_seed, seeds, 0)
-  start = indptr[s].astype(jnp.int32)
-  deg = (indptr[s + 1].astype(jnp.int32) - start)
+  # Edge positions keep indptr's dtype (int64-safe for >2^31 edges when
+  # x64 is enabled); degrees always fit int32.
+  start = indptr[s]
+  deg = (indptr[s + 1] - start).astype(jnp.int32)
   deg = jnp.where(valid_seed, deg, 0)
 
   mask = slot[None, :] < jnp.minimum(deg, k)[:, None]

@@ -61,8 +61,8 @@ def induced_subgraph(
 
   valid_node = nodes >= 0
   n = jnp.where(valid_node, nodes, 0)
-  start = indptr[n].astype(jnp.int32)
-  deg = (indptr[n + 1].astype(jnp.int32) - start)
+  start = indptr[n]
+  deg = (indptr[n + 1] - start).astype(jnp.int32)
   deg = jnp.where(valid_node, deg, 0)
 
   wslot = jnp.arange(d, dtype=jnp.int32)

@@ -55,6 +55,11 @@ def unique_stable(
        build the inverse map.
   """
   n = x.shape[0]
+  if n == 0:
+    return UniqueResult(
+        values=jnp.full((capacity,), fill_value, x.dtype),
+        inverse=jnp.zeros((0,), jnp.int32),
+        count=jnp.zeros((), jnp.int32))
   if valid is None:
     valid = x != fill_value
   else:

@@ -1,0 +1,12 @@
+from .base import (
+    EdgeSamplerInput,
+    HeteroSamplerOutput,
+    NegativeSampling,
+    NodeSamplerInput,
+    SamplerOutput,
+    SamplingConfig,
+    SamplingType,
+    BaseSampler,
+)
+from .neighbor_sampler import NeighborSampler
+from .negative_sampler import RandomNegativeSampler

@@ -1,0 +1,261 @@
+"""Sampler contract: I/O dataclasses and the abstract sampler.
+
+TPU-native re-design of the reference sampler vocabulary
+(`graphlearn_torch/python/sampler/base.py`): the same PyG-compatible
+field names (``node/row/col/edge/batch``), but every array is a fixed
+capacity `jax.Array` with validity masks instead of a ragged
+`torch.Tensor`, so a whole `SamplerOutput` is a pytree that can cross
+`jit`/`shard_map` boundaries unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..typing import EdgeType, NodeType, NumNeighbors
+from ..utils.mixin import CastMixin
+
+
+@dataclasses.dataclass
+class NodeSamplerInput(CastMixin):
+  """Seed nodes for node-wise sampling.
+
+  Mirrors reference `sampler/base.py:44-74`; ``node`` is INVALID_ID-
+  padded to the loader's static batch size.
+
+  Args:
+    node: ``[B]`` global seed node ids.
+    input_type: node type for hetero sampling.
+  """
+  node: Union[np.ndarray, jax.Array]
+  input_type: Optional[NodeType] = None
+
+  def __len__(self) -> int:
+    return len(self.node)
+
+  def __getitem__(self, index) -> 'NodeSamplerInput':
+    return NodeSamplerInput(self.node[index], self.input_type)
+
+
+@dataclasses.dataclass(frozen=True)
+class NegativeSampling(CastMixin):
+  """Negative edge sampling configuration.
+
+  Mirrors reference `sampler/base.py:76-145` (binary / triplet modes,
+  float ``amount`` ratio).
+  """
+  mode: str = 'binary'
+  amount: Union[int, float] = 1
+
+  def __post_init__(self):
+    if self.mode not in ('binary', 'triplet'):
+      raise ValueError(f"Unsupported negative sampling mode {self.mode!r}")
+    if self.amount <= 0:
+      raise ValueError('amount must be positive')
+
+  def is_binary(self) -> bool:
+    return self.mode == 'binary'
+
+  def is_triplet(self) -> bool:
+    return self.mode == 'triplet'
+
+  def sample_size(self, num_pos: int) -> int:
+    return int(np.ceil(float(self.amount) * num_pos))
+
+
+@dataclasses.dataclass
+class EdgeSamplerInput(CastMixin):
+  """Seed edges for link-wise sampling.
+
+  Mirrors reference `sampler/base.py:148-203`.
+
+  Args:
+    row / col: ``[B]`` global endpoint ids.
+    label: optional ``[B]`` edge labels.
+    input_type: edge type for hetero sampling.
+    neg_sampling: negative sampling spec.
+  """
+  row: Union[np.ndarray, jax.Array]
+  col: Union[np.ndarray, jax.Array]
+  label: Optional[Union[np.ndarray, jax.Array]] = None
+  input_type: Optional[EdgeType] = None
+  neg_sampling: Optional[NegativeSampling] = None
+
+  def __len__(self) -> int:
+    return len(self.row)
+
+  def __getitem__(self, index) -> 'EdgeSamplerInput':
+    return EdgeSamplerInput(
+        self.row[index], self.col[index],
+        self.label[index] if self.label is not None else None,
+        self.input_type, self.neg_sampling)
+
+
+class SamplerOutput(CastMixin):
+  """Homogeneous sampling result — a static-shape pytree.
+
+  Mirrors reference `sampler/base.py:206-239` with the TPU padding
+  contract:
+
+  Attributes:
+    node: ``[node_capacity]`` global node ids in insertion order
+      (seeds first), INVALID_ID-padded; local index of ``node[i]`` = i.
+    node_count: scalar — number of valid entries in ``node``.
+    row / col: ``[edge_capacity]`` local COO (-1 when masked).  As in
+      the reference, edges are emitted *transposed* for PyG message
+      passing (`sampler/neighbor_sampler.py:159-166`): ``row`` is the
+      neighbor and ``col`` the seed side.
+    edge: ``[edge_capacity]`` global edge ids or None.
+    edge_mask: ``[edge_capacity]`` validity.
+    batch: ``[B]`` original (global) seed ids, INVALID_ID-padded.
+    num_sampled_nodes / num_sampled_edges: per-hop counts.
+    metadata: extra payload (e.g. link-prediction label indices).
+  """
+
+  def __init__(self, node, node_count, row, col, edge=None, edge_mask=None,
+               batch=None, num_sampled_nodes=None, num_sampled_edges=None,
+               device=None, metadata=None):
+    self.node = node
+    self.node_count = node_count
+    self.row = row
+    self.col = col
+    self.edge = edge
+    self.edge_mask = edge_mask
+    self.batch = batch
+    self.num_sampled_nodes = num_sampled_nodes
+    self.num_sampled_edges = num_sampled_edges
+    self.device = device
+    self.metadata = metadata if metadata is not None else {}
+
+  @property
+  def batch_size(self) -> int:
+    return 0 if self.batch is None else int(self.batch.shape[0])
+
+  def tree_flatten(self):
+    children = (self.node, self.node_count, self.row, self.col, self.edge,
+                self.edge_mask, self.batch, self.num_sampled_nodes,
+                self.num_sampled_edges, self.metadata)
+    return children, (self.device,)
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    (node, node_count, row, col, edge, edge_mask, batch, nsn, nse,
+     metadata) = children
+    return cls(node, node_count, row, col, edge, edge_mask, batch, nsn, nse,
+               aux[0], metadata)
+
+  def __repr__(self):
+    return (f'SamplerOutput(node={getattr(self.node, "shape", None)}, '
+            f'edges={getattr(self.row, "shape", None)})')
+
+
+jax.tree_util.register_pytree_node(
+    SamplerOutput,
+    lambda s: s.tree_flatten(),
+    SamplerOutput.tree_unflatten)
+
+
+class HeteroSamplerOutput(CastMixin):
+  """Heterogeneous sampling result keyed by node/edge type.
+
+  Mirrors reference `sampler/base.py:242-297`.
+
+  Attributes:
+    node: ``Dict[NodeType, [cap] ids]`` (+ ``node_count`` dict).
+    row / col / edge / edge_mask: ``Dict[EdgeType, [cap] arrays]``.
+    batch: ``Dict[NodeType, [B] seed ids]`` (seed types only).
+    edge_types: declared edge types (includes empty ones).
+    metadata: extra payload.
+  """
+
+  def __init__(self, node, node_count, row, col, edge=None, edge_mask=None,
+               batch=None, num_sampled_nodes=None, num_sampled_edges=None,
+               edge_types=None, device=None, metadata=None):
+    self.node = node
+    self.node_count = node_count
+    self.row = row
+    self.col = col
+    self.edge = edge
+    self.edge_mask = edge_mask
+    self.batch = batch
+    self.num_sampled_nodes = num_sampled_nodes
+    self.num_sampled_edges = num_sampled_edges
+    self.edge_types = edge_types
+    self.device = device
+    self.metadata = metadata if metadata is not None else {}
+
+  def get_edge_index(self) -> Dict[EdgeType, Any]:
+    """Local COO per edge type (reference `sampler/base.py:283-297`)."""
+    out = {}
+    for etype in (self.edge_types or self.row.keys()):
+      if etype in self.row:
+        out[etype] = jnp.stack([self.row[etype], self.col[etype]])
+    return out
+
+  def tree_flatten(self):
+    children = (self.node, self.node_count, self.row, self.col, self.edge,
+                self.edge_mask, self.batch, self.num_sampled_nodes,
+                self.num_sampled_edges, self.metadata)
+    return children, (tuple(self.edge_types or ()), self.device)
+
+  @classmethod
+  def tree_unflatten(cls, aux, children):
+    (node, node_count, row, col, edge, edge_mask, batch, nsn, nse,
+     metadata) = children
+    return cls(node, node_count, row, col, edge, edge_mask, batch, nsn, nse,
+               list(aux[0]), aux[1], metadata)
+
+  def __repr__(self):
+    return (f'HeteroSamplerOutput(node_types={list(self.node)}, '
+            f'edge_types={list(self.row)})')
+
+
+jax.tree_util.register_pytree_node(
+    HeteroSamplerOutput,
+    lambda s: s.tree_flatten(),
+    HeteroSamplerOutput.tree_unflatten)
+
+
+class SamplingType(enum.Enum):
+  """Reference `sampler/base.py:325-331`."""
+  NODE = 0
+  LINK = 1
+  SUBGRAPH = 2
+  RANDOM_WALK = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+  """Bundle of sampling options carried to (distributed) workers.
+
+  Mirrors reference `sampler/base.py:334-346`.
+  """
+  sampling_type: SamplingType
+  num_neighbors: Optional[NumNeighbors]
+  batch_size: int
+  shuffle: bool
+  drop_last: bool
+  with_edge: bool
+  collect_features: bool
+  with_neg: bool
+  with_weight: bool = False
+  edge_dir: str = 'out'
+  seed: Optional[int] = None
+
+
+class BaseSampler:
+  """Abstract sampler interface (reference `sampler/base.py:348-400`)."""
+
+  def sample_from_nodes(self, inputs: NodeSamplerInput, **kwargs):
+    raise NotImplementedError
+
+  def sample_from_edges(self, inputs: EdgeSamplerInput, **kwargs):
+    raise NotImplementedError
+
+  def subgraph(self, inputs: NodeSamplerInput, **kwargs):
+    raise NotImplementedError

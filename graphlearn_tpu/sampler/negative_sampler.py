@@ -1,0 +1,43 @@
+"""Standalone random negative sampler.
+
+Counterpart of reference `sampler/negative_sampler.py:21-51` — a thin
+class over the device op (`ops/negative.py`), returning a stacked
+``[2, req_num]`` edge_index like the reference.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..data.graph import Graph
+from ..ops.negative import sample_negative
+
+
+class RandomNegativeSampler:
+  """Draw random non-edges from a device graph.
+
+  Args:
+    graph: device graph handle.
+    seed: PRNG seed.
+  """
+
+  def __init__(self, graph: Graph, seed: int = 0):
+    self.graph = graph
+    self._base_key = jax.random.key(seed)
+    self._step = 0
+
+  def sample(self, req_num: int, trials_num: int = 5,
+             padding: bool = True) -> jax.Array:
+    """Returns ``[2, req_num]`` edge_index of sampled negative pairs.
+
+    ``padding=True`` guarantees a full output (possibly containing a
+    few false negatives), matching reference semantics.
+    """
+    self._step += 1
+    key = jax.random.fold_in(self._base_key, self._step)
+    res = sample_negative(
+        self.graph.indptr, self.graph.indices, int(req_num), key,
+        trials=int(trials_num), strict=True, padding=padding)
+    return jnp.stack([res.rows, res.cols])

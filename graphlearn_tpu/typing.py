@@ -126,11 +126,11 @@ class TablePartitionBook(PartitionBook):
     self._num_partitions = (int(num_partitions) if num_partitions is not None
                             else int(self.table.max()) + 1 if self.table.size
                             else 1)
+    self._device_table = None
 
   def __getitem__(self, ids):
-    import jax.numpy as jnp
     if isinstance(ids, jax.Array):
-      return jnp.asarray(self.table)[ids]
+      return self.to_device()[ids]
     return self.table[np.asarray(ids)]
 
   def __len__(self):
@@ -142,7 +142,9 @@ class TablePartitionBook(PartitionBook):
 
   def to_device(self):
     import jax.numpy as jnp
-    return jnp.asarray(self.table)
+    if self._device_table is None:
+      self._device_table = jnp.asarray(self.table)
+    return self._device_table
 
 
 class RangePartitionBook(PartitionBook):

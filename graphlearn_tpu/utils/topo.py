@@ -26,8 +26,16 @@ def coo_to_csr(
   """
   rows = np.asarray(rows)
   cols = np.asarray(cols)
+  max_row = int(rows.max(initial=-1))
   if num_nodes is None:
-    num_nodes = int(max(rows.max(initial=-1), cols.max(initial=-1))) + 1
+    num_nodes = int(max(max_row, cols.max(initial=-1))) + 1
+  elif max_row >= num_nodes:
+    # Row ids index indptr; columns may exceed the row count (bipartite
+    # CSR), so only rows are range-checked.
+    raise ValueError(
+        f'source node id {max_row} out of range for num_nodes={num_nodes}')
+  if len(rows) and int(min(rows.min(), cols.min())) < 0:
+    raise ValueError('edge endpoint ids must be non-negative')
   if edge_ids is None:
     edge_ids = np.arange(len(rows), dtype=np.int64)
   else:
