@@ -1,2 +1,5 @@
 from .topology import CSRTopo
 from .graph import Graph
+from .feature import Feature
+from .reorder import sort_by_in_degree, sort_by_hotness
+from .dataset import Dataset

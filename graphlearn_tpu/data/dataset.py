@@ -1,0 +1,198 @@
+"""User-facing data manager: graphs + features + labels, homo or hetero.
+
+Counterpart of reference `data/dataset.py:29-336` (``Dataset``): owns the
+device graph handles, the two-tier feature stores and label arrays, for
+a homogeneous graph or a dict-of-edge-type heterogeneous one.  The
+reference's IPC/ForkingPickler machinery has no TPU counterpart — JAX is
+single-controller per host; cross-process handoff is replaced by the
+host-side producer pipeline (:mod:`graphlearn_tpu.channel`).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Union
+
+import numpy as np
+
+from ..typing import EdgeType, NodeType, as_str
+from ..utils.tensor import convert_to_array
+from .feature import Feature
+from .graph import Graph
+from .reorder import sort_by_in_degree
+from .topology import CSRTopo
+
+
+class Dataset:
+  """Holds graph topology, features and labels ready for sampling.
+
+  All ``init_*`` methods accept either a single value (homogeneous) or a
+  ``dict`` keyed by node/edge type (heterogeneous), mirroring reference
+  `data/dataset.py:44-219`.
+  """
+
+  def __init__(self,
+               graph: Union[Graph, Dict[EdgeType, Graph], None] = None,
+               node_features=None, edge_features=None, node_labels=None,
+               node_split=None):
+    self.graph = graph
+    self.node_features = node_features
+    self.edge_features = edge_features
+    self.node_labels = node_labels
+    self.node_split = node_split
+
+  # -- graph --------------------------------------------------------------
+  def init_graph(self, edge_index=None, edge_ids=None, layout='COO',
+                 graph_mode: str = 'device', device=None,
+                 num_nodes=None):
+    """Build device graph handle(s) from COO/CSR/CSC input.
+
+    Mirrors reference `Dataset.init_graph` (`data/dataset.py:44-100`).
+    ``edge_index`` may be a dict keyed by ``EdgeType`` for hetero.
+    """
+    if edge_index is None:
+      return self
+    if isinstance(edge_index, dict):
+      topos = {}
+      for etype, ei in edge_index.items():
+        eids = edge_ids.get(etype) if isinstance(edge_ids, dict) else None
+        lay = layout.get(etype) if isinstance(layout, dict) else layout
+        nn = num_nodes.get(etype) if isinstance(num_nodes, dict) else num_nodes
+        topos[etype] = CSRTopo(ei, edge_ids=eids, layout=lay, num_nodes=nn)
+      self.graph = {
+          etype: Graph(t, mode=graph_mode, device=device)
+          for etype, t in topos.items()
+      }
+    else:
+      topo = CSRTopo(edge_index, edge_ids=edge_ids, layout=layout,
+                     num_nodes=num_nodes)
+      self.graph = Graph(topo, mode=graph_mode, device=device)
+    return self
+
+  # -- features ------------------------------------------------------------
+  def init_node_features(self, node_feature_data=None, id2idx=None,
+                         sort_func: Optional[Callable] = None,
+                         split_ratio: float = 1.0, device=None, dtype=None):
+    """Create node feature store(s).
+
+    ``sort_func`` (e.g. :func:`sort_by_in_degree`) reorders rows
+    hottest-first and supplies the id→row map, exactly the reference's
+    cache-ordering hook (`data/dataset.py:102-162`).
+    """
+    if node_feature_data is None:
+      return self
+    if isinstance(node_feature_data, dict):
+      self.node_features = {}
+      for ntype, feats in node_feature_data.items():
+        i2i = id2idx.get(ntype) if isinstance(id2idx, dict) else None
+        self.node_features[ntype] = self._build_feature(
+            feats, i2i, sort_func, split_ratio, device, dtype,
+            topo=self._topo_for_ntype(ntype))
+    else:
+      topo = self.graph.csr_topo if isinstance(self.graph, Graph) else None
+      self.node_features = self._build_feature(
+          node_feature_data, id2idx, sort_func, split_ratio, device, dtype,
+          topo=topo)
+    return self
+
+  def _topo_for_ntype(self, ntype: NodeType) -> Optional[CSRTopo]:
+    if not isinstance(self.graph, dict):
+      return None
+    candidate = None
+    for (src, _, dst), g in self.graph.items():
+      if dst == ntype:   # in-degree hotness counts incoming edges
+        return g.csr_topo
+      if src == ntype:
+        candidate = g.csr_topo
+    return candidate
+
+  def _build_feature(self, feats, id2idx, sort_func, split_ratio, device,
+                     dtype, topo: Optional[CSRTopo]) -> Feature:
+    feats = convert_to_array(feats)
+    if sort_func is not None and id2idx is None and topo is not None \
+        and 0.0 < split_ratio < 1.0:
+      # Contract: sort_func(feats, split_ratio, topo) -> (feats, id2index),
+      # i.e. `sort_by_in_degree`-shaped.  Score-based sorters
+      # (`sort_by_hotness`) take precomputed scores — apply those before
+      # init and pass `id2idx` instead.
+      feats, id2idx = sort_func(feats, split_ratio, topo)
+    return Feature(feats, id2index=id2idx, split_ratio=split_ratio,
+                   device=device, dtype=dtype)
+
+  def init_edge_features(self, edge_feature_data=None, id2idx=None,
+                         split_ratio: float = 1.0, device=None, dtype=None):
+    """Mirrors reference `Dataset.init_edge_features`
+    (`data/dataset.py:164-205`)."""
+    if edge_feature_data is None:
+      return self
+    if isinstance(edge_feature_data, dict):
+      self.edge_features = {
+          etype: Feature(convert_to_array(f),
+                         id2index=(id2idx.get(etype)
+                                   if isinstance(id2idx, dict) else None),
+                         split_ratio=split_ratio, device=device, dtype=dtype)
+          for etype, f in edge_feature_data.items()
+      }
+    else:
+      self.edge_features = Feature(convert_to_array(edge_feature_data),
+                                   id2index=id2idx, split_ratio=split_ratio,
+                                   device=device, dtype=dtype)
+    return self
+
+  def init_node_labels(self, node_label_data=None):
+    """Mirrors reference `Dataset.init_node_labels`
+    (`data/dataset.py:207-219`)."""
+    if node_label_data is None:
+      return self
+    if isinstance(node_label_data, dict):
+      self.node_labels = {k: convert_to_array(v)
+                          for k, v in node_label_data.items()}
+    else:
+      self.node_labels = convert_to_array(node_label_data)
+    return self
+
+  # -- typed getters (reference `data/dataset.py:230-278`) ------------------
+  def get_graph(self, etype: Optional[EdgeType] = None):
+    if isinstance(self.graph, dict):
+      return self.graph.get(etype) if etype is not None else self.graph
+    return self.graph
+
+  def get_node_feature(self, ntype: Optional[NodeType] = None):
+    if isinstance(self.node_features, dict):
+      return self.node_features.get(ntype)
+    return self.node_features
+
+  def get_edge_feature(self, etype: Optional[EdgeType] = None):
+    if isinstance(self.edge_features, dict):
+      return self.edge_features.get(etype)
+    return self.edge_features
+
+  def get_node_label(self, ntype: Optional[NodeType] = None):
+    if isinstance(self.node_labels, dict):
+      return self.node_labels.get(ntype)
+    return self.node_labels
+
+  def get_node_types(self):
+    ntypes = set()
+    if isinstance(self.graph, dict):
+      for (src, _, dst) in self.graph:
+        ntypes.add(src)
+        ntypes.add(dst)
+    if isinstance(self.node_features, dict):
+      ntypes.update(self.node_features.keys())
+    if isinstance(self.node_labels, dict):
+      ntypes.update(self.node_labels.keys())
+    return sorted(ntypes)
+
+  def get_edge_types(self):
+    if isinstance(self.graph, dict):
+      return list(self.graph.keys())
+    return None
+
+  @property
+  def is_hetero(self) -> bool:
+    return isinstance(self.graph, dict)
+
+  def __repr__(self):
+    if self.is_hetero:
+      etypes = ', '.join(as_str(e) for e in self.graph)
+      return f'Dataset(hetero, edge_types=[{etypes}])'
+    return f'Dataset(graph={self.graph!r})'

@@ -1,0 +1,168 @@
+"""Two-tier feature store: HBM-resident hot rows + host-DRAM cold rows.
+
+TPU-native replacement for the reference's ``UnifiedTensor``/``Feature``
+stack (`csrc/cuda/unified_tensor.cu:29-96` — per-row warp gather across
+{local HBM, peer-GPU HBM via NVLink, pinned host via UVA};
+`data/feature.py:31-280` — split_ratio hot/cold split + DeviceGroup
+sharding).  TPUs have no UVA and no per-warp gather kernel to write: the
+idiomatic mapping is
+
+  * **hot tier**: the first ``split_ratio`` fraction of rows (callers
+    pre-sort by hotness, see :func:`~graphlearn_tpu.data.reorder.
+    sort_by_in_degree`) lives as a `jax.Array` in device HBM; lookups
+    are a single fused XLA gather feeding the MXU directly.
+  * **cold tier**: remaining rows stay in TPU-VM host DRAM (numpy);
+    misses are gathered on host and `device_put` once per batch —
+    the explicit, async analog of the reference's UVA reads.
+
+The reference's ``DeviceGroup`` replication/sharding across NVLink
+cliques maps to sharding the hot tier over a `jax.sharding.Mesh` (see
+:mod:`graphlearn_tpu.parallel`); single-device behavior is here.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.tensor import convert_to_array
+
+
+class Feature:
+  """Hot/cold split feature table addressed by global ids.
+
+  Args:
+    feature_array: ``[N, D]`` host array, rows assumed ordered
+      hottest-first when ``split_ratio < 1`` (use ``sort_by_in_degree``).
+    id2index: optional ``[max_id+1]`` map from global id to storage row
+      (produced by hotness reordering); identity when ``None``.
+    split_ratio: fraction of rows resident in device HBM.  ``1.0`` pins
+      everything on device (DMA mode analog), ``0.0`` keeps everything
+      on host (CPU mode analog).
+    device: optional explicit device for the hot tier.
+    dtype: optional storage dtype for the hot tier (e.g. ``bfloat16`` —
+      halves HBM footprint and feeds the MXU natively).
+  """
+
+  def __init__(self, feature_array, id2index: Optional[np.ndarray] = None,
+               split_ratio: float = 1.0,
+               device: Optional[jax.Device] = None,
+               dtype=None):
+    feats = convert_to_array(feature_array)
+    if feats.ndim == 1:
+      feats = feats[:, None]
+    self._host_feats = feats
+    self._id2index_host = (np.asarray(id2index, dtype=np.int64)
+                           if id2index is not None else None)
+    self.split_ratio = float(split_ratio)
+    self._device = device
+    self._dtype = dtype
+    self._hot = None            # jax.Array [hot_rows, D] (lazy)
+    self._id2index_dev = None   # jax.Array (lazy)
+    n = feats.shape[0]
+    self.hot_rows = int(round(n * self.split_ratio))
+    self.hot_rows = max(0, min(self.hot_rows, n))
+
+  # -- lazy device residency (reference `Feature.lazy_init*`,
+  # `data/feature.py:208-258`) -------------------------------------------
+  def lazy_init(self):
+    if self._hot is not None or self.hot_rows == 0:
+      return
+    dev = self._device or jax.devices()[0]
+    hot = self._host_feats[:self.hot_rows]
+    if self._dtype is not None:
+      hot = hot.astype(self._dtype)
+    self._hot = jax.device_put(hot, dev)
+    if self._id2index_host is not None:
+      self._id2index_dev = jax.device_put(self._id2index_host, dev)
+
+  @property
+  def shape(self):
+    return self._host_feats.shape
+
+  @property
+  def dtype(self):
+    return self._dtype or self._host_feats.dtype
+
+  @property
+  def feature_dim(self) -> int:
+    return self._host_feats.shape[1]
+
+  def size(self, dim: int = 0) -> int:
+    return self._host_feats.shape[dim]
+
+  @property
+  def hot_tier(self) -> Optional[jax.Array]:
+    """The device-resident block (rows ``[0, hot_rows)``), for callers
+    that gather inside jit when the whole table is HBM-resident."""
+    self.lazy_init()
+    return self._hot
+
+  # -- lookup -------------------------------------------------------------
+  def __getitem__(self, ids) -> jax.Array:
+    """Gather rows by global id onto the device.
+
+    Counterpart of reference `Feature.__getitem__`
+    (`data/feature.py:141-154`) → `GatherTensorKernel`.  Invalid ids
+    (< 0, the padding sentinel) return zero rows, so padded batches
+    flow straight into the model.
+    """
+    self.lazy_init()
+    ids_host = np.asarray(ids)
+    valid = ids_host >= 0
+    idx = np.where(valid, ids_host, 0)
+    if self._id2index_host is not None:
+      idx = self._id2index_host[idx]
+      valid &= idx >= 0  # partial maps hold -1 for unmapped ids
+      idx = np.where(valid, idx, 0)
+    d = self.feature_dim
+
+    if self.hot_rows >= self._host_feats.shape[0]:
+      # Fully HBM-resident: one fused device gather.
+      out = jnp.take(self._hot, jnp.asarray(idx), axis=0)
+      return jnp.where(jnp.asarray(valid)[:, None], out, 0)
+
+    cold_sel = valid & (idx >= self.hot_rows)
+    if self.hot_rows == 0 or not cold_sel.any():
+      if self.hot_rows == 0:
+        # Fully host-resident: gather on host, one transfer.
+        out = np.zeros((len(ids_host), d), dtype=self._host_feats.dtype)
+        out[valid] = self._host_feats[idx[valid]]
+        return jnp.asarray(out if self._dtype is None
+                           else out.astype(self._dtype))
+      out = jnp.take(self._hot, jnp.asarray(np.where(cold_sel, 0, idx)),
+                     axis=0)
+      return jnp.where(jnp.asarray(valid)[:, None], out, 0)
+
+    # Mixed: device gather for hot, host gather + one device_put for cold.
+    hot_idx = np.where(cold_sel, 0, idx)
+    out = jnp.take(self._hot, jnp.asarray(hot_idx), axis=0)
+    out = jnp.where(jnp.asarray(valid & ~cold_sel)[:, None], out, 0)
+    cold_vals = self._host_feats[idx[cold_sel]]
+    if self._dtype is not None:
+      cold_vals = cold_vals.astype(self._dtype)
+    cold_pos = jnp.asarray(np.nonzero(cold_sel)[0])
+    return out.at[cold_pos].set(jnp.asarray(cold_vals))
+
+  def host_get(self, ids=None) -> np.ndarray:
+    """Host-side gather (reference ``Feature.cpu_get``,
+    `data/feature.py:156`); full table when ``ids`` is None."""
+    if ids is None:
+      return self._host_feats
+    ids = np.asarray(ids)
+    valid = ids >= 0
+    idx = np.where(valid, ids, 0)
+    if self._id2index_host is not None:
+      idx = self._id2index_host[idx]
+      valid &= idx >= 0
+      idx = np.where(valid, idx, 0)
+    out = np.zeros((len(ids), self.feature_dim),
+                   dtype=self._host_feats.dtype)
+    out[valid] = self._host_feats[idx[valid]]
+    return out
+
+  def __repr__(self):
+    return (f'Feature(shape={self._host_feats.shape}, '
+            f'split_ratio={self.split_ratio}, hot_rows={self.hot_rows})')

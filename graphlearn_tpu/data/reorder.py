@@ -1,0 +1,56 @@
+"""Hotness reordering for the feature cache.
+
+Counterpart of reference `data/reorder.py:19-31`
+(``sort_by_in_degree``): order feature rows so the most-accessed nodes
+occupy the leading rows, which the :class:`~graphlearn_tpu.data.feature.
+Feature` store pins in HBM.  In-degree is the access proxy — under
+uniform neighbor sampling a node is touched proportionally to how many
+edges point at it.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .topology import CSRTopo
+
+
+def sort_by_in_degree(
+    feature_array: np.ndarray,
+    split_ratio: float,
+    csr_topo: CSRTopo,
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Reorder rows hottest-first by in-degree.
+
+  Args:
+    feature_array: ``[N, D]`` host features indexed by global id.
+    split_ratio: fraction destined for the HBM tier (only used to report
+      how much of the table the reorder actually protects; the full
+      permutation is applied regardless, matching the reference).
+    csr_topo: out-edge CSR; in-degree is computed by counting each id's
+      appearances in ``indices``.
+
+  Returns:
+    ``(reordered_feats, id2index)`` where
+    ``reordered_feats[id2index[v]] == feature_array[v]``.
+  """
+  feats = np.asarray(feature_array)
+  in_deg = np.bincount(csr_topo.indices, minlength=feats.shape[0])
+  in_deg = in_deg[:feats.shape[0]]
+  del split_ratio  # full permutation either way; ratio applied by Feature
+  return sort_by_hotness(feats, in_deg)
+
+
+def sort_by_hotness(
+    feature_array: np.ndarray,
+    hotness: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Same contract with an arbitrary hotness score (e.g. sampling
+  probabilities from :func:`graphlearn_tpu.ops.cal_nbr_prob`, the
+  frequency-partitioner signal)."""
+  feats = np.asarray(feature_array)
+  order = np.argsort(-np.asarray(hotness), kind='stable')
+  id2index = np.empty(feats.shape[0], dtype=np.int64)
+  id2index[order] = np.arange(feats.shape[0], dtype=np.int64)
+  return feats[order], id2index

@@ -187,6 +187,8 @@ class NeighborSampler(BaseSampler):
     src = jnp.asarray(np.asarray(inputs.row, dtype=np.int32))
     dst = jnp.asarray(np.asarray(inputs.col, dtype=np.int32))
     b = src.shape[0]
+    # Static-batch padding: (-1, -1) pairs are mask-outs, never examples.
+    pair_valid = (src >= 0) & (dst >= 0)
     key = self._next_key()
 
     if neg is None:
@@ -197,6 +199,7 @@ class NeighborSampler(BaseSampler):
           'edge_label_index': jnp.stack([sl[:b], sl[b:2 * b]]),
           'edge_label': (inputs.label if inputs.label is not None
                          else jnp.ones((b,), jnp.int32)),
+          'edge_label_mask': pair_valid,
           'seed_local': sl,
       }
       return out
@@ -220,9 +223,12 @@ class NeighborSampler(BaseSampler):
       # labels then zeros.
       edge_label = jnp.concatenate(
           [pos_label, jnp.zeros((num_neg,), pos_label.dtype)])
+      edge_label_mask = jnp.concatenate(
+          [pair_valid, jnp.ones((num_neg,), jnp.bool_)])
       out.metadata = {
           'edge_label_index': edge_label_index,
           'edge_label': edge_label,
+          'edge_label_mask': edge_label_mask,
           'seed_local': sl,
       }
       return out
@@ -238,6 +244,7 @@ class NeighborSampler(BaseSampler):
         'src_index': sl[:b],
         'dst_pos_index': sl[b:2 * b],
         'dst_neg_index': sl[2 * b:].reshape(b, amount),
+        'pair_mask': pair_valid,
         'seed_local': sl,
     }
     return out

@@ -1,0 +1,130 @@
+"""Feature store tests.
+
+Mirrors the reference's `test/python/test_feature.py` intent: id→row
+mapping, hot/cold split correctness, dtype handling — on the TPU
+two-tier design instead of UnifiedTensor DeviceGroups.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from graphlearn_tpu.data import CSRTopo, Dataset, Feature, sort_by_in_degree
+
+
+def _feats(n=32, d=8):
+  return (np.arange(n, dtype=np.float32)[:, None]
+          * np.ones((1, d), np.float32))
+
+
+def test_full_device_lookup():
+  f = Feature(_feats(), split_ratio=1.0)
+  ids = np.array([3, 0, 31, 7])
+  out = np.asarray(f[ids])
+  np.testing.assert_allclose(out[:, 0], [3, 0, 31, 7])
+
+
+def test_full_host_lookup():
+  f = Feature(_feats(), split_ratio=0.0)
+  ids = np.array([5, 2])
+  out = np.asarray(f[ids])
+  np.testing.assert_allclose(out[:, 0], [5, 2])
+
+
+def test_mixed_tier_lookup():
+  f = Feature(_feats(), split_ratio=0.25)  # rows 0-7 hot, 8-31 cold
+  assert f.hot_rows == 8
+  ids = np.array([1, 9, 7, 30, 0])
+  out = np.asarray(f[ids])
+  np.testing.assert_allclose(out[:, 0], [1, 9, 7, 30, 0])
+
+
+def test_invalid_ids_zero_rows():
+  for ratio in (1.0, 0.25, 0.0):
+    f = Feature(_feats(), split_ratio=ratio)
+    out = np.asarray(f[np.array([-1, 4, -1])])
+    np.testing.assert_allclose(out[0], 0)
+    np.testing.assert_allclose(out[2], 0)
+    np.testing.assert_allclose(out[1, 0], 4)
+
+
+def test_id2index_mapping():
+  feats = _feats()
+  # Reversed storage order: global id v lives at row N-1-v.
+  id2index = np.arange(31, -1, -1)
+  stored = feats[::-1].copy()
+  f = Feature(stored, id2index=id2index, split_ratio=0.5)
+  out = np.asarray(f[np.array([0, 31, 16])])
+  np.testing.assert_allclose(out[:, 0], [0, 31, 16])
+
+
+def test_bfloat16_storage():
+  f = Feature(_feats(), split_ratio=1.0, dtype=jnp.bfloat16)
+  out = f[np.array([2, 3])]
+  assert out.dtype == jnp.bfloat16
+  np.testing.assert_allclose(np.asarray(out, np.float32)[:, 0], [2, 3])
+
+
+def test_sort_by_in_degree_roundtrip():
+  # Star graph: node 0 is pointed at by everyone → hottest.
+  n = 10
+  rows = np.arange(1, n)
+  cols = np.zeros(n - 1, dtype=np.int64)
+  topo = CSRTopo((rows, cols), num_nodes=n)
+  feats = _feats(n, 4)
+  reordered, id2index = sort_by_in_degree(feats, 0.3, topo)
+  assert id2index[0] == 0  # hottest row first
+  f = Feature(reordered, id2index=id2index, split_ratio=0.3)
+  out = np.asarray(f[np.arange(n)])
+  np.testing.assert_allclose(out[:, 0], np.arange(n))
+
+
+def test_host_get():
+  f = Feature(_feats(), split_ratio=0.5)
+  out = f.host_get(np.array([4, 20]))
+  np.testing.assert_allclose(out[:, 0], [4, 20])
+
+
+def test_dataset_homo():
+  rows = np.array([0, 1, 2, 3])
+  cols = np.array([1, 2, 3, 0])
+  ds = (Dataset()
+        .init_graph((rows, cols), layout='COO')
+        .init_node_features(_feats(4, 4), split_ratio=1.0)
+        .init_node_labels(np.array([0, 1, 0, 1])))
+  assert not ds.is_hetero
+  assert ds.get_graph().num_nodes == 4
+  out = np.asarray(ds.get_node_feature()[np.array([2])])
+  np.testing.assert_allclose(out[0, 0], 2)
+  assert ds.get_node_label()[1] == 1
+
+
+def test_dataset_hetero():
+  ei = {
+      ('user', 'clicks', 'item'): (np.array([0, 1]), np.array([1, 0])),
+      ('item', 'rev_clicks', 'user'): (np.array([1, 0]), np.array([0, 1])),
+  }
+  ds = (Dataset()
+        .init_graph(ei, layout='COO')
+        .init_node_features({'user': _feats(2, 4), 'item': _feats(2, 4)},
+                            split_ratio=1.0))
+  assert ds.is_hetero
+  assert set(ds.get_node_types()) == {'user', 'item'}
+  assert len(ds.get_edge_types()) == 2
+  g = ds.get_graph(('user', 'clicks', 'item'))
+  assert g.num_edges == 2
+
+
+def test_partial_id2index_unmapped_returns_zero():
+  # id2index built from a partial id set: unmapped ids hold -1 and must
+  # come back as zero rows, not the last storage row.
+  from graphlearn_tpu.utils.tensor import id2idx
+  stored = _feats(3, 4)
+  mapping = id2idx(np.array([5, 7, 9]), max_id=9)
+  for ratio in (1.0, 0.5, 0.0):
+    f = Feature(stored, id2index=mapping, split_ratio=ratio)
+    out = np.asarray(f[np.array([6, 5, 9])])
+    np.testing.assert_allclose(out[0], 0)
+    np.testing.assert_allclose(out[1, 0], 0)   # id 5 -> row 0
+    np.testing.assert_allclose(out[2, 0], 2)   # id 9 -> row 2
+  out = Feature(stored, id2index=mapping).host_get(np.array([6, 7]))
+  np.testing.assert_allclose(out[0], 0)
+  np.testing.assert_allclose(out[1, 0], 1)
