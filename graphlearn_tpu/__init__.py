@@ -1,0 +1,14 @@
+"""graphlearn_tpu: a TPU-native GNN data-plane framework.
+
+Brand-new JAX/XLA/Pallas re-design with the capability set of
+graphlearn-for-pytorch (reference mounted at /root/reference): device
+graph sampling, tiered feature storage, PyG-vocabulary loaders, and a
+distributed (ICI-collective) runtime — built for TPU from the ground
+up: static shapes + masks, counter-based PRNG, pjit/shard_map
+parallelism instead of RPC.
+"""
+from . import data, loader, ops, sampler, utils
+from .typing import (EdgeType, NodeType, RangePartitionBook, Split,
+                     TablePartitionBook, as_str, reverse_edge_type)
+
+__version__ = '0.1.0'

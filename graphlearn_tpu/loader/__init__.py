@@ -1,0 +1,3 @@
+from .transform import Batch, HeteroBatch, to_data, to_hetero_data
+from .node_loader import NodeLoader, SeedBatcher
+from .neighbor_loader import NeighborLoader

@@ -1,0 +1,42 @@
+"""Neighbor-sampling mini-batch loader (the headline single-chip API).
+
+Counterpart of reference `loader/neighbor_loader.py:27-106`
+(``NeighborLoader``): a `NodeLoader` wired to a `NeighborSampler`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..data.dataset import Dataset
+from ..sampler.neighbor_sampler import NeighborSampler
+from .node_loader import NodeLoader
+
+
+class NeighborLoader(NodeLoader):
+  """Multi-hop uniform neighbor-sampling loader.
+
+  Example::
+
+      loader = NeighborLoader(dataset, [15, 10, 5], train_idx,
+                              batch_size=1024, shuffle=True)
+      for batch in loader:
+        loss = train_step(state, batch)
+
+  Args:
+    data: `Dataset` with an initialized homogeneous graph.
+    num_neighbors: per-hop fanouts.
+    input_nodes: seed ids (or boolean mask).
+    with_edge: emit global edge ids (+ edge features if present).
+    seed: PRNG seed for sampling & shuffling.
+  """
+
+  def __init__(self, data: Dataset, num_neighbors: Sequence[int],
+               input_nodes, batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               device=None, seed: Optional[int] = None, **kwargs):
+    sampler = NeighborSampler(
+        data.get_graph(), num_neighbors, device=device,
+        with_edge=with_edge, seed=seed or 0)
+    super().__init__(data, sampler, input_nodes, batch_size=batch_size,
+                     shuffle=shuffle, drop_last=drop_last, seed=seed,
+                     **kwargs)

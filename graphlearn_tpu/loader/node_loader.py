@@ -1,0 +1,110 @@
+"""Node-wise mini-batch loader.
+
+Counterpart of reference `loader/node_loader.py:27-113` (``NodeLoader``):
+iterate seed ids in (optionally shuffled) batches, run the sampler, and
+collate features/labels into a `Batch` pytree.  Where the reference
+leans on `torch.utils.data.DataLoader` for seed batching, the TPU
+version batches on the host with numpy and **pads the tail batch to the
+static batch size** so every step reuses one compiled program.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..sampler.base import BaseSampler, NodeSamplerInput
+from ..utils.padding import INVALID_ID, pad_1d
+from .transform import Batch, to_data
+
+
+class SeedBatcher:
+  """Host-side seed iterator: shuffle, slice, pad to static size."""
+
+  def __init__(self, seeds: np.ndarray, batch_size: int,
+               shuffle: bool = False, drop_last: bool = False,
+               seed: Optional[int] = None):
+    self.seeds = np.asarray(seeds).reshape(-1)
+    self.batch_size = int(batch_size)
+    self.shuffle = shuffle
+    self.drop_last = drop_last
+    self._rng = np.random.default_rng(seed)
+    self._epoch_order = None
+    self._pos = 0
+
+  def __len__(self) -> int:
+    n = len(self.seeds)
+    if self.drop_last:
+      return n // self.batch_size
+    return -(-n // self.batch_size)
+
+  def reset(self):
+    self._epoch_order = (self._rng.permutation(len(self.seeds))
+                         if self.shuffle else np.arange(len(self.seeds)))
+    self._pos = 0
+
+  def __iter__(self):
+    self.reset()
+    return self
+
+  def __next__(self) -> np.ndarray:
+    n = len(self.seeds)
+    if self._pos >= n:
+      raise StopIteration
+    end = self._pos + self.batch_size
+    if end > n and self.drop_last:
+      raise StopIteration
+    idx = self._epoch_order[self._pos:end]
+    self._pos = end
+    batch = self.seeds[idx].astype(np.int32)
+    if len(batch) < self.batch_size:
+      batch = pad_1d(batch, self.batch_size, INVALID_ID)
+    return batch
+
+
+class NodeLoader:
+  """Base loader: seeds → sampler → collate.
+
+  Args:
+    data: the `Dataset` (graph + features + labels).
+    sampler: any `BaseSampler` with ``sample_from_nodes``.
+    input_nodes: ``[N]`` seed ids (e.g. the train split).
+    batch_size / shuffle / drop_last: epoch iteration controls.
+    seed: shuffling seed.
+  """
+
+  def __init__(self, data: Dataset, sampler: BaseSampler, input_nodes,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, seed: Optional[int] = None,
+               **kwargs):
+    self.data = data
+    self.sampler = sampler
+    input_nodes = np.asarray(input_nodes)
+    if input_nodes.dtype == np.bool_:
+      input_nodes = np.nonzero(input_nodes)[0]
+    self._batcher = SeedBatcher(input_nodes, batch_size, shuffle, drop_last,
+                                seed)
+    self.batch_size = int(batch_size)
+
+  def __len__(self) -> int:
+    return len(self._batcher)
+
+  def __iter__(self) -> Iterator[Batch]:
+    self._seed_iter = iter(self._batcher)
+    return self
+
+  def __next__(self) -> Batch:
+    seeds = next(self._seed_iter)
+    out = self.sampler.sample_from_nodes(NodeSamplerInput(node=seeds))
+    return self._collate_fn(out)
+
+  def _collate_fn(self, out) -> Batch:
+    """Gather features/labels for sampled nodes and build the batch
+    (reference `loader/node_loader.py:85-113`)."""
+    return to_data(
+        out,
+        node_feature=self.data.get_node_feature(),
+        node_label=self.data.get_node_label(),
+        edge_feature=(self.data.get_edge_feature()
+                      if out.edge is not None else None))

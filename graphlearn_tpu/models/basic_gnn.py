@@ -1,0 +1,64 @@
+"""Stacked GNN models over padded batches.
+
+The TPU counterparts of the PyG models the reference's examples train
+(GraphSAGE: `examples/train_sage_ogbn_products.py`; GAT/GCN variants in
+`examples/`).  Each model is a flax module whose ``__call__`` takes
+``(x, edge_index, edge_mask)`` — the `Batch` pytree fields — and
+returns per-node embeddings/logits over the static node table.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .conv import GATConv, GCNConv, SAGEConv
+
+
+class BasicGNN(nn.Module):
+  """L-layer stack: conv → relu → dropout, last layer linear."""
+  hidden_features: int
+  out_features: int
+  num_layers: int = 2
+  dropout: float = 0.0
+  aggr: str = 'mean'
+
+  def make_conv(self, out_features: int, idx: int) -> nn.Module:
+    raise NotImplementedError
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask=None, *, train: bool = False):
+    for i in range(self.num_layers):
+      last = i == self.num_layers - 1
+      out = self.out_features if last else self.hidden_features
+      x = self.make_conv(out, i)(x, edge_index, edge_mask)
+      if not last:
+        x = nn.relu(x)
+        if self.dropout > 0:
+          x = nn.Dropout(self.dropout, deterministic=not train)(x)
+    return x
+
+
+class GraphSAGE(BasicGNN):
+  """The flagship model (reference flagship example
+  `examples/train_sage_ogbn_products.py`: 3 layers, hidden 256)."""
+
+  def make_conv(self, out_features: int, idx: int) -> nn.Module:
+    return SAGEConv(out_features, aggr=self.aggr, name=f'conv{idx}')
+
+
+class GCN(BasicGNN):
+
+  def make_conv(self, out_features: int, idx: int) -> nn.Module:
+    return GCNConv(out_features, name=f'conv{idx}')
+
+
+class GAT(BasicGNN):
+  heads: int = 4
+
+  def make_conv(self, out_features: int, idx: int) -> nn.Module:
+    last = idx == self.num_layers - 1
+    return GATConv(out_features if last else out_features // self.heads,
+                   heads=self.heads, concat=not last, name=f'conv{idx}')

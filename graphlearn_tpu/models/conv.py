@@ -1,0 +1,150 @@
+"""Message-passing convolutions on padded COO batches (flax).
+
+The reference deliberately leaves model compute to PyG
+(`README.md` "Architecture Overview"); its examples train PyG's
+``SAGEConv``/``GATConv``/HGT on the batches GLT loads.  A standalone
+TPU framework has no PyG to lean on, so the model family lives here —
+designed for the padding contract: edges are ``[2, E]`` local COO with
+-1 masked slots, aggregation is `segment_sum` over static-size node
+tables (XLA lowers this to fused one-hot matmuls / scatter on the MXU;
+no atomics, no dynamic shapes).
+
+Edge direction follows the loader's transposed emission
+(reference `sampler/neighbor_sampler.py:159-166`): ``edge_index[0]`` is
+the message *source* (sampled neighbor), ``edge_index[1]`` the
+*target* (seed side) — i.e. messages flow src→dst like PyG.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def segment_mean(data: jax.Array, segment_ids: jax.Array,
+                 num_segments: int, mask: Optional[jax.Array] = None
+                 ) -> jax.Array:
+  """Masked mean-aggregation of edge messages into node slots.
+
+  Invalid edges (mask False or negative target) are routed to segment
+  ``num_segments`` which is out of range and therefore dropped by XLA's
+  segment_sum — the standard static-shape trick.
+  """
+  if mask is not None:
+    segment_ids = jnp.where(mask, segment_ids, num_segments)
+  else:
+    segment_ids = jnp.where(segment_ids >= 0, segment_ids, num_segments)
+  tot = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+  cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                            segment_ids, num_segments=num_segments)
+  return tot / jnp.maximum(cnt, 1.0)[:, None]
+
+
+def segment_max(data: jax.Array, segment_ids: jax.Array,
+                num_segments: int, mask: Optional[jax.Array] = None
+                ) -> jax.Array:
+  if mask is not None:
+    segment_ids = jnp.where(mask, segment_ids, num_segments)
+  out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+  return jnp.where(jnp.isfinite(out), out, 0.0)
+
+
+class SAGEConv(nn.Module):
+  """GraphSAGE convolution (mean aggregator).
+
+  ``out[v] = W_l · x[v] + W_r · mean_{u→v} x[u]`` — the layer the
+  reference's flagship examples use via PyG
+  (`examples/train_sage_ogbn_products.py`).
+  """
+  out_features: int
+  use_bias: bool = True
+  aggr: str = 'mean'
+
+  @nn.compact
+  def __call__(self, x: jax.Array, edge_index: jax.Array,
+               edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    n = x.shape[0]
+    src, dst = edge_index[0], edge_index[1]
+    msg = x[jnp.clip(src, 0, n - 1)]
+    if self.aggr == 'mean':
+      agg = segment_mean(msg, dst, n, edge_mask)
+    elif self.aggr == 'max':
+      agg = segment_max(msg, dst, n, edge_mask)
+    elif self.aggr == 'sum':
+      seg = jnp.where(edge_mask, dst, n) if edge_mask is not None else dst
+      agg = jax.ops.segment_sum(msg, seg, num_segments=n)
+    else:
+      raise ValueError(f'Unknown aggr {self.aggr!r}')
+    out = (nn.Dense(self.out_features, use_bias=self.use_bias,
+                    name='lin_self')(x)
+           + nn.Dense(self.out_features, use_bias=False,
+                      name='lin_neigh')(agg))
+    return out
+
+
+class GCNConv(nn.Module):
+  """Graph convolution with symmetric degree normalization (masked)."""
+  out_features: int
+  use_bias: bool = True
+
+  @nn.compact
+  def __call__(self, x: jax.Array, edge_index: jax.Array,
+               edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    n = x.shape[0]
+    src, dst = edge_index[0], edge_index[1]
+    valid = edge_mask if edge_mask is not None else (dst >= 0)
+    ssafe = jnp.where(valid, src, n)
+    dsafe = jnp.where(valid, dst, n)
+    ones = valid.astype(x.dtype)
+    deg_in = jax.ops.segment_sum(ones, dsafe, num_segments=n) + 1.0
+    deg_out = jax.ops.segment_sum(ones, ssafe, num_segments=n) + 1.0
+    w = (jax.lax.rsqrt(deg_out)[jnp.clip(src, 0, n - 1)]
+         * jax.lax.rsqrt(deg_in)[jnp.clip(dst, 0, n - 1)])
+    h = nn.Dense(self.out_features, use_bias=self.use_bias)(x)
+    msg = h[jnp.clip(src, 0, n - 1)] * w[:, None]
+    agg = jax.ops.segment_sum(msg, dsafe, num_segments=n)
+    # self loop with 1/deg normalization
+    return agg + h * (jax.lax.rsqrt(deg_in) * jax.lax.rsqrt(deg_out))[:, None]
+
+
+class GATConv(nn.Module):
+  """Graph attention convolution (masked softmax over incoming edges)."""
+  out_features: int
+  heads: int = 1
+  concat: bool = True
+  negative_slope: float = 0.2
+
+  @nn.compact
+  def __call__(self, x: jax.Array, edge_index: jax.Array,
+               edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    n = x.shape[0]
+    h, f = self.heads, self.out_features
+    src, dst = edge_index[0], edge_index[1]
+    valid = edge_mask if edge_mask is not None else (dst >= 0)
+    dsafe = jnp.where(valid, dst, n)
+    z = nn.Dense(h * f, use_bias=False)(x).reshape(n, h, f)
+    a_src = self.param('att_src', nn.initializers.glorot_uniform(),
+                       (h, f))
+    a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
+                       (h, f))
+    alpha_src = (z * a_src[None]).sum(-1)   # [n, h]
+    alpha_dst = (z * a_dst[None]).sum(-1)
+    sc = jnp.clip(src, 0, n - 1)
+    e = nn.leaky_relu(alpha_src[sc] + alpha_dst[jnp.clip(dst, 0, n - 1)],
+                      self.negative_slope)          # [E, h]
+    e = jnp.where(valid[:, None], e, -jnp.inf)
+    # segment softmax: subtract per-target max, exp, normalize.
+    emax = jax.ops.segment_max(e, dsafe, num_segments=n)
+    emax = jnp.where(jnp.isfinite(emax), emax, 0.0)
+    ex = jnp.where(valid[:, None],
+                   jnp.exp(e - emax[jnp.clip(dst, 0, n - 1)]), 0.0)
+    denom = jax.ops.segment_sum(ex, dsafe, num_segments=n)
+    w = ex / jnp.maximum(denom[jnp.clip(dst, 0, n - 1)], 1e-16)
+    msg = z[sc] * w[:, :, None]                      # [E, h, f]
+    agg = jax.ops.segment_sum(msg.reshape(-1, h * f), dsafe,
+                              num_segments=n).reshape(n, h, f)
+    if self.concat:
+      return agg.reshape(n, h * f)
+    return agg.mean(axis=1)

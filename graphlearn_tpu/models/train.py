@@ -1,0 +1,116 @@
+"""Supervised / unsupervised training steps on Batch pytrees.
+
+The reference leaves the training loop to user code + DDP
+(`examples/train_sage_ogbn_products.py:90-130`); here the loop is a
+jitted optax step.  Loss is computed on the **seed slots** only (table
+positions ``[0, batch_size)``), masked by seed validity — padded seeds
+contribute zero, so the tail batch trains correctly with one compiled
+program.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class TrainState(NamedTuple):
+  params: Any
+  opt_state: Any
+  step: jax.Array
+
+
+def create_train_state(model, rng, sample_batch, tx: optax.GradientTransformation
+                       ) -> Tuple[TrainState, Callable]:
+  """Init params from a sample batch; returns (state, apply_fn)."""
+  params = model.init(rng, sample_batch.x, sample_batch.edge_index,
+                      sample_batch.edge_mask)
+  return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32)), \
+      model.apply
+
+
+def supervised_loss(logits: jax.Array, y: jax.Array, batch_seeds: jax.Array,
+                    batch_size: int) -> jax.Array:
+  """Masked softmax CE over seed slots [0, batch_size)."""
+  seed_logits = logits[:batch_size]
+  seed_y = y[:batch_size]
+  valid = (batch_seeds >= 0).astype(seed_logits.dtype)
+  ce = optax.softmax_cross_entropy_with_integer_labels(
+      seed_logits, seed_y.astype(jnp.int32))
+  return (ce * valid).sum() / jnp.maximum(valid.sum(), 1.0)
+
+
+def make_supervised_step(apply_fn, tx: optax.GradientTransformation,
+                         batch_size: int):
+  """Build a jitted ``(state, batch) -> (state, loss, correct)`` step."""
+
+  @jax.jit
+  def step(state: TrainState, batch):
+    def loss_fn(params):
+      logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+      loss = supervised_loss(logits, batch.y, batch.batch, batch_size)
+      return loss, logits
+
+    (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    valid = batch.batch >= 0
+    pred = jnp.argmax(logits[:batch_size], axis=-1)
+    correct = jnp.sum((pred == batch.y[:batch_size]) & valid)
+    return TrainState(params, opt_state, state.step + 1), loss, correct
+
+  return step
+
+
+def make_eval_step(apply_fn, batch_size: int):
+
+  @jax.jit
+  def step(params, batch):
+    logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+    valid = batch.batch >= 0
+    pred = jnp.argmax(logits[:batch_size], axis=-1)
+    correct = jnp.sum((pred == batch.y[:batch_size]) & valid)
+    return correct, jnp.sum(valid)
+
+  return step
+
+
+def unsupervised_link_loss(emb: jax.Array, metadata: dict) -> jax.Array:
+  """Binary link-prediction loss from sampler metadata
+  (``edge_label_index`` / ``edge_label`` / ``edge_label_mask``), the
+  objective of the reference's unsupervised SAGE example
+  (`examples/graph_sage_unsup_ppi.py:41-45`)."""
+  eli = metadata['edge_label_index']
+  label = metadata['edge_label'].astype(emb.dtype)
+  mask = metadata.get('edge_label_mask')
+  n = emb.shape[0]
+  src = emb[jnp.clip(eli[0], 0, n - 1)]
+  dst = emb[jnp.clip(eli[1], 0, n - 1)]
+  logit = jnp.sum(src * dst, axis=-1)
+  ls = optax.sigmoid_binary_cross_entropy(logit, jnp.minimum(label, 1.0))
+  if mask is not None:
+    valid = mask & (eli[0] >= 0) & (eli[1] >= 0)
+  else:
+    valid = (eli[0] >= 0) & (eli[1] >= 0)
+  v = valid.astype(emb.dtype)
+  return (ls * v).sum() / jnp.maximum(v.sum(), 1.0)
+
+
+def make_unsupervised_step(apply_fn, tx: optax.GradientTransformation):
+
+  @jax.jit
+  def step(state: TrainState, batch):
+    def loss_fn(params):
+      emb = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+      return unsupervised_link_loss(emb, batch.metadata)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss
+
+  return step

@@ -4,11 +4,22 @@ Mirrors the reference's test strategy of running the real distributed
 stack all-locally (`test/python/dist_test_utils.py`): multi-chip
 sharding paths compile and execute on 8 virtual CPU devices; the same
 code runs unchanged on a real TPU slice.
+
+NOTE: this environment pre-imports jax at interpreter startup (a
+sitecustomize on PYTHONPATH registers the TPU tunnel plugin), so
+``JAX_PLATFORMS`` from the environment is already latched — setting
+env vars here is too late.  ``jax.config.update`` works post-import,
+and ``XLA_FLAGS`` is parsed at first backend init, which hasn't
+happened yet when conftest loads.  Real-chip validation runs as plain
+scripts (see .claude/skills/verify), not through pytest.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (
       _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
