@@ -1,0 +1,98 @@
+"""Headline benchmark: neighbor-sampling throughput on one TPU chip.
+
+Reproduces the reference's metric definition — "Sampled Edges per secs"
+(`benchmarks/api/bench_sampler.py:46-54`: wall-clock around
+`sampler.sample_from_nodes`, edges counted from the sampled topology) —
+on the reference's flagship config: fanout [15, 10, 5], batch 1024
+(`examples/train_sage_ogbn_products.py:16`), on an ogbn-products-scale
+synthetic graph (2.45M nodes, ~62M directed edges).
+
+The reference publishes figures, not numbers (`BASELINE.md`);
+``BASELINE_EDGES_PER_SEC`` is our normalization constant: 100M
+sampled-edges/sec, a mid-range read of GLT's single-A100 scale_up plot
+era. vs_baseline > 1.0 means faster than that nominal A100 figure.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EDGES_PER_SEC = 100e6
+
+NUM_NODES = 2_449_029          # ogbn-products node count
+AVG_DEG = 25
+FANOUT = (15, 10, 5)
+BATCH = 1024
+WARMUP = 3
+ITERS = 20
+
+
+def build_graph(seed=0):
+  """Synthetic power-law-ish graph at ogbn-products scale."""
+  rng = np.random.default_rng(seed)
+  n = NUM_NODES
+  e = n * AVG_DEG
+  rows = rng.integers(0, n, e, dtype=np.int64)
+  # Preferential-attachment-flavored targets: mix uniform + squared
+  # concentration so degree distribution is skewed like a real graph.
+  hubs = (rng.random(e) < 0.3)
+  cols = np.where(hubs,
+                  (rng.random(e) ** 2 * n).astype(np.int64),
+                  rng.integers(0, n, e, dtype=np.int64))
+  return rows, cols.astype(np.int64)
+
+
+def main():
+  import jax
+  sys.path.insert(0, '.')
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.sampler import NeighborSampler, NodeSamplerInput
+
+  if '--cpu' in sys.argv:
+    jax.config.update('jax_platforms', 'cpu')
+  dev = jax.devices()[0]
+
+  rows, cols = build_graph()
+  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=NUM_NODES)
+  g = ds.get_graph()
+  g.lazy_init()
+
+  sampler = NeighborSampler(g, FANOUT, seed=0)
+  rng = np.random.default_rng(1)
+
+  def one_batch():
+    seeds = rng.integers(0, NUM_NODES, BATCH).astype(np.int32)
+    return sampler.sample_from_nodes(NodeSamplerInput(node=seeds))
+
+  # Warmup (compile) — not timed.
+  for _ in range(WARMUP):
+    out = one_batch()
+  out.node.block_until_ready()
+
+  edges = 0
+  t0 = time.perf_counter()
+  outs = []
+  for _ in range(ITERS):
+    outs.append(one_batch())
+  for o in outs:
+    o.row.block_until_ready()
+  dt = time.perf_counter() - t0
+  # Count actually-sampled (valid) edges on host, outside the timer.
+  for o in outs:
+    edges += int(np.asarray(o.edge_mask).sum())
+
+  eps = edges / dt
+  print(json.dumps({
+      'metric': f'sampled_edges_per_sec (fanout {list(FANOUT)}, '
+                f'batch {BATCH}, {dev.platform})',
+      'value': round(eps / 1e6, 3),
+      'unit': 'M edges/s',
+      'vs_baseline': round(eps / BASELINE_EDGES_PER_SEC, 4),
+  }))
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,117 @@
+"""Supervised GraphSAGE training — the flagship workload.
+
+TPU-native counterpart of reference `examples/train_sage_ogbn_products.py`
+(fanout [15,10,5], batch 1024, 3 layers, hidden 256, reported test acc
+~0.7870).  Zero-egress environments can't download OGB, so the script
+accepts either an on-disk `.npz` (keys: rows, cols, feats, labels,
+train_idx, val_idx, test_idx) or generates a synthetic clustered graph
+whose labels are learnable (sanity-checking the full pipeline).
+
+Usage::
+
+    python examples/train_sage.py                      # synthetic
+    python examples/train_sage.py --data products.npz  # real data
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def synthetic(n=20000, d=64, classes=16, deg=10, seed=0):
+  rng = np.random.default_rng(seed)
+  labels = rng.integers(0, classes, n).astype(np.int32)
+  # Mostly intra-class edges + noise.
+  rows = np.repeat(np.arange(n), deg)
+  intra = rng.random(n * deg) < 0.7
+  perm_by_class = np.argsort(labels, kind='stable')
+  class_ptr = np.searchsorted(labels[perm_by_class], np.arange(classes + 1))
+  intra_targets = np.empty(n * deg, dtype=np.int64)
+  for c in range(classes):
+    mask = labels[rows] == c
+    lo, hi = class_ptr[c], class_ptr[c + 1]
+    intra_targets[mask] = perm_by_class[rng.integers(lo, hi, mask.sum())]
+  cols = np.where(intra, intra_targets, rng.integers(0, n, n * deg))
+  feats = np.eye(classes, dtype=np.float32)[labels] @ rng.normal(
+      0, 1, (classes, d)).astype(np.float32)
+  feats += rng.normal(0, 0.5, (n, d)).astype(np.float32)
+  idx = rng.permutation(n)
+  return dict(rows=rows, cols=cols, feats=feats, labels=labels,
+              train_idx=idx[:int(n * .6)], val_idx=idx[int(n * .6):
+                                                       int(n * .8)],
+              test_idx=idx[int(n * .8):])
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--data', type=str, default=None)
+  ap.add_argument('--epochs', type=int, default=5)
+  ap.add_argument('--batch-size', type=int, default=1024)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[15, 10, 5])
+  ap.add_argument('--hidden', type=int, default=256)
+  ap.add_argument('--lr', type=float, default=3e-3)
+  ap.add_argument('--split-ratio', type=float, default=1.0,
+                  help='fraction of features resident in HBM')
+  ap.add_argument('--cpu', action='store_true')
+  args = ap.parse_args()
+
+  import jax
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  import optax
+  from graphlearn_tpu.data import Dataset, sort_by_in_degree
+  from graphlearn_tpu.loader import NeighborLoader
+  from graphlearn_tpu.models import (GraphSAGE, create_train_state,
+                                     make_eval_step, make_supervised_step)
+
+  data = dict(np.load(args.data)) if args.data else synthetic()
+  classes = int(data['labels'].max()) + 1
+  n = len(data['labels'])
+
+  ds = (Dataset()
+        .init_graph((data['rows'], data['cols']), layout='COO', num_nodes=n)
+        .init_node_features(
+            data['feats'],
+            sort_func=sort_by_in_degree if args.split_ratio < 1.0 else None,
+            split_ratio=args.split_ratio)
+        .init_node_labels(data['labels']))
+
+  bs = args.batch_size
+  train_loader = NeighborLoader(ds, args.fanout, data['train_idx'],
+                                batch_size=bs, shuffle=True, seed=0)
+  test_loader = NeighborLoader(ds, args.fanout, data['test_idx'],
+                               batch_size=bs)
+
+  model = GraphSAGE(hidden_features=args.hidden, out_features=classes,
+                    num_layers=len(args.fanout))
+  tx = optax.adam(args.lr)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), next(iter(train_loader)), tx)
+  train_step = make_supervised_step(apply_fn, tx, bs)
+  eval_step = make_eval_step(apply_fn, bs)
+
+  for epoch in range(args.epochs):
+    t0 = time.perf_counter()
+    tot = cnt = 0
+    for batch in train_loader:
+      state, loss, _ = train_step(state, batch)
+      tot += float(loss)
+      cnt += 1
+    dt = time.perf_counter() - t0
+    print(f'epoch {epoch}: loss {tot / max(cnt, 1):.4f}  '
+          f'({dt:.2f}s, {cnt} steps)')
+
+  correct = total = 0
+  for batch in test_loader:
+    c, t = eval_step(state.params, batch)
+    correct += int(c)
+    total += int(t)
+  print(f'test acc: {correct / max(total, 1):.4f}')
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,2 @@
+from .dp import (DataParallelLoader, make_dp_supervised_step, make_mesh,
+                 replicate, shard_stacked, stack_batches)
