@@ -1,0 +1,135 @@
+"""Link-prediction loaders.
+
+Counterparts of reference `loader/link_loader.py:35-216` (``LinkLoader``)
+and `loader/link_neighbor_loader.py:27-149` (``LinkNeighborLoader``):
+iterate seed *edges*, sample around their endpoints (+negatives), and
+collate batches carrying link-label metadata.
+
+Reference semantics kept:
+  * binary mode with user labels applies the +1 shift so label 0 means
+    "negative sample" (`link_loader.py:146-186`);
+  * metadata names match PyG: ``edge_label_index`` / ``edge_label`` for
+    binary, ``src_index`` / ``dst_pos_index`` / ``dst_neg_index`` for
+    triplet — plus the TPU padding masks.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..sampler.base import (BaseSampler, EdgeSamplerInput, NegativeSampling,
+                            SamplerOutput)
+from ..utils.padding import INVALID_ID, pad_1d
+from .node_loader import SeedBatcher
+from .transform import Batch, to_data
+
+
+class EdgeSeedBatcher:
+  """Batch (row, col, label) edge seeds with static-size tail padding."""
+
+  def __init__(self, rows, cols, labels=None, batch_size: int = 1,
+               shuffle: bool = False, drop_last: bool = False,
+               seed: Optional[int] = None):
+    self.rows = np.asarray(rows).reshape(-1)
+    self.cols = np.asarray(cols).reshape(-1)
+    assert len(self.rows) == len(self.cols)
+    self.labels = None if labels is None else np.asarray(labels).reshape(-1)
+    self._idx = SeedBatcher(np.arange(len(self.rows)), batch_size, shuffle,
+                            drop_last, seed)
+
+  def __len__(self):
+    return len(self._idx)
+
+  def __iter__(self):
+    self._it = iter(self._idx)
+    return self
+
+  def __next__(self):
+    idx = next(self._it)
+    valid = idx >= 0
+    safe = np.where(valid, idx, 0)
+    r = np.where(valid, self.rows[safe], INVALID_ID).astype(np.int32)
+    c = np.where(valid, self.cols[safe], INVALID_ID).astype(np.int32)
+    lab = None
+    if self.labels is not None:
+      lab = np.where(valid, self.labels[safe], 0)
+    return r, c, lab
+
+
+class LinkLoader:
+  """Base link loader: seed edges → sampler.sample_from_edges → collate.
+
+  Args:
+    data: the Dataset.
+    sampler: sampler implementing ``sample_from_edges``.
+    edge_label_index: ``[2, E]`` (or (rows, cols)) seed edges.
+    edge_label: optional ``[E]`` labels.
+    neg_sampling: `NegativeSampling` spec or mode string.
+  """
+
+  def __init__(self, data: Dataset, sampler: BaseSampler, edge_label_index,
+               edge_label=None, neg_sampling=None, batch_size: int = 1,
+               shuffle: bool = False, drop_last: bool = False,
+               seed: Optional[int] = None, **kwargs):
+    self.data = data
+    self.sampler = sampler
+    if isinstance(edge_label_index, (tuple, list)):
+      rows, cols = edge_label_index
+    else:
+      ei = np.asarray(edge_label_index)
+      rows, cols = ei[0], ei[1]
+    self.neg_sampling = NegativeSampling.cast(neg_sampling)
+    self._batcher = EdgeSeedBatcher(rows, cols, edge_label, batch_size,
+                                    shuffle, drop_last, seed)
+    self.batch_size = int(batch_size)
+
+  def __len__(self):
+    return len(self._batcher)
+
+  def __iter__(self) -> Iterator[Batch]:
+    self._it = iter(self._batcher)
+    return self
+
+  def __next__(self) -> Batch:
+    r, c, lab = next(self._it)
+    if lab is not None and self.neg_sampling is not None \
+        and self.neg_sampling.is_binary():
+      # Reference +1 shift: user labels move up, 0 = negative class
+      # (`loader/link_loader.py:146-186`).
+      lab = lab + 1
+    out = self.sampler.sample_from_edges(
+        EdgeSamplerInput(row=r, col=c, label=lab,
+                         neg_sampling=self.neg_sampling))
+    return self._collate_fn(out)
+
+  def _collate_fn(self, out: SamplerOutput) -> Batch:
+    return to_data(
+        out,
+        node_feature=self.data.get_node_feature(),
+        node_label=self.data.get_node_label(),
+        edge_feature=(self.data.get_edge_feature()
+                      if out.edge is not None else None))
+
+
+class LinkNeighborLoader(LinkLoader):
+  """Link loader with multi-hop neighbor expansion around endpoints.
+
+  Mirrors reference `loader/link_neighbor_loader.py:27-149`; the
+  workhorse of unsupervised SAGE
+  (`examples/graph_sage_unsup_ppi.py:41-45`).
+  """
+
+  def __init__(self, data: Dataset, num_neighbors: Sequence[int],
+               edge_label_index, edge_label=None, neg_sampling=None,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               device=None, seed: Optional[int] = None, **kwargs):
+    from ..sampler.neighbor_sampler import NeighborSampler
+    sampler = NeighborSampler(
+        data.get_graph(), num_neighbors, device=device, with_edge=with_edge,
+        with_neg=neg_sampling is not None, seed=seed or 0)
+    super().__init__(data, sampler, edge_label_index, edge_label,
+                     neg_sampling, batch_size, shuffle, drop_last, seed,
+                     **kwargs)

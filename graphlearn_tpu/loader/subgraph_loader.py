@@ -1,0 +1,48 @@
+"""Induced-subgraph loader (SEAL-style link prediction).
+
+Counterpart of reference `loader/subgraph_loader.py:27-98`
+(``SubGraphLoader``): for each seed batch, take the multi-hop closure,
+then materialize ALL edges among the collected nodes (the `SubGraphOp`
+path, `csrc/cuda/subgraph_op.cu`), exposing ``mapping`` — the local
+positions of the seeds — in batch metadata.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..data.dataset import Dataset
+from ..sampler.base import NodeSamplerInput
+from ..sampler.neighbor_sampler import NeighborSampler
+from .node_loader import NodeLoader
+from .transform import Batch
+
+
+class SubGraphLoader(NodeLoader):
+  """Loader yielding induced subgraphs around seed batches.
+
+  Args:
+    data: Dataset with a homogeneous graph.
+    num_neighbors: per-hop fanouts bounding the closure.
+    input_nodes: seed ids.
+    max_degree: optional per-node cap for the induced-edge scan
+      (bounds the intermediate on hub-heavy graphs).
+  """
+
+  def __init__(self, data: Dataset, num_neighbors: Sequence[int],
+               input_nodes, batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, with_edge: bool = False,
+               max_degree: Optional[int] = None, device=None,
+               seed: Optional[int] = None, **kwargs):
+    sampler = NeighborSampler(
+        data.get_graph(), num_neighbors, device=device,
+        with_edge=with_edge, seed=seed or 0)
+    super().__init__(data, sampler, input_nodes, batch_size=batch_size,
+                     shuffle=shuffle, drop_last=drop_last, seed=seed,
+                     **kwargs)
+    self.max_degree = max_degree
+
+  def __next__(self) -> Batch:
+    seeds = next(self._seed_iter)
+    out = self.sampler.subgraph(NodeSamplerInput(node=seeds),
+                                max_degree=self.max_degree)
+    return self._collate_fn(out)

@@ -106,6 +106,25 @@ def _multihop_sample(
           num_sampled_nodes, num_sampled_edges)
 
 
+@functools.partial(jax.jit, static_argnames=('amount', 'num_nodes'))
+def _triplet_neg_dst(indptr: jax.Array, indices: jax.Array, src: jax.Array,
+                     key: jax.Array, *, amount: int, num_nodes: int
+                     ) -> jax.Array:
+  """Per-source negative destinations with strict rejection (up to 5
+  trials), the vectorized analog of the curand retry loop
+  (`csrc/cuda/random_negative_sampler.cu:56-94`)."""
+  b = src.shape[0]
+  trials = 5
+  cand = jax.random.randint(key, (trials, b * amount), 0, num_nodes,
+                            dtype=jnp.int32)
+  rows = jnp.tile(jnp.repeat(src, amount)[None, :], (trials, 1))
+  exists = edge_in_csr(indptr, indices, rows.reshape(-1), cand.reshape(-1))
+  ok = ~exists.reshape(trials, b * amount)
+  pick = jnp.where(jnp.any(ok, axis=0), jnp.argmax(ok, axis=0), trials - 1)
+  out = cand[pick, jnp.arange(b * amount)]
+  return out.reshape(b, amount)
+
+
 class NeighborSampler(BaseSampler):
   """Uniform multi-hop neighbor sampler over a device `Graph`.
 
@@ -236,7 +255,9 @@ class NeighborSampler(BaseSampler):
     # triplet: per-positive-edge negative destinations.
     amount = int(np.ceil(float(neg.amount)))
     num_neg = b * amount
-    neg_dst = self._sample_triplet_neg_dst(src, amount, key)
+    neg_dst = _triplet_neg_dst(
+        self.graph.indptr, self.graph.indices, src, key,
+        amount=amount, num_nodes=self.graph.num_nodes)
     seeds = jnp.concatenate([src, dst, neg_dst.reshape(-1)])
     out = self.sample_from_nodes(NodeSamplerInput(node=seeds))
     sl = out.metadata['seed_local']
@@ -249,24 +270,10 @@ class NeighborSampler(BaseSampler):
     }
     return out
 
-  @functools.partial(jax.jit, static_argnames=('self', 'amount'))
-  def _sample_triplet_neg_dst(self, src: jax.Array, amount: int,
-                              key: jax.Array) -> jax.Array:
-    """Per-source negative destinations with strict rejection (up to 5
-    trials), the vectorized analog of the curand retry loop
-    (`csrc/cuda/random_negative_sampler.cu:56-94`)."""
-    b = src.shape[0]
-    trials = 5
-    num_nodes = self.graph.num_nodes
-    cand = jax.random.randint(key, (trials, b * amount), 0, num_nodes,
-                              dtype=jnp.int32)
-    rows = jnp.tile(jnp.repeat(src, amount)[None, :], (trials, 1))
-    exists = edge_in_csr(self.graph.indptr, self.graph.indices,
-                         rows.reshape(-1), cand.reshape(-1))
-    ok = ~exists.reshape(trials, b * amount)
-    pick = jnp.where(jnp.any(ok, axis=0), jnp.argmax(ok, axis=0), trials - 1)
-    out = cand[pick, jnp.arange(b * amount)]
-    return out.reshape(b, amount)
+  # (triplet negative sampling lives in module-level `_triplet_neg_dst`
+  # so graph arrays are passed in concrete — a jitted *method* touching
+  # `self.graph.indptr` would run the graph's lazy device_put inside
+  # tracing and leak tracers into the handle.)
 
   # -- induced subgraph -----------------------------------------------------
 
