@@ -30,13 +30,19 @@ class NeighborLoader(NodeLoader):
     seed: PRNG seed for sampling & shuffling.
   """
 
-  def __init__(self, data: Dataset, num_neighbors: Sequence[int],
-               input_nodes, batch_size: int = 1, shuffle: bool = False,
+  def __init__(self, data: Dataset, num_neighbors, input_nodes,
+               batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, with_edge: bool = False,
                device=None, seed: Optional[int] = None, **kwargs):
-    sampler = NeighborSampler(
-        data.get_graph(), num_neighbors, device=device,
-        with_edge=with_edge, seed=seed or 0)
+    if data.is_hetero:
+      from ..sampler.hetero_neighbor_sampler import HeteroNeighborSampler
+      sampler = HeteroNeighborSampler(
+          data.get_graph(), num_neighbors, device=device,
+          with_edge=with_edge, seed=seed or 0)
+    else:
+      sampler = NeighborSampler(
+          data.get_graph(), num_neighbors, device=device,
+          with_edge=with_edge, seed=seed or 0)
     super().__init__(data, sampler, input_nodes, batch_size=batch_size,
                      shuffle=shuffle, drop_last=drop_last, seed=seed,
                      **kwargs)

@@ -16,7 +16,7 @@ import numpy as np
 from ..data.dataset import Dataset
 from ..sampler.base import BaseSampler, NodeSamplerInput
 from ..utils.padding import INVALID_ID, pad_1d
-from .transform import Batch, to_data
+from .transform import Batch, to_data, to_hetero_data
 
 
 class SeedBatcher:
@@ -80,6 +80,11 @@ class NodeLoader:
                **kwargs):
     self.data = data
     self.sampler = sampler
+    self.input_type = None
+    if isinstance(input_nodes, tuple) and isinstance(input_nodes[0], str):
+      # Hetero seeds: (node_type, ids) — reference `InputNodes`
+      # (`typing.py:83`).
+      self.input_type, input_nodes = input_nodes
     input_nodes = np.asarray(input_nodes)
     if input_nodes.dtype == np.bool_:
       input_nodes = np.nonzero(input_nodes)[0]
@@ -96,12 +101,23 @@ class NodeLoader:
 
   def __next__(self) -> Batch:
     seeds = next(self._seed_iter)
-    out = self.sampler.sample_from_nodes(NodeSamplerInput(node=seeds))
+    out = self.sampler.sample_from_nodes(
+        NodeSamplerInput(node=seeds, input_type=self.input_type))
     return self._collate_fn(out)
 
-  def _collate_fn(self, out) -> Batch:
+  def _collate_fn(self, out):
     """Gather features/labels for sampled nodes and build the batch
     (reference `loader/node_loader.py:85-113`)."""
+    from ..sampler.base import HeteroSamplerOutput
+    if isinstance(out, HeteroSamplerOutput):
+      return to_hetero_data(
+          out,
+          node_feature_dict=self.data.node_features
+          if isinstance(self.data.node_features, dict) else None,
+          node_label_dict=self.data.node_labels
+          if isinstance(self.data.node_labels, dict) else None,
+          edge_feature_dict=self.data.edge_features
+          if isinstance(self.data.edge_features, dict) else None)
     return to_data(
         out,
         node_feature=self.data.get_node_feature(),

@@ -18,6 +18,19 @@ from ..typing import EdgeType, NodeType
 from ..sampler.base import HeteroSamplerOutput, SamplerOutput
 
 
+def _split_metadata(metadata: Dict):
+  """Split metadata into (dynamic array-valued, static hashable) parts
+  so batches stay jit-compatible pytrees even when samplers attach
+  strings (e.g. ``input_type``)."""
+  dyn, static = {}, {}
+  for k, v in metadata.items():
+    if hasattr(v, 'shape') or hasattr(v, 'dtype'):
+      dyn[k] = v
+    else:
+      static[k] = v
+  return dyn, tuple(sorted(static.items()))
+
+
 class Batch:
   """PyG-``Data``-shaped mini-batch (homogeneous), as a pytree.
 
@@ -57,15 +70,18 @@ class Batch:
     self.metadata = metadata if metadata is not None else {}
 
   def tree_flatten(self):
+    dyn_md, static_md = _split_metadata(self.metadata)
     children = (self.x, self.y, self.edge_index, self.edge_attr, self.node,
                 self.node_mask, self.edge_mask, self.edge, self.batch,
-                self.num_sampled_nodes, self.num_sampled_edges, self.metadata)
-    return children, (self.batch_size,)
+                self.num_sampled_nodes, self.num_sampled_edges, dyn_md)
+    return children, (self.batch_size, static_md)
 
   @classmethod
   def tree_unflatten(cls, aux, children):
     (x, y, edge_index, edge_attr, node, node_mask, edge_mask, edge, batch,
      nsn, nse, metadata) = children
+    metadata = dict(metadata)
+    metadata.update(dict(aux[1]))
     return cls(x, y, edge_index, edge_attr, node, node_mask, edge_mask, edge,
                batch, aux[0], nsn, nse, metadata)
 
@@ -98,14 +114,17 @@ class HeteroBatch:
     self.metadata = metadata if metadata is not None else {}
 
   def tree_flatten(self):
+    dyn_md, static_md = _split_metadata(self.metadata)
     children = (self.x_dict, self.y_dict, self.edge_index_dict,
                 self.edge_attr_dict, self.node_dict, self.node_mask_dict,
-                self.edge_mask_dict, self.batch_dict, self.metadata)
-    return children, (self.batch_size,)
+                self.edge_mask_dict, self.batch_dict, dyn_md)
+    return children, (self.batch_size, static_md)
 
   @classmethod
   def tree_unflatten(cls, aux, children):
     (x, y, ei, ea, node, nm, em, batch, metadata) = children
+    metadata = dict(metadata)
+    metadata.update(dict(aux[1]))
     return cls(x, y, ei, ea, node, nm, em, batch, aux[0], metadata)
 
   def __repr__(self):

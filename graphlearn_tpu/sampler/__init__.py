@@ -9,4 +9,5 @@ from .base import (
     BaseSampler,
 )
 from .neighbor_sampler import NeighborSampler
+from .hetero_neighbor_sampler import HeteroNeighborSampler
 from .negative_sampler import RandomNegativeSampler
