@@ -1,0 +1,3 @@
+from .base import (PartitionerBase, cat_feature_cache, load_partition)
+from .random_partitioner import RandomPartitioner
+from .frequency_partitioner import FrequencyPartitioner

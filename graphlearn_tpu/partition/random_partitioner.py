@@ -1,0 +1,30 @@
+"""Random node partitioner.
+
+Counterpart of reference `partition/random_partitioner.py:27-85`:
+node partition book = a random permutation folded modulo num_parts
+(balanced to within one node per partition).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..typing import NodeType
+from .base import PartitionerBase
+
+
+class RandomPartitioner(PartitionerBase):
+
+  def __init__(self, *args, seed: Optional[int] = None, **kwargs):
+    super().__init__(*args, **kwargs)
+    self._rng = np.random.default_rng(seed)
+
+  def partition_node(self, ntype: Optional[NodeType] = None) -> np.ndarray:
+    n = (self.num_nodes[ntype] if isinstance(self.num_nodes, dict)
+         else self.num_nodes)
+    pb = np.empty(n, dtype=np.int8)
+    perm = self._rng.permutation(n)
+    for p in range(self.num_parts):
+      pb[perm[p::self.num_parts]] = p
+    return pb
