@@ -55,7 +55,7 @@ def make_dp_supervised_step(apply_fn: Callable,
   Gradient averaging = ``jax.lax.pmean`` over the mesh axis — the XLA
   collective that replaces the reference's NCCL allreduce.
   """
-  from jax.experimental.shard_map import shard_map  # noqa: deprecation path kept for jax pin
+  from .shard_map_compat import shard_map
 
   def per_device(state: TrainState, batch):
     # batch leaves carry a leading singleton shard axis; drop it.
@@ -81,8 +81,7 @@ def make_dp_supervised_step(apply_fn: Callable,
   sharded = shard_map(
       per_device, mesh=mesh,
       in_specs=(P(), P(axis)),
-      out_specs=(P(), P(), P()),
-      check_rep=False)
+      out_specs=(P(), P(), P()))
 
   @jax.jit
   def step(state, stacked_batch):

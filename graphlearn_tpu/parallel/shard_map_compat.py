@@ -1,0 +1,16 @@
+"""shard_map API compatibility (jax>=0.8 moved it out of experimental
+and renamed check_rep -> check_vma)."""
+from __future__ import annotations
+
+try:
+  from jax import shard_map as _shard_map
+
+  def shard_map(f, mesh, in_specs, out_specs):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+except ImportError:  # older jax
+  from jax.experimental.shard_map import shard_map as _shard_map
+
+  def shard_map(f, mesh, in_specs, out_specs):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)

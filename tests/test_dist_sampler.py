@@ -1,0 +1,130 @@
+"""Distributed sampling tests on the virtual 8-device CPU mesh.
+
+The TPU translation of the reference's all-local distributed tests
+(`test/python/test_dist_neighbor_loader.py` + `dist_test_utils.py`):
+a deterministic ring graph partitioned across devices, features that
+encode node ids, correctness asserted arithmetically — the real
+collective stack runs, no mocks.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                     DistNeighborSampler, make_mesh)
+
+N = 64  # ring: v -> v+1, v -> v+2 (mod N)
+
+
+def _ring_dist_dataset(num_parts=4, contiguous=False, with_feats=True):
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  feats = (np.arange(N, dtype=np.float32)[:, None]
+           * np.ones((1, 4), np.float32)) if with_feats else None
+  labels = (np.arange(N) % 5).astype(np.int32)
+  if contiguous:
+    node_pb = (np.arange(N) * num_parts // N).astype(np.int32)
+  else:
+    node_pb = (np.arange(N) % num_parts).astype(np.int32)  # interleaved
+  return DistDataset.from_full_graph(
+      num_parts, rows, cols, node_feat=feats, node_label=labels,
+      num_nodes=N, node_pb=node_pb)
+
+
+def test_dist_graph_layout():
+  ds = _ring_dist_dataset(4)
+  g = ds.graph
+  assert g.num_partitions == 4
+  assert g.num_nodes == N
+  np.testing.assert_array_equal(g.bounds, [0, 16, 32, 48, 64])
+  # each node has out-degree 2 in its owner's local CSR.
+  for p in range(4):
+    deg = np.diff(g.indptr[p])[:16]
+    np.testing.assert_array_equal(deg, 2)
+
+
+def test_dist_one_hop_edges_correct():
+  ds = _ring_dist_dataset(4)
+  sampler = DistNeighborSampler(ds, [2], mesh=make_mesh(4), seed=0)
+  # each device seeds 4 of its own... seeds can be ANY nodes; use a
+  # spread so every device requests remote partitions.
+  seeds = ds.old2new[np.arange(16).reshape(4, 4)]
+  out = sampler.sample_from_nodes(seeds)
+  nodes = np.asarray(out['node'])       # [P, cap] relabeled ids
+  rows = np.asarray(out['row'])
+  cols = np.asarray(out['col'])
+  new2old = ds.new2old
+  for p in range(4):
+    m = rows[p] >= 0
+    assert m.any()
+    r_old = new2old[nodes[p][rows[p][m]]]
+    c_old = new2old[nodes[p][cols[p][m]]]
+    # ring invariant: neighbor = seed + 1 or + 2 (mod N).
+    d = (r_old - c_old) % N
+    assert np.isin(d, [1, 2]).all(), d
+
+
+def test_dist_feature_and_label_provenance():
+  ds = _ring_dist_dataset(4)
+  sampler = DistNeighborSampler(ds, [2, 2], mesh=make_mesh(4), seed=0)
+  seeds = ds.old2new[np.arange(32).reshape(4, 8)]
+  out = sampler.sample_from_nodes(seeds)
+  nodes = np.asarray(out['node'])
+  x = np.asarray(out['x'])
+  y = np.asarray(out['y'])
+  for p in range(4):
+    m = nodes[p] >= 0
+    old_ids = ds.new2old[nodes[p][m]]
+    # feature rows encode the ORIGINAL node id — remote gathers
+    # included (the dist_test_utils provenance trick).
+    np.testing.assert_allclose(x[p][m][:, 0], old_ids)
+    np.testing.assert_allclose(x[p][~m], 0)
+    np.testing.assert_array_equal(y[p][m], old_ids % 5)
+
+
+def test_dist_sampling_matches_single_chip_statistics():
+  # every sampled edge must be a real edge; seeds keep slots 0..B-1.
+  ds = _ring_dist_dataset(8)
+  sampler = DistNeighborSampler(ds, [2], mesh=make_mesh(8), seed=0)
+  seeds = ds.old2new[np.arange(64).reshape(8, 8)]
+  out = sampler.sample_from_nodes(seeds)
+  sl = np.asarray(out['seed_local'])
+  for p in range(8):
+    np.testing.assert_array_equal(sl[p], np.arange(8))
+
+
+def test_dist_loader_epoch_and_training():
+  import optax
+  from graphlearn_tpu.models import GraphSAGE, create_train_state
+  from graphlearn_tpu.parallel import make_dp_supervised_step, replicate
+  from graphlearn_tpu.parallel.dp import make_mesh as mm
+
+  num_parts = 4
+  mesh = make_mesh(num_parts)
+  ds = _ring_dist_dataset(num_parts)
+  bs = 4
+  loader = DistNeighborLoader(ds, [2, 2], np.arange(N), batch_size=bs,
+                              shuffle=True, mesh=mesh, seed=0)
+  batches = list(loader)
+  assert len(batches) == len(loader) == N // (bs * num_parts)
+  b0 = batches[0]
+  assert b0.x.shape[0] == num_parts
+  assert b0.edge_index.shape[1] == 2
+
+  model = GraphSAGE(hidden_features=8, out_features=5, num_layers=2)
+  tx = optax.adam(1e-2)
+  single = jax.tree_util.tree_map(lambda v: v[0], b0)
+  params = model.init(jax.random.key(0), single.x, single.edge_index,
+                      single.edge_mask)
+  from graphlearn_tpu.models.train import TrainState
+  state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+  step = make_dp_supervised_step(model.apply, tx, bs, mesh)
+  state = replicate(state, mesh)
+  losses = []
+  for _ in range(3):
+    for batch in loader:
+      state, loss, _ = step(state, batch)
+      losses.append(float(loss))
+  assert np.isfinite(losses).all()
+  assert losses[-1] < losses[0]
