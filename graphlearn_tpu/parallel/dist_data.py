@@ -65,15 +65,19 @@ class DistGraph:
 
 def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
                      node_pb: np.ndarray, num_nodes: int,
-                     edge_ids: Optional[np.ndarray] = None
+                     edge_ids: Optional[np.ndarray] = None,
+                     num_parts: Optional[int] = None
                      ) -> Tuple[DistGraph, np.ndarray]:
   """Relabel + shard a COO graph by a node partition book.
 
   Returns ``(dist_graph, old2new)`` — feed seeds/features through
-  ``old2new`` to enter the relabeled id space.
+  ``old2new`` to enter the relabeled id space.  Pass ``num_parts``
+  explicitly when trailing partitions may be empty (the book's max
+  value alone would under-count them).
   """
   node_pb = np.asarray(node_pb)
-  num_parts = int(node_pb.max()) + 1 if node_pb.size else 1
+  if num_parts is None:
+    num_parts = int(node_pb.max()) + 1 if node_pb.size else 1
   # contiguous relabel: sort nodes by (partition, old id).
   order = np.argsort(node_pb, kind='stable')         # new id -> old id
   old2new = np.empty(num_nodes, dtype=np.int64)
@@ -179,14 +183,15 @@ class DistDataset:
       perm = rng.permutation(n)
       for p in range(num_parts):
         node_pb[perm[p::num_parts]] = p
-    g, old2new = build_dist_graph(rows, cols, node_pb, n)
+    g, old2new = build_dist_graph(rows, cols, node_pb, n,
+                                  num_parts=num_parts)
     nf = (build_dist_feature(node_feat, old2new, g.bounds)
           if node_feat is not None else None)
     nl = None
     if node_label is not None:
+      # build_dist_feature preserves dtype — no float round-trip.
       lab = np.asarray(node_label)
-      nl = build_dist_feature(lab.astype(np.float32), old2new, g.bounds)
-      nl = nl.shards[..., 0].astype(lab.dtype)
+      nl = build_dist_feature(lab, old2new, g.bounds).shards[..., 0]
     return cls(g, nf, nl, old2new)
 
   @classmethod
@@ -207,7 +212,8 @@ class DistDataset:
     rows = np.concatenate([p['graph'].edge_index[0] for p in parts])
     cols = np.concatenate([p['graph'].edge_index[1] for p in parts])
     eids = np.concatenate([p['graph'].eids for p in parts])
-    g, old2new = build_dist_graph(rows, cols, node_pb, n, edge_ids=eids)
+    g, old2new = build_dist_graph(rows, cols, node_pb, n, edge_ids=eids,
+                                  num_parts=num_parts)
     nf = None
     if parts[0]['node_feat'] is not None:
       d = parts[0]['node_feat'].feats.shape[1]
@@ -222,6 +228,5 @@ class DistDataset:
       for p in parts:
         lab, ids = p['node_label']
         labels[ids] = lab
-      nlf = build_dist_feature(labels.astype(np.float32), old2new, g.bounds)
-      nl = nlf.shards[..., 0].astype(lab0.dtype)
+      nl = build_dist_feature(labels, old2new, g.bounds).shards[..., 0]
     return cls(g, nf, nl, old2new)

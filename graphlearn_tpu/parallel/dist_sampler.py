@@ -98,11 +98,15 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   return out_nbrs, out_mask, out_eids
 
 
-def dist_gather(shard_loc, bounds, ids, axis: str, num_parts: int):
-  """Distributed row gather: ``out[i] = table[ids[i]]`` where the table
-  is range-sharded over the mesh (the collective-era
+def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int):
+  """Distributed row gather from several range-sharded tables that
+  share ``bounds``: ``out_t[i] = table_t[ids[i]]`` (the collective-era
   `DistFeature.async_get`, `distributed/dist_feature.py:134-269`).
-  Invalid ids (-1) return zero rows."""
+
+  The id bucketing and request all_to_all run ONCE for all tables —
+  feature + label collection share a single exchange.  Invalid ids
+  (-1) return zero rows.
+  """
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
   owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(jnp.int32)
@@ -111,20 +115,29 @@ def dist_gather(shard_loc, bounds, ids, axis: str, num_parts: int):
   flat = recv.reshape(-1)
   valid = flat >= 0
   local = jnp.where(valid, flat - my_start, 0)
-  local = jnp.clip(local, 0, shard_loc.shape[0] - 1)
-  rows = shard_loc[local]
-  if rows.ndim == 1:
-    rows = jnp.where(valid, rows, 0)
-  else:
-    rows = jnp.where(valid[:, None], rows, 0)
   c = ids.shape[0]
-  reply = jax.lax.all_to_all(
-      rows.reshape((num_parts, c) + rows.shape[1:]), axis, 0, 0,
-      tiled=True)
-  out = reply[slot_p, slot_j]
-  if out.ndim == 1:
-    return jnp.where(ids >= 0, out, 0)
-  return jnp.where((ids >= 0)[:, None], out, 0)
+  outs = []
+  for shard_loc in shard_locs:
+    idx = jnp.clip(local, 0, shard_loc.shape[0] - 1)
+    rows = shard_loc[idx]
+    if rows.ndim == 1:
+      rows = jnp.where(valid, rows, 0)
+    else:
+      rows = jnp.where(valid[:, None], rows, 0)
+    reply = jax.lax.all_to_all(
+        rows.reshape((num_parts, c) + rows.shape[1:]), axis, 0, 0,
+        tiled=True)
+    out = reply[slot_p, slot_j]
+    if out.ndim == 1:
+      outs.append(jnp.where(ids >= 0, out, 0))
+    else:
+      outs.append(jnp.where((ids >= 0)[:, None], out, 0))
+  return tuple(outs)
+
+
+def dist_gather(shard_loc, bounds, ids, axis: str, num_parts: int):
+  """Single-table convenience wrapper over :func:`dist_gather_multi`."""
+  return dist_gather_multi((shard_loc,), bounds, ids, axis, num_parts)[0]
 
 
 def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
@@ -177,10 +190,15 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
     col = jnp.concatenate(cols_acc)
     edge = jnp.concatenate(eids_acc) if with_edge else None
     x = y = None
-    if collect_features:
-      x = dist_gather(fshard, bounds, state.nodes, axis, num_parts)
-    if collect_labels:
-      y = dist_gather(lshard, bounds, state.nodes, axis, num_parts)
+    tables = (((fshard,) if collect_features else ())
+              + ((lshard,) if collect_labels else ()))
+    if tables:
+      got = list(dist_gather_multi(tables, bounds, state.nodes, axis,
+                                   num_parts))
+      if collect_features:
+        x = got.pop(0)
+      if collect_labels:
+        y = got.pop(0)
     cum = jnp.stack(hop_counts)
     nsn = jnp.concatenate([cum[:1], cum[1:] - cum[:-1]]).astype(jnp.int32)
 

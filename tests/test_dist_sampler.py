@@ -115,10 +115,7 @@ def test_dist_loader_epoch_and_training():
   model = GraphSAGE(hidden_features=8, out_features=5, num_layers=2)
   tx = optax.adam(1e-2)
   single = jax.tree_util.tree_map(lambda v: v[0], b0)
-  params = model.init(jax.random.key(0), single.x, single.edge_index,
-                      single.edge_mask)
-  from graphlearn_tpu.models.train import TrainState
-  state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+  state, _ = create_train_state(model, jax.random.key(0), single, tx)
   step = make_dp_supervised_step(model.apply, tx, bs, mesh)
   state = replicate(state, mesh)
   losses = []

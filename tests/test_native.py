@@ -1,0 +1,244 @@
+"""Tests for the native host runtime (csrc/ via ctypes).
+
+Mirrors the reference's C++ gtest coverage
+(`test/cpp/test_shm_queue.cu`, `test_tensor_map_serializer.cu`,
+`test_random_sampler.cu`, `test_random_negative_sampler.cu`,
+`test_inducer.cu`) — tiny handcrafted graphs, exact assertions, plus a
+forked-process queue test.
+"""
+import multiprocessing as mp
+import pickle
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu import native as nat
+
+
+@pytest.fixture(scope='module')
+def ring_graph():
+  # Node v -> {v+1, v+2} mod n, the reference's deterministic test
+  # topology (`test/python/dist_test_utils.py`).
+  n = 40
+  rows = np.repeat(np.arange(n), 2).astype(np.int64)
+  cols = np.concatenate(
+      [np.stack([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n], 1)]
+  ).reshape(-1).astype(np.int64)
+  indptr, indices, perm = nat.coo_to_csr(rows, cols, n)
+  return n, indptr, indices, perm
+
+
+class TestSerializer:
+  def test_roundtrip(self):
+    msg = {
+        'x': np.random.randn(5, 3).astype(np.float32),
+        'ids': np.arange(7, dtype=np.int64),
+        'mask': np.array([True, False, True]),
+        'scalar': np.array(42, np.int32),
+        'empty': np.zeros((0, 4), np.float32),
+    }
+    out = nat.parse_tensor_map(nat.serialize_tensor_map(msg))
+    assert set(out) == set(msg)
+    for k in msg:
+      assert out[k].dtype == msg[k].dtype
+      assert out[k].shape == msg[k].shape
+      assert np.array_equal(out[k], msg[k])
+
+  def test_noncontiguous_input(self):
+    big = np.random.randn(6, 6).astype(np.float32)
+    msg = {'v': big[:, 2]}  # strided view
+    out = nat.parse_tensor_map(nat.serialize_tensor_map(msg))
+    assert np.array_equal(out['v'], big[:, 2])
+
+  def test_bad_buffer(self):
+    with pytest.raises(ValueError):
+      nat.parse_tensor_map(b'\x00' * 32)
+
+
+class TestShmQueue:
+  def test_fifo_and_size(self):
+    q = nat.ShmQueue(4, 4096)
+    for i in range(3):
+      q.put({'i': np.array(i, np.int64)})
+    assert q.qsize() == 3 and not q.empty()
+    got = [int(q.get()['i']) for _ in range(3)]
+    assert got == [0, 1, 2]
+    assert q.empty()
+    q.close()
+
+  def test_oversize_message_rejected(self):
+    q = nat.ShmQueue(2, 64)
+    with pytest.raises(ValueError):
+      q.put_bytes(b'x' * 100)
+    q.close()
+
+  def test_cross_process_pickle(self):
+    q = nat.ShmQueue(4, 1 << 16)
+    msg = {'x': np.random.randn(8, 4).astype(np.float32)}
+    q.put(msg)
+    ctx = mp.get_context('spawn')
+    p = ctx.Process(target=_echo_double, args=(pickle.dumps(q),))
+    p.start()
+    p.join(30)
+    assert p.exitcode == 0
+    out = q.get()
+    assert np.allclose(out['x'], msg['x'] * 2)
+    q.close()
+
+  def test_blocking_producer_when_full(self):
+    q = nat.ShmQueue(2, 256)
+    q.put_bytes(b'a')
+    q.put_bytes(b'b')
+    ctx = mp.get_context('spawn')
+    p = ctx.Process(target=_drain_one, args=(pickle.dumps(q),))
+    p.start()
+    # This put blocks until the child consumes one slot.
+    q.put_bytes(b'c')
+    p.join(30)
+    assert p.exitcode == 0
+    assert q.get_bytes() == b'b'
+    assert q.get_bytes() == b'c'
+    q.close()
+
+
+def _echo_double(qp):
+  qq = pickle.loads(qp)
+  m = qq.get()
+  m['x'] = m['x'] * 2
+  qq.put(m)
+
+
+def _drain_one(qp):
+  import time
+  time.sleep(0.2)
+  qq = pickle.loads(qp)
+  assert qq.get_bytes() == b'a'
+
+
+class TestCooToCsr:
+  def test_exact(self):
+    rows = np.array([2, 0, 1, 0, 2], np.int64)
+    cols = np.array([1, 2, 0, 1, 0], np.int64)
+    indptr, indices, perm = nat.coo_to_csr(rows, cols, 3)
+    assert indptr.tolist() == [0, 2, 3, 5]
+    assert indices.tolist() == [2, 1, 0, 1, 0]
+    # perm maps CSR slot -> original edge id
+    assert rows[perm].tolist() == [0, 0, 1, 2, 2]
+    assert np.array_equal(cols[perm], indices)
+
+  def test_matches_device_builder(self, ring_graph):
+    n, indptr, indices, _ = ring_graph
+    from graphlearn_tpu.data import CSRTopo
+    rows = np.repeat(np.arange(n), 2)
+    cols = indices.copy()
+    topo = CSRTopo((rows, indices), layout='COO', num_nodes=n)
+    assert np.array_equal(np.asarray(topo.indptr), indptr)
+
+
+class TestCpuSampler:
+  def test_full_copy_when_deg_le_k(self, ring_graph):
+    n, indptr, indices, _ = ring_graph
+    seeds = np.arange(10, dtype=np.int64)
+    nbrs, mask, eids = nat.sample_one_hop(indptr, indices, seeds, 4,
+                                          seed=1, with_edge_ids=True)
+    assert nbrs.shape == (10, 4)
+    for b, v in enumerate(seeds):
+      got = set(nbrs[b][mask[b]].tolist())
+      assert got == {(v + 1) % n, (v + 2) % n}
+      assert mask[b].sum() == 2
+      assert (nbrs[b][~mask[b]] == -1).all()
+
+  def test_downsample_distinct(self):
+    n = 50
+    rng = np.random.default_rng(0)
+    rows = np.repeat(np.arange(n), 20).astype(np.int64)
+    cols = rng.integers(0, n, n * 20).astype(np.int64)
+    indptr, indices, _ = nat.coo_to_csr(rows, cols, n)
+    nbrs, mask, eids = nat.sample_one_hop(indptr, indices,
+                                          np.arange(n, dtype=np.int64),
+                                          8, seed=7, with_edge_ids=True)
+    assert mask.all()
+    for b in range(n):
+      assert len(set(eids[b].tolist())) == 8  # distinct edges
+      lo, hi = indptr[b], indptr[b + 1]
+      assert set(nbrs[b]) <= set(indices[lo:hi])
+
+  def test_padded_seed_masked(self, ring_graph):
+    n, indptr, indices, _ = ring_graph
+    seeds = np.array([0, -1, 3], np.int64)
+    nbrs, mask, _ = nat.sample_one_hop(indptr, indices, seeds, 4)
+    assert not mask[1].any()
+    assert (nbrs[1] == -1).all()
+
+  def test_deterministic_by_seed(self, ring_graph):
+    n, indptr, indices, _ = ring_graph
+    s = np.arange(n, dtype=np.int64)
+    a = nat.sample_one_hop(indptr, indices, s, 1, seed=9)
+    b = nat.sample_one_hop(indptr, indices, s, 1, seed=9)
+    c = nat.sample_one_hop(indptr, indices, s, 1, seed=10)
+    assert np.array_equal(a[0], b[0])
+    assert not np.array_equal(a[0], c[0])  # overwhelmingly likely
+
+
+class TestNegativeSampler:
+  def test_strict_rejects_edges(self, ring_graph):
+    n, indptr, indices, _ = ring_graph
+    rows, cols = nat.negative_sample(indptr, indices, 64, trials=10,
+                                     strict=True, seed=3)
+    for r, c in zip(rows, cols):
+      assert c not in indices[indptr[r]:indptr[r + 1]]
+
+  def test_padding_fills(self, ring_graph):
+    n, indptr, indices, _ = ring_graph
+    rows, cols = nat.negative_sample(indptr, indices, 100, trials=1,
+                                     strict=True, padding=True, seed=3)
+    assert len(rows) == 100
+
+
+class TestCpuInducer:
+  def test_seed_dedup(self):
+    ind = nat.CpuInducer()
+    loc = ind.init_nodes(np.array([5, 7, 5, 9], np.int64))
+    assert loc.tolist() == [0, 1, 0, 2]
+    assert ind.num_nodes == 3
+
+  def test_induce_relabel_and_direction(self):
+    ind = nat.CpuInducer()
+    ind.init_nodes(np.array([10, 20], np.int64))
+    nbrs = np.array([[20, 30], [10, 40]], np.int64)
+    mask = np.ones((2, 2), np.uint8)
+    new, rl, cl = ind.induce_next(np.array([10, 20], np.int64), nbrs, mask)
+    assert set(new.tolist()) == {30, 40}
+    # Edge direction: neighbor -> seed.
+    assert rl[0, 0] == 1 and cl[0, 0] == 0   # 20 -> 10
+    assert rl[1, 0] == 0 and cl[1, 0] == 1   # 10 -> 20
+    assert rl[0, 1] == 2 and cl[0, 1] == 0   # 30 -> 10
+
+  def test_masked_slots_no_edges(self):
+    ind = nat.CpuInducer()
+    ind.init_nodes(np.array([1], np.int64))
+    nbrs = np.array([[2, -1]], np.int64)
+    mask = np.array([[1, 0]], np.uint8)
+    new, rl, cl = ind.induce_next(np.array([1], np.int64), nbrs, mask)
+    assert rl[0, 1] == -1 and cl[0, 1] == -1
+    assert new.tolist() == [2]
+
+  def test_clear(self):
+    ind = nat.CpuInducer()
+    ind.init_nodes(np.array([1, 2], np.int64))
+    ind.clear()
+    assert ind.num_nodes == 0
+    loc = ind.init_nodes(np.array([3], np.int64))
+    assert loc.tolist() == [0]
+
+
+class TestCalNbrProb:
+  def test_propagation(self):
+    # 0 -> {1, 2}; 1 -> {2}
+    rows = np.array([0, 0, 1], np.int64)
+    cols = np.array([1, 2, 2], np.int64)
+    indptr, indices, _ = nat.coo_to_csr(rows, cols, 3)
+    p = nat.cal_nbr_prob(indptr, indices, np.array([1., 0., 0.],
+                                                   np.float32), k=1)
+    # deg(0)=2, w = 1 * min(1, 1/2) = .5 to each nbr
+    assert np.allclose(p, [0., .5, .5])
