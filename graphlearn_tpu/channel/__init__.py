@@ -1,0 +1,14 @@
+"""Sample-message channels: producer -> consumer transport.
+
+TPU-native counterpart of the reference `python/channel/`
+(`channel/base.py`, `shm_channel.py`, `mp_channel.py`,
+`remote_channel.py`): typed queues carrying flat ``SampleMessage``
+dicts from sampling producers to the training process.
+"""
+from .base import ChannelBase, SampleMessage
+from .mp_channel import MpChannel
+from .remote_channel import RemoteReceivingChannel
+from .shm_channel import ShmChannel
+
+__all__ = ['ChannelBase', 'SampleMessage', 'ShmChannel', 'MpChannel',
+           'RemoteReceivingChannel']

@@ -1,0 +1,38 @@
+"""Channel contract (reference `channel/base.py:24-42`).
+
+A ``SampleMessage`` is a flat ``Dict[str, np.ndarray]`` — the
+process-portable form of one sampled mini-batch (the reference uses
+``Dict[str, torch.Tensor]``, `channel/base.py:24`).  Key conventions
+(mirroring `distributed/dist_neighbor_sampler.py:600-673`):
+
+  * ``'#IS_HETERO'``: uint8 scalar flag.
+  * ``'#META.<name>'``: loader metadata entries.
+  * homo: ``ids / rows / cols / eids / nfeats / nlabels / batch ...``
+  * hetero: ``'<type>.ids'``, ``'<src>__<rel>__<dst>.rows'``, ...
+"""
+from __future__ import annotations
+
+import abc
+from typing import Dict
+
+import numpy as np
+
+SampleMessage = Dict[str, np.ndarray]
+
+
+class ChannelBase(abc.ABC):
+  """Abstract producer->consumer sample-message queue."""
+
+  @abc.abstractmethod
+  def send(self, msg: SampleMessage) -> None:
+    """Enqueue one message (blocks when full)."""
+
+  @abc.abstractmethod
+  def recv(self) -> SampleMessage:
+    """Dequeue one message (blocks when empty)."""
+
+  def empty(self) -> bool:
+    raise NotImplementedError
+
+  def close(self) -> None:
+    pass

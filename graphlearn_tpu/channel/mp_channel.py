@@ -1,0 +1,28 @@
+"""multiprocessing.Queue channel (reference `channel/mp_channel.py:21-34`).
+
+Slower than `ShmChannel` (pickle per message) but size-unbounded and
+dependency-free; the debugging/fallback transport.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+
+from .base import ChannelBase, SampleMessage
+
+
+class MpChannel(ChannelBase):
+
+  def __init__(self, maxsize: int = 0):
+    self._q = mp.get_context('spawn').Queue(maxsize)
+
+  def send(self, msg: SampleMessage) -> None:
+    self._q.put(msg)
+
+  def recv(self) -> SampleMessage:
+    return self._q.get()
+
+  def empty(self) -> bool:
+    return self._q.empty()
+
+  def close(self) -> None:
+    self._q.close()

@@ -1,0 +1,72 @@
+"""Pull-based prefetching channel for server-fed loaders.
+
+Reference `channel/remote_channel.py:23-85`: the client keeps
+``prefetch_size`` async fetches in flight against a sampling server's
+message buffer and hands results to the trainer in order.  Here the
+fetch is any callable (the `DistClient` binds it to a socket RPC); a
+small thread pool keeps the pipeline full — the asyncio/torch-future
+machinery of the reference collapses to ``concurrent.futures``.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures as cf
+from typing import Callable, Optional
+
+from .base import ChannelBase, SampleMessage
+
+# Server returns this key to signal the epoch's message stream is done.
+END_OF_EPOCH = '#END_OF_EPOCH'
+
+
+class RemoteReceivingChannel(ChannelBase):
+  """Prefetch ``num_expected`` messages per epoch from ``fetch_fn``.
+
+  Args:
+    fetch_fn: blocking callable returning one `SampleMessage`.
+    num_expected: messages per epoch (loader's batch count).
+    prefetch_size: in-flight fetches (reference default 4,
+      `dist_options.py:202-258`).
+  """
+
+  def __init__(self, fetch_fn: Callable[[], SampleMessage],
+               num_expected: int, prefetch_size: int = 4):
+    self._fetch = fetch_fn
+    self._num_expected = num_expected
+    self._prefetch = max(1, prefetch_size)
+    self._pool = cf.ThreadPoolExecutor(max_workers=self._prefetch)
+    self._pending: collections.deque = collections.deque()
+    self._issued = 0
+    self._received = 0
+
+  def reset(self, num_expected: Optional[int] = None) -> None:
+    """Start a new epoch (reference re-creates the channel per epoch)."""
+    if num_expected is not None:
+      self._num_expected = num_expected
+    self._issued = 0
+    self._received = 0
+    self._pending.clear()
+
+  def _fill(self) -> None:
+    while (self._issued < self._num_expected
+           and len(self._pending) < self._prefetch):
+      self._pending.append(self._pool.submit(self._fetch))
+      self._issued += 1
+
+  def send(self, msg: SampleMessage) -> None:
+    raise RuntimeError('RemoteReceivingChannel is receive-only')
+
+  def recv(self) -> SampleMessage:
+    if self._received >= self._num_expected:
+      raise StopIteration
+    self._fill()
+    msg = self._pending.popleft().result()
+    self._received += 1
+    self._fill()
+    return msg
+
+  def empty(self) -> bool:
+    return not self._pending
+
+  def close(self) -> None:
+    self._pool.shutdown(wait=False, cancel_futures=True)

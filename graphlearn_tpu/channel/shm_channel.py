@@ -1,0 +1,56 @@
+"""Cross-process shared-memory channel.
+
+Wraps the native SysV-shm MPMC ring queue (`csrc/shm_queue.cc`, the
+TPU-host twin of the reference's `csrc/shm_queue.cc:138-151` +
+`SampleQueue`).  Messages are tensor-map serialized in C
+(`csrc/tensor_map.cc`) — no pickle on the hot path.  The channel is
+picklable by shmid so producer subprocesses attach to the same segment
+(reference `py_export.cc:132-140` pickles `SampleQueue` the same way).
+"""
+from __future__ import annotations
+
+from ..native import ShmQueue
+from ..utils.units import parse_size
+from .base import ChannelBase, SampleMessage
+
+
+class ShmChannel(ChannelBase):
+  """Fixed-capacity shm ring of sample messages.
+
+  Args:
+    capacity: max queued messages (reference ``ShmChannel(capacity,...)``,
+      `channel/shm_channel.py:24-60`).
+    shm_size: total shared-memory budget in bytes, or a string like
+      ``'64MB'``; per-slot size = shm_size / capacity.
+  """
+
+  def __init__(self, capacity: int = 64, shm_size='64MB'):
+    shm_bytes = parse_size(shm_size)
+    slot = max(int(shm_bytes) // max(capacity, 1), 4096)
+    self._q = ShmQueue(num_slots=capacity, slot_bytes=slot)
+
+  def send(self, msg: SampleMessage) -> None:
+    self._q.put(msg)
+
+  def recv(self) -> SampleMessage:
+    return self._q.get()
+
+  def empty(self) -> bool:
+    return self._q.empty()
+
+  def pin_memory(self) -> None:
+    """No-op on TPU hosts: there is no cudaHostRegister analog — the
+    consumer's `jax.device_put` path already staged through host DRAM
+    (reference `ShmChannel.pin_memory`, `channel/shm_channel.py:47`)."""
+
+  def close(self) -> None:
+    self._q.close()
+
+  def __reduce__(self):
+    return (_attach, (self._q,))
+
+
+def _attach(q):
+  ch = ShmChannel.__new__(ShmChannel)
+  ch._q = q
+  return ch

@@ -1,0 +1,30 @@
+"""Process-based host runtime: producers, channels, server-client.
+
+The SPMD device-mesh engine lives in :mod:`graphlearn_tpu.parallel`
+(sampling *on* TPU via shard_map collectives).  This package is the
+host-side complement — the reference's `python/distributed/` world
+(`dist_context.py`, `dist_options.py`, `dist_sampling_producer.py`,
+`dist_loader.py`, `dist_server.py`, `dist_client.py`): sampling
+subprocess pools on CPU feeding the TPU trainer through shm channels,
+and a server-client mode where dedicated sampling hosts feed remote
+trainers over sockets.
+"""
+from .dist_context import (DistContext, DistRole, get_context,
+                           init_worker_group)
+from .dist_loader import DistLoader, DistNeighborLoader
+from .dist_options import (CollocatedDistSamplingWorkerOptions,
+                           MpDistSamplingWorkerOptions,
+                           RemoteDistSamplingWorkerOptions)
+from .dist_sampling_producer import (CollocatedSamplingProducer,
+                                     MpSamplingProducer)
+from .host_dataset import HostDataset
+from .host_sampler import HostNeighborSampler
+
+__all__ = [
+    'DistContext', 'DistRole', 'get_context', 'init_worker_group',
+    'DistLoader', 'DistNeighborLoader',
+    'CollocatedDistSamplingWorkerOptions', 'MpDistSamplingWorkerOptions',
+    'RemoteDistSamplingWorkerOptions',
+    'CollocatedSamplingProducer', 'MpSamplingProducer',
+    'HostDataset', 'HostNeighborSampler',
+]

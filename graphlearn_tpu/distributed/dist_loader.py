@@ -1,0 +1,190 @@
+"""Trainer-side loader over a sample channel.
+
+Reference `distributed/dist_loader.py:49-383`: pick a worker mode
+(collocated / mp / remote), run the epoch protocol (produce_all, then
+recv exactly the expected number of messages), and collate each flat
+``SampleMessage`` into the training batch.  TPU twist: ragged host
+messages are padded to **static capacities** here so every batch
+compiles to the same XLA program, then staged with one `device_put`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from ..channel import (ChannelBase, MpChannel, RemoteReceivingChannel,
+                       SampleMessage, ShmChannel)
+from ..loader.transform import Batch
+from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
+from .dist_options import (CollocatedDistSamplingWorkerOptions,
+                           MpDistSamplingWorkerOptions,
+                           RemoteDistSamplingWorkerOptions)
+from .dist_sampling_producer import (CollocatedSamplingProducer,
+                                     MpSamplingProducer)
+from .host_dataset import HostDataset
+
+WorkerOptions = Union[CollocatedDistSamplingWorkerOptions,
+                      MpDistSamplingWorkerOptions,
+                      RemoteDistSamplingWorkerOptions]
+
+
+def edge_capacity(batch_size: int, fanouts: Sequence[int]) -> int:
+  """Static bound on total sampled edges across hops."""
+  total, width = 0, batch_size
+  for k in fanouts:
+    width *= int(k)
+    total += width
+  return max(round_up(total, 8), 8)
+
+
+class DistLoader:
+  """Channel-fed loader base (reference `dist_loader.py:49-383`).
+
+  Args:
+    dataset: `HostDataset` (sampling world's shard).
+    num_neighbors: per-hop fanouts.
+    input_nodes: seed ids.
+    batch_size / shuffle / drop_last: epoch iteration controls.
+    worker_options: deployment mode selector.
+    to_device: stage collated batches onto the default device.
+  """
+
+  def __init__(self, dataset: Optional[HostDataset], num_neighbors,
+               input_nodes, batch_size: int = 512, shuffle: bool = False,
+               drop_last: bool = False,
+               worker_options: Optional[WorkerOptions] = None,
+               with_edge: bool = False, to_device: bool = True,
+               seed: int = 0):
+    self.fanouts = [int(k) for k in num_neighbors]
+    self.batch_size = int(batch_size)
+    self.seeds = np.asarray(input_nodes).reshape(-1)
+    self.shuffle = shuffle
+    self.drop_last = drop_last
+    self.with_edge = with_edge
+    self.to_device = to_device
+    self.opts = worker_options or CollocatedDistSamplingWorkerOptions()
+    self._epoch_iter = None
+    self._expected = 0
+    self._received = 0
+    self.node_cap = round_up(
+        min(max_sampled_nodes(self.batch_size, self.fanouts),
+            self.batch_size + (dataset.num_nodes if dataset else 1 << 30)),
+        8)
+    self.edge_cap = edge_capacity(self.batch_size, self.fanouts)
+
+    self.channel: Optional[ChannelBase] = None
+    self._producer = None
+    if isinstance(self.opts, MpDistSamplingWorkerOptions):
+      self.channel = ShmChannel(self.opts.resolved_capacity(),
+                                self.opts.resolved_size())
+      self._producer = MpSamplingProducer(
+          dataset, self.fanouts, self.batch_size, self.channel,
+          self.opts, with_edge=with_edge, shuffle=shuffle, seed=seed)
+      self._producer.init()
+    elif isinstance(self.opts, RemoteDistSamplingWorkerOptions):
+      from .dist_client import get_client
+      client = get_client()
+      assert client is not None, (
+          'init_client() before RemoteDistSamplingWorkerOptions loaders')
+      self._remote = client.create_sampling_producer(
+          self.opts, self.fanouts, self.batch_size, self.seeds,
+          with_edge=with_edge, shuffle=shuffle, seed=seed)
+      self.channel = RemoteReceivingChannel(
+          self._remote.fetch, self._num_batches(),
+          self.opts.prefetch_size)
+    else:
+      self._producer = CollocatedSamplingProducer(
+          dataset, self.fanouts, self.batch_size, with_edge=with_edge,
+          collect_features=self.opts.collect_features, shuffle=shuffle,
+          seed=seed)
+
+  def _num_batches(self) -> int:
+    n = len(self.seeds)
+    if self.drop_last:
+      return n // self.batch_size
+    return (n + self.batch_size - 1) // self.batch_size
+
+  def __len__(self) -> int:
+    return self._num_batches()
+
+  # -- epoch protocol (reference `__iter__`/`__next__`,
+  # `dist_loader.py:246-272`) ---------------------------------------------
+  def __iter__(self):
+    n = self._num_batches() * self.batch_size if self.drop_last else None
+    seeds = self.seeds[:n] if n is not None else self.seeds
+    if isinstance(self.opts, MpDistSamplingWorkerOptions):
+      self._expected = self._producer.produce_all(seeds)
+      self._received = 0
+    elif isinstance(self.opts, RemoteDistSamplingWorkerOptions):
+      self._remote.start_new_epoch()
+      self.channel.reset(self._num_batches())
+      self._expected = self._num_batches()
+      self._received = 0
+    else:
+      self._epoch_iter = self._producer.epoch(seeds)
+    return self
+
+  def __next__(self) -> Batch:
+    if self._epoch_iter is not None:
+      msg = next(self._epoch_iter)
+    else:
+      if self._received >= self._expected:
+        raise StopIteration
+      msg = self.channel.recv()
+      self._received += 1
+    return self._collate_fn(msg)
+
+  # -- message -> static-shape Batch (reference `dist_loader.py:286-383`) --
+  def _collate_fn(self, msg: SampleMessage) -> Batch:
+    nc, ec = self.node_cap, self.edge_cap
+    ids = msg['ids']
+    c = len(ids)
+    node = np.full(nc, INVALID_ID, np.int32)
+    node[:c] = ids
+    e = len(msg['rows'])
+    edge_index = np.full((2, ec), INVALID_ID, np.int32)
+    edge_index[0, :e] = msg['rows']
+    edge_index[1, :e] = msg['cols']
+    x = y = edge = None
+    if 'nfeats' in msg:
+      d = msg['nfeats'].shape[1]
+      x = np.zeros((nc, d), msg['nfeats'].dtype)
+      x[:c] = msg['nfeats']
+    if 'nlabels' in msg:
+      y = np.zeros(nc, msg['nlabels'].dtype)
+      y[:c] = msg['nlabels']
+    if 'eids' in msg:
+      edge = np.full(ec, INVALID_ID, np.int64)
+      edge[:e] = msg['eids']
+    batch = np.full(self.batch_size, INVALID_ID, np.int64)
+    batch[:len(msg['batch'])] = msg['batch']
+    out = Batch(
+        x=x, y=y, edge_index=edge_index, node=node,
+        node_mask=node >= 0, edge_mask=edge_index[0] >= 0, edge=edge,
+        batch=batch, batch_size=self.batch_size,
+        num_sampled_nodes=msg.get('num_sampled_nodes'),
+        metadata={'seed_local': msg.get('seed_local')})
+    if self.to_device:
+      out = jax.device_put(out)
+    return out
+
+  def shutdown(self) -> None:
+    if self._producer is not None and hasattr(self._producer, 'shutdown'):
+      self._producer.shutdown()
+    if isinstance(self.opts, RemoteDistSamplingWorkerOptions):
+      self._remote.destroy()
+    if self.channel is not None:
+      self.channel.close()
+
+  def __del__(self):
+    try:
+      self.shutdown()
+    except Exception:
+      pass
+
+
+class DistNeighborLoader(DistLoader):
+  """Node-wise distributed loader (reference
+  `distributed/dist_neighbor_loader.py:27-94`)."""

@@ -1,0 +1,64 @@
+"""Loader deployment options (reference `distributed/dist_options.py:26-265`).
+
+Three modes, same trio as the reference:
+
+  * **Collocated** — sampling runs synchronously in the training
+    process (`_BasicDistSamplingWorkerOptions` + `Collocated…`, `:119`).
+  * **Mp** — a pool of sampling subprocesses per trainer feeding a
+    `ShmChannel` (`MpDistSamplingWorkerOptions`, `:145-199`).
+  * **Remote** — sampling runs on dedicated server hosts; the trainer
+    pulls over sockets (`RemoteDistSamplingWorkerOptions`, `:202-258`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Union
+
+
+@dataclass
+class CollocatedDistSamplingWorkerOptions:
+  """Sample in-process, synchronously."""
+  use_native: bool = False       # host CPU sampler instead of device ops
+  collect_features: bool = True
+
+
+@dataclass
+class MpDistSamplingWorkerOptions:
+  """Spawn ``num_workers`` sampling subprocesses feeding a shm channel.
+
+  Reference defaults: channel 64MB/worker, capacity scaled by pending
+  batches (`dist_options.py:145-199`).
+  """
+  num_workers: int = 2
+  worker_concurrency: int = 4           # pending batches per worker
+  channel_capacity: Optional[int] = None  # default 4 * num_workers * conc
+  channel_size: Union[int, str, None] = None  # default 64MB * num_workers
+  collect_features: bool = True
+  pin_memory: bool = False              # accepted for API parity; no-op
+  mp_start_method: str = 'fork'         # producers are numpy-only
+
+  def resolved_capacity(self) -> int:
+    return (self.channel_capacity if self.channel_capacity is not None
+            else 4 * self.num_workers * self.worker_concurrency)
+
+  def resolved_size(self):
+    if self.channel_size is not None:
+      return self.channel_size
+    return 64 * 1024 * 1024 * self.num_workers
+
+
+@dataclass
+class RemoteDistSamplingWorkerOptions:
+  """Pull batches from sampling servers.
+
+  Reference `dist_options.py:202-258`: server ranks, per-server buffer,
+  client prefetch depth.
+  """
+  server_rank: Union[int, List[int], None] = None
+  num_workers: int = 2
+  worker_concurrency: int = 4
+  buffer_capacity: int = 64
+  buffer_size: Union[int, str] = '64MB'
+  prefetch_size: int = 4
+  collect_features: bool = True
+  worker_key: str = ''

@@ -1,0 +1,146 @@
+"""Sampling producers: subprocess pool + collocated twin.
+
+Reference `distributed/dist_sampling_producer.py:52-328`:
+``DistMpSamplingProducer`` spawns N sampling workers which consume
+SAMPLE_ALL commands from a task queue, iterate their seed slice, and
+push messages into the shm channel; ``DistCollocatedSamplingProducer``
+does the same synchronously in-process.  Here the workers are
+numpy/native-only (no device), started with ``fork`` so the graph and
+feature arrays are inherited copy-on-write.
+"""
+from __future__ import annotations
+
+import enum
+import multiprocessing as mp
+import queue as queue_mod
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..channel.base import ChannelBase
+from .dist_options import MpDistSamplingWorkerOptions
+from .host_dataset import HostDataset
+from .host_sampler import HostNeighborSampler
+
+
+class MpCommand(enum.Enum):
+  SAMPLE_ALL = 0
+  STOP = 1
+
+
+def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
+                          collect_features, channel, task_queue, seed):
+  """Body of one sampling subprocess (reference `_sampling_worker_loop`,
+  `dist_sampling_producer.py:52-144`)."""
+  sampler = HostNeighborSampler(
+      dataset, fanouts, with_edge=with_edge,
+      collect_features=collect_features, seed=seed * 7919 + rank)
+  while True:
+    try:
+      cmd, payload = task_queue.get(timeout=5.0)
+    except queue_mod.Empty:
+      continue
+    if cmd == MpCommand.STOP:
+      break
+    seeds, batch_size, epoch = payload
+    for lo in range(0, len(seeds), batch_size):
+      msg = sampler.sample_from_nodes(
+          seeds[lo:lo + batch_size],
+          batch_seed=(epoch * 1000003 + rank) * 131071 + lo)
+      channel.send(msg)
+
+
+class MpSamplingProducer:
+  """N sampling subprocesses feeding ``channel``.
+
+  Reference ``DistMpSamplingProducer`` (`dist_sampling_producer.py:
+  147-260`): per-epoch ``produce_all`` splits the shuffled seed set
+  into per-worker, batch-aligned ranges.
+  """
+
+  def __init__(self, dataset: HostDataset, num_neighbors: Sequence[int],
+               batch_size: int, channel: ChannelBase,
+               options: Optional[MpDistSamplingWorkerOptions] = None,
+               with_edge: bool = False, shuffle: bool = False,
+               seed: int = 0):
+    self.opts = options or MpDistSamplingWorkerOptions()
+    self.ds = dataset
+    self.fanouts = list(num_neighbors)
+    self.batch_size = int(batch_size)
+    self.channel = channel
+    self.with_edge = with_edge
+    self.shuffle = shuffle
+    self._rng = np.random.default_rng(seed)
+    self._seed = seed
+    self._epoch = 0
+    self._ctx = mp.get_context(self.opts.mp_start_method)
+    self._task_queues: List = []
+    self._workers: List = []
+
+  def init(self) -> None:
+    for r in range(self.opts.num_workers):
+      tq = self._ctx.Queue()
+      w = self._ctx.Process(
+          target=_sampling_worker_loop,
+          args=(r, self.ds, self.fanouts, self.with_edge,
+                self.opts.collect_features, self.channel, tq, self._seed),
+          daemon=True)
+      w.start()
+      self._task_queues.append(tq)
+      self._workers.append(w)
+
+  def num_batches(self, num_seeds: int) -> int:
+    return (num_seeds + self.batch_size - 1) // self.batch_size
+
+  def produce_all(self, seeds: np.ndarray) -> int:
+    """Dispatch one epoch; returns the number of messages to expect."""
+    seeds = np.asarray(seeds).reshape(-1)
+    if self.shuffle:
+      seeds = self._rng.permutation(seeds)
+    nw = max(len(self._workers), 1)
+    # batch-aligned contiguous slices (reference `:249-260`)
+    n_batches = self.num_batches(len(seeds))
+    per_worker = ((n_batches + nw - 1) // nw) * self.batch_size
+    for r, tq in enumerate(self._task_queues):
+      sl = seeds[r * per_worker:(r + 1) * per_worker]
+      if len(sl):
+        tq.put((MpCommand.SAMPLE_ALL, (sl, self.batch_size, self._epoch)))
+    self._epoch += 1
+    return n_batches
+
+  def shutdown(self) -> None:
+    for tq in self._task_queues:
+      try:
+        tq.put((MpCommand.STOP, None))
+      except Exception:
+        pass
+    for w in self._workers:
+      w.join(timeout=5.0)
+      if w.is_alive():
+        w.terminate()
+    self._workers = []
+    self._task_queues = []
+
+
+class CollocatedSamplingProducer:
+  """Synchronous in-process producer (reference
+  ``DistCollocatedSamplingProducer``, `dist_sampling_producer.py:
+  263-328`) — same message contract, no subprocesses, no channel."""
+
+  def __init__(self, dataset: HostDataset, num_neighbors: Sequence[int],
+               batch_size: int, with_edge: bool = False,
+               collect_features: bool = True, shuffle: bool = False,
+               seed: int = 0):
+    self.sampler = HostNeighborSampler(
+        dataset, num_neighbors, with_edge=with_edge,
+        collect_features=collect_features, seed=seed)
+    self.batch_size = int(batch_size)
+    self.shuffle = shuffle
+    self._rng = np.random.default_rng(seed)
+
+  def epoch(self, seeds: np.ndarray):
+    seeds = np.asarray(seeds).reshape(-1)
+    if self.shuffle:
+      seeds = self._rng.permutation(seeds)
+    for lo in range(0, len(seeds), self.batch_size):
+      yield self.sampler.sample_from_nodes(seeds[lo:lo + self.batch_size])

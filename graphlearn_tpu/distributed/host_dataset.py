@@ -1,0 +1,90 @@
+"""Host-side (numpy) dataset handle for sampling subprocesses.
+
+Producer workers never touch the TPU: they sample on CPU with the
+native ops (`csrc/cpu_ops.cc`, `csrc/inducer.cc`) over plain numpy
+CSR + feature arrays.  With the default ``fork`` start method children
+inherit these arrays copy-on-write — the zero-copy analog of the
+reference's ForkingPickler shm reductions (`data/*.py` "Pickling
+Registration").
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class HostDataset:
+  """CSR topology + features/labels as host numpy arrays.
+
+  Attributes:
+    indptr / indices / edge_ids: CSR (``edge_ids`` optional).
+    node_features: ``[N, D]`` or None.
+    node_labels: ``[N]`` or None.
+  """
+
+  def __init__(self, indptr, indices, edge_ids=None, node_features=None,
+               node_labels=None):
+    self.indptr = np.ascontiguousarray(indptr, np.int64)
+    self.indices = np.ascontiguousarray(indices, np.int64)
+    self.edge_ids = (np.ascontiguousarray(edge_ids, np.int64)
+                     if edge_ids is not None else None)
+    self.node_features = (np.asarray(node_features)
+                          if node_features is not None else None)
+    self.node_labels = (np.asarray(node_labels)
+                        if node_labels is not None else None)
+
+  @property
+  def num_nodes(self) -> int:
+    return len(self.indptr) - 1
+
+  @property
+  def num_edges(self) -> int:
+    return len(self.indices)
+
+  @classmethod
+  def from_coo(cls, rows, cols, num_nodes: Optional[int] = None,
+               node_features=None, node_labels=None) -> 'HostDataset':
+    from ..native import coo_to_csr
+    rows = np.asarray(rows)
+    cols = np.asarray(cols)
+    n = int(num_nodes if num_nodes is not None
+            else max(rows.max(initial=-1), cols.max(initial=-1)) + 1)
+    indptr, indices, perm = coo_to_csr(rows, cols, n)
+    return cls(indptr, indices, edge_ids=perm, node_features=node_features,
+               node_labels=node_labels)
+
+  @classmethod
+  def from_dataset(cls, dataset) -> 'HostDataset':
+    """Borrow the host copies inside a `graphlearn_tpu.data.Dataset`."""
+    topo = dataset.get_graph().csr_topo
+    feats = dataset.get_node_feature()
+    labels = dataset.get_node_label()
+    return cls(
+        topo.indptr, topo.indices, edge_ids=topo.edge_ids,
+        node_features=feats.host_get() if feats is not None else None,
+        node_labels=np.asarray(labels) if labels is not None else None)
+
+  @classmethod
+  def from_partition_dir(cls, root, partition_idx: int) -> 'HostDataset':
+    """Load one partition's shard from the offline layout
+    (`graphlearn_tpu.partition.load_partition`)."""
+    from ..partition import load_partition
+    from ..native import coo_to_csr
+    p = load_partition(root, partition_idx)
+    rows, cols = p['graph'].edge_index
+    n = len(p['node_pb'].table)
+    indptr, indices, perm = coo_to_csr(rows, cols, n)
+    feats = None
+    if p['node_feat'] is not None:
+      d = p['node_feat'].feats.shape[1]
+      feats = np.zeros((n, d), p['node_feat'].feats.dtype)
+      feats[p['node_feat'].ids] = p['node_feat'].feats
+    labels = None
+    if p['node_label'] is not None:
+      lab, ids = p['node_label']
+      labels = np.zeros((n,), lab.dtype)
+      labels[ids] = lab
+    eids = p['graph'].eids[perm] if p['graph'].eids is not None else perm
+    return cls(indptr, indices, edge_ids=eids, node_features=feats,
+               node_labels=labels)

@@ -1,0 +1,89 @@
+"""Host (CPU) multi-hop neighbor sampling -> flat SampleMessage.
+
+The engine that runs inside sampling subprocesses — the role the
+reference's `DistNeighborSampler._sample_from_nodes` + `_colloate_fn`
+play in its sampling workers (`distributed/dist_neighbor_sampler.py:
+255-324,600-673`), built on the native CPU ops instead of CUDA.
+Feature/label collation happens here, in the producer, so the trainer
+process only deserializes and `device_put`s.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import native
+from ..channel.base import SampleMessage
+from .host_dataset import HostDataset
+
+
+class HostNeighborSampler:
+  """Multi-hop uniform sampler over a `HostDataset`.
+
+  Args:
+    dataset: host CSR + features.
+    num_neighbors: per-hop fanouts.
+    with_edge: emit global edge ids.
+    collect_features: gather ``nfeats``/``nlabels`` rows into messages.
+    seed: base PRNG seed (per-batch streams derive from it).
+  """
+
+  def __init__(self, dataset: HostDataset, num_neighbors: Sequence[int],
+               with_edge: bool = False, collect_features: bool = True,
+               seed: int = 0):
+    self.ds = dataset
+    self.fanouts = [int(k) for k in num_neighbors]
+    self.with_edge = with_edge
+    self.collect_features = collect_features
+    self._seed = int(seed)
+    self._batch_idx = 0
+
+  def sample_from_nodes(self, seeds: np.ndarray,
+                        batch_seed: Optional[int] = None) -> SampleMessage:
+    """One ragged mini-batch message for ``seeds``."""
+    seeds = np.ascontiguousarray(seeds, np.int64)
+    if batch_seed is None:
+      batch_seed = self._seed + self._batch_idx
+      self._batch_idx += 1
+    ind = native.CpuInducer(capacity_hint=max(len(seeds) * 4, 64))
+    seed_local = ind.init_nodes(seeds)
+    frontier = ind.all_nodes()
+    rows_acc, cols_acc, eids_acc = [], [], []
+    num_sampled = [ind.num_nodes]
+    for h, k in enumerate(self.fanouts):
+      nbrs, mask, eids = native.sample_one_hop(
+          self.ds.indptr, self.ds.indices, frontier, k,
+          seed=batch_seed * 1000003 + h, edge_ids=self.ds.edge_ids,
+          with_edge_ids=self.with_edge)
+      before = ind.num_nodes
+      new_nodes, rl, cl = ind.induce_next(frontier, nbrs, mask)
+      keep = rl.reshape(-1) >= 0
+      rows_acc.append(rl.reshape(-1)[keep])
+      cols_acc.append(cl.reshape(-1)[keep])
+      if self.with_edge:
+        eids_acc.append(eids.reshape(-1)[keep])
+      num_sampled.append(ind.num_nodes - before)
+      frontier = new_nodes
+      if len(frontier) == 0:
+        break
+    nodes = ind.all_nodes()
+    msg: SampleMessage = {
+        '#IS_HETERO': np.uint8(0),
+        'ids': nodes,
+        'rows': np.concatenate(rows_acc) if rows_acc else
+                np.empty(0, np.int32),
+        'cols': np.concatenate(cols_acc) if cols_acc else
+                np.empty(0, np.int32),
+        'batch': seeds,
+        'seed_local': seed_local,
+        'num_sampled_nodes': np.asarray(num_sampled, np.int32),
+    }
+    if self.with_edge:
+      msg['eids'] = (np.concatenate(eids_acc) if eids_acc else
+                     np.empty(0, np.int64))
+    if self.collect_features and self.ds.node_features is not None:
+      msg['nfeats'] = np.ascontiguousarray(self.ds.node_features[nodes])
+    if self.ds.node_labels is not None:
+      msg['nlabels'] = np.ascontiguousarray(self.ds.node_labels[nodes])
+    return msg
