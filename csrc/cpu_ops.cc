@@ -52,8 +52,9 @@ void glt_coo_to_csr(const int64_t* rows, const int64_t* cols, int64_t num_edges,
 // ---------------------------------------------------------------------------
 void glt_sample_one_hop(const int64_t* indptr, const int64_t* indices,
                         const int64_t* edge_ids /*nullable*/,
-                        const int64_t* seeds, int64_t batch, int64_t k,
-                        uint64_t seed, int64_t* out_nbrs /*[B,k]*/,
+                        const int64_t* seeds, int64_t batch,
+                        int64_t num_nodes, int64_t k, uint64_t seed,
+                        int64_t* out_nbrs /*[B,k]*/,
                         uint8_t* out_mask /*[B,k]*/,
                         int64_t* out_eids /*nullable [B,k]*/) {
 #pragma omp parallel for schedule(dynamic, 64)
@@ -62,7 +63,9 @@ void glt_sample_one_hop(const int64_t* indptr, const int64_t* indices,
     uint8_t* mk = out_mask + b * k;
     int64_t* ei = out_eids ? out_eids + b * k : nullptr;
     int64_t v = seeds[b];
-    if (v == kInvalidId) {
+    // out-of-range ids degrade to empty rows, like the reference's
+    // empty-sample fallback (`sampler/neighbor_sampler.py:118-136`)
+    if (v < 0 || v >= num_nodes) {
       for (int64_t j = 0; j < k; ++j) {
         nb[j] = kInvalidId;
         mk[j] = 0;

@@ -6,6 +6,12 @@ message buffer and hands results to the trainer in order.  Here the
 fetch is any callable (the `DistClient` binds it to a socket RPC); a
 small thread pool keeps the pipeline full — the asyncio/torch-future
 machinery of the reference collapses to ``concurrent.futures``.
+
+Epoch hygiene: messages carry an ``'#EPOCH'`` stamp.  If the consumer
+abandons an epoch early, leftover messages (including ones already in
+flight) surface on the next epoch and are *discarded by stamp* rather
+than delivered as training data; each discard issues a replacement
+fetch, so accounting stays exact.
 """
 from __future__ import annotations
 
@@ -13,10 +19,11 @@ import collections
 import concurrent.futures as cf
 from typing import Callable, Optional
 
+import numpy as np
+
 from .base import ChannelBase, SampleMessage
 
-# Server returns this key to signal the epoch's message stream is done.
-END_OF_EPOCH = '#END_OF_EPOCH'
+EPOCH_KEY = '#EPOCH'
 
 
 class RemoteReceivingChannel(ChannelBase):
@@ -36,22 +43,22 @@ class RemoteReceivingChannel(ChannelBase):
     self._prefetch = max(1, prefetch_size)
     self._pool = cf.ThreadPoolExecutor(max_workers=self._prefetch)
     self._pending: collections.deque = collections.deque()
-    self._issued = 0
     self._received = 0
+    self._epoch = -1
 
-  def reset(self, num_expected: Optional[int] = None) -> None:
-    """Start a new epoch (reference re-creates the channel per epoch)."""
+  def reset(self, num_expected: Optional[int] = None,
+            epoch: Optional[int] = None) -> None:
+    """Start a new epoch.  In-flight fetches are kept — their results
+    are filtered by epoch stamp when they surface."""
     if num_expected is not None:
       self._num_expected = num_expected
-    self._issued = 0
+    self._epoch = self._epoch + 1 if epoch is None else epoch
     self._received = 0
-    self._pending.clear()
 
   def _fill(self) -> None:
-    while (self._issued < self._num_expected
-           and len(self._pending) < self._prefetch):
+    want = min(self._prefetch, self._num_expected - self._received)
+    while len(self._pending) < want:
       self._pending.append(self._pool.submit(self._fetch))
-      self._issued += 1
 
   def send(self, msg: SampleMessage) -> None:
     raise RuntimeError('RemoteReceivingChannel is receive-only')
@@ -59,11 +66,16 @@ class RemoteReceivingChannel(ChannelBase):
   def recv(self) -> SampleMessage:
     if self._received >= self._num_expected:
       raise StopIteration
-    self._fill()
-    msg = self._pending.popleft().result()
-    self._received += 1
-    self._fill()
-    return msg
+    while True:
+      self._fill()
+      if not self._pending:
+        self._pending.append(self._pool.submit(self._fetch))
+      msg = self._pending.popleft().result()
+      stamp = msg.get(EPOCH_KEY)
+      if stamp is not None and int(np.asarray(stamp)) != self._epoch:
+        continue     # stale message from an abandoned epoch; refetch
+      self._received += 1
+      return msg
 
   def empty(self) -> bool:
     return not self._pending

@@ -35,6 +35,11 @@ class ShmChannel(ChannelBase):
   def recv(self) -> SampleMessage:
     return self._q.get()
 
+  def recv_bytes(self) -> bytes:
+    """Dequeue one message still in tensor-map wire form — lets the
+    server forward it over RPC without a parse/re-serialize round trip."""
+    return self._q.get_bytes()
+
   def empty(self) -> bool:
     return self._q.empty()
 

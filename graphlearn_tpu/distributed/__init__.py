@@ -9,6 +9,8 @@ subprocess pools on CPU feeding the TPU trainer through shm channels,
 and a server-client mode where dedicated sampling hosts feed remote
 trainers over sockets.
 """
+from .dist_client import (DistClient, get_client, init_client,
+                          shutdown_client)
 from .dist_context import (DistContext, DistRole, get_context,
                            init_worker_group)
 from .dist_loader import DistLoader, DistNeighborLoader
@@ -17,6 +19,8 @@ from .dist_options import (CollocatedDistSamplingWorkerOptions,
                            RemoteDistSamplingWorkerOptions)
 from .dist_sampling_producer import (CollocatedSamplingProducer,
                                      MpSamplingProducer)
+from .dist_server import (DistServer, get_server, init_server,
+                          wait_and_shutdown_server)
 from .host_dataset import HostDataset
 from .host_sampler import HostNeighborSampler
 
@@ -26,5 +30,7 @@ __all__ = [
     'CollocatedDistSamplingWorkerOptions', 'MpDistSamplingWorkerOptions',
     'RemoteDistSamplingWorkerOptions',
     'CollocatedSamplingProducer', 'MpSamplingProducer',
+    'DistServer', 'get_server', 'init_server', 'wait_and_shutdown_server',
+    'DistClient', 'get_client', 'init_client', 'shutdown_client',
     'HostDataset', 'HostNeighborSampler',
 ]

@@ -1,0 +1,170 @@
+"""Trainer-side client of the sampling servers.
+
+Reference `distributed/dist_client.py:24-98`: `init_client` joins the
+deployment, loaders call `create_sampling_producer` on their target
+server, and `shutdown_client` has client-0 tell every server to exit.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .dist_context import DistContext, DistRole, _set_context, get_context
+from .dist_options import RemoteDistSamplingWorkerOptions
+from .rpc import RpcClient
+
+
+class RemoteProducerHandle:
+  """One loader's producer living on a server."""
+
+  def __init__(self, client: 'DistClient', server_idx: int,
+               producer_id: int):
+    self._client = client
+    self._server_idx = server_idx
+    self._pid = producer_id
+
+  def start_new_epoch(self, drop_last: bool = False) -> int:
+    return self._client.request_server(
+        self._server_idx, 'start_new_epoch_sampling', self._pid,
+        drop_last=drop_last)
+
+  def fetch(self):
+    return self._client.request_server(
+        self._server_idx, 'fetch_one_sampled_message', self._pid)
+
+  def destroy(self) -> None:
+    try:
+      self._client.request_server(
+          self._server_idx, 'destroy_sampling_producer', self._pid)
+    except Exception:
+      pass
+
+
+class MultiProducerHandle:
+  """One loader fanned out over several servers (list-valued
+  ``server_rank``, reference `dist_options.py:202-258`): each server
+  samples a batch-aligned seed slice; fetches round-robin by each
+  server's per-epoch message count."""
+
+  def __init__(self, handles: List[RemoteProducerHandle]):
+    self._handles = handles
+    self._lock = threading.Lock()
+    self._plan: List[int] = []      # handle idx per outstanding message
+    self._pos = 0
+
+  def start_new_epoch(self, drop_last: bool = False) -> int:
+    counts = [h.start_new_epoch(drop_last) for h in self._handles]
+    with self._lock:
+      # interleave: h0, h1, ..., h0, h1, ... while counts last
+      plan = []
+      remaining = list(counts)
+      while any(remaining):
+        for i, r in enumerate(remaining):
+          if r > 0:
+            plan.append(i)
+            remaining[i] -= 1
+      self._plan = plan
+      self._pos = 0
+    return sum(counts)
+
+  def fetch(self):
+    with self._lock:
+      idx = self._plan[self._pos % max(len(self._plan), 1)]
+      self._pos += 1
+    return self._handles[idx].fetch()
+
+  def destroy(self) -> None:
+    for h in self._handles:
+      h.destroy()
+
+
+class DistClient:
+  """Connections to every sampling server."""
+
+  def __init__(self, server_addrs: Sequence[Tuple[str, int]], rank: int,
+               num_clients: int):
+    self.rank = rank
+    self._rpcs: List[RpcClient] = [RpcClient(h, p) for h, p in server_addrs]
+    self.num_servers = len(self._rpcs)
+    self.num_clients = num_clients
+
+  def request_server(self, server_idx: int, name: str, *args, **kwargs):
+    return self._rpcs[server_idx].request(name, *args, **kwargs)
+
+  def get_dataset_meta(self, server_idx: int = 0):
+    return self.request_server(server_idx, 'get_dataset_meta')
+
+  def _create_one(self, idx: int, opts, fanouts, batch_size, seeds,
+                  with_edge, shuffle, seed) -> RemoteProducerHandle:
+    pid = self.request_server(
+        idx, 'create_sampling_producer', opts, list(fanouts),
+        int(batch_size), np.asarray(seeds), with_edge=with_edge,
+        shuffle=shuffle, seed=seed)
+    return RemoteProducerHandle(self, idx, pid)
+
+  def create_sampling_producer(
+      self, opts: RemoteDistSamplingWorkerOptions, fanouts,
+      batch_size: int, seeds: np.ndarray, with_edge: bool = False,
+      shuffle: bool = False, seed: int = 0):
+    idx = opts.server_rank
+    if idx is None:
+      idx = self.rank % self.num_servers   # round-robin default
+    if isinstance(idx, (list, tuple)):
+      if len(idx) == 1:
+        idx = idx[0]
+      else:
+        # fan out: split seeds batch-aligned across the listed servers
+        seeds = np.asarray(seeds).reshape(-1)
+        n_batches = (len(seeds) + batch_size - 1) // batch_size
+        per = ((n_batches + len(idx) - 1) // len(idx)) * batch_size
+        handles = []
+        for j, sidx in enumerate(idx):
+          sl = seeds[j * per:(j + 1) * per]
+          if len(sl):
+            handles.append(self._create_one(
+                sidx, opts, fanouts, batch_size, sl, with_edge,
+                shuffle, seed + j))
+        return MultiProducerHandle(handles)
+    return self._create_one(idx, opts, fanouts, batch_size, seeds,
+                            with_edge, shuffle, seed)
+
+  def shutdown(self, notify_servers: bool = True) -> None:
+    """Client-0 asks every server to exit
+    (reference `shutdown_client`, `dist_client.py:54-76`)."""
+    if notify_servers and self.rank == 0:
+      for i in range(self.num_servers):
+        try:
+          self.request_server(i, 'exit')
+        except Exception:
+          pass
+    for c in self._rpcs:
+      c.close()
+
+
+_client: Optional[DistClient] = None
+
+
+def init_client(server_addrs: Sequence[Tuple[str, int]], rank: int = 0,
+                num_clients: int = 1) -> DistClient:
+  """Declare this process trainer client ``rank``
+  (reference `init_client`, `dist_client.py:24-51`)."""
+  global _client
+  _set_context(DistContext(
+      role=DistRole.CLIENT, rank=rank, world_size=num_clients,
+      group_name='client', num_servers=len(server_addrs),
+      num_clients=num_clients))
+  _client = DistClient(server_addrs, rank, num_clients)
+  return _client
+
+
+def get_client() -> Optional[DistClient]:
+  return _client
+
+
+def shutdown_client(notify_servers: bool = True) -> None:
+  global _client
+  if _client is not None:
+    _client.shutdown(notify_servers)
+  _client = None

@@ -112,18 +112,18 @@ class DistLoader:
   # -- epoch protocol (reference `__iter__`/`__next__`,
   # `dist_loader.py:246-272`) ---------------------------------------------
   def __iter__(self):
-    n = self._num_batches() * self.batch_size if self.drop_last else None
-    seeds = self.seeds[:n] if n is not None else self.seeds
     if isinstance(self.opts, MpDistSamplingWorkerOptions):
-      self._expected = self._producer.produce_all(seeds)
+      self._expected = self._producer.produce_all(self.seeds,
+                                                  drop_last=self.drop_last)
       self._received = 0
     elif isinstance(self.opts, RemoteDistSamplingWorkerOptions):
-      self._remote.start_new_epoch()
-      self.channel.reset(self._num_batches())
-      self._expected = self._num_batches()
+      expected = self._remote.start_new_epoch(drop_last=self.drop_last)
+      self.channel.reset(expected)
+      self._expected = expected
       self._received = 0
     else:
-      self._epoch_iter = self._producer.epoch(seeds)
+      self._epoch_iter = self._producer.epoch(self.seeds,
+                                              drop_last=self.drop_last)
     return self
 
   def __next__(self) -> Batch:
@@ -132,9 +132,22 @@ class DistLoader:
     else:
       if self._received >= self._expected:
         raise StopIteration
-      msg = self.channel.recv()
+      msg = self._recv_current_epoch()
       self._received += 1
     return self._collate_fn(msg)
+
+  def _recv_current_epoch(self) -> SampleMessage:
+    """Receive, discarding stale-epoch messages left in the channel by
+    an early-terminated previous epoch (`RemoteReceivingChannel` does
+    its own stamp filtering)."""
+    if isinstance(self.opts, RemoteDistSamplingWorkerOptions):
+      return self.channel.recv()
+    cur = self._producer.current_epoch
+    while True:
+      msg = self.channel.recv()
+      stamp = msg.get('#EPOCH')
+      if stamp is None or int(np.asarray(stamp)) == cur:
+        return msg
 
   # -- message -> static-shape Batch (reference `dist_loader.py:286-383`) --
   def _collate_fn(self, msg: SampleMessage) -> Batch:

@@ -47,6 +47,9 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
       msg = sampler.sample_from_nodes(
           seeds[lo:lo + batch_size],
           batch_seed=(epoch * 1000003 + rank) * 131071 + lo)
+      # Epoch stamp lets consumers discard stale messages after an
+      # early-terminated epoch (see `DistLoader._recv_current_epoch`).
+      msg['#EPOCH'] = np.int64(epoch)
       channel.send(msg)
 
 
@@ -76,6 +79,7 @@ class MpSamplingProducer:
     self._ctx = mp.get_context(self.opts.mp_start_method)
     self._task_queues: List = []
     self._workers: List = []
+    self.current_epoch = -1      # stamp of the last dispatched epoch
 
   def init(self) -> None:
     for r in range(self.opts.num_workers):
@@ -92,11 +96,15 @@ class MpSamplingProducer:
   def num_batches(self, num_seeds: int) -> int:
     return (num_seeds + self.batch_size - 1) // self.batch_size
 
-  def produce_all(self, seeds: np.ndarray) -> int:
-    """Dispatch one epoch; returns the number of messages to expect."""
+  def produce_all(self, seeds: np.ndarray, drop_last: bool = False) -> int:
+    """Dispatch one epoch; returns the number of messages to expect.
+    ``drop_last`` truncates *after* the shuffle, so the dropped
+    remainder differs per epoch (torch DataLoader semantics)."""
     seeds = np.asarray(seeds).reshape(-1)
     if self.shuffle:
       seeds = self._rng.permutation(seeds)
+    if drop_last:
+      seeds = seeds[:(len(seeds) // self.batch_size) * self.batch_size]
     nw = max(len(self._workers), 1)
     # batch-aligned contiguous slices (reference `:249-260`)
     n_batches = self.num_batches(len(seeds))
@@ -105,6 +113,7 @@ class MpSamplingProducer:
       sl = seeds[r * per_worker:(r + 1) * per_worker]
       if len(sl):
         tq.put((MpCommand.SAMPLE_ALL, (sl, self.batch_size, self._epoch)))
+    self.current_epoch = self._epoch
     self._epoch += 1
     return n_batches
 
@@ -138,9 +147,11 @@ class CollocatedSamplingProducer:
     self.shuffle = shuffle
     self._rng = np.random.default_rng(seed)
 
-  def epoch(self, seeds: np.ndarray):
+  def epoch(self, seeds: np.ndarray, drop_last: bool = False):
     seeds = np.asarray(seeds).reshape(-1)
     if self.shuffle:
       seeds = self._rng.permutation(seeds)
+    if drop_last:
+      seeds = seeds[:(len(seeds) // self.batch_size) * self.batch_size]
     for lo in range(0, len(seeds), self.batch_size):
       yield self.sampler.sample_from_nodes(seeds[lo:lo + self.batch_size])

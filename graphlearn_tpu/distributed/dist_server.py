@@ -1,0 +1,150 @@
+"""Sampling server: producer pools serving remote trainer clients.
+
+Reference `distributed/dist_server.py:38-227`: a server process owns
+the dataset shard, builds an `MpSamplingProducer` + shm buffer per
+client loader, and serves `fetch_one_sampled_message` pulls until the
+clients ask it to exit.  The TPU deployment this enables: cheap CPU
+hosts do the sampling, TPU VMs only train.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..channel import ShmChannel
+from .dist_context import DistContext, DistRole, _set_context
+from .dist_options import (MpDistSamplingWorkerOptions,
+                           RemoteDistSamplingWorkerOptions)
+from .dist_sampling_producer import MpSamplingProducer
+from .host_dataset import HostDataset
+from .rpc import RpcServer
+
+
+class DistServer:
+  """Per-process server state + RPC handler methods
+  (reference `dist_server.py:38-156`)."""
+
+  def __init__(self, dataset: HostDataset):
+    self.dataset = dataset
+    self._producers: Dict[int, MpSamplingProducer] = {}
+    self._channels: Dict[int, ShmChannel] = {}
+    self._seeds: Dict[int, np.ndarray] = {}
+    self._next_id = 0
+    self._exit = threading.Event()
+    self._lock = threading.Lock()
+
+  # -- handlers ------------------------------------------------------------
+  def get_dataset_meta(self):
+    d = self.dataset
+    return {
+        'num_nodes': d.num_nodes, 'num_edges': d.num_edges,
+        'feature_dim': (d.node_features.shape[1]
+                        if d.node_features is not None else 0),
+        'has_labels': d.node_labels is not None,
+    }
+
+  def create_sampling_producer(self, opts: RemoteDistSamplingWorkerOptions,
+                               fanouts, batch_size: int, seeds,
+                               with_edge: bool = False,
+                               shuffle: bool = False, seed: int = 0) -> int:
+    """Build a producer + buffer for one client loader
+    (reference `dist_server.py:83-116`)."""
+    channel = ShmChannel(opts.buffer_capacity, opts.buffer_size)
+    mp_opts = MpDistSamplingWorkerOptions(
+        num_workers=opts.num_workers,
+        worker_concurrency=opts.worker_concurrency,
+        collect_features=opts.collect_features)
+    producer = MpSamplingProducer(
+        self.dataset, fanouts, batch_size, channel, mp_opts,
+        with_edge=with_edge, shuffle=shuffle, seed=seed)
+    producer.init()
+    with self._lock:
+      pid = self._next_id
+      self._next_id += 1
+      self._producers[pid] = producer
+      self._channels[pid] = channel
+      self._seeds[pid] = np.asarray(seeds).reshape(-1)
+    return pid
+
+  def start_new_epoch_sampling(self, producer_id: int,
+                               drop_last: bool = False) -> int:
+    return self._producers[producer_id].produce_all(
+        self._seeds[producer_id], drop_last=drop_last)
+
+  def fetch_one_sampled_message(self, producer_id: int):
+    """Blocking pull of one message (reference
+    `fetch_one_sampled_message`, `dist_server.py:121-131`).  Returns
+    the wire bytes untouched — they cross the socket as a tensor-map
+    frame without a parse/re-serialize round trip."""
+    from .rpc import RawTensorMap
+    return RawTensorMap(self._channels[producer_id].recv_bytes())
+
+  def destroy_sampling_producer(self, producer_id: int) -> None:
+    with self._lock:
+      producer = self._producers.pop(producer_id, None)
+      channel = self._channels.pop(producer_id, None)
+      self._seeds.pop(producer_id, None)
+    if producer is not None:
+      producer.shutdown()
+    if channel is not None:
+      channel.close()
+
+  def exit(self) -> bool:
+    self._exit.set()
+    return True
+
+  # -- lifecycle -----------------------------------------------------------
+  def wait_for_exit(self, timeout: Optional[float] = None) -> bool:
+    """Poll until a client requested exit (reference
+    `wait_and_shutdown_server` poll loop, `dist_server.py:64-74`).
+    Producers are destroyed either way — a timeout means the clients
+    died, and leaking sampling subprocesses + SysV segments is worse
+    than cutting them off."""
+    done = self._exit.wait(timeout)
+    for pid in list(self._producers):
+      self.destroy_sampling_producer(pid)
+    return done
+
+
+_server: Optional[DistServer] = None
+_rpc_server: Optional[RpcServer] = None
+
+
+def init_server(num_servers: int, num_clients: int, rank: int,
+                dataset: HostDataset, host: str = '0.0.0.0',
+                port: int = 0) -> DistServer:
+  """Stand up this process as sampling server ``rank``
+  (reference `init_server`, `dist_server.py:158-190`).  Returns after
+  binding; call `wait_for_exit` to serve until shutdown.  The bound
+  port is at ``get_server().port`` (0 = auto-pick, for tests)."""
+  global _server, _rpc_server
+  _set_context(DistContext(role=DistRole.SERVER, rank=rank,
+                           world_size=num_servers, group_name='server',
+                           num_servers=num_servers,
+                           num_clients=num_clients))
+  srv = DistServer(dataset)
+  rpc = RpcServer(host, port)
+  for name in ('get_dataset_meta', 'create_sampling_producer',
+               'start_new_epoch_sampling', 'fetch_one_sampled_message',
+               'destroy_sampling_producer', 'exit'):
+    rpc.register(name, getattr(srv, name))
+  rpc.start()
+  srv.port = rpc.port
+  _server, _rpc_server = srv, rpc
+  return srv
+
+
+def get_server() -> Optional[DistServer]:
+  return _server
+
+
+def wait_and_shutdown_server(timeout: Optional[float] = None) -> None:
+  global _server, _rpc_server
+  if _server is not None:
+    _server.wait_for_exit(timeout)
+  if _rpc_server is not None:
+    _rpc_server.shutdown()
+  _server = _rpc_server = None

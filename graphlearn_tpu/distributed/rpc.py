@@ -1,0 +1,176 @@
+"""Socket RPC substrate for the server-client deployment mode.
+
+The reference rides torch.distributed.rpc/TensorPipe (ibv RDMA + uv
+TCP, `distributed/rpc.py:236-292`).  A TPU-VM sampling tier has no
+torch runtime to lean on, and the *data* plane between hosts is DCN
+TCP anyway — so the control plane here is a deliberately small
+threaded socket RPC:
+
+  * frames: ``[u32 kind][u64 len][payload]`` — kind 0 = pickled
+    control object, kind 1 = tensor-map bytes (`csrc/tensor_map.cc`
+    serialization, no pickle on the sample-message path);
+  * server: one daemon thread per connection, handlers looked up in a
+    registry (the reference's `RpcCalleeBase`/`rpc_register`,
+    `rpc.py:364-443`);
+  * client: a connection pool so concurrent prefetch threads each own
+    a socket.
+
+Trusted-cluster assumption (same as TensorPipe): control frames use
+pickle, so only run between your own hosts.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..native import parse_tensor_map, serialize_tensor_map
+
+_HDR = struct.Struct('<IQ')
+KIND_PICKLE = 0
+KIND_TENSOR_MAP = 1
+
+
+def _send_frame(sock: socket.socket, kind: int, payload: bytes) -> None:
+  sock.sendall(_HDR.pack(kind, len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+  buf = bytearray()
+  while len(buf) < n:
+    chunk = sock.recv(min(n - len(buf), 1 << 20))
+    if not chunk:
+      raise ConnectionError('peer closed')
+    buf += chunk
+  return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[int, bytes]:
+  kind, ln = _HDR.unpack(_recv_exact(sock, _HDR.size))
+  return kind, _recv_exact(sock, ln)
+
+
+def send_obj(sock: socket.socket, obj: Any) -> None:
+  """Send one value; dict-of-ndarray goes through the tensor-map path."""
+  if isinstance(obj, RawTensorMap):
+    _send_frame(sock, KIND_TENSOR_MAP, bytes(obj))
+  elif (isinstance(obj, dict) and obj
+      and all(isinstance(k, str) for k in obj)
+      and all(isinstance(v, (np.ndarray, np.generic))
+              for v in obj.values())):
+    _send_frame(sock, KIND_TENSOR_MAP, serialize_tensor_map(obj))
+  else:
+    _send_frame(sock, KIND_PICKLE, pickle.dumps(obj))
+
+
+def recv_obj(sock: socket.socket) -> Any:
+  kind, payload = _recv_frame(sock)
+  if kind == KIND_TENSOR_MAP:
+    return parse_tensor_map(payload)
+  return pickle.loads(payload)
+
+
+class RawTensorMap(bytes):
+  """Already-serialized tensor-map payload: `send_obj` frames it
+  directly (no parse/re-serialize on the server's fetch hot path) and
+  the receiving side parses it into the usual dict."""
+
+
+class RpcError(RuntimeError):
+  pass
+
+
+class _RemoteError:
+  def __init__(self, msg: str):
+    self.msg = msg
+
+
+class RpcServer:
+  """Threaded request server with a name->handler registry."""
+
+  def __init__(self, host: str = '0.0.0.0', port: int = 0):
+    registry: Dict[str, Callable] = {}
+    self._registry = registry
+
+    class Handler(socketserver.BaseRequestHandler):
+      def handle(self):
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+          while True:
+            name, args, kwargs = recv_obj(sock)
+            fn = registry.get(name)
+            try:
+              if fn is None:
+                raise RpcError(f'no handler registered for {name!r}')
+              result = fn(*args, **kwargs)
+            except Exception as exc:  # ship the error to the caller
+              send_obj(sock, _RemoteError(f'{type(exc).__name__}: {exc}'))
+              continue
+            send_obj(sock, result)
+        except (ConnectionError, EOFError, OSError):
+          return
+
+    class Server(socketserver.ThreadingTCPServer):
+      daemon_threads = True
+      allow_reuse_address = True
+
+    self._server = Server((host, port), Handler)
+    self.host, self.port = self._server.server_address
+    self._thread = threading.Thread(target=self._server.serve_forever,
+                                    daemon=True)
+
+  def register(self, name: str, fn: Callable) -> None:
+    """Reference `rpc_register` (`distributed/rpc.py:401-420`)."""
+    self._registry[name] = fn
+
+  def start(self) -> None:
+    self._thread.start()
+
+  def shutdown(self) -> None:
+    self._server.shutdown()
+    self._server.server_close()
+
+
+class RpcClient:
+  """Per-thread pooled connections to one server address."""
+
+  def __init__(self, host: str, port: int):
+    self.addr = (host, port)
+    self._local = threading.local()
+    self._all: list = []
+    self._lock = threading.Lock()
+
+  def _sock(self) -> socket.socket:
+    s = getattr(self._local, 'sock', None)
+    if s is None:
+      s = socket.create_connection(self.addr, timeout=120)
+      s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+      self._local.sock = s
+      with self._lock:
+        self._all.append(s)
+    return s
+
+  def request(self, name: str, *args, **kwargs) -> Any:
+    """Synchronous call (reference `request_server`,
+    `dist_client.py:79-98`); safe from multiple threads."""
+    sock = self._sock()
+    send_obj(sock, (name, args, kwargs))
+    out = recv_obj(sock)
+    if isinstance(out, _RemoteError):
+      raise RpcError(out.msg)
+    return out
+
+  def close(self) -> None:
+    with self._lock:
+      for s in self._all:
+        try:
+          s.close()
+        except OSError:
+          pass
+      self._all.clear()

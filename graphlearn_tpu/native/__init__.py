@@ -122,8 +122,8 @@ def _declare(l):
   l.glt_coo_to_csr.restype = None
   l.glt_coo_to_csr.argtypes = [i64p, i64p, i64, i64, i64p, i64p, i64p]
   l.glt_sample_one_hop.restype = None
-  l.glt_sample_one_hop.argtypes = [i64p, i64p, p, i64p, i64, i64, u64,
-                                   i64p, u8p, p]
+  l.glt_sample_one_hop.argtypes = [i64p, i64p, p, i64p, i64, i64, i64,
+                                   u64, i64p, u8p, p]
   l.glt_cal_nbr_prob.restype = None
   l.glt_cal_nbr_prob.argtypes = [i64p, i64p, f32p, i64, i64, f32p]
   l.glt_negative_sample.restype = i64
@@ -324,8 +324,8 @@ def sample_one_hop(indptr: np.ndarray, indices: np.ndarray,
   src_eids = (np.ascontiguousarray(edge_ids, np.int64)
               .ctypes.data_as(ctypes.c_void_p)
               if edge_ids is not None else None)
-  l.glt_sample_one_hop(indptr, indices, src_eids, seeds, b, k, seed,
-                       nbrs, mask, eid_ptr)
+  l.glt_sample_one_hop(indptr, indices, src_eids, seeds, b,
+                       len(indptr) - 1, k, seed, nbrs, mask, eid_ptr)
   return nbrs, mask.astype(bool), eids
 
 

@@ -123,6 +123,27 @@ class TestDistLoaderModes:
     for _ in range(2):   # two epochs
       self._check_epoch(loader, 40, 5, 8)
 
+  def test_mp_early_break_and_drop_last(self):
+    """Abandoning an epoch mid-way must not leak stale batches into the
+    next epoch (epoch-stamp filtering), and drop_last truncates."""
+    ds = ring_dataset(n=44)
+    loader = DistNeighborLoader(
+        ds, [2], np.arange(44), batch_size=8, shuffle=True, drop_last=True,
+        worker_options=MpDistSamplingWorkerOptions(num_workers=2),
+        to_device=False, seed=5)
+    try:
+      it = iter(loader)
+      next(it)          # consume one of 5, then abandon the epoch
+      for _ in range(3):
+        count = 0
+        for batch in loader:
+          count += 1
+          s = np.asarray(batch.batch)
+          assert (s >= 0).all()       # full batches only (drop_last)
+        assert count == 5             # 44 // 8
+    finally:
+      loader.shutdown()
+
   def test_mp(self):
     ds = ring_dataset()
     loader = DistNeighborLoader(

@@ -1,0 +1,109 @@
+"""Server-client deployment tests — all roles as local processes
+(the SURVEY §4 pattern: real RPC over localhost, no mocks; reference
+`test_dist_neighbor_loader.py:run_test_as_server/client`, `:180-213`).
+"""
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native lib unavailable')
+
+
+def _ring(n=40, d=4):
+  from graphlearn_tpu.distributed import HostDataset
+  rows = np.repeat(np.arange(n), 2)
+  cols = np.stack([(np.arange(n) + 1) % n,
+                   (np.arange(n) + 2) % n], 1).reshape(-1)
+  feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, d))
+  return HostDataset.from_coo(rows, cols, n, node_features=feats,
+                              node_labels=np.arange(n) % 4)
+
+
+def _server_proc(port_q):
+  from graphlearn_tpu.distributed import (get_server, init_server,
+                                          wait_and_shutdown_server)
+  srv = init_server(num_servers=1, num_clients=1, rank=0,
+                    dataset=_ring(), host='127.0.0.1', port=0)
+  port_q.put(srv.port)
+  wait_and_shutdown_server(timeout=60)
+
+
+def test_multi_server_fanout():
+  """List-valued server_rank spreads one loader across servers."""
+  ctx = mp.get_context('fork')
+  procs, ports = [], []
+  for _ in range(2):
+    q = ctx.Queue()
+    p = ctx.Process(target=_server_proc, args=(q,), daemon=False)
+    p.start()
+    procs.append(p)
+    ports.append(q.get(timeout=30))
+
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  init_client([('127.0.0.1', pt) for pt in ports], rank=0, num_clients=1)
+  n = 40
+  loader = DistNeighborLoader(
+      None, [2], np.arange(n), batch_size=8, shuffle=False,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=[0, 1], num_workers=1, prefetch_size=2),
+      to_device=False)
+  for _ in range(2):
+    seeds_seen = []
+    for batch in loader:
+      s = np.asarray(batch.batch)
+      seeds_seen.append(s[s >= 0])
+    np.testing.assert_array_equal(np.sort(np.concatenate(seeds_seen)),
+                                  np.arange(n))
+  loader.shutdown()
+  shutdown_client()
+  for p in procs:
+    p.join(timeout=20)
+    assert not p.is_alive()
+
+
+def test_remote_loader_epochs():
+  ctx = mp.get_context('fork')
+  port_q = ctx.Queue()
+  # non-daemonic: the server itself spawns producer subprocesses
+  p = ctx.Process(target=_server_proc, args=(port_q,), daemon=False)
+  p.start()
+  port = port_q.get(timeout=30)
+
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  client = init_client([('127.0.0.1', port)], rank=0, num_clients=1)
+  meta = client.get_dataset_meta()
+  assert meta['num_nodes'] == 40 and meta['feature_dim'] == 4
+
+  n = 40
+  loader = DistNeighborLoader(
+      None, [2, 2], np.arange(n), batch_size=8, shuffle=True,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=0, num_workers=2, prefetch_size=2),
+      to_device=False, seed=1)
+  for _ in range(2):
+    seeds_seen = []
+    batches = 0
+    for batch in loader:
+      batches += 1
+      ids = np.asarray(batch.node)
+      valid = np.asarray(batch.node_mask)
+      np.testing.assert_allclose(np.asarray(batch.x)[:, 0][valid],
+                                 ids[valid].astype(np.float32))
+      s = np.asarray(batch.batch)
+      seeds_seen.append(s[s >= 0])
+    assert batches == 5
+    np.testing.assert_array_equal(np.sort(np.concatenate(seeds_seen)),
+                                  np.arange(n))
+
+  loader.shutdown()
+  shutdown_client()          # client-0 tells the server to exit
+  p.join(timeout=20)
+  assert not p.is_alive()
