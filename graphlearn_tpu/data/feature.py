@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.pallas_gather import gather_rows
 from ..utils.tensor import convert_to_array
 
 
@@ -120,8 +121,9 @@ class Feature:
     d = self.feature_dim
 
     if self.hot_rows >= self._host_feats.shape[0]:
-      # Fully HBM-resident: one fused device gather.
-      out = jnp.take(self._hot, jnp.asarray(idx), axis=0)
+      # Fully HBM-resident: one device gather — per-row DMA kernel on
+      # TPU (`ops/pallas_gather.py`), fused XLA gather elsewhere.
+      out = gather_rows(self._hot, jnp.asarray(idx.astype(np.int32)))
       return jnp.where(jnp.asarray(valid)[:, None], out, 0)
 
     cold_sel = valid & (idx >= self.hot_rows)

@@ -1,0 +1,71 @@
+"""Pallas feature-gather kernel vs the XLA gather (interpret mode).
+
+Real-chip validation runs as a plain script on TPU (the kernel was
+verified bit-exact on v5e); here the same kernel runs through the
+Pallas interpreter on the CPU backend, mirroring the reference's
+C++ gtest of ``GatherTensorKernel`` (`test/cpp/test_unified_tensor.cu`).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from graphlearn_tpu.ops.pallas_gather import gather_rows
+
+
+@pytest.mark.parametrize('n,d,b,tile', [
+    (500, 128, 37, 8),     # unaligned batch -> padded grid tail
+    (100, 256, 64, 32),    # batch smaller than tile
+    (1000, 128, 256, 16),
+])
+def test_gather_rows_matches_xla(n, d, b, tile):
+  rng = np.random.default_rng(0)
+  table = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+  idx = jnp.asarray(rng.integers(0, n, b).astype(np.int32))
+  out = gather_rows(table, idx, tile=tile, interpret=True)
+  assert out.shape == (b, d)
+  np.testing.assert_array_equal(np.asarray(out),
+                                np.asarray(jnp.take(table, idx, axis=0)))
+
+
+def test_gather_rows_int32_table():
+  rng = np.random.default_rng(1)
+  table = jnp.asarray(rng.integers(0, 1 << 30, (300, 128)).astype(np.int32))
+  idx = jnp.asarray(rng.integers(0, 300, 50).astype(np.int32))
+  out = gather_rows(table, idx, interpret=True)
+  np.testing.assert_array_equal(np.asarray(out),
+                                np.asarray(jnp.take(table, idx, axis=0)))
+
+
+def test_gather_rows_repeated_and_boundary_ids():
+  table = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+  idx = jnp.asarray([0, 63, 0, 63, 7, 7, 7], dtype=jnp.int32)
+  out = gather_rows(table, idx, tile=4, interpret=True)
+  np.testing.assert_array_equal(np.asarray(out),
+                                np.asarray(table)[np.asarray(idx)])
+
+
+def test_unaligned_dim_falls_back():
+  # d % 128 != 0 on a compiled backend falls back to XLA take; in
+  # interpret mode the DMA path itself handles it — both must agree.
+  rng = np.random.default_rng(2)
+  table = jnp.asarray(rng.standard_normal((100, 100)).astype(np.float32))
+  idx = jnp.asarray(rng.integers(0, 100, 17).astype(np.int32))
+  out = gather_rows(table, idx, interpret=True)
+  np.testing.assert_array_equal(np.asarray(out),
+                                np.asarray(jnp.take(table, idx, axis=0)))
+
+
+def test_feature_store_uses_kernel(monkeypatch):
+  # Force the pallas path (interpret on CPU) through Feature.__getitem__.
+  monkeypatch.setenv('GLT_PALLAS', '1')
+  from graphlearn_tpu.data.feature import Feature
+  rng = np.random.default_rng(3)
+  feats = rng.standard_normal((200, 128)).astype(np.float32)
+  f = Feature(feats, split_ratio=1.0)
+  ids = np.array([5, -1, 199, 0, 5], dtype=np.int64)
+  out = np.asarray(f[ids])
+  assert out.shape == (5, 128)
+  np.testing.assert_array_equal(out[1], np.zeros(128, np.float32))
+  np.testing.assert_allclose(out[0], feats[5], rtol=0, atol=0)
+  np.testing.assert_allclose(out[2], feats[199], rtol=0, atol=0)
