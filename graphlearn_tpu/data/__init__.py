@@ -3,3 +3,6 @@ from .graph import Graph
 from .feature import Feature
 from .reorder import sort_by_in_degree, sort_by_hotness
 from .dataset import Dataset
+from .table_dataset import (CsvTableReader, NpzTableReader, OdpsTableReader,
+                            TableDataset, TableReader, read_edge_table,
+                            read_node_table)

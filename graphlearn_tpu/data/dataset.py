@@ -55,7 +55,14 @@ class Dataset:
       for etype, ei in edge_index.items():
         eids = edge_ids.get(etype) if isinstance(edge_ids, dict) else None
         lay = layout.get(etype) if isinstance(layout, dict) else layout
-        nn = num_nodes.get(etype) if isinstance(num_nodes, dict) else num_nodes
+        if isinstance(num_nodes, dict):
+          # keyed by edge type, or by node type (the CSR row dimension
+          # is the *source* type's node count)
+          nn = num_nodes.get(etype)
+          if nn is None and isinstance(etype, tuple):
+            nn = num_nodes.get(etype[0])
+        else:
+          nn = num_nodes
         topos[etype] = CSRTopo(ei, edge_ids=eids, layout=lay, num_nodes=nn)
       self.graph = {
           etype: Graph(t, mode=graph_mode, device=device)

@@ -17,6 +17,8 @@ from .dist_loader import DistLoader, DistNeighborLoader
 from .dist_options import (CollocatedDistSamplingWorkerOptions,
                            MpDistSamplingWorkerOptions,
                            RemoteDistSamplingWorkerOptions)
+from .dist_random_partitioner import (DistPartitionManager,
+                                      DistRandomPartitioner, node_range)
 from .dist_sampling_producer import (CollocatedSamplingProducer,
                                      MpSamplingProducer)
 from .dist_server import (DistServer, get_server, init_server,
@@ -33,4 +35,5 @@ __all__ = [
     'DistServer', 'get_server', 'init_server', 'wait_and_shutdown_server',
     'DistClient', 'get_client', 'init_client', 'shutdown_client',
     'HostDataset', 'HostNeighborSampler',
+    'DistPartitionManager', 'DistRandomPartitioner', 'node_range',
 ]

@@ -70,7 +70,6 @@ def _dma_supported(dtype) -> bool:
   return jnp.dtype(dtype).itemsize == 4
 
 
-@functools.partial(jax.jit, static_argnames=('tile', 'interpret'))
 def gather_rows(table: jax.Array, idx: jax.Array, *,
                 tile: int = _TILE,
                 interpret: Optional[bool] = None) -> jax.Array:
@@ -80,6 +79,10 @@ def gather_rows(table: jax.Array, idx: jax.Array, *,
   Pallas is disabled (:func:`pallas_enabled`) or the table layout is
   not DMA-able (unaligned ``D``, sub-32-bit dtype).  Out-of-range ids
   are clamped to the last row, matching ``jnp.take``'s TPU semantics.
+
+  The env flag is re-read on every call (this plain wrapper dispatches
+  to jitted implementations, so ``GLT_PALLAS=0`` works mid-process as
+  the kill-switch it documents).
 
   Args:
     table: ``[N, D]`` HBM-resident array.
@@ -92,12 +95,24 @@ def gather_rows(table: jax.Array, idx: jax.Array, *,
   """
   if interpret is None:
     if not pallas_enabled():
-      return jnp.take(table, idx.astype(jnp.int32), axis=0)
+      return _xla_take(table, idx)
     interpret = _interpret_default()
-  b = idx.shape[0]
   d = table.shape[1]
   if not interpret and (d % 128 != 0 or not _dma_supported(table.dtype)):
-    return jnp.take(table, idx.astype(jnp.int32), axis=0)
+    return _xla_take(table, idx)
+  return _gather_rows_dma(table, idx, tile=tile, interpret=interpret)
+
+
+@jax.jit
+def _xla_take(table: jax.Array, idx: jax.Array) -> jax.Array:
+  return jnp.take(table, idx.astype(jnp.int32), axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=('tile', 'interpret'))
+def _gather_rows_dma(table: jax.Array, idx: jax.Array, *,
+                     tile: int, interpret: bool) -> jax.Array:
+  b = idx.shape[0]
+  d = table.shape[1]
   bp = round_up(b, tile)
   idx_c = jnp.clip(idx.astype(jnp.int32), 0, table.shape[0] - 1)
   idx_p = jnp.zeros((bp,), jnp.int32).at[:b].set(idx_c)
