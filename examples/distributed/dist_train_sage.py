@@ -1,0 +1,111 @@
+"""Distributed supervised GraphSAGE — worker (collocated) mode.
+
+TPU counterpart of reference `examples/distributed/
+dist_train_sage_supervised.py`: the graph is partitioned across the
+device mesh, every chip samples its own seed shard with cross-partition
+neighbor exchange riding ICI collectives (`parallel.DistNeighborSampler`
+— the `_sample_one_hop` + stitch dance as all-to-all instead of RPC),
+and the train step is data-parallel with psum-averaged gradients.
+Host-side mp sampling producers (the reference's sampling subprocess
+pool) are the orthogonal pipeline knob — see
+`dist_train_sage_with_server.py` for that plane.
+
+Runs on a real TPU slice, or anywhere via the virtual CPU mesh::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/distributed/dist_train_sage.py --num-parts 8
+
+With a pre-partitioned dataset (see `partition_dataset.py`)::
+
+    python examples/distributed/dist_train_sage.py --partition-dir /tmp/parts
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import numpy as np
+
+
+def synthetic(n=8192, d=32, classes=8, deg=8, seed=0):
+  rng = np.random.default_rng(seed)
+  labels = rng.integers(0, classes, n).astype(np.int32)
+  rows = np.repeat(np.arange(n), deg)
+  order = np.argsort(labels, kind='stable')
+  ptr = np.searchsorted(labels[order], np.arange(classes + 1))
+  intra = np.empty(n * deg, dtype=np.int64)
+  for c in range(classes):
+    m = labels[rows] == c
+    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
+  cols = np.where(rng.random(n * deg) < 0.7, intra,
+                  rng.integers(0, n, n * deg))
+  feats = (np.eye(classes, dtype=np.float32)[labels] @
+           rng.normal(0, 1, (classes, d)).astype(np.float32)
+           + rng.normal(0, .5, (n, d)).astype(np.float32))
+  return rows, cols, feats, labels
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-parts', type=int, default=None,
+                  help='mesh size; default = all local devices')
+  ap.add_argument('--partition-dir', type=str, default=None)
+  ap.add_argument('--epochs', type=int, default=4)
+  ap.add_argument('--batch-size', type=int, default=128,
+                  help='per-device seed batch')
+  ap.add_argument('--fanout', type=int, nargs='+', default=[10, 5])
+  ap.add_argument('--hidden', type=int, default=64)
+  args = ap.parse_args()
+
+  import jax
+  import optax
+  from graphlearn_tpu.models import GraphSAGE, create_train_state
+  from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                       make_dp_supervised_step, make_mesh,
+                                       replicate)
+
+  num_parts = args.num_parts or len(jax.devices())
+  mesh = make_mesh(num_parts)
+
+  if args.partition_dir:
+    ds = DistDataset.from_partition_dir(args.partition_dir, num_parts)
+  else:
+    rows, cols, feats, labels = synthetic()
+    ds = DistDataset.from_full_graph(num_parts, rows, cols,
+                                     node_feat=feats, node_label=labels,
+                                     num_nodes=len(labels))
+  assert ds.node_labels is not None, 'training needs labels'
+  n = ds.graph.num_nodes
+  num_classes = int(np.max(np.asarray(ds.node_labels))) + 1
+
+  bs = args.batch_size
+  loader = DistNeighborLoader(ds, args.fanout, np.arange(n),
+                              batch_size=bs, shuffle=True, mesh=mesh,
+                              seed=0)
+  model = GraphSAGE(hidden_features=args.hidden,
+                    out_features=num_classes, num_layers=2)
+  tx = optax.adam(1e-3)
+  b0 = next(iter(loader))
+  single = jax.tree_util.tree_map(lambda v: v[0], b0)
+  state, _ = create_train_state(model, jax.random.key(0), single, tx)
+  step = make_dp_supervised_step(model.apply, tx, bs, mesh)
+  state = replicate(state, mesh)
+
+  for epoch in range(args.epochs):
+    t0 = time.perf_counter()
+    tot = cnt = correct = 0
+    for batch in loader:
+      state, loss, c = step(state, batch)
+      tot += float(loss)
+      correct += int(c)
+      cnt += 1
+    dt = time.perf_counter() - t0
+    print(f'epoch {epoch}: loss {tot / max(cnt, 1):.4f}  '
+          f'train acc {correct / max(cnt * bs * num_parts, 1):.4f}  '
+          f'({dt:.2f}s, {cnt} steps x {num_parts} devices)')
+
+
+if __name__ == '__main__':
+  main()

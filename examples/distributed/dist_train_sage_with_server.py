@@ -1,0 +1,129 @@
+"""Distributed GraphSAGE — server-client deployment mode.
+
+TPU counterpart of reference `examples/distributed/
+dist_train_sage_supervised_with_server.py:54-150`: dedicated sampling
+*server* processes own the dataset and run producer pools; training
+*client* processes (the TPU hosts) pull ready-made sample messages over
+sockets through a prefetching `RemoteReceivingChannel` and spend their
+cycles on model compute only.
+
+This launcher runs both roles as local processes (the SURVEY §4
+all-local pattern); on a real deployment run the two blocks on
+different hosts with real addresses.
+
+Usage::
+
+    python examples/distributed/dist_train_sage_with_server.py \
+        [--num-servers 2] [--epochs 2]
+"""
+import argparse
+import multiprocessing as mp
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import numpy as np
+
+
+def synthetic(n=4096, d=32, classes=8, deg=8, seed=0):
+  rng = np.random.default_rng(seed)
+  labels = rng.integers(0, classes, n).astype(np.int32)
+  rows = np.repeat(np.arange(n), deg)
+  order = np.argsort(labels, kind='stable')
+  ptr = np.searchsorted(labels[order], np.arange(classes + 1))
+  intra = np.empty(n * deg, dtype=np.int64)
+  for c in range(classes):
+    m = labels[rows] == c
+    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
+  cols = np.where(rng.random(n * deg) < 0.7, intra,
+                  rng.integers(0, n, n * deg))
+  feats = (np.eye(classes, dtype=np.float32)[labels] @
+           rng.normal(0, 1, (classes, d)).astype(np.float32)
+           + rng.normal(0, .5, (n, d)).astype(np.float32))
+  return rows, cols, feats, labels
+
+
+def run_server(rank, num_servers, port_q, n):
+  """One sampling host (reference `init_server` +
+  `wait_and_shutdown_server`, `dist_server.py:158-211`)."""
+  sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+  from graphlearn_tpu.distributed import (HostDataset, init_server,
+                                          wait_and_shutdown_server)
+  rows, cols, feats, labels = synthetic(n)
+  ds = HostDataset.from_coo(rows, cols, n, node_features=feats,
+                            node_labels=labels)
+  srv = init_server(num_servers=num_servers, num_clients=1, rank=rank,
+                    dataset=ds, host='127.0.0.1', port=0)
+  port_q.put((rank, srv.port))
+  wait_and_shutdown_server(timeout=600)
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--num-servers', type=int, default=2)
+  ap.add_argument('--epochs', type=int, default=2)
+  ap.add_argument('--batch-size', type=int, default=128)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[10, 5])
+  ap.add_argument('--hidden', type=int, default=64)
+  ap.add_argument('--num-nodes', type=int, default=4096)
+  args = ap.parse_args()
+  n = args.num_nodes
+
+  ctx = mp.get_context('fork')
+  port_q = ctx.Queue()
+  servers = [ctx.Process(target=run_server,
+                         args=(r, args.num_servers, port_q, n),
+                         daemon=False)
+             for r in range(args.num_servers)]
+  for p in servers:
+    p.start()
+  ports = dict(port_q.get(timeout=60) for _ in servers)
+
+  # ---- client (the TPU host) ------------------------------------------
+  import jax
+  import optax
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  from graphlearn_tpu.models import (GraphSAGE, create_train_state,
+                                     make_supervised_step)
+
+  init_client([('127.0.0.1', ports[r]) for r in range(args.num_servers)],
+              rank=0, num_clients=1)
+  loader = DistNeighborLoader(
+      None, args.fanout, np.arange(n), batch_size=args.batch_size,
+      shuffle=True,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=list(range(args.num_servers)), num_workers=2,
+          prefetch_size=4),
+      seed=0)
+
+  model = GraphSAGE(hidden_features=args.hidden, out_features=8,
+                    num_layers=2)
+  tx = optax.adam(1e-3)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), next(iter(loader)), tx)
+  step = make_supervised_step(apply_fn, tx, args.batch_size)
+
+  for epoch in range(args.epochs):
+    t0 = time.perf_counter()
+    tot = cnt = 0
+    for batch in loader:
+      state, loss, _ = step(state, batch)
+      tot += float(loss)
+      cnt += 1
+    print(f'epoch {epoch}: loss {tot / max(cnt, 1):.4f} '
+          f'({time.perf_counter() - t0:.2f}s, {cnt} steps, '
+          f'{args.num_servers} sampling servers)')
+
+  loader.shutdown()
+  shutdown_client()            # client-0 tells every server to exit
+  for p in servers:
+    p.join(timeout=30)
+  print('done')
+
+
+if __name__ == '__main__':
+  main()

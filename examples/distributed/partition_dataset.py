@@ -1,0 +1,94 @@
+"""Offline dataset partitioning for distributed training.
+
+TPU counterpart of reference `examples/distributed/
+partition_ogbn_dataset.py`: run once before launching the trainers;
+writes the on-disk layout that `parallel.DistDataset` /
+`partition.load_partition` consume.  Supports random and
+frequency-based (hotness) partitioning — the latter samples with the
+training fanout to estimate per-partition access probabilities and
+co-locates + caches hot rows (reference `FrequencyPartitioner`).
+
+Usage::
+
+    python examples/distributed/partition_dataset.py \
+        --out /tmp/parts --num-parts 4 [--frequency] [--data graph.npz]
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
+
+import numpy as np
+
+
+def synthetic(n=20000, deg=8, d=64, classes=16, seed=0):
+  """Clustered, learnable graph (same construction as the training
+  examples, so `dist_train_sage.py --partition-dir` demonstrably
+  learns on the partitioned output)."""
+  rng = np.random.default_rng(seed)
+  labels = rng.integers(0, classes, n).astype(np.int32)
+  rows = np.repeat(np.arange(n), deg)
+  order = np.argsort(labels, kind='stable')
+  ptr = np.searchsorted(labels[order], np.arange(classes + 1))
+  intra = np.empty(n * deg, dtype=np.int64)
+  for c in range(classes):
+    m = labels[rows] == c
+    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
+  cols = np.where(rng.random(n * deg) < 0.7, intra,
+                  rng.integers(0, n, n * deg))
+  feats = (np.eye(classes, dtype=np.float32)[labels] @
+           rng.normal(0, 1, (classes, d)).astype(np.float32)
+           + rng.normal(0, .5, (n, d)).astype(np.float32))
+  return rows, cols, feats, labels
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--out', required=True)
+  ap.add_argument('--num-parts', type=int, default=4)
+  ap.add_argument('--data', type=str, default=None,
+                  help='.npz with rows, cols, feats, labels')
+  ap.add_argument('--frequency', action='store_true',
+                  help='hotness-driven partitioning + feature caching')
+  ap.add_argument('--cache-ratio', type=float, default=0.1)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[15, 10, 5])
+  args = ap.parse_args()
+
+  if args.data:
+    d = dict(np.load(args.data))
+    rows, cols, feats, labels = (d['rows'], d['cols'], d['feats'],
+                                 d['labels'])
+  else:
+    rows, cols, feats, labels = synthetic()
+  n = feats.shape[0]
+
+  if args.frequency:
+    # hotness: per-partition visit probability under the training
+    # fanout (reference `NeighborSampler.sample_prob` ->
+    # `FrequencyPartitioner`, SURVEY §3.5)
+    from graphlearn_tpu.data import Dataset
+    from graphlearn_tpu.partition import FrequencyPartitioner
+    from graphlearn_tpu.sampler import NeighborSampler
+    ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
+    sampler = NeighborSampler(ds.get_graph(), args.fanout, seed=0)
+    seed_groups = [np.arange(n)[p::args.num_parts]
+                   for p in range(args.num_parts)]
+    probs = np.stack([np.asarray(sampler.sample_prob(g, n))
+                      for g in seed_groups])
+    p = FrequencyPartitioner(
+        args.out, args.num_parts, n, (rows, cols), feats, labels,
+        probs=probs, cache_ratio=args.cache_ratio)
+  else:
+    from graphlearn_tpu.partition import RandomPartitioner
+    p = RandomPartitioner(args.out, args.num_parts, n, (rows, cols),
+                          feats, labels, cache_ratio=args.cache_ratio)
+  p.partition()
+  pb = np.load(Path(args.out) / 'node_pb.npy')
+  sizes = [int((pb == i).sum()) for i in range(args.num_parts)]
+  print(f'wrote {args.num_parts} partitions to {args.out}; '
+        f'sizes {sizes}')
+
+
+if __name__ == '__main__':
+  main()

@@ -73,6 +73,10 @@ class NpzTableReader(TableReader):
     cols = list(self.columns or data.files)
     arrays = [data[c] for c in cols]
     n = len(arrays[0])
+    if any(len(a) != n for a in arrays):
+      raise ValueError(
+          f'npz columns {cols} have mismatched lengths '
+          f'{[len(a) for a in arrays]}')
     for lo in range(0, n, batch_size):
       hi = min(lo + batch_size, n)
       yield list(zip(*(a[lo:hi] for a in arrays)))

@@ -158,10 +158,6 @@ class DistPartitionManager:
     else:
       self._peers[rank].request('put', tag, payload)
 
-  def put_to_all(self, tag: str, payload: Dict[str, np.ndarray]):
-    for r in range(self.world_size):
-      self.put_to(r, tag, payload)
-
   def take(self, tag: str, expect: int, timeout: float = 600.0
            ) -> List[dict]:
     """Block until ``expect`` payloads arrived under ``tag``; pop them."""
@@ -329,6 +325,11 @@ class DistRandomPartitioner:
       pbs = mgr.take('edge_pb', self.world_size)
       all_eids = np.concatenate([p['eids'] for p in pbs])
       all_owner = np.concatenate([p['owner'] for p in pbs])
+      if not np.array_equal(np.sort(all_eids), np.arange(len(all_eids))):
+        raise ValueError(
+            'global edge ids are not a disjoint cover of '
+            f'range({len(all_eids)}) — check each rank\'s '
+            'edge_id_offset (overlap or gap)')
       edge_pb = np.empty((len(all_eids),), dtype=np.int8)
       edge_pb[all_eids] = all_owner
       np.save(self.output_dir / 'edge_pb.npy', edge_pb)

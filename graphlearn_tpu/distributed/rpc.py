@@ -65,7 +65,7 @@ def send_obj(sock: socket.socket, obj: Any) -> None:
               for v in obj.values())):
     _send_frame(sock, KIND_TENSOR_MAP, serialize_tensor_map(obj))
   else:
-    _send_frame(sock, KIND_PICKLE, pickle.dumps(obj))
+    _send_frame(sock, KIND_PICKLE, pickle.dumps(obj, protocol=5))
 
 
 def recv_obj(sock: socket.socket) -> Any:

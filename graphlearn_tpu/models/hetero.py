@@ -18,18 +18,42 @@ from ..typing import EdgeType, NodeType, as_str
 from .conv import SAGEConv, segment_mean
 
 
+class _NamedConv(nn.Module):
+  """Binds a factory-made conv under an explicit etype-keyed scope, so
+  params never depend on positional auto-naming (which shifts when a
+  batch lacks some edge type)."""
+  factory: Callable[[], nn.Module]
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask):
+    return self.factory()(x, edge_index, edge_mask)
+
+
 class HeteroConv(nn.Module):
   """Applies a per-edge-type conv and aggregates per target type.
 
+  Two modes (reference analog: PyG's ``HeteroConv`` the examples wrap,
+  `examples/igbh/rgnn.py`):
+
+    * default (``make_conv=None``): per-etype linear message +
+      mean-aggregation, plus a per-type self term — the RGCN flavor;
+    * ``make_conv`` given: each edge type gets a fresh conv from the
+      factory (e.g. ``lambda: GATConv(d, heads=h)`` for RGAT), run
+      bipartite via source-offset concatenation; no extra self term
+      (the conv's own self path applies, PyG semantics).
+
   Args:
-    convs: ``{EdgeType: conv factory}`` — each conv is called as
-      ``conv(x_src, x_dst, edge_index, edge_mask)`` via the
-      `_BipartiteAdapter` below when it's a plain homogeneous conv.
+    etypes: edge types to convolve.
+    out_features: per-type output width (factory convs must produce
+      this width too — e.g. ``GATConv(d // heads, heads=heads)``).
     aggr: cross-etype aggregation into a target type ('sum'/'mean').
+    make_conv: optional factory of homogeneous convs with signature
+      ``conv(x, edge_index, edge_mask)``.
   """
   etypes: Tuple[EdgeType, ...]
   out_features: int
   aggr: str = 'sum'
+  make_conv: Optional[Callable[[], nn.Module]] = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict=None):
@@ -45,14 +69,42 @@ class HeteroConv(nn.Module):
       em = (edge_mask_dict or {}).get(et)
       na, nb = x_dict[a].shape[0], x_dict[b].shape[0]
       src, dst = ei[0], ei[1]
-      msg = nn.Dense(self.out_features, use_bias=False,
-                     name=f'lin_{as_str(et)}')(
-                         x_dict[a][jnp.clip(src, 0, na - 1)])
-      agg = segment_mean(msg, dst, nb, em)
+      if self.make_conv is not None:
+        # bipartite via concatenation: [x_b; x_a] so dst ids are
+        # unchanged and src ids shift by nb; any homogeneous conv
+        # then runs unmodified, and rows [0, nb) are the dst output.
+        xa, xb = x_dict[a], x_dict[b]
+        if xa.shape[-1] != xb.shape[-1]:
+          raise ValueError(
+              f'HeteroConv(make_conv=...) needs equal feature widths '
+              f'for {et}: {xa.shape[-1]} vs {xb.shape[-1]} — project '
+              f'per-type inputs first (e.g. a Dense per node type)')
+        xcat = jnp.concatenate([xb, xa], axis=0)
+        src2 = jnp.clip(src, 0, na - 1) + nb
+        ei2 = jnp.stack([src2, dst])
+        conv = _NamedConv(self.make_conv, name=f'conv_{as_str(et)}')
+        agg = conv(xcat, ei2, em)[:nb]
+      else:
+        msg = nn.Dense(self.out_features, use_bias=False,
+                       name=f'lin_{as_str(et)}')(
+                           x_dict[a][jnp.clip(src, 0, na - 1)])
+        agg = segment_mean(msg, dst, nb, em)
       out[b] = out.get(b, 0) + agg
       counts[b] = counts.get(b, 0) + 1
     res = {}
     for nt, x in x_dict.items():
+      if self.make_conv is not None:
+        # factory mode: conv output only; untouched types pass through
+        # a projection so widths stay consistent across layers.
+        if nt in out:
+          h = out[nt]
+          if self.aggr == 'mean':
+            h = h / counts[nt]
+          res[nt] = h
+        else:
+          res[nt] = nn.Dense(self.out_features,
+                             name=f'lin_self_{nt}')(x)
+        continue
       self_term = nn.Dense(self.out_features, name=f'lin_self_{nt}')(x)
       if nt in out:
         h = out[nt]

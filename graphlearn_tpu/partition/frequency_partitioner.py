@@ -45,9 +45,15 @@ class FrequencyPartitioner(PartitionerBase):
 
     # Greedy chunk assignment maximizing own-hotness advantage
     # (reference `frequency_partitioner.py:104-128`): score each chunk
-    # for partition p as sum(own prob) - mean(others' prob).
-    chunks = [slice(i, min(i + self.chunk_size, n))
-              for i in range(0, n, self.chunk_size)]
+    # for partition p as sum(own prob) - mean(others' prob).  The
+    # chunk granularity adapts so every partition sees >= 8 chunks —
+    # the fixed reference default degenerates on small graphs (e.g.
+    # 2 chunks for 4 partitions leaves partitions empty).
+    eff_chunk = self.chunk_size
+    if n // max(eff_chunk, 1) < self.num_parts * 4:
+      eff_chunk = max(1, -(-n // (self.num_parts * 8)))
+    chunks = [slice(i, min(i + eff_chunk, n))
+              for i in range(0, n, eff_chunk)]
     # visit chunks in a deterministic shuffled order for balance
     rng = np.random.default_rng(0)
     for ci in rng.permutation(len(chunks)):
@@ -58,7 +64,7 @@ class FrequencyPartitioner(PartitionerBase):
       gain = tot - others
       order = np.argsort(-gain, kind='stable')
       for p in order:
-        if assigned[p] + (sl.stop - sl.start) <= cap * 1.05 + self.chunk_size:
+        if assigned[p] + (sl.stop - sl.start) <= cap + eff_chunk:
           pb[sl] = p
           assigned[p] += sl.stop - sl.start
           break

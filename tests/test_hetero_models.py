@@ -103,3 +103,49 @@ def test_hgt_forward():
                     batch.edge_mask_dict)
   assert out.shape == (batch.x_dict[U].shape[0], 3)
   assert np.isfinite(np.asarray(out)).all()
+
+
+def test_heteroconv_factory_rgat():
+  """make_conv factory path (RGAT flavor): per-etype GAT attention run
+  bipartite via source-offset concatenation."""
+  import flax.linen as nn
+  from graphlearn_tpu.models import GATConv, HeteroConv
+
+  ds = _dataset(d=12)
+  loader = NeighborLoader(ds, [3, 3], (U, np.arange(16)), batch_size=8,
+                          seed=0)
+  batch = next(iter(loader))
+  etypes = tuple(batch.edge_index_dict.keys())
+
+  class RGAT(nn.Module):
+    @nn.compact
+    def __call__(self, x_dict, ei_dict, em_dict):
+      h = {nt: nn.Dense(16)(x) for nt, x in x_dict.items()}
+      for li in range(2):
+        conv = HeteroConv(etypes, 16,
+                          make_conv=lambda: GATConv(8, heads=2),
+                          name=f'conv{li}')
+        h = conv(h, ei_dict, em_dict)
+        h = {nt: nn.relu(v) for nt, v in h.items()}
+      return nn.Dense(3)(h[U])
+
+  model = RGAT()
+  params = model.init(jax.random.key(0), batch.x_dict,
+                      batch.edge_index_dict, batch.edge_mask_dict)
+  out = model.apply(params, batch.x_dict, batch.edge_index_dict,
+                    batch.edge_mask_dict)
+  assert out.shape == (batch.x_dict[U].shape[0], 3)
+  assert np.isfinite(np.asarray(out)).all()
+
+
+def test_heteroconv_factory_rejects_width_mismatch():
+  import pytest
+  import jax.numpy as jnp
+  from graphlearn_tpu.models import HeteroConv, SAGEConv
+
+  et = (U, 'clicks', I)
+  conv = HeteroConv((et,), 8, make_conv=lambda: SAGEConv(8))
+  x = {U: jnp.ones((4, 6)), I: jnp.ones((3, 5))}
+  ei = {et: jnp.zeros((2, 2), jnp.int32)}
+  with pytest.raises(ValueError, match='equal feature widths'):
+    conv.init(jax.random.key(0), x, ei, None)
