@@ -1,0 +1,70 @@
+"""Distributed loader throughput over a device mesh.
+
+Reference counterpart: `benchmarks/api/bench_dist_neighbor_loader.py`
+(2 nodes x 2 GPUs, RPC sampling) — here the mesh-collective engine:
+graph sharded over N devices, per-device seed shards, cross-partition
+neighbor exchange on ICI (or the virtual CPU mesh).
+
+Usage::
+
+    # virtual 8-device mesh anywhere:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/bench_dist_loader.py --quick
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import Timer, build_graph, emit
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--quick', action='store_true')
+  ap.add_argument('--num-parts', type=int, default=None)
+  ap.add_argument('--dim', type=int, default=64)
+  args = ap.parse_args()
+
+  import jax
+  from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                       make_mesh)
+
+  num_parts = args.num_parts or len(jax.devices())
+  mesh = make_mesh(num_parts)
+  n = 100_000 if args.quick else 500_000
+  rows, cols = build_graph(n)
+  feats = np.random.default_rng(0).standard_normal(
+      (n, args.dim)).astype(np.float32)
+  labels = (np.arange(n) % 47).astype(np.int32)
+  ds = DistDataset.from_full_graph(num_parts, rows, cols,
+                                   node_feat=feats, node_label=labels,
+                                   num_nodes=n)
+
+  seeds = np.random.default_rng(1).permutation(n)[:8192 if args.quick
+                                                  else 65536]
+  for batch_size in (256, 512):
+    loader = DistNeighborLoader(ds, [10, 5], seeds,
+                                batch_size=batch_size, shuffle=True,
+                                mesh=mesh, seed=0)
+    b = next(iter(loader))          # compile
+    b.x.block_until_ready()
+    batches = 0
+    with Timer() as t:
+      last = None
+      for b in loader:
+        last = b
+        batches += 1
+      last.x.block_until_ready()
+    global_batch = batch_size * num_parts
+    emit('dist_loader_seeds_per_sec',
+         batches * global_batch / t.dt / 1e3, 'K seeds/s',
+         batch=batch_size, num_parts=num_parts,
+         platform=jax.devices()[0].platform)
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,79 @@
+"""Feature-lookup throughput (GB/s) across hot/cold split ratios.
+
+Reference counterpart: `benchmarks/api/bench_feature.py:27-62` — gather
+the features of each sampled batch's node set, timed alone, reported
+as GB/s.  Sweeps ``split_ratio`` (1.0 = all HBM, like the reference's
+DMA mode; lower = two-tier with host gathers) and the Pallas DMA
+kernel vs the XLA gather on the hot tier.
+
+Usage::
+
+    python benchmarks/bench_feature.py [--cpu] [--quick]
+"""
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import Timer, build_graph, emit
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--cpu', action='store_true')
+  ap.add_argument('--quick', action='store_true')
+  ap.add_argument('--dim', type=int, default=128)
+  args = ap.parse_args()
+
+  import jax
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  from graphlearn_tpu.data import Dataset, sort_by_in_degree
+  from graphlearn_tpu.sampler import NeighborSampler, NodeSamplerInput
+
+  n = 200_000 if args.quick else 1_000_000
+  iters = 5 if args.quick else 20
+  rows, cols = build_graph(n)
+  feats = np.random.default_rng(0).standard_normal(
+      (n, args.dim)).astype(np.float32)
+  rng = np.random.default_rng(1)
+
+  # sampled node sets at the flagship config drive the lookups
+  ds0 = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
+  sampler = NeighborSampler(ds0.get_graph(), [15, 10, 5], seed=0)
+  node_sets = []
+  for _ in range(iters):
+    seeds = rng.integers(0, n, 1024).astype(np.int32)
+    out = sampler.sample_from_nodes(NodeSamplerInput(node=seeds))
+    node_sets.append(np.asarray(out.node))
+
+  for split_ratio in (1.0, 0.5, 0.2):
+    for pallas in ((True, False) if split_ratio == 1.0 else (False,)):
+      os.environ['GLT_PALLAS'] = '1' if pallas else '0'
+      ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
+      ds.init_node_features(
+          feats,
+          sort_func=sort_by_in_degree if split_ratio < 1.0 else None,
+          split_ratio=split_ratio)
+      feat = ds.get_node_feature()
+      feat[node_sets[0]].block_until_ready()   # compile + lazy init
+      nbytes = 0
+      with Timer() as t:
+        res = None
+        for ns in node_sets:
+          res = feat[ns]
+          nbytes += res.size * res.dtype.itemsize
+        res.block_until_ready()
+      emit('feature_lookup_gbps', nbytes / t.dt / 1e9, 'GB/s',
+           split_ratio=split_ratio,
+           impl=('pallas' if pallas else 'xla'),
+           platform=jax.devices()[0].platform)
+  os.environ.pop('GLT_PALLAS', None)
+
+
+if __name__ == '__main__':
+  main()

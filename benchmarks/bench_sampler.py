@@ -1,0 +1,67 @@
+"""Neighbor-sampling throughput across batch sizes and fanouts.
+
+Reference counterpart: `benchmarks/api/bench_sampler.py` — metric
+"Sampled Edges per secs (M)".  The root `bench.py` runs the single
+flagship config; this sweeps the grid the reference's scale-up plot
+covers.
+
+Usage::
+
+    python benchmarks/bench_sampler.py [--cpu] [--quick]
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import Timer, build_graph, emit
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--cpu', action='store_true')
+  ap.add_argument('--quick', action='store_true',
+                  help='small graph, fewer iters')
+  args = ap.parse_args()
+
+  import jax
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.sampler import NeighborSampler, NodeSamplerInput
+
+  n = 200_000 if args.quick else None
+  iters = 5 if args.quick else 20
+  rows, cols = (build_graph(n) if n else build_graph())
+  n = n or int(max(rows.max(), cols.max())) + 1
+  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
+  g = ds.get_graph()
+  g.lazy_init()
+  rng = np.random.default_rng(1)
+
+  for fanout in ([15, 10, 5], [10, 10], [25, 10]):
+    for batch in (512, 1024, 4096):
+      sampler = NeighborSampler(g, fanout, seed=0)
+
+      def one(batch=batch):
+        seeds = rng.integers(0, n, batch).astype(np.int32)
+        return sampler.sample_from_nodes(NodeSamplerInput(node=seeds))
+
+      out = one()
+      out.row.block_until_ready()          # compile
+      outs = []
+      with Timer() as t:
+        for _ in range(iters):
+          outs.append(one())
+        outs[-1].row.block_until_ready()
+      edges = sum(int(np.asarray(o.edge_mask).sum()) for o in outs)
+      emit(f'sampler_edges_per_sec', edges / t.dt / 1e6, 'M edges/s',
+           fanout=fanout, batch=batch,
+           platform=jax.devices()[0].platform)
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,50 @@
+"""Shared benchmark scaffolding.
+
+Mirrors the reference's harness conventions (`benchmarks/api/
+bench_sampler.py:46-54`, `bench_feature.py:50-62`): wall-clock around
+the op under test, device-synchronized, metric printed as one JSON
+line per config so the results are machine-comparable across rounds.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+NUM_NODES = 2_449_029          # ogbn-products node count
+AVG_DEG = 25
+
+
+def build_graph(num_nodes=NUM_NODES, avg_deg=AVG_DEG, seed=0):
+  """Synthetic power-law-ish graph at ogbn-products scale (same
+  construction as the root `bench.py`)."""
+  rng = np.random.default_rng(seed)
+  n = num_nodes
+  e = n * avg_deg
+  rows = rng.integers(0, n, e, dtype=np.int64)
+  hubs = (rng.random(e) < 0.3)
+  cols = np.where(hubs,
+                  (rng.random(e) ** 2 * n).astype(np.int64),
+                  rng.integers(0, n, e, dtype=np.int64))
+  return rows, cols.astype(np.int64)
+
+
+def emit(metric: str, value: float, unit: str, baseline: float = None,
+         **extra):
+  rec = {'metric': metric, 'value': round(float(value), 3), 'unit': unit}
+  if baseline:
+    rec['vs_baseline'] = round(float(value) / baseline, 4)
+  rec.update(extra)
+  print(json.dumps(rec), flush=True)
+
+
+class Timer:
+  """Wall-clock over N iters; call ``sync`` on a device array first."""
+
+  def __enter__(self):
+    self.t0 = time.perf_counter()
+    return self
+
+  def __exit__(self, *exc):
+    self.dt = time.perf_counter() - self.t0

@@ -1,0 +1,38 @@
+"""Shared synthetic-graph generator for the examples.
+
+Zero-egress stand-in for OGB-style datasets: a label-clustered COO
+graph (intra-class edges dominate) with features carrying a faint
+class direction in noise, so every example's objective is genuinely
+learnable and partition/train pairs (`distributed/
+partition_dataset.py` -> `dist_train_sage.py --partition-dir`) stay in
+sync by construction.
+"""
+import numpy as np
+
+
+def clustered_graph(n=8192, deg=8, classes=8, d=32, intra_p=0.7,
+                    feat_signal=1.0, seed=0):
+  """Returns ``(rows, cols, feats, labels)``.
+
+  Args:
+    intra_p: probability an edge stays inside its source's class.
+    feat_signal: scale of the class direction mixed into the features
+      (0 = pure noise; 1 = the class prototype mix the supervised
+      examples use).
+  """
+  rng = np.random.default_rng(seed)
+  labels = rng.integers(0, classes, n).astype(np.int32)
+  rows = np.repeat(np.arange(n), deg)
+  order = np.argsort(labels, kind='stable')
+  ptr = np.searchsorted(labels[order], np.arange(classes + 1))
+  intra = np.empty(n * deg, dtype=np.int64)
+  for c in range(classes):
+    m = labels[rows] == c
+    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
+  cols = np.where(rng.random(n * deg) < intra_p, intra,
+                  rng.integers(0, n, n * deg))
+  proto = rng.normal(0, 1, (classes, d)).astype(np.float32)
+  feats = (feat_signal * proto[labels]
+           + rng.normal(0, 0.5 + 0.5 * (feat_signal == 0),
+                        (n, d)).astype(np.float32))
+  return rows, cols, feats, labels

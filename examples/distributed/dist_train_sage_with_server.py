@@ -27,22 +27,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
 import numpy as np
 
 
-def synthetic(n=4096, d=32, classes=8, deg=8, seed=0):
-  rng = np.random.default_rng(seed)
-  labels = rng.integers(0, classes, n).astype(np.int32)
-  rows = np.repeat(np.arange(n), deg)
-  order = np.argsort(labels, kind='stable')
-  ptr = np.searchsorted(labels[order], np.arange(classes + 1))
-  intra = np.empty(n * deg, dtype=np.int64)
-  for c in range(classes):
-    m = labels[rows] == c
-    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
-  cols = np.where(rng.random(n * deg) < 0.7, intra,
-                  rng.integers(0, n, n * deg))
-  feats = (np.eye(classes, dtype=np.float32)[labels] @
-           rng.normal(0, 1, (classes, d)).astype(np.float32)
-           + rng.normal(0, .5, (n, d)).astype(np.float32))
-  return rows, cols, feats, labels
+from examples._synthetic import clustered_graph
+
+
+def synthetic(n):
+  return clustered_graph(n=n)
 
 
 def run_server(rank, num_servers, port_q, n):

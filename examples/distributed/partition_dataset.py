@@ -22,25 +22,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
 import numpy as np
 
 
-def synthetic(n=20000, deg=8, d=64, classes=16, seed=0):
-  """Clustered, learnable graph (same construction as the training
-  examples, so `dist_train_sage.py --partition-dir` demonstrably
-  learns on the partitioned output)."""
-  rng = np.random.default_rng(seed)
-  labels = rng.integers(0, classes, n).astype(np.int32)
-  rows = np.repeat(np.arange(n), deg)
-  order = np.argsort(labels, kind='stable')
-  ptr = np.searchsorted(labels[order], np.arange(classes + 1))
-  intra = np.empty(n * deg, dtype=np.int64)
-  for c in range(classes):
-    m = labels[rows] == c
-    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
-  cols = np.where(rng.random(n * deg) < 0.7, intra,
-                  rng.integers(0, n, n * deg))
-  feats = (np.eye(classes, dtype=np.float32)[labels] @
-           rng.normal(0, 1, (classes, d)).astype(np.float32)
-           + rng.normal(0, .5, (n, d)).astype(np.float32))
-  return rows, cols, feats, labels
+from examples._synthetic import clustered_graph
+
+
+def synthetic():
+  # same construction as the training examples, so
+  # `dist_train_sage.py --partition-dir` demonstrably learns on the
+  # partitioned output
+  return clustered_graph(n=20000, d=64, classes=16)
 
 
 def main():

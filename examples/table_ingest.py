@@ -19,25 +19,18 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 
+from examples._synthetic import clustered_graph
+
+
 def write_tables(d: Path, n=2000, classes=8, deg=6, seed=0):
-  rng = np.random.default_rng(seed)
-  labels = rng.integers(0, classes, n).astype(np.int32)
-  order = np.argsort(labels, kind='stable')
-  ptr = np.searchsorted(labels[order], np.arange(classes + 1))
-  rows = np.repeat(np.arange(n), deg)
-  intra = np.empty(n * deg, np.int64)
-  for c in range(classes):
-    m = labels[rows] == c
-    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
-  cols = np.where(rng.random(n * deg) < 0.75, intra,
-                  rng.integers(0, n, n * deg))
+  rows, cols, feat, labels = clustered_graph(n=n, deg=deg,
+                                             classes=classes, d=classes,
+                                             intra_p=0.75, seed=seed)
   with open(d / 'edges.csv', 'w') as f:
     for r, c in zip(rows, cols):
       f.write(f'{r},{c}\n')
-  feat = (np.eye(classes, dtype=np.float32)[labels]
-          + rng.normal(0, .3, (n, classes)).astype(np.float32))
   with open(d / 'nodes.csv', 'w') as f:
-    for i in rng.permutation(n):       # arbitrary record order
+    for i in np.random.default_rng(seed).permutation(n):  # any order
       f.write(f'{i},' + ':'.join(f'{v:.5f}' for v in feat[i]) + '\n')
   return labels
 

@@ -22,24 +22,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 
+from examples._synthetic import clustered_graph
+
+
 def synthetic(n=20000, d=64, classes=16, deg=10, seed=0):
-  rng = np.random.default_rng(seed)
-  labels = rng.integers(0, classes, n).astype(np.int32)
-  # Mostly intra-class edges + noise.
-  rows = np.repeat(np.arange(n), deg)
-  intra = rng.random(n * deg) < 0.7
-  perm_by_class = np.argsort(labels, kind='stable')
-  class_ptr = np.searchsorted(labels[perm_by_class], np.arange(classes + 1))
-  intra_targets = np.empty(n * deg, dtype=np.int64)
-  for c in range(classes):
-    mask = labels[rows] == c
-    lo, hi = class_ptr[c], class_ptr[c + 1]
-    intra_targets[mask] = perm_by_class[rng.integers(lo, hi, mask.sum())]
-  cols = np.where(intra, intra_targets, rng.integers(0, n, n * deg))
-  feats = np.eye(classes, dtype=np.float32)[labels] @ rng.normal(
-      0, 1, (classes, d)).astype(np.float32)
-  feats += rng.normal(0, 0.5, (n, d)).astype(np.float32)
-  idx = rng.permutation(n)
+  rows, cols, feats, labels = clustered_graph(n=n, deg=deg,
+                                              classes=classes, d=d,
+                                              seed=seed)
+  idx = np.random.default_rng(seed).permutation(n)
   return dict(rows=rows, cols=cols, feats=feats, labels=labels,
               train_idx=idx[:int(n * .6)], val_idx=idx[int(n * .6):
                                                        int(n * .8)],

@@ -21,25 +21,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 
-def synthetic(n=4000, d=32, clusters=8, deg=8, seed=0):
-  rng = np.random.default_rng(seed)
-  cl = rng.integers(0, clusters, n)
-  rows = np.repeat(np.arange(n), deg)
-  same = rng.random(n * deg) < 0.8
-  # intra-cluster targets: random member of the same cluster
-  order = np.argsort(cl, kind='stable')
-  ptr = np.searchsorted(cl[order], np.arange(clusters + 1))
-  intra = np.empty(n * deg, dtype=np.int64)
-  for c in range(clusters):
-    m = cl[rows] == c
-    intra[m] = order[rng.integers(ptr[c], ptr[c + 1], m.sum())]
-  cols = np.where(same, intra, rng.integers(0, n, n * deg))
+from examples._synthetic import clustered_graph
+
+
+def synthetic():
   # weakly informative features (PPI features carry signal too):
-  # a faint cluster direction buried in noise.
-  proto = rng.normal(0, 1, (clusters, d)).astype(np.float32)
-  feats = (0.5 * proto[cl]
-           + rng.standard_normal((n, d)).astype(np.float32))
-  return rows, cols, feats, cl
+  # a faint cluster direction buried in noise
+  return clustered_graph(n=4000, deg=8, classes=8, d=32, intra_p=0.8,
+                         feat_signal=0.5)
 
 
 def main():

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.pallas_gather import gather_rows
+from ..utils.padding import next_power_of_two
 from ..utils.tensor import convert_to_array
 
 
@@ -127,26 +128,37 @@ class Feature:
       return jnp.where(jnp.asarray(valid)[:, None], out, 0)
 
     cold_sel = valid & (idx >= self.hot_rows)
-    if self.hot_rows == 0 or not cold_sel.any():
-      if self.hot_rows == 0:
-        # Fully host-resident: gather on host, one transfer.
-        out = np.zeros((len(ids_host), d), dtype=self._host_feats.dtype)
-        out[valid] = self._host_feats[idx[valid]]
-        return jnp.asarray(out if self._dtype is None
-                           else out.astype(self._dtype))
-      out = jnp.take(self._hot, jnp.asarray(np.where(cold_sel, 0, idx)),
-                     axis=0)
+    if self.hot_rows == 0:
+      # Fully host-resident: gather on host, one transfer.
+      out = np.zeros((len(ids_host), d), dtype=self._host_feats.dtype)
+      out[valid] = self._host_feats[idx[valid]]
+      return jnp.asarray(out if self._dtype is None
+                         else out.astype(self._dtype))
+    if not cold_sel.any():
+      out = gather_rows(self._hot, jnp.asarray(idx.astype(np.int32)))
       return jnp.where(jnp.asarray(valid)[:, None], out, 0)
 
-    # Mixed: device gather for hot, host gather + one device_put for cold.
+    # Mixed: device gather for hot rows; cold rows host-gathered into a
+    # COMPACT [n_cold_pad, D] buffer (power-of-two padded so the number
+    # of compiled variants stays logarithmic) and expanded on device by
+    # a per-row rank map.  Ships only the cold bytes — a full-[B, D]
+    # staging buffer or a dynamic scatter is 10-200x slower (the former
+    # in transfer, the latter recompiling on every batch's cold count).
     hot_idx = np.where(cold_sel, 0, idx)
-    out = jnp.take(self._hot, jnp.asarray(hot_idx), axis=0)
-    out = jnp.where(jnp.asarray(valid & ~cold_sel)[:, None], out, 0)
-    cold_vals = self._host_feats[idx[cold_sel]]
+    out = gather_rows(self._hot, jnp.asarray(hot_idx.astype(np.int32)))
+    n_cold = int(cold_sel.sum())
+    cold_pad = next_power_of_two(n_cold)
+    compact = np.zeros((cold_pad, d), dtype=self._host_feats.dtype)
+    compact[:n_cold] = self._host_feats[idx[cold_sel]]
     if self._dtype is not None:
-      cold_vals = cold_vals.astype(self._dtype)
-    cold_pos = jnp.asarray(np.nonzero(cold_sel)[0])
-    return out.at[cold_pos].set(jnp.asarray(cold_vals))
+      compact = compact.astype(self._dtype)
+    # rank[i] = position of row i's value in the compact buffer
+    rank = np.cumsum(cold_sel) - 1
+    rank = np.where(cold_sel, rank, 0).astype(np.int32)
+    cold_rows = jnp.take(jnp.asarray(compact), jnp.asarray(rank), axis=0)
+    hot_ok = jnp.asarray(valid & ~cold_sel)[:, None]
+    cold_ok = jnp.asarray(cold_sel)[:, None]
+    return jnp.where(hot_ok, out, jnp.where(cold_ok, cold_rows, 0))
 
   def host_get(self, ids=None) -> np.ndarray:
     """Host-side gather (reference ``Feature.cpu_get``,

@@ -60,30 +60,41 @@ class HeteroConv(nn.Module):
     out: Dict[NodeType, Any] = {}
     counts: Dict[NodeType, int] = {}
     for et in self.etypes:
-      if et not in edge_index_dict:
-        continue
       a, _, b = et
       if a not in x_dict or b not in x_dict:
         continue
-      ei = edge_index_dict[et]
-      em = (edge_mask_dict or {}).get(et)
+      if et in edge_index_dict:
+        ei = edge_index_dict[et]
+        em = (edge_mask_dict or {}).get(et)
+      else:
+        # etype configured but absent from this batch: run the conv on
+        # an empty edge set so the param structure stays a function of
+        # `self.etypes`, never of batch content (otherwise a batch
+        # missing one etype would init/apply a different pytree).
+        ei = jnp.zeros((2, 0), jnp.int32)
+        em = jnp.zeros((0,), jnp.bool_)
       na, nb = x_dict[a].shape[0], x_dict[b].shape[0]
       src, dst = ei[0], ei[1]
       if self.make_conv is not None:
-        # bipartite via concatenation: [x_b; x_a] so dst ids are
-        # unchanged and src ids shift by nb; any homogeneous conv
-        # then runs unmodified, and rows [0, nb) are the dst output.
-        xa, xb = x_dict[a], x_dict[b]
-        if xa.shape[-1] != xb.shape[-1]:
-          raise ValueError(
-              f'HeteroConv(make_conv=...) needs equal feature widths '
-              f'for {et}: {xa.shape[-1]} vs {xb.shape[-1]} — project '
-              f'per-type inputs first (e.g. a Dense per node type)')
-        xcat = jnp.concatenate([xb, xa], axis=0)
-        src2 = jnp.clip(src, 0, na - 1) + nb
-        ei2 = jnp.stack([src2, dst])
         conv = _NamedConv(self.make_conv, name=f'conv_{as_str(et)}')
-        agg = conv(xcat, ei2, em)[:nb]
+        if a == b:
+          # self-relation: the conv runs directly — no concat, no
+          # doubled node dimension for the usually-largest relation.
+          agg = conv(x_dict[a], ei, em)
+        else:
+          # bipartite via concatenation: [x_b; x_a] so dst ids are
+          # unchanged and src ids shift by nb; any homogeneous conv
+          # then runs unmodified, and rows [0, nb) are the dst output.
+          xa, xb = x_dict[a], x_dict[b]
+          if xa.shape[-1] != xb.shape[-1]:
+            raise ValueError(
+                f'HeteroConv(make_conv=...) needs equal feature widths '
+                f'for {et}: {xa.shape[-1]} vs {xb.shape[-1]} — project '
+                f'per-type inputs first (e.g. a Dense per node type)')
+          xcat = jnp.concatenate([xb, xa], axis=0)
+          src2 = jnp.clip(src, 0, na - 1) + nb
+          ei2 = jnp.stack([src2, dst])
+          agg = conv(xcat, ei2, em)[:nb]
       else:
         msg = nn.Dense(self.out_features, use_bias=False,
                        name=f'lin_{as_str(et)}')(
