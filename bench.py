@@ -15,34 +15,21 @@ era. vs_baseline > 1.0 means faster than that nominal A100 figure.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from benchmarks.common import NUM_NODES, build_graph  # noqa: E402
+
 BASELINE_EDGES_PER_SEC = 100e6
 
-NUM_NODES = 2_449_029          # ogbn-products node count
-AVG_DEG = 25
 FANOUT = (15, 10, 5)
 BATCH = 1024
 WARMUP = 3
 ITERS = 20
-
-
-def build_graph(seed=0):
-  """Synthetic power-law-ish graph at ogbn-products scale."""
-  rng = np.random.default_rng(seed)
-  n = NUM_NODES
-  e = n * AVG_DEG
-  rows = rng.integers(0, n, e, dtype=np.int64)
-  # Preferential-attachment-flavored targets: mix uniform + squared
-  # concentration so degree distribution is skewed like a real graph.
-  hubs = (rng.random(e) < 0.3)
-  cols = np.where(hubs,
-                  (rng.random(e) ** 2 * n).astype(np.int64),
-                  rng.integers(0, n, e, dtype=np.int64))
-  return rows, cols.astype(np.int64)
 
 
 def main():

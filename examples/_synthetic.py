@@ -11,7 +11,7 @@ import numpy as np
 
 
 def clustered_graph(n=8192, deg=8, classes=8, d=32, intra_p=0.7,
-                    feat_signal=1.0, seed=0):
+                    feat_signal=1.0, noise_std=0.5, seed=0):
   """Returns ``(rows, cols, feats, labels)``.
 
   Args:
@@ -19,6 +19,8 @@ def clustered_graph(n=8192, deg=8, classes=8, d=32, intra_p=0.7,
     feat_signal: scale of the class direction mixed into the features
       (0 = pure noise; 1 = the class prototype mix the supervised
       examples use).
+    noise_std: feature noise scale (sets the SNR together with
+      ``feat_signal``).
   """
   rng = np.random.default_rng(seed)
   labels = rng.integers(0, classes, n).astype(np.int32)
@@ -33,6 +35,5 @@ def clustered_graph(n=8192, deg=8, classes=8, d=32, intra_p=0.7,
                   rng.integers(0, n, n * deg))
   proto = rng.normal(0, 1, (classes, d)).astype(np.float32)
   feats = (feat_signal * proto[labels]
-           + rng.normal(0, 0.5 + 0.5 * (feat_signal == 0),
-                        (n, d)).astype(np.float32))
+           + rng.normal(0, noise_std, (n, d)).astype(np.float32))
   return rows, cols, feats, labels

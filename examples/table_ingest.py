@@ -25,7 +25,8 @@ from examples._synthetic import clustered_graph
 def write_tables(d: Path, n=2000, classes=8, deg=6, seed=0):
   rows, cols, feat, labels = clustered_graph(n=n, deg=deg,
                                              classes=classes, d=classes,
-                                             intra_p=0.75, seed=seed)
+                                             intra_p=0.75, noise_std=0.3,
+                                             seed=seed)
   with open(d / 'edges.csv', 'w') as f:
     for r, c in zip(rows, cols):
       f.write(f'{r},{c}\n')

@@ -46,6 +46,8 @@ def main():
   ap.add_argument('--lr', type=float, default=3e-3)
   ap.add_argument('--split-ratio', type=float, default=1.0,
                   help='fraction of features resident in HBM')
+  ap.add_argument('--ckpt-dir', type=str, default=None,
+                  help='checkpoint/resume directory (resumes if present)')
   ap.add_argument('--cpu', action='store_true')
   args = ap.parse_args()
 
@@ -84,7 +86,17 @@ def main():
   train_step = make_supervised_step(apply_fn, tx, bs)
   eval_step = make_eval_step(apply_fn, bs)
 
-  for epoch in range(args.epochs):
+  ckpt = start_epoch = None
+  if args.ckpt_dir:
+    from graphlearn_tpu.utils import Checkpointer
+    ckpt = Checkpointer(args.ckpt_dir, max_to_keep=2)
+    restored = ckpt.restore(template=state)
+    start_epoch = ckpt.latest_step() or 0
+    if restored is not None:
+      state = jax.tree_util.tree_map(jax.numpy.asarray, restored)
+      print(f'resumed from epoch {start_epoch}')
+
+  for epoch in range(start_epoch or 0, args.epochs):
     t0 = time.perf_counter()
     tot = cnt = 0
     for batch in train_loader:
@@ -94,6 +106,8 @@ def main():
     dt = time.perf_counter() - t0
     print(f'epoch {epoch}: loss {tot / max(cnt, 1):.4f}  '
           f'({dt:.2f}s, {cnt} steps)')
+    if ckpt is not None:
+      ckpt.save(epoch + 1, state)
 
   correct = total = 0
   for batch in test_loader:

@@ -28,7 +28,7 @@ def synthetic():
   # weakly informative features (PPI features carry signal too):
   # a faint cluster direction buried in noise
   return clustered_graph(n=4000, deg=8, classes=8, d=32, intra_p=0.8,
-                         feat_signal=0.5)
+                         feat_signal=0.5, noise_std=1.0)
 
 
 def main():

@@ -18,6 +18,7 @@ from ..channel import (ChannelBase, MpChannel, RemoteReceivingChannel,
                        SampleMessage, ShmChannel)
 from ..loader.transform import Batch
 from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
+from ..utils.profiling import metrics, trace
 from .dist_options import (CollocatedDistSamplingWorkerOptions,
                            MpDistSamplingWorkerOptions,
                            RemoteDistSamplingWorkerOptions)
@@ -132,9 +133,13 @@ class DistLoader:
     else:
       if self._received >= self._expected:
         raise StopIteration
-      msg = self._recv_current_epoch()
+      with trace('dist_loader.recv'):
+        msg = self._recv_current_epoch()
       self._received += 1
-    return self._collate_fn(msg)
+    with trace('dist_loader.collate'):
+      batch = self._collate_fn(msg)
+    metrics.inc('dist_loader.batches')
+    return batch
 
   def _recv_current_epoch(self) -> SampleMessage:
     """Receive, discarding stale-epoch messages left in the channel by

@@ -16,6 +16,7 @@ import numpy as np
 from ..data.dataset import Dataset
 from ..sampler.base import BaseSampler, NodeSamplerInput
 from ..utils.padding import INVALID_ID, pad_1d
+from ..utils.profiling import metrics, trace
 from .transform import Batch, to_data, to_hetero_data
 
 
@@ -101,9 +102,14 @@ class NodeLoader:
 
   def __next__(self) -> Batch:
     seeds = next(self._seed_iter)
-    out = self.sampler.sample_from_nodes(
-        NodeSamplerInput(node=seeds, input_type=self.input_type))
-    return self._collate_fn(out)
+    with trace('loader.sample'):
+      out = self.sampler.sample_from_nodes(
+          NodeSamplerInput(node=seeds, input_type=self.input_type))
+    with trace('loader.collate'):
+      batch = self._collate_fn(out)
+    metrics.inc('loader.batches')
+    metrics.inc('loader.seeds', int((seeds >= 0).sum()))
+    return batch
 
   def _collate_fn(self, out):
     """Gather features/labels for sampled nodes and build the batch

@@ -3,7 +3,18 @@ from .device import (assign_device, ensure_device, get_available_devices,
 from .mixin import CastMixin
 from .padding import (INVALID_ID, bucket_size, max_sampled_edges,
                       max_sampled_nodes, next_power_of_two, pad_1d, round_up)
+from .profiling import Metrics, capture, metrics, start_trace, stop_trace, trace
 from .tensor import convert_to_array, id2idx, to_device, to_host
+
+
+def __getattr__(name):
+  # Checkpointer is lazy: importing it pulls orbax (~4s), which every
+  # process importing the library would otherwise pay — including each
+  # mp sampling producer subprocess.
+  if name == 'Checkpointer':
+    from .checkpoint import Checkpointer
+    return Checkpointer
+  raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
 from .topo import (coo_to_csc, coo_to_csr, csr_to_coo, degrees_from_indptr,
                    ptr2ind)
 from .units import format_size, parse_size

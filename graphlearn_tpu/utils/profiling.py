@@ -1,0 +1,97 @@
+"""Tracing, profiling and lightweight metrics.
+
+The reference has NO tracing/profiling subsystem (SURVEY §5: wall-clock
+prints in benchmarks only) — this module is deliberately beyond parity:
+
+  * :func:`trace` — context manager emitting a `jax.profiler`
+    TraceAnnotation (visible in xprof/tensorboard timelines) and
+    feeding the wall-clock metrics registry;
+  * :func:`start_trace` / :func:`stop_trace` — capture an xprof trace
+    directory viewable in TensorBoard's profile plugin;
+  * :class:`Metrics` — process-local counters/timers the loaders and
+    channels tick (batches produced, edges sampled, bytes moved), with
+    a one-line JSON snapshot for logs and the bench harness.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+class Metrics:
+  """Thread-safe counter/timer registry.
+
+  >>> metrics.inc('loader.batches')
+  >>> with metrics.timer('sampler.one_hop'):
+  ...   ...
+  >>> metrics.snapshot()
+  {'loader.batches': 1, 'sampler.one_hop.secs': 0.01, ...}
+  """
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._counts: Dict[str, float] = {}
+
+  def inc(self, name: str, value: float = 1.0) -> None:
+    with self._lock:
+      self._counts[name] = self._counts.get(name, 0) + value
+
+  @contextlib.contextmanager
+  def timer(self, name: str) -> Iterator[None]:
+    t0 = time.perf_counter()
+    try:
+      yield
+    finally:
+      dt = time.perf_counter() - t0
+      self.inc(f'{name}.secs', dt)
+      self.inc(f'{name}.calls')
+
+  def snapshot(self) -> Dict[str, float]:
+    with self._lock:
+      return dict(self._counts)
+
+  def reset(self) -> None:
+    with self._lock:
+      self._counts.clear()
+
+  def dump(self) -> str:
+    return json.dumps(
+        {k: round(v, 6) for k, v in sorted(self.snapshot().items())})
+
+
+#: process-global registry (the reference has none; loaders tick this)
+metrics = Metrics()
+
+
+@contextlib.contextmanager
+def trace(name: str, registry: Optional[Metrics] = None) -> Iterator[None]:
+  """Annotate a host-side region: shows up on the xprof timeline AND
+  accumulates wall-clock in the metrics registry."""
+  reg = registry if registry is not None else metrics
+  with jax.profiler.TraceAnnotation(name):
+    with reg.timer(name):
+      yield
+
+
+def start_trace(log_dir: str) -> None:
+  """Begin an xprof capture (TensorBoard profile plugin format)."""
+  jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+  jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def capture(log_dir: str) -> Iterator[None]:
+  """Trace a whole block: ``with capture('/tmp/xprof'): train()``."""
+  start_trace(log_dir)
+  try:
+    yield
+  finally:
+    stop_trace()

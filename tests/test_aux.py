@@ -1,0 +1,107 @@
+"""Aux subsystems: metrics/tracing + checkpoint/resume.
+
+These exceed the reference deliberately (SURVEY §5 lists tracing and
+checkpointing as absent there); tests pin the public contracts.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from graphlearn_tpu.utils import Checkpointer, Metrics, metrics, trace
+
+
+def test_metrics_counts_and_timers():
+  m = Metrics()
+  m.inc('a')
+  m.inc('a', 2)
+  with m.timer('t'):
+    pass
+  snap = m.snapshot()
+  assert snap['a'] == 3
+  assert snap['t.calls'] == 1
+  assert snap['t.secs'] >= 0
+  m.reset()
+  assert m.snapshot() == {}
+
+
+def test_trace_annotation_ticks_registry():
+  m = Metrics()
+  with trace('region', registry=m):
+    jnp.ones(4).block_until_ready()
+  assert m.snapshot()['region.calls'] == 1
+
+
+def test_loader_ticks_global_metrics():
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.loader import NeighborLoader
+  rows = np.repeat(np.arange(20), 2)
+  cols = (rows + 1) % 20
+  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=20)
+  loader = NeighborLoader(ds, [2], np.arange(20), batch_size=8)
+  before = metrics.snapshot().get('loader.batches', 0)
+  list(loader)
+  after = metrics.snapshot()['loader.batches']
+  assert after - before == 3
+
+
+@pytest.mark.parametrize('use_orbax', [True, False])
+def test_checkpoint_roundtrip(tmp_path, use_orbax):
+  if use_orbax:
+    pytest.importorskip('orbax.checkpoint')
+  ck = Checkpointer(tmp_path / 'ck', max_to_keep=2, use_orbax=use_orbax)
+  assert ck.restore(template=None if use_orbax else {'x': np.zeros(2)}
+                    ) is None
+  tree = {'w': jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+          'opt': {'mu': jnp.ones(3)}, 'step': jnp.asarray(7)}
+  ck.save(1, tree)
+  ck.save(5, jax.tree_util.tree_map(lambda v: v + 1, tree))
+  ck.save(9, jax.tree_util.tree_map(lambda v: v * 2, tree))
+  assert ck.all_steps() == [5, 9]        # max_to_keep=2 pruned step 1
+  assert ck.latest_step() == 9
+  out = ck.restore(template=tree)
+  np.testing.assert_array_equal(out['w'], np.asarray(tree['w']) * 2)
+  np.testing.assert_array_equal(out['opt']['mu'], 2 * np.ones(3))
+  assert int(out['step']) == 14
+  # restore a specific retained step
+  out5 = ck.restore(template=tree, step=5)
+  np.testing.assert_array_equal(out5['w'], np.asarray(tree['w']) + 1)
+
+
+def test_checkpoint_resume_training_state(tmp_path):
+  """Round-trips a real TrainState through save/restore and continues
+  training — the examples' --ckpt-dir flow."""
+  import optax
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.loader import NeighborLoader
+  from graphlearn_tpu.models import (GraphSAGE, create_train_state,
+                                     make_supervised_step)
+  rng = np.random.default_rng(0)
+  n = 32
+  rows = np.repeat(np.arange(n), 3)
+  cols = rng.integers(0, n, n * 3)
+  ds = (Dataset()
+        .init_graph((rows, cols), layout='COO', num_nodes=n)
+        .init_node_features(rng.standard_normal((n, 8)).astype(np.float32))
+        .init_node_labels((np.arange(n) % 3).astype(np.int32)))
+  loader = NeighborLoader(ds, [2], np.arange(n), batch_size=8)
+  model = GraphSAGE(hidden_features=8, out_features=3, num_layers=1)
+  tx = optax.adam(1e-2)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), next(iter(loader)), tx)
+  step = make_supervised_step(apply_fn, tx, 8)
+  for b in loader:
+    state, _, _ = step(state, b)
+
+  ck = Checkpointer(tmp_path / 'run')
+  ck.save(1, state)
+  restored = ck.restore(template=state)
+  chex_equal = jax.tree_util.tree_map(
+      lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+      state, restored)
+  del chex_equal
+  # training continues from the restored pytree
+  state2 = jax.tree_util.tree_map(jnp.asarray, restored)
+  for b in loader:
+    state2, loss, _ = step(state2, b)
+  assert np.isfinite(float(loss))
