@@ -1,16 +1,15 @@
-"""Unsupervised SAGE on a bipartite user-item graph.
+"""Unsupervised SAGE on a bipartite user-item graph — hetero link loader.
 
 TPU counterpart of reference `examples/hetero/bipartite_sage_unsup.py`:
-learn user/item embeddings from observed interactions with a
-link-prediction objective, then rank held-out interactions.  The
-reference drives a hetero LinkNeighborLoader; until the hetero link
-loader lands here, the bipartite graph is homogenized with offset item
-ids (item j -> nu + j) — the standard bipartite-to-homo embedding
-construction, sampling and objective unchanged.
+a hetero `LinkNeighborLoader` seeded with ``(user, clicks, item)``
+edges samples around both endpoint types (+ strict item-space
+negatives), a per-edge-type SAGE (HeteroConv factory mode) embeds both
+types, and the dot-product link objective trains them jointly.
+Held-out interactions are ranked against random pairs.
 
 Usage::
 
-    python examples/hetero/bipartite_sage_unsup.py [--epochs 5] [--cpu]
+    python examples/hetero/bipartite_sage_unsup.py [--epochs 10] [--cpu]
 """
 import argparse
 import sys
@@ -20,8 +19,12 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
 
 import numpy as np
 
+U, I = 'user', 'item'
+ET = (U, 'clicks', I)
+ET_REV = (I, 'rev_clicks', U)
 
-def synthetic(nu=2000, ni=400, taste=8, deg=10, seed=0):
+
+def synthetic(nu=2000, ni=400, taste=8, deg=10, d=32, seed=0):
   rng = np.random.default_rng(seed)
   ut = rng.integers(0, taste, nu)       # user taste group
   it = rng.integers(0, taste, ni)       # item taste group
@@ -34,7 +37,11 @@ def synthetic(nu=2000, ni=400, taste=8, deg=10, seed=0):
     pool = by_taste[t] if len(by_taste[t]) else np.arange(ni)
     cols[m] = pool[rng.integers(0, len(pool), m.sum())]
   cols[~match] = rng.integers(0, ni, (~match).sum())
-  return rows, cols, ut, it
+  # weakly informative features: a faint taste direction in noise
+  proto = rng.normal(0, 1, (taste, d)).astype(np.float32)
+  ufeat = 0.5 * proto[ut] + rng.standard_normal((nu, d)).astype(np.float32)
+  ifeat = 0.5 * proto[it] + rng.standard_normal((ni, d)).astype(np.float32)
+  return rows, cols, ufeat, ifeat
 
 
 def main():
@@ -48,73 +55,107 @@ def main():
   import jax
   if args.cpu:
     jax.config.update('jax_platforms', 'cpu')
+  import flax.linen as nn
+  import jax.numpy as jnp
   import optax
   from graphlearn_tpu.data import Dataset
-  from graphlearn_tpu.loader import LinkNeighborLoader
-  from graphlearn_tpu.models import (GraphSAGE, create_train_state,
-                                     make_unsupervised_step)
+  from graphlearn_tpu.loader import LinkNeighborLoader, NeighborLoader
+  from graphlearn_tpu.models import HeteroConv, SAGEConv
   from graphlearn_tpu.sampler import NegativeSampling
 
-  urow, icol, ut, it = synthetic()
-  nu, ni = len(ut), len(it)
-  n = nu + ni
-  d = 32
+  urow, icol, ufeat, ifeat = synthetic()
+  nu, ni = len(ufeat), len(ifeat)
   rng = np.random.default_rng(2)
-  # homogenized ids: users [0, nu), items [nu, nu+ni)
-  rows = np.concatenate([urow, icol + nu])
-  cols = np.concatenate([icol + nu, urow])       # symmetric interactions
-  # weakly informative features: a faint taste direction in noise.
-  proto = rng.normal(0, 1, (int(max(ut.max(), it.max())) + 1, d)
-                     ).astype(np.float32)
-  feats = (0.5 * np.concatenate([proto[ut], proto[it]])
-           + rng.standard_normal((n, d)).astype(np.float32))
 
   # hold out 10% of interactions for ranking eval
   m = len(urow)
   perm = rng.permutation(m)
-  heldout = perm[:m // 10]
-  train = perm[m // 10:]
-  tr = np.concatenate([urow[train], icol[train] + nu])
-  tc = np.concatenate([icol[train] + nu, urow[train]])
+  heldout, train = perm[:m // 10], perm[m // 10:]
+  tr_u, tr_i = urow[train], icol[train]
 
   ds = (Dataset()
-        .init_graph((tr, tc), layout='COO', num_nodes=n)
-        .init_node_features(feats, split_ratio=1.0))
+        .init_graph({ET: (tr_u, tr_i), ET_REV: (tr_i, tr_u)},
+                    layout='COO', num_nodes={U: nu, I: ni})
+        .init_node_features({U: ufeat, I: ifeat}, split_ratio=1.0))
   loader = LinkNeighborLoader(
-      ds, [8, 8], (urow[train], icol[train] + nu),
+      ds, [8, 8], (ET, (tr_u, tr_i)),
       neg_sampling=NegativeSampling('binary', 1.0),
       batch_size=args.batch_size, shuffle=True, seed=0)
 
-  model = GraphSAGE(hidden_features=args.hidden, out_features=args.hidden,
-                    num_layers=2)
+  hidden = args.hidden
+  etypes = None  # resolved from the first batch
+
+  class BiSAGE(nn.Module):
+    etypes: tuple
+
+    @nn.compact
+    def __call__(self, x_dict, ei_dict, em_dict):
+      h = {nt: nn.Dense(hidden)(x) for nt, x in x_dict.items()}
+      for li in range(2):
+        conv = HeteroConv(self.etypes, hidden,
+                          make_conv=lambda: SAGEConv(hidden),
+                          name=f'conv{li}')
+        h = conv(h, ei_dict, em_dict)
+        if li == 0:
+          h = {nt: nn.relu(v) for nt, v in h.items()}
+      return h
+
+  batch0 = next(iter(loader))
+  etypes = tuple(batch0.edge_index_dict.keys())
+  model = BiSAGE(etypes)
   tx = optax.adam(3e-3)
-  state, apply_fn = create_train_state(
-      model, jax.random.key(0), next(iter(loader)), tx)
-  step = make_unsupervised_step(apply_fn, tx)
+  params = model.init(jax.random.key(0), batch0.x_dict,
+                      batch0.edge_index_dict, batch0.edge_mask_dict)
+  opt = tx.init(params)
+
+  @jax.jit
+  def step(params, opt, batch):
+    def loss_fn(p):
+      h = model.apply(p, batch.x_dict, batch.edge_index_dict,
+                      batch.edge_mask_dict)
+      eli = batch.metadata['edge_label_index']
+      lab = jnp.minimum(batch.metadata['edge_label'], 1).astype(jnp.float32)
+      mask = batch.metadata['edge_label_mask']
+      eu = h[U][jnp.clip(eli[0], 0, h[U].shape[0] - 1)]
+      ev = h[I][jnp.clip(eli[1], 0, h[I].shape[0] - 1)]
+      logit = jnp.sum(eu * ev, axis=-1)
+      ls = optax.sigmoid_binary_cross_entropy(logit, lab)
+      w = (mask & (eli[0] >= 0) & (eli[1] >= 0)).astype(jnp.float32)
+      return (ls * w).sum() / jnp.maximum(w.sum(), 1.0)
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    upd, opt = tx.update(g, opt, params)
+    return optax.apply_updates(params, upd), opt, loss
 
   for epoch in range(args.epochs):
     tot = cnt = 0
     for batch in loader:
-      state, loss = step(state, batch)
+      params, opt, loss = step(params, opt, batch)
       tot += float(loss)
       cnt += 1
     print(f'epoch {epoch}: link loss {tot / max(cnt, 1):.4f}')
 
-  # rank held-out pairs against random pairs
-  from graphlearn_tpu.loader import NeighborLoader
-  emb = np.zeros((n, args.hidden), np.float32)
-  for batch in NeighborLoader(ds, [8, 8], np.arange(n),
-                              batch_size=args.batch_size):
-    e = apply_fn(state.params, batch.x, batch.edge_index, batch.edge_mask)
-    seeds = np.asarray(batch.batch)
-    valid = seeds >= 0
-    sl = np.asarray(batch.metadata['seed_local'])[valid]
-    emb[seeds[valid]] = np.asarray(e)[sl]
-  hu, hi = urow[heldout], icol[heldout] + nu
-  pos_s = (emb[hu] * emb[hi]).sum(1)
-  ru = rng.integers(0, nu, len(heldout))
-  ri = rng.integers(nu, n, len(heldout))
-  neg_s = (emb[ru] * emb[ri]).sum(1)
+  # full-type embeddings via node loaders, then rank held-out pairs
+  @jax.jit
+  def embed(params, batch):
+    return model.apply(params, batch.x_dict, batch.edge_index_dict,
+                       batch.edge_mask_dict)
+
+  def all_embeddings(ntype, count):
+    emb = np.zeros((count, hidden), np.float32)
+    el = NeighborLoader(ds, [8, 8], (ntype, np.arange(count)),
+                        batch_size=args.batch_size)
+    for b in el:
+      h = embed(params, b)
+      seeds = np.asarray(b.batch_dict[ntype])
+      valid = seeds >= 0
+      sl = np.asarray(b.metadata['seed_local'])[valid]
+      emb[seeds[valid]] = np.asarray(h[ntype])[sl]
+    return emb
+
+  uemb, iemb = all_embeddings(U, nu), all_embeddings(I, ni)
+  pos_s = (uemb[urow[heldout]] * iemb[icol[heldout]]).sum(1)
+  neg_s = (uemb[rng.integers(0, nu, len(heldout))]
+           * iemb[rng.integers(0, ni, len(heldout))]).sum(1)
   auc = (pos_s[:, None] > neg_s[None, :]).mean()
   print(f'held-out interaction AUC: {auc:.4f}')
 

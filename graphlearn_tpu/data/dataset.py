@@ -156,6 +156,20 @@ class Dataset:
       self.node_labels = convert_to_array(node_label_data)
     return self
 
+  def num_nodes_dict(self) -> Dict[NodeType, int]:
+    """Per-node-type counts for hetero graphs: feature-store row counts
+    (authoritative — they include isolated nodes) merged with topology
+    src-side counts.  Samplers use this to size negative draws and
+    capacity plans correctly."""
+    out: Dict[NodeType, int] = {}
+    if isinstance(self.node_features, dict):
+      for nt, f in self.node_features.items():
+        out[nt] = max(out.get(nt, 0), f.size(0))
+    if isinstance(self.graph, dict):
+      for (s, _, _d), g in self.graph.items():
+        out[s] = max(out.get(s, 0), g.num_nodes)
+    return out
+
   # -- typed getters (reference `data/dataset.py:230-278`) ------------------
   def get_graph(self, etype: Optional[EdgeType] = None):
     if isinstance(self.graph, dict):

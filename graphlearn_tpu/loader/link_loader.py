@@ -23,7 +23,7 @@ from ..sampler.base import (BaseSampler, EdgeSamplerInput, NegativeSampling,
                             SamplerOutput)
 from ..utils.padding import INVALID_ID, pad_1d
 from .node_loader import SeedBatcher
-from .transform import Batch, to_data
+from .transform import Batch, collate
 
 
 class EdgeSeedBatcher:
@@ -75,6 +75,13 @@ class LinkLoader:
                seed: Optional[int] = None, **kwargs):
     self.data = data
     self.sampler = sampler
+    self.input_type = None
+    if (isinstance(edge_label_index, tuple)
+        and isinstance(edge_label_index[0], tuple)
+        and len(edge_label_index[0]) == 3):
+      # Hetero seed edges: (edge_type, (rows, cols)) — reference
+      # `InputEdges` (`typing.py:87`).
+      self.input_type, edge_label_index = edge_label_index
     if isinstance(edge_label_index, (tuple, list)):
       rows, cols = edge_label_index
     else:
@@ -101,16 +108,12 @@ class LinkLoader:
       lab = lab + 1
     out = self.sampler.sample_from_edges(
         EdgeSamplerInput(row=r, col=c, label=lab,
+                         input_type=self.input_type,
                          neg_sampling=self.neg_sampling))
     return self._collate_fn(out)
 
-  def _collate_fn(self, out: SamplerOutput) -> Batch:
-    return to_data(
-        out,
-        node_feature=self.data.get_node_feature(),
-        node_label=self.data.get_node_label(),
-        edge_feature=(self.data.get_edge_feature()
-                      if out.edge is not None else None))
+  def _collate_fn(self, out) -> Batch:
+    return collate(self.data, out)
 
 
 class LinkNeighborLoader(LinkLoader):
@@ -126,10 +129,18 @@ class LinkNeighborLoader(LinkLoader):
                batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, with_edge: bool = False,
                device=None, seed: Optional[int] = None, **kwargs):
-    from ..sampler.neighbor_sampler import NeighborSampler
-    sampler = NeighborSampler(
-        data.get_graph(), num_neighbors, device=device, with_edge=with_edge,
-        with_neg=neg_sampling is not None, seed=seed or 0)
+    if data.is_hetero:
+      from ..sampler.hetero_neighbor_sampler import HeteroNeighborSampler
+      sampler = HeteroNeighborSampler(
+          data.get_graph(), num_neighbors, device=device,
+          with_edge=with_edge, num_nodes=data.num_nodes_dict(),
+          seed=seed or 0)
+    else:
+      from ..sampler.neighbor_sampler import NeighborSampler
+      sampler = NeighborSampler(
+          data.get_graph(), num_neighbors, device=device,
+          with_edge=with_edge, with_neg=neg_sampling is not None,
+          seed=seed or 0)
     super().__init__(data, sampler, edge_label_index, edge_label,
                      neg_sampling, batch_size, shuffle, drop_last, seed,
                      **kwargs)

@@ -38,7 +38,8 @@ class NeighborLoader(NodeLoader):
       from ..sampler.hetero_neighbor_sampler import HeteroNeighborSampler
       sampler = HeteroNeighborSampler(
           data.get_graph(), num_neighbors, device=device,
-          with_edge=with_edge, seed=seed or 0)
+          with_edge=with_edge, num_nodes=data.num_nodes_dict(),
+          seed=seed or 0)
     else:
       sampler = NeighborSampler(
           data.get_graph(), num_neighbors, device=device,

@@ -17,7 +17,7 @@ from ..data.dataset import Dataset
 from ..sampler.base import BaseSampler, NodeSamplerInput
 from ..utils.padding import INVALID_ID, pad_1d
 from ..utils.profiling import metrics, trace
-from .transform import Batch, to_data, to_hetero_data
+from .transform import Batch, collate
 
 
 class SeedBatcher:
@@ -114,19 +114,4 @@ class NodeLoader:
   def _collate_fn(self, out):
     """Gather features/labels for sampled nodes and build the batch
     (reference `loader/node_loader.py:85-113`)."""
-    from ..sampler.base import HeteroSamplerOutput
-    if isinstance(out, HeteroSamplerOutput):
-      return to_hetero_data(
-          out,
-          node_feature_dict=self.data.node_features
-          if isinstance(self.data.node_features, dict) else None,
-          node_label_dict=self.data.node_labels
-          if isinstance(self.data.node_labels, dict) else None,
-          edge_feature_dict=self.data.edge_features
-          if isinstance(self.data.edge_features, dict) else None)
-    return to_data(
-        out,
-        node_feature=self.data.get_node_feature(),
-        node_label=self.data.get_node_label(),
-        edge_feature=(self.data.get_edge_feature()
-                      if out.edge is not None else None))
+    return collate(self.data, out)

@@ -18,13 +18,24 @@ from ..typing import EdgeType, NodeType
 from ..sampler.base import HeteroSamplerOutput, SamplerOutput
 
 
+def _contains_array(v) -> bool:
+  if hasattr(v, 'shape') or hasattr(v, 'dtype'):
+    return True
+  if isinstance(v, dict):
+    return any(_contains_array(x) for x in v.values())
+  if isinstance(v, (list, tuple)):
+    return any(_contains_array(x) for x in v)
+  return False
+
+
 def _split_metadata(metadata: Dict):
   """Split metadata into (dynamic array-valued, static hashable) parts
   so batches stay jit-compatible pytrees even when samplers attach
-  strings (e.g. ``input_type``)."""
+  strings (e.g. ``input_type``).  Containers holding arrays (the
+  hetero ``seed_local`` per-type dict) count as dynamic."""
   dyn, static = {}, {}
   for k, v in metadata.items():
-    if hasattr(v, 'shape') or hasattr(v, 'dtype'):
+    if _contains_array(v):
       dyn[k] = v
     else:
       static[k] = v
@@ -169,6 +180,27 @@ def to_data(
       num_sampled_nodes=out.num_sampled_nodes,
       num_sampled_edges=out.num_sampled_edges,
       metadata=dict(out.metadata))
+
+
+def collate(data, out) -> Any:
+  """Dispatch a sampler output through the right collation against a
+  `Dataset` — the one shared implementation behind every loader's
+  ``_collate_fn`` (reference `loader/node_loader.py:85-113`)."""
+  if isinstance(out, HeteroSamplerOutput):
+    return to_hetero_data(
+        out,
+        node_feature_dict=data.node_features
+        if isinstance(data.node_features, dict) else None,
+        node_label_dict=data.node_labels
+        if isinstance(data.node_labels, dict) else None,
+        edge_feature_dict=data.edge_features
+        if isinstance(data.edge_features, dict) else None)
+  return to_data(
+      out,
+      node_feature=data.get_node_feature(),
+      node_label=data.get_node_label(),
+      edge_feature=(data.get_edge_feature()
+                    if out.edge is not None else None))
 
 
 def to_hetero_data(

@@ -15,7 +15,7 @@ Requires within-row-sorted CSR columns (guaranteed by
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -66,7 +66,8 @@ class NegativeSampleResult(NamedTuple):
 
 
 @functools.partial(
-    jax.jit, static_argnames=('req_num', 'trials', 'strict', 'padding'))
+    jax.jit,
+    static_argnames=('req_num', 'trials', 'strict', 'padding', 'num_cols'))
 def sample_negative(
     indptr: jax.Array,
     indices: jax.Array,
@@ -76,6 +77,7 @@ def sample_negative(
     trials: int = 5,
     strict: bool = True,
     padding: bool = True,
+    num_cols: Optional[int] = None,
 ) -> NegativeSampleResult:
   """Draw ``req_num`` node pairs that are (in strict mode) non-edges.
 
@@ -83,12 +85,17 @@ def sample_negative(
   ``strict`` rejects existing edges with up to ``trials`` redraws per
   slot; ``padding`` falls back to the final (possibly invalid) draw so
   the output is always full.
+
+  Args:
+    num_cols: destination id space (bipartite graphs draw cols from
+      the dst type's ``[0, num_cols)``); defaults to the row space.
   """
   num_nodes = indptr.shape[0] - 1
   kr, kc = jax.random.split(key)
   rows = jax.random.randint(kr, (trials, req_num), 0, num_nodes,
                             dtype=jnp.int32)
-  cols = jax.random.randint(kc, (trials, req_num), 0, num_nodes,
+  cols = jax.random.randint(kc, (trials, req_num), 0,
+                            num_cols if num_cols is not None else num_nodes,
                             dtype=jnp.int32)
   if not strict:
     return NegativeSampleResult(rows[0], cols[0],

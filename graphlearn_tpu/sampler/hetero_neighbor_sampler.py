@@ -40,8 +40,7 @@ from .base import (BaseSampler, HeteroSamplerOutput, NodeSamplerInput)
 def _plan_capacities(
     etypes: Sequence[EdgeType],
     fanouts: Dict[EdgeType, Tuple[int, ...]],
-    input_type: NodeType,
-    batch_size: int,
+    input_sizes: Dict[NodeType, int],
     num_hops: int,
     num_nodes: Dict[NodeType, int],
 ):
@@ -50,12 +49,12 @@ def _plan_capacities(
   Returns per-ntype table capacities, per-(hop, ntype) frontier
   capacities, and per-(hop, etype) edge capacities — the hetero analog
   of the reference's `_max_sampled_nodes` bound
-  (`sampler/neighbor_sampler.py:595-612`).
+  (`sampler/neighbor_sampler.py:595-612`).  ``input_sizes`` gives the
+  seed count per seeded node type (link sampling seeds two types).
   """
   ntypes = sorted({t for (s, _, d) in etypes for t in (s, d)}
-                  | {input_type})
-  frontier = {nt: 0 for nt in ntypes}
-  frontier[input_type] = batch_size
+                  | set(input_sizes))
+  frontier = {nt: int(input_sizes.get(nt, 0)) for nt in ntypes}
   frontier_caps = [dict(frontier)]
   table_cap = {nt: frontier[nt] for nt in ntypes}
   edge_caps: List[Dict[EdgeType, int]] = []
@@ -74,7 +73,8 @@ def _plan_capacities(
     frontier_caps.append(dict(frontier))
     for nt in ntypes:
       table_cap[nt] = min(table_cap[nt] + add[nt],
-                          batch_size + num_nodes.get(nt, 1 << 60))
+                          input_sizes.get(nt, 0)
+                          + num_nodes.get(nt, 1 << 60))
     edge_caps.append(ecap)
   table_cap = {nt: round_up(max(c, 1), 8) for nt, c in table_cap.items()}
   return ntypes, table_cap, frontier_caps, edge_caps
@@ -82,16 +82,16 @@ def _plan_capacities(
 
 @functools.partial(
     jax.jit,
-    static_argnames=('etypes', 'fanouts_t', 'input_type', 'num_hops',
+    static_argnames=('etypes', 'fanouts_t', 'seed_types', 'num_hops',
                      'table_caps', 'frontier_caps_t', 'with_edge'))
 def _hetero_multihop(
     graphs,           # dict etype -> (indptr, indices, edge_ids|None)
-    seeds: jax.Array,
+    seeds_t: Tuple[jax.Array, ...],   # aligned with seed_types
     key: jax.Array,
     *,
     etypes: Tuple[EdgeType, ...],
     fanouts_t: Tuple[Tuple[int, ...], ...],   # aligned with etypes
-    input_type: NodeType,
+    seed_types: Tuple[NodeType, ...],
     num_hops: int,
     table_caps: Tuple[Tuple[NodeType, int], ...],
     frontier_caps_t: Tuple[Tuple[Tuple[NodeType, int], ...], ...],
@@ -102,12 +102,14 @@ def _hetero_multihop(
   frontier_caps = [dict(fc) for fc in frontier_caps_t]
   ntypes = list(caps.keys())
 
-  # per-ntype inducer state; input type seeded, others empty.
+  # per-ntype inducer state; seeded types (one for node sampling, the
+  # two endpoint types for link sampling) start with their seed sets.
   states = {}
-  seed_local = None
+  seed_locals = {}
+  seed_by_type = dict(zip(seed_types, seeds_t))
   for nt in ntypes:
-    if nt == input_type:
-      states[nt], seed_local = init_node(seeds, caps[nt])
+    if nt in seed_by_type:
+      states[nt], seed_locals[nt] = init_node(seed_by_type[nt], caps[nt])
     else:
       states[nt] = init_node(
           jnp.full((1,), INVALID_ID, jnp.int32), caps[nt])[0]
@@ -178,7 +180,7 @@ def _hetero_multihop(
                            jnp.stack(v)[1:] - jnp.stack(v)[:-1]])
       for nt, v in nsn.items()}
   return (node, node_count, row_out, col_out,
-          eid_out if with_edge else None, emask_out, seed_local,
+          eid_out if with_edge else None, emask_out, seed_locals,
           num_sampled_nodes)
 
 
@@ -222,34 +224,149 @@ class HeteroNeighborSampler(BaseSampler):
     self._step += 1
     return jax.random.fold_in(self._base_key, self._step)
 
-  def sample_from_nodes(self, inputs: NodeSamplerInput,
-                        **kwargs) -> HeteroSamplerOutput:
-    input_type = inputs.input_type
-    assert input_type is not None, 'hetero sampling needs input_type'
-    seeds = jnp.asarray(np.asarray(inputs.node, dtype=np.int32))
-    b = seeds.shape[0]
+  def _run_multihop(self, seeds_by_type: Dict[NodeType, jax.Array]):
+    """One fused hetero multi-hop from per-type seed sets; returns the
+    raw pieces plus per-type seed-local maps."""
+    input_sizes = {nt: int(s.shape[0]) for nt, s in seeds_by_type.items()}
     ntypes, table_cap, frontier_caps, _ = _plan_capacities(
-        self.etypes, self.fanouts, input_type, b, self.num_hops,
+        self.etypes, self.fanouts, input_sizes, self.num_hops,
         self._num_nodes)
     graphs = {}
     for et in self.etypes:
       g = self.graphs[et]
       graphs[et] = (g.indptr, g.indices,
                     g.edge_ids if self.with_edge else None)
-    (node, node_count, row, col, eid, emask, seed_local,
-     nsn) = _hetero_multihop(
-         graphs, seeds, self._next_key(),
-         etypes=self.etypes,
-         fanouts_t=tuple(self.fanouts[et] for et in self.etypes),
-         input_type=input_type,
-         num_hops=self.num_hops,
-         table_caps=tuple(sorted(table_cap.items())),
-         frontier_caps_t=tuple(
-             tuple(sorted(fc.items())) for fc in frontier_caps),
-         with_edge=self.with_edge)
+    seed_types = tuple(sorted(seeds_by_type))
+    return _hetero_multihop(
+        graphs, tuple(seeds_by_type[nt] for nt in seed_types),
+        self._next_key(),
+        etypes=self.etypes,
+        fanouts_t=tuple(self.fanouts[et] for et in self.etypes),
+        seed_types=seed_types,
+        num_hops=self.num_hops,
+        table_caps=tuple(sorted(table_cap.items())),
+        frontier_caps_t=tuple(
+            tuple(sorted(fc.items())) for fc in frontier_caps),
+        with_edge=self.with_edge)
+
+  def sample_from_nodes(self, inputs: NodeSamplerInput,
+                        **kwargs) -> HeteroSamplerOutput:
+    input_type = inputs.input_type
+    assert input_type is not None, 'hetero sampling needs input_type'
+    seeds = jnp.asarray(np.asarray(inputs.node, dtype=np.int32))
+    (node, node_count, row, col, eid, emask, seed_locals,
+     nsn) = self._run_multihop({input_type: seeds})
     return HeteroSamplerOutput(
         node=node, node_count=node_count, row=row, col=col, edge=eid,
         edge_mask=emask, batch={input_type: seeds},
         num_sampled_nodes=nsn,
         edge_types=[reverse_edge_type(et) for et in self.etypes],
-        metadata={'seed_local': seed_local, 'input_type': input_type})
+        metadata={'seed_local': seed_locals[input_type],
+                  'input_type': input_type})
+
+  def sample_from_edges(self, inputs, neg_sampling=None,
+                        **kwargs) -> HeteroSamplerOutput:
+    """Hetero link-prediction sampling.
+
+    Counterpart of the reference's hetero ``sample_from_edges``
+    (`sampler/neighbor_sampler.py:255-381`): seed edges of one edge
+    type; endpoints (+ sampled negatives of the dst type) seed their
+    respective node-type tables, multi-hop expand, and the metadata
+    carries PyG's link-label indices *per endpoint type*:
+    ``edge_label_index[0]`` indexes the src-type table,
+    ``edge_label_index[1]`` the dst-type table.
+    """
+    from ..ops.negative import sample_negative
+    from .base import NegativeSampling
+    from .neighbor_sampler import _triplet_neg_dst
+
+    et = inputs.input_type
+    assert et is not None, 'hetero link sampling needs input_type=etype'
+    assert et in self.graphs, f'unknown edge type {et}'
+    s_t, _, d_t = et
+    neg = neg_sampling or inputs.neg_sampling
+    neg = NegativeSampling.cast(neg)
+    src = jnp.asarray(np.asarray(inputs.row, dtype=np.int32))
+    dst = jnp.asarray(np.asarray(inputs.col, dtype=np.int32))
+    b = src.shape[0]
+    pair_valid = (src >= 0) & (dst >= 0)
+    g = self.graphs[et]
+    key = self._next_key()
+
+    if neg is not None and neg.is_binary():
+      num_neg = neg.sample_size(b)
+      nres = sample_negative(g.indptr, g.indices, num_neg, key,
+                             strict=True, padding=True,
+                             num_cols=self._num_nodes[d_t])
+      src_seeds = jnp.concatenate([src, nres.rows])
+      dst_seeds = jnp.concatenate([dst, nres.cols])
+    elif neg is not None:        # triplet
+      amount = int(np.ceil(float(neg.amount)))
+      num_neg = b * amount
+      neg_dst = _triplet_neg_dst(g.indptr, g.indices, src, key,
+                                 amount=amount,
+                                 num_nodes=self._num_nodes[d_t])
+      src_seeds = src
+      dst_seeds = jnp.concatenate([dst, neg_dst.reshape(-1)])
+    else:
+      num_neg = 0
+      src_seeds, dst_seeds = src, dst
+
+    if s_t == d_t:
+      seeds_by_type = {s_t: jnp.concatenate([src_seeds, dst_seeds])}
+    else:
+      seeds_by_type = {s_t: src_seeds, d_t: dst_seeds}
+    (node, node_count, row, col, eid, emask, seed_locals,
+     nsn) = self._run_multihop(seeds_by_type)
+    if s_t == d_t:
+      ns = src_seeds.shape[0]
+      sl_src = seed_locals[s_t][:ns]
+      sl_dst = seed_locals[s_t][ns:]
+    else:
+      sl_src = seed_locals[s_t]
+      sl_dst = seed_locals[d_t]
+
+    if neg is not None and neg.is_binary():
+      pos_label = (jnp.asarray(np.asarray(inputs.label))
+                   if inputs.label is not None
+                   else jnp.ones((b,), jnp.int32))
+      metadata = {
+          'edge_label_index': jnp.stack([sl_src, sl_dst]),
+          'edge_label': jnp.concatenate(
+              [pos_label, jnp.zeros((num_neg,), pos_label.dtype)]),
+          'edge_label_mask': jnp.concatenate(
+              [pair_valid, jnp.ones((num_neg,), jnp.bool_)]),
+      }
+    elif neg is not None:
+      metadata = {
+          'src_index': sl_src,
+          'dst_pos_index': sl_dst[:b],
+          'dst_neg_index': sl_dst[b:].reshape(b, -1),
+          'pair_mask': pair_valid,
+      }
+    else:
+      pos_label = (jnp.asarray(np.asarray(inputs.label))
+                   if inputs.label is not None
+                   else jnp.ones((b,), jnp.int32))
+      metadata = {
+          'edge_label_index': jnp.stack([sl_src, sl_dst]),
+          'edge_label': pos_label,
+          'edge_label_mask': pair_valid,
+      }
+    metadata['input_type'] = et
+    # seed_local aligns 1:1 with `batch` (the POSITIVE endpoints only),
+    # matching the node-loader pattern consumers rely on; negatives'
+    # locals live in edge_label_index / dst_neg_index.
+    if s_t == d_t:
+      batch = {s_t: jnp.concatenate([src, dst])}
+      metadata['seed_local'] = {
+          s_t: jnp.concatenate([sl_src[:b], sl_dst[:b]])}
+    else:
+      batch = {s_t: src, d_t: dst}
+      metadata['seed_local'] = {s_t: sl_src[:b], d_t: sl_dst[:b]}
+    return HeteroSamplerOutput(
+        node=node, node_count=node_count, row=row, col=col, edge=eid,
+        edge_mask=emask, batch=batch,
+        num_sampled_nodes=nsn,
+        edge_types=[reverse_edge_type(e) for e in self.etypes],
+        metadata=metadata)
