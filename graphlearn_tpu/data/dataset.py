@@ -50,6 +50,9 @@ class Dataset:
     """
     if edge_index is None:
       return self
+    # retain the explicit hetero counts for num_nodes_dict()
+    self._explicit_num_nodes = num_nodes if isinstance(num_nodes, dict) \
+        else None
     if isinstance(edge_index, dict):
       topos = {}
       for etype, ei in edge_index.items():
@@ -157,17 +160,25 @@ class Dataset:
     return self
 
   def num_nodes_dict(self) -> Dict[NodeType, int]:
-    """Per-node-type counts for hetero graphs: feature-store row counts
-    (authoritative — they include isolated nodes) merged with topology
-    src-side counts.  Samplers use this to size negative draws and
-    capacity plans correctly."""
+    """Per-node-type counts for hetero graphs: explicit ``init_graph``
+    counts and feature-store row counts (both include isolated nodes)
+    merged with topology src- AND dst-side counts.  Samplers use this
+    to size negative draws and capacity plans correctly."""
     out: Dict[NodeType, int] = {}
+    explicit = getattr(self, '_explicit_num_nodes', None)
+    if explicit:
+      for key, n in explicit.items():
+        # keyed by node type, or by edge type (count of its src type)
+        nt = key[0] if isinstance(key, tuple) else key
+        out[nt] = max(out.get(nt, 0), int(n))
     if isinstance(self.node_features, dict):
       for nt, f in self.node_features.items():
         out[nt] = max(out.get(nt, 0), f.size(0))
     if isinstance(self.graph, dict):
-      for (s, _, _d), g in self.graph.items():
+      for (s, _, d), g in self.graph.items():
         out[s] = max(out.get(s, 0), g.num_nodes)
+        dmax = int(g.csr_topo.indices.max(initial=-1)) + 1
+        out[d] = max(out.get(d, 0), dmax)
     return out
 
   # -- typed getters (reference `data/dataset.py:230-278`) ------------------

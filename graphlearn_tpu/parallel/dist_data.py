@@ -206,7 +206,8 @@ class DistDataset:
     meta = p0['meta']
     num_parts = num_parts or meta['num_parts']
     parts = [p0] + [load_partition(root, i) for i in range(1, num_parts)]
-    assert not meta['hetero'], 'hetero dist loading lands with DistHetero'
+    assert not meta['hetero'], (
+        'hetero layout: use DistHeteroDataset.from_partition_dir')
     node_pb = parts[0]['node_pb'].table
     n = len(node_pb)
     rows = np.concatenate([p['graph'].edge_index[0] for p in parts])

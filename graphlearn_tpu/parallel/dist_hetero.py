@@ -1,0 +1,482 @@
+"""Heterogeneous distributed sampling over the device mesh.
+
+The hetero counterpart of `parallel/dist_sampler.py` — and the engine
+behind IGBH-scale distributed RGNN (reference `examples/igbh/
+dist_train_rgnn.py` + `distributed/dist_neighbor_sampler.py`'s hetero
+branch, `:255-324`): every node type is range-sharded with its own
+bounds, every edge type's local CSR lives on its source type's owner
+device, and each hop's cross-partition neighbor exchange rides
+`all_to_all` per edge type inside ONE SPMD program.
+
+Layout (`DistHeteroDataset`):
+  * per node type: contiguous relabel by its partition book →
+    ``bounds[nt]`` (`RangePartitionBook` form), feature/label shards
+    ``[P, rows_max_nt, D]``;
+  * per edge type ``(s, rel, d)``: edges owned by the SRC node's
+    partition; stacked local CSRs ``[P, ...]`` with local rows in
+    ``s``-space and GLOBAL (relabeled) ``d``-space columns, so sampled
+    neighbors enter ``d``'s tables with no translation.
+
+Engine (`DistHeteroNeighborSampler`): the hetero multihop loop of
+`sampler/hetero_neighbor_sampler.py` with every one-hop replaced by
+the collective exchange of `dist_sampler._dist_one_hop` (bucket by
+``searchsorted(bounds[s], frontier)`` → all_to_all → local sample →
+all_to_all back → stitch), and per-type feature collection via
+`dist_gather_multi` against that type's shards.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.unique import init_node, induce_next
+from ..sampler.hetero_neighbor_sampler import (_plan_capacities,
+                                               normalize_fanouts)
+from ..typing import EdgeType, NodeType, reverse_edge_type
+from ..utils.padding import INVALID_ID
+from .dist_data import build_dist_feature
+from .dist_sampler import _dist_one_hop, dist_gather_multi
+
+
+class DistHeteroDataset:
+  """Per-type sharded hetero layout.
+
+  Attributes:
+    graphs: ``{EdgeType: DistGraph}`` (bounds of the SRC type).
+    bounds: ``{NodeType: [P+1]}`` ownership ranges.
+    node_features: ``{NodeType: DistFeature}``.
+    node_labels: ``{NodeType: [P, rows_max]}``.
+    old2new / new2old: ``{NodeType: [N_nt]}`` id-space maps.
+  """
+
+  def __init__(self, graphs, bounds, node_features=None, node_labels=None,
+               old2new=None):
+    self.graphs = dict(graphs)
+    self.bounds = {nt: np.asarray(b, np.int64) for nt, b in bounds.items()}
+    self.node_features = dict(node_features or {})
+    self.node_labels = dict(node_labels or {})
+    self.old2new = dict(old2new or {})
+    self.new2old = {nt: np.argsort(m) for nt, m in self.old2new.items()}
+
+  @property
+  def num_partitions(self) -> int:
+    return len(next(iter(self.bounds.values()))) - 1
+
+  @property
+  def etypes(self) -> Tuple[EdgeType, ...]:
+    return tuple(sorted(self.graphs.keys()))
+
+  @property
+  def ntypes(self) -> Tuple[NodeType, ...]:
+    return tuple(sorted(self.bounds.keys()))
+
+  def num_nodes_dict(self) -> Dict[NodeType, int]:
+    return {nt: int(b[-1]) for nt, b in self.bounds.items()}
+
+  @classmethod
+  def from_full_graph(cls, num_parts: int, edge_index_dict,
+                      node_feat_dict=None, node_label_dict=None,
+                      num_nodes_dict=None, node_pb_dict=None,
+                      seed: int = 0) -> 'DistHeteroDataset':
+    """In-memory partition + shard (testing & single-host path) — the
+    hetero analog of `DistDataset.from_full_graph`."""
+    node_feat_dict = node_feat_dict or {}
+    node_label_dict = node_label_dict or {}
+    num_nodes_dict = dict(num_nodes_dict or {})
+    ntypes = sorted({t for (s, _, d) in edge_index_dict for t in (s, d)}
+                    | set(node_feat_dict) | set(num_nodes_dict))
+    for (s, _, d), (rows, cols) in edge_index_dict.items():
+      num_nodes_dict[s] = max(num_nodes_dict.get(s, 0),
+                              int(np.max(rows, initial=-1)) + 1)
+      num_nodes_dict[d] = max(num_nodes_dict.get(d, 0),
+                              int(np.max(cols, initial=-1)) + 1)
+    for nt, f in node_feat_dict.items():
+      num_nodes_dict[nt] = max(num_nodes_dict.get(nt, 0), len(f))
+
+    rng = np.random.default_rng(seed)
+    node_pb_dict = dict(node_pb_dict or {})
+    old2new, bounds = {}, {}
+    for nt in ntypes:
+      n = num_nodes_dict[nt]
+      pb = node_pb_dict.get(nt)
+      if pb is None:
+        pb = np.empty(n, dtype=np.int32)
+        perm = rng.permutation(n)
+        for p in range(num_parts):
+          pb[perm[p::num_parts]] = p
+        node_pb_dict[nt] = pb
+      order = np.argsort(pb, kind='stable')
+      m = np.empty(n, dtype=np.int64)
+      m[order] = np.arange(n)
+      old2new[nt] = m
+      counts = np.bincount(pb, minlength=num_parts)
+      bounds[nt] = np.concatenate([[0], np.cumsum(counts)])
+
+    graphs = {}
+    for et, (rows, cols) in edge_index_dict.items():
+      s, _, d = et
+      graphs[et] = _build_etype_graph(
+          old2new[s][np.asarray(rows)], old2new[d][np.asarray(cols)],
+          bounds[s], num_parts)
+
+    feats = {nt: build_dist_feature(f, old2new[nt], bounds[nt])
+             for nt, f in node_feat_dict.items()}
+    labels = {}
+    for nt, lab in node_label_dict.items():
+      labels[nt] = build_dist_feature(
+          np.asarray(lab), old2new[nt], bounds[nt]).shards[..., 0]
+    return cls(graphs, bounds, feats, labels, old2new)
+
+  @classmethod
+  def from_partition_dir(cls, root, num_parts: Optional[int] = None
+                         ) -> 'DistHeteroDataset':
+    """Assemble from the offline partitioner's hetero layout
+    (`partition/base.py` hetero branch; reference `DistDataset.load`)."""
+    from ..partition import load_partition
+    p0 = load_partition(root, 0)
+    meta = p0['meta']
+    assert meta['hetero'], 'homogeneous layout: use DistDataset'
+    num_parts = num_parts or meta['num_parts']
+    parts = [p0] + [load_partition(root, i) for i in range(1, num_parts)]
+
+    edge_index_dict, node_pb_dict = {}, {}
+    for nt in meta['node_types']:
+      node_pb_dict[nt] = np.asarray(parts[0]['node_pb'][nt].table)
+    for et in parts[0]['graph']:
+      rows = np.concatenate([p['graph'][et].edge_index[0] for p in parts])
+      cols = np.concatenate([p['graph'][et].edge_index[1] for p in parts])
+      edge_index_dict[et] = (rows, cols)
+    node_feat_dict = {}
+    for nt in meta['node_types']:
+      fparts = [p['node_feat'].get(nt) for p in parts]
+      if any(f is not None for f in fparts):
+        n = int(meta['num_nodes'][nt])
+        d = next(f for f in fparts if f is not None).feats.shape[1]
+        feats = np.zeros((n, d), next(f for f in fparts
+                                      if f is not None).feats.dtype)
+        for f in fparts:
+          if f is not None:
+            feats[f.ids] = f.feats
+        node_feat_dict[nt] = feats
+    node_label_dict = {}
+    for nt in meta['node_types']:
+      lparts = [p['node_label'].get(nt) for p in parts]
+      if any(l is not None for l in lparts):
+        n = int(meta['num_nodes'][nt])
+        lab0 = next(l for l in lparts if l is not None)[0]
+        labels = np.zeros((n,), lab0.dtype)
+        for l in lparts:
+          if l is not None:
+            labels[l[1]] = l[0]
+        node_label_dict[nt] = labels
+    return cls.from_full_graph(
+        num_parts, edge_index_dict, node_feat_dict, node_label_dict,
+        num_nodes_dict={nt: int(meta['num_nodes'][nt])
+                        for nt in meta['node_types']},
+        node_pb_dict=node_pb_dict)
+
+
+def _build_etype_graph(rows_new: np.ndarray, cols_new: np.ndarray,
+                       bounds_s: np.ndarray, num_parts: int):
+  """Stacked per-partition local CSRs for one edge type.
+
+  ``rows_new`` are RELABELED src-type ids (sharded by ``bounds_s``),
+  ``cols_new`` RELABELED dst-type ids kept global — the hetero twist
+  `build_dist_graph` can't express (its single relabel map would be
+  applied to both endpoint spaces).
+  """
+  from .dist_data import DistGraph
+  from ..utils.topo import coo_to_csr
+  counts = np.diff(bounds_s)
+  owner = (np.searchsorted(bounds_s, rows_new, side='right') - 1)
+  edge_ids = np.arange(len(rows_new), dtype=np.int64)
+  max_nodes = int(counts.max()) if num_parts else 0
+  max_edges = max(int(np.bincount(owner, minlength=num_parts).max()), 1)
+  indptr_s = np.zeros((num_parts, max_nodes + 1), dtype=np.int64)
+  indices_s = np.full((num_parts, max_edges), -1, dtype=np.int32)
+  eids_s = np.full((num_parts, max_edges), -1, dtype=np.int64)
+  for p in range(num_parts):
+    sel = owner == p
+    local_rows = rows_new[sel] - bounds_s[p]
+    iptr, idx, eid = coo_to_csr(local_rows, cols_new[sel],
+                                int(counts[p]), edge_ids[sel])
+    indptr_s[p, :len(iptr)] = iptr
+    indptr_s[p, len(iptr):] = iptr[-1]
+    indices_s[p, :len(idx)] = idx
+    eids_s[p, :len(eid)] = eid
+  return DistGraph(indptr_s, indices_s, eids_s, bounds_s)
+
+
+class DistHeteroNeighborSampler:
+  """SPMD hetero multihop sampler (+ per-type feature collection).
+
+  Args:
+    dataset: `DistHeteroDataset`.
+    num_neighbors: per-hop fanouts — list (all etypes) or per-etype
+      dict.
+    mesh: mesh whose ``axis`` size == partition count.
+  """
+
+  def __init__(self, dataset: DistHeteroDataset, num_neighbors,
+               mesh: Optional[Mesh] = None, axis: str = 'data',
+               with_edge: bool = False, collect_features: bool = True,
+               seed: int = 0):
+    from .dp import make_mesh
+    self.ds = dataset
+    self.etypes, self.fanouts, self.num_hops = normalize_fanouts(
+        dataset.etypes, num_neighbors)
+    self.num_parts = dataset.num_partitions
+    self.mesh = mesh or make_mesh(self.num_parts, axis)
+    self.axis = axis
+    self.with_edge = with_edge
+    self.collect_features = collect_features
+    self._base_key = jax.random.key(seed)
+    self._step_cnt = 0
+    self._steps = {}
+    self._device_arrays = None
+
+  def _arrays(self):
+    if self._device_arrays is None:
+      shard = NamedSharding(self.mesh, P(self.axis))
+      repl = NamedSharding(self.mesh, P())
+      put = jax.device_put
+      arrs = {'graphs': {}, 'bounds': {}, 'feats': {}, 'labels': {}}
+      for et in self.etypes:
+        g = self.ds.graphs[et]
+        arrs['graphs'][et] = (put(g.indptr, shard), put(g.indices, shard),
+                              put(g.edge_ids, shard))
+      for nt, b in self.ds.bounds.items():
+        arrs['bounds'][nt] = put(b, repl)
+      if self.collect_features:
+        for nt, f in self.ds.node_features.items():
+          arrs['feats'][nt] = put(f.shards, shard)
+      for nt, l in self.ds.node_labels.items():
+        arrs['labels'][nt] = put(np.asarray(l), shard)
+      self._device_arrays = arrs
+    return self._device_arrays
+
+  def _make_step(self, input_type: NodeType, b: int):
+    from .shard_map_compat import shard_map
+    input_sizes = {input_type: b}
+    ntypes, table_cap, frontier_caps, _ = _plan_capacities(
+        self.etypes, self.fanouts, input_sizes, self.num_hops,
+        self.ds.num_nodes_dict())
+    etypes = self.etypes
+    fanouts = self.fanouts
+    num_parts = self.num_parts
+    axis = self.axis
+    with_edge = self.with_edge
+    arrs = self._arrays()
+    feat_nts = tuple(sorted(arrs['feats'])) if self.collect_features else ()
+    label_nts = tuple(sorted(arrs['labels']))
+    num_hops = self.num_hops
+
+    def per_device(graphs_t, bounds_t, feats_t, labels_t, seeds_s, key):
+      graphs = {et: tuple(a[0] for a in g)
+                for et, g in zip(etypes, graphs_t)}
+      bounds = dict(zip(ntypes, bounds_t))
+      fshards = {nt: f[0] for nt, f in zip(feat_nts, feats_t)}
+      lshards = {nt: l[0] for nt, l in zip(label_nts, labels_t)}
+      seeds = seeds_s[0]
+
+      states, seed_local = {}, None
+      for nt in ntypes:
+        if nt == input_type:
+          states[nt], seed_local = init_node(seeds, table_cap[nt])
+        else:
+          states[nt] = init_node(
+              jnp.full((1,), INVALID_ID, jnp.int32), table_cap[nt])[0]
+      fr_start = {nt: jnp.zeros((), jnp.int32) for nt in ntypes}
+      rows_acc = {et: [] for et in etypes}
+      cols_acc = {et: [] for et in etypes}
+      eids_acc = {et: [] for et in etypes}
+      nsn = {nt: [states[nt].count] for nt in ntypes}
+
+      for h in range(num_hops):
+        hop_start = {nt: states[nt].count for nt in ntypes}
+        frontiers = {}
+        for nt in ntypes:
+          fcap = frontier_caps[h].get(nt, 0)
+          if fcap <= 0:
+            frontiers[nt] = None
+            continue
+          slots = fr_start[nt] + jnp.arange(fcap, dtype=jnp.int32)
+          valid = slots < hop_start[nt]
+          nodes = states[nt].nodes[
+              jnp.clip(slots, 0, table_cap[nt] - 1)]
+          frontiers[nt] = (jnp.where(valid, nodes, INVALID_ID),
+                           jnp.where(valid, slots, -1))
+        for ei_i, et in enumerate(etypes):
+          s, _, d = et
+          k = fanouts[et][h] if h < len(fanouts[et]) else 0
+          if k <= 0 or frontiers.get(s) is None:
+            continue
+          fr_nodes, fr_local = frontiers[s]
+          indptr, indices, eids = graphs[et]
+          hop_key = jax.random.fold_in(jax.random.fold_in(key, h), ei_i)
+          nbrs, mask, e = _dist_one_hop(
+              indptr, indices, eids if with_edge else None, bounds[s],
+              fr_nodes, int(k), hop_key, axis, num_parts, with_edge)
+          states[d], rows, cols, _ = induce_next(
+              states[d], fr_local, nbrs, mask)
+          rows_acc[et].append(rows)
+          cols_acc[et].append(cols)
+          if with_edge:
+            eids_acc[et].append(
+                jnp.where(rows >= 0, e.reshape(-1), INVALID_ID))
+        for nt in ntypes:
+          fr_start[nt] = hop_start[nt]
+          nsn[nt].append(states[nt].count)
+
+      x = {}
+      for nt in feat_nts:
+        (x[nt],) = dist_gather_multi((fshards[nt],), bounds[nt],
+                                     states[nt].nodes, axis, num_parts)
+      y = {}
+      for nt in label_nts:
+        (y[nt],) = dist_gather_multi((lshards[nt],), bounds[nt],
+                                     states[nt].nodes, axis, num_parts)
+
+      def lead(v):
+        return None if v is None else v[None]
+      node_t = tuple(lead(states[nt].nodes) for nt in ntypes)
+      cnt_t = tuple(lead(states[nt].count[None]) for nt in ntypes)
+      row_t = tuple(
+          lead(jnp.concatenate(rows_acc[et])) if rows_acc[et] else None
+          for et in etypes)
+      col_t = tuple(
+          lead(jnp.concatenate(cols_acc[et])) if cols_acc[et] else None
+          for et in etypes)
+      eid_t = tuple(
+          lead(jnp.concatenate(eids_acc[et]))
+          if (with_edge and eids_acc[et]) else None
+          for et in etypes)
+      x_t = tuple(lead(x[nt]) for nt in feat_nts)
+      y_t = tuple(lead(y[nt]) for nt in label_nts)
+      nsn_t = tuple(
+          lead(jnp.concatenate(
+              [jnp.stack(nsn[nt])[:1],
+               jnp.stack(nsn[nt])[1:] - jnp.stack(nsn[nt])[:-1]]))
+          for nt in ntypes)
+      return (node_t, cnt_t, row_t, col_t, eid_t, lead(seed_local),
+              x_t, y_t, nsn_t)
+
+    sh = P(axis)
+    rp = P()
+    in_specs = (
+        tuple((sh, sh, sh) for _ in etypes),      # graphs
+        tuple(rp for _ in ntypes),                # bounds
+        tuple(sh for _ in feat_nts),              # feature shards
+        tuple(sh for _ in label_nts),             # label shards
+        sh,                                       # seeds
+        rp,                                       # key
+    )
+    out_specs = (
+        tuple(sh for _ in ntypes), tuple(sh for _ in ntypes),
+        tuple(sh for _ in etypes), tuple(sh for _ in etypes),
+        tuple(sh for _ in etypes), sh,
+        tuple(sh for _ in feat_nts), tuple(sh for _ in label_nts),
+        tuple(sh for _ in ntypes),
+    )
+    sharded = shard_map(per_device, mesh=self.mesh, in_specs=in_specs,
+                        out_specs=out_specs)
+    meta = dict(ntypes=ntypes, feat_nts=feat_nts, label_nts=label_nts)
+    return jax.jit(sharded), meta
+
+  def sample_from_nodes(self, input_type: NodeType,
+                        seeds_stacked: np.ndarray):
+    """``seeds_stacked``: ``[P, B]`` per-device seeds of ``input_type``
+    in that type's RELABELED id space (-1 padded).  Returns a dict of
+    per-type stacked pieces."""
+    b = int(seeds_stacked.shape[1])
+    cfg = (input_type, b)
+    if cfg not in self._steps:
+      self._steps[cfg] = self._make_step(input_type, b)
+    step, meta = self._steps[cfg]
+    arrs = self._arrays()
+    self._step_cnt += 1
+    key = jax.random.fold_in(self._base_key, self._step_cnt)
+    seeds_dev = jax.device_put(
+        np.asarray(seeds_stacked, dtype=np.int32),
+        NamedSharding(self.mesh, P(self.axis)))
+    graphs_t = tuple(arrs['graphs'][et] for et in self.etypes)
+    bounds_t = tuple(arrs['bounds'][nt] for nt in meta['ntypes'])
+    feats_t = tuple(arrs['feats'][nt] for nt in meta['feat_nts'])
+    labels_t = tuple(arrs['labels'][nt] for nt in meta['label_nts'])
+    (node_t, cnt_t, row_t, col_t, eid_t, seed_local, x_t, y_t,
+     nsn_t) = step(graphs_t, bounds_t, feats_t, labels_t, seeds_dev, key)
+    ntypes = meta['ntypes']
+    out = dict(
+        node=dict(zip(ntypes, node_t)),
+        node_count={nt: c[..., 0] for nt, c in zip(ntypes, cnt_t)},
+        row={reverse_edge_type(et): r
+             for et, r in zip(self.etypes, row_t) if r is not None},
+        col={reverse_edge_type(et): c
+             for et, c in zip(self.etypes, col_t) if c is not None},
+        edge={reverse_edge_type(et): e
+              for et, e in zip(self.etypes, eid_t) if e is not None},
+        seed_local=seed_local,
+        x=dict(zip(meta['feat_nts'], x_t)),
+        y=dict(zip(meta['label_nts'], y_t)),
+        num_sampled_nodes=dict(zip(ntypes, nsn_t)),
+        batch=seeds_dev, input_type=input_type)
+    return out
+
+
+class DistHeteroNeighborLoader:
+  """Distributed hetero loader: stacked `HeteroBatch`-shaped pytrees
+  (leading axis = device), ready for a DP hetero train step.
+
+  The facade reference users reach via ``DistNeighborLoader`` on a
+  hetero `DistDataset` (`distributed/dist_neighbor_loader.py:27-94`).
+  """
+
+  def __init__(self, dataset: DistHeteroDataset, num_neighbors,
+               input_nodes, batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, mesh: Optional[Mesh] = None,
+               with_edge: bool = False, collect_features: bool = True,
+               seed: int = 0, input_space: str = 'old'):
+    from ..loader.node_loader import SeedBatcher
+    input_type, seeds = input_nodes
+    self.input_type = input_type
+    self.sampler = DistHeteroNeighborSampler(
+        dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
+        collect_features=collect_features, seed=seed)
+    self.ds = dataset
+    seeds = np.asarray(seeds).reshape(-1)
+    if input_space == 'old' and input_type in dataset.old2new:
+      seeds = dataset.old2new[input_type][seeds]
+    self.num_parts = dataset.num_partitions
+    self.batch_size = int(batch_size)
+    self._batcher = SeedBatcher(seeds, batch_size * self.num_parts,
+                                shuffle, drop_last, seed)
+
+  def __len__(self):
+    return len(self._batcher)
+
+  def __iter__(self):
+    self._it = iter(self._batcher)
+    return self
+
+  def __next__(self):
+    from ..loader.transform import HeteroBatch
+    flat = next(self._it)
+    seeds = flat.reshape(self.num_parts, self.batch_size)
+    out = self.sampler.sample_from_nodes(self.input_type, seeds)
+    ei = {et: jnp.stack([out['row'][et], out['col'][et]], axis=1)
+          for et in out['row']}
+    em = {et: out['row'][et] >= 0 for et in out['row']}
+    return HeteroBatch(
+        x_dict=out['x'], y_dict=out['y'], edge_index_dict=ei,
+        edge_attr_dict={}, node_dict=out['node'],
+        node_mask_dict={nt: v >= 0 for nt, v in out['node'].items()},
+        edge_mask_dict=em,
+        batch_dict={self.input_type: out['batch']},
+        batch_size=self.batch_size,
+        metadata={'seed_local': out['seed_local'],
+                  'input_type': self.input_type})

@@ -37,6 +37,22 @@ from ..utils.padding import INVALID_ID, round_up
 from .base import (BaseSampler, HeteroSamplerOutput, NodeSamplerInput)
 
 
+def normalize_fanouts(etypes: Tuple[EdgeType, ...], num_neighbors):
+  """Resolve ``num_neighbors`` (shared list or per-etype dict) into
+  ``(etypes, fanouts, num_hops)`` — etypes absent from a dict spec
+  don't participate.  Shared by the single-host and distributed hetero
+  samplers."""
+  if isinstance(num_neighbors, dict):
+    fanouts = {et: tuple(int(k) for k in num_neighbors[et])
+               for et in etypes if et in num_neighbors}
+    etypes = tuple(et for et in etypes if et in fanouts)
+  else:
+    fan = tuple(int(k) for k in num_neighbors)
+    fanouts = {et: fan for et in etypes}
+  num_hops = max((len(f) for f in fanouts.values()), default=0)
+  return etypes, fanouts, num_hops
+
+
 def _plan_capacities(
     etypes: Sequence[EdgeType],
     fanouts: Dict[EdgeType, Tuple[int, ...]],
@@ -200,16 +216,8 @@ class HeteroNeighborSampler(BaseSampler):
                num_nodes: Optional[Dict[NodeType, int]] = None,
                seed: int = 0):
     self.graphs = dict(graphs)
-    self.etypes = tuple(sorted(self.graphs.keys()))
-    if isinstance(num_neighbors, dict):
-      self.fanouts = {et: tuple(int(k) for k in num_neighbors[et])
-                      for et in self.etypes if et in num_neighbors}
-      # etypes absent from the dict don't participate.
-      self.etypes = tuple(et for et in self.etypes if et in self.fanouts)
-    else:
-      fan = tuple(int(k) for k in num_neighbors)
-      self.fanouts = {et: fan for et in self.etypes}
-    self.num_hops = max((len(f) for f in self.fanouts.values()), default=0)
+    self.etypes, self.fanouts, self.num_hops = normalize_fanouts(
+        tuple(sorted(self.graphs.keys())), num_neighbors)
     self.with_edge = with_edge
     self.device = device
     self._num_nodes = dict(num_nodes or {})

@@ -49,6 +49,7 @@ def test_bipartite_binary_negatives():
     unodes = np.asarray(batch.node_dict[U])
     inodes = np.asarray(batch.node_dict[I])
     assert eli.shape == (2, 16)
+    xu = np.asarray(batch.x_dict[U])
     for j in range(16):
       if not mask[j]:
         continue
@@ -59,9 +60,8 @@ def test_bipartite_binary_negatives():
         assert (u, v) in existing
       else:
         assert (u, v) not in existing
-    # features prove table identity: value == id
-    np.testing.assert_array_equal(
-        np.asarray(batch.x_dict[U])[eli[0, j], 0], float(u))
+      # features prove table identity: value == id
+      np.testing.assert_array_equal(xu[eli[0, j], 0], float(u))
   assert batches == 2
 
 
@@ -150,11 +150,12 @@ def test_num_nodes_forwarded_for_negative_space():
   rows = np.arange(nu)
   cols = rows % 8          # items 8..19 never clicked
   ufeat = np.ones((nu, 4), np.float32)
-  ifeat = np.ones((ni, 4), np.float32)
+  # deliberately NO item features: the count must come from the
+  # explicit init_graph num_nodes, not the feature store
   ds = (Dataset()
         .init_graph({ET: (rows, cols)}, layout='COO',
                     num_nodes={U: nu, I: ni})
-        .init_node_features({U: ufeat, I: ifeat}, split_ratio=1.0))
+        .init_node_features({U: ufeat}, split_ratio=1.0))
   loader = LinkNeighborLoader(
       ds, [2], (ET, (rows, cols)),
       neg_sampling=NegativeSampling('binary', 1.0), batch_size=10, seed=0)
