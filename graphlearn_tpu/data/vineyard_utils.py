@@ -1,0 +1,62 @@
+"""Vineyard (GraphScope) in-memory graph-store connectors — gated.
+
+Counterpart of reference `data/vineyard_utils.py:15-55` +
+`csrc/cpu/vineyard_utils.cc` (optional, behind ``WITH_VINEYARD``):
+read CSR topology and vertex/edge feature columns straight from a
+vineyard object store shared with GraphScope.
+
+Vineyard is not part of this image (and its client is Linux-x86
+specific); the API surface is kept so GraphScope deployments can drop
+in the real client — every function imports lazily and raises with
+guidance otherwise, exactly like the reference's build-time gate.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def _client():
+  try:
+    import vineyard  # noqa: F401
+    return vineyard
+  except ImportError as e:
+    raise ImportError(
+        'vineyard is not installed; these connectors need a GraphScope '
+        'deployment (pip install vineyard-graphlearn or use '
+        'CsvTableReader/NpzTableReader ingestion instead)') from e
+
+
+def vineyard_to_csr(sock: str, object_id: str, v_label: int, e_label: int,
+                    edge_dir: str = 'out'
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+  """CSR of one (vertex-label, edge-label) fragment
+  (reference ``vineyard_to_csr``, `py_export.cc:52-56`)."""
+  vy = _client()
+  client = vy.connect(sock)
+  frag = client.get(vy.ObjectID(object_id))
+  raise NotImplementedError(
+      f'wire the GraphScope fragment accessors for {type(frag)} here; '
+      'the TPU data plane consumes (indptr, indices, edge_ids) numpy '
+      'arrays via CSRTopo')
+
+
+def load_vertex_feature_from_vineyard(sock: str, object_id: str,
+                                      cols: List[str], v_label: int
+                                      ) -> np.ndarray:
+  """Vertex feature columns (reference ``LoadVertexFeatures``)."""
+  _client()
+  raise NotImplementedError(
+      'map the fragment vertex table columns to a [N, D] numpy array '
+      'and feed Dataset.init_node_features')
+
+
+def load_edge_feature_from_vineyard(sock: str, object_id: str,
+                                    cols: List[str], e_label: int
+                                    ) -> np.ndarray:
+  """Edge feature columns (reference ``LoadEdgeFeatures``)."""
+  _client()
+  raise NotImplementedError(
+      'map the fragment edge table columns to a [E, D] numpy array '
+      'and feed Dataset.init_edge_features')

@@ -13,12 +13,15 @@ from .dist_client import (DistClient, get_client, init_client,
                           shutdown_client)
 from .dist_context import (DistContext, DistRole, get_context,
                            init_worker_group)
-from .dist_loader import DistLoader, DistNeighborLoader
+from .dist_loader import (DistLinkNeighborLoader, DistLoader,
+                          DistNeighborLoader, DistSubGraphLoader)
 from .dist_options import (CollocatedDistSamplingWorkerOptions,
+                           HostSamplingConfig,
                            MpDistSamplingWorkerOptions,
                            RemoteDistSamplingWorkerOptions)
 from .dist_random_partitioner import (DistPartitionManager,
                                       DistRandomPartitioner, node_range)
+from .dist_table_dataset import DistTableRandomPartitioner
 from .dist_sampling_producer import (CollocatedSamplingProducer,
                                      MpSamplingProducer)
 from .dist_server import (DistServer, get_server, init_server,
@@ -28,7 +31,8 @@ from .host_sampler import HostNeighborSampler
 
 __all__ = [
     'DistContext', 'DistRole', 'get_context', 'init_worker_group',
-    'DistLoader', 'DistNeighborLoader',
+    'DistLoader', 'DistNeighborLoader', 'DistLinkNeighborLoader',
+    'DistSubGraphLoader', 'HostSamplingConfig',
     'CollocatedDistSamplingWorkerOptions', 'MpDistSamplingWorkerOptions',
     'RemoteDistSamplingWorkerOptions',
     'CollocatedSamplingProducer', 'MpSamplingProducer',
@@ -36,4 +40,5 @@ __all__ = [
     'DistClient', 'get_client', 'init_client', 'shutdown_client',
     'HostDataset', 'HostNeighborSampler',
     'DistPartitionManager', 'DistRandomPartitioner', 'node_range',
+    'DistTableRandomPartitioner',
 ]

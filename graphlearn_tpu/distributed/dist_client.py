@@ -97,17 +97,18 @@ class DistClient:
     return self.request_server(server_idx, 'get_dataset_meta')
 
   def _create_one(self, idx: int, opts, fanouts, batch_size, seeds,
-                  with_edge, shuffle, seed) -> RemoteProducerHandle:
+                  with_edge, shuffle, seed,
+                  sampling_config=None) -> RemoteProducerHandle:
     pid = self.request_server(
         idx, 'create_sampling_producer', opts, list(fanouts),
         int(batch_size), np.asarray(seeds), with_edge=with_edge,
-        shuffle=shuffle, seed=seed)
+        shuffle=shuffle, seed=seed, sampling_config=sampling_config)
     return RemoteProducerHandle(self, idx, pid)
 
   def create_sampling_producer(
       self, opts: RemoteDistSamplingWorkerOptions, fanouts,
       batch_size: int, seeds: np.ndarray, with_edge: bool = False,
-      shuffle: bool = False, seed: int = 0):
+      shuffle: bool = False, seed: int = 0, sampling_config=None):
     idx = opts.server_rank
     if idx is None:
       idx = self.rank % self.num_servers   # round-robin default
@@ -116,7 +117,8 @@ class DistClient:
         idx = idx[0]
       else:
         # fan out: split seeds batch-aligned across the listed servers
-        seeds = np.asarray(seeds).reshape(-1)
+        # (axis 0: rows are edge pairs in link mode)
+        seeds = np.asarray(seeds)
         n_batches = (len(seeds) + batch_size - 1) // batch_size
         per = ((n_batches + len(idx) - 1) // len(idx)) * batch_size
         handles = []
@@ -125,10 +127,10 @@ class DistClient:
           if len(sl):
             handles.append(self._create_one(
                 sidx, opts, fanouts, batch_size, sl, with_edge,
-                shuffle, seed + j))
+                shuffle, seed + j, sampling_config))
         return MultiProducerHandle(handles)
     return self._create_one(idx, opts, fanouts, batch_size, seeds,
-                            with_edge, shuffle, seed)
+                            with_edge, shuffle, seed, sampling_config)
 
   def shutdown(self, notify_servers: bool = True) -> None:
     """Client-0 asks every server to exit

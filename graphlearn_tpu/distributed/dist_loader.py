@@ -17,9 +17,11 @@ import numpy as np
 from ..channel import (ChannelBase, MpChannel, RemoteReceivingChannel,
                        SampleMessage, ShmChannel)
 from ..loader.transform import Batch
-from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
+from ..utils.padding import (INVALID_ID, max_sampled_nodes,
+                             next_power_of_two, round_up)
 from ..utils.profiling import metrics, trace
 from .dist_options import (CollocatedDistSamplingWorkerOptions,
+                           HostSamplingConfig,
                            MpDistSamplingWorkerOptions,
                            RemoteDistSamplingWorkerOptions)
 from .dist_sampling_producer import (CollocatedSamplingProducer,
@@ -57,23 +59,30 @@ class DistLoader:
                drop_last: bool = False,
                worker_options: Optional[WorkerOptions] = None,
                with_edge: bool = False, to_device: bool = True,
-               seed: int = 0):
+               seed: int = 0, sampling_config=None):
     self.fanouts = [int(k) for k in num_neighbors]
     self.batch_size = int(batch_size)
-    self.seeds = np.asarray(input_nodes).reshape(-1)
+    seeds = np.asarray(input_nodes)
+    self.seeds = seeds if seeds.ndim > 1 else seeds.reshape(-1)
     self.shuffle = shuffle
     self.drop_last = drop_last
     self.with_edge = with_edge
     self.to_device = to_device
     self.opts = worker_options or CollocatedDistSamplingWorkerOptions()
+    self.sampling_config = sampling_config
     self._epoch_iter = None
     self._expected = 0
     self._received = 0
+    # link/subgraph modes feed more node seeds into expansion per
+    # seed-batch slot (endpoints + negatives)
+    exp_seeds = (sampling_config.expansion_seeds(self.batch_size)
+                 if sampling_config is not None else self.batch_size)
     self.node_cap = round_up(
-        min(max_sampled_nodes(self.batch_size, self.fanouts),
-            self.batch_size + (dataset.num_nodes if dataset else 1 << 30)),
+        min(max_sampled_nodes(exp_seeds, self.fanouts),
+            exp_seeds + (dataset.num_nodes if dataset else 1 << 30)),
         8)
-    self.edge_cap = edge_capacity(self.batch_size, self.fanouts)
+    self.edge_cap = edge_capacity(exp_seeds, self.fanouts)
+    self.batch_cap = exp_seeds
 
     self.channel: Optional[ChannelBase] = None
     self._producer = None
@@ -82,7 +91,8 @@ class DistLoader:
                                 self.opts.resolved_size())
       self._producer = MpSamplingProducer(
           dataset, self.fanouts, self.batch_size, self.channel,
-          self.opts, with_edge=with_edge, shuffle=shuffle, seed=seed)
+          self.opts, with_edge=with_edge, shuffle=shuffle, seed=seed,
+          sampling_config=sampling_config)
       self._producer.init()
     elif isinstance(self.opts, RemoteDistSamplingWorkerOptions):
       from .dist_client import get_client
@@ -91,7 +101,8 @@ class DistLoader:
           'init_client() before RemoteDistSamplingWorkerOptions loaders')
       self._remote = client.create_sampling_producer(
           self.opts, self.fanouts, self.batch_size, self.seeds,
-          with_edge=with_edge, shuffle=shuffle, seed=seed)
+          with_edge=with_edge, shuffle=shuffle, seed=seed,
+          sampling_config=sampling_config)
       self.channel = RemoteReceivingChannel(
           self._remote.fetch, self._num_batches(),
           self.opts.prefetch_size)
@@ -99,7 +110,7 @@ class DistLoader:
       self._producer = CollocatedSamplingProducer(
           dataset, self.fanouts, self.batch_size, with_edge=with_edge,
           collect_features=self.opts.collect_features, shuffle=shuffle,
-          seed=seed)
+          seed=seed, sampling_config=sampling_config)
 
   def _num_batches(self) -> int:
     n = len(self.seeds)
@@ -162,6 +173,10 @@ class DistLoader:
     node = np.full(nc, INVALID_ID, np.int32)
     node[:c] = ids
     e = len(msg['rows'])
+    if e > ec:
+      # induced-subgraph messages can exceed the sampled-tree bound;
+      # grow in power-of-two buckets so consumers see few shapes
+      ec = next_power_of_two(e)
     edge_index = np.full((2, ec), INVALID_ID, np.int32)
     edge_index[0, :e] = msg['rows']
     edge_index[1, :e] = msg['cols']
@@ -176,17 +191,56 @@ class DistLoader:
     if 'eids' in msg:
       edge = np.full(ec, INVALID_ID, np.int64)
       edge[:e] = msg['eids']
-    batch = np.full(self.batch_size, INVALID_ID, np.int64)
+    batch = np.full(self.batch_cap, INVALID_ID, np.int64)
     batch[:len(msg['batch'])] = msg['batch']
     out = Batch(
         x=x, y=y, edge_index=edge_index, node=node,
         node_mask=node >= 0, edge_mask=edge_index[0] >= 0, edge=edge,
         batch=batch, batch_size=self.batch_size,
         num_sampled_nodes=msg.get('num_sampled_nodes'),
-        metadata={'seed_local': msg.get('seed_local')})
+        metadata=self._collate_metadata(msg))
     if self.to_device:
       out = jax.device_put(out)
     return out
+
+  def _collate_metadata(self, msg: SampleMessage) -> dict:
+    """Lift ``#META.*`` keys into batch metadata, statically padded so
+    tail batches reuse the same compiled programs (the link/subgraph
+    label contracts of reference `dist_loader.py:286-383`)."""
+    md = {'seed_local': msg.get('seed_local')}
+    cfg = self.sampling_config
+    bs = self.batch_size
+    for k, v in msg.items():
+      if not k.startswith('#META.'):
+        continue
+      name = k[len('#META.'):]
+      if name == 'edge_label_index':
+        cap = bs + (int(np.ceil(bs * cfg.neg_amount))
+                    if cfg and cfg.neg_mode == 'binary' else 0)
+        out = np.full((2, cap), INVALID_ID, np.int64)
+        out[:, :v.shape[1]] = v
+        md[name] = out
+        md['edge_label_mask'] = np.arange(cap) < v.shape[1]
+      elif name == 'edge_label':
+        cap = bs + (int(np.ceil(bs * cfg.neg_amount))
+                    if cfg and cfg.neg_mode == 'binary' else 0)
+        out = np.zeros(cap, v.dtype)
+        out[:len(v)] = v
+        md[name] = out
+      elif name in ('src_index', 'dst_pos_index', 'mapping'):
+        out = np.full(bs, INVALID_ID, np.int64)
+        out[:len(v)] = v
+        md[name] = out
+        if name == 'src_index':
+          md['pair_mask'] = np.arange(bs) < len(v)
+      elif name == 'dst_neg_index':
+        amount = v.shape[1]
+        out = np.full((bs, amount), INVALID_ID, np.int64)
+        out[:len(v)] = v
+        md[name] = out
+      else:
+        md[name] = v
+    return md
 
   def shutdown(self) -> None:
     if self._producer is not None and hasattr(self._producer, 'shutdown'):
@@ -206,3 +260,60 @@ class DistLoader:
 class DistNeighborLoader(DistLoader):
   """Node-wise distributed loader (reference
   `distributed/dist_neighbor_loader.py:27-94`)."""
+
+
+class DistLinkNeighborLoader(DistLoader):
+  """Link-prediction distributed loader (reference
+  `distributed/dist_link_neighbor_loader.py:30-153`): seed edges +
+  negatives sampled in the producers, link-label metadata
+  (``edge_label_index``/``edge_label`` or triplet indices) collated
+  statically padded.
+
+  Args:
+    edge_label_index: ``[2, E]`` (or ``(rows, cols)``) seed edges.
+    edge_label: optional integer labels (binary mode applies the
+      reference's +1 shift: 0 becomes the negative class).
+    neg_sampling: ``'binary'`` / ``'triplet'`` or
+      ``(mode, amount)``.
+  """
+
+  def __init__(self, dataset, num_neighbors, edge_label_index,
+               edge_label=None, neg_sampling=None, **kwargs):
+    if isinstance(edge_label_index, (tuple, list)):
+      rows, cols = edge_label_index
+    else:
+      ei = np.asarray(edge_label_index)
+      rows, cols = ei[0], ei[1]
+    mode, amount = None, 1.0
+    if neg_sampling is not None:
+      if isinstance(neg_sampling, (tuple, list)):
+        mode, amount = neg_sampling[0], float(neg_sampling[1])
+      elif isinstance(neg_sampling, str):
+        mode = neg_sampling
+      else:  # NegativeSampling-like
+        mode = neg_sampling.mode
+        amount = float(neg_sampling.amount)
+    cols_arr = [np.asarray(rows, np.int64), np.asarray(cols, np.int64)]
+    if edge_label is not None:
+      lab = np.asarray(edge_label, np.int64)
+      if mode == 'binary':
+        lab = lab + 1     # reference +1 shift (`link_loader.py:146-186`)
+      cols_arr.append(lab)
+    seeds = np.stack(cols_arr, axis=1)
+    cfg = HostSamplingConfig(sampling_type='link', neg_mode=mode,
+                             neg_amount=amount)
+    super().__init__(dataset, num_neighbors, seeds,
+                     sampling_config=cfg, **kwargs)
+
+
+class DistSubGraphLoader(DistLoader):
+  """Induced-subgraph distributed loader (reference
+  `distributed/dist_subgraph_loader.py:28-89`): each batch message is
+  the enclosing subgraph of its seed set, with ``mapping`` locating
+  the seeds in the node table (SEAL-style)."""
+
+  def __init__(self, dataset, num_neighbors, input_nodes, **kwargs):
+    super().__init__(dataset, num_neighbors, input_nodes,
+                     sampling_config=HostSamplingConfig(
+                         sampling_type='subgraph'),
+                     **kwargs)

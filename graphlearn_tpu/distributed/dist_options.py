@@ -14,6 +14,40 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional, Union
 
+import numpy as np
+
+
+@dataclass
+class HostSamplingConfig:
+  """What the producers sample per seed batch (reference
+  ``SamplingConfig``, `sampler/base.py:334-346`: the NODE/LINK/SUBGRAPH
+  dispatch carried into sampling workers).
+
+  Attributes:
+    sampling_type: ``'node'`` (seed ids), ``'link'`` (seed edge pairs,
+      optional third label column), or ``'subgraph'`` (induced
+      enclosing subgraphs).
+    neg_mode / neg_amount: link-mode negative sampling spec.
+  """
+  sampling_type: str = 'node'
+  neg_mode: Optional[str] = None       # 'binary' | 'triplet'
+  neg_amount: float = 1.0
+
+  def expansion_seeds(self, batch_size: int) -> int:
+    """EXACT number of node seeds entering multi-hop expansion for a
+    full seed batch — must match ``HostNeighborSampler``'s seed
+    construction exactly (a float factor rounds differently when
+    ``batch_size * neg_amount`` is fractional and undersizes the
+    loader's static capacities)."""
+    b = int(batch_size)
+    if self.sampling_type != 'link':
+      return b
+    if self.neg_mode == 'binary':
+      return 2 * b + 2 * int(np.ceil(b * self.neg_amount))
+    if self.neg_mode == 'triplet':
+      return 2 * b + b * int(np.ceil(self.neg_amount))
+    return 2 * b
+
 
 @dataclass
 class CollocatedDistSamplingWorkerOptions:

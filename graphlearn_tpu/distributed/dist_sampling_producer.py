@@ -28,8 +28,26 @@ class MpCommand(enum.Enum):
   STOP = 1
 
 
+def _dispatch_sample(sampler: HostNeighborSampler, cfg, seeds_slice,
+                     batch_seed: int):
+  """NODE/LINK/SUBGRAPH dispatch (reference `SamplingType` switch in
+  `_sampling_worker_loop`, `dist_sampling_producer.py:110-135`)."""
+  if cfg is None or cfg.sampling_type == 'node':
+    return sampler.sample_from_nodes(seeds_slice, batch_seed=batch_seed)
+  if cfg.sampling_type == 'link':
+    label = seeds_slice[:, 2] if seeds_slice.shape[1] > 2 else None
+    return sampler.sample_from_edges(
+        seeds_slice[:, 0], seeds_slice[:, 1], label=label,
+        neg_mode=cfg.neg_mode, neg_amount=cfg.neg_amount,
+        batch_seed=batch_seed)
+  if cfg.sampling_type == 'subgraph':
+    return sampler.sample_subgraph(seeds_slice, batch_seed=batch_seed)
+  raise ValueError(f'unknown sampling_type {cfg.sampling_type!r}')
+
+
 def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
-                          collect_features, channel, task_queue, seed):
+                          collect_features, channel, task_queue, seed,
+                          sampling_config=None):
   """Body of one sampling subprocess (reference `_sampling_worker_loop`,
   `dist_sampling_producer.py:52-144`)."""
   sampler = HostNeighborSampler(
@@ -44,8 +62,8 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
       break
     seeds, batch_size, epoch = payload
     for lo in range(0, len(seeds), batch_size):
-      msg = sampler.sample_from_nodes(
-          seeds[lo:lo + batch_size],
+      msg = _dispatch_sample(
+          sampler, sampling_config, seeds[lo:lo + batch_size],
           batch_seed=(epoch * 1000003 + rank) * 131071 + lo)
       # Epoch stamp lets consumers discard stale messages after an
       # early-terminated epoch (see `DistLoader._recv_current_epoch`).
@@ -65,7 +83,7 @@ class MpSamplingProducer:
                batch_size: int, channel: ChannelBase,
                options: Optional[MpDistSamplingWorkerOptions] = None,
                with_edge: bool = False, shuffle: bool = False,
-               seed: int = 0):
+               seed: int = 0, sampling_config=None):
     self.opts = options or MpDistSamplingWorkerOptions()
     self.ds = dataset
     self.fanouts = list(num_neighbors)
@@ -73,6 +91,7 @@ class MpSamplingProducer:
     self.channel = channel
     self.with_edge = with_edge
     self.shuffle = shuffle
+    self.sampling_config = sampling_config
     self._rng = np.random.default_rng(seed)
     self._seed = seed
     self._epoch = 0
@@ -87,7 +106,8 @@ class MpSamplingProducer:
       w = self._ctx.Process(
           target=_sampling_worker_loop,
           args=(r, self.ds, self.fanouts, self.with_edge,
-                self.opts.collect_features, self.channel, tq, self._seed),
+                self.opts.collect_features, self.channel, tq, self._seed,
+                self.sampling_config),
           daemon=True)
       w.start()
       self._task_queues.append(tq)
@@ -99,8 +119,12 @@ class MpSamplingProducer:
   def produce_all(self, seeds: np.ndarray, drop_last: bool = False) -> int:
     """Dispatch one epoch; returns the number of messages to expect.
     ``drop_last`` truncates *after* the shuffle, so the dropped
-    remainder differs per epoch (torch DataLoader semantics)."""
-    seeds = np.asarray(seeds).reshape(-1)
+    remainder differs per epoch (torch DataLoader semantics).
+    ``seeds`` is ``[E]`` node ids, or ``[E, 2|3]`` edge pairs
+    (+labels) in link mode — shuffling/slicing is along axis 0."""
+    seeds = np.asarray(seeds)
+    if seeds.ndim == 1:
+      seeds = seeds.reshape(-1)
     if self.shuffle:
       seeds = self._rng.permutation(seeds)
     if drop_last:
@@ -139,19 +163,24 @@ class CollocatedSamplingProducer:
   def __init__(self, dataset: HostDataset, num_neighbors: Sequence[int],
                batch_size: int, with_edge: bool = False,
                collect_features: bool = True, shuffle: bool = False,
-               seed: int = 0):
+               seed: int = 0, sampling_config=None):
     self.sampler = HostNeighborSampler(
         dataset, num_neighbors, with_edge=with_edge,
         collect_features=collect_features, seed=seed)
     self.batch_size = int(batch_size)
     self.shuffle = shuffle
+    self.sampling_config = sampling_config
     self._rng = np.random.default_rng(seed)
 
   def epoch(self, seeds: np.ndarray, drop_last: bool = False):
-    seeds = np.asarray(seeds).reshape(-1)
+    seeds = np.asarray(seeds)
+    if seeds.ndim == 1:
+      seeds = seeds.reshape(-1)
     if self.shuffle:
       seeds = self._rng.permutation(seeds)
     if drop_last:
       seeds = seeds[:(len(seeds) // self.batch_size) * self.batch_size]
     for lo in range(0, len(seeds), self.batch_size):
-      yield self.sampler.sample_from_nodes(seeds[lo:lo + self.batch_size])
+      yield _dispatch_sample(self.sampler, self.sampling_config,
+                             seeds[lo:lo + self.batch_size],
+                             batch_seed=None)

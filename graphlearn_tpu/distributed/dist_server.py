@@ -49,7 +49,8 @@ class DistServer:
   def create_sampling_producer(self, opts: RemoteDistSamplingWorkerOptions,
                                fanouts, batch_size: int, seeds,
                                with_edge: bool = False,
-                               shuffle: bool = False, seed: int = 0) -> int:
+                               shuffle: bool = False, seed: int = 0,
+                               sampling_config=None) -> int:
     """Build a producer + buffer for one client loader
     (reference `dist_server.py:83-116`)."""
     channel = ShmChannel(opts.buffer_capacity, opts.buffer_size)
@@ -59,14 +60,16 @@ class DistServer:
         collect_features=opts.collect_features)
     producer = MpSamplingProducer(
         self.dataset, fanouts, batch_size, channel, mp_opts,
-        with_edge=with_edge, shuffle=shuffle, seed=seed)
+        with_edge=with_edge, shuffle=shuffle, seed=seed,
+        sampling_config=sampling_config)
     producer.init()
+    seeds = np.asarray(seeds)
     with self._lock:
       pid = self._next_id
       self._next_id += 1
       self._producers[pid] = producer
       self._channels[pid] = channel
-      self._seeds[pid] = np.asarray(seeds).reshape(-1)
+      self._seeds[pid] = seeds if seeds.ndim > 1 else seeds.reshape(-1)
     return pid
 
   def start_new_epoch_sampling(self, producer_id: int,

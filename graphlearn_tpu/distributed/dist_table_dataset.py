@@ -1,0 +1,93 @@
+"""Table-fed distributed partitioning.
+
+Counterpart of reference `distributed/dist_table_dataset.py:38-360`
+(``DistTableRandomPartitioner`` / ``DistTableDataset``): each rank
+streams ITS slice of the input tables (ODPS there; any `TableReader`
+here — csv/npz/ODPS share the record formats) and the cluster runs the
+cooperative partitioning pipeline of `DistRandomPartitioner`, writing
+the standard on-disk layout.
+
+Usage (every rank)::
+
+    p = DistTableRandomPartitioner(
+        out_dir, num_nodes,
+        edge_table=f'edges_rank{r}.csv',      # this rank's edge slice
+        node_table=f'nodes_rank{r}.csv',      # this rank's node range
+        edge_id_offset=my_first_global_edge_id,
+        rank=r, world_size=W, master_addr=..., master_port=...)
+    p.partition()
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..data.table_dataset import TableLike, read_edge_table, read_node_table
+from .dist_random_partitioner import DistRandomPartitioner, node_range
+
+
+class DistTableRandomPartitioner(DistRandomPartitioner):
+  """`DistRandomPartitioner` whose inputs stream from tables.
+
+  Args:
+    edge_table: this rank's edge records (``src, dst``).
+    node_table: this rank's node records (``id, "f0:f1:..."``) — ids
+      must cover exactly this rank's node range
+      ``node_range(rank, world_size, num_nodes)``.
+    label_table: optional ``(id, label)`` records for the same range.
+    (remaining args as `DistRandomPartitioner`)
+  """
+
+  def __init__(self, output_dir, num_nodes: int,
+               edge_table: TableLike,
+               node_table: Optional[TableLike] = None,
+               label_table: Optional[TableLike] = None,
+               reader_batch_size: int = 65536, **kwargs):
+    rows, cols = read_edge_table(edge_table, reader_batch_size)
+    rank = kwargs.get('rank')
+    world_size = kwargs.get('world_size')
+    lo, hi = node_range(rank, world_size, num_nodes)
+    node_feat = None
+    if node_table is not None:
+      # records arrive keyed by GLOBAL id within [lo, hi); rebase
+      node_feat = _read_ranged_node_table(node_table, lo, hi,
+                                          reader_batch_size)
+    node_label = None
+    if label_table is not None:
+      from ..data.table_dataset import _as_reader
+      ids, labs = [], []
+      for batch in _as_reader(label_table).batches(reader_batch_size):
+        ids.extend(int(r[0]) for r in batch)
+        labs.extend(int(r[1]) for r in batch)
+      idx = np.asarray(ids, np.int64)
+      if len(idx) and (idx.min() < lo or idx.max() >= hi):
+        raise ValueError(
+            f'label table ids must lie in this rank\'s range '
+            f'[{lo}, {hi}); got [{idx.min()}, {idx.max()}]')
+      node_label = np.zeros(hi - lo, np.int64)
+      node_label[idx - lo] = labs
+    super().__init__(output_dir, num_nodes, (rows, cols),
+                     node_feat, node_label, **kwargs)
+
+
+def _read_ranged_node_table(table: TableLike, lo: int, hi: int,
+                            batch_size: int) -> np.ndarray:
+  """Node records with global ids in ``[lo, hi)`` -> ``[hi-lo, D]``."""
+  from ..data.table_dataset import _as_reader, _decode_feat
+  ids, feats = [], []
+  for batch in _as_reader(table).batches(batch_size):
+    ids.extend(int(r[0]) for r in batch)
+    feats.extend(_decode_feat(r[1]) for r in batch)
+  arr = np.asarray(feats, dtype=np.float32)
+  idx = np.asarray(ids, dtype=np.int64)
+  uniq = np.unique(idx)
+  if (len(uniq) != hi - lo or (len(uniq) and
+                               (uniq[0] != lo or uniq[-1] != hi - 1))):
+    raise ValueError(
+        f'node table must cover ids [{lo}, {hi}) exactly once; got '
+        f'{len(idx)} records ({len(uniq)} unique) in '
+        f'[{idx.min(initial=-1)}, {idx.max(initial=-1)}]')
+  out = np.empty_like(arr)
+  out[idx - lo] = arr
+  return out

@@ -39,13 +39,15 @@ class HostNeighborSampler:
     self._seed = int(seed)
     self._batch_idx = 0
 
-  def sample_from_nodes(self, seeds: np.ndarray,
-                        batch_seed: Optional[int] = None) -> SampleMessage:
-    """One ragged mini-batch message for ``seeds``."""
-    seeds = np.ascontiguousarray(seeds, np.int64)
+  def _next_batch_seed(self, batch_seed: Optional[int]) -> int:
     if batch_seed is None:
       batch_seed = self._seed + self._batch_idx
       self._batch_idx += 1
+    return batch_seed
+
+  def _expand(self, seeds: np.ndarray, batch_seed: int):
+    """Multi-hop expansion shared by node/link/subgraph modes; returns
+    ``(inducer, seed_local, rows, cols, eids, num_sampled)``."""
     ind = native.CpuInducer(capacity_hint=max(len(seeds) * 4, 64))
     seed_local = ind.init_nodes(seeds)
     frontier = ind.all_nodes()
@@ -67,23 +69,140 @@ class HostNeighborSampler:
       frontier = new_nodes
       if len(frontier) == 0:
         break
+    rows = (np.concatenate(rows_acc) if rows_acc else np.empty(0, np.int32))
+    cols = (np.concatenate(cols_acc) if cols_acc else np.empty(0, np.int32))
+    eids = (np.concatenate(eids_acc) if (self.with_edge and eids_acc)
+            else None)
+    return ind, seed_local, rows, cols, eids, num_sampled
+
+  def _finish(self, seeds, ind, seed_local, rows, cols, eids,
+              num_sampled) -> SampleMessage:
     nodes = ind.all_nodes()
     msg: SampleMessage = {
         '#IS_HETERO': np.uint8(0),
         'ids': nodes,
-        'rows': np.concatenate(rows_acc) if rows_acc else
-                np.empty(0, np.int32),
-        'cols': np.concatenate(cols_acc) if cols_acc else
-                np.empty(0, np.int32),
-        'batch': seeds,
+        'rows': rows,
+        'cols': cols,
+        'batch': np.ascontiguousarray(seeds, np.int64),
         'seed_local': seed_local,
         'num_sampled_nodes': np.asarray(num_sampled, np.int32),
     }
-    if self.with_edge:
-      msg['eids'] = (np.concatenate(eids_acc) if eids_acc else
-                     np.empty(0, np.int64))
+    if eids is not None:
+      msg['eids'] = eids
     if self.collect_features and self.ds.node_features is not None:
       msg['nfeats'] = np.ascontiguousarray(self.ds.node_features[nodes])
     if self.ds.node_labels is not None:
       msg['nlabels'] = np.ascontiguousarray(self.ds.node_labels[nodes])
+    return msg
+
+  def sample_from_nodes(self, seeds: np.ndarray,
+                        batch_seed: Optional[int] = None) -> SampleMessage:
+    """One ragged mini-batch message for ``seeds``."""
+    seeds = np.ascontiguousarray(seeds, np.int64)
+    batch_seed = self._next_batch_seed(batch_seed)
+    out = self._expand(seeds, batch_seed)
+    return self._finish(seeds, *out)
+
+  # -- link mode (reference `DistNeighborSampler._sample_from_edges`,
+  # `dist_neighbor_sampler.py:327-453`) -----------------------------------
+  def sample_from_edges(self, src: np.ndarray, dst: np.ndarray,
+                        label: Optional[np.ndarray] = None,
+                        neg_mode: Optional[str] = None,
+                        neg_amount: float = 1.0,
+                        batch_seed: Optional[int] = None) -> SampleMessage:
+    """Link-prediction message: endpoints + negatives expanded, with
+    PyG link-label metadata under ``#META.*`` keys."""
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    b = len(src)
+    batch_seed = self._next_batch_seed(batch_seed)
+    if neg_mode == 'binary':
+      num_neg = int(np.ceil(b * neg_amount))
+      nrows, ncols = native.negative_sample(
+          self.ds.indptr, self.ds.indices, num_neg, strict=True,
+          padding=True, seed=batch_seed * 31 + 7)
+      seeds = np.concatenate([src, dst, nrows, ncols])
+    elif neg_mode == 'triplet':
+      amount = int(np.ceil(neg_amount))
+      neg_dst = self._triplet_neg(src, amount, batch_seed)
+      seeds = np.concatenate([src, dst, neg_dst.reshape(-1)])
+    else:
+      seeds = np.concatenate([src, dst])
+    msg = self._finish(seeds, *self._expand(seeds, batch_seed))
+    sl = msg['seed_local']
+    pos_label = (np.ascontiguousarray(label, np.int64)
+                 if label is not None else np.ones(b, np.int64))
+    if neg_mode == 'binary':
+      msg['#META.edge_label_index'] = np.stack([
+          np.concatenate([sl[:b], sl[2 * b:2 * b + num_neg]]),
+          np.concatenate([sl[b:2 * b], sl[2 * b + num_neg:]]),
+      ]).astype(np.int64)
+      msg['#META.edge_label'] = np.concatenate(
+          [pos_label, np.zeros(num_neg, np.int64)])
+    elif neg_mode == 'triplet':
+      amount = int(np.ceil(neg_amount))
+      msg['#META.src_index'] = sl[:b]
+      msg['#META.dst_pos_index'] = sl[b:2 * b]
+      msg['#META.dst_neg_index'] = sl[2 * b:].reshape(b, amount)
+    else:
+      msg['#META.edge_label_index'] = np.stack(
+          [sl[:b], sl[b:2 * b]]).astype(np.int64)
+      msg['#META.edge_label'] = pos_label
+    return msg
+
+  def _triplet_neg(self, src: np.ndarray, amount: int,
+                   batch_seed: int, trials: int = 5) -> np.ndarray:
+    """Per-source strict negative destinations (host rejection via
+    adjacency sets — native CSR columns are unsorted)."""
+    rng = np.random.default_rng(batch_seed)
+    indptr, indices = self.ds.indptr, self.ds.indices
+    n = self.ds.num_nodes
+    out = np.empty((len(src), amount), np.int64)
+    for i, u in enumerate(src):
+      adj = set(indices[indptr[u]:indptr[u + 1]].tolist())
+      for a in range(amount):
+        c = int(rng.integers(0, n))
+        for _ in range(trials - 1):
+          if c not in adj:
+            break
+          c = int(rng.integers(0, n))
+        out[i, a] = c
+    return out
+
+  # -- subgraph mode (reference `DistNeighborSampler._subgraph`,
+  # `dist_neighbor_sampler.py:456-516`) -----------------------------------
+  def sample_subgraph(self, seeds: np.ndarray,
+                      batch_seed: Optional[int] = None) -> SampleMessage:
+    """Multi-hop closure, then ALL edges among the collected nodes
+    (relabeled local COO) — the SEAL enclosing-subgraph message."""
+    seeds = np.ascontiguousarray(seeds, np.int64)
+    batch_seed = self._next_batch_seed(batch_seed)
+    ind, seed_local, _r, _c, _e, num_sampled = self._expand(
+        seeds, batch_seed)
+    nodes = ind.all_nodes()
+    # membership + relabel over the closure set: one vectorized pass
+    # (a per-node loop here would dominate the producer hot path at
+    # SEAL closure sizes)
+    order = np.argsort(nodes)
+    snodes = nodes[order]
+    indptr, indices = self.ds.indptr, self.ds.indices
+    starts = indptr[nodes]
+    degs = indptr[nodes + 1] - starts
+    total = int(degs.sum())
+    # flat positions of every closure node's out-edges in `indices`
+    off = np.repeat(np.cumsum(degs) - degs, degs)
+    flat = (np.arange(total) - off
+            + np.repeat(starts, degs)) if total else np.empty(0, np.int64)
+    src_l = np.repeat(np.arange(len(nodes), dtype=np.int64), degs)
+    nb = indices[flat]
+    pos = np.clip(np.searchsorted(snodes, nb), 0, max(len(snodes) - 1, 0))
+    keep = (snodes[pos] == nb) if len(snodes) else np.zeros(0, bool)
+    rows = src_l[keep]
+    cols = order[pos[keep]]
+    eids = (self.ds.edge_ids[flat][keep]
+            if (self.with_edge and self.ds.edge_ids is not None)
+            else None)
+    msg = self._finish(seeds, ind, seed_local, rows, cols, eids,
+                       num_sampled)
+    msg['#META.mapping'] = seed_local
     return msg

@@ -116,3 +116,49 @@ def test_matches_seeded_book(tmp_path):
     p.join(timeout=120)
     assert p.exitcode == 0
   np.testing.assert_array_equal(np.load(tmp_path / 'node_pb.npy'), expect)
+
+
+def _table_rank_main(rank, world, port, out_dir, n):
+  from graphlearn_tpu.distributed import DistTableRandomPartitioner
+  from graphlearn_tpu.distributed.dist_random_partitioner import node_range
+  import tempfile, os
+  rows, cols = _ring(n)
+  lo, hi = node_range(rank, world, n)
+  sel = (rows >= lo) & (rows < hi)
+  offset = int(np.nonzero(sel)[0][0]) if sel.any() else 0
+  d = tempfile.mkdtemp()
+  with open(os.path.join(d, 'e.csv'), 'w') as f:
+    for r, c in zip(rows[sel], cols[sel]):
+      f.write(f'{r},{c}\n')
+  with open(os.path.join(d, 'n.csv'), 'w') as f:
+    for i in range(lo, hi):
+      f.write(f'{i},{float(i)}:{float(i)}\n')
+  p = DistTableRandomPartitioner(
+      out_dir, n, edge_table=os.path.join(d, 'e.csv'),
+      node_table=os.path.join(d, 'n.csv'),
+      rank=rank, world_size=world, master_port=port,
+      edge_id_offset=offset, seed=5)
+  p.partition()
+
+
+def test_dist_table_partitioner(tmp_path):
+  n, world = 40, 2
+  port = _free_port()
+  ctx = mp.get_context('fork')
+  procs = [ctx.Process(target=_table_rank_main,
+                       args=(r, world, port, str(tmp_path), n))
+           for r in range(world)]
+  for p in procs:
+    p.start()
+  for p in procs:
+    p.join(timeout=120)
+    assert p.exitcode == 0
+  pb = np.load(tmp_path / 'node_pb.npy')
+  nids = []
+  for i in range(world):
+    part = load_partition(tmp_path, i)
+    f = part['node_feat']
+    np.testing.assert_array_equal(pb[f.ids], i)
+    np.testing.assert_array_equal(f.feats[:, 0], f.ids.astype(np.float32))
+    nids.append(f.ids)
+  np.testing.assert_array_equal(np.sort(np.concatenate(nids)), np.arange(n))
