@@ -215,15 +215,13 @@ class DistLoader:
         continue
       name = k[len('#META.'):]
       if name == 'edge_label_index':
-        cap = bs + (int(np.ceil(bs * cfg.neg_amount))
-                    if cfg and cfg.neg_mode == 'binary' else 0)
+        cap = cfg.label_cap(bs) if cfg else bs
         out = np.full((2, cap), INVALID_ID, np.int64)
         out[:, :v.shape[1]] = v
         md[name] = out
         md['edge_label_mask'] = np.arange(cap) < v.shape[1]
       elif name == 'edge_label':
-        cap = bs + (int(np.ceil(bs * cfg.neg_amount))
-                    if cfg and cfg.neg_mode == 'binary' else 0)
+        cap = cfg.label_cap(bs) if cfg else bs
         out = np.zeros(cap, v.dtype)
         out[:len(v)] = v
         md[name] = out

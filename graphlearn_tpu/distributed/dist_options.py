@@ -17,6 +17,14 @@ from typing import List, Optional, Union
 import numpy as np
 
 
+def binary_num_negatives(batch: int, amount: float) -> int:
+  """Binary-mode negative count for a ``batch``-edge seed slice — the
+  ONE definition shared by the sampler, the capacity plan and the
+  metadata collation (a rounding mismatch between them undersizes
+  static buffers; it happened once)."""
+  return int(np.ceil(batch * amount))
+
+
 @dataclass
 class HostSamplingConfig:
   """What the producers sample per seed batch (reference
@@ -35,18 +43,23 @@ class HostSamplingConfig:
 
   def expansion_seeds(self, batch_size: int) -> int:
     """EXACT number of node seeds entering multi-hop expansion for a
-    full seed batch — must match ``HostNeighborSampler``'s seed
-    construction exactly (a float factor rounds differently when
-    ``batch_size * neg_amount`` is fractional and undersizes the
-    loader's static capacities)."""
+    full seed batch — matches ``HostNeighborSampler``'s seed
+    construction via :func:`binary_num_negatives`."""
     b = int(batch_size)
     if self.sampling_type != 'link':
       return b
     if self.neg_mode == 'binary':
-      return 2 * b + 2 * int(np.ceil(b * self.neg_amount))
+      return 2 * b + 2 * binary_num_negatives(b, self.neg_amount)
     if self.neg_mode == 'triplet':
       return 2 * b + b * int(np.ceil(self.neg_amount))
     return 2 * b
+
+  def label_cap(self, batch_size: int) -> int:
+    """Static width of ``edge_label_index`` / ``edge_label``."""
+    b = int(batch_size)
+    if self.neg_mode == 'binary':
+      return b + binary_num_negatives(b, self.neg_amount)
+    return b
 
 
 @dataclass

@@ -82,12 +82,12 @@ def _read_ranged_node_table(table: TableLike, lo: int, hi: int,
   arr = np.asarray(feats, dtype=np.float32)
   idx = np.asarray(ids, dtype=np.int64)
   uniq = np.unique(idx)
-  if (len(uniq) != hi - lo or (len(uniq) and
-                               (uniq[0] != lo or uniq[-1] != hi - 1))):
+  if (len(idx) != hi - lo or len(uniq) != hi - lo
+      or (len(uniq) and (uniq[0] != lo or uniq[-1] != hi - 1))):
+    lohi = (f'[{idx.min()}, {idx.max()}]' if len(idx) else '[]')
     raise ValueError(
         f'node table must cover ids [{lo}, {hi}) exactly once; got '
-        f'{len(idx)} records ({len(uniq)} unique) in '
-        f'[{idx.min(initial=-1)}, {idx.max(initial=-1)}]')
-  out = np.empty_like(arr)
+        f'{len(idx)} records ({len(uniq)} unique) in {lohi}')
+  out = np.empty((hi - lo,) + arr.shape[1:], arr.dtype)
   out[idx - lo] = arr
   return out

@@ -117,7 +117,8 @@ class HostNeighborSampler:
     b = len(src)
     batch_seed = self._next_batch_seed(batch_seed)
     if neg_mode == 'binary':
-      num_neg = int(np.ceil(b * neg_amount))
+      from .dist_options import binary_num_negatives
+      num_neg = binary_num_negatives(b, neg_amount)
       nrows, ncols = native.negative_sample(
           self.ds.indptr, self.ds.indices, num_neg, strict=True,
           padding=True, seed=batch_seed * 31 + 7)
@@ -150,24 +151,52 @@ class HostNeighborSampler:
       msg['#META.edge_label'] = pos_label
     return msg
 
+  def _sorted_csr(self):
+    """Lazily cached within-row-sorted column view (the native CSR is
+    unsorted) enabling vectorized membership tests."""
+    if not hasattr(self, '_sorted_indices'):
+      indptr, indices = self.ds.indptr, self.ds.indices
+      rows = np.repeat(np.arange(len(indptr) - 1),
+                       np.diff(indptr))
+      order = np.lexsort((indices, rows))
+      self._sorted_indices = indices[order]
+    return self._sorted_indices
+
+  def _edge_exists(self, rows: np.ndarray, cols: np.ndarray) -> np.ndarray:
+    """Vectorized (row, col) membership via per-row binary search on
+    the sorted view — one pass, no per-source Python loops."""
+    indptr = self.ds.indptr
+    sindices = self._sorted_csr()
+    e = len(sindices)
+    lo = indptr[rows].copy()
+    hi0 = indptr[rows + 1]
+    hi = hi0.copy()
+    for _ in range(max(int(e), 1).bit_length()):
+      active = lo < hi
+      mid = (lo + hi) // 2
+      v = sindices[np.clip(mid, 0, max(e - 1, 0))]
+      go = v < cols
+      lo = np.where(active & go, mid + 1, lo)
+      hi = np.where(active & ~go, mid, hi)
+    at = np.clip(lo, 0, max(e - 1, 0))
+    return (lo < hi0) & (sindices[at] == cols) if e else \
+        np.zeros(len(rows), bool)
+
   def _triplet_neg(self, src: np.ndarray, amount: int,
                    batch_seed: int, trials: int = 5) -> np.ndarray:
-    """Per-source strict negative destinations (host rejection via
-    adjacency sets — native CSR columns are unsorted)."""
+    """Per-source strict negative destinations, fully vectorized
+    (the reference's curand retry loop, `random_negative_sampler.cu:
+    56-94`, as trials-stacked draws + batched rejection)."""
     rng = np.random.default_rng(batch_seed)
-    indptr, indices = self.ds.indptr, self.ds.indices
     n = self.ds.num_nodes
-    out = np.empty((len(src), amount), np.int64)
-    for i, u in enumerate(src):
-      adj = set(indices[indptr[u]:indptr[u + 1]].tolist())
-      for a in range(amount):
-        c = int(rng.integers(0, n))
-        for _ in range(trials - 1):
-          if c not in adj:
-            break
-          c = int(rng.integers(0, n))
-        out[i, a] = c
-    return out
+    m = len(src) * amount
+    cand = rng.integers(0, n, (trials, m))
+    srcr = np.tile(np.repeat(src, amount), (trials, 1))
+    exists = self._edge_exists(srcr.reshape(-1),
+                               cand.reshape(-1)).reshape(trials, m)
+    ok = ~exists
+    pick = np.where(ok.any(axis=0), np.argmax(ok, axis=0), trials - 1)
+    return cand[pick, np.arange(m)].reshape(len(src), amount)
 
   # -- subgraph mode (reference `DistNeighborSampler._subgraph`,
   # `dist_neighbor_sampler.py:456-516`) -----------------------------------

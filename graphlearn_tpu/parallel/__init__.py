@@ -2,6 +2,7 @@ from .dp import (DataParallelLoader, make_dp_supervised_step, make_mesh,
                  replicate, shard_stacked, stack_batches)
 from .dist_data import (DistDataset, DistFeature, DistGraph,
                         build_dist_feature, build_dist_graph)
+from . import multihost
 from .dist_hetero import (DistHeteroDataset, DistHeteroNeighborLoader,
                           DistHeteroNeighborSampler)
 from .dist_sampler import (DistNeighborLoader, DistNeighborSampler,

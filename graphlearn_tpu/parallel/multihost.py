@@ -1,0 +1,88 @@
+"""Multi-host (pod / multi-slice) launch helpers.
+
+The reference scales out by launching one process group per machine
+with torch RPC worlds knitted over TCP/RDMA (`distributed/rpc.py:
+236-292`, `run_dist_bench.py` ssh fan-out).  JAX is single-controller
+per host: every host runs the SAME program, `jax.distributed`
+initializes the cross-host runtime, and the mesh spans all hosts'
+devices — collectives ride ICI within a slice and DCN across slices
+automatically.  What the framework must add is exactly two things:
+
+  * a mesh over ALL devices with the partition axis aligned to the
+    global device order (`global_mesh`);
+  * deterministic per-host seed sharding so every host feeds its own
+    devices' seed batches without coordination (`host_seed_shard`) —
+    the multi-host analog of the reference's per-worker `randperm`
+    splits (`dist_sampling_producer.py:249-260`): same epoch
+    permutation everywhere (shared seed), disjoint slices by host.
+
+Typical launch (same script on every host)::
+
+    from graphlearn_tpu.parallel import multihost
+    multihost.initialize()                  # env-driven on TPU pods
+    mesh = multihost.global_mesh()
+    ds = DistDataset.from_partition_dir(root, mesh.devices.size)
+    seeds = multihost.host_seed_shard(all_seeds, epoch=e, seed=0)
+    loader = DistNeighborLoader(ds, fanouts, seeds, mesh=mesh, ...)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+  """Bring up the cross-host runtime (no-op if already initialized).
+
+  On TPU pods all three arguments resolve from the environment; set
+  them explicitly for CPU/GPU multi-process testing.
+  """
+  # NOTE: nothing here may touch the XLA backend (jax.devices(),
+  # jax.process_count(), ...) before initialize() — backend init makes
+  # distributed init impossible, and that failure must stay LOUD.
+  if jax.distributed.is_initialized():
+    return
+  try:
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id)
+  except ValueError:
+    # no cluster environment detected and no coordinator given:
+    # single-process run (tests, one host) — nothing to initialize.
+    if coordinator_address is not None:
+      raise
+
+
+def global_mesh(axis: str = 'data') -> Mesh:
+  """One partition-axis mesh over every device of every host."""
+  return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def host_device_slice(num_parts: Optional[int] = None) -> slice:
+  """This host's contiguous slice of the mesh partition axis."""
+  num_parts = num_parts or len(jax.devices())
+  per_host = num_parts // jax.process_count()
+  lo = jax.process_index() * per_host
+  return slice(lo, lo + per_host)
+
+
+def host_seed_shard(seeds: np.ndarray, epoch: int = 0, seed: int = 0,
+                    shuffle: bool = True) -> np.ndarray:
+  """This host's disjoint slice of the (globally shuffled) seed set.
+
+  Every host computes the SAME permutation from ``(seed, epoch)`` and
+  takes its process-index slice — globally consistent epoch shuffling
+  with zero cross-host coordination.
+  """
+  seeds = np.asarray(seeds)
+  if shuffle:
+    rng = np.random.default_rng((int(seed), int(epoch)))
+    seeds = seeds[rng.permutation(len(seeds))]
+  n_hosts = jax.process_count()
+  per = -(-len(seeds) // n_hosts)
+  lo = jax.process_index() * per
+  return seeds[lo:lo + per]
