@@ -168,6 +168,8 @@ class HostNeighborSampler:
     indptr = self.ds.indptr
     sindices = self._sorted_csr()
     e = len(sindices)
+    if e == 0:
+      return np.zeros(len(rows), bool)
     lo = indptr[rows].copy()
     hi0 = indptr[rows + 1]
     hi = hi0.copy()
@@ -178,9 +180,8 @@ class HostNeighborSampler:
       go = v < cols
       lo = np.where(active & go, mid + 1, lo)
       hi = np.where(active & ~go, mid, hi)
-    at = np.clip(lo, 0, max(e - 1, 0))
-    return (lo < hi0) & (sindices[at] == cols) if e else \
-        np.zeros(len(rows), bool)
+    at = np.clip(lo, 0, e - 1)
+    return (lo < hi0) & (sindices[at] == cols)
 
   def _triplet_neg(self, src: np.ndarray, amount: int,
                    batch_seed: int, trials: int = 5) -> np.ndarray:

@@ -51,9 +51,11 @@ def initialize(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(coordinator_address, num_processes,
                                process_id)
   except ValueError:
-    # no cluster environment detected and no coordinator given:
-    # single-process run (tests, one host) — nothing to initialize.
-    if coordinator_address is not None:
+    # Swallow ONLY the fully-implicit case (no cluster environment
+    # detected, nothing requested): single-process tests.  Any
+    # explicitly-requested multi-process setup must fail loudly.
+    if (coordinator_address is not None or num_processes is not None
+        or process_id is not None):
       raise
 
 
@@ -76,7 +78,10 @@ def host_seed_shard(seeds: np.ndarray, epoch: int = 0, seed: int = 0,
 
   Every host computes the SAME permutation from ``(seed, epoch)`` and
   takes its process-index slice — globally consistent epoch shuffling
-  with zero cross-host coordination.
+  with zero cross-host coordination.  Shards are wrap-around padded to
+  EQUAL length (torch DistributedSampler semantics): unequal shards
+  would run different step counts and desynchronize the SPMD
+  collectives at epoch end.
   """
   seeds = np.asarray(seeds)
   if shuffle:
@@ -84,5 +89,8 @@ def host_seed_shard(seeds: np.ndarray, epoch: int = 0, seed: int = 0,
     seeds = seeds[rng.permutation(len(seeds))]
   n_hosts = jax.process_count()
   per = -(-len(seeds) // n_hosts)
+  if per * n_hosts > len(seeds) and len(seeds):
+    pad = seeds[:per * n_hosts - len(seeds)]
+    seeds = np.concatenate([seeds, pad])
   lo = jax.process_index() * per
   return seeds[lo:lo + per]
