@@ -13,6 +13,8 @@
 //
 // The queue is picklable by shmid (reference `py_export.cc:132-140`):
 // any process on the host can attach with `glt_queue_attach`.
+#include <cerrno>
+#include <ctime>
 #include <semaphore.h>
 #include <sys/ipc.h>
 #include <sys/shm.h>
@@ -151,6 +153,42 @@ int glt_queue_put(void* handle, const void* data, uint64_t len) {
 int64_t glt_queue_get(void* handle, void* out, uint64_t cap) {
   Queue* q = static_cast<Queue*>(handle);
   sem_wait(&q->hdr->filled_slots);
+  uint64_t ticket = q->hdr->tail.fetch_add(1);
+  uint64_t i = ticket % q->hdr->num_slots;
+  SlotHeader* sh = q->slot_hdr(i);
+  while (sh->seq.load(std::memory_order_acquire) != ticket + 1) {
+  }
+  int64_t len = (int64_t)sh->len;
+  int64_t ret = len;
+  if ((uint64_t)len <= cap) {
+    memcpy(out, q->slot_data(i), len);
+  } else {
+    ret = -1;
+  }
+  sh->seq.store(ticket + q->hdr->num_slots, std::memory_order_release);
+  sem_post(&q->hdr->free_slots);
+  return ret;
+}
+
+// Timed dequeue: like glt_queue_get but waits at most `timeout_ms`
+// for a message.  Returns payload length, -1 oversized (dropped),
+// -2 timeout (nothing consumed).  Lets consumers run liveness
+// watchdogs without busy-polling or losing the blocking fast path.
+int64_t glt_queue_get_timed(void* handle, void* out, uint64_t cap,
+                            int64_t timeout_ms) {
+  Queue* q = static_cast<Queue*>(handle);
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) {
+    ts.tv_sec += 1;
+    ts.tv_nsec -= 1000000000L;
+  }
+  while (sem_timedwait(&q->hdr->filled_slots, &ts) != 0) {
+    if (errno == ETIMEDOUT) return -2;
+    if (errno != EINTR) return -2;  // treat other failures as timeout
+  }
   uint64_t ticket = q->hdr->tail.fetch_add(1);
   uint64_t i = ticket % q->hdr->num_slots;
   SlotHeader* sh = q->slot_hdr(i);

@@ -35,6 +35,11 @@ class ShmChannel(ChannelBase):
   def recv(self) -> SampleMessage:
     return self._q.get()
 
+  def recv_timeout(self, timeout: float):
+    """Dequeue with a timeout; ``None`` when nothing arrived — the
+    hook liveness watchdogs need (blocking fast path preserved)."""
+    return self._q.get_timed(timeout)
+
   def recv_bytes(self) -> bytes:
     """Dequeue one message still in tensor-map wire form — lets the
     server forward it over RPC without a parse/re-serialize round trip."""

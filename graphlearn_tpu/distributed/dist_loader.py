@@ -155,12 +155,28 @@ class DistLoader:
   def _recv_current_epoch(self) -> SampleMessage:
     """Receive, discarding stale-epoch messages left in the channel by
     an early-terminated previous epoch (`RemoteReceivingChannel` does
-    its own stamp filtering)."""
+    its own stamp filtering).  Blocking waits are liveness-guarded:
+    the shm dequeue blocks in a semaphore, so a crashed producer pool
+    must surface as an error here, not as a hang (the reference's
+    MP_STATUS_CHECK_INTERVAL watchdog)."""
     if isinstance(self.opts, RemoteDistSamplingWorkerOptions):
       return self.channel.recv()
     cur = self._producer.current_epoch
     while True:
-      msg = self.channel.recv()
+      # timed semaphore wait: blocking fast path, and ANY crashed
+      # worker surfaces as an error on the next timeout (a dead worker
+      # may hold an outstanding seed slice that will never arrive).
+      # The timed recv itself closes the message-arrived-then-died
+      # race: a message present at raise-decision time was drained.
+      msg = self.channel.recv_timeout(5.0)
+      if msg is None:
+        dead = self._producer.dead_worker_exitcodes()
+        if dead:
+          raise RuntimeError(
+              f'{len(dead)} sampling worker(s) exited (exit codes '
+              f'{dead}) with {self._expected - self._received} '
+              'batches outstanding')
+        continue
       stamp = msg.get('#EPOCH')
       if stamp is None or int(np.asarray(stamp)) == cur:
         return msg

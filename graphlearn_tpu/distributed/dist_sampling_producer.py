@@ -141,6 +141,16 @@ class MpSamplingProducer:
     self._epoch += 1
     return n_batches
 
+  def alive_workers(self) -> int:
+    """Liveness probe (the reference's 5s MP_STATUS_CHECK_INTERVAL
+    watchdog, `dist_sampling_producer.py:39-41`): consumers use this
+    to fail loudly instead of blocking forever on a channel no one
+    will ever fill."""
+    return sum(1 for w in self._workers if w.is_alive())
+
+  def dead_worker_exitcodes(self):
+    return [w.exitcode for w in self._workers if not w.is_alive()]
+
   def shutdown(self) -> None:
     for tq in self._task_queues:
       try:

@@ -96,6 +96,8 @@ def _declare(l):
   l.glt_queue_put.argtypes = [p, ctypes.c_char_p, u64]
   l.glt_queue_get.restype = i64
   l.glt_queue_get.argtypes = [p, p, u64]
+  l.glt_queue_get_timed.restype = i64
+  l.glt_queue_get_timed.argtypes = [p, p, u64, i64]
   l.glt_queue_empty.restype = ctypes.c_int
   l.glt_queue_empty.argtypes = [p]
   l.glt_queue_detach.argtypes = [p]
@@ -264,6 +266,23 @@ class ShmQueue:
     if n < 0:
       raise ValueError('message exceeded receive buffer')
     return buf.raw[:n]
+
+  def get_bytes_timed(self, timeout: float):
+    """Dequeue with a timeout (seconds); ``None`` when nothing arrived
+    — consumers run liveness watchdogs between waits."""
+    cap = self.slot_bytes
+    buf = ctypes.create_string_buffer(cap)
+    n = self._l.glt_queue_get_timed(self._h, buf, cap,
+                                    int(timeout * 1000))
+    if n == -2:
+      return None
+    if n < 0:
+      raise ValueError('message exceeded receive buffer')
+    return buf.raw[:n]
+
+  def get_timed(self, timeout: float):
+    b = self.get_bytes_timed(timeout)
+    return None if b is None else parse_tensor_map(b)
 
   def put(self, msg: Dict[str, np.ndarray]):
     self.put_bytes(serialize_tensor_map(msg))

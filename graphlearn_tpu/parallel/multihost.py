@@ -90,7 +90,8 @@ def host_seed_shard(seeds: np.ndarray, epoch: int = 0, seed: int = 0,
   n_hosts = jax.process_count()
   per = -(-len(seeds) // n_hosts)
   if per * n_hosts > len(seeds) and len(seeds):
-    pad = seeds[:per * n_hosts - len(seeds)]
-    seeds = np.concatenate([seeds, pad])
+    # wrap-around pad to exactly per * n_hosts even when the pad
+    # exceeds the seed count (tiny seed sets on many hosts)
+    seeds = np.resize(seeds, (per * n_hosts,) + seeds.shape[1:])
   lo = jax.process_index() * per
   return seeds[lo:lo + per]

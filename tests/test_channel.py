@@ -155,3 +155,30 @@ class TestDistLoaderModes:
         self._check_epoch(loader, 40, 5, 8)
     finally:
       loader.shutdown()
+
+
+def test_dead_workers_raise_not_hang():
+  """Crashed sampling pool surfaces as a RuntimeError (the reference's
+  MP_STATUS_CHECK_INTERVAL watchdog), never an infinite semaphore
+  wait.  The epoch is far larger than the channel capacity, so
+  terminating the workers mid-epoch is guaranteed to leave
+  outstanding batches — the test can only pass through the watchdog."""
+  from graphlearn_tpu.distributed import DistNeighborLoader
+  ds = ring_dataset(n=40)
+  seeds = np.tile(np.arange(40), 100)          # 500 batches expected
+  loader = DistNeighborLoader(
+      ds, [2], seeds, batch_size=8,
+      worker_options=MpDistSamplingWorkerOptions(
+          num_workers=2, channel_capacity=4),
+      to_device=False)
+  try:
+    it = iter(loader)
+    next(it)                       # epoch running
+    for w in loader._producer._workers:
+      w.terminate()
+      w.join(timeout=10)
+    with pytest.raises(RuntimeError, match='worker'):
+      for _ in range(600):
+        next(it)
+  finally:
+    loader.shutdown()
