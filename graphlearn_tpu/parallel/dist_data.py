@@ -111,22 +111,60 @@ def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
   return DistGraph(indptr_s, indices_s, eids_s, bounds), old2new
 
 
+CACHE_PAD_ID = np.iinfo(np.int32).max  # sorts AFTER every real id
+
+
 class DistFeature:
-  """Stacked per-partition feature shards.
+  """Stacked per-partition feature shards + optional remote-hot cache.
 
   Attributes:
     shards: ``[P, rows_max, D]`` (zero rows where padded).
     bounds: ``[P + 1]`` — row ``r`` of shard ``p`` holds global id
       ``bounds[p] + r``.
+    cache_ids: optional ``[P, C]`` SORTED (relabeled) ids of remote
+      rows partition ``p`` caches locally, ``CACHE_PAD_ID``-padded —
+      the collective-era `cat_feature_cache`
+      (`partition/base.py:606-647`): lookups hit the cache first and
+      only misses ride the all_to_all.
+    cache_rows: optional ``[P, C, D]`` the cached rows.
   """
 
-  def __init__(self, shards, bounds):
+  def __init__(self, shards, bounds, cache_ids=None, cache_rows=None):
     self.shards = np.asarray(shards)
     self.bounds = np.asarray(bounds, dtype=np.int64)
+    self.cache_ids = (np.asarray(cache_ids, np.int32)
+                      if cache_ids is not None else None)
+    self.cache_rows = (np.asarray(cache_rows)
+                       if cache_rows is not None else None)
 
   @property
   def feature_dim(self) -> int:
     return self.shards.shape[-1]
+
+  @property
+  def has_cache(self) -> bool:
+    return self.cache_ids is not None and self.cache_ids.shape[1] > 0
+
+
+def build_feature_cache(cache_ids_old, cache_feats, old2new, num_parts):
+  """Assemble per-partition sorted cache arrays from the offline
+  layout's ``cache_ids/cache_feats`` (old id space)."""
+  cmax = max((len(c) for c in cache_ids_old), default=0)
+  if cmax == 0:
+    return None, None
+  d = next(f.shape[1] for f in cache_feats if f is not None and len(f))
+  dtype = next(f.dtype for f in cache_feats if f is not None and len(f))
+  ids = np.full((num_parts, cmax), CACHE_PAD_ID, np.int32)
+  rows = np.zeros((num_parts, cmax, d), dtype)
+  for p in range(num_parts):
+    cid = np.asarray(cache_ids_old[p], np.int64)
+    if not len(cid):
+      continue
+    new = old2new[cid].astype(np.int32)
+    order = np.argsort(new)
+    ids[p, :len(cid)] = new[order]
+    rows[p, :len(cid)] = np.asarray(cache_feats[p])[order]
+  return ids, rows
 
 
 def build_dist_feature(feats: np.ndarray, old2new: np.ndarray,
@@ -222,6 +260,15 @@ class DistDataset:
       for p in parts:
         feats[p['node_feat'].ids] = p['node_feat'].feats
       nf = build_dist_feature(feats, old2new, g.bounds)
+      # remote-hot cache planned by the partitioner (cache_ratio /
+      # FrequencyPartitioner): served locally, misses ride all_to_all.
+      cache_ids = [p['node_feat'].cache_ids
+                   if p['node_feat'].cache_ids is not None else []
+                   for p in parts]
+      cache_feats = [p['node_feat'].cache_feats for p in parts]
+      cids, crows = build_feature_cache(cache_ids, cache_feats, old2new,
+                                        num_parts)
+      nf.cache_ids, nf.cache_rows = cids, crows
     nl = None
     if parts[0]['node_label'] is not None:
       lab0, ids0 = parts[0]['node_label']

@@ -140,20 +140,47 @@ def dist_gather(shard_loc, bounds, ids, axis: str, num_parts: int):
   return dist_gather_multi((shard_loc,), bounds, ids, axis, num_parts)[0]
 
 
+def cache_overlay(gathered, ids, cache_ids_loc, cache_rows_loc):
+  """Overlay this device's remote-hot CACHE rows on exchanged results
+  — the collective-era `cat_feature_cache` trick
+  (`distributed/dist_dataset.py:77-164`: cached remote rows count as
+  local).
+
+  In the RPC world a cache hit skips a network round-trip; under
+  fixed-capacity collectives the all_to_all buffers do not shrink with
+  the hit count, so the cache is applied as a post-exchange OVERLAY
+  (identical bytes, ONE shared feature+label exchange) rather than a
+  miss-only second exchange — its value here is serving hot rows from
+  the freshest local copy and keeping the offline cache plan
+  meaningful for RPC-backed deployments.
+
+  ``cache_ids_loc``: ``[C]`` sorted ids (CACHE_PAD_ID padded);
+  ``cache_rows_loc``: ``[C, D]``.
+  """
+  c = cache_ids_loc.shape[0]
+  pos = jnp.clip(jnp.searchsorted(cache_ids_loc, ids), 0, c - 1)
+  hit = (cache_ids_loc[pos] == ids) & (ids >= 0)
+  cache_val = cache_rows_loc[pos]
+  return jnp.where(hit[:, None], cache_val, gathered)
+
+
 def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     node_cap: int, with_edge: bool, collect_features: bool,
-                    collect_labels: bool, axis: str = 'data'):
+                    collect_labels: bool, axis: str = 'data',
+                    with_cache: bool = False):
   """Build the jitted SPMD sample(+collect) step."""
   from .shard_map_compat import shard_map
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-                 lshard_s, key):
+                 lshard_s, cids_s, crows_s, key):
     indptr = indptr_s[0]
     indices = indices_s[0]
     eids = eids_s[0] if with_edge else None
     seeds = seeds_s[0]
     fshard = fshard_s[0] if collect_features else None
     lshard = lshard_s[0] if collect_labels else None
+    cids = cids_s[0] if with_cache else None
+    crows = crows_s[0] if with_cache else None
 
     b = seeds.shape[0]
     state, seed_local = init_node(seeds, node_cap)
@@ -197,6 +224,11 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                                    num_parts))
       if collect_features:
         x = got.pop(0)
+        if with_cache:
+          # overlay local cache hits on the exchanged rows (see
+          # `cache_overlay` for why this is an overlay, not a
+          # miss-only exchange)
+          x = cache_overlay(x, state.nodes, cids, crows)
       if collect_labels:
         y = got.pop(0)
     cum = jnp.stack(hop_counts)
@@ -209,16 +241,16 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
             lead(nsn))
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P())
+              P(axis), P(axis), P())
   specs_out = tuple(P(axis) for _ in range(9))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-           lshard_s, key):
+           lshard_s, cids_s, crows_s, key):
     return sharded(indptr_s, indices_s, eids_s, bounds, seeds_s,
-                   fshard_s, lshard_s, key)
+                   fshard_s, lshard_s, cids_s, crows_s, key)
 
   return step
 
@@ -250,6 +282,8 @@ class DistNeighborSampler:
     self.collect_features = (collect_features
                              and dataset.node_features is not None)
     self.collect_labels = dataset.node_labels is not None
+    self.with_cache = (self.collect_features
+                       and dataset.node_features.has_cache)
     self._base_key = jax.random.key(seed)
     self._step_cnt = 0
     self._steps = {}
@@ -265,10 +299,18 @@ class DistNeighborSampler:
                  else np.zeros((self.num_parts, 1, 1), np.float32))
       lshards = (self.ds.node_labels if self.collect_labels
                  else np.zeros((self.num_parts, 1), np.int32))
+      if self.with_cache:
+        cids = self.ds.node_features.cache_ids
+        crows = self.ds.node_features.cache_rows
+      else:
+        from .dist_data import CACHE_PAD_ID
+        cids = np.full((self.num_parts, 1), CACHE_PAD_ID, np.int32)
+        crows = np.zeros((self.num_parts, 1, 1), np.float32)
       self._device_arrays = dict(
           indptr=put(g.indptr, shard), indices=put(g.indices, shard),
           eids=put(g.edge_ids, shard), bounds=put(g.bounds, repl),
-          fshards=put(fshards, shard), lshards=put(lshards, shard))
+          fshards=put(fshards, shard), lshards=put(lshards, shard),
+          cids=put(cids, shard), crows=put(crows, shard))
     return self._device_arrays
 
   def node_capacity(self, batch_size: int) -> int:
@@ -286,7 +328,7 @@ class DistNeighborSampler:
       self._steps[cfg] = _make_dist_step(
           self.mesh, self.num_parts, self.fanouts, node_cap,
           self.with_edge, self.collect_features, self.collect_labels,
-          self.axis)
+          self.axis, with_cache=self.with_cache)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -296,7 +338,8 @@ class DistNeighborSampler:
     (nodes, count, row, col, edge, seed_local, x, y, nsn) = \
         self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
                          arrs['bounds'], seeds_dev, arrs['fshards'],
-                         arrs['lshards'], key)
+                         arrs['lshards'], arrs['cids'], arrs['crows'],
+                         key)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, seed_local=seed_local, x=x, y=y,
                 num_sampled_nodes=nsn, batch=seeds_dev)
