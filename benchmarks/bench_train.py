@@ -1,0 +1,87 @@
+"""GraphSAGE training epoch time — the BASELINE.json headline metric.
+
+Reference counterpart: per-epoch wall-clock of
+`examples/train_sage_ogbn_products.py` (the number GLT's README quotes
+against a single A100).  Full pipeline per batch: seed shuffle ->
+multi-hop sampling -> feature/label collation -> fused train step
+(forward, backward, adam) on device.
+
+Usage::
+
+    python benchmarks/bench_train.py [--cpu] [--quick]
+"""
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import NUM_NODES, build_graph, emit
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--cpu', action='store_true')
+  ap.add_argument('--quick', action='store_true')
+  ap.add_argument('--dim', type=int, default=100,    # ogbn-products dim
+                  help='feature dim')
+  ap.add_argument('--hidden', type=int, default=256)
+  ap.add_argument('--classes', type=int, default=47)  # products classes
+  ap.add_argument('--epochs', type=int, default=3)
+  args = ap.parse_args()
+  if args.epochs < 1:
+    ap.error('--epochs must be >= 1 (epoch 0 is the untimed warmup)')
+
+  import jax
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  import optax
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.loader import NeighborLoader
+  from graphlearn_tpu.models import (GraphSAGE, create_train_state,
+                                     make_supervised_step)
+
+  n = 200_000 if args.quick else NUM_NODES
+  rows, cols = build_graph(n)
+  rng = np.random.default_rng(0)
+  feats = rng.standard_normal((n, args.dim)).astype(np.float32)
+  labels = rng.integers(0, args.classes, n).astype(np.int32)
+  ds = (Dataset()
+        .init_graph((rows, cols), layout='COO', num_nodes=n)
+        .init_node_features(feats, split_ratio=1.0)
+        .init_node_labels(labels))
+
+  # ogbn-products train split is ~196k seeds (8%); mirror that ratio
+  train_idx = rng.permutation(n)[:max(n // 12, 1)]
+  bs = 1024
+  loader = NeighborLoader(ds, [15, 10, 5], train_idx, batch_size=bs,
+                          shuffle=True, seed=0)
+  model = GraphSAGE(hidden_features=args.hidden, out_features=args.classes,
+                    num_layers=3)
+  tx = optax.adam(3e-3)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), next(iter(loader)), tx)
+  step = make_supervised_step(apply_fn, tx, bs)
+
+  # epoch 0 = warmup/compile (not reported)
+  times = []
+  for epoch in range(args.epochs + 1):
+    t0 = time.perf_counter()
+    for batch in loader:
+      state, loss, _ = step(state, batch)
+    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    dt = time.perf_counter() - t0
+    if epoch > 0:
+      times.append(dt)
+  best = min(times)
+  emit('train_epoch_secs', best, 's',
+       seeds=len(train_idx), batch=bs,
+       steps_per_sec=round(len(loader) / best, 2),
+       platform=jax.devices()[0].platform)
+
+
+if __name__ == '__main__':
+  main()
