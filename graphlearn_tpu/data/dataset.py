@@ -157,7 +157,23 @@ class Dataset:
                           for k, v in node_label_data.items()}
     else:
       self.node_labels = convert_to_array(node_label_data)
+    self._device_labels = None      # re-upload on next collate
     return self
+
+  def get_node_label_device(self, ntype: Optional[NodeType] = None):
+    """Device-resident label array, uploaded once and cached — batch
+    collation gathers labels on device (a per-batch host gather would
+    round-trip the sampled node table through the host)."""
+    lab = self.get_node_label(ntype)
+    if lab is None:
+      return None
+    cache = getattr(self, '_device_labels', None)
+    if cache is None:
+      cache = self._device_labels = {}
+    if ntype not in cache:
+      import jax.numpy as jnp
+      cache[ntype] = jnp.asarray(np.asarray(lab))
+    return cache[ntype]
 
   def num_nodes_dict(self) -> Dict[NodeType, int]:
     """Per-node-type counts for hetero graphs: explicit ``init_graph``

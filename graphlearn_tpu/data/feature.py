@@ -21,15 +21,34 @@ cliques maps to sharding the hot tier over a `jax.sharding.Mesh` (see
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.pallas_gather import gather_rows
+from ..ops.pallas_gather import gather_rows, pallas_enabled
 from ..utils.padding import next_power_of_two
 from ..utils.tensor import convert_to_array
+
+
+@functools.partial(jax.jit, static_argnames=('use_pallas',))
+def _device_gather(hot: jax.Array, ids: jax.Array, id2index, *,
+                   use_pallas: bool) -> jax.Array:
+  # `use_pallas` is part of the jit cache key so the GLT_PALLAS
+  # kill-switch keeps working mid-process (resolved per call outside).
+  valid = ids >= 0
+  idx = jnp.where(valid, ids, 0).astype(jnp.int32)
+  if id2index is not None:
+    idx = id2index[idx].astype(jnp.int32)
+    valid = valid & (idx >= 0)
+    idx = jnp.where(valid, idx, 0)
+  if use_pallas:
+    out = gather_rows(hot, idx)
+  else:
+    out = jnp.take(hot, idx, axis=0)
+  return jnp.where(valid[:, None], out, 0)
 
 
 class Feature:
@@ -110,8 +129,15 @@ class Feature:
     (`data/feature.py:141-154`) → `GatherTensorKernel`.  Invalid ids
     (< 0, the padding sentinel) return zero rows, so padded batches
     flow straight into the model.
+
+    Device-resident ids with a fully-HBM table take an all-device
+    path: the reference's ids are already on-GPU likewise; a host
+    round-trip here would serialize every batch on transfer latency.
     """
     self.lazy_init()
+    if (isinstance(ids, jax.Array)
+        and self.hot_rows >= self._host_feats.shape[0]):
+      return self._device_get(ids)
     ids_host = np.asarray(ids)
     valid = ids_host >= 0
     idx = np.where(valid, ids_host, 0)
@@ -159,6 +185,11 @@ class Feature:
     hot_ok = jnp.asarray(valid & ~cold_sel)[:, None]
     cold_ok = jnp.asarray(cold_sel)[:, None]
     return jnp.where(hot_ok, out, jnp.where(cold_ok, cold_rows, 0))
+
+  def _device_get(self, ids: jax.Array) -> jax.Array:
+    """All-device gather (fully-hot tables, device ids): no host sync."""
+    return _device_gather(self._hot, ids, self._id2index_dev,
+                          use_pallas=pallas_enabled())
 
   def host_get(self, ids=None) -> np.ndarray:
     """Host-side gather (reference ``Feature.cpu_get``,

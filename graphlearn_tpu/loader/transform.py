@@ -147,6 +147,15 @@ jax.tree_util.register_pytree_node(
     HeteroBatch, lambda b: b.tree_flatten(), HeteroBatch.tree_unflatten)
 
 
+@jax.jit
+def _gather_labels(labels: jax.Array, ids: jax.Array) -> jax.Array:
+  valid = ids >= 0
+  idx = jnp.where(valid, ids, 0)
+  out = labels[idx]
+  mask = valid.reshape(valid.shape + (1,) * (out.ndim - 1))
+  return jnp.where(mask, out, 0)
+
+
 def to_data(
     out: SamplerOutput,
     node_feature=None,
@@ -162,13 +171,18 @@ def to_data(
   x = node_feature[out.node] if node_feature is not None else None
   y = None
   if node_label is not None:
-    import numpy as np
-    ids = np.asarray(out.node)
-    valid = ids >= 0
-    lab = np.asarray(node_label)
-    yv = np.zeros((len(ids),) + lab.shape[1:], dtype=lab.dtype)
-    yv[valid] = lab[ids[valid]]
-    y = jnp.asarray(yv)
+    if isinstance(node_label, jax.Array) and isinstance(out.node,
+                                                       jax.Array):
+      # all-device label gather: no host round trip per batch
+      y = _gather_labels(node_label, out.node)
+    else:
+      import numpy as np
+      ids = np.asarray(out.node)
+      valid = ids >= 0
+      lab = np.asarray(node_label)
+      yv = np.zeros((len(ids),) + lab.shape[1:], dtype=lab.dtype)
+      yv[valid] = lab[ids[valid]]
+      y = jnp.asarray(yv)
   edge_attr = None
   if edge_feature is not None and out.edge is not None:
     edge_attr = edge_feature[out.edge]
@@ -187,18 +201,21 @@ def collate(data, out) -> Any:
   `Dataset` — the one shared implementation behind every loader's
   ``_collate_fn`` (reference `loader/node_loader.py:85-113`)."""
   if isinstance(out, HeteroSamplerOutput):
+    label_dict = None
+    if isinstance(data.node_labels, dict):
+      label_dict = {nt: data.get_node_label_device(nt)
+                    for nt in data.node_labels}
     return to_hetero_data(
         out,
         node_feature_dict=data.node_features
         if isinstance(data.node_features, dict) else None,
-        node_label_dict=data.node_labels
-        if isinstance(data.node_labels, dict) else None,
+        node_label_dict=label_dict,
         edge_feature_dict=data.edge_features
         if isinstance(data.edge_features, dict) else None)
   return to_data(
       out,
       node_feature=data.get_node_feature(),
-      node_label=data.get_node_label(),
+      node_label=data.get_node_label_device(),
       edge_feature=(data.get_edge_feature()
                     if out.edge is not None else None))
 
@@ -217,12 +234,16 @@ def to_hetero_data(
     if node_feature_dict and ntype in node_feature_dict:
       x_dict[ntype] = node_feature_dict[ntype][ids]
     if node_label_dict and ntype in node_label_dict:
-      ids_h = np.asarray(ids)
-      valid = ids_h >= 0
-      lab = np.asarray(node_label_dict[ntype])
-      yv = np.zeros((len(ids_h),) + lab.shape[1:], dtype=lab.dtype)
-      yv[valid] = lab[ids_h[valid]]
-      y_dict[ntype] = jnp.asarray(yv)
+      lab = node_label_dict[ntype]
+      if isinstance(lab, jax.Array) and isinstance(ids, jax.Array):
+        y_dict[ntype] = _gather_labels(lab, ids)
+      else:
+        ids_h = np.asarray(ids)
+        valid = ids_h >= 0
+        lab = np.asarray(lab)
+        yv = np.zeros((len(ids_h),) + lab.shape[1:], dtype=lab.dtype)
+        yv[valid] = lab[ids_h[valid]]
+        y_dict[ntype] = jnp.asarray(yv)
   ei_dict, em_dict, ea_dict = {}, {}, {}
   for etype in out.row:
     ei_dict[etype] = jnp.stack([out.row[etype], out.col[etype]])
