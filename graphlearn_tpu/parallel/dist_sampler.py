@@ -70,7 +70,7 @@ def bucket_by_owner(ids: jax.Array, owner: jax.Array, num_parts: int,
 
 def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
                   k: int, key, axis: str, num_parts: int,
-                  with_edge: bool):
+                  with_edge: bool, sort_locality: bool = True):
   """One distributed hop for this device's ``frontier`` ids."""
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
@@ -82,7 +82,8 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   local = jnp.where(flat >= 0, flat - my_start, INVALID_ID).astype(jnp.int32)
   res = sample_one_hop(indptr_loc, indices_loc, local, k,
                        jax.random.fold_in(key, my_idx), eids_loc,
-                       with_edge_ids=with_edge)
+                       with_edge_ids=with_edge,
+                       sort_locality=sort_locality)
   f = frontier.shape[0]
   nbrs = jax.lax.all_to_all(res.nbrs.reshape(num_parts, f, k),
                             axis, 0, 0, tiled=True)

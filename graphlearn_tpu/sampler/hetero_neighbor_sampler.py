@@ -99,7 +99,8 @@ def _plan_capacities(
 @functools.partial(
     jax.jit,
     static_argnames=('etypes', 'fanouts_t', 'seed_types', 'num_hops',
-                     'table_caps', 'frontier_caps_t', 'with_edge'))
+                     'table_caps', 'frontier_caps_t', 'with_edge',
+                     'sort_locality'))
 def _hetero_multihop(
     graphs,           # dict etype -> (indptr, indices, edge_ids|None)
     seeds_t: Tuple[jax.Array, ...],   # aligned with seed_types
@@ -112,6 +113,7 @@ def _hetero_multihop(
     table_caps: Tuple[Tuple[NodeType, int], ...],
     frontier_caps_t: Tuple[Tuple[Tuple[NodeType, int], ...], ...],
     with_edge: bool,
+    sort_locality: bool = True,
 ):
   caps = dict(table_caps)
   fanouts = dict(zip(etypes, fanouts_t))
@@ -163,7 +165,8 @@ def _hetero_multihop(
       indptr, indices, edge_ids = graphs[et]
       hop_key = jax.random.fold_in(jax.random.fold_in(key, h), ei)
       res = sample_one_hop(indptr, indices, fr_nodes, int(k), hop_key,
-                           edge_ids, with_edge_ids=with_edge)
+                           edge_ids, with_edge_ids=with_edge,
+                           sort_locality=sort_locality)
       states[d], rows, cols, _ = induce_next(
           states[d], fr_local, res.nbrs, res.mask)
       rows_acc[et].append(rows)
@@ -214,7 +217,8 @@ class HeteroNeighborSampler(BaseSampler):
   def __init__(self, graphs: Dict[EdgeType, Graph], num_neighbors,
                device=None, with_edge: bool = False,
                num_nodes: Optional[Dict[NodeType, int]] = None,
-               seed: int = 0):
+               seed: int = 0, sort_locality: bool = True):
+    self.sort_locality = bool(sort_locality)
     self.graphs = dict(graphs)
     self.etypes, self.fanouts, self.num_hops = normalize_fanouts(
         tuple(sorted(self.graphs.keys())), num_neighbors)
@@ -255,7 +259,7 @@ class HeteroNeighborSampler(BaseSampler):
         table_caps=tuple(sorted(table_cap.items())),
         frontier_caps_t=tuple(
             tuple(sorted(fc.items())) for fc in frontier_caps),
-        with_edge=self.with_edge)
+        with_edge=self.with_edge, sort_locality=self.sort_locality)
 
   def sample_from_nodes(self, inputs: NodeSamplerInput,
                         **kwargs) -> HeteroSamplerOutput:

@@ -188,3 +188,25 @@ def test_csc_layout_preserves_isolated_tail_nodes():
   deg = lookup_degree(jnp.asarray(topo.indptr),
                       jnp.array([4], jnp.int32))
   assert int(deg[0]) == 0
+
+
+def test_sort_locality_restores_input_order():
+  """The locality sort is internal: outputs align with the UNSORTED
+  input seed order (regression for the inverse permutation — existing
+  tests all pass pre-sorted seeds, for which argsort is identity)."""
+  import jax
+  import jax.numpy as jnp
+  from graphlearn_tpu.ops.neighbor import sample_one_hop
+  # ring: node v -> v+1 only, so the correct neighbor is seed+1
+  n = 50
+  indptr = jnp.arange(n + 1, dtype=jnp.int32)
+  indices = jnp.asarray((np.arange(n) + 1) % n, dtype=jnp.int32)
+  seeds = jnp.asarray([9, -1, 3, 0, 41, 3, 17], dtype=jnp.int32)
+  res = sample_one_hop(indptr, indices, seeds, 1, jax.random.key(0),
+                       sort_locality=True)
+  nbrs = np.asarray(res.nbrs)[:, 0]
+  mask = np.asarray(res.mask)[:, 0]
+  expect_valid = np.asarray(seeds) >= 0
+  np.testing.assert_array_equal(mask, expect_valid)
+  np.testing.assert_array_equal(nbrs[expect_valid],
+                                (np.asarray(seeds)[expect_valid] + 1) % n)
