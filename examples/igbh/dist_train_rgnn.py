@@ -26,6 +26,8 @@ from examples.igbh.train_rgnn import ETYPES, P as PAPER, synthetic
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument('--model', choices=['rgat', 'rsage'], default='rsage')
+  ap.add_argument('--partition-dir', type=str, default=None,
+                  help='hetero partition layout from RandomPartitioner')
   ap.add_argument('--num-parts', type=int, default=None)
   ap.add_argument('--epochs', type=int, default=3)
   ap.add_argument('--batch-size', type=int, default=64,
@@ -49,11 +51,24 @@ def main():
   num_parts = args.num_parts or len(jax.devices())
   mesh = make_mesh(num_parts)
 
-  edges, feats, nnodes, topic = synthetic()
-  npaper, classes = len(topic), int(topic.max()) + 1
-  ds = DistHeteroDataset.from_full_graph(
-      num_parts, edges, node_feat_dict=feats,
-      node_label_dict={PAPER: topic}, num_nodes_dict=nnodes)
+  if args.partition_dir:
+    import json
+    with open(Path(args.partition_dir) / 'META.json') as f:
+      disk_parts = json.load(f)['num_parts']
+    assert disk_parts == num_parts, (
+        f'partition layout has {disk_parts} parts but the mesh has '
+        f'{num_parts} devices — repartition or set --num-parts')
+    ds = DistHeteroDataset.from_partition_dir(args.partition_dir,
+                                              num_parts)
+    assert PAPER in ds.node_labels, 'training needs paper labels'
+    npaper = ds.num_nodes_dict()[PAPER]
+    classes = int(np.max(ds.node_labels[PAPER])) + 1
+  else:
+    edges, feats, nnodes, topic = synthetic()
+    npaper, classes = len(topic), int(topic.max()) + 1
+    ds = DistHeteroDataset.from_full_graph(
+        num_parts, edges, node_feat_dict=feats,
+        node_label_dict={PAPER: topic}, num_nodes_dict=nnodes)
 
   bs = args.batch_size
   loader = DistHeteroNeighborLoader(
