@@ -41,82 +41,115 @@ from .dist_data import DistDataset
 
 
 def bucket_by_owner(ids: jax.Array, owner: jax.Array, num_parts: int,
-                    self_idx: jax.Array):
-  """Pack ids into per-owner rows of a ``[P, F]`` send buffer.
+                    self_idx: jax.Array, capacity: Optional[int] = None):
+  """Pack ids into per-owner rows of a ``[P, C]`` send buffer.
 
   Returns ``(send, slot_p, slot_j)``: ``send[p]`` holds the ids owned
   by partition ``p`` (-1 padded); original position ``i`` landed at
   ``send[slot_p[i], slot_j[i]]`` — the inverse map used to stitch
   replies back into request order (the collective-era
   `stitch_sample_results`, `csrc/cuda/stitch_sample_results.cu:27-100`).
+
+  ``capacity`` bounds the per-destination row width ``C`` (default:
+  the full frontier size ``F``).  With shuffled seeds each owner gets
+  ~``F/P`` ids, so the uncapped buffer is ~``P``x padding — the
+  SURVEY §7 "partition-aware capacity tuning" trade.  Ids past an
+  owner's capacity are DROPPED: their ``slot_j`` is -1 and callers
+  must mask their results invalid (a capped neighbor sample loses
+  those neighbors — statistically a slight under-sample, never a
+  wrong edge).
   """
   f = ids.shape[0]
+  cap = f if capacity is None else min(int(capacity), f)
   valid = ids >= 0
-  owner = jnp.where(valid, owner, self_idx)   # park invalids locally
+  # invalid ids sort AFTER every real owner: they never consume a
+  # capacity slot (parking them at self could evict valid self-owned
+  # ids under a cap) and land in the dropped row of the scatter.
+  owner = jnp.where(valid, owner, num_parts)
   perm = jnp.argsort(owner, stable=True)
   owner_s = owner[perm]
   ids_s = ids[perm]
   counts = jax.ops.segment_sum(jnp.ones((f,), jnp.int32), owner_s,
-                               num_segments=num_parts)
+                               num_segments=num_parts + 1)
   offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                              jnp.cumsum(counts)[:-1]])
   rank = jnp.arange(f, dtype=jnp.int32) - offsets[owner_s]
-  send = jnp.full((num_parts, f), INVALID_ID, ids.dtype)
-  send = send.at[owner_s, rank].set(ids_s)
-  slot_p = jnp.zeros((f,), jnp.int32).at[perm].set(owner_s)
-  slot_j = jnp.zeros((f,), jnp.int32).at[perm].set(rank)
+  fits = (rank < cap) & (owner_s < num_parts)
+  send = jnp.full((num_parts, cap), INVALID_ID, ids.dtype)
+  # non-fitting entries scatter to row `num_parts`, dropped by XLA
+  send = send.at[jnp.where(fits, owner_s, num_parts),
+                 jnp.where(fits, rank, 0)].set(ids_s, mode='drop')
+  slot_p = jnp.zeros((f,), jnp.int32).at[perm].set(
+      jnp.where(owner_s < num_parts, owner_s, 0))
+  slot_j = jnp.full((f,), -1, jnp.int32).at[perm].set(
+      jnp.where(fits, rank, -1))
   return send, slot_p, slot_j
 
 
 def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
                   k: int, key, axis: str, num_parts: int,
-                  with_edge: bool, sort_locality: bool = True):
-  """One distributed hop for this device's ``frontier`` ids."""
+                  with_edge: bool, sort_locality: bool = True,
+                  exchange_capacity: Optional[int] = None):
+  """One distributed hop for this device's ``frontier`` ids.
+
+  ``exchange_capacity`` caps the per-destination exchange width
+  (default: the full frontier — ~P x padding with balanced buckets);
+  overflowed frontier entries sample nothing this hop (masked).
+  """
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
   owner = (jnp.searchsorted(bounds, frontier, side='right') - 1).astype(
       jnp.int32)
-  send, slot_p, slot_j = bucket_by_owner(frontier, owner, num_parts, my_idx)
-  recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)     # [P, F]
+  send, slot_p, slot_j = bucket_by_owner(frontier, owner, num_parts,
+                                         my_idx, exchange_capacity)
+  c = send.shape[1]
+  recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)     # [P, C]
   flat = recv.reshape(-1)
   local = jnp.where(flat >= 0, flat - my_start, INVALID_ID).astype(jnp.int32)
   res = sample_one_hop(indptr_loc, indices_loc, local, k,
                        jax.random.fold_in(key, my_idx), eids_loc,
                        with_edge_ids=with_edge,
                        sort_locality=sort_locality)
-  f = frontier.shape[0]
-  nbrs = jax.lax.all_to_all(res.nbrs.reshape(num_parts, f, k),
+  kept = slot_j >= 0
+  sj = jnp.where(kept, slot_j, 0)
+  nbrs = jax.lax.all_to_all(res.nbrs.reshape(num_parts, c, k),
                             axis, 0, 0, tiled=True)
-  mask = jax.lax.all_to_all(res.mask.reshape(num_parts, f, k),
+  mask = jax.lax.all_to_all(res.mask.reshape(num_parts, c, k),
                             axis, 0, 0, tiled=True)
-  out_nbrs = nbrs[slot_p, slot_j]                              # [F, k]
-  out_mask = mask[slot_p, slot_j]
+  out_nbrs = jnp.where(kept[:, None], nbrs[slot_p, sj], INVALID_ID)
+  out_mask = mask[slot_p, sj] & kept[:, None]
   out_eids = None
   if with_edge:
-    eids = jax.lax.all_to_all(res.eids.reshape(num_parts, f, k),
+    eids = jax.lax.all_to_all(res.eids.reshape(num_parts, c, k),
                               axis, 0, 0, tiled=True)
-    out_eids = eids[slot_p, slot_j]
+    out_eids = jnp.where(kept[:, None], eids[slot_p, sj], INVALID_ID)
   return out_nbrs, out_mask, out_eids
 
 
-def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int):
+def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
+                      exchange_capacity: Optional[int] = None):
   """Distributed row gather from several range-sharded tables that
   share ``bounds``: ``out_t[i] = table_t[ids[i]]`` (the collective-era
   `DistFeature.async_get`, `distributed/dist_feature.py:134-269`).
 
   The id bucketing and request all_to_all run ONCE for all tables —
   feature + label collection share a single exchange.  Invalid ids
-  (-1) return zero rows.
+  (-1) return zero rows; ids past ``exchange_capacity`` per owner
+  return zero rows too (callers choosing a capacity accept that tail).
   """
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
   owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(jnp.int32)
-  send, slot_p, slot_j = bucket_by_owner(ids, owner, num_parts, my_idx)
+  send, slot_p, slot_j = bucket_by_owner(ids, owner, num_parts, my_idx,
+                                         exchange_capacity)
+  cw = send.shape[1]
   recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)
   flat = recv.reshape(-1)
   valid = flat >= 0
   local = jnp.where(valid, flat - my_start, 0)
-  c = ids.shape[0]
+  kept = slot_j >= 0
+  sj = jnp.where(kept, slot_j, 0)
+  ok = (ids >= 0) & kept
   outs = []
   for shard_loc in shard_locs:
     idx = jnp.clip(local, 0, shard_loc.shape[0] - 1)
@@ -126,13 +159,13 @@ def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int):
     else:
       rows = jnp.where(valid[:, None], rows, 0)
     reply = jax.lax.all_to_all(
-        rows.reshape((num_parts, c) + rows.shape[1:]), axis, 0, 0,
+        rows.reshape((num_parts, cw) + rows.shape[1:]), axis, 0, 0,
         tiled=True)
-    out = reply[slot_p, slot_j]
+    out = reply[slot_p, sj]
     if out.ndim == 1:
-      outs.append(jnp.where(ids >= 0, out, 0))
+      outs.append(jnp.where(ok, out, 0))
     else:
-      outs.append(jnp.where((ids >= 0)[:, None], out, 0))
+      outs.append(jnp.where(ok[:, None], out, 0))
   return tuple(outs)
 
 
@@ -168,9 +201,21 @@ def cache_overlay(gathered, ids, cache_ids_loc, cache_rows_loc):
 def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     node_cap: int, with_edge: bool, collect_features: bool,
                     collect_labels: bool, axis: str = 'data',
-                    with_cache: bool = False):
-  """Build the jitted SPMD sample(+collect) step."""
+                    with_cache: bool = False,
+                    exchange_slack: Optional[float] = None):
+  """Build the jitted SPMD sample(+collect) step.
+
+  ``exchange_slack``: per-destination exchange capacity as a multiple
+  of the balanced share (``frontier/P``); None = uncapped (full
+  frontier width, ~P x padding).  See `bucket_by_owner`.
+  """
   from .shard_map_compat import shard_map
+
+  def _cap(n: int) -> Optional[int]:
+    if exchange_slack is None:
+      return None
+    return int(round_up(min(n, int(np.ceil(n / num_parts
+                                           * exchange_slack))), 8))
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
                  lshard_s, cids_s, crows_s, key):
@@ -198,7 +243,8 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
       hop_key = jax.random.fold_in(key, h)
       nbrs, mask, e = _dist_one_hop(
           indptr, indices, eids, bounds, frontier, int(k), hop_key,
-          axis, num_parts, with_edge)
+          axis, num_parts, with_edge,
+          exchange_capacity=_cap(frontier.shape[0]))
       state, rows, cols, prev_cnt = induce_next(
           state, frontier_local, nbrs, mask)
       rows_acc.append(rows)
@@ -222,7 +268,8 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
               + ((lshard,) if collect_labels else ()))
     if tables:
       got = list(dist_gather_multi(tables, bounds, state.nodes, axis,
-                                   num_parts))
+                                   num_parts,
+                                   exchange_capacity=_cap(node_cap)))
       if collect_features:
         x = got.pop(0)
         if with_cache:
@@ -272,7 +319,7 @@ class DistNeighborSampler:
   def __init__(self, dataset: DistDataset, num_neighbors,
                mesh: Optional[Mesh] = None, axis: str = 'data',
                with_edge: bool = False, collect_features: bool = True,
-               seed: int = 0):
+               seed: int = 0, exchange_slack: Optional[float] = None):
     from .dp import make_mesh
     self.ds = dataset
     self.fanouts = tuple(int(k) for k in num_neighbors)
@@ -285,6 +332,11 @@ class DistNeighborSampler:
     self.collect_labels = dataset.node_labels is not None
     self.with_cache = (self.collect_features
                        and dataset.node_features.has_cache)
+    # SURVEY §7 "partition-aware capacity tuning": e.g. 2.0 sends
+    # 2x the balanced share per destination instead of the full
+    # frontier (P/2 x fewer exchanged bytes); overflowed ids lose
+    # their neighbors/features that hop — opt-in, None = exact.
+    self.exchange_slack = exchange_slack
     self._base_key = jax.random.key(seed)
     self._step_cnt = 0
     self._steps = {}
@@ -329,7 +381,8 @@ class DistNeighborSampler:
       self._steps[cfg] = _make_dist_step(
           self.mesh, self.num_parts, self.fanouts, node_cap,
           self.with_edge, self.collect_features, self.collect_labels,
-          self.axis, with_cache=self.with_cache)
+          self.axis, with_cache=self.with_cache,
+          exchange_slack=self.exchange_slack)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -358,11 +411,13 @@ class DistNeighborLoader:
                batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
-               seed: int = 0, input_space: str = 'old'):
+               seed: int = 0, input_space: str = 'old',
+               exchange_slack: Optional[float] = None):
     from ..loader.node_loader import SeedBatcher
     self.sampler = DistNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
-        collect_features=collect_features, seed=seed)
+        collect_features=collect_features, seed=seed,
+        exchange_slack=exchange_slack)
     self.ds = dataset
     seeds = np.asarray(input_nodes).reshape(-1)
     if input_space == 'old' and dataset.old2new is not None:

@@ -192,3 +192,88 @@ def test_partition_dir_cache_roundtrip(tmp_path):
       valid = nodes[p] >= 0
       np.testing.assert_array_equal(
           x[p][valid][:, 0], new2old[nodes[p][valid]].astype(np.float32))
+
+
+def test_exchange_capacity_lossless_with_slack():
+  """With balanced buckets and 2x slack the capped exchange returns
+  exactly the uncapped results (bytes shrink, nothing drops)."""
+  ds = _ring_dist_dataset(4)
+  mesh = make_mesh(4)
+  a = DistNeighborSampler(ds, [2, 2], mesh=mesh, seed=0)
+  b = DistNeighborSampler(ds, [2, 2], mesh=mesh, seed=0,
+                          exchange_slack=2.0)
+  seeds = ds.old2new[np.arange(16).reshape(4, 4)]
+  oa = a.sample_from_nodes(seeds)
+  ob = b.sample_from_nodes(seeds)
+  for k in ('node', 'row', 'col', 'x', 'y'):
+    np.testing.assert_array_equal(np.asarray(oa[k]), np.asarray(ob[k]))
+
+
+def test_bucket_capacity_drops_overflow_not_valid_ids():
+  """Direct bucket_by_owner contract under a cap smaller than one
+  owner's load: exactly `cap` ids of the hot owner survive, invalid
+  ids never consume slots, and dropped ids get slot_j == -1."""
+  from functools import partial
+  from graphlearn_tpu.parallel.dist_sampler import bucket_by_owner
+  from graphlearn_tpu.parallel.shard_map_compat import shard_map
+  from jax.sharding import PartitionSpec as P
+
+  num_parts = 2
+  mesh = make_mesh(num_parts)
+  # device row: 5 ids for owner 1, one invalid FIRST, 2 for owner 0
+  ids = np.tile(np.array([-1, 10, 11, 12, 13, 14, 2, 3], np.int32),
+                (num_parts, 1))
+  owner = np.tile(np.array([0, 1, 1, 1, 1, 1, 0, 0], np.int32),
+                  (num_parts, 1))
+
+  def run(ids_s, owner_s):
+    send, slot_p, slot_j = bucket_by_owner(
+        ids_s[0], owner_s[0], num_parts,
+        jax.lax.axis_index('data'), capacity=3)
+    return send[None], slot_p[None], slot_j[None]
+
+  sh = P('data')
+  f = jax.jit(shard_map(run, mesh=mesh, in_specs=(sh, sh),
+                        out_specs=(sh, sh, sh)))
+  send, slot_p, slot_j = (np.asarray(v) for v in f(ids, owner))
+  d = 0
+  # owner 0 had 2 valid ids (+1 invalid that must NOT take a slot)
+  assert set(send[d, 0][send[d, 0] >= 0]) == {2, 3}
+  # owner 1 had 5 ids, cap 3: exactly the first 3 survive
+  np.testing.assert_array_equal(send[d, 1], [10, 11, 12])
+  # dropped: ids 13, 14 and the invalid id -> slot_j -1
+  dropped = slot_j[d] < 0
+  np.testing.assert_array_equal(ids[0][dropped], [-1, 13, 14])
+  # surviving slots point at their id
+  for i in np.nonzero(~dropped)[0]:
+    assert send[d, slot_p[d, i], slot_j[d, i]] == ids[0, i]
+
+
+def test_exchange_capacity_drops_are_masked():
+  """A skewed workload (every seed targets partition 0's range) with a
+  small slack: real drops happen, survivors stay correct."""
+  ds = _ring_dist_dataset(4, contiguous=True)
+  mesh = make_mesh(4)
+  s = DistNeighborSampler(ds, [2], mesh=mesh, seed=0,
+                          exchange_slack=0.5)
+  # 16 seeds per device, ALL in partition 0's range [0, 16): buckets
+  # are maximally skewed, caps bind hard
+  seeds = ds.old2new[np.tile(np.arange(16), (4, 1))]
+  out = s.sample_from_nodes(seeds)
+  rows = np.asarray(out['row'])
+  cols = np.asarray(out['col'])
+  nodes = np.asarray(out['node'])
+  new2old = ds.new2old
+  survived = 0
+  for p in range(4):
+    m = rows[p] >= 0
+    for r, c in zip(rows[p][m], cols[p][m]):
+      u = new2old[nodes[p, c]]
+      v = new2old[nodes[p, r]]
+      assert (v - u) % N in (1, 2)     # still a real ring edge
+      survived += 1
+  # the uncapped run yields 2 edges/seed; drops must actually occur
+  uncapped = DistNeighborSampler(ds, [2], mesh=mesh, seed=0)
+  out_u = uncapped.sample_from_nodes(seeds)
+  full = int((np.asarray(out_u['row']) >= 0).sum())
+  assert 0 < survived < full
