@@ -65,7 +65,11 @@ def main():
 
   # Best of 3 repetitions: the sampling program is deterministic-cost;
   # repetition suppresses host/dispatch jitter (which otherwise swings
-  # the measurement several-fold on tunneled chips).
+  # the measurement several-fold on tunneled chips).  Edge counting
+  # happens ON DEVICE (one scalar pull per rep): bulk device->host
+  # pulls permanently degrade tunneled dispatch (benchmarks/README,
+  # "first-burst validity"), which would poison reps 2-3.
+  import jax.numpy as jnp
   best_dt, edges = None, 0
   for _ in range(3):
     t0 = time.perf_counter()
@@ -77,9 +81,9 @@ def main():
     dt = time.perf_counter() - t0
     if best_dt is None or dt < best_dt:
       best_dt = dt
-      # Count actually-sampled (valid) edges on host, outside the timer.
-      edges = sum(int(np.asarray(o.edge_mask).sum()) for o in outs)
-
+      edges_dev = sum((o.edge_mask.sum() for o in outs),
+                      jnp.zeros((), jnp.int32))
+      edges = int(edges_dev)       # single tiny transfer, post-timer
   eps = edges / best_dt
   print(json.dumps({
       'metric': f'sampled_edges_per_sec (fanout {list(FANOUT)}, '
