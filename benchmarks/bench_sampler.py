@@ -82,12 +82,17 @@ def main():
 
   from benchmarks.common import run_in_fresh_process
   build_graph(200_000 if args.quick else NUM_NODES)   # warm the cache
+  failed = 0
   for fanout, batch in CONFIGS:
     extra = (['--quick'] if args.quick else []) + \
             (['--cpu'] if args.cpu else [])
-    run_in_fresh_process(
+    ok = run_in_fresh_process(
         __file__, ['--one', ','.join(map(str, fanout)) + f':{batch}']
         + extra)
+    failed += not ok
+  if failed:
+    print(f'{failed}/{len(CONFIGS)} configs failed', file=sys.stderr)
+    sys.exit(1)
 
 
 if __name__ == '__main__':
