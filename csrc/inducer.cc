@@ -142,4 +142,35 @@ void glt_inducer_nodes_since(void* h, int64_t start, int64_t n,
   memcpy(out, ind->nodes().data() + start, sizeof(int64_t) * n);
 }
 
+// One HETERO hop: the frontier lives in a *different* (source-type)
+// table, so its local ids are passed in directly; neighbors insert
+// into THIS (destination-type) table.  Counterpart of the reference's
+// per-node-type hetero inducer (`csrc/cpu/inducer.cc`, hetero variants
+// keyed by type at `csrc/cuda/inducer.cu:149+`).  src_local [B] are
+// seed-side local ids (already -1 for invalid slots); nbrs/mask [B,k]
+// are destination-type globals.  Emits neighbor->seed local COO (row =
+// dst-table local, col = src-table local) and returns the number of
+// new unique nodes appended to this table.
+int64_t glt_inducer_induce_pair(void* dst_h, const int32_t* src_local,
+                                const int64_t* nbrs, const uint8_t* mask,
+                                int64_t batch, int64_t k,
+                                int32_t* row_local, int32_t* col_local) {
+  auto* dst = static_cast<Inducer*>(dst_h);
+  int64_t before = (int64_t)dst->nodes().size();
+  for (int64_t b = 0; b < batch; ++b) {
+    int32_t sl = src_local[b];
+    for (int64_t j = 0; j < k; ++j) {
+      int64_t idx = b * k + j;
+      if (sl < 0 || !mask[idx] || nbrs[idx] == kInvalidId) {
+        row_local[idx] = -1;
+        col_local[idx] = -1;
+        continue;
+      }
+      row_local[idx] = dst->insert(nbrs[idx]);
+      col_local[idx] = sl;
+    }
+  }
+  return (int64_t)dst->nodes().size() - before;
+}
+
 }  // extern "C"

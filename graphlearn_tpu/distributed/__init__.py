@@ -26,8 +26,8 @@ from .dist_sampling_producer import (CollocatedSamplingProducer,
                                      MpSamplingProducer)
 from .dist_server import (DistServer, get_server, init_server,
                           wait_and_shutdown_server)
-from .host_dataset import HostDataset
-from .host_sampler import HostNeighborSampler
+from .host_dataset import HostDataset, HostHeteroDataset
+from .host_sampler import HostHeteroNeighborSampler, HostNeighborSampler
 
 __all__ = [
     'DistContext', 'DistRole', 'get_context', 'init_worker_group',
@@ -38,7 +38,8 @@ __all__ = [
     'CollocatedSamplingProducer', 'MpSamplingProducer',
     'DistServer', 'get_server', 'init_server', 'wait_and_shutdown_server',
     'DistClient', 'get_client', 'init_client', 'shutdown_client',
-    'HostDataset', 'HostNeighborSampler',
+    'HostDataset', 'HostHeteroDataset', 'HostNeighborSampler',
+    'HostHeteroNeighborSampler',
     'DistPartitionManager', 'DistRandomPartitioner', 'node_range',
     'DistTableRandomPartitioner',
 ]

@@ -99,8 +99,12 @@ class DistClient:
   def _create_one(self, idx: int, opts, fanouts, batch_size, seeds,
                   with_edge, shuffle, seed,
                   sampling_config=None) -> RemoteProducerHandle:
+    # dict-valued (per-edge-type) fanouts must survive the RPC intact;
+    # tuple keys pickle fine
+    fanouts = (dict(fanouts) if isinstance(fanouts, dict)
+               else list(fanouts))
     pid = self.request_server(
-        idx, 'create_sampling_producer', opts, list(fanouts),
+        idx, 'create_sampling_producer', opts, fanouts,
         int(batch_size), np.asarray(seeds), with_edge=with_edge,
         shuffle=shuffle, seed=seed, sampling_config=sampling_config)
     return RemoteProducerHandle(self, idx, pid)

@@ -16,7 +16,8 @@ import numpy as np
 
 from ..channel import (ChannelBase, MpChannel, RemoteReceivingChannel,
                        SampleMessage, ShmChannel)
-from ..loader.transform import Batch
+from ..loader.transform import Batch, HeteroBatch
+from ..typing import as_str, reverse_edge_type
 from ..utils.padding import (INVALID_ID, max_sampled_nodes,
                              next_power_of_two, round_up)
 from ..utils.profiling import metrics, trace
@@ -26,7 +27,7 @@ from .dist_options import (CollocatedDistSamplingWorkerOptions,
                            RemoteDistSamplingWorkerOptions)
 from .dist_sampling_producer import (CollocatedSamplingProducer,
                                      MpSamplingProducer)
-from .host_dataset import HostDataset
+from .host_dataset import HostDataset, HostHeteroDataset
 
 WorkerOptions = Union[CollocatedDistSamplingWorkerOptions,
                       MpDistSamplingWorkerOptions,
@@ -60,8 +61,25 @@ class DistLoader:
                worker_options: Optional[WorkerOptions] = None,
                with_edge: bool = False, to_device: bool = True,
                seed: int = 0, sampling_config=None):
-    self.fanouts = [int(k) for k in num_neighbors]
+    if isinstance(num_neighbors, dict):
+      self.fanouts = {tuple(k): [int(x) for x in v]
+                      for k, v in num_neighbors.items()}
+    else:
+      self.fanouts = [int(k) for k in num_neighbors]
     self.batch_size = int(batch_size)
+    # hetero node seeds come as ``(node_type, ids)`` (the reference's
+    # hetero ``input_nodes`` contract, `loader/node_loader.py`)
+    if (isinstance(input_nodes, tuple) and len(input_nodes) == 2
+        and isinstance(input_nodes[0], str)):
+      ntype, input_nodes = input_nodes
+      if sampling_config is None:
+        sampling_config = HostSamplingConfig(sampling_type='node',
+                                             input_type=ntype)
+      elif sampling_config.input_type is None:
+        # copy: the caller's config object may be shared across loaders
+        import dataclasses
+        sampling_config = dataclasses.replace(sampling_config,
+                                              input_type=ntype)
     seeds = np.asarray(input_nodes)
     self.seeds = seeds if seeds.ndim > 1 else seeds.reshape(-1)
     self.shuffle = shuffle
@@ -73,16 +91,37 @@ class DistLoader:
     self._epoch_iter = None
     self._expected = 0
     self._received = 0
-    # link/subgraph modes feed more node seeds into expansion per
-    # seed-batch slot (endpoints + negatives)
-    exp_seeds = (sampling_config.expansion_seeds(self.batch_size)
-                 if sampling_config is not None else self.batch_size)
-    self.node_cap = round_up(
-        min(max_sampled_nodes(exp_seeds, self.fanouts),
-            exp_seeds + (dataset.num_nodes if dataset else 1 << 30)),
-        8)
-    self.edge_cap = edge_capacity(exp_seeds, self.fanouts)
-    self.batch_cap = exp_seeds
+    self.is_hetero = isinstance(dataset, HostHeteroDataset)
+    meta = None
+    if dataset is None and isinstance(self.opts,
+                                      RemoteDistSamplingWorkerOptions):
+      # remote mode without a local dataset: the server's meta carries
+      # what capacity planning needs (reference loaders likewise fetch
+      # `get_dataset_meta` first, `dist_loader.py:202`)
+      from .dist_client import get_client
+      client = get_client()
+      if client is not None:
+        sr = self.opts.server_rank
+        idx = (sr[0] if isinstance(sr, (list, tuple)) else (sr or 0))
+        meta = client.get_dataset_meta(idx)
+        self.is_hetero = bool(meta.get('hetero'))
+    if self.is_hetero:
+      etypes = (dataset.edge_types if dataset is not None
+                else tuple(tuple(e) for e in meta['edge_types']))
+      num_nodes = (dataset.num_nodes if dataset is not None
+                   else meta['num_nodes'])
+      self._init_hetero_caps(etypes, num_nodes)
+    else:
+      # link/subgraph modes feed more node seeds into expansion per
+      # seed-batch slot (endpoints + negatives)
+      exp_seeds = (sampling_config.expansion_seeds(self.batch_size)
+                   if sampling_config is not None else self.batch_size)
+      self.node_cap = round_up(
+          min(max_sampled_nodes(exp_seeds, self.fanouts),
+              exp_seeds + (dataset.num_nodes if dataset else 1 << 30)),
+          8)
+      self.edge_cap = edge_capacity(exp_seeds, self.fanouts)
+      self.batch_cap = exp_seeds
 
     self.channel: Optional[ChannelBase] = None
     self._producer = None
@@ -111,6 +150,37 @@ class DistLoader:
           dataset, self.fanouts, self.batch_size, with_edge=with_edge,
           collect_features=self.opts.collect_features, shuffle=shuffle,
           seed=seed, sampling_config=sampling_config)
+
+  def _init_hetero_caps(self, etypes, num_nodes) -> None:
+    """Static per-type capacity plan for hetero collation — the same
+    planner the device hetero sampler compiles against
+    (`sampler/hetero_neighbor_sampler.py::_plan_capacities`)."""
+    from ..sampler.hetero_neighbor_sampler import (_plan_capacities,
+                                                   normalize_fanouts)
+    cfg = self.sampling_config
+    if cfg is not None and cfg.sampling_type == 'subgraph':
+      # the reference's SubGraphOp is homogeneous-only
+      # (`include/subgraph_op_base.h`); reject at construction, not
+      # as an opaque worker crash at iteration time
+      raise ValueError('subgraph sampling is homogeneous-only')
+    assert cfg is not None and cfg.input_type is not None, (
+        'hetero loading needs a seed type: pass input_nodes=(ntype, ids) '
+        'or edge_label_index=(etype, pairs)')
+    etypes, fanouts, num_hops = normalize_fanouts(tuple(etypes),
+                                                  self.fanouts)
+    input_sizes = cfg.hetero_input_sizes(self.batch_size)
+    ntypes, table_cap, _, edge_caps = _plan_capacities(
+        etypes, fanouts, input_sizes, num_hops, dict(num_nodes))
+    self.h_ntypes = ntypes
+    self.h_node_cap = table_cap
+    self.h_seed_cap = input_sizes
+    self.h_edge_cap = {}
+    for et in etypes:
+      total = sum(ec.get(et, 0) for ec in edge_caps)
+      if total > 0:
+        self.h_edge_cap[reverse_edge_type(et)] = round_up(total, 8)
+    self.h_num_hops = num_hops
+    self.batch_cap = self.batch_size
 
   def _num_batches(self) -> int:
     n = len(self.seeds)
@@ -182,7 +252,9 @@ class DistLoader:
         return msg
 
   # -- message -> static-shape Batch (reference `dist_loader.py:286-383`) --
-  def _collate_fn(self, msg: SampleMessage) -> Batch:
+  def _collate_fn(self, msg: SampleMessage):
+    if int(np.asarray(msg.get('#IS_HETERO', 0))):
+      return self._collate_hetero(msg)
     nc, ec = self.node_cap, self.edge_cap
     ids = msg['ids']
     c = len(ids)
@@ -215,6 +287,77 @@ class DistLoader:
         batch=batch, batch_size=self.batch_size,
         num_sampled_nodes=msg.get('num_sampled_nodes'),
         metadata=self._collate_metadata(msg))
+    if self.to_device:
+      out = jax.device_put(out)
+    return out
+
+  def _collate_hetero(self, msg: SampleMessage) -> HeteroBatch:
+    """Flat hetero message -> static-shape `HeteroBatch` (the hetero
+    arm of reference `dist_loader.py:286-383`, keys ``f'{type}.x'``
+    etc.).  Every batch pads to the SAME per-type capacities so the
+    training step compiles once."""
+    node_d, nm_d, x_d, y_d = {}, {}, {}, {}
+    md = {'seed_local': {}, 'num_sampled_nodes': {}}
+    for nt in self.h_ntypes:
+      cap = self.h_node_cap[nt]
+      ids = msg.get(f'{nt}.ids')
+      node = np.full(cap, INVALID_ID, np.int32)
+      c = 0
+      if ids is not None:
+        c = len(ids)
+        node[:c] = ids
+      node_d[nt] = node
+      nm_d[nt] = node >= 0
+      feats = msg.get(f'{nt}.nfeats')
+      if feats is not None:
+        x = np.zeros((cap, feats.shape[1]), feats.dtype)
+        x[:c] = feats
+        x_d[nt] = x
+      labels = msg.get(f'{nt}.nlabels')
+      if labels is not None:
+        y = np.zeros(cap, labels.dtype)
+        y[:c] = labels
+        y_d[nt] = y
+      sl = msg.get(f'{nt}.seed_local')
+      if sl is not None:
+        out = np.full(self.h_seed_cap.get(nt, len(sl)), INVALID_ID,
+                      np.int64)
+        out[:len(sl)] = sl
+        md['seed_local'][nt] = out
+      ns = msg.get(f'{nt}.num_sampled')
+      if ns is not None:
+        md['num_sampled_nodes'][nt] = ns
+    ei_d, em_d, edge_d = {}, {}, {}
+    for et, ecap in self.h_edge_cap.items():
+      key = as_str(et)
+      rows = msg.get(f'{key}.rows')
+      edge_index = np.full((2, ecap), INVALID_ID, np.int32)
+      if rows is not None:
+        e = len(rows)
+        edge_index[0, :e] = rows
+        edge_index[1, :e] = msg[f'{key}.cols']
+        eids = msg.get(f'{key}.eids')
+        if eids is not None:
+          ev = np.full(ecap, INVALID_ID, np.int64)
+          ev[:e] = eids
+          edge_d[et] = ev
+      ei_d[et] = edge_index
+      em_d[et] = edge_index[0] >= 0
+    cfg = self.sampling_config
+    seed_t = cfg.input_type
+    batch_t = seed_t if isinstance(seed_t, str) else seed_t[0]
+    batch = np.full(self.batch_cap, INVALID_ID, np.int64)
+    batch[:len(msg['batch'])] = msg['batch']
+    extra = self._collate_metadata(msg)
+    extra.pop('seed_local', None)    # homo key; hetero built per type
+    md.update(extra)
+    if edge_d:
+      md['edge_dict'] = edge_d
+    out = HeteroBatch(
+        x_dict=x_d, y_dict=y_d, edge_index_dict=ei_d, node_dict=node_d,
+        node_mask_dict=nm_d, edge_mask_dict=em_d,
+        batch_dict={batch_t: batch}, batch_size=self.batch_size,
+        metadata=md)
     if self.to_device:
       out = jax.device_put(out)
     return out
@@ -293,6 +436,16 @@ class DistLinkNeighborLoader(DistLoader):
 
   def __init__(self, dataset, num_neighbors, edge_label_index,
                edge_label=None, neg_sampling=None, **kwargs):
+    input_type = None
+    if (isinstance(edge_label_index, (tuple, list))
+        and len(edge_label_index) == 2
+        and isinstance(edge_label_index[0], (tuple, list))
+        and len(edge_label_index[0]) == 3
+        and all(isinstance(t, str) for t in edge_label_index[0])):
+      # hetero seeds: (edge_type, pairs) — the reference's hetero
+      # `edge_label_index` contract (`loader/link_loader.py`)
+      input_type, edge_label_index = edge_label_index
+      input_type = tuple(input_type)
     if isinstance(edge_label_index, (tuple, list)):
       rows, cols = edge_label_index
     else:
@@ -315,7 +468,7 @@ class DistLinkNeighborLoader(DistLoader):
       cols_arr.append(lab)
     seeds = np.stack(cols_arr, axis=1)
     cfg = HostSamplingConfig(sampling_type='link', neg_mode=mode,
-                             neg_amount=amount)
+                             neg_amount=amount, input_type=input_type)
     super().__init__(dataset, num_neighbors, seeds,
                      sampling_config=cfg, **kwargs)
 

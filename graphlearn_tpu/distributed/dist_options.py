@@ -36,10 +36,13 @@ class HostSamplingConfig:
       optional third label column), or ``'subgraph'`` (induced
       enclosing subgraphs).
     neg_mode / neg_amount: link-mode negative sampling spec.
+    input_type: hetero seed type — a node type (node mode) or an edge
+      type 3-tuple (link mode); None for homogeneous datasets.
   """
   sampling_type: str = 'node'
   neg_mode: Optional[str] = None       # 'binary' | 'triplet'
   neg_amount: float = 1.0
+  input_type: Union[str, tuple, None] = None
 
   def expansion_seeds(self, batch_size: int) -> int:
     """EXACT number of node seeds entering multi-hop expansion for a
@@ -60,6 +63,28 @@ class HostSamplingConfig:
     if self.neg_mode == 'binary':
       return b + binary_num_negatives(b, self.neg_amount)
     return b
+
+  def hetero_input_sizes(self, batch_size: int) -> dict:
+    """Per-node-type seed counts entering hetero multi-hop expansion —
+    the ``input_sizes`` of the capacity plan.  Node mode seeds one
+    type; link mode seeds the input edge type's two endpoint types
+    (merged when the relation is type-homophilous)."""
+    b = int(batch_size)
+    if self.sampling_type != 'link':
+      assert isinstance(self.input_type, str), (
+          'hetero node sampling needs a node-type input_type')
+      return {self.input_type: b}
+    s, _, d = self.input_type
+    if self.neg_mode == 'binary':
+      nn = binary_num_negatives(b, self.neg_amount)
+      src_n, dst_n = b + nn, b + nn
+    elif self.neg_mode == 'triplet':
+      src_n, dst_n = b, b + b * int(np.ceil(self.neg_amount))
+    else:
+      src_n, dst_n = b, b
+    if s == d:
+      return {s: src_n + dst_n}
+    return {s: src_n, d: dst_n}
 
 
 @dataclass

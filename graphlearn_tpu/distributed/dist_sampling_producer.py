@@ -19,8 +19,8 @@ import numpy as np
 
 from ..channel.base import ChannelBase
 from .dist_options import MpDistSamplingWorkerOptions
-from .host_dataset import HostDataset
-from .host_sampler import HostNeighborSampler
+from .host_dataset import HostDataset, HostHeteroDataset
+from .host_sampler import HostHeteroNeighborSampler, HostNeighborSampler
 
 
 class MpCommand(enum.Enum):
@@ -28,19 +28,43 @@ class MpCommand(enum.Enum):
   STOP = 1
 
 
-def _dispatch_sample(sampler: HostNeighborSampler, cfg, seeds_slice,
-                     batch_seed: int):
+def _make_sampler(dataset, fanouts, with_edge, collect_features, seed):
+  """Homo/hetero host sampler by dataset kind."""
+  cls = (HostHeteroNeighborSampler
+         if isinstance(dataset, HostHeteroDataset) else HostNeighborSampler)
+  return cls(dataset, fanouts, with_edge=with_edge,
+             collect_features=collect_features, seed=seed)
+
+
+def _dispatch_sample(sampler, cfg, seeds_slice, batch_seed: int):
   """NODE/LINK/SUBGRAPH dispatch (reference `SamplingType` switch in
   `_sampling_worker_loop`, `dist_sampling_producer.py:110-135`)."""
+  hetero = isinstance(sampler, HostHeteroNeighborSampler)
+  if hetero and (cfg is None or cfg.input_type is None):
+    raise ValueError(
+        'hetero sampling needs a HostSamplingConfig with input_type '
+        '(the seed node type, or the seed edge type in link mode)')
   if cfg is None or cfg.sampling_type == 'node':
+    if hetero:
+      return sampler.sample_from_nodes(cfg.input_type, seeds_slice,
+                                       batch_seed=batch_seed)
     return sampler.sample_from_nodes(seeds_slice, batch_seed=batch_seed)
   if cfg.sampling_type == 'link':
     label = seeds_slice[:, 2] if seeds_slice.shape[1] > 2 else None
+    if hetero:
+      return sampler.sample_from_edges(
+          cfg.input_type, seeds_slice[:, 0], seeds_slice[:, 1],
+          label=label, neg_mode=cfg.neg_mode, neg_amount=cfg.neg_amount,
+          batch_seed=batch_seed)
     return sampler.sample_from_edges(
         seeds_slice[:, 0], seeds_slice[:, 1], label=label,
         neg_mode=cfg.neg_mode, neg_amount=cfg.neg_amount,
         batch_seed=batch_seed)
   if cfg.sampling_type == 'subgraph':
+    if hetero:
+      # the reference's SubGraphOp is homogeneous-only
+      # (`include/subgraph_op_base.h`); same boundary here
+      raise ValueError('subgraph sampling is homogeneous-only')
     return sampler.sample_subgraph(seeds_slice, batch_seed=batch_seed)
   raise ValueError(f'unknown sampling_type {cfg.sampling_type!r}')
 
@@ -50,9 +74,8 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
                           sampling_config=None):
   """Body of one sampling subprocess (reference `_sampling_worker_loop`,
   `dist_sampling_producer.py:52-144`)."""
-  sampler = HostNeighborSampler(
-      dataset, fanouts, with_edge=with_edge,
-      collect_features=collect_features, seed=seed * 7919 + rank)
+  sampler = _make_sampler(dataset, fanouts, with_edge, collect_features,
+                          seed * 7919 + rank)
   while True:
     try:
       cmd, payload = task_queue.get(timeout=5.0)
@@ -86,7 +109,9 @@ class MpSamplingProducer:
                seed: int = 0, sampling_config=None):
     self.opts = options or MpDistSamplingWorkerOptions()
     self.ds = dataset
-    self.fanouts = list(num_neighbors)
+    # keep dict-valued (per-edge-type) fanouts intact
+    self.fanouts = (dict(num_neighbors) if isinstance(num_neighbors, dict)
+                    else list(num_neighbors))
     self.batch_size = int(batch_size)
     self.channel = channel
     self.with_edge = with_edge
@@ -174,9 +199,8 @@ class CollocatedSamplingProducer:
                batch_size: int, with_edge: bool = False,
                collect_features: bool = True, shuffle: bool = False,
                seed: int = 0, sampling_config=None):
-    self.sampler = HostNeighborSampler(
-        dataset, num_neighbors, with_edge=with_edge,
-        collect_features=collect_features, seed=seed)
+    self.sampler = _make_sampler(dataset, num_neighbors, with_edge,
+                                 collect_features, seed)
     self.batch_size = int(batch_size)
     self.shuffle = shuffle
     self.sampling_config = sampling_config

@@ -39,7 +39,18 @@ class DistServer:
   # -- handlers ------------------------------------------------------------
   def get_dataset_meta(self):
     d = self.dataset
+    from .host_dataset import HostHeteroDataset
+    if isinstance(d, HostHeteroDataset):
+      return {
+          'hetero': True,
+          'num_nodes': dict(d.num_nodes),
+          'edge_types': [tuple(et) for et in d.edge_types],
+          'feature_dims': {nt: f.shape[1]
+                           for nt, f in d.node_features.items()},
+          'has_labels': {nt: True for nt in d.node_labels},
+      }
     return {
+        'hetero': False,
         'num_nodes': d.num_nodes, 'num_edges': d.num_edges,
         'feature_dim': (d.node_features.shape[1]
                         if d.node_features is not None else 0),

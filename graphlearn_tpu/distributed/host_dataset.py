@@ -88,3 +88,108 @@ class HostDataset:
     eids = p['graph'].eids[perm] if p['graph'].eids is not None else perm
     return cls(indptr, indices, edge_ids=eids, node_features=feats,
                node_labels=labels)
+
+
+class HostHeteroDataset:
+  """Per-edge-type CSR + per-node-type features/labels, host numpy.
+
+  The heterogeneous twin of `HostDataset` for sampling subprocesses —
+  the data the reference's hetero `DistNeighborSampler` reads through
+  its per-etype `DistGraph` (`distributed/dist_neighbor_sampler.py:
+  192-253` hetero path).
+
+  Attributes:
+    csr: ``{EdgeType: (indptr, indices, edge_ids)}`` in sampling
+      direction src→dst (``edge_ids`` may be None).
+    num_nodes: ``{NodeType: int}``.
+    node_features / node_labels: ``{NodeType: array}`` (optional).
+  """
+
+  def __init__(self, csr, num_nodes, node_features=None, node_labels=None):
+    self.csr = {}
+    for et, (indptr, indices, eids) in csr.items():
+      self.csr[tuple(et)] = (
+          np.ascontiguousarray(indptr, np.int64),
+          np.ascontiguousarray(indices, np.int64),
+          np.ascontiguousarray(eids, np.int64) if eids is not None
+          else None)
+    self.num_nodes = {nt: int(n) for nt, n in num_nodes.items()}
+    self.node_features = {nt: np.asarray(v) for nt, v in
+                          (node_features or {}).items()}
+    self.node_labels = {nt: np.asarray(v) for nt, v in
+                        (node_labels or {}).items()}
+
+  @property
+  def edge_types(self):
+    return tuple(self.csr.keys())
+
+  @property
+  def node_types(self):
+    return tuple(sorted({t for (s, _, d) in self.csr for t in (s, d)}
+                        | set(self.num_nodes)))
+
+  @classmethod
+  def from_coo(cls, edge_index_dict, num_nodes_dict=None,
+               node_features=None, node_labels=None) -> 'HostHeteroDataset':
+    """Build from ``{EdgeType: (rows, cols)}`` COO dicts."""
+    from ..native import coo_to_csr
+    num_nodes = dict(num_nodes_dict or {})
+    for (s, _, d), (rows, cols) in edge_index_dict.items():
+      rows, cols = np.asarray(rows), np.asarray(cols)
+      num_nodes[s] = max(num_nodes.get(s, 0),
+                         int(rows.max(initial=-1)) + 1)
+      num_nodes[d] = max(num_nodes.get(d, 0),
+                         int(cols.max(initial=-1)) + 1)
+    csr = {}
+    for et, (rows, cols) in edge_index_dict.items():
+      indptr, indices, perm = coo_to_csr(
+          np.asarray(rows), np.asarray(cols), num_nodes[et[0]])
+      csr[et] = (indptr, indices, perm)
+    return cls(csr, num_nodes, node_features=node_features,
+               node_labels=node_labels)
+
+  @classmethod
+  def from_dataset(cls, dataset) -> 'HostHeteroDataset':
+    """Borrow the host copies inside a hetero `graphlearn_tpu.data.Dataset`."""
+    assert dataset.is_hetero, 'use HostDataset for homogeneous datasets'
+    csr = {}
+    for et in dataset.get_edge_types():
+      topo = dataset.get_graph(et).csr_topo
+      csr[et] = (topo.indptr, topo.indices, topo.edge_ids)
+    feats = {}
+    for nt, f in (dataset.node_features or {}).items():
+      feats[nt] = f.host_get()
+    labels = {}
+    if isinstance(dataset.node_labels, dict):
+      for nt, lab in dataset.node_labels.items():
+        labels[nt] = np.asarray(lab)
+    return cls(csr, dataset.num_nodes_dict(), node_features=feats,
+               node_labels=labels)
+
+  @classmethod
+  def from_partition_dir(cls, root, partition_idx: int
+                         ) -> 'HostHeteroDataset':
+    """Load one hetero partition shard from the offline layout."""
+    from ..partition import load_partition
+    from ..native import coo_to_csr
+    p = load_partition(root, partition_idx)
+    assert p['meta']['hetero'], 'partition dir is homogeneous'
+    num_nodes = {nt: len(pb.table) for nt, pb in p['node_pb'].items()}
+    csr = {}
+    for et, g in p['graph'].items():
+      rows, cols = g.edge_index
+      indptr, indices, perm = coo_to_csr(rows, cols, num_nodes[et[0]])
+      eids = g.eids[perm] if g.eids is not None else perm
+      csr[et] = (indptr, indices, eids)
+    feats = {}
+    for nt, f in (p['node_feat'] or {}).items():
+      d = f.feats.shape[1]
+      full = np.zeros((num_nodes[nt], d), f.feats.dtype)
+      full[f.ids] = f.feats
+      feats[nt] = full
+    labels = {}
+    for nt, (lab, ids) in (p['node_label'] or {}).items():
+      full = np.zeros((num_nodes[nt],), lab.dtype)
+      full[ids] = lab
+      labels[nt] = full
+    return cls(csr, num_nodes, node_features=feats, node_labels=labels)

@@ -147,6 +147,9 @@ def _declare(l):
   l.glt_inducer_induce.argtypes = [p, i64p, i64p, u8p, i64, i64, i32p, i32p]
   l.glt_inducer_nodes_since.restype = None
   l.glt_inducer_nodes_since.argtypes = [p, i64, i64, i64p]
+  l.glt_inducer_induce_pair.restype = i64
+  l.glt_inducer_induce_pair.argtypes = [p, i32p, i64p, u8p, i64, i64,
+                                        i32p, i32p]
 
 
 # ---------------------------------------------------------------------------
@@ -421,8 +424,33 @@ class CpuInducer:
     return new_nodes, rows, cols
 
   def all_nodes(self) -> np.ndarray:
-    n = self.num_nodes
-    out = np.empty(n, np.int64)
-    if n:
-      self._l.glt_inducer_nodes_since(self._h, 0, n, out)
+    return self.nodes_since(0)
+
+  def nodes_since(self, start: int) -> np.ndarray:
+    """Global ids of table slots ``[start, num_nodes)`` in local-id
+    order — the nodes first discovered after a hop snapshot."""
+    n = self.num_nodes - int(start)
+    out = np.empty(max(n, 0), np.int64)
+    if n > 0:
+      self._l.glt_inducer_nodes_since(self._h, start, n, out)
     return out
+
+  def induce_from(self, src_local: np.ndarray, nbrs: np.ndarray,
+                  mask: np.ndarray):
+    """Hetero hop: the frontier's local ids come from a *different*
+    (source-type) inducer; neighbors insert into THIS table.  Returns
+    (new_nodes, row_local, col_local), edges neighbor->seed like
+    `induce_next`."""
+    src_local = np.ascontiguousarray(src_local, np.int32)
+    nbrs = np.ascontiguousarray(nbrs, np.int64)
+    mask = np.ascontiguousarray(mask, np.uint8)
+    b, k = nbrs.shape
+    rows = np.empty((b, k), np.int32)
+    cols = np.empty((b, k), np.int32)
+    before = self.num_nodes
+    n_new = self._l.glt_inducer_induce_pair(self._h, src_local, nbrs, mask,
+                                            b, k, rows, cols)
+    new_nodes = np.empty(n_new, np.int64)
+    if n_new:
+      self._l.glt_inducer_nodes_since(self._h, before, n_new, new_nodes)
+    return new_nodes, rows, cols

@@ -1,0 +1,256 @@
+"""Heterogeneous host-runtime loaders — collocated, mp and
+server-client modes (the hetero arm of the reference's distributed
+loader tests, `test/python/test_dist_neighbor_loader.py`, with the
+SURVEY §4 provenance trick: feature values encode their global id so
+correctness is checkable arithmetically from any process)."""
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native lib unavailable')
+
+NU, NI = 40, 25
+ET = ('u', 'to', 'i')
+REV = ('i', 'rev_to', 'u')
+
+
+def _bipartite():
+  """Deterministic u->i graph: u connects to (u % NI) and (u*3 % NI);
+  features encode 'type base + id' for provenance checks."""
+  from graphlearn_tpu.distributed import HostHeteroDataset
+  urow = np.repeat(np.arange(NU), 2)
+  icol = np.stack([np.arange(NU) % NI, (np.arange(NU) * 3) % NI],
+                  1).reshape(-1)
+  feats = {
+      'u': (np.arange(NU)[:, None] + np.zeros((1, 4))).astype(np.float32),
+      'i': (1000 + np.arange(NI)[:, None]
+            + np.zeros((1, 4))).astype(np.float32),
+  }
+  labels = {'u': (np.arange(NU) % 3).astype(np.int64)}
+  ds = HostHeteroDataset.from_coo(
+      {ET: (urow, icol), REV: (icol, urow)},
+      node_features=feats, node_labels=labels)
+  edge_set = set(zip(urow.tolist(), icol.tolist()))
+  return ds, edge_set, urow, icol
+
+
+def _check_batch(batch, edge_set):
+  u_ids = np.asarray(batch.node_dict['u'])
+  i_ids = np.asarray(batch.node_dict['i'])
+  xu = np.asarray(batch.x_dict['u'])
+  xi = np.asarray(batch.x_dict['i'])
+  assert np.all(xu[u_ids >= 0, 0] == u_ids[u_ids >= 0])
+  assert np.all(xi[i_ids >= 0, 0] == 1000 + i_ids[i_ids >= 0])
+  if 'u' in batch.y_dict:
+    yu = np.asarray(batch.y_dict['u'])
+    assert np.all(yu[u_ids >= 0] == u_ids[u_ids >= 0] % 3)
+  # u->i edges are emitted under the REVERSED etype, direction
+  # neighbor->seed: row = i-local, col = u-local
+  ei = np.asarray(batch.edge_index_dict[REV])
+  m = ei[0] >= 0
+  for a, b in zip(u_ids[ei[1, m]].tolist(), i_ids[ei[0, m]].tolist()):
+    assert (a, b) in edge_set
+  return int(m.sum())
+
+
+def test_collocated_hetero_node_loader():
+  from graphlearn_tpu.distributed import DistNeighborLoader
+  ds, edge_set, _, _ = _bipartite()
+  loader = DistNeighborLoader(ds, [2, 2], ('u', np.arange(NU)),
+                              batch_size=8, to_device=False)
+  total_edges = 0
+  for batch in loader:
+    total_edges += _check_batch(batch, edge_set)
+  assert total_edges > 0
+
+
+def test_collocated_hetero_per_etype_fanouts():
+  """Dict-valued num_neighbors restricts sampling to listed etypes."""
+  from graphlearn_tpu.distributed import DistNeighborLoader
+  ds, edge_set, _, _ = _bipartite()
+  loader = DistNeighborLoader(ds, {ET: [2], REV: [0]},
+                              ('u', np.arange(NU)), batch_size=8,
+                              to_device=False)
+  for batch in loader:
+    _check_batch(batch, edge_set)
+    # one hop u->i only: every u node is a seed, no second-hop u's
+    u_ids = np.asarray(batch.node_dict['u'])
+    assert int((u_ids >= 0).sum()) <= 8
+
+
+def test_collocated_hetero_link_binary():
+  from graphlearn_tpu.distributed import DistLinkNeighborLoader
+  ds, edge_set, urow, icol = _bipartite()
+  src, dst = urow[:32], icol[:32]
+  loader = DistLinkNeighborLoader(ds, [2, 2], (ET, (src, dst)),
+                                  neg_sampling='binary', batch_size=8,
+                                  to_device=False)
+  for batch in loader:
+    _check_batch(batch, edge_set)
+    eli = np.asarray(batch.metadata['edge_label_index'])
+    lab = np.asarray(batch.metadata['edge_label'])
+    mask = np.asarray(batch.metadata['edge_label_mask'])
+    u_ids = np.asarray(batch.node_dict['u'])
+    i_ids = np.asarray(batch.node_dict['i'])
+    gs = u_ids[eli[0, mask]]
+    gd = i_ids[eli[1, mask]]
+    pos = lab[mask] >= 1
+    assert pos.any() and (~pos).any()
+    for a, b, p in zip(gs.tolist(), gd.tolist(), pos.tolist()):
+      assert ((a, b) in edge_set) == bool(p)
+
+
+def test_collocated_hetero_link_triplet():
+  from graphlearn_tpu.distributed import DistLinkNeighborLoader
+  ds, edge_set, urow, icol = _bipartite()
+  src, dst = urow[:32], icol[:32]
+  loader = DistLinkNeighborLoader(ds, [2, 2], (ET, (src, dst)),
+                                  neg_sampling=('triplet', 2),
+                                  batch_size=8, to_device=False)
+  for batch in loader:
+    si = np.asarray(batch.metadata['src_index'])
+    dp = np.asarray(batch.metadata['dst_pos_index'])
+    dn = np.asarray(batch.metadata['dst_neg_index'])
+    pm = np.asarray(batch.metadata['pair_mask'])
+    u_ids = np.asarray(batch.node_dict['u'])
+    i_ids = np.asarray(batch.node_dict['i'])
+    gs = u_ids[si[pm]]
+    for a, b in zip(gs.tolist(), i_ids[dp[pm]].tolist()):
+      assert (a, b) in edge_set
+    for j, a in enumerate(gs.tolist()):
+      for b in i_ids[dn[pm][j]].tolist():
+        assert (a, b) not in edge_set
+
+
+def test_mp_hetero_loader_epochs():
+  from graphlearn_tpu.distributed import (DistNeighborLoader,
+                                          MpDistSamplingWorkerOptions)
+  ds, edge_set, _, _ = _bipartite()
+  # dict fanouts exercise the fanout-forwarding path into workers
+  loader = DistNeighborLoader(
+      ds, {ET: [2, 2], REV: [2, 2]}, ('u', np.arange(NU)), batch_size=8,
+      shuffle=True, to_device=False,
+      worker_options=MpDistSamplingWorkerOptions(num_workers=2))
+  try:
+    for _ in range(2):
+      seen = 0
+      for batch in loader:
+        _check_batch(batch, edge_set)
+        seen += int((np.asarray(batch.batch_dict['u']) >= 0).sum())
+      assert seen == NU
+  finally:
+    loader.shutdown()
+
+
+def _hetero_server_proc(port_q):
+  from graphlearn_tpu.distributed import (init_server,
+                                          wait_and_shutdown_server)
+  ds, _, _, _ = _bipartite()
+  srv = init_server(num_servers=1, num_clients=1, rank=0, dataset=ds,
+                    host='127.0.0.1', port=0)
+  port_q.put(srv.port)
+  wait_and_shutdown_server(timeout=60)
+
+
+def test_remote_hetero_loader():
+  """Client passes dataset=None: capacities come from the server's
+  hetero dataset meta."""
+  ctx = mp.get_context('fork')
+  port_q = ctx.Queue()
+  p = ctx.Process(target=_hetero_server_proc, args=(port_q,),
+                  daemon=False)
+  p.start()
+  port = port_q.get(timeout=30)
+
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  init_client([('127.0.0.1', port)], rank=0, num_clients=1)
+  _, edge_set, _, _ = _bipartite()
+  # dict fanouts must survive the client->server RPC intact
+  loader = DistNeighborLoader(
+      None, {ET: [2, 2], REV: [2, 2]}, ('u', np.arange(NU)), batch_size=8,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=0, num_workers=1, prefetch_size=2),
+      to_device=False)
+  for _ in range(2):
+    seen = 0
+    for batch in loader:
+      _check_batch(batch, edge_set)
+      seen += int((np.asarray(batch.batch_dict['u']) >= 0).sum())
+    assert seen == NU
+  loader.shutdown()
+  shutdown_client()
+  p.join(timeout=20)
+  assert not p.is_alive()
+
+
+def test_hetero_partition_roundtrip_host_dataset():
+  """Offline hetero partitions load into per-partition host datasets
+  with provenance intact."""
+  from graphlearn_tpu.distributed import (DistNeighborLoader,
+                                          HostHeteroDataset)
+  from graphlearn_tpu.partition import RandomPartitioner
+  import tempfile
+  ds, edge_set, urow, icol = _bipartite()
+  with tempfile.TemporaryDirectory() as root:
+    part = RandomPartitioner(
+        root, num_parts=2,
+        num_nodes={'u': NU, 'i': NI},
+        edge_index={ET: (urow, icol), REV: (icol, urow)},
+        node_feat={nt: ds.node_features[nt] for nt in ('u', 'i')},
+        node_label={'u': ds.node_labels['u']})
+    part.partition()
+    for idx in range(2):
+      shard = HostHeteroDataset.from_partition_dir(root, idx)
+      assert shard.num_nodes == {'u': NU, 'i': NI}
+      # every owned edge must be real (features zero-filled for
+      # non-owned rows, so only check edges)
+      indptr, indices, _ = shard.csr[ET]
+      for u in range(NU):
+        for j in range(indptr[u], indptr[u + 1]):
+          assert (u, int(indices[j])) in edge_set
+      # a loader over the shard's own seeds works end-to-end
+      owned_u = np.nonzero(
+          np.diff(indptr) > 0)[0] if idx == 0 else np.arange(NU)
+      if len(owned_u):
+        loader = DistNeighborLoader(shard, [2], ('u', owned_u[:8]),
+                                    batch_size=4, to_device=False)
+        for batch in loader:
+          ei = np.asarray(batch.edge_index_dict[REV])
+          u_ids = np.asarray(batch.node_dict['u'])
+          i_ids = np.asarray(batch.node_dict['i'])
+          m = ei[0] >= 0
+          for a, b in zip(u_ids[ei[1, m]].tolist(),
+                          i_ids[ei[0, m]].tolist()):
+            assert (a, b) in edge_set
+
+
+def test_hetero_error_paths_and_config_reuse():
+  from graphlearn_tpu.distributed import (CollocatedSamplingProducer,
+                                          DistNeighborLoader,
+                                          DistSubGraphLoader,
+                                          HostSamplingConfig)
+  ds, _, _, _ = _bipartite()
+  # hetero producer without an input_type fails loudly, not opaquely
+  prod = CollocatedSamplingProducer(ds, [2], batch_size=4)
+  with pytest.raises(ValueError, match='input_type'):
+    next(prod.epoch(np.arange(8)))
+  # hetero subgraph mode is rejected at construction
+  with pytest.raises(ValueError, match='homogeneous-only'):
+    DistSubGraphLoader(ds, [2], ('u', np.arange(8)), to_device=False)
+  # a config object shared across loaders is not mutated in place
+  cfg = HostSamplingConfig(sampling_type='node')
+  DistNeighborLoader(ds, [2], ('u', np.arange(8)), batch_size=4,
+                     sampling_config=cfg, to_device=False)
+  assert cfg.input_type is None
+  li = DistNeighborLoader(ds, [2], ('i', np.arange(8)), batch_size=4,
+                          sampling_config=cfg, to_device=False)
+  for batch in li:
+    i_ids = np.asarray(batch.node_dict['i'])
+    xi = np.asarray(batch.x_dict['i'])
+    assert np.all(xi[i_ids >= 0, 0] == 1000 + i_ids[i_ids >= 0])
