@@ -69,7 +69,7 @@ class DistLoader:
     self.batch_size = int(batch_size)
     # hetero node seeds come as ``(node_type, ids)`` (the reference's
     # hetero ``input_nodes`` contract, `loader/node_loader.py`)
-    if (isinstance(input_nodes, tuple) and len(input_nodes) == 2
+    if (isinstance(input_nodes, (tuple, list)) and len(input_nodes) == 2
         and isinstance(input_nodes[0], str)):
       ntype, input_nodes = input_nodes
       if sampling_config is None:
@@ -112,14 +112,24 @@ class DistLoader:
                    else meta['num_nodes'])
       self._init_hetero_caps(etypes, num_nodes)
     else:
+      if isinstance(self.fanouts, dict):
+        raise ValueError(
+            'dict-valued num_neighbors implies a hetero dataset: pass a '
+            'HostHeteroDataset, or init_client() first so the remote '
+            "server's hetero meta is reachable")
       # link/subgraph modes feed more node seeds into expansion per
       # seed-batch slot (endpoints + negatives)
       exp_seeds = (sampling_config.expansion_seeds(self.batch_size)
                    if sampling_config is not None else self.batch_size)
+      if dataset is not None:
+        num_nodes = dataset.num_nodes
+      elif meta is not None:
+        num_nodes = meta['num_nodes']
+      else:
+        num_nodes = 1 << 30
       self.node_cap = round_up(
           min(max_sampled_nodes(exp_seeds, self.fanouts),
-              exp_seeds + (dataset.num_nodes if dataset else 1 << 30)),
-          8)
+              exp_seeds + num_nodes), 8)
       self.edge_cap = edge_capacity(exp_seeds, self.fanouts)
       self.batch_cap = exp_seeds
 
@@ -332,15 +342,20 @@ class DistLoader:
       key = as_str(et)
       rows = msg.get(f'{key}.rows')
       edge_index = np.full((2, ecap), INVALID_ID, np.int32)
+      # every batch carries the SAME edge_dict key set (padded when an
+      # etype sampled nothing) so jitted consumers see one pytree
+      # structure across the epoch
+      ev = (np.full(ecap, INVALID_ID, np.int64)
+            if self.with_edge else None)
       if rows is not None:
         e = len(rows)
         edge_index[0, :e] = rows
         edge_index[1, :e] = msg[f'{key}.cols']
         eids = msg.get(f'{key}.eids')
-        if eids is not None:
-          ev = np.full(ecap, INVALID_ID, np.int64)
+        if ev is not None and eids is not None:
           ev[:e] = eids
-          edge_d[et] = ev
+      if ev is not None:
+        edge_d[et] = ev
       ei_d[et] = edge_index
       em_d[et] = edge_index[0] >= 0
     cfg = self.sampling_config
@@ -351,7 +366,7 @@ class DistLoader:
     extra = self._collate_metadata(msg)
     extra.pop('seed_local', None)    # homo key; hetero built per type
     md.update(extra)
-    if edge_d:
+    if self.with_edge:
       md['edge_dict'] = edge_d
     out = HeteroBatch(
         x_dict=x_d, y_dict=y_d, edge_index_dict=ei_d, node_dict=node_d,

@@ -407,10 +407,10 @@ class HostHeteroNeighborSampler:
     dst = np.ascontiguousarray(dst, np.int64)
     b = len(src)
     batch_seed = self._next_batch_seed(batch_seed)
-    indptr, _, _ = self.ds.csr[tuple(input_type)]
-    sind = self._sorted_for(tuple(input_type))
     if neg_mode == 'binary':
       from .dist_options import binary_num_negatives
+      indptr, _, _ = self.ds.csr[tuple(input_type)]
+      sind = self._sorted_for(tuple(input_type))
       num_neg = binary_num_negatives(b, neg_amount)
       nrows, ncols = strict_negative_pairs(
           indptr, sind, self.ds.num_nodes[s], self.ds.num_nodes[d],
@@ -419,6 +419,8 @@ class HostHeteroNeighborSampler:
       dst_seeds = np.concatenate([dst, ncols])
     elif neg_mode == 'triplet':
       amount = int(np.ceil(neg_amount))
+      indptr, _, _ = self.ds.csr[tuple(input_type)]
+      sind = self._sorted_for(tuple(input_type))
       negs = strict_negative_dsts(indptr, sind, src,
                                   self.ds.num_nodes[d], amount,
                                   seed=batch_seed * 31 + 7)

@@ -254,3 +254,28 @@ def test_hetero_error_paths_and_config_reuse():
     i_ids = np.asarray(batch.node_dict['i'])
     xi = np.asarray(batch.x_dict['i'])
     assert np.all(xi[i_ids >= 0, 0] == 1000 + i_ids[i_ids >= 0])
+
+
+def test_hetero_with_edge_static_pytree():
+  """Every batch carries the same edge_dict/edge_index key set even
+  when an etype samples nothing, so jitted consumers never retrace."""
+  import jax
+  from graphlearn_tpu.distributed import DistNeighborLoader
+  ds, edge_set, urow, icol = _bipartite()
+  # second hop only expands i->u, so batches where hop-1 found no new
+  # i nodes would otherwise drop the rev-etype keys
+  loader = DistNeighborLoader(ds, {ET: [2, 0], REV: [0, 2]},
+                              ('u', np.arange(NU)), batch_size=8,
+                              with_edge=True, to_device=False)
+  structs = set()
+  for batch in loader:
+    assert set(batch.metadata['edge_dict'].keys()) == set(
+        batch.edge_index_dict.keys())
+    structs.add(jax.tree_util.tree_structure(
+        jax.tree_util.tree_map(lambda a: a.shape, batch)))
+    # emitted global edge ids refer to real edges of the right etype
+    for et, ev in batch.metadata['edge_dict'].items():
+      ev = np.asarray(ev)
+      em = np.asarray(batch.edge_mask_dict[et])
+      assert np.all(ev[em] >= 0)
+  assert len(structs) == 1
