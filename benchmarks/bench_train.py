@@ -31,6 +31,8 @@ def main():
   ap.add_argument('--hidden', type=int, default=256)
   ap.add_argument('--classes', type=int, default=47)  # products classes
   ap.add_argument('--epochs', type=int, default=3)
+  ap.add_argument('--bf16', action='store_true',
+                  help='bfloat16 model compute (MXU half-width)')
   args = ap.parse_args()
   if args.epochs < 1:
     ap.error('--epochs must be >= 1 (epoch 0 is the untimed warmup)')
@@ -59,8 +61,10 @@ def main():
   bs = 1024
   loader = NeighborLoader(ds, [15, 10, 5], train_idx, batch_size=bs,
                           shuffle=True, seed=0)
+  import jax.numpy as jnp
   model = GraphSAGE(hidden_features=args.hidden, out_features=args.classes,
-                    num_layers=3)
+                    num_layers=3,
+                    dtype=jnp.bfloat16 if args.bf16 else None)
   tx = optax.adam(3e-3)
   state, apply_fn = create_train_state(
       model, jax.random.key(0), next(iter(loader)), tx)
@@ -80,6 +84,7 @@ def main():
   emit('train_epoch_secs', best, 's',
        seeds=len(train_idx), batch=bs,
        steps_per_sec=round(len(loader) / best, 2),
+       dtype='bf16' if args.bf16 else 'f32',
        platform=jax.devices()[0].platform)
 
 

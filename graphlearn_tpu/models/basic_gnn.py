@@ -24,6 +24,9 @@ class BasicGNN(nn.Module):
   num_layers: int = 2
   dropout: float = 0.0
   aggr: str = 'mean'
+  dtype: Optional[jnp.dtype] = None   # compute dtype (bfloat16 puts
+                                      # the matmuls on the MXU at half
+                                      # width; params/outputs stay f32)
 
   def make_conv(self, out_features: int, idx: int) -> nn.Module:
     raise NotImplementedError
@@ -38,7 +41,7 @@ class BasicGNN(nn.Module):
         x = nn.relu(x)
         if self.dropout > 0:
           x = nn.Dropout(self.dropout, deterministic=not train)(x)
-    return x
+    return x.astype(jnp.float32) if self.dtype is not None else x
 
 
 class GraphSAGE(BasicGNN):
@@ -46,13 +49,14 @@ class GraphSAGE(BasicGNN):
   `examples/train_sage_ogbn_products.py`: 3 layers, hidden 256)."""
 
   def make_conv(self, out_features: int, idx: int) -> nn.Module:
-    return SAGEConv(out_features, aggr=self.aggr, name=f'conv{idx}')
+    return SAGEConv(out_features, aggr=self.aggr, dtype=self.dtype,
+                    name=f'conv{idx}')
 
 
 class GCN(BasicGNN):
 
   def make_conv(self, out_features: int, idx: int) -> nn.Module:
-    return GCNConv(out_features, name=f'conv{idx}')
+    return GCNConv(out_features, dtype=self.dtype, name=f'conv{idx}')
 
 
 class GAT(BasicGNN):
@@ -61,4 +65,5 @@ class GAT(BasicGNN):
   def make_conv(self, out_features: int, idx: int) -> nn.Module:
     last = idx == self.num_layers - 1
     return GATConv(out_features if last else out_features // self.heads,
-                   heads=self.heads, concat=not last, name=f'conv{idx}')
+                   heads=self.heads, concat=not last, dtype=self.dtype,
+                   name=f'conv{idx}')

@@ -61,10 +61,14 @@ class SAGEConv(nn.Module):
   out_features: int
   use_bias: bool = True
   aggr: str = 'mean'
+  dtype: Optional[jnp.dtype] = None   # compute dtype (e.g. bfloat16
+                                      # for the MXU); params stay f32
 
   @nn.compact
   def __call__(self, x: jax.Array, edge_index: jax.Array,
                edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
     n = x.shape[0]
     src, dst = edge_index[0], edge_index[1]
     msg = x[jnp.clip(src, 0, n - 1)]
@@ -78,9 +82,9 @@ class SAGEConv(nn.Module):
     else:
       raise ValueError(f'Unknown aggr {self.aggr!r}')
     out = (nn.Dense(self.out_features, use_bias=self.use_bias,
-                    name='lin_self')(x)
+                    dtype=self.dtype, name='lin_self')(x)
            + nn.Dense(self.out_features, use_bias=False,
-                      name='lin_neigh')(agg))
+                      dtype=self.dtype, name='lin_neigh')(agg))
     return out
 
 
@@ -88,10 +92,13 @@ class GCNConv(nn.Module):
   """Graph convolution with symmetric degree normalization (masked)."""
   out_features: int
   use_bias: bool = True
+  dtype: Optional[jnp.dtype] = None
 
   @nn.compact
   def __call__(self, x: jax.Array, edge_index: jax.Array,
                edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
     n = x.shape[0]
     src, dst = edge_index[0], edge_index[1]
     valid = edge_mask if edge_mask is not None else (dst >= 0)
@@ -102,7 +109,8 @@ class GCNConv(nn.Module):
     deg_out = jax.ops.segment_sum(ones, ssafe, num_segments=n) + 1.0
     w = (jax.lax.rsqrt(deg_out)[jnp.clip(src, 0, n - 1)]
          * jax.lax.rsqrt(deg_in)[jnp.clip(dst, 0, n - 1)])
-    h = nn.Dense(self.out_features, use_bias=self.use_bias)(x)
+    h = nn.Dense(self.out_features, use_bias=self.use_bias,
+                 dtype=self.dtype)(x)
     msg = h[jnp.clip(src, 0, n - 1)] * w[:, None]
     agg = jax.ops.segment_sum(msg, dsafe, num_segments=n)
     # self loop with 1/deg normalization
@@ -115,22 +123,26 @@ class GATConv(nn.Module):
   heads: int = 1
   concat: bool = True
   negative_slope: float = 0.2
+  dtype: Optional[jnp.dtype] = None
 
   @nn.compact
   def __call__(self, x: jax.Array, edge_index: jax.Array,
                edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
     n = x.shape[0]
     h, f = self.heads, self.out_features
     src, dst = edge_index[0], edge_index[1]
     valid = edge_mask if edge_mask is not None else (dst >= 0)
     dsafe = jnp.where(valid, dst, n)
-    z = nn.Dense(h * f, use_bias=False)(x).reshape(n, h, f)
+    z = nn.Dense(h * f, use_bias=False,
+                 dtype=self.dtype)(x).reshape(n, h, f)
     a_src = self.param('att_src', nn.initializers.glorot_uniform(),
                        (h, f))
     a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
                        (h, f))
-    alpha_src = (z * a_src[None]).sum(-1)   # [n, h]
-    alpha_dst = (z * a_dst[None]).sum(-1)
+    alpha_src = (z * a_src[None]).sum(-1).astype(jnp.float32)  # [n, h]
+    alpha_dst = (z * a_dst[None]).sum(-1).astype(jnp.float32)
     sc = jnp.clip(src, 0, n - 1)
     e = nn.leaky_relu(alpha_src[sc] + alpha_dst[jnp.clip(dst, 0, n - 1)],
                       self.negative_slope)          # [E, h]
@@ -142,7 +154,7 @@ class GATConv(nn.Module):
                    jnp.exp(e - emax[jnp.clip(dst, 0, n - 1)]), 0.0)
     denom = jax.ops.segment_sum(ex, dsafe, num_segments=n)
     w = ex / jnp.maximum(denom[jnp.clip(dst, 0, n - 1)], 1e-16)
-    msg = z[sc] * w[:, :, None]                      # [E, h, f]
+    msg = z[sc] * w.astype(z.dtype)[:, :, None]      # [E, h, f]
     agg = jax.ops.segment_sum(msg.reshape(-1, h * f), dsafe,
                               num_segments=n).reshape(n, h, f)
     if self.concat:

@@ -54,9 +54,17 @@ class HeteroConv(nn.Module):
   out_features: int
   aggr: str = 'sum'
   make_conv: Optional[Callable[[], nn.Module]] = None
+  dtype: Optional[jnp.dtype] = None   # compute dtype; params stay f32
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict=None):
+    if self.make_conv is not None and self.dtype is not None:
+      # the factory owns its convs' compute dtype; accepting both
+      # would leave the dominant per-etype matmuls silently f32
+      raise ValueError(
+          'HeteroConv(make_conv=..., dtype=...): set the compute dtype '
+          'inside the factory instead, e.g. '
+          'lambda: SAGEConv(d, dtype=jnp.bfloat16)')
     out: Dict[NodeType, Any] = {}
     counts: Dict[NodeType, int] = {}
     for et in self.etypes:
@@ -97,7 +105,7 @@ class HeteroConv(nn.Module):
           agg = conv(xcat, ei2, em)[:nb]
       else:
         msg = nn.Dense(self.out_features, use_bias=False,
-                       name=f'lin_{as_str(et)}')(
+                       dtype=self.dtype, name=f'lin_{as_str(et)}')(
                            x_dict[a][jnp.clip(src, 0, na - 1)])
         agg = segment_mean(msg, dst, nb, em)
       out[b] = out.get(b, 0) + agg
@@ -113,10 +121,11 @@ class HeteroConv(nn.Module):
             h = h / counts[nt]
           res[nt] = h
         else:
-          res[nt] = nn.Dense(self.out_features,
+          res[nt] = nn.Dense(self.out_features, dtype=self.dtype,
                              name=f'lin_self_{nt}')(x)
         continue
-      self_term = nn.Dense(self.out_features, name=f'lin_self_{nt}')(x)
+      self_term = nn.Dense(self.out_features, dtype=self.dtype,
+                           name=f'lin_self_{nt}')(x)
       if nt in out:
         h = out[nt]
         if self.aggr == 'mean':
@@ -136,6 +145,7 @@ class RGCN(nn.Module):
   num_layers: int = 2
   dropout: float = 0.0
   target_ntype: Optional[NodeType] = None
+  dtype: Optional[jnp.dtype] = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict=None, *,
@@ -144,13 +154,15 @@ class RGCN(nn.Module):
     for i in range(self.num_layers):
       last = i == self.num_layers - 1
       feats = self.out_features if last else self.hidden_features
-      h = HeteroConv(self.etypes, feats, name=f'conv{i}')(
-          h, edge_index_dict, edge_mask_dict)
+      h = HeteroConv(self.etypes, feats, dtype=self.dtype,
+                     name=f'conv{i}')(h, edge_index_dict, edge_mask_dict)
       if not last:
         h = {nt: nn.relu(v) for nt, v in h.items()}
         if self.dropout > 0:
           h = {nt: nn.Dropout(self.dropout, deterministic=not train)(v)
                for nt, v in h.items()}
+    if self.dtype is not None:
+      h = {nt: v.astype(jnp.float32) for nt, v in h.items()}
     if self.target_ntype is not None:
       return h[self.target_ntype]
     return h
@@ -168,6 +180,7 @@ class HGTConv(nn.Module):
   etypes: Tuple[EdgeType, ...]
   out_features: int
   heads: int = 2
+  dtype: Optional[jnp.dtype] = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict=None):
@@ -178,11 +191,14 @@ class HGTConv(nn.Module):
       if nt not in x_dict:
         continue
       n = x_dict[nt].shape[0]
-      q_dict[nt] = nn.Dense(h * f, name=f'q_{nt}')(x_dict[nt]).reshape(
+      q_dict[nt] = nn.Dense(h * f, dtype=self.dtype,
+                           name=f'q_{nt}')(x_dict[nt]).reshape(
           n, h, f)
-      k_dict[nt] = nn.Dense(h * f, name=f'k_{nt}')(x_dict[nt]).reshape(
+      k_dict[nt] = nn.Dense(h * f, dtype=self.dtype,
+                           name=f'k_{nt}')(x_dict[nt]).reshape(
           n, h, f)
-      v_dict[nt] = nn.Dense(h * f, name=f'v_{nt}')(x_dict[nt]).reshape(
+      v_dict[nt] = nn.Dense(h * f, dtype=self.dtype,
+                           name=f'v_{nt}')(x_dict[nt]).reshape(
           n, h, f)
 
     # accumulate per-target-type attention numerators/denominators
@@ -209,14 +225,15 @@ class HGTConv(nn.Module):
       k = jnp.einsum('ehf,hfg->ehg', k_dict[a][src], w_att)
       v = jnp.einsum('ehf,hfg->ehg', v_dict[a][src], w_msg)
       q = q_dict[b][jnp.clip(dst, 0, nb - 1)]
-      score = (q * k).sum(-1) * prior[None, :] / jnp.sqrt(f)   # [E, h]
+      score = ((q * k).sum(-1).astype(jnp.float32)
+               * prior[None, :] / jnp.sqrt(f))         # [E, h]
       score = jnp.where(valid[:, None], score, -jnp.inf)
       smax = jax.ops.segment_max(score, dsafe, num_segments=nb)
       smax = jnp.where(jnp.isfinite(smax), smax, 0.0)
       ex = jnp.where(valid[:, None],
                      jnp.exp(score - smax[jnp.clip(dst, 0, nb - 1)]), 0.0)
       num = jax.ops.segment_sum(
-          (ex[:, :, None] * v).reshape(-1, h * f), dsafe,
+          (ex.astype(v.dtype)[:, :, None] * v).reshape(-1, h * f), dsafe,
           num_segments=nb).reshape(nb, h, f)
       agg[b] = agg[b] + num
       den[b] = den[b] + jax.ops.segment_sum(ex, dsafe, num_segments=nb)
@@ -225,13 +242,15 @@ class HGTConv(nn.Module):
     for nt in q_dict:
       n = x_dict[nt].shape[0]
       if isinstance(agg[nt], float):
-        out[nt] = nn.Dense(self.out_features, name=f'skip_{nt}')(x_dict[nt])
+        out[nt] = nn.Dense(self.out_features, dtype=self.dtype,
+                           name=f'skip_{nt}')(x_dict[nt])
         continue
       att = agg[nt] / jnp.maximum(den[nt], 1e-16)[:, :, None]
       att = att.reshape(n, h * f)
-      out[nt] = (nn.Dense(self.out_features, name=f'out_{nt}')(
-          nn.gelu(att))
-          + nn.Dense(self.out_features, name=f'skip_{nt}')(x_dict[nt]))
+      out[nt] = (nn.Dense(self.out_features, dtype=self.dtype,
+                          name=f'out_{nt}')(nn.gelu(att))
+          + nn.Dense(self.out_features, dtype=self.dtype,
+                     name=f'skip_{nt}')(x_dict[nt]))
     return out
 
 
@@ -244,19 +263,26 @@ class HGT(nn.Module):
   num_layers: int = 2
   heads: int = 2
   target_ntype: Optional[NodeType] = None
+  dtype: Optional[jnp.dtype] = None
 
   @nn.compact
   def __call__(self, x_dict, edge_index_dict, edge_mask_dict=None, *,
                train: bool = False):
-    h = {nt: nn.Dense(self.hidden_features, name=f'in_{nt}')(x)
+    h = {nt: nn.Dense(self.hidden_features, dtype=self.dtype,
+                      name=f'in_{nt}')(x)
          for nt, x in x_dict.items()}
     for i in range(self.num_layers):
       h = HGTConv(self.ntypes, self.etypes, self.hidden_features,
-                  self.heads, name=f'conv{i}')(
+                  self.heads, dtype=self.dtype, name=f'conv{i}')(
                       h, edge_index_dict, edge_mask_dict)
       h = {nt: nn.relu(v) for nt, v in h.items()}
     if self.target_ntype is not None:
-      return nn.Dense(self.out_features, name='head')(
-          h[self.target_ntype])
-    return {nt: nn.Dense(self.out_features, name=f'head_{nt}')(v)
-            for nt, v in h.items()}
+      out = nn.Dense(self.out_features, dtype=self.dtype,
+                     name='head')(h[self.target_ntype])
+      return (out.astype(jnp.float32) if self.dtype is not None else out)
+    out = {nt: nn.Dense(self.out_features, dtype=self.dtype,
+                        name=f'head_{nt}')(v)
+           for nt, v in h.items()}
+    if self.dtype is not None:
+      out = {nt: v.astype(jnp.float32) for nt, v in out.items()}
+    return out

@@ -149,3 +149,32 @@ def test_heteroconv_factory_rejects_width_mismatch():
   ei = {et: jnp.zeros((2, 2), jnp.int32)}
   with pytest.raises(ValueError, match='equal feature widths'):
     conv.init(jax.random.key(0), x, ei, None)
+
+
+def test_hgt_bf16_dtype():
+  """bfloat16 compute keeps params/outputs f32 in the hetero stack."""
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  from graphlearn_tpu.models import HGT
+
+  rng = np.random.default_rng(0)
+  U, V = 'u', 'v'
+  ET1, ET2 = (U, 'r', V), (V, 'rev_r', U)
+  x = {U: jnp.asarray(rng.standard_normal((16, 8)).astype(np.float32)),
+       V: jnp.asarray(rng.standard_normal((12, 8)).astype(np.float32))}
+  ei = {ET1: jnp.asarray(np.stack([rng.integers(0, 16, 24),
+                                   rng.integers(0, 12, 24)])),
+        ET2: jnp.asarray(np.stack([rng.integers(0, 12, 24),
+                                   rng.integers(0, 16, 24)]))}
+  em = {k: v[0] >= 0 for k, v in ei.items()}
+  model = HGT(ntypes=(U, V), etypes=(ET1, ET2), hidden_features=16,
+              out_features=4, num_layers=2, target_ntype=U,
+              dtype=jnp.bfloat16)
+  params = model.init(jax.random.key(0), x, ei, em)
+  out = model.apply(params, x, ei, em)
+  assert out.dtype == jnp.float32
+  assert out.shape == (16, 4)
+  assert all(p.dtype == jnp.float32
+             for p in jax.tree_util.tree_leaves(params))
+  assert bool(jnp.isfinite(out).all())

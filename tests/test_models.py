@@ -100,3 +100,47 @@ def test_gcn_gat_forward_shapes():
     out = model.apply(params, batch.x, batch.edge_index, batch.edge_mask)
     assert out.shape == (batch.x.shape[0], 3)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_bf16_compute_dtype():
+  """dtype=bfloat16 computes on half-width MXU lanes but keeps params
+  and outputs f32, and still learns."""
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  import optax
+  from graphlearn_tpu.models import GraphSAGE
+
+  rng = np.random.default_rng(0)
+  n, d, classes = 64, 16, 4
+  x = rng.standard_normal((n, d)).astype(np.float32)
+  y = (np.arange(n) % classes).astype(np.int32)
+  ei = jnp.asarray(
+      np.stack([rng.integers(0, n, 128), rng.integers(0, n, 128)]))
+  em = ei[0] >= 0
+  x, y = jnp.asarray(x), jnp.asarray(y)
+  model = GraphSAGE(hidden_features=32, out_features=classes,
+                    num_layers=2, dtype=jnp.bfloat16)
+  params = model.init(jax.random.key(0), x, ei, em)
+  out = model.apply(params, x, ei, em)
+  assert out.dtype == jnp.float32
+  assert all(p.dtype == jnp.float32
+             for p in jax.tree_util.tree_leaves(params))
+  tx = optax.adam(1e-2)
+  opt = tx.init(params)
+
+  @jax.jit
+  def step(params, opt):
+    def loss_fn(p):
+      logits = model.apply(p, x, ei, em)
+      return optax.softmax_cross_entropy_with_integer_labels(
+          logits, y).mean()
+    loss, g = jax.value_and_grad(loss_fn)(params)
+    upd, opt = tx.update(g, opt, params)
+    return optax.apply_updates(params, upd), opt, loss
+
+  first = None
+  for _ in range(30):
+    params, opt, loss = step(params, opt)
+    first = float(loss) if first is None else first
+  assert float(loss) < first * 0.7
