@@ -37,9 +37,12 @@ def segment_mean(data: jax.Array, segment_ids: jax.Array,
   else:
     segment_ids = jnp.where(segment_ids >= 0, segment_ids, num_segments)
   tot = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
-  cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+  # count in f32: low-precision ones (bf16) saturate near 256 under
+  # scatter-add, corrupting hub-node means
+  cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), jnp.float32),
                             segment_ids, num_segments=num_segments)
-  return tot / jnp.maximum(cnt, 1.0)[:, None]
+  mean = tot.astype(jnp.float32) / jnp.maximum(cnt, 1.0)[:, None]
+  return mean.astype(data.dtype)
 
 
 def segment_max(data: jax.Array, segment_ids: jax.Array,
@@ -104,17 +107,19 @@ class GCNConv(nn.Module):
     valid = edge_mask if edge_mask is not None else (dst >= 0)
     ssafe = jnp.where(valid, src, n)
     dsafe = jnp.where(valid, dst, n)
-    ones = valid.astype(x.dtype)
+    # degrees count in f32 (bf16 scatter-add saturates near 256)
+    ones = valid.astype(jnp.float32)
     deg_in = jax.ops.segment_sum(ones, dsafe, num_segments=n) + 1.0
     deg_out = jax.ops.segment_sum(ones, ssafe, num_segments=n) + 1.0
     w = (jax.lax.rsqrt(deg_out)[jnp.clip(src, 0, n - 1)]
          * jax.lax.rsqrt(deg_in)[jnp.clip(dst, 0, n - 1)])
     h = nn.Dense(self.out_features, use_bias=self.use_bias,
                  dtype=self.dtype)(x)
-    msg = h[jnp.clip(src, 0, n - 1)] * w[:, None]
+    msg = h[jnp.clip(src, 0, n - 1)] * w.astype(h.dtype)[:, None]
     agg = jax.ops.segment_sum(msg, dsafe, num_segments=n)
     # self loop with 1/deg normalization
-    return agg + h * (jax.lax.rsqrt(deg_in) * jax.lax.rsqrt(deg_out))[:, None]
+    self_w = jax.lax.rsqrt(deg_in) * jax.lax.rsqrt(deg_out)
+    return agg + h * self_w.astype(h.dtype)[:, None]
 
 
 class GATConv(nn.Module):
@@ -141,6 +146,8 @@ class GATConv(nn.Module):
                        (h, f))
     a_dst = self.param('att_dst', nn.initializers.glorot_uniform(),
                        (h, f))
+    a_src = a_src.astype(z.dtype)
+    a_dst = a_dst.astype(z.dtype)
     alpha_src = (z * a_src[None]).sum(-1).astype(jnp.float32)  # [n, h]
     alpha_dst = (z * a_dst[None]).sum(-1).astype(jnp.float32)
     sc = jnp.clip(src, 0, n - 1)

@@ -222,8 +222,10 @@ class HGTConv(nn.Module):
       w_msg = self.param(f'w_msg_{as_str(et)}',
                          nn.initializers.glorot_uniform(), (h, f, f))
       prior = self.param(f'prior_{as_str(et)}', nn.initializers.ones, (h,))
-      k = jnp.einsum('ehf,hfg->ehg', k_dict[a][src], w_att)
-      v = jnp.einsum('ehf,hfg->ehg', v_dict[a][src], w_msg)
+      k = jnp.einsum('ehf,hfg->ehg', k_dict[a][src],
+                     w_att.astype(k_dict[a].dtype))
+      v = jnp.einsum('ehf,hfg->ehg', v_dict[a][src],
+                     w_msg.astype(v_dict[a].dtype))
       q = q_dict[b][jnp.clip(dst, 0, nb - 1)]
       score = ((q * k).sum(-1).astype(jnp.float32)
                * prior[None, :] / jnp.sqrt(f))         # [E, h]

@@ -144,3 +144,31 @@ def test_bf16_compute_dtype():
     params, opt, loss = step(params, opt)
     first = float(loss) if first is None else first
   assert float(loss) < first * 0.7
+
+
+def test_bf16_hub_degree_counts_not_saturated():
+  """Edge counts/degrees accumulate in f32 even under bf16 compute:
+  a 400-degree hub's mean aggregation must match the f32 model
+  closely (bf16 scatter-add of ones saturates near 256)."""
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  from graphlearn_tpu.models import GraphSAGE
+
+  n, deg = 512, 400
+  rng = np.random.default_rng(0)
+  x = jnp.asarray(rng.standard_normal((n, 8)).astype(np.float32))
+  # every edge points at node 0 (the hub)
+  src = jnp.asarray(rng.integers(1, n, deg).astype(np.int32))
+  ei = jnp.stack([src, jnp.zeros((deg,), jnp.int32)])
+  em = jnp.ones((deg,), bool)
+  kw = dict(hidden_features=16, out_features=4, num_layers=1)
+  m32 = GraphSAGE(**kw)
+  m16 = GraphSAGE(**kw, dtype=jnp.bfloat16)
+  params = m32.init(jax.random.key(0), x, ei, em)
+  o32 = m32.apply(params, x, ei, em)
+  o16 = m16.apply(params, x, ei, em)
+  # hub row would be off by ~deg/256 (≈1.6x) if counts saturated
+  rel = float(jnp.abs(o16[0] - o32[0]).max()
+              / jnp.maximum(jnp.abs(o32[0]).max(), 1e-6))
+  assert rel < 0.05, rel
