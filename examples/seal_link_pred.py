@@ -4,9 +4,9 @@ TPU counterpart of reference `examples/seal_link_pred.py`: for each
 candidate edge (u, v), extract the k-hop enclosing subgraph with
 `SubGraphLoader` (one batch of 2 seeds = one link's subgraph), label
 nodes with Double-Radius Node Labeling, and classify the subgraph.
-The reference pools with DGCNN sort-pooling; here a masked-mean GCN
-readout keeps the whole model jit-friendly on static shapes — the
-SEAL signal (DRNL structure labels) is preserved exactly.
+The classifier is the same DGCNN the reference trains, via the
+static-shape TPU sort-pool in `graphlearn_tpu.models.DGCNN`; the SEAL
+signal (DRNL structure labels) is preserved exactly.
 
 Synthetic task: a clustered graph; existing intra-cluster edges are
 positives, random non-edges negatives.
@@ -90,7 +90,7 @@ def main():
   import flax.linen as nn
   from graphlearn_tpu.data import Dataset
   from graphlearn_tpu.loader import SubGraphLoader
-  from graphlearn_tpu.models import GCNConv
+  from graphlearn_tpu.models import DGCNN
 
   rows, cols, cl = synthetic()
   n = len(cl)
@@ -115,21 +115,22 @@ def main():
   loader = SubGraphLoader(ds, [8], pairs.reshape(-1), batch_size=2,
                           shuffle=False, seed=0)
 
-  class SealGCN(nn.Module):
+  class SealDGCNN(nn.Module):
+    """DRNL label embedding -> DGCNN (the reference's SEAL classifier:
+    sort-pooling + Conv1d, `examples/seal_link_pred.py` via PyG)."""
     hidden: int = 32
     max_label: int = 16
+    k: int = 30
 
     @nn.compact
     def __call__(self, lab, edge_index, edge_mask, node_mask):
       x = nn.Embed(self.max_label, self.hidden)(
           jnp.clip(lab, 0, self.max_label - 1))
-      h = nn.relu(GCNConv(self.hidden)(x, edge_index, edge_mask))
-      h = nn.relu(GCNConv(self.hidden)(h, edge_index, edge_mask))
-      w = node_mask[:, None].astype(h.dtype)
-      pooled = (h * w).sum(0) / jnp.maximum(w.sum(), 1.0)
-      return nn.Dense(2)(pooled)
+      return DGCNN(hidden_features=self.hidden, out_features=2,
+                   num_layers=3, k=self.k)(
+                       x, edge_index, edge_mask, node_mask)
 
-  model = SealGCN(max_label=args.max_label)
+  model = SealDGCNN(max_label=args.max_label)
 
   # Pre-extract subgraphs + DRNL labels once (host-side prep).
   sub = []

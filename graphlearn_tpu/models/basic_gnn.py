@@ -67,3 +67,63 @@ class GAT(BasicGNN):
     return GATConv(out_features if last else out_features // self.heads,
                    heads=self.heads, concat=not last, dtype=self.dtype,
                    name=f'conv{idx}')
+
+
+class DGCNN(nn.Module):
+  """Deep Graph CNN: sort-pooling + 1-D convolutions.
+
+  The classifier the reference's SEAL example trains (its
+  `examples/seal_link_pred.py` uses PyG's DGCNN: stacked tanh-GCN
+  layers, concatenate all layer outputs, SortPool the top ``k`` nodes
+  by the last 1-wide layer's value, then Conv1d -> MLP).  TPU
+  re-design: the pool is a masked top-k (static ``k``) instead of a
+  dynamic-size sort, the "kernel = total-width, stride = total-width"
+  Conv1d of the paper is the equivalent per-node width-1 convolution
+  over the ``[k, D]`` sequence, and everything keeps static shapes.
+
+  Call with node features (or label embeddings), padded local COO and
+  masks; returns ``[out_features]`` graph-level logits.
+  """
+  hidden_features: int = 32
+  out_features: int = 2
+  num_layers: int = 3
+  k: int = 30
+  dtype: Optional[jnp.dtype] = None
+
+  @nn.compact
+  def __call__(self, x, edge_index, edge_mask=None, node_mask=None):
+    if node_mask is None:
+      node_mask = jnp.ones((x.shape[0],), bool)
+    hs = []
+    h = x
+    for i in range(self.num_layers):
+      h = jnp.tanh(GCNConv(self.hidden_features, dtype=self.dtype,
+                           name=f'conv{i}')(h, edge_index, edge_mask))
+      hs.append(h)
+    # final 1-wide layer provides the canonical sort key
+    h = jnp.tanh(GCNConv(1, dtype=self.dtype,
+                         name=f'conv{self.num_layers}')(
+                             h, edge_index, edge_mask))
+    hs.append(h)
+    hcat = jnp.concatenate(hs, axis=-1)                   # [n, D]
+    sort_key = jnp.where(node_mask, h[:, 0], -jnp.inf)
+    top = jax.lax.top_k(sort_key, min(self.k, x.shape[0]))[1]
+    valid = sort_key[top] > -jnp.inf
+    pooled = jnp.where(valid[:, None], hcat[top], 0.0)    # [k, D]
+    if pooled.shape[0] < self.k:                          # tiny graphs
+      pooled = jnp.concatenate(
+          [pooled, jnp.zeros((self.k - pooled.shape[0], pooled.shape[1]),
+                             pooled.dtype)])
+    seq = pooled[None]                                    # [1, k, D]
+    z = nn.relu(nn.Conv(16, kernel_size=(1,), dtype=self.dtype,
+                        name='conv1d_a')(seq))
+    if z.shape[1] >= 2:
+      z = nn.max_pool(z, window_shape=(2,), strides=(2,))
+    # kernel clamps for small k so the VALID conv never emits length 0
+    z = nn.relu(nn.Conv(32, kernel_size=(min(5, z.shape[1]),),
+                        padding='VALID', dtype=self.dtype,
+                        name='conv1d_b')(z))
+    z = z.reshape(1, -1)
+    z = nn.relu(nn.Dense(128, dtype=self.dtype)(z))
+    out = nn.Dense(self.out_features, dtype=self.dtype)(z)[0]
+    return out.astype(jnp.float32) if self.dtype is not None else out
