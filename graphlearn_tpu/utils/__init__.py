@@ -1,3 +1,5 @@
+from .common import (format_hetero_sampler_output,
+                     merge_hetero_sampler_output)
 from .device import (assign_device, ensure_device, get_available_devices,
                      is_tpu_available)
 from .mixin import CastMixin

@@ -1,0 +1,141 @@
+"""Hetero sampler-output merging/formatting helpers.
+
+Counterparts of reference `utils/common.py:55-98`
+(``merge_hetero_sampler_output`` — combine partial hetero results from
+different partitions into one — and ``format_hetero_sampler_output`` —
+give every declared type a presence so downstream collation never
+key-errors).  TPU twist: outputs are statically padded, so the merge
+concatenates per-type tables and re-deduplicates with a capacity-bound
+`unique_stable`, remapping both sides' local edge indices through the
+merged table.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..ops.unique import unique_stable
+from ..typing import EdgeType, NodeType
+from .padding import INVALID_ID, round_up
+
+
+def format_hetero_sampler_output(out, ntypes: Sequence[NodeType] = (),
+                                 etypes: Sequence[EdgeType] = (),
+                                 node_cap: int = 8, edge_cap: int = 8):
+  """Ensure every declared node/edge type is present (empty padded
+  entries), so consumers can index unconditionally — reference
+  `format_hetero_sampler_output` (`utils/common.py:85-98`).
+
+  ``node_cap``/``edge_cap`` size the filled-in entries; pass the same
+  per-type capacities the present batches use so jitted consumers see
+  one shape per type across batches."""
+  for nt in ntypes:
+    if nt not in out.node:
+      out.node[nt] = jnp.full((node_cap,), INVALID_ID, jnp.int32)
+      out.node_count[nt] = jnp.zeros((), jnp.int32)
+  for et in etypes:
+    et = tuple(et)
+    if et not in out.row:
+      out.row[et] = jnp.full((edge_cap,), -1, jnp.int32)
+      out.col[et] = jnp.full((edge_cap,), -1, jnp.int32)
+      if out.edge_mask is not None:
+        out.edge_mask[et] = jnp.zeros((edge_cap,), bool)
+      if out.edge is not None:
+        out.edge[et] = jnp.full((edge_cap,), INVALID_ID, jnp.int32)
+  if out.edge_types is not None:
+    declared = {tuple(e) for e in out.edge_types}
+    out.edge_types = list(out.edge_types) + [
+        tuple(e) for e in etypes if tuple(e) not in declared]
+  return out
+
+
+def merge_hetero_sampler_output(a, b, node_caps: Optional[
+    Dict[NodeType, int]] = None):
+  """Merge two `HeteroSamplerOutput`s into one (reference
+  `merge_hetero_sampler_output`, `utils/common.py:55-82`: the
+  distributed hetero path merges per-partition partials).
+
+  Node tables concatenate per type and re-deduplicate in
+  first-occurrence order (``a``'s locals stay stable when ``a``'s
+  table has no internal duplicates); both sides' edge indices are
+  remapped through the merged table.  ``node_caps`` bounds each merged
+  table (default: sum of the two capacities).
+  """
+  from ..sampler.base import HeteroSamplerOutput
+
+  node, node_count, remap = {}, {}, {}
+  for nt in set(a.node) | set(b.node):
+    xa = a.node.get(nt)
+    xb = b.node.get(nt)
+    if xa is None or xb is None:
+      src = a if xb is None else b
+      node[nt] = src.node[nt]
+      node_count[nt] = src.node_count[nt]
+      n_a = 0 if xa is None else xa.shape[0]
+      remap[nt] = (jnp.arange(node[nt].shape[0] + n_a, dtype=jnp.int32),
+                   n_a)
+      continue
+    cap = (node_caps or {}).get(
+        nt, round_up(xa.shape[0] + xb.shape[0], 8))
+    combined = jnp.concatenate([xa, xb])
+    valid = jnp.concatenate([
+        jnp.arange(xa.shape[0]) < a.node_count[nt],
+        jnp.arange(xb.shape[0]) < b.node_count[nt]])
+    res = unique_stable(combined, cap, valid=valid)
+    node[nt] = res.values
+    node_count[nt] = res.count
+    remap[nt] = (res.inverse, xa.shape[0])
+
+  def _remap_side(ids, nt, side_b: bool):
+    inv, n_a = remap[nt]
+    off = n_a if side_b else 0
+    safe = jnp.clip(ids + off, 0, inv.shape[0] - 1)
+    return jnp.where(ids >= 0, inv[safe], -1)
+
+  any_edge = (a.edge is not None) or (b.edge is not None)
+  row, col, edge, emask = {}, {}, {}, {}
+  for et in list(dict.fromkeys(list(a.row) + list(b.row))):
+    s, _, d = et
+    parts_r, parts_c, parts_e, parts_m = [], [], [], []
+    for side, out in ((False, a), (True, b)):
+      if et not in out.row:
+        continue
+      r = _remap_side(out.row[et], d, side)
+      parts_r.append(r)
+      parts_c.append(_remap_side(out.col[et], s, side))
+      # sides lacking edge ids / masks pad to THEIR edge width so the
+      # concatenated arrays stay aligned with row/col
+      if any_edge:
+        if out.edge is not None and et in out.edge:
+          parts_e.append(out.edge[et])
+        else:
+          parts_e.append(jnp.full(r.shape, INVALID_ID,
+                                  jnp.asarray(INVALID_ID).dtype))
+      if out.edge_mask is not None and et in out.edge_mask:
+        parts_m.append(out.edge_mask[et])
+      else:
+        parts_m.append(out.row[et] >= 0)
+    row[et] = jnp.concatenate(parts_r)
+    col[et] = jnp.concatenate(parts_c)
+    if any_edge:
+      edge[et] = jnp.concatenate(parts_e)
+    # a merged-away duplicate can't invalidate an edge, but clipped
+    # overflow (cap reached) must
+    emask[et] = (jnp.concatenate(parts_m)
+                 & (row[et] >= 0) & (col[et] >= 0))
+
+  # first-occurrence order (a raw set would hash-randomize the order
+  # across processes, desyncing jitted consumers that iterate it)
+  etypes = list(dict.fromkeys(
+      [tuple(e) for e in list(a.edge_types or a.row)
+       + list(b.edge_types or b.row)]))
+  batch = dict(a.batch or {})
+  for nt, v in (b.batch or {}).items():
+    # both partials contribute seeds for a shared seed type
+    batch[nt] = (jnp.concatenate([batch[nt], v]) if nt in batch else v)
+  return HeteroSamplerOutput(
+      node=node, node_count=node_count, row=row, col=col,
+      edge=edge or None, edge_mask=emask, batch=batch or None,
+      edge_types=etypes, metadata={**(b.metadata or {}),
+                                   **(a.metadata or {})})

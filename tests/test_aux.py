@@ -105,3 +105,48 @@ def test_checkpoint_resume_training_state(tmp_path):
   for b in loader:
     state2, loss, _ = step(state2, b)
   assert np.isfinite(float(loss))
+
+
+def test_merge_hetero_sampler_output():
+  """Partition partials merge with dedup + edge-index remap (reference
+  `utils/common.py:55-98`)."""
+  import jax.numpy as jnp
+  from graphlearn_tpu.sampler.base import HeteroSamplerOutput
+  from graphlearn_tpu.utils import (format_hetero_sampler_output,
+                                    merge_hetero_sampler_output)
+
+  ET = ('u', 'to', 'i')
+  a = HeteroSamplerOutput(
+      node={'u': jnp.array([10, 11, -1, -1]), 'i': jnp.array([5, 6, -1, -1])},
+      node_count={'u': jnp.int32(2), 'i': jnp.int32(2)},
+      # edges (i-local row, u-local col): (5<-10), (6<-11)
+      row={ET: jnp.array([0, 1])}, col={ET: jnp.array([0, 1])},
+      edge_mask={ET: jnp.array([True, True])},
+      batch={'u': jnp.array([10, 11])}, edge_types=[ET])
+  b = HeteroSamplerOutput(
+      node={'u': jnp.array([11, 12, -1, -1]), 'i': jnp.array([6, 7, -1, -1])},
+      node_count={'u': jnp.int32(2), 'i': jnp.int32(2)},
+      # edges: (6<-11), (7<-12)
+      row={ET: jnp.array([0, 1])}, col={ET: jnp.array([0, 1])},
+      edge_mask={ET: jnp.array([True, True])},
+      batch={'u': jnp.array([11, 12])}, edge_types=[ET])
+  m = merge_hetero_sampler_output(a, b)
+  u = np.asarray(m.node['u'])
+  i = np.asarray(m.node['i'])
+  assert list(u[:int(m.node_count['u'])]) == [10, 11, 12]
+  assert list(i[:int(m.node_count['i'])]) == [5, 6, 7]
+  # remapped global edges must be exactly the union
+  got = set()
+  em = np.asarray(m.edge_mask[ET])
+  for r, c, v in zip(np.asarray(m.row[ET]), np.asarray(m.col[ET]), em):
+    if v:
+      got.add((int(u[c]), int(i[r])))
+  assert got == {(10, 5), (11, 6), (12, 7)}
+
+  # merged batch carries BOTH partials' seeds
+  assert list(np.asarray(m.batch['u'])) == [10, 11, 11, 12]
+  m = format_hetero_sampler_output(m, ntypes=('w',),
+                                   etypes=(('w', 'r', 'u'),),
+                                   node_cap=16, edge_cap=24)
+  assert m.node['w'].shape == (16,)
+  assert m.row[('w', 'r', 'u')].shape == (24,)
