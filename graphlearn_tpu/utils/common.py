@@ -96,14 +96,16 @@ def merge_hetero_sampler_output(a, b, node_caps: Optional[
   any_edge = (a.edge is not None) or (b.edge is not None)
   row, col, edge, emask = {}, {}, {}, {}
   for et in list(dict.fromkeys(list(a.row) + list(b.row))):
+    # emission convention (transform.py / models): row[K] holds
+    # K[0]-type locals (message sources), col[K] holds K[2]-type locals
     s, _, d = et
     parts_r, parts_c, parts_e, parts_m = [], [], [], []
     for side, out in ((False, a), (True, b)):
       if et not in out.row:
         continue
-      r = _remap_side(out.row[et], d, side)
+      r = _remap_side(out.row[et], s, side)
       parts_r.append(r)
-      parts_c.append(_remap_side(out.col[et], s, side))
+      parts_c.append(_remap_side(out.col[et], d, side))
       # sides lacking edge ids / masks pad to THEIR edge width so the
       # concatenated arrays stay aligned with row/col
       if any_edge:

@@ -115,7 +115,9 @@ def test_merge_hetero_sampler_output():
   from graphlearn_tpu.utils import (format_hetero_sampler_output,
                                     merge_hetero_sampler_output)
 
-  ET = ('u', 'to', 'i')
+  # emission shape of the hetero samplers: u->i edges appear under the
+  # REVERSED key with row = i-type (K[0]) locals, col = u-type locals
+  ET = ('i', 'rev_to', 'u')
   a = HeteroSamplerOutput(
       node={'u': jnp.array([10, 11, -1, -1]), 'i': jnp.array([5, 6, -1, -1])},
       node_count={'u': jnp.int32(2), 'i': jnp.int32(2)},
