@@ -21,12 +21,17 @@ from .transform import Batch, collate
 
 
 class SeedBatcher:
-  """Host-side seed iterator: shuffle, slice, pad to static size."""
+  """Host-side seed iterator: shuffle, slice, pad to static size.
+
+  ``seeds`` may be ``[E]`` node ids or ``[E, K]`` rows (link-mode
+  (src, dst[, label]) triples); shuffling/slicing is along axis 0 and
+  padding fills whole rows with INVALID_ID."""
 
   def __init__(self, seeds: np.ndarray, batch_size: int,
                shuffle: bool = False, drop_last: bool = False,
                seed: Optional[int] = None):
-    self.seeds = np.asarray(seeds).reshape(-1)
+    seeds = np.asarray(seeds)
+    self.seeds = seeds if seeds.ndim > 1 else seeds.reshape(-1)
     self.batch_size = int(batch_size)
     self.shuffle = shuffle
     self.drop_last = drop_last
@@ -60,7 +65,12 @@ class SeedBatcher:
     self._pos = end
     batch = self.seeds[idx].astype(np.int32)
     if len(batch) < self.batch_size:
-      batch = pad_1d(batch, self.batch_size, INVALID_ID)
+      if batch.ndim > 1:
+        pad = np.full((self.batch_size - len(batch),) + batch.shape[1:],
+                      INVALID_ID, batch.dtype)
+        batch = np.concatenate([batch, pad])
+      else:
+        batch = pad_1d(batch, self.batch_size, INVALID_ID)
     return batch
 
 

@@ -5,5 +5,7 @@ from .dist_data import (DistDataset, DistFeature, DistGraph,
 from . import multihost
 from .dist_hetero import (DistHeteroDataset, DistHeteroNeighborLoader,
                           DistHeteroNeighborSampler)
-from .dist_sampler import (DistNeighborLoader, DistNeighborSampler,
-                           bucket_by_owner, dist_gather)
+from .dist_sampler import (DistLinkNeighborLoader, DistLinkNeighborSampler,
+                           DistNeighborLoader, DistNeighborSampler,
+                           bucket_by_owner, dist_edge_exists, dist_gather,
+                           dist_sample_negative)
