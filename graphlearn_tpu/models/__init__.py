@@ -3,4 +3,5 @@ from .basic_gnn import DGCNN, GAT, GCN, BasicGNN, GraphSAGE
 from .hetero import HGT, HGTConv, HeteroConv, RGCN
 from .train import (TrainState, create_train_state, make_eval_step,
                     make_supervised_step, make_unsupervised_step,
-                    supervised_loss, unsupervised_link_loss)
+                    link_loss_from_metadata, supervised_loss,
+                    triplet_link_loss, unsupervised_link_loss)

@@ -100,6 +100,37 @@ def unsupervised_link_loss(emb: jax.Array, metadata: dict) -> jax.Array:
   return (ls * v).sum() / jnp.maximum(v.sum(), 1.0)
 
 
+def triplet_link_loss(emb: jax.Array, metadata: dict,
+                      margin: float = 1.0) -> jax.Array:
+  """Max-margin triplet loss from sampler metadata (``src_index`` /
+  ``dst_pos_index`` / ``dst_neg_index`` with -1 invalid slots) — the
+  triplet-mode counterpart of :func:`unsupervised_link_loss`."""
+  si = metadata['src_index']
+  dp = metadata['dst_pos_index']
+  dn = metadata['dst_neg_index']
+  n = emb.shape[0]
+  es = emb[jnp.clip(si, 0, n - 1)]
+  ep = emb[jnp.clip(dp, 0, n - 1)]
+  en = emb[jnp.clip(dn, 0, n - 1)]                  # [B, A, D]
+  pos = jnp.sum(es * ep, axis=-1)                   # [B]
+  neg = jnp.sum(es[:, None, :] * en, axis=-1)       # [B, A]
+  ls = jnp.maximum(0.0, margin - pos[:, None] + neg)
+  valid = ((si >= 0) & (dp >= 0))[:, None] & (dn >= 0)
+  v = valid.astype(emb.dtype)
+  return (ls * v).sum() / jnp.maximum(v.sum(), 1.0)
+
+
+def link_loss_from_metadata(emb: jax.Array, metadata: dict) -> jax.Array:
+  """Dispatch binary vs triplet link loss by the (static) metadata
+  keys a link batch carries."""
+  if 'edge_label_index' in metadata:
+    return unsupervised_link_loss(emb, metadata)
+  if 'src_index' in metadata:
+    return triplet_link_loss(emb, metadata)
+  raise KeyError('batch metadata carries neither edge_label_index '
+                 '(binary) nor src_index (triplet) link labels')
+
+
 def make_unsupervised_step(apply_fn, tx: optax.GradientTransformation):
 
   @jax.jit

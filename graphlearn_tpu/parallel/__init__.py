@@ -1,4 +1,5 @@
-from .dp import (DataParallelLoader, make_dp_supervised_step, make_mesh,
+from .dp import (DataParallelLoader, make_dp_supervised_step,
+                 make_dp_unsupervised_step, make_mesh,
                  replicate, shard_stacked, stack_batches)
 from .dist_data import (DistDataset, DistFeature, DistGraph,
                         build_dist_feature, build_dist_graph)

@@ -154,9 +154,12 @@ def dist_sample_negative(indptr_loc, indices_loc, bounds,
                          rows_fixed: Optional[jax.Array] = None):
   """``req_num`` strict negative pairs over the sharded graph
   (collective analog of `ops.negative.sample_negative`): trials-stacked
-  draws, ONE existence exchange for all trials, first-non-edge pick
-  with padding fallback.  ``rows_fixed`` pins the row of each slot
-  (triplet mode's per-source negatives)."""
+  draws, ONE existence exchange for all trials, first-non-edge pick.
+  Returns ``(rows, cols, ok)`` — ``ok`` False marks slots where every
+  trial hit an existing edge (the padding fallback pair may be a REAL
+  edge; consumers must mask it out of the negative label set).
+  ``rows_fixed`` pins the row of each slot (triplet mode's per-source
+  negatives)."""
   kr, kc = jax.random.split(key)
   if rows_fixed is None:
     rows = jax.random.randint(kr, (trials, req_num), 0, num_rows,
@@ -173,7 +176,7 @@ def dist_sample_negative(indptr_loc, indices_loc, bounds,
   any_ok = jnp.any(ok, axis=0)
   pick = jnp.where(any_ok, jnp.argmax(ok, axis=0), trials - 1)
   slot = jnp.arange(req_num)
-  return rows[pick, slot], cols[pick, slot]
+  return rows[pick, slot], cols[pick, slot], any_ok
 
 
 def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
@@ -412,6 +415,7 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                          fanouts: Tuple[int, ...], node_cap: int,
                          batch: int, num_nodes: int,
                          neg_mode: Optional[str], num_neg: int,
+                         neg_amount: float,
                          with_edge: bool, collect_features: bool,
                          collect_labels: bool, axis: str = 'data',
                          with_cache: bool = False,
@@ -437,15 +441,16 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
     neg_key = jax.random.fold_in(jax.random.fold_in(key, my_idx), 977)
     cap = _slack_cap(num_neg * NEG_TRIALS, num_parts,
                      exchange_slack)
+    neg_ok = None
     if neg_mode == 'binary':
-      nrows, ncols = dist_sample_negative(
+      nrows, ncols, neg_ok = dist_sample_negative(
           indptr, indices, bounds, num_nodes, num_nodes, num_neg,
           neg_key, axis, num_parts, exchange_capacity=cap)
       seeds = jnp.concatenate([src, dst, nrows, ncols])
     elif neg_mode == 'triplet':
       amount = num_neg // batch
       srcs_rep = jnp.repeat(jnp.where(src >= 0, src, 0), amount)
-      _, negs = dist_sample_negative(
+      _, negs, neg_ok = dist_sample_negative(
           indptr, indices, bounds, num_nodes, num_nodes, num_neg,
           neg_key, axis, num_parts, exchange_capacity=cap,
           rows_fixed=srcs_rep.astype(jnp.int32))
@@ -478,15 +483,20 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                        jnp.concatenate([sl[b:2 * b], sl[2 * b + num_neg:]])])
       elab = jnp.concatenate([pos_label,
                               jnp.zeros((num_neg,), jnp.int32)])
-      emask_lab = jnp.concatenate([pair_valid,
-                                   jnp.ones((num_neg,), bool)])
+      # exhausted-trials slots may be REAL edges, and padded tail
+      # batches keep the neg_amount-per-positive contract: negatives
+      # beyond ceil(valid_pairs * amount) are masked out
+      quota = jnp.ceil(jnp.sum(pair_valid)
+                       * jnp.float32(neg_amount)).astype(jnp.int32)
+      neg_keep = neg_ok & (jnp.arange(num_neg) < quota)
+      emask_lab = jnp.concatenate([pair_valid, neg_keep])
       md = (eli, elab, emask_lab, jnp.zeros((b,), jnp.int32),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b, 1), jnp.int32))
     elif neg_mode == 'triplet':
       amount = num_neg // batch
+      dn = jnp.where(neg_ok, sl[2 * b:], -1).reshape(b, amount)
       md = (jnp.zeros((2, 1), jnp.int32), jnp.zeros((1,), jnp.int32),
-            jnp.zeros((1,), bool), sl[:b], sl[b:2 * b],
-            sl[2 * b:].reshape(b, amount))
+            jnp.zeros((1,), bool), sl[:b], sl[b:2 * b], dn)
     else:
       eli = jnp.stack([sl[:b], sl[b:2 * b]])
       md = (eli, pos_label, pair_valid, jnp.zeros((b,), jnp.int32),
@@ -683,9 +693,12 @@ class DistLinkNeighborSampler(DistNeighborSampler):
     self.neg_amount = float(ns.amount) if ns is not None else 1.0
 
   def _expansion_seeds(self, b: int) -> Tuple[int, int]:
-    """(total expansion seeds, negative count) per device batch."""
+    """(total expansion seeds, negative count) per device batch —
+    negative counts come from the ONE shared definition
+    (`distributed.dist_options.binary_num_negatives`)."""
+    from ..distributed.dist_options import binary_num_negatives
     if self.neg_mode == 'binary':
-      nn = int(np.ceil(b * self.neg_amount))
+      nn = binary_num_negatives(b, self.neg_amount)
       return 2 * b + 2 * nn, nn
     if self.neg_mode == 'triplet':
       amount = int(np.ceil(self.neg_amount))
@@ -703,6 +716,7 @@ class DistLinkNeighborSampler(DistNeighborSampler):
       self._steps[cfg] = _make_dist_link_step(
           self.mesh, self.num_parts, self.fanouts, node_cap, b,
           self.ds.graph.num_nodes, self.neg_mode, num_neg,
+          self.neg_amount,
           self.with_edge, self.collect_features, self.collect_labels,
           self.axis, with_cache=self.with_cache,
           exchange_slack=self.exchange_slack)

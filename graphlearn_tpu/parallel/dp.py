@@ -91,6 +91,44 @@ def make_dp_supervised_step(apply_fn: Callable,
   return step
 
 
+def make_dp_unsupervised_step(apply_fn: Callable,
+                              tx: optax.GradientTransformation,
+                              mesh: Mesh, axis: str = 'data'):
+  """SPMD data-parallel UNSUPERVISED (link-loss) step for stacked
+  link batches (`DistLinkNeighborLoader` output): per-device link loss
+  (binary sigmoid or max-margin triplet, picked by the batch's
+  metadata keys) on its own positives/negatives, pmean-averaged
+  gradients — the distributed form of the reference's unsupervised
+  SAGE objective (`examples/graph_sage_unsup_ppi.py:41-45`)."""
+  from ..models.train import link_loss_from_metadata
+  from .shard_map_compat import shard_map
+
+  def per_device(state: TrainState, batch):
+    batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+
+    def loss_fn(params):
+      emb = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+      return link_loss_from_metadata(emb, batch.metadata)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    grads = jax.lax.pmean(grads, axis)
+    loss = jax.lax.pmean(loss, axis)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss
+
+  sharded = shard_map(
+      per_device, mesh=mesh,
+      in_specs=(P(), P(axis)),
+      out_specs=(P(), P()))
+
+  @jax.jit
+  def step(state, stacked_batch):
+    return sharded(state, stacked_batch)
+
+  return step
+
+
 class DataParallelLoader:
   """Wraps a single-chip loader, emitting mesh-size stacks of batches.
 

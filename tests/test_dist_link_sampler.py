@@ -19,9 +19,7 @@ def _setup():
                                     num_nodes=N)
   edge_set = set(zip(rows.tolist(), cols.tolist()))
   idx = rng.choice(len(rows), M, replace=False)
-  new2old = (np.argsort(dds.old2new) if dds.old2new is not None
-             else np.arange(N))
-  return dds, edge_set, rows[idx], cols[idx], new2old
+  return dds, edge_set, rows[idx], cols[idx], dds.new2old
 
 
 def test_mesh_link_binary_strict():
@@ -79,8 +77,10 @@ def test_mesh_link_triplet_strict():
         assert (a, b) in edge_set
       pairs_seen += len(gs)
       for j, a in enumerate(gs.tolist()):
-        for b in new2old[node[p][dn[p][pm[p]][j]]].tolist():
-          assert (a, b) not in edge_set
+        for dl in dn[p][pm[p]][j].tolist():
+          if dl < 0:
+            continue               # exhausted-trials slot, masked out
+          assert (a, new2old[node[p][dl]]) not in edge_set
   assert pairs_seen == M
 
 
