@@ -40,7 +40,8 @@ from ..sampler.hetero_neighbor_sampler import (_plan_capacities,
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils.padding import INVALID_ID
 from .dist_data import build_dist_feature
-from .dist_sampler import _dist_one_hop, dist_gather_multi
+from .dist_sampler import (_dist_one_hop, dist_gather_multi,
+                           dist_sample_negative)
 
 
 class DistHeteroDataset:
@@ -260,12 +261,14 @@ class DistHeteroNeighborSampler:
       self._device_arrays = arrs
     return self._device_arrays
 
-  def _make_step(self, input_type: NodeType, b: int):
+  def _make_step(self, input_sizes: Dict[NodeType, int],
+                 link: Optional[dict] = None):
     from .shard_map_compat import shard_map
-    input_sizes = {input_type: b}
     ntypes, table_cap, frontier_caps, _ = _plan_capacities(
         self.etypes, self.fanouts, input_sizes, self.num_hops,
         self.ds.num_nodes_dict())
+    num_nodes = self.ds.num_nodes_dict()
+    seed_types = tuple(sorted(input_sizes))
     etypes = self.etypes
     fanouts = self.fanouts
     num_parts = self.num_parts
@@ -284,10 +287,50 @@ class DistHeteroNeighborSampler:
       lshards = {nt: l[0] for nt, l in zip(label_nts, labels_t)}
       seeds = seeds_s[0]
 
-      states, seed_local = {}, None
+      neg_ok = None
+      if link is None:
+        seed_sets = {seed_types[0]: seeds}
+      else:
+        # link mode: endpoints + collective strict negatives on the
+        # seed edge type's sharded CSR (the hetero arm of
+        # `dist_sampler._make_dist_link_step`)
+        let = link['etype']
+        s_t, _, d_t = let
+        pairs = seeds
+        src, dst = pairs[:, 0], pairs[:, 1]
+        li, lx, _ = graphs[let]
+        my_idx = jax.lax.axis_index(axis)
+        neg_key = jax.random.fold_in(jax.random.fold_in(key, my_idx), 977)
+        if link['mode'] == 'binary':
+          nrows, ncols, neg_ok = dist_sample_negative(
+              li, lx, bounds[s_t], num_nodes[s_t], num_nodes[d_t],
+              link['num_neg'], neg_key, axis, num_parts)
+          src_seeds = jnp.concatenate([src, nrows])
+          dst_seeds = jnp.concatenate([dst, ncols])
+        elif link['mode'] == 'triplet':
+          amount = link['num_neg'] // link['batch']
+          srcs_rep = jnp.repeat(jnp.where(src >= 0, src, 0), amount)
+          _, negs, neg_ok = dist_sample_negative(
+              li, lx, bounds[s_t], num_nodes[s_t], num_nodes[d_t],
+              link['num_neg'], neg_key, axis, num_parts,
+              rows_fixed=srcs_rep.astype(jnp.int32))
+          src_seeds = src
+          dst_seeds = jnp.concatenate([dst, negs])
+        else:
+          src_seeds, dst_seeds = src, dst
+        clean = lambda v: jnp.where(v >= 0, v, INVALID_ID).astype(
+            jnp.int32)
+        if s_t == d_t:
+          seed_sets = {s_t: clean(jnp.concatenate([src_seeds,
+                                                   dst_seeds]))}
+        else:
+          seed_sets = {s_t: clean(src_seeds), d_t: clean(dst_seeds)}
+
+      states, seed_locals = {}, {}
       for nt in ntypes:
-        if nt == input_type:
-          states[nt], seed_local = init_node(seeds, table_cap[nt])
+        if nt in seed_sets:
+          states[nt], seed_locals[nt] = init_node(seed_sets[nt],
+                                                  table_cap[nt])
         else:
           states[nt] = init_node(
               jnp.full((1,), INVALID_ID, jnp.int32), table_cap[nt])[0]
@@ -342,6 +385,9 @@ class DistHeteroNeighborSampler:
         (y[nt],) = dist_gather_multi((lshards[nt],), bounds[nt],
                                      states[nt].nodes, axis, num_parts)
 
+      if neg_ok is None:
+        neg_ok = jnp.ones((1,), bool)
+
       def lead(v):
         return None if v is None else v[None]
       node_t = tuple(lead(states[nt].nodes) for nt in ntypes)
@@ -363,8 +409,9 @@ class DistHeteroNeighborSampler:
               [jnp.stack(nsn[nt])[:1],
                jnp.stack(nsn[nt])[1:] - jnp.stack(nsn[nt])[:-1]]))
           for nt in ntypes)
-      return (node_t, cnt_t, row_t, col_t, eid_t, lead(seed_local),
-              x_t, y_t, nsn_t)
+      sl_t = tuple(lead(seed_locals[nt]) for nt in seed_types)
+      return (node_t, cnt_t, row_t, col_t, eid_t, sl_t,
+              x_t, y_t, nsn_t, lead(neg_ok))
 
     sh = P(axis)
     rp = P()
@@ -379,13 +426,14 @@ class DistHeteroNeighborSampler:
     out_specs = (
         tuple(sh for _ in ntypes), tuple(sh for _ in ntypes),
         tuple(sh for _ in etypes), tuple(sh for _ in etypes),
-        tuple(sh for _ in etypes), sh,
+        tuple(sh for _ in etypes), tuple(sh for _ in seed_types),
         tuple(sh for _ in feat_nts), tuple(sh for _ in label_nts),
-        tuple(sh for _ in ntypes),
+        tuple(sh for _ in ntypes), sh,
     )
     sharded = shard_map(per_device, mesh=self.mesh, in_specs=in_specs,
                         out_specs=out_specs)
-    meta = dict(ntypes=ntypes, feat_nts=feat_nts, label_nts=label_nts)
+    meta = dict(ntypes=ntypes, feat_nts=feat_nts, label_nts=label_nts,
+                seed_types=seed_types)
     return jax.jit(sharded), meta
 
   def sample_from_nodes(self, input_type: NodeType,
@@ -396,7 +444,7 @@ class DistHeteroNeighborSampler:
     b = int(seeds_stacked.shape[1])
     cfg = (input_type, b)
     if cfg not in self._steps:
-      self._steps[cfg] = self._make_step(input_type, b)
+      self._steps[cfg] = self._make_step({input_type: b})
     step, meta = self._steps[cfg]
     arrs = self._arrays()
     self._step_cnt += 1
@@ -408,8 +456,10 @@ class DistHeteroNeighborSampler:
     bounds_t = tuple(arrs['bounds'][nt] for nt in meta['ntypes'])
     feats_t = tuple(arrs['feats'][nt] for nt in meta['feat_nts'])
     labels_t = tuple(arrs['labels'][nt] for nt in meta['label_nts'])
-    (node_t, cnt_t, row_t, col_t, eid_t, seed_local, x_t, y_t,
-     nsn_t) = step(graphs_t, bounds_t, feats_t, labels_t, seeds_dev, key)
+    (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t,
+     nsn_t, _) = step(graphs_t, bounds_t, feats_t, labels_t, seeds_dev,
+                      key)
+    seed_local = sl_t[meta['seed_types'].index(input_type)]
     ntypes = meta['ntypes']
     out = dict(
         node=dict(zip(ntypes, node_t)),
@@ -426,6 +476,116 @@ class DistHeteroNeighborSampler:
         num_sampled_nodes=dict(zip(ntypes, nsn_t)),
         batch=seeds_dev, input_type=input_type)
     return out
+
+  def _link_input_sizes(self, etype, mode, amount, b):
+    """Per-type seed counts for link expansion — negative counts from
+    the ONE shared definition (`distributed.dist_options.
+    binary_num_negatives`)."""
+    from ..distributed.dist_options import binary_num_negatives
+    s_t, _, d_t = etype
+    if mode == 'binary':
+      nn = binary_num_negatives(b, amount)
+      src_n = dst_n = b + nn
+    elif mode == 'triplet':
+      nn = b * int(np.ceil(amount))
+      src_n, dst_n = b, b + nn
+    else:
+      nn = 0
+      src_n = dst_n = b
+    if s_t == d_t:
+      return {s_t: src_n + dst_n}, nn
+    return {s_t: src_n, d_t: dst_n}, nn
+
+  def sample_from_edges(self, input_type: EdgeType,
+                        pairs_stacked: np.ndarray,
+                        neg_sampling=None):
+    """``pairs_stacked``: ``[P, B, 2|3]`` per-device (src, dst[,
+    label]) seed edges of edge type ``input_type``, each endpoint in
+    its node type's RELABELED id space.  Negatives are strict against
+    the global sharded etype CSR (collective `dist_edge_exists`)."""
+    from ..sampler.base import NegativeSampling
+    et = tuple(input_type)
+    s_t, _, d_t = et
+    ns = (NegativeSampling.cast(neg_sampling)
+          if neg_sampling is not None else None)
+    mode = ns.mode if ns is not None else None
+    amount = float(ns.amount) if ns is not None else 1.0
+    b = int(pairs_stacked.shape[1])
+    input_sizes, num_neg = self._link_input_sizes(et, mode, amount, b)
+    cfg = ('link', et, mode, amount, b, pairs_stacked.shape[2])
+    if cfg not in self._steps:
+      self._steps[cfg] = self._make_step(
+          input_sizes, link=dict(etype=et, mode=mode,
+                                 num_neg=num_neg, batch=b))
+    step, meta = self._steps[cfg]
+    arrs = self._arrays()
+    self._step_cnt += 1
+    key = jax.random.fold_in(self._base_key, self._step_cnt)
+    pairs_dev = jax.device_put(
+        np.asarray(pairs_stacked, dtype=np.int32),
+        NamedSharding(self.mesh, P(self.axis)))
+    graphs_t = tuple(arrs['graphs'][e] for e in self.etypes)
+    bounds_t = tuple(arrs['bounds'][nt] for nt in meta['ntypes'])
+    feats_t = tuple(arrs['feats'][nt] for nt in meta['feat_nts'])
+    labels_t = tuple(arrs['labels'][nt] for nt in meta['label_nts'])
+    (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t, nsn_t,
+     neg_ok) = step(graphs_t, bounds_t, feats_t, labels_t, pairs_dev,
+                    key)
+    ntypes = meta['ntypes']
+    seed_types = meta['seed_types']
+    sl = dict(zip(seed_types, sl_t))
+    if s_t == d_t:
+      all_sl = sl[s_t]
+      if mode == 'triplet':
+        n_src = b
+      elif mode == 'binary':
+        n_src = b + num_neg
+      else:
+        n_src = b
+      sl_s, sl_d = all_sl[:, :n_src], all_sl[:, n_src:]
+    else:
+      sl_s, sl_d = sl[s_t], sl[d_t]
+    pair_valid = (pairs_dev[:, :, 0] >= 0) & (pairs_dev[:, :, 1] >= 0)
+    pos_label = jnp.where(
+        pair_valid,
+        pairs_dev[:, :, 2] if pairs_stacked.shape[2] > 2
+        else jnp.ones_like(pair_valid, jnp.int32), 0)
+    md = {'seed_local': sl}
+    if mode == 'binary':
+      # sl_s/sl_d are already laid out positives-then-negatives
+      eli = jnp.stack([sl_s, sl_d], axis=1)
+      quota = jnp.ceil(jnp.sum(pair_valid, axis=1, keepdims=True)
+                       * jnp.float32(amount)).astype(jnp.int32)
+      neg_keep = neg_ok & (jnp.arange(num_neg)[None, :] < quota)
+      md.update(
+          edge_label_index=eli,
+          edge_label=jnp.concatenate(
+              [pos_label, jnp.zeros((pos_label.shape[0], num_neg),
+                                    jnp.int32)], axis=1),
+          edge_label_mask=jnp.concatenate([pair_valid, neg_keep],
+                                          axis=1))
+    elif mode == 'triplet':
+      amount_i = num_neg // b
+      dn = jnp.where(neg_ok, sl_d[:, b:], -1).reshape(
+          sl_d.shape[0], b, amount_i)
+      md.update(src_index=sl_s[:, :b], dst_pos_index=sl_d[:, :b],
+                dst_neg_index=dn, pair_mask=sl_s[:, :b] >= 0)
+    else:
+      md.update(edge_label_index=jnp.stack([sl_s, sl_d], axis=1),
+                edge_label=pos_label, edge_label_mask=pair_valid)
+    return dict(
+        node=dict(zip(ntypes, node_t)),
+        node_count={nt: c[..., 0] for nt, c in zip(ntypes, cnt_t)},
+        row={reverse_edge_type(e): r
+             for e, r in zip(self.etypes, row_t) if r is not None},
+        col={reverse_edge_type(e): c
+             for e, c in zip(self.etypes, col_t) if c is not None},
+        edge={reverse_edge_type(e): v
+              for e, v in zip(self.etypes, eid_t) if v is not None},
+        x=dict(zip(meta['feat_nts'], x_t)),
+        y=dict(zip(meta['label_nts'], y_t)),
+        num_sampled_nodes=dict(zip(ntypes, nsn_t)),
+        batch=pairs_dev[:, :, 0], metadata=md, input_type=et)
 
 
 class DistHeteroNeighborLoader:
