@@ -4,7 +4,8 @@ from .dp import (DataParallelLoader, make_dp_supervised_step,
 from .dist_data import (DistDataset, DistFeature, DistGraph,
                         build_dist_feature, build_dist_graph)
 from . import multihost
-from .dist_hetero import (DistHeteroDataset, DistHeteroNeighborLoader,
+from .dist_hetero import (DistHeteroDataset, DistHeteroLinkNeighborLoader,
+                          DistHeteroNeighborLoader,
                           DistHeteroNeighborSampler)
 from .dist_sampler import (DistLinkNeighborLoader, DistLinkNeighborSampler,
                            DistNeighborLoader, DistNeighborSampler,

@@ -640,3 +640,77 @@ class DistHeteroNeighborLoader:
         batch_size=self.batch_size,
         metadata={'seed_local': out['seed_local'],
                   'input_type': self.input_type})
+
+
+class DistHeteroLinkNeighborLoader:
+  """Distributed hetero link-prediction loader over the device mesh
+  (the hetero arm of `dist_sampler.DistLinkNeighborLoader`; reference
+  users reach it via ``DistLinkNeighborLoader`` on a hetero dataset,
+  `distributed/dist_link_neighbor_loader.py:30-153`).
+
+  Args:
+    edge_label_index: ``(edge_type, (rows, cols))`` seed edges, each
+      endpoint in its node type's id space.
+    edge_label: optional integer labels (binary mode applies the
+      reference's +1 shift).
+    neg_sampling: ``'binary'`` / ``('triplet', amount)`` / None.
+  """
+
+  def __init__(self, dataset: DistHeteroDataset, num_neighbors,
+               edge_label_index, edge_label=None, neg_sampling=None,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, mesh: Optional[Mesh] = None,
+               with_edge: bool = False, collect_features: bool = True,
+               seed: int = 0, input_space: str = 'old'):
+    from ..loader.node_loader import SeedBatcher
+    from ..sampler.base import NegativeSampling
+    from .dist_sampler import pack_link_seeds
+    input_type, pairs = edge_label_index
+    self.input_type = tuple(input_type)
+    # cast ONCE at construction: validates the mode up front and keeps
+    # the +1 label shift in lockstep with the sampler's parsing
+    ns = (NegativeSampling.cast(neg_sampling)
+          if neg_sampling is not None else None)
+    self.neg_sampling = ns
+    self.sampler = DistHeteroNeighborSampler(
+        dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
+        collect_features=collect_features, seed=seed)
+    rows, cols, colsarr = pack_link_seeds(
+        pairs, edge_label, ns.mode if ns is not None else None)
+    s_t, _, d_t = self.input_type
+    if input_space == 'old':
+      if s_t in dataset.old2new:
+        colsarr[0] = dataset.old2new[s_t][rows]
+      if d_t in dataset.old2new:
+        colsarr[1] = dataset.old2new[d_t][cols]
+    self.num_parts = dataset.num_partitions
+    self.batch_size = int(batch_size)
+    self._batcher = SeedBatcher(np.stack(colsarr, axis=1),
+                                batch_size * self.num_parts, shuffle,
+                                drop_last, seed)
+
+  def __len__(self):
+    return len(self._batcher)
+
+  def __iter__(self):
+    self._it = iter(self._batcher)
+    return self
+
+  def __next__(self):
+    from ..loader.transform import HeteroBatch
+    flat = next(self._it)
+    pairs = flat.reshape(self.num_parts, self.batch_size, -1)
+    out = self.sampler.sample_from_edges(self.input_type, pairs,
+                                         neg_sampling=self.neg_sampling)
+    ei = {et: jnp.stack([out['row'][et], out['col'][et]], axis=1)
+          for et in out['row']}
+    em = {et: out['row'][et] >= 0 for et in out['row']}
+    md = dict(out['metadata'])
+    md['input_type'] = self.input_type
+    return HeteroBatch(
+        x_dict=out['x'], y_dict=out['y'], edge_index_dict=ei,
+        edge_attr_dict={}, node_dict=out['node'],
+        node_mask_dict={nt: v >= 0 for nt, v in out['node'].items()},
+        edge_mask_dict=em,
+        batch_dict={self.input_type[0]: out['batch']},
+        batch_size=self.batch_size, metadata=md)

@@ -670,6 +670,33 @@ class DistNeighborLoader:
         metadata={'seed_local': out['seed_local']})
 
 
+def pack_link_seeds(edge_label_index, edge_label,
+                    neg_mode: Optional[str]):
+  """Pack seed edges (+optional integer labels, binary +1-shifted) into
+  the ``[E, 2|3]`` tensor both mesh link loaders batch over — ONE
+  definition of the label contract (`link_loader.py:146-186`)."""
+  if isinstance(edge_label_index, (tuple, list)):
+    rows, cols = edge_label_index
+  else:
+    ei = np.asarray(edge_label_index)
+    rows, cols = ei[0], ei[1]
+  rows = np.asarray(rows, np.int64)
+  cols = np.asarray(cols, np.int64)
+  colsarr = [rows, cols]
+  if edge_label is not None:
+    lab = np.asarray(edge_label)
+    if not np.issubdtype(lab.dtype, np.integer):
+      raise ValueError(
+          'mesh link loaders carry integer edge labels in their packed '
+          'seed tensor; for float labels use the host-runtime '
+          'DistLinkNeighborLoader (graphlearn_tpu.distributed)')
+    lab = lab.astype(np.int64)
+    if neg_mode == 'binary':
+      lab = lab + 1     # reference +1 shift (`link_loader.py:146-186`)
+    colsarr.append(lab)
+  return rows, cols, colsarr
+
+
 class DistLinkNeighborSampler(DistNeighborSampler):
   """Device-mesh LINK sampler: per-device seed edges + collective
   strict negatives + endpoint expansion — the SPMD analog of the
@@ -772,29 +799,11 @@ class DistLinkNeighborLoader:
         dataset, num_neighbors, neg_sampling=neg_sampling, mesh=mesh,
         with_edge=with_edge, collect_features=collect_features,
         seed=seed, exchange_slack=exchange_slack)
-    if isinstance(edge_label_index, (tuple, list)):
-      rows, cols = edge_label_index
-    else:
-      ei = np.asarray(edge_label_index)
-      rows, cols = ei[0], ei[1]
-    rows = np.asarray(rows, np.int64)
-    cols = np.asarray(cols, np.int64)
+    rows, cols, colsarr = pack_link_seeds(edge_label_index, edge_label,
+                                          self.sampler.neg_mode)
     if input_space == 'old' and dataset.old2new is not None:
-      rows = dataset.old2new[rows]
-      cols = dataset.old2new[cols]
-    colsarr = [rows, cols]
-    if edge_label is not None:
-      lab = np.asarray(edge_label)
-      if not np.issubdtype(lab.dtype, np.integer):
-        raise ValueError(
-            'mesh DistLinkNeighborLoader carries integer edge labels in '
-            'its packed [B, 3] seed tensor; for float labels use the '
-            'host-runtime DistLinkNeighborLoader '
-            '(graphlearn_tpu.distributed)')
-      lab = lab.astype(np.int64)
-      if self.sampler.neg_mode == 'binary':
-        lab = lab + 1     # reference +1 shift (`link_loader.py:146-186`)
-      colsarr.append(lab)
+      colsarr[0] = dataset.old2new[rows]
+      colsarr[1] = dataset.old2new[cols]
     self.pairs = np.stack(colsarr, axis=1)
     self.num_parts = dataset.num_partitions
     self.batch_size = int(batch_size)

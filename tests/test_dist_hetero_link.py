@@ -102,3 +102,40 @@ def test_mesh_hetero_link_triplet():
         if dl < 0:
           continue
         assert (a, n2o_i[i[p][dl]]) not in edge_set
+
+
+def test_mesh_hetero_link_loader_epochs():
+  """Loader facade: every seed edge appears as a positive exactly once
+  per epoch; batches are HeteroBatch pytrees."""
+  import jax
+  from graphlearn_tpu.parallel import DistHeteroLinkNeighborLoader
+  hds, edge_set, urow, icol = _setup()
+  mesh = make_mesh(P)
+  m = 64
+  rng = np.random.default_rng(2)
+  idx = rng.choice(len(urow), m, replace=False)
+  loader = DistHeteroLinkNeighborLoader(
+      hds, [2, 2], (ET, (urow[idx], icol[idx])),
+      neg_sampling='binary', batch_size=2, shuffle=True, mesh=mesh,
+      seed=0)
+  n2o_u, n2o_i = hds.new2old['u'], hds.new2old['i']
+  structs = set()
+  for _ in range(2):
+    pos = []
+    for batch in loader:
+      structs.add(jax.tree_util.tree_structure(
+          jax.tree_util.tree_map(lambda a: a.shape, batch)))
+      u = np.asarray(batch.node_dict['u'])
+      i = np.asarray(batch.node_dict['i'])
+      eli = np.asarray(batch.metadata['edge_label_index'])
+      lab = np.asarray(batch.metadata['edge_label'])
+      lm = np.asarray(batch.metadata['edge_label_mask'])
+      for p in range(P):
+        ok = lm[p] & (lab[p] >= 1)
+        gs = n2o_u[u[p][eli[p, 0, ok]]]
+        gd = n2o_i[i[p][eli[p, 1, ok]]]
+        for a, b in zip(gs.tolist(), gd.tolist()):
+          assert (a, b) in edge_set
+          pos.append((a, b))
+    assert len(pos) == m
+  assert len(structs) == 1
