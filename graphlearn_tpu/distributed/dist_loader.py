@@ -384,6 +384,7 @@ class DistLoader:
     md = {'seed_local': msg.get('seed_local')}
     cfg = self.sampling_config
     bs = self.batch_size
+    explicit_mask = None
     for k, v in msg.items():
       if not k.startswith('#META.'):
         continue
@@ -399,12 +400,18 @@ class DistLoader:
         out = np.zeros(cap, v.dtype)
         out[:len(v)] = v
         md[name] = out
+      elif name == 'edge_label_mask':
+        # producer-supplied validity (strict-negative ok flags); folded
+        # into the width-derived mask after the loop
+        explicit_mask = np.asarray(v, bool)
       elif name in ('src_index', 'dst_pos_index', 'mapping'):
         out = np.full(bs, INVALID_ID, np.int64)
         out[:len(v)] = v
         md[name] = out
         if name == 'src_index':
-          md['pair_mask'] = np.arange(bs) < len(v)
+          # seed validity, not emission width: padded tail slots carry
+          # si = -1 and must read invalid (matches the mesh samplers)
+          md['pair_mask'] = out >= 0
       elif name == 'dst_neg_index':
         amount = v.shape[1]
         out = np.full((bs, amount), INVALID_ID, np.int64)
@@ -412,6 +419,12 @@ class DistLoader:
         md[name] = out
       else:
         md[name] = v
+    if explicit_mask is not None:
+      cap = cfg.label_cap(bs) if cfg else bs
+      padded = np.zeros(cap, bool)
+      padded[:len(explicit_mask)] = explicit_mask
+      base = md.get('edge_label_mask')
+      md['edge_label_mask'] = padded if base is None else padded & base
     return md
 
   def shutdown(self) -> None:

@@ -9,7 +9,7 @@ process only deserializes and `device_put`s.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -55,22 +55,29 @@ def strict_negative_pairs(indptr, sindices, num_src: int, num_dst: int,
   (`random_negative_sampler.cu:96-120`) as trials-stacked draws with
   batched rejection; slots where every trial collides keep the last
   draw (non-strict padding).  Bipartite-aware: rows from ``num_src``,
-  cols from ``num_dst``."""
+  cols from ``num_dst``.  Returns ``(rows, cols, ok)`` — ``ok`` False
+  marks exhausted-trials slots whose pair may be a REAL edge; callers
+  must mask those out of the negative label set (mirroring the mesh
+  engine's ``neg_ok`` contract in `parallel.dist_sampler.
+  dist_sample_negative`)."""
   rng = np.random.default_rng(seed)
   rows = rng.integers(0, num_src, (trials, count))
   cols = rng.integers(0, num_dst, (trials, count))
   exists = edges_exist(indptr, sindices, rows.reshape(-1),
                        cols.reshape(-1)).reshape(trials, count)
   ok = ~exists
-  pick = np.where(ok.any(axis=0), np.argmax(ok, axis=0), trials - 1)
+  any_ok = ok.any(axis=0)
+  pick = np.where(any_ok, np.argmax(ok, axis=0), trials - 1)
   ar = np.arange(count)
-  return rows[pick, ar], cols[pick, ar]
+  return rows[pick, ar], cols[pick, ar], any_ok
 
 
 def strict_negative_dsts(indptr, sindices, src: np.ndarray, num_dst: int,
                          amount: int, seed: int, trials: int = 5):
   """Per-source strict negative destinations ``[len(src), amount]``
-  (triplet mode), vectorized like :func:`strict_negative_pairs`."""
+  (triplet mode), vectorized like :func:`strict_negative_pairs`.
+  Returns ``(dsts, ok)`` — ``ok[i, j]`` False marks exhausted-trials
+  slots (possible real edges) the caller must invalidate."""
   rng = np.random.default_rng(seed)
   m = len(src) * amount
   cand = rng.integers(0, num_dst, (trials, m))
@@ -78,8 +85,10 @@ def strict_negative_dsts(indptr, sindices, src: np.ndarray, num_dst: int,
   exists = edges_exist(indptr, sindices, srcr.reshape(-1),
                        cand.reshape(-1)).reshape(trials, m)
   ok = ~exists
-  pick = np.where(ok.any(axis=0), np.argmax(ok, axis=0), trials - 1)
-  return cand[pick, np.arange(m)].reshape(len(src), amount)
+  any_ok = ok.any(axis=0)
+  pick = np.where(any_ok, np.argmax(ok, axis=0), trials - 1)
+  return (cand[pick, np.arange(m)].reshape(len(src), amount),
+          any_ok.reshape(len(src), amount))
 
 
 class HostNeighborSampler:
@@ -180,16 +189,28 @@ class HostNeighborSampler:
     dst = np.ascontiguousarray(dst, np.int64)
     b = len(src)
     batch_seed = self._next_batch_seed(batch_seed)
+    neg_ok = None
     if neg_mode == 'binary':
       from .dist_options import binary_num_negatives
       num_neg = binary_num_negatives(b, neg_amount)
-      nrows, ncols = native.negative_sample(
+      # padding=False: the native sampler returns only strict-verified
+      # pairs; self-pad the exhausted slots with masked dummies so the
+      # batch keeps its static width while possible real edges stay
+      # out of the negative label set (the mesh engine's neg_ok
+      # contract)
+      srows, scols = native.negative_sample(
           self.ds.indptr, self.ds.indices, num_neg, strict=True,
-          padding=True, seed=batch_seed * 31 + 7)
+          padding=False, seed=batch_seed * 31 + 7)
+      cnt = len(srows)
+      nrows = np.zeros(num_neg, np.int64)
+      ncols = np.zeros(num_neg, np.int64)
+      nrows[:cnt] = srows
+      ncols[:cnt] = scols
+      neg_ok = np.arange(num_neg) < cnt
       seeds = np.concatenate([src, dst, nrows, ncols])
     elif neg_mode == 'triplet':
       amount = int(np.ceil(neg_amount))
-      neg_dst = self._triplet_neg(src, amount, batch_seed)
+      neg_dst, neg_ok = self._triplet_neg(src, amount, batch_seed)
       seeds = np.concatenate([src, dst, neg_dst.reshape(-1)])
     else:
       seeds = np.concatenate([src, dst])
@@ -204,11 +225,14 @@ class HostNeighborSampler:
       ]).astype(np.int64)
       msg['#META.edge_label'] = np.concatenate(
           [pos_label, np.zeros(num_neg, np.int64)])
+      msg['#META.edge_label_mask'] = np.concatenate(
+          [np.ones(b, bool), neg_ok])
     elif neg_mode == 'triplet':
       amount = int(np.ceil(neg_amount))
       msg['#META.src_index'] = sl[:b]
       msg['#META.dst_pos_index'] = sl[b:2 * b]
-      msg['#META.dst_neg_index'] = sl[2 * b:].reshape(b, amount)
+      msg['#META.dst_neg_index'] = np.where(
+          neg_ok, sl[2 * b:].reshape(b, amount), -1)
     else:
       msg['#META.edge_label_index'] = np.stack(
           [sl[:b], sl[b:2 * b]]).astype(np.int64)
@@ -222,8 +246,8 @@ class HostNeighborSampler:
       self._sorted_indices = sorted_cols(self.ds.indptr, self.ds.indices)
     return self._sorted_indices
 
-  def _triplet_neg(self, src: np.ndarray, amount: int,
-                   batch_seed: int, trials: int = 5) -> np.ndarray:
+  def _triplet_neg(self, src: np.ndarray, amount: int, batch_seed: int,
+                   trials: int = 5) -> Tuple[np.ndarray, np.ndarray]:
     return strict_negative_dsts(self.ds.indptr, self._sorted_csr(), src,
                                 self.ds.num_nodes, amount, batch_seed,
                                 trials)
@@ -407,12 +431,13 @@ class HostHeteroNeighborSampler:
     dst = np.ascontiguousarray(dst, np.int64)
     b = len(src)
     batch_seed = self._next_batch_seed(batch_seed)
+    neg_ok = None
     if neg_mode == 'binary':
       from .dist_options import binary_num_negatives
       indptr, _, _ = self.ds.csr[tuple(input_type)]
       sind = self._sorted_for(tuple(input_type))
       num_neg = binary_num_negatives(b, neg_amount)
-      nrows, ncols = strict_negative_pairs(
+      nrows, ncols, neg_ok = strict_negative_pairs(
           indptr, sind, self.ds.num_nodes[s], self.ds.num_nodes[d],
           num_neg, seed=batch_seed * 31 + 7)
       src_seeds = np.concatenate([src, nrows])
@@ -421,9 +446,9 @@ class HostHeteroNeighborSampler:
       amount = int(np.ceil(neg_amount))
       indptr, _, _ = self.ds.csr[tuple(input_type)]
       sind = self._sorted_for(tuple(input_type))
-      negs = strict_negative_dsts(indptr, sind, src,
-                                  self.ds.num_nodes[d], amount,
-                                  seed=batch_seed * 31 + 7)
+      negs, neg_ok = strict_negative_dsts(indptr, sind, src,
+                                          self.ds.num_nodes[d], amount,
+                                          seed=batch_seed * 31 + 7)
       src_seeds = src
       dst_seeds = np.concatenate([dst, negs.reshape(-1)])
     else:
@@ -449,11 +474,14 @@ class HostHeteroNeighborSampler:
           [sl_s, sl_d]).astype(np.int64)
       msg['#META.edge_label'] = np.concatenate(
           [pos_label, np.zeros(len(sl_s) - b, np.int64)])
+      msg['#META.edge_label_mask'] = np.concatenate(
+          [np.ones(b, bool), neg_ok])
     elif neg_mode == 'triplet':
       amount = int(np.ceil(neg_amount))
       msg['#META.src_index'] = sl_s[:b]
       msg['#META.dst_pos_index'] = sl_d[:b]
-      msg['#META.dst_neg_index'] = sl_d[b:].reshape(b, amount)
+      msg['#META.dst_neg_index'] = np.where(
+          neg_ok, sl_d[b:].reshape(b, amount), -1)
     else:
       msg['#META.edge_label_index'] = np.stack(
           [sl_s, sl_d]).astype(np.int64)

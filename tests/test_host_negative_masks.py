@@ -1,0 +1,72 @@
+"""Host-runtime strict-negative validity + tail-batch pair_mask.
+
+Regressions for two runtime-disagreement bugs: (1) on graphs dense
+enough that strict rejection exhausts its trials, the host producers
+used to ship the fallback (possibly real-edge) pairs unmasked, while
+the mesh engine masked them via ``neg_ok``; (2) ``pair_mask`` was
+derived from emission width (always all-True) instead of seed
+validity, marking padded tail-batch slots valid.  Mirrors the
+reference's padding semantics (`random_negative_sampler.cu:96-120`)
+with the mesh engine's masking contract on top.
+"""
+import numpy as np
+import pytest
+
+from graphlearn_tpu import native
+from graphlearn_tpu.distributed import DistLinkNeighborLoader, HostDataset
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native lib unavailable')
+
+N = 10
+
+
+def _complete_graph():
+  """Every (u, v) pair INCLUDING self-loops is an edge: strict
+  negative sampling cannot succeed, every trial collides."""
+  rows = np.repeat(np.arange(N), N)
+  cols = np.tile(np.arange(N), N)
+  feats = np.tile(np.arange(N, dtype=np.float32)[:, None], (1, 3))
+  return HostDataset.from_coo(rows, cols, N, node_features=feats), rows, cols
+
+
+def test_binary_exhausted_trials_are_masked():
+  ds, rows, cols = _complete_graph()
+  loader = DistLinkNeighborLoader(
+      ds, [2], (rows[:8], cols[:8]),
+      neg_sampling=('binary', 1.0), batch_size=8, to_device=False)
+  for batch in loader:
+    lab = np.asarray(batch.metadata['edge_label'])
+    mask = np.asarray(batch.metadata['edge_label_mask'])
+    # positives stay valid; every negative slot collided and must be
+    # masked out (its fallback pair IS a real edge on this graph)
+    assert mask[:8].all()
+    assert not mask[lab == 0].any()
+
+
+def test_triplet_exhausted_trials_invalidate_dst_neg():
+  ds, rows, cols = _complete_graph()
+  loader = DistLinkNeighborLoader(
+      ds, [2], (rows[:8], cols[:8]),
+      neg_sampling=('triplet', 2), batch_size=8, to_device=False)
+  batch = next(iter(loader))
+  dneg = np.asarray(batch.metadata['dst_neg_index'])
+  assert (dneg == -1).all()
+
+
+def test_tail_batch_pair_mask_tracks_seed_validity():
+  # sparse ring so negatives succeed; 10 seeds into batches of 8
+  # leaves a 2-seed tail whose 6 padded slots must read invalid
+  rows = np.arange(40)
+  cols = (rows + 1) % 40
+  ds = HostDataset.from_coo(rows, cols, 40)
+  loader = DistLinkNeighborLoader(
+      ds, [2], (rows[:10], cols[:10]),
+      neg_sampling=('triplet', 1), batch_size=8, to_device=False)
+  masks = []
+  for batch in loader:
+    pm = np.asarray(batch.metadata['pair_mask'])
+    si = np.asarray(batch.metadata['src_index'])
+    assert (pm == (si >= 0)).all()
+    masks.append(pm.sum())
+  assert sorted(masks) == [2, 8]
