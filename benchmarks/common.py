@@ -72,7 +72,27 @@ class Timer:
     self.dt = time.perf_counter() - self.t0
 
 
-def run_in_fresh_process(script: str, args) -> bool:
+def cpu_mesh_env(num_devices: int) -> dict:
+  """Subprocess env forcing an ``num_devices``-device virtual CPU mesh.
+
+  Must be applied at process SPAWN: a sitecustomize on PYTHONPATH
+  pre-imports jax and latches the platform before user code runs, so
+  in-process env changes are too late (see tests/conftest.py).
+  """
+  import os
+  env = dict(os.environ)
+  env.pop('PALLAS_AXON_POOL_IPS', None)     # don't register the TPU plugin
+  env['JAX_PLATFORMS'] = 'cpu'
+  flags = env.get('XLA_FLAGS', '')
+  flags = ' '.join(f for f in flags.split()
+                   if '--xla_force_host_platform_device_count' not in f)
+  env['XLA_FLAGS'] = (
+      f'{flags} --xla_force_host_platform_device_count={num_devices}'
+      .strip())
+  return env
+
+
+def run_in_fresh_process(script: str, args, env=None) -> bool:
   """Re-exec one benchmark config in a clean interpreter and stream
   its output; returns False (and keeps going) if the config failed,
   so one bad configuration never aborts the rest of a sweep.
@@ -85,7 +105,7 @@ def run_in_fresh_process(script: str, args) -> bool:
   import subprocess
   import sys
   cmd = [sys.executable, script] + [str(a) for a in args]
-  rc = subprocess.run(cmd).returncode
+  rc = subprocess.run(cmd, env=env).returncode
   if rc != 0:
     print(json.dumps({'metric': 'config_failed', 'args': list(map(str, args)),
                       'returncode': rc}), flush=True)

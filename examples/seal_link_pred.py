@@ -14,6 +14,11 @@ positives, random non-edges negatives.
 Usage::
 
     python examples/seal_link_pred.py [--epochs 3] [--cpu]
+
+    # pod-scale extraction: enclosing subgraphs sampled by the
+    # device-mesh engine (P links in flight per SPMD step):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/seal_link_pred.py --mesh
 """
 import argparse
 import sys
@@ -80,6 +85,9 @@ def main():
   ap.add_argument('--num-links', type=int, default=256)
   ap.add_argument('--max-label', type=int, default=16)
   ap.add_argument('--cpu', action='store_true')
+  ap.add_argument('--mesh', action='store_true',
+                  help='extract enclosing subgraphs with the device-'
+                       'mesh DistSubGraphLoader (SEAL at pod scale)')
   args = ap.parse_args()
 
   import jax
@@ -111,9 +119,20 @@ def main():
   order = rng.permutation(2 * m)
   pairs, labels = pairs[order], labels[order]
 
-  # one SubGraphLoader batch of 2 seeds == one link's enclosing subgraph
-  loader = SubGraphLoader(ds, [8], pairs.reshape(-1), batch_size=2,
-                          shuffle=False, seed=0)
+  # one batch of 2 seeds == one link's enclosing subgraph; --mesh runs
+  # P links per SPMD step on the sharded graph (reference `_subgraph`
+  # across partitions, `dist_neighbor_sampler.py:456-516`)
+  if args.mesh:
+    from graphlearn_tpu.parallel import (DistDataset, DistSubGraphLoader,
+                                         make_mesh)
+    num_parts = len(jax.devices())
+    dds = DistDataset.from_full_graph(num_parts, rows, cols, num_nodes=n)
+    loader = DistSubGraphLoader(dds, [8], pairs.reshape(-1),
+                                batch_size=2, mesh=make_mesh(num_parts),
+                                collect_features=False, seed=0)
+  else:
+    loader = SubGraphLoader(ds, [8], pairs.reshape(-1), batch_size=2,
+                            shuffle=False, seed=0)
 
   class SealDGCNN(nn.Module):
     """DRNL label embedding -> DGCNN (the reference's SEAL classifier:
@@ -134,13 +153,28 @@ def main():
 
   # Pre-extract subgraphs + DRNL labels once (host-side prep).
   sub = []
-  for i, batch in enumerate(loader):
-    nmask = np.asarray(batch.node_mask)
-    ei = np.asarray(batch.edge_index)
-    em = np.asarray(batch.edge_mask)
-    mapping = np.asarray(batch.metadata['mapping'])
-    lab = drnl(nmask, ei, em, int(mapping[0]), int(mapping[1]))
-    sub.append((lab, ei, em, nmask, labels[i]))
+  if args.mesh:
+    num_parts = len(jax.devices())
+    for i, batch in enumerate(loader):
+      nmask = np.asarray(batch.node_mask)
+      ei = np.asarray(batch.edge_index)
+      em = np.asarray(batch.edge_mask)
+      mapping = np.asarray(batch.metadata['mapping'])
+      for p in range(num_parts):       # one link per device slice
+        link = i * num_parts + p
+        if link >= len(labels) or mapping[p, 0] < 0:
+          continue
+        lab = drnl(nmask[p], ei[p], em[p], int(mapping[p, 0]),
+                   int(mapping[p, 1]))
+        sub.append((lab, ei[p], em[p], nmask[p], labels[link]))
+  else:
+    for i, batch in enumerate(loader):
+      nmask = np.asarray(batch.node_mask)
+      ei = np.asarray(batch.edge_index)
+      em = np.asarray(batch.edge_mask)
+      mapping = np.asarray(batch.metadata['mapping'])
+      lab = drnl(nmask, ei, em, int(mapping[0]), int(mapping[1]))
+      sub.append((lab, ei, em, nmask, labels[i]))
 
   tx = optax.adam(1e-3)
   l0, e0, m0, nm0, _ = sub[0]

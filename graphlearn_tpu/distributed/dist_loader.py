@@ -278,7 +278,7 @@ class DistLoader:
     edge_index = np.full((2, ec), INVALID_ID, np.int32)
     edge_index[0, :e] = msg['rows']
     edge_index[1, :e] = msg['cols']
-    x = y = edge = None
+    x = y = edge = edge_attr = None
     if 'nfeats' in msg:
       d = msg['nfeats'].shape[1]
       x = np.zeros((nc, d), msg['nfeats'].dtype)
@@ -289,10 +289,14 @@ class DistLoader:
     if 'eids' in msg:
       edge = np.full(ec, INVALID_ID, np.int64)
       edge[:e] = msg['eids']
+    if 'efeats' in msg:
+      de = msg['efeats'].shape[1]
+      edge_attr = np.zeros((ec, de), msg['efeats'].dtype)
+      edge_attr[:e] = msg['efeats']
     batch = np.full(self.batch_cap, INVALID_ID, np.int64)
     batch[:len(msg['batch'])] = msg['batch']
     out = Batch(
-        x=x, y=y, edge_index=edge_index, node=node,
+        x=x, y=y, edge_index=edge_index, edge_attr=edge_attr, node=node,
         node_mask=node >= 0, edge_mask=edge_index[0] >= 0, edge=edge,
         batch=batch, batch_size=self.batch_size,
         num_sampled_nodes=msg.get('num_sampled_nodes'),
@@ -338,6 +342,7 @@ class DistLoader:
       if ns is not None:
         md['num_sampled_nodes'][nt] = ns
     ei_d, em_d, edge_d = {}, {}, {}
+    ea_d = {}
     for et, ecap in self.h_edge_cap.items():
       key = as_str(et)
       rows = msg.get(f'{key}.rows')
@@ -354,6 +359,11 @@ class DistLoader:
         eids = msg.get(f'{key}.eids')
         if ev is not None and eids is not None:
           ev[:e] = eids
+        efeats = msg.get(f'{key}.efeats')
+        if efeats is not None:
+          ea = np.zeros((ecap, efeats.shape[1]), efeats.dtype)
+          ea[:e] = efeats
+          ea_d[et] = ea
       if ev is not None:
         edge_d[et] = ev
       ei_d[et] = edge_index
@@ -370,6 +380,7 @@ class DistLoader:
       md['edge_dict'] = edge_d
     out = HeteroBatch(
         x_dict=x_d, y_dict=y_d, edge_index_dict=ei_d, node_dict=node_d,
+        edge_attr_dict=ea_d,
         node_mask_dict=nm_d, edge_mask_dict=em_d,
         batch_dict={batch_t: batch}, batch_size=self.batch_size,
         metadata=md)

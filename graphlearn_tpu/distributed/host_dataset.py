@@ -13,6 +13,8 @@ from typing import Optional
 
 import numpy as np
 
+from ..typing import as_str
+
 
 class HostDataset:
   """CSR topology + features/labels as host numpy arrays.
@@ -21,10 +23,11 @@ class HostDataset:
     indptr / indices / edge_ids: CSR (``edge_ids`` optional).
     node_features: ``[N, D]`` or None.
     node_labels: ``[N]`` or None.
+    edge_features: ``[E, De]`` indexed by GLOBAL edge id, or None.
   """
 
   def __init__(self, indptr, indices, edge_ids=None, node_features=None,
-               node_labels=None):
+               node_labels=None, edge_features=None):
     self.indptr = np.ascontiguousarray(indptr, np.int64)
     self.indices = np.ascontiguousarray(indices, np.int64)
     self.edge_ids = (np.ascontiguousarray(edge_ids, np.int64)
@@ -33,6 +36,8 @@ class HostDataset:
                           if node_features is not None else None)
     self.node_labels = (np.asarray(node_labels)
                         if node_labels is not None else None)
+    self.edge_features = (np.asarray(edge_features)
+                          if edge_features is not None else None)
 
   @property
   def num_nodes(self) -> int:
@@ -44,7 +49,10 @@ class HostDataset:
 
   @classmethod
   def from_coo(cls, rows, cols, num_nodes: Optional[int] = None,
-               node_features=None, node_labels=None) -> 'HostDataset':
+               node_features=None, node_labels=None,
+               edge_features=None) -> 'HostDataset':
+    """``edge_features`` rows follow the INPUT edge order (edge id i =
+    i-th COO edge), matching `Dataset.init_edge_features`."""
     from ..native import coo_to_csr
     rows = np.asarray(rows)
     cols = np.asarray(cols)
@@ -52,7 +60,7 @@ class HostDataset:
             else max(rows.max(initial=-1), cols.max(initial=-1)) + 1)
     indptr, indices, perm = coo_to_csr(rows, cols, n)
     return cls(indptr, indices, edge_ids=perm, node_features=node_features,
-               node_labels=node_labels)
+               node_labels=node_labels, edge_features=edge_features)
 
   @classmethod
   def from_dataset(cls, dataset) -> 'HostDataset':
@@ -60,10 +68,12 @@ class HostDataset:
     topo = dataset.get_graph().csr_topo
     feats = dataset.get_node_feature()
     labels = dataset.get_node_label()
+    efeats = dataset.get_edge_feature()
     return cls(
         topo.indptr, topo.indices, edge_ids=topo.edge_ids,
         node_features=feats.host_get() if feats is not None else None,
-        node_labels=np.asarray(labels) if labels is not None else None)
+        node_labels=np.asarray(labels) if labels is not None else None,
+        edge_features=efeats.host_get() if efeats is not None else None)
 
   @classmethod
   def from_partition_dir(cls, root, partition_idx: int) -> 'HostDataset':
@@ -86,8 +96,15 @@ class HostDataset:
       labels = np.zeros((n,), lab.dtype)
       labels[ids] = lab
     eids = p['graph'].eids[perm] if p['graph'].eids is not None else perm
+    efeats = None
+    if p.get('edge_feat') is not None:
+      ef = p['edge_feat']
+      e_total = int(p['meta'].get('num_edges',
+                                  int(ef.ids.max(initial=-1)) + 1))
+      efeats = np.zeros((e_total, ef.feats.shape[1]), ef.feats.dtype)
+      efeats[ef.ids] = ef.feats
     return cls(indptr, indices, edge_ids=eids, node_features=feats,
-               node_labels=labels)
+               node_labels=labels, edge_features=efeats)
 
 
 class HostHeteroDataset:
@@ -103,9 +120,11 @@ class HostHeteroDataset:
       direction src→dst (``edge_ids`` may be None).
     num_nodes: ``{NodeType: int}``.
     node_features / node_labels: ``{NodeType: array}`` (optional).
+    edge_features: ``{EdgeType: [E, De]}`` by global eid (optional).
   """
 
-  def __init__(self, csr, num_nodes, node_features=None, node_labels=None):
+  def __init__(self, csr, num_nodes, node_features=None, node_labels=None,
+               edge_features=None):
     self.csr = {}
     for et, (indptr, indices, eids) in csr.items():
       self.csr[tuple(et)] = (
@@ -118,6 +137,8 @@ class HostHeteroDataset:
                           (node_features or {}).items()}
     self.node_labels = {nt: np.asarray(v) for nt, v in
                         (node_labels or {}).items()}
+    self.edge_features = {tuple(et): np.asarray(v) for et, v in
+                          (edge_features or {}).items()}
 
   @property
   def edge_types(self):
@@ -130,7 +151,8 @@ class HostHeteroDataset:
 
   @classmethod
   def from_coo(cls, edge_index_dict, num_nodes_dict=None,
-               node_features=None, node_labels=None) -> 'HostHeteroDataset':
+               node_features=None, node_labels=None,
+               edge_features=None) -> 'HostHeteroDataset':
     """Build from ``{EdgeType: (rows, cols)}`` COO dicts."""
     from ..native import coo_to_csr
     num_nodes = dict(num_nodes_dict or {})
@@ -146,7 +168,7 @@ class HostHeteroDataset:
           np.asarray(rows), np.asarray(cols), num_nodes[et[0]])
       csr[et] = (indptr, indices, perm)
     return cls(csr, num_nodes, node_features=node_features,
-               node_labels=node_labels)
+               node_labels=node_labels, edge_features=edge_features)
 
   @classmethod
   def from_dataset(cls, dataset) -> 'HostHeteroDataset':
@@ -163,8 +185,12 @@ class HostHeteroDataset:
     if isinstance(dataset.node_labels, dict):
       for nt, lab in dataset.node_labels.items():
         labels[nt] = np.asarray(lab)
+    efeats = {}
+    if isinstance(dataset.edge_features, dict):
+      for et, f in dataset.edge_features.items():
+        efeats[tuple(et)] = f.host_get()
     return cls(csr, dataset.num_nodes_dict(), node_features=feats,
-               node_labels=labels)
+               node_labels=labels, edge_features=efeats)
 
   @classmethod
   def from_partition_dir(cls, root, partition_idx: int
@@ -192,4 +218,13 @@ class HostHeteroDataset:
       full = np.zeros((num_nodes[nt],), lab.dtype)
       full[ids] = lab
       labels[nt] = full
-    return cls(csr, num_nodes, node_features=feats, node_labels=labels)
+    efeats = {}
+    num_edges = p['meta'].get('num_edges', {})
+    for et, f in (p.get('edge_feat') or {}).items():
+      e_total = int(num_edges.get(as_str(et),
+                                  int(f.ids.max(initial=-1)) + 1))
+      full = np.zeros((e_total, f.feats.shape[1]), f.feats.dtype)
+      full[f.ids] = f.feats
+      efeats[et] = full
+    return cls(csr, num_nodes, node_features=feats, node_labels=labels,
+               edge_features=efeats)

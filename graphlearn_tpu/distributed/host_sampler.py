@@ -162,6 +162,12 @@ class HostNeighborSampler:
     }
     if eids is not None:
       msg['eids'] = eids
+      if (self.collect_features
+          and self.ds.edge_features is not None):
+        # per-edge feature rows by global eid — the reference's efeats
+        # collation (`dist_neighbor_sampler.py:600-673`)
+        msg['efeats'] = np.ascontiguousarray(
+            self.ds.edge_features[eids])
     if self.collect_features and self.ds.node_features is not None:
       msg['nfeats'] = np.ascontiguousarray(self.ds.node_features[nodes])
     if self.ds.node_labels is not None:
@@ -406,7 +412,12 @@ class HostHeteroNeighborSampler:
       msg[f'{key}.rows'] = np.concatenate(rows_acc[et])
       msg[f'{key}.cols'] = np.concatenate(cols_acc[et])
       if self.with_edge and eids_acc[et]:
-        msg[f'{key}.eids'] = np.concatenate(eids_acc[et])
+        eids = np.concatenate(eids_acc[et])
+        msg[f'{key}.eids'] = eids
+        if (self.collect_features
+            and tuple(et) in self.ds.edge_features):
+          msg[f'{key}.efeats'] = np.ascontiguousarray(
+              self.ds.edge_features[tuple(et)][eids])
     return msg
 
   def sample_from_nodes(self, input_type: str, seeds: np.ndarray,

@@ -2,12 +2,14 @@ from .dp import (DataParallelLoader, make_dp_supervised_step,
                  make_dp_unsupervised_step, make_mesh,
                  replicate, shard_stacked, stack_batches)
 from .dist_data import (DistDataset, DistFeature, DistGraph,
-                        build_dist_feature, build_dist_graph)
+                        build_dist_edge_feature, build_dist_feature,
+                        build_dist_graph)
 from . import multihost
 from .dist_hetero import (DistHeteroDataset, DistHeteroLinkNeighborLoader,
                           DistHeteroNeighborLoader,
                           DistHeteroNeighborSampler)
 from .dist_sampler import (DistLinkNeighborLoader, DistLinkNeighborSampler,
                            DistNeighborLoader, DistNeighborSampler,
+                           DistSubGraphLoader, DistSubGraphSampler,
                            bucket_by_owner, dist_edge_exists, dist_gather,
                            dist_sample_negative)

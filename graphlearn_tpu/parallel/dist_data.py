@@ -129,13 +129,17 @@ class DistFeature:
     cache_rows: optional ``[P, C, D]`` the cached rows.
   """
 
-  def __init__(self, shards, bounds, cache_ids=None, cache_rows=None):
+  def __init__(self, shards, bounds, cache_ids=None, cache_rows=None,
+               mod_sharded: bool = False):
     self.shards = np.asarray(shards)
     self.bounds = np.asarray(bounds, dtype=np.int64)
     self.cache_ids = (np.asarray(cache_ids, np.int32)
                       if cache_ids is not None else None)
     self.cache_rows = (np.asarray(cache_rows)
                        if cache_rows is not None else None)
+    #: True = strided ownership (owner = id % P, row = id // P) —
+    #: `build_dist_edge_feature`; False = range ownership by `bounds`.
+    self.mod_sharded = mod_sharded
 
   @property
   def feature_dim(self) -> int:
@@ -183,6 +187,35 @@ def build_dist_feature(feats: np.ndarray, old2new: np.ndarray,
   return DistFeature(shards, bounds)
 
 
+def build_dist_edge_feature(efeats: np.ndarray,
+                            num_parts: int) -> DistFeature:
+  """MOD-shard an edge-feature table ``[E, De]`` (indexed by GLOBAL
+  edge id): shard ``p`` row ``r`` holds edge ``r * P + p``.
+
+  Edge ids are stable through the node relabel (`build_dist_graph`
+  keeps the input edge order), so no id map is needed — the collective
+  analog of the reference's separate ``edge_feat_pb``
+  (`distributed/dist_dataset.py:183-193`).  Mod (strided) assignment,
+  not ranges, on purpose: a node's out-edges have CONSECUTIVE ids in
+  the usual COO order, so range sharding would send one seed's whole
+  edge set to a single owner and systematically overflow the
+  capacity-bounded gather; mod sharding spreads every consecutive run
+  evenly, making the balanced-share capacity assumption hold by
+  construction.
+  """
+  efeats = np.asarray(efeats)
+  if efeats.ndim == 1:
+    efeats = efeats[:, None]
+  e = efeats.shape[0]
+  rows_max = max(-(-e // num_parts), 1)
+  shards = np.zeros((num_parts, rows_max, efeats.shape[1]), efeats.dtype)
+  for p in range(num_parts):
+    own = efeats[p::num_parts]
+    shards[p, :len(own)] = own
+  return DistFeature(shards, np.arange(num_parts + 1, dtype=np.int64),
+                     mod_sharded=True)
+
+
 class DistDataset:
   """Sharded dataset: graph + features + labels in the relabeled space.
 
@@ -190,14 +223,17 @@ class DistDataset:
     graph: `DistGraph`.
     node_features: `DistFeature` or None.
     node_labels: ``[P, rows_max]`` stacked label shards or None.
+    edge_features: `DistFeature` MOD-sharded over GLOBAL edge ids
+      (owner = eid % P; see `build_dist_edge_feature`) or None.
     old2new / new2old: id-space maps.
   """
 
   def __init__(self, graph: DistGraph, node_features=None, node_labels=None,
-               old2new: Optional[np.ndarray] = None):
+               old2new: Optional[np.ndarray] = None, edge_features=None):
     self.graph = graph
     self.node_features = node_features
     self.node_labels = node_labels
+    self.edge_features = edge_features
     self.old2new = old2new
     self.new2old = (np.argsort(old2new) if old2new is not None else None)
 
@@ -209,7 +245,7 @@ class DistDataset:
   def from_full_graph(cls, num_parts: int, rows, cols, node_feat=None,
                       node_label=None, num_nodes: Optional[int] = None,
                       node_pb: Optional[np.ndarray] = None,
-                      seed: int = 0) -> 'DistDataset':
+                      seed: int = 0, edge_feat=None) -> 'DistDataset':
     """In-memory partition + shard (testing & single-host path)."""
     rows = np.asarray(rows)
     cols = np.asarray(cols)
@@ -230,7 +266,9 @@ class DistDataset:
       # build_dist_feature preserves dtype — no float round-trip.
       lab = np.asarray(node_label)
       nl = build_dist_feature(lab, old2new, g.bounds).shards[..., 0]
-    return cls(g, nf, nl, old2new)
+    ef = (build_dist_edge_feature(edge_feat, num_parts)
+          if edge_feat is not None else None)
+    return cls(g, nf, nl, old2new, edge_features=ef)
 
   @classmethod
   def from_partition_dir(cls, root, num_parts: Optional[int] = None
@@ -277,4 +315,12 @@ class DistDataset:
         lab, ids = p['node_label']
         labels[ids] = lab
       nl = build_dist_feature(labels, old2new, g.bounds).shards[..., 0]
-    return cls(g, nf, nl, old2new)
+    ef = None
+    if parts[0].get('edge_feat') is not None:
+      e = len(rows)
+      d = parts[0]['edge_feat'].feats.shape[1]
+      efeats = np.zeros((e, d), parts[0]['edge_feat'].feats.dtype)
+      for p in parts:
+        efeats[p['edge_feat'].ids] = p['edge_feat'].feats
+      ef = build_dist_edge_feature(efeats, num_parts)
+    return cls(g, nf, nl, old2new, edge_features=ef)

@@ -39,9 +39,10 @@ from ..sampler.hetero_neighbor_sampler import (_plan_capacities,
                                                normalize_fanouts)
 from ..typing import EdgeType, NodeType, reverse_edge_type
 from ..utils.padding import INVALID_ID
-from .dist_data import build_dist_feature
-from .dist_sampler import (_dist_one_hop, dist_gather_multi,
-                           dist_sample_negative)
+from .dist_data import build_dist_edge_feature, build_dist_feature
+from .dist_sampler import (ExchangeTelemetry, NEG_TRIALS, _dist_one_hop,
+                           _slack_cap, dist_gather_multi,
+                           dist_sample_negative, resolve_exchange_slack)
 
 
 class DistHeteroDataset:
@@ -52,15 +53,20 @@ class DistHeteroDataset:
     bounds: ``{NodeType: [P+1]}`` ownership ranges.
     node_features: ``{NodeType: DistFeature}``.
     node_labels: ``{NodeType: [P, rows_max]}``.
+    edge_features: ``{EdgeType: DistFeature}`` MOD-sharded over that
+      type's GLOBAL edge ids (owner = eid % P,
+      `build_dist_edge_feature`).
     old2new / new2old: ``{NodeType: [N_nt]}`` id-space maps.
   """
 
   def __init__(self, graphs, bounds, node_features=None, node_labels=None,
-               old2new=None):
+               old2new=None, edge_features=None):
     self.graphs = dict(graphs)
     self.bounds = {nt: np.asarray(b, np.int64) for nt, b in bounds.items()}
     self.node_features = dict(node_features or {})
     self.node_labels = dict(node_labels or {})
+    self.edge_features = {tuple(et): f
+                          for et, f in (edge_features or {}).items()}
     self.old2new = dict(old2new or {})
     self.new2old = {nt: np.argsort(m) for nt, m in self.old2new.items()}
 
@@ -83,9 +89,12 @@ class DistHeteroDataset:
   def from_full_graph(cls, num_parts: int, edge_index_dict,
                       node_feat_dict=None, node_label_dict=None,
                       num_nodes_dict=None, node_pb_dict=None,
-                      seed: int = 0) -> 'DistHeteroDataset':
+                      seed: int = 0, edge_feat_dict=None,
+                      edge_ids_dict=None) -> 'DistHeteroDataset':
     """In-memory partition + shard (testing & single-host path) — the
-    hetero analog of `DistDataset.from_full_graph`."""
+    hetero analog of `DistDataset.from_full_graph`.  ``edge_ids_dict``
+    preserves caller-global edge ids (``edge_feat_dict`` rows index by
+    them); defaults to input order per etype."""
     node_feat_dict = node_feat_dict or {}
     node_label_dict = node_label_dict or {}
     num_nodes_dict = dict(num_nodes_dict or {})
@@ -123,7 +132,8 @@ class DistHeteroDataset:
       s, _, d = et
       graphs[et] = _build_etype_graph(
           old2new[s][np.asarray(rows)], old2new[d][np.asarray(cols)],
-          bounds[s], num_parts)
+          bounds[s], num_parts,
+          edge_ids=(edge_ids_dict or {}).get(et))
 
     feats = {nt: build_dist_feature(f, old2new[nt], bounds[nt])
              for nt, f in node_feat_dict.items()}
@@ -131,7 +141,10 @@ class DistHeteroDataset:
     for nt, lab in node_label_dict.items():
       labels[nt] = build_dist_feature(
           np.asarray(lab), old2new[nt], bounds[nt]).shards[..., 0]
-    return cls(graphs, bounds, feats, labels, old2new)
+    efeats = {tuple(et): build_dist_edge_feature(f, num_parts)
+              for et, f in (edge_feat_dict or {}).items()}
+    return cls(graphs, bounds, feats, labels, old2new,
+               edge_features=efeats)
 
   @classmethod
   def from_partition_dir(cls, root, num_parts: Optional[int] = None
@@ -145,13 +158,17 @@ class DistHeteroDataset:
     num_parts = num_parts or meta['num_parts']
     parts = [p0] + [load_partition(root, i) for i in range(1, num_parts)]
 
-    edge_index_dict, node_pb_dict = {}, {}
+    edge_index_dict, node_pb_dict, edge_ids_dict = {}, {}, {}
     for nt in meta['node_types']:
       node_pb_dict[nt] = np.asarray(parts[0]['node_pb'][nt].table)
     for et in parts[0]['graph']:
       rows = np.concatenate([p['graph'][et].edge_index[0] for p in parts])
       cols = np.concatenate([p['graph'][et].edge_index[1] for p in parts])
       edge_index_dict[et] = (rows, cols)
+      # keep the partitioner's GLOBAL edge ids: edge features (and any
+      # user-side eid provenance) index by them, not by concat order
+      edge_ids_dict[et] = np.concatenate(
+          [p['graph'][et].eids for p in parts])
     node_feat_dict = {}
     for nt in meta['node_types']:
       fparts = [p['node_feat'].get(nt) for p in parts]
@@ -175,27 +192,47 @@ class DistHeteroDataset:
           if l is not None:
             labels[l[1]] = l[0]
         node_label_dict[nt] = labels
+    edge_feat_dict = {}
+    from ..typing import as_str
+    for et in edge_index_dict:
+      fparts = [(p.get('edge_feat') or {}).get(et) for p in parts]
+      if any(f is not None for f in fparts):
+        e = int(meta.get('num_edges', {}).get(
+            as_str(et), len(edge_index_dict[et][0])))
+        f0 = next(f for f in fparts if f is not None)
+        efeats = np.zeros((e, f0.feats.shape[1]), f0.feats.dtype)
+        for f in fparts:
+          if f is not None:
+            efeats[f.ids] = f.feats
+        edge_feat_dict[et] = efeats
     return cls.from_full_graph(
         num_parts, edge_index_dict, node_feat_dict, node_label_dict,
         num_nodes_dict={nt: int(meta['num_nodes'][nt])
                         for nt in meta['node_types']},
-        node_pb_dict=node_pb_dict)
+        node_pb_dict=node_pb_dict, edge_feat_dict=edge_feat_dict,
+        edge_ids_dict=edge_ids_dict)
 
 
 def _build_etype_graph(rows_new: np.ndarray, cols_new: np.ndarray,
-                       bounds_s: np.ndarray, num_parts: int):
+                       bounds_s: np.ndarray, num_parts: int,
+                       edge_ids: Optional[np.ndarray] = None):
   """Stacked per-partition local CSRs for one edge type.
 
   ``rows_new`` are RELABELED src-type ids (sharded by ``bounds_s``),
   ``cols_new`` RELABELED dst-type ids kept global — the hetero twist
   `build_dist_graph` can't express (its single relabel map would be
-  applied to both endpoint spaces).
+  applied to both endpoint spaces).  ``edge_ids`` preserves the
+  caller's GLOBAL edge ids (edge features index by them); defaults to
+  input order.
   """
   from .dist_data import DistGraph
   from ..utils.topo import coo_to_csr
   counts = np.diff(bounds_s)
   owner = (np.searchsorted(bounds_s, rows_new, side='right') - 1)
-  edge_ids = np.arange(len(rows_new), dtype=np.int64)
+  if edge_ids is None:
+    edge_ids = np.arange(len(rows_new), dtype=np.int64)
+  else:
+    edge_ids = np.asarray(edge_ids, np.int64)
   max_nodes = int(counts.max()) if num_parts else 0
   max_edges = max(int(np.bincount(owner, minlength=num_parts).max()), 1)
   indptr_s = np.zeros((num_parts, max_nodes + 1), dtype=np.int64)
@@ -213,7 +250,7 @@ def _build_etype_graph(rows_new: np.ndarray, cols_new: np.ndarray,
   return DistGraph(indptr_s, indices_s, eids_s, bounds_s)
 
 
-class DistHeteroNeighborSampler:
+class DistHeteroNeighborSampler(ExchangeTelemetry):
   """SPMD hetero multihop sampler (+ per-type feature collection).
 
   Args:
@@ -221,12 +258,15 @@ class DistHeteroNeighborSampler:
     num_neighbors: per-hop fanouts — list (all etypes) or per-etype
       dict.
     mesh: mesh whose ``axis`` size == partition count.
+    exchange_slack: per-destination exchange capacity as a multiple of
+      the balanced share (see `dist_sampler.DistNeighborSampler`);
+      None = exact.
   """
 
   def __init__(self, dataset: DistHeteroDataset, num_neighbors,
                mesh: Optional[Mesh] = None, axis: str = 'data',
                with_edge: bool = False, collect_features: bool = True,
-               seed: int = 0):
+               seed: int = 0, exchange_slack: Optional[float] = None):
     from .dp import make_mesh
     self.ds = dataset
     self.etypes, self.fanouts, self.num_hops = normalize_fanouts(
@@ -236,17 +276,20 @@ class DistHeteroNeighborSampler:
     self.axis = axis
     self.with_edge = with_edge
     self.collect_features = collect_features
+    self.exchange_slack = exchange_slack
     self._base_key = jax.random.key(seed)
     self._step_cnt = 0
     self._steps = {}
     self._device_arrays = None
+    self._init_stats()
 
   def _arrays(self):
     if self._device_arrays is None:
       shard = NamedSharding(self.mesh, P(self.axis))
       repl = NamedSharding(self.mesh, P())
       put = jax.device_put
-      arrs = {'graphs': {}, 'bounds': {}, 'feats': {}, 'labels': {}}
+      arrs = {'graphs': {}, 'bounds': {}, 'feats': {}, 'labels': {},
+              'efeats': {}}
       for et in self.etypes:
         g = self.ds.graphs[et]
         arrs['graphs'][et] = (put(g.indptr, shard), put(g.indices, shard),
@@ -256,6 +299,14 @@ class DistHeteroNeighborSampler:
       if self.collect_features:
         for nt, f in self.ds.node_features.items():
           arrs['feats'][nt] = put(f.shards, shard)
+        if self.with_edge:
+          # only fanout-selected etypes sample edges; features of
+          # unselected etypes would never be gathered (and their
+          # eids_acc keys don't exist in the step)
+          for et, f in self.ds.edge_features.items():
+            if et in self.etypes:
+              arrs['efeats'][et] = (put(f.shards, shard),
+                                    put(f.bounds, repl))
       for nt, l in self.ds.node_labels.items():
         arrs['labels'][nt] = put(np.asarray(l), shard)
       self._device_arrays = arrs
@@ -277,14 +328,22 @@ class DistHeteroNeighborSampler:
     arrs = self._arrays()
     feat_nts = tuple(sorted(arrs['feats'])) if self.collect_features else ()
     label_nts = tuple(sorted(arrs['labels']))
+    efeat_ets = tuple(sorted(arrs['efeats']))
+    ef_shard_mode = ('mod' if all(
+        self.ds.edge_features[et].mod_sharded for et in efeat_ets)
+        else 'range')
     num_hops = self.num_hops
+    exchange_slack = self.exchange_slack
 
-    def per_device(graphs_t, bounds_t, feats_t, labels_t, seeds_s, key):
+    def per_device(graphs_t, bounds_t, feats_t, labels_t, efeats_t,
+                   ebounds_t, seeds_s, key):
       graphs = {et: tuple(a[0] for a in g)
                 for et, g in zip(etypes, graphs_t)}
       bounds = dict(zip(ntypes, bounds_t))
       fshards = {nt: f[0] for nt, f in zip(feat_nts, feats_t)}
       lshards = {nt: l[0] for nt, l in zip(label_nts, labels_t)}
+      efshards = {et: f[0] for et, f in zip(efeat_ets, efeats_t)}
+      ebounds = dict(zip(efeat_ets, ebounds_t))
       seeds = seeds_s[0]
 
       neg_ok = None
@@ -301,10 +360,13 @@ class DistHeteroNeighborSampler:
         li, lx, _ = graphs[let]
         my_idx = jax.lax.axis_index(axis)
         neg_key = jax.random.fold_in(jax.random.fold_in(key, my_idx), 977)
+        neg_cap = _slack_cap(link['num_neg'] * NEG_TRIALS, num_parts,
+                             exchange_slack)
         if link['mode'] == 'binary':
           nrows, ncols, neg_ok = dist_sample_negative(
               li, lx, bounds[s_t], num_nodes[s_t], num_nodes[d_t],
-              link['num_neg'], neg_key, axis, num_parts)
+              link['num_neg'], neg_key, axis, num_parts,
+              exchange_capacity=neg_cap)
           src_seeds = jnp.concatenate([src, nrows])
           dst_seeds = jnp.concatenate([dst, ncols])
         elif link['mode'] == 'triplet':
@@ -313,6 +375,7 @@ class DistHeteroNeighborSampler:
           _, negs, neg_ok = dist_sample_negative(
               li, lx, bounds[s_t], num_nodes[s_t], num_nodes[d_t],
               link['num_neg'], neg_key, axis, num_parts,
+              exchange_capacity=neg_cap,
               rows_fixed=srcs_rep.astype(jnp.int32))
           src_seeds = src
           dst_seeds = jnp.concatenate([dst, negs])
@@ -339,6 +402,8 @@ class DistHeteroNeighborSampler:
       cols_acc = {et: [] for et in etypes}
       eids_acc = {et: [] for et in etypes}
       nsn = {nt: [states[nt].count] for nt in ntypes}
+      fr_stats = jnp.zeros((3,), jnp.int32)
+      ft_stats = jnp.zeros((3,), jnp.int32)
 
       for h in range(num_hops):
         hop_start = {nt: states[nt].count for nt in ntypes}
@@ -362,9 +427,12 @@ class DistHeteroNeighborSampler:
           fr_nodes, fr_local = frontiers[s]
           indptr, indices, eids = graphs[et]
           hop_key = jax.random.fold_in(jax.random.fold_in(key, h), ei_i)
-          nbrs, mask, e = _dist_one_hop(
+          nbrs, mask, e, hstats = _dist_one_hop(
               indptr, indices, eids if with_edge else None, bounds[s],
-              fr_nodes, int(k), hop_key, axis, num_parts, with_edge)
+              fr_nodes, int(k), hop_key, axis, num_parts, with_edge,
+              exchange_capacity=_slack_cap(fr_nodes.shape[0], num_parts,
+                                           exchange_slack))
+          fr_stats = fr_stats + jnp.stack(hstats)
           states[d], rows, cols, _ = induce_next(
               states[d], fr_local, nbrs, mask)
           rows_acc[et].append(rows)
@@ -378,13 +446,36 @@ class DistHeteroNeighborSampler:
 
       x = {}
       for nt in feat_nts:
-        (x[nt],) = dist_gather_multi((fshards[nt],), bounds[nt],
-                                     states[nt].nodes, axis, num_parts)
+        (x[nt],), gstats = dist_gather_multi(
+            (fshards[nt],), bounds[nt], states[nt].nodes, axis,
+            num_parts,
+            exchange_capacity=_slack_cap(table_cap[nt], num_parts,
+                                         exchange_slack))
+        ft_stats = ft_stats + jnp.stack(gstats)
       y = {}
       for nt in label_nts:
-        (y[nt],) = dist_gather_multi((lshards[nt],), bounds[nt],
-                                     states[nt].nodes, axis, num_parts)
+        (y[nt],), gstats = dist_gather_multi(
+            (lshards[nt],), bounds[nt], states[nt].nodes, axis,
+            num_parts,
+            exchange_capacity=_slack_cap(table_cap[nt], num_parts,
+                                         exchange_slack))
+        ft_stats = ft_stats + jnp.stack(gstats)
 
+      ef = {}
+      for et in efeat_ets:
+        if not eids_acc.get(et):
+          continue
+        all_eids = jnp.concatenate(eids_acc[et])
+        (ef[et],), gstats = dist_gather_multi(
+            (efshards[et],), ebounds[et], all_eids, axis, num_parts,
+            exchange_capacity=_slack_cap(all_eids.shape[0], num_parts,
+                                         exchange_slack),
+            shard_mode=ef_shard_mode)
+        ft_stats = ft_stats + jnp.stack(gstats)
+
+      neg_lost = (jnp.sum((~neg_ok).astype(jnp.int32))
+                  if neg_ok is not None else jnp.int32(0))
+      stats = jnp.concatenate([fr_stats, ft_stats, neg_lost[None]])
       if neg_ok is None:
         neg_ok = jnp.ones((1,), bool)
 
@@ -410,8 +501,10 @@ class DistHeteroNeighborSampler:
                jnp.stack(nsn[nt])[1:] - jnp.stack(nsn[nt])[:-1]]))
           for nt in ntypes)
       sl_t = tuple(lead(seed_locals[nt]) for nt in seed_types)
+      ef_t = tuple(lead(ef[et]) if et in ef else None
+                   for et in efeat_ets)
       return (node_t, cnt_t, row_t, col_t, eid_t, sl_t,
-              x_t, y_t, nsn_t, lead(neg_ok))
+              x_t, y_t, ef_t, nsn_t, lead(neg_ok), lead(stats))
 
     sh = P(axis)
     rp = P()
@@ -420,6 +513,8 @@ class DistHeteroNeighborSampler:
         tuple(rp for _ in ntypes),                # bounds
         tuple(sh for _ in feat_nts),              # feature shards
         tuple(sh for _ in label_nts),             # label shards
+        tuple(sh for _ in efeat_ets),             # edge-feature shards
+        tuple(rp for _ in efeat_ets),             # edge-feature bounds
         sh,                                       # seeds
         rp,                                       # key
     )
@@ -428,12 +523,13 @@ class DistHeteroNeighborSampler:
         tuple(sh for _ in etypes), tuple(sh for _ in etypes),
         tuple(sh for _ in etypes), tuple(sh for _ in seed_types),
         tuple(sh for _ in feat_nts), tuple(sh for _ in label_nts),
-        tuple(sh for _ in ntypes), sh,
+        tuple(sh for _ in efeat_ets),
+        tuple(sh for _ in ntypes), sh, sh,
     )
     sharded = shard_map(per_device, mesh=self.mesh, in_specs=in_specs,
                         out_specs=out_specs)
     meta = dict(ntypes=ntypes, feat_nts=feat_nts, label_nts=label_nts,
-                seed_types=seed_types)
+                seed_types=seed_types, efeat_ets=efeat_ets)
     return jax.jit(sharded), meta
 
   def sample_from_nodes(self, input_type: NodeType,
@@ -456,9 +552,12 @@ class DistHeteroNeighborSampler:
     bounds_t = tuple(arrs['bounds'][nt] for nt in meta['ntypes'])
     feats_t = tuple(arrs['feats'][nt] for nt in meta['feat_nts'])
     labels_t = tuple(arrs['labels'][nt] for nt in meta['label_nts'])
-    (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t,
-     nsn_t, _) = step(graphs_t, bounds_t, feats_t, labels_t, seeds_dev,
-                      key)
+    efeats_t = tuple(arrs['efeats'][et][0] for et in meta['efeat_ets'])
+    ebounds_t = tuple(arrs['efeats'][et][1] for et in meta['efeat_ets'])
+    (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t, ef_t,
+     nsn_t, _, stats) = step(graphs_t, bounds_t, feats_t, labels_t,
+                             efeats_t, ebounds_t, seeds_dev, key)
+    self._accumulate_stats(stats)
     seed_local = sl_t[meta['seed_types'].index(input_type)]
     ntypes = meta['ntypes']
     out = dict(
@@ -473,6 +572,8 @@ class DistHeteroNeighborSampler:
         seed_local=seed_local,
         x=dict(zip(meta['feat_nts'], x_t)),
         y=dict(zip(meta['label_nts'], y_t)),
+        ef={reverse_edge_type(et): e
+            for et, e in zip(meta['efeat_ets'], ef_t) if e is not None},
         num_sampled_nodes=dict(zip(ntypes, nsn_t)),
         batch=seeds_dev, input_type=input_type)
     return out
@@ -528,9 +629,12 @@ class DistHeteroNeighborSampler:
     bounds_t = tuple(arrs['bounds'][nt] for nt in meta['ntypes'])
     feats_t = tuple(arrs['feats'][nt] for nt in meta['feat_nts'])
     labels_t = tuple(arrs['labels'][nt] for nt in meta['label_nts'])
-    (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t, nsn_t,
-     neg_ok) = step(graphs_t, bounds_t, feats_t, labels_t, pairs_dev,
-                    key)
+    efeats_t = tuple(arrs['efeats'][e][0] for e in meta['efeat_ets'])
+    ebounds_t = tuple(arrs['efeats'][e][1] for e in meta['efeat_ets'])
+    (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t, ef_t, nsn_t,
+     neg_ok, stats) = step(graphs_t, bounds_t, feats_t, labels_t,
+                           efeats_t, ebounds_t, pairs_dev, key)
+    self._accumulate_stats(stats)
     ntypes = meta['ntypes']
     seed_types = meta['seed_types']
     sl = dict(zip(seed_types, sl_t))
@@ -584,6 +688,8 @@ class DistHeteroNeighborSampler:
               for e, v in zip(self.etypes, eid_t) if v is not None},
         x=dict(zip(meta['feat_nts'], x_t)),
         y=dict(zip(meta['label_nts'], y_t)),
+        ef={reverse_edge_type(e): v
+            for e, v in zip(meta['efeat_ets'], ef_t) if v is not None},
         num_sampled_nodes=dict(zip(ntypes, nsn_t)),
         batch=pairs_dev[:, :, 0], metadata=md, input_type=et)
 
@@ -600,13 +706,15 @@ class DistHeteroNeighborLoader:
                input_nodes, batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
-               seed: int = 0, input_space: str = 'old'):
+               seed: int = 0, input_space: str = 'old',
+               exchange_slack='auto'):
     from ..loader.node_loader import SeedBatcher
     input_type, seeds = input_nodes
     self.input_type = input_type
     self.sampler = DistHeteroNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
-        collect_features=collect_features, seed=seed)
+        collect_features=collect_features, seed=seed,
+        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
     self.ds = dataset
     seeds = np.asarray(seeds).reshape(-1)
     if input_space == 'old' and input_type in dataset.old2new:
@@ -631,15 +739,20 @@ class DistHeteroNeighborLoader:
     ei = {et: jnp.stack([out['row'][et], out['col'][et]], axis=1)
           for et in out['row']}
     em = {et: out['row'][et] >= 0 for et in out['row']}
+    md = {'seed_local': out['seed_local'],
+          'input_type': self.input_type}
+    if out['edge']:
+      # global eids per reversed etype — same key the host runtime
+      # collates (`distributed/dist_loader.py::_collate_hetero`)
+      md['edge_dict'] = out['edge']
     return HeteroBatch(
         x_dict=out['x'], y_dict=out['y'], edge_index_dict=ei,
-        edge_attr_dict={}, node_dict=out['node'],
+        edge_attr_dict=dict(out.get('ef') or {}), node_dict=out['node'],
         node_mask_dict={nt: v >= 0 for nt, v in out['node'].items()},
         edge_mask_dict=em,
         batch_dict={self.input_type: out['batch']},
         batch_size=self.batch_size,
-        metadata={'seed_local': out['seed_local'],
-                  'input_type': self.input_type})
+        metadata=md)
 
 
 class DistHeteroLinkNeighborLoader:
@@ -661,7 +774,8 @@ class DistHeteroLinkNeighborLoader:
                batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
-               seed: int = 0, input_space: str = 'old'):
+               seed: int = 0, input_space: str = 'old',
+               exchange_slack='auto'):
     from ..loader.node_loader import SeedBatcher
     from ..sampler.base import NegativeSampling
     from .dist_sampler import pack_link_seeds
@@ -674,7 +788,8 @@ class DistHeteroLinkNeighborLoader:
     self.neg_sampling = ns
     self.sampler = DistHeteroNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
-        collect_features=collect_features, seed=seed)
+        collect_features=collect_features, seed=seed,
+        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
     rows, cols, colsarr = pack_link_seeds(
         pairs, edge_label, ns.mode if ns is not None else None)
     s_t, _, d_t = self.input_type
@@ -707,9 +822,11 @@ class DistHeteroLinkNeighborLoader:
     em = {et: out['row'][et] >= 0 for et in out['row']}
     md = dict(out['metadata'])
     md['input_type'] = self.input_type
+    if out['edge']:
+      md['edge_dict'] = out['edge']
     return HeteroBatch(
         x_dict=out['x'], y_dict=out['y'], edge_index_dict=ei,
-        edge_attr_dict={}, node_dict=out['node'],
+        edge_attr_dict=dict(out.get('ef') or {}), node_dict=out['node'],
         node_mask_dict={nt: v >= 0 for nt, v in out['node'].items()},
         edge_mask_dict=em,
         batch_dict={self.input_type[0]: out['batch']},

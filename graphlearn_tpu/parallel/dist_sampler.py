@@ -40,6 +40,32 @@ from ..ops.unique import init_node, induce_next
 from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
 from .dist_data import DistDataset
 
+#: default per-destination exchange capacity, as a multiple of the
+#: balanced share (frontier / P).  2.0 tolerates 2x ownership skew
+#: while shrinking every all_to_all buffer by P/2 — the right trade
+#: for SHUFFLED seeds (near-balanced buckets); unshuffled loaders keep
+#: exact (uncapped) exchanges since contiguous seed ranges can land
+#: entirely on one owner.  See `bucket_by_owner` for drop semantics.
+DEFAULT_EXCHANGE_SLACK = 2.0
+
+#: layout of the per-step exchange-telemetry vector (stacked [P, 7]).
+#: offered = valid ids entering an exchange; dropped = valid ids past
+#: an owner's capacity (their neighbors/features are lost that hop);
+#: slots = total send-buffer width (padding waste = 1 - offered/slots);
+#: negative.lost = strict-negative slots whose every trial collided.
+EXCHANGE_STAT_NAMES = (
+    'frontier.offered', 'frontier.dropped', 'frontier.slots',
+    'feature.offered', 'feature.dropped', 'feature.slots',
+    'negative.lost')
+
+
+def _exchange_stats(ids, slot_j, num_parts: int, cap: int):
+  """(offered, dropped, slots) triple for one bucketed exchange."""
+  valid = ids >= 0
+  offered = jnp.sum(valid.astype(jnp.int32))
+  dropped = jnp.sum((valid & (slot_j < 0)).astype(jnp.int32))
+  return offered, dropped, jnp.int32(num_parts * cap)
+
 
 def bucket_by_owner(ids: jax.Array, owner: jax.Array, num_parts: int,
                     self_idx: jax.Array, capacity: Optional[int] = None):
@@ -188,6 +214,8 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   ``exchange_capacity`` caps the per-destination exchange width
   (default: the full frontier — ~P x padding with balanced buckets);
   overflowed frontier entries sample nothing this hop (masked).
+  Returns ``(nbrs, mask, eids, stats)`` — ``stats`` is the
+  (offered, dropped, slots) telemetry triple.
   """
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
@@ -196,6 +224,7 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   send, slot_p, slot_j = bucket_by_owner(frontier, owner, num_parts,
                                          my_idx, exchange_capacity)
   c = send.shape[1]
+  stats = _exchange_stats(frontier, slot_j, num_parts, c)
   recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)     # [P, C]
   flat = recv.reshape(-1)
   local = jnp.where(flat >= 0, flat - my_start, INVALID_ID).astype(jnp.int32)
@@ -216,30 +245,46 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
     eids = jax.lax.all_to_all(res.eids.reshape(num_parts, c, k),
                               axis, 0, 0, tiled=True)
     out_eids = jnp.where(kept[:, None], eids[slot_p, sj], INVALID_ID)
-  return out_nbrs, out_mask, out_eids
+  return out_nbrs, out_mask, out_eids, stats
 
 
 def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
-                      exchange_capacity: Optional[int] = None):
-  """Distributed row gather from several range-sharded tables that
-  share ``bounds``: ``out_t[i] = table_t[ids[i]]`` (the collective-era
+                      exchange_capacity: Optional[int] = None,
+                      shard_mode: str = 'range'):
+  """Distributed row gather from several sharded tables that share an
+  ownership scheme: ``out_t[i] = table_t[ids[i]]`` (the collective-era
   `DistFeature.async_get`, `distributed/dist_feature.py:134-269`).
+
+  ``shard_mode='range'``: owner by ``searchsorted(bounds, id)`` (node
+  tables); ``'mod'``: owner = ``id % P``, local row = ``id // P``
+  (edge-feature tables, `build_dist_edge_feature` — strided so
+  consecutive-id runs spread across owners under a capacity cap).
 
   The id bucketing and request all_to_all run ONCE for all tables —
   feature + label collection share a single exchange.  Invalid ids
   (-1) return zero rows; ids past ``exchange_capacity`` per owner
   return zero rows too (callers choosing a capacity accept that tail).
+  Returns ``(outs, stats)`` with the (offered, dropped, slots)
+  telemetry triple.
   """
   my_idx = jax.lax.axis_index(axis)
-  my_start = bounds[my_idx]
-  owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(jnp.int32)
+  if shard_mode == 'mod':
+    owner = (ids % num_parts).astype(jnp.int32)
+  else:
+    my_start = bounds[my_idx]
+    owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(
+        jnp.int32)
   send, slot_p, slot_j = bucket_by_owner(ids, owner, num_parts, my_idx,
                                          exchange_capacity)
   cw = send.shape[1]
+  stats = _exchange_stats(ids, slot_j, num_parts, cw)
   recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)
   flat = recv.reshape(-1)
   valid = flat >= 0
-  local = jnp.where(valid, flat - my_start, 0)
+  if shard_mode == 'mod':
+    local = jnp.where(valid, flat // num_parts, 0)
+  else:
+    local = jnp.where(valid, flat - my_start, 0)
   kept = slot_j >= 0
   sj = jnp.where(kept, slot_j, 0)
   ok = (ids >= 0) & kept
@@ -259,12 +304,14 @@ def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
       outs.append(jnp.where(ok, out, 0))
     else:
       outs.append(jnp.where(ok[:, None], out, 0))
-  return tuple(outs)
+  return tuple(outs), stats
 
 
 def dist_gather(shard_loc, bounds, ids, axis: str, num_parts: int):
   """Single-table convenience wrapper over :func:`dist_gather_multi`."""
-  return dist_gather_multi((shard_loc,), bounds, ids, axis, num_parts)[0]
+  (out,), _ = dist_gather_multi((shard_loc,), bounds, ids, axis,
+                                num_parts)
+  return out
 
 
 def cache_overlay(gathered, ids, cache_ids_loc, cache_rows_loc):
@@ -291,20 +338,45 @@ def cache_overlay(gathered, ids, cache_ids_loc, cache_rows_loc):
   return jnp.where(hit[:, None], cache_val, gathered)
 
 
+def resolve_exchange_slack(exchange_slack, shuffle: bool):
+  """Resolve the loaders' ``'auto'`` default: capped at
+  `DEFAULT_EXCHANGE_SLACK` for shuffled seeds (near-balanced owner
+  buckets), exact for sequential seeds (contiguous ranges can land
+  entirely on one owner and a cap would drop most of them)."""
+  if isinstance(exchange_slack, str):
+    if exchange_slack != 'auto':
+      raise ValueError(f'unknown exchange_slack {exchange_slack!r}')
+    return DEFAULT_EXCHANGE_SLACK if shuffle else None
+  return exchange_slack
+
+
+#: per-destination capacity floor: exchanges this small gain nothing
+#: from capping (the buffer is a few KB) but would drop ids on ANY
+#: ownership skew, so they stay exact.
+MIN_EXCHANGE_CAP = 64
+
+
 def _slack_cap(n: int, num_parts: int,
                exchange_slack: Optional[float]) -> Optional[int]:
   if exchange_slack is None:
     return None
-  return int(round_up(min(n, int(np.ceil(n / num_parts
-                                         * exchange_slack))), 8))
+  cap = max(int(np.ceil(n / num_parts * exchange_slack)),
+            MIN_EXCHANGE_CAP)
+  return int(round_up(min(n, cap), 8))
 
 
 def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
                         fanouts, node_cap, with_edge, collect_features,
                         collect_labels, with_cache, fshard, lshard,
-                        cids, crows, axis, num_parts, exchange_slack):
+                        cids, crows, axis, num_parts, exchange_slack,
+                        collect_edge_features=False, efshard=None,
+                        ebounds=None, ef_shard_mode='mod'):
   """Per-device multihop expansion + feature/label collection — the
-  shared body of the node and link SPMD steps."""
+  shared body of the node and link SPMD steps.  When
+  ``collect_edge_features`` is set, every sampled edge's feature row is
+  gathered by GLOBAL edge id through the same exchange machinery (the
+  collective analog of the reference's efeats collation,
+  `distributed/dist_neighbor_sampler.py:600-673`)."""
   b = seeds.shape[0]
   state, seed_local = init_node(seeds, node_cap)
   f_cap = b
@@ -316,13 +388,16 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
 
   rows_acc, cols_acc, eids_acc = [], [], []
   hop_counts = [state.count]
+  fr_stats = jnp.zeros((3,), jnp.int32)
+  ft_stats = jnp.zeros((3,), jnp.int32)
   for h, k in enumerate(fanouts):
     hop_key = jax.random.fold_in(key, h)
-    nbrs, mask, e = _dist_one_hop(
+    nbrs, mask, e, hstats = _dist_one_hop(
         indptr, indices, eids, bounds, frontier, int(k), hop_key,
         axis, num_parts, with_edge,
         exchange_capacity=_slack_cap(frontier.shape[0], num_parts,
                                      exchange_slack))
+    fr_stats = fr_stats + jnp.stack(hstats)
     state, rows, cols, prev_cnt = induce_next(
         state, frontier_local, nbrs, mask)
     rows_acc.append(rows)
@@ -341,14 +416,23 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
   row = jnp.concatenate(rows_acc)
   col = jnp.concatenate(cols_acc)
   edge = jnp.concatenate(eids_acc) if with_edge else None
-  x = y = None
+  x = y = ef = None
+  if collect_edge_features and edge is not None:
+    (ef,), estats = dist_gather_multi(
+        (efshard,), ebounds, edge, axis, num_parts,
+        exchange_capacity=_slack_cap(edge.shape[0], num_parts,
+                                     exchange_slack),
+        shard_mode=ef_shard_mode)
+    ft_stats = ft_stats + jnp.stack(estats)
   tables = (((fshard,) if collect_features else ())
             + ((lshard,) if collect_labels else ()))
   if tables:
-    got = list(dist_gather_multi(
+    got, gstats = dist_gather_multi(
         tables, bounds, state.nodes, axis, num_parts,
         exchange_capacity=_slack_cap(node_cap, num_parts,
-                                     exchange_slack)))
+                                     exchange_slack))
+    got = list(got)
+    ft_stats = ft_stats + jnp.stack(gstats)
     if collect_features:
       x = got.pop(0)
       if with_cache:
@@ -360,14 +444,17 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
       y = got.pop(0)
   cum = jnp.stack(hop_counts)
   nsn = jnp.concatenate([cum[:1], cum[1:] - cum[:-1]]).astype(jnp.int32)
-  return state, row, col, edge, seed_local, x, y, nsn
+  stats = jnp.concatenate([fr_stats, ft_stats, jnp.zeros((1,), jnp.int32)])
+  return state, row, col, edge, seed_local, x, y, ef, nsn, stats
 
 
 def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     node_cap: int, with_edge: bool, collect_features: bool,
                     collect_labels: bool, axis: str = 'data',
                     with_cache: bool = False,
-                    exchange_slack: Optional[float] = None):
+                    exchange_slack: Optional[float] = None,
+                    collect_edge_features: bool = False,
+                    ef_shard_mode: str = 'mod'):
   """Build the jitted SPMD sample(+collect) step.
 
   ``exchange_slack``: per-destination exchange capacity as a multiple
@@ -377,8 +464,9 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
   from .shard_map_compat import shard_map
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-                 lshard_s, cids_s, crows_s, key):
-    state, row, col, edge, seed_local, x, y, nsn = _expand_and_collect(
+                 lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
+    (state, row, col, edge, seed_local, x, y, ef, nsn,
+     stats) = _expand_and_collect(
         indptr_s[0], indices_s[0], eids_s[0] if with_edge else None,
         bounds, seeds_s[0], key,
         fanouts=fanouts, node_cap=node_cap, with_edge=with_edge,
@@ -388,25 +476,29 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
         lshard=lshard_s[0] if collect_labels else None,
         cids=cids_s[0] if with_cache else None,
         crows=crows_s[0] if with_cache else None,
-        axis=axis, num_parts=num_parts, exchange_slack=exchange_slack)
+        axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
+        collect_edge_features=collect_edge_features,
+        efshard=efshard_s[0] if collect_edge_features else None,
+        ebounds=ebounds, ef_shard_mode=ef_shard_mode)
 
     def lead(v):   # re-add the shard axis for stacked outputs
       return None if v is None else v[None]
     return (lead(state.nodes), lead(state.count[None]), lead(row),
             lead(col), lead(edge), lead(seed_local), lead(x), lead(y),
-            lead(nsn))
+            lead(ef), lead(nsn), lead(stats))
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P(axis), P(axis), P())
-  specs_out = tuple(P(axis) for _ in range(9))
+              P(axis), P(axis), P(axis), P(), P())
+  specs_out = tuple(P(axis) for _ in range(11))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-           lshard_s, cids_s, crows_s, key):
+           lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
     return sharded(indptr_s, indices_s, eids_s, bounds, seeds_s,
-                   fshard_s, lshard_s, cids_s, crows_s, key)
+                   fshard_s, lshard_s, cids_s, crows_s, efshard_s,
+                   ebounds, key)
 
   return step
 
@@ -419,7 +511,9 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                          with_edge: bool, collect_features: bool,
                          collect_labels: bool, axis: str = 'data',
                          with_cache: bool = False,
-                         exchange_slack: Optional[float] = None):
+                         exchange_slack: Optional[float] = None,
+                         collect_edge_features: bool = False,
+                         ef_shard_mode: str = 'mod'):
   """Build the jitted SPMD LINK sample step: per-device seed edges +
   collective strict negatives + the shared expansion body.
 
@@ -432,7 +526,7 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
   from .shard_map_compat import shard_map
 
   def per_device(indptr_s, indices_s, eids_s, bounds, pairs_s, fshard_s,
-                 lshard_s, cids_s, crows_s, key):
+                 lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
     indptr = indptr_s[0]
     indices = indices_s[0]
     pairs = pairs_s[0]                       # [B, 2|3]
@@ -459,7 +553,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
       seeds = jnp.concatenate([src, dst])
     seeds = jnp.where(seeds >= 0, seeds, INVALID_ID).astype(jnp.int32)
 
-    state, row, col, edge, seed_local, x, y, nsn = _expand_and_collect(
+    (state, row, col, edge, seed_local, x, y, ef, nsn,
+     stats) = _expand_and_collect(
         indptr, indices, eids_s[0] if with_edge else None, bounds,
         seeds, key,
         fanouts=fanouts, node_cap=node_cap, with_edge=with_edge,
@@ -469,7 +564,10 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
         lshard=lshard_s[0] if collect_labels else None,
         cids=cids_s[0] if with_cache else None,
         crows=crows_s[0] if with_cache else None,
-        axis=axis, num_parts=num_parts, exchange_slack=exchange_slack)
+        axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
+        collect_edge_features=collect_edge_features,
+        efshard=efshard_s[0] if collect_edge_features else None,
+        ebounds=ebounds, ef_shard_mode=ef_shard_mode)
 
     b = batch
     sl = seed_local
@@ -502,28 +600,162 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
       md = (eli, pos_label, pair_valid, jnp.zeros((b,), jnp.int32),
             jnp.zeros((b,), jnp.int32), jnp.zeros((b, 1), jnp.int32))
 
+    if neg_ok is not None:
+      stats = stats.at[6].add(
+          jnp.sum((~neg_ok).astype(jnp.int32)))
+
     def lead(v):
       return None if v is None else v[None]
     return ((lead(state.nodes), lead(state.count[None]), lead(row),
              lead(col), lead(edge), lead(seed_local), lead(x), lead(y),
-             lead(nsn)) + tuple(lead(m) for m in md))
+             lead(ef), lead(nsn), lead(stats))
+            + tuple(lead(m) for m in md))
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P(axis), P(axis), P())
-  specs_out = tuple(P(axis) for _ in range(15))
+              P(axis), P(axis), P(axis), P(), P())
+  specs_out = tuple(P(axis) for _ in range(17))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, pairs_s, fshard_s,
-           lshard_s, cids_s, crows_s, key):
+           lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
     return sharded(indptr_s, indices_s, eids_s, bounds, pairs_s,
+                   fshard_s, lshard_s, cids_s, crows_s, efshard_s,
+                   ebounds, key)
+
+  return step
+
+
+def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
+                             fanouts: Tuple[int, ...], node_cap: int,
+                             max_degree: int, with_edge: bool,
+                             collect_features: bool, collect_labels: bool,
+                             axis: str = 'data',
+                             with_cache: bool = False,
+                             exchange_slack: Optional[float] = None):
+  """Build the jitted SPMD INDUCED-SUBGRAPH step — the device-mesh
+  analog of reference ``DistNeighborSampler._subgraph``
+  (`distributed/dist_neighbor_sampler.py:456-516`).
+
+  Per device: multihop closure over the sharded CSR (the shared
+  expansion body), then ONE full-window distributed hop with
+  ``k = max_degree`` — each owner returns every out-neighbor of the
+  closure nodes it owns (no sampling: the Gumbel top-k window is exact
+  when ``deg <= k``) — and a LOCAL sort-based membership test +
+  relabel against this device's closure set.  The membership test runs
+  at the requester, which owns its closure, so no closure-set
+  all_gather is needed; edge (u, v) is emitted exactly once, by u's
+  window, in natural (source, dest) direction like the single-chip
+  `ops.subgraph.induced_subgraph`.
+  """
+  from .shard_map_compat import shard_map
+
+  def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
+                 lshard_s, cids_s, crows_s, key):
+    (state, _row, _col, _edge, seed_local, x, y, _ef, nsn,
+     stats) = _expand_and_collect(
+        indptr_s[0], indices_s[0], None, bounds, seeds_s[0], key,
+        fanouts=fanouts, node_cap=node_cap, with_edge=False,
+        collect_features=collect_features, collect_labels=collect_labels,
+        with_cache=with_cache,
+        fshard=fshard_s[0] if collect_features else None,
+        lshard=lshard_s[0] if collect_labels else None,
+        cids=cids_s[0] if with_cache else None,
+        crows=crows_s[0] if with_cache else None,
+        axis=axis, num_parts=num_parts, exchange_slack=exchange_slack)
+
+    nodes = state.nodes                              # [node_cap]
+    nbrs, mask, eids, hstats = _dist_one_hop(
+        indptr_s[0], indices_s[0], eids_s[0] if with_edge else None,
+        bounds, nodes, max_degree, key, axis, num_parts, with_edge,
+        exchange_capacity=_slack_cap(node_cap, num_parts,
+                                     exchange_slack))
+    stats = stats.at[:3].add(jnp.stack(hstats))
+    big = jnp.iinfo(jnp.int32).max
+    keyed = jnp.where(nodes >= 0, nodes, big)
+    order = jnp.argsort(keyed)
+    sorted_nodes = keyed[order]
+    flat = nbrs.reshape(-1)
+    loc = jnp.clip(jnp.searchsorted(sorted_nodes, flat), 0,
+                   node_cap - 1).astype(jnp.int32)
+    hit = (sorted_nodes[loc] == flat) & (flat >= 0) & mask.reshape(-1)
+    col = jnp.where(hit, order[loc], INVALID_ID).astype(jnp.int32)
+    row = jnp.where(
+        hit,
+        jnp.repeat(jnp.arange(node_cap, dtype=jnp.int32), max_degree),
+        INVALID_ID)
+    edge = (jnp.where(hit, eids.reshape(-1), INVALID_ID)
+            if with_edge else None)
+
+    def lead(v):
+      return None if v is None else v[None]
+    return (lead(nodes), lead(state.count[None]), lead(row), lead(col),
+            lead(edge), lead(seed_local), lead(x), lead(y), lead(nsn),
+            lead(stats))
+
+  specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
+              P(axis), P(axis), P())
+  specs_out = tuple(P(axis) for _ in range(10))
+  sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
+                      out_specs=specs_out)
+
+  @jax.jit
+  def step(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
+           lshard_s, cids_s, crows_s, key):
+    return sharded(indptr_s, indices_s, eids_s, bounds, seeds_s,
                    fshard_s, lshard_s, cids_s, crows_s, key)
 
   return step
 
 
-class DistNeighborSampler:
+class ExchangeTelemetry:
+  """Device-resident exchange-overflow telemetry shared by the mesh
+  samplers: adding each step's stacked ``[P, 7]`` stats stays async
+  (no per-batch host sync); `exchange_stats` materializes totals at
+  epoch/bench boundaries and ticks the global metrics registry."""
+
+  #: auto-drain interval: the device counter is int32 (x64 disabled)
+  #: and the biggest per-step count (exchange SLOTS at the reference
+  #: workload) is ~2e7, so 64 steps stay safely under 2^31.  Draining
+  #: costs one [7]-scalar transfer at the tail of an already-dispatched
+  #: chain — negligible against a training step.
+  STATS_DRAIN_INTERVAL = 64
+
+  def _init_stats(self) -> None:
+    self._stats_acc = jnp.zeros((len(EXCHANGE_STAT_NAMES),), jnp.int32)
+    self._stats_total = np.zeros(len(EXCHANGE_STAT_NAMES), np.int64)
+    self._stats_pending = 0
+
+  def _accumulate_stats(self, stats_stacked) -> None:
+    self._stats_acc = self._stats_acc + jnp.sum(stats_stacked, axis=0)
+    self._stats_pending += 1
+    if self._stats_pending >= self.STATS_DRAIN_INTERVAL:
+      self.exchange_stats()
+
+  def exchange_stats(self, tick_metrics: bool = True):
+    """Materialize cumulative exchange telemetry (one device sync).
+
+    Returns ``{'dist.frontier.offered': n, ...}`` totals since
+    construction; the delta since the previous call is also ticked
+    into the global `utils.profiling.metrics` registry so overflow
+    drops are never invisible.
+    """
+    delta = np.asarray(jax.device_get(self._stats_acc), np.int64)
+    self._stats_acc = jnp.zeros_like(self._stats_acc)
+    self._stats_pending = 0
+    self._stats_total += delta
+    out = {f'dist.{n}': int(v)
+           for n, v in zip(EXCHANGE_STAT_NAMES, self._stats_total)}
+    if tick_metrics:
+      from ..utils.profiling import metrics
+      for n, d in zip(EXCHANGE_STAT_NAMES, delta):
+        if d:
+          metrics.inc(f'dist.{n}', float(d))
+    return out
+
+
+class DistNeighborSampler(ExchangeTelemetry):
   """Device-mesh distributed sampler (+ feature/label collection).
 
   The public analog of reference ``DistNeighborSampler``
@@ -550,17 +782,27 @@ class DistNeighborSampler:
     self.collect_features = (collect_features
                              and dataset.node_features is not None)
     self.collect_labels = dataset.node_labels is not None
+    # edge features need the sampled eids to gather by — implied
+    # with_edge, like the reference's `with_edge=True` efeats contract
+    self.collect_edge_features = (collect_features and with_edge
+                                  and dataset.edge_features is not None)
+    self._ef_shard_mode = (
+        'mod' if (self.collect_edge_features
+                  and dataset.edge_features.mod_sharded) else 'range')
     self.with_cache = (self.collect_features
                        and dataset.node_features.has_cache)
     # SURVEY §7 "partition-aware capacity tuning": e.g. 2.0 sends
     # 2x the balanced share per destination instead of the full
     # frontier (P/2 x fewer exchanged bytes); overflowed ids lose
-    # their neighbors/features that hop — opt-in, None = exact.
+    # their neighbors/features that hop (counted by the telemetry).
+    # None = exact; the loaders resolve 'auto' to
+    # DEFAULT_EXCHANGE_SLACK when shuffling, exact otherwise.
     self.exchange_slack = exchange_slack
     self._base_key = jax.random.key(seed)
     self._step_cnt = 0
     self._steps = {}
     self._device_arrays = None
+    self._init_stats()
 
   def _arrays(self):
     if self._device_arrays is None:
@@ -579,11 +821,18 @@ class DistNeighborSampler:
         from .dist_data import CACHE_PAD_ID
         cids = np.full((self.num_parts, 1), CACHE_PAD_ID, np.int32)
         crows = np.zeros((self.num_parts, 1, 1), np.float32)
+      if self.collect_edge_features:
+        efshards = self.ds.edge_features.shards
+        ebounds = self.ds.edge_features.bounds
+      else:
+        efshards = np.zeros((self.num_parts, 1, 1), np.float32)
+        ebounds = np.zeros(self.num_parts + 1, np.int64)
       self._device_arrays = dict(
           indptr=put(g.indptr, shard), indices=put(g.indices, shard),
           eids=put(g.edge_ids, shard), bounds=put(g.bounds, repl),
           fshards=put(fshards, shard), lshards=put(lshards, shard),
-          cids=put(cids, shard), crows=put(crows, shard))
+          cids=put(cids, shard), crows=put(crows, shard),
+          efshards=put(efshards, shard), ebounds=put(ebounds, repl))
     return self._device_arrays
 
   def node_capacity(self, batch_size: int) -> int:
@@ -602,6 +851,57 @@ class DistNeighborSampler:
           self.mesh, self.num_parts, self.fanouts, node_cap,
           self.with_edge, self.collect_features, self.collect_labels,
           self.axis, with_cache=self.with_cache,
+          exchange_slack=self.exchange_slack,
+          collect_edge_features=self.collect_edge_features,
+          ef_shard_mode=self._ef_shard_mode)
+    arrs = self._arrays()
+    self._step_cnt += 1
+    key = jax.random.fold_in(self._base_key, self._step_cnt)
+    seeds_dev = jax.device_put(
+        np.asarray(seeds_stacked, dtype=np.int32),
+        NamedSharding(self.mesh, P(self.axis)))
+    (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats) = \
+        self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
+                         arrs['bounds'], seeds_dev, arrs['fshards'],
+                         arrs['lshards'], arrs['cids'], arrs['crows'],
+                         arrs['efshards'], arrs['ebounds'], key)
+    self._accumulate_stats(stats)
+    return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
+                edge=edge, seed_local=seed_local, x=x, y=y, ef=ef,
+                num_sampled_nodes=nsn, batch=seeds_dev)
+
+
+class DistSubGraphSampler(DistNeighborSampler):
+  """Device-mesh induced-subgraph sampler: multihop closure + one
+  full-window distributed hop + local membership/relabel (SEAL at pod
+  scale; reference `distributed/dist_neighbor_sampler.py:456-516`).
+
+  Args:
+    max_degree: static per-node neighbor window for the induced scan;
+      None = the sharded graph's true max degree (exact results).
+  """
+
+  def __init__(self, dataset: DistDataset, num_neighbors,
+               max_degree: Optional[int] = None, **kwargs):
+    super().__init__(dataset, num_neighbors, **kwargs)
+    if max_degree is None:
+      g = dataset.graph
+      max_degree = int(np.diff(g.indptr, axis=1).max())
+    self.max_degree = max(int(max_degree), 1)
+
+  def sample_subgraph(self, seeds_stacked: np.ndarray):
+    """``seeds_stacked``: ``[P, B]`` per-device seed batches (relabeled
+    space, -1 padded).  Returns the induced-subgraph pieces; edges in
+    natural (source, dest) direction; ``seed_local`` doubles as the
+    reference's ``mapping`` metadata."""
+    b = seeds_stacked.shape[1]
+    node_cap = self.node_capacity(b)
+    cfg = ('subgraph', b)
+    if cfg not in self._steps:
+      self._steps[cfg] = _make_dist_subgraph_step(
+          self.mesh, self.num_parts, self.fanouts, node_cap,
+          self.max_degree, self.with_edge, self.collect_features,
+          self.collect_labels, self.axis, with_cache=self.with_cache,
           exchange_slack=self.exchange_slack)
     arrs = self._arrays()
     self._step_cnt += 1
@@ -609,14 +909,75 @@ class DistNeighborSampler:
     seeds_dev = jax.device_put(
         np.asarray(seeds_stacked, dtype=np.int32),
         NamedSharding(self.mesh, P(self.axis)))
-    (nodes, count, row, col, edge, seed_local, x, y, nsn) = \
+    (nodes, count, row, col, edge, seed_local, x, y, nsn, stats) = \
         self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
                          arrs['bounds'], seeds_dev, arrs['fshards'],
                          arrs['lshards'], arrs['cids'], arrs['crows'],
                          key)
+    self._accumulate_stats(stats)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, seed_local=seed_local, x=x, y=y,
                 num_sampled_nodes=nsn, batch=seeds_dev)
+
+
+class DistSubGraphLoader:
+  """Distributed induced-subgraph loader over the device mesh — the
+  mesh-engine arm of reference ``DistSubGraphLoader``
+  (`distributed/dist_subgraph_loader.py:28-89`); the host-runtime arm
+  lives in `graphlearn_tpu.distributed`.  Yields stacked `Batch`
+  pytrees with ``metadata['mapping']`` locating each seed in the node
+  table (the SEAL contract, `loader/subgraph_loader.py:88-97`).
+  """
+
+  def __init__(self, dataset: DistDataset, num_neighbors, input_nodes,
+               batch_size: int = 1, shuffle: bool = False,
+               drop_last: bool = False, mesh: Optional[Mesh] = None,
+               with_edge: bool = False, collect_features: bool = True,
+               max_degree: Optional[int] = None, seed: int = 0,
+               input_space: str = 'old', exchange_slack='auto'):
+    from ..loader.node_loader import SeedBatcher
+    # 'auto' resolves to EXACT here, shuffled or not: a dropped
+    # closure node under a capacity cap loses its whole neighbor
+    # window, making the "induced subgraph" silently wrong (for
+    # neighbor sampling a drop is a statistical under-sample; for
+    # SEAL/DRNL it corrupts labels).  An explicit float still opts in.
+    if exchange_slack == 'auto':
+      exchange_slack = None
+    self.sampler = DistSubGraphSampler(
+        dataset, num_neighbors, max_degree=max_degree, mesh=mesh,
+        with_edge=with_edge, collect_features=collect_features,
+        seed=seed,
+        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
+    self.ds = dataset
+    seeds = np.asarray(input_nodes).reshape(-1)
+    if input_space == 'old' and dataset.old2new is not None:
+      seeds = dataset.old2new[seeds]
+    self.num_parts = dataset.num_partitions
+    self.batch_size = int(batch_size)
+    self._batcher = SeedBatcher(seeds, batch_size * self.num_parts,
+                                shuffle, drop_last, seed)
+
+  def __len__(self):
+    return len(self._batcher)
+
+  def __iter__(self):
+    self._it = iter(self._batcher)
+    return self
+
+  def __next__(self):
+    from ..loader.transform import Batch
+    flat = next(self._it)
+    seeds = flat.reshape(self.num_parts, self.batch_size)
+    out = self.sampler.sample_subgraph(seeds)
+    edge_index = jnp.stack([out['row'], out['col']], axis=1)
+    return Batch(
+        x=out['x'], y=out['y'], edge_index=edge_index,
+        node=out['node'], node_mask=out['node'] >= 0,
+        edge_mask=out['row'] >= 0, edge=out['edge'],
+        batch=out['batch'], batch_size=self.batch_size,
+        num_sampled_nodes=out['num_sampled_nodes'],
+        metadata={'seed_local': out['seed_local'],
+                  'mapping': out['seed_local']})
 
 
 class DistNeighborLoader:
@@ -632,12 +993,12 @@ class DistNeighborLoader:
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack: Optional[float] = None):
+               exchange_slack='auto'):
     from ..loader.node_loader import SeedBatcher
     self.sampler = DistNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
-        exchange_slack=exchange_slack)
+        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
     self.ds = dataset
     seeds = np.asarray(input_nodes).reshape(-1)
     if input_space == 'old' and dataset.old2new is not None:
@@ -663,6 +1024,7 @@ class DistNeighborLoader:
     edge_index = jnp.stack([out['row'], out['col']], axis=1)  # [P, 2, E]
     return Batch(
         x=out['x'], y=out['y'], edge_index=edge_index,
+        edge_attr=out['ef'],
         node=out['node'], node_mask=out['node'] >= 0,
         edge_mask=out['row'] >= 0, edge=out['edge'],
         batch=out['batch'], batch_size=self.batch_size,
@@ -746,19 +1108,22 @@ class DistLinkNeighborSampler(DistNeighborSampler):
           self.neg_amount,
           self.with_edge, self.collect_features, self.collect_labels,
           self.axis, with_cache=self.with_cache,
-          exchange_slack=self.exchange_slack)
+          exchange_slack=self.exchange_slack,
+          collect_edge_features=self.collect_edge_features,
+          ef_shard_mode=self._ef_shard_mode)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
     pairs_dev = jax.device_put(
         np.asarray(pairs_stacked, dtype=np.int32),
         NamedSharding(self.mesh, P(self.axis)))
-    (nodes, count, row, col, edge, seed_local, x, y, nsn,
+    (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats,
      eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
         self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
                          arrs['bounds'], pairs_dev, arrs['fshards'],
                          arrs['lshards'], arrs['cids'], arrs['crows'],
-                         key)
+                         arrs['efshards'], arrs['ebounds'], key)
+    self._accumulate_stats(stats)
     md = {'seed_local': seed_local}
     if self.neg_mode == 'triplet':
       md.update(src_index=src_idx, dst_pos_index=dst_pos,
@@ -768,7 +1133,7 @@ class DistLinkNeighborSampler(DistNeighborSampler):
       md.update(edge_label_index=eli, edge_label=elab,
                 edge_label_mask=elab_mask)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
-                edge=edge, x=x, y=y, num_sampled_nodes=nsn,
+                edge=edge, x=x, y=y, ef=ef, num_sampled_nodes=nsn,
                 batch=pairs_dev[:, :, 0], metadata=md)
 
 
@@ -793,12 +1158,13 @@ class DistLinkNeighborLoader:
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack: Optional[float] = None):
+               exchange_slack='auto'):
     from ..loader.node_loader import SeedBatcher
     self.sampler = DistLinkNeighborSampler(
         dataset, num_neighbors, neg_sampling=neg_sampling, mesh=mesh,
         with_edge=with_edge, collect_features=collect_features,
-        seed=seed, exchange_slack=exchange_slack)
+        seed=seed, exchange_slack=resolve_exchange_slack(exchange_slack,
+                                                         shuffle))
     rows, cols, colsarr = pack_link_seeds(edge_label_index, edge_label,
                                           self.sampler.neg_mode)
     if input_space == 'old' and dataset.old2new is not None:
@@ -826,6 +1192,7 @@ class DistLinkNeighborLoader:
     edge_index = jnp.stack([out['row'], out['col']], axis=1)
     return Batch(
         x=out['x'], y=out['y'], edge_index=edge_index,
+        edge_attr=out['ef'],
         node=out['node'], node_mask=out['node'] >= 0,
         edge_mask=out['row'] >= 0, edge=out['edge'],
         batch=out['batch'], batch_size=self.batch_size,

@@ -47,6 +47,10 @@ class PartitionerBase:
     num_nodes: node count (dict per ntype for hetero).
     edge_index: ``(rows, cols)`` (dict per etype for hetero).
     node_feat / node_label: optional arrays (dicts for hetero).
+    edge_feat: optional ``[E, De]`` edge features in input edge order
+      (dict per etype for hetero) — partitioned by the edge partition
+      book, the reference's separate ``edge_feat_pb`` world
+      (`distributed/dist_dataset.py:183-193`).
     edge_assign: ``'by_src'`` or ``'by_dst'`` edge ownership
       (reference `partition/base.py:218-290` chunked variant).
     cache_ratio: fraction of hottest *remote* rows each partition
@@ -55,13 +59,15 @@ class PartitionerBase:
 
   def __init__(self, output_dir, num_parts: int, num_nodes,
                edge_index, node_feat=None, node_label=None,
-               edge_assign: str = 'by_src', cache_ratio: float = 0.0):
+               edge_assign: str = 'by_src', cache_ratio: float = 0.0,
+               edge_feat=None):
     self.output_dir = Path(output_dir)
     self.num_parts = int(num_parts)
     self.num_nodes = num_nodes
     self.edge_index = edge_index
     self.node_feat = node_feat
     self.node_label = node_label
+    self.edge_feat = edge_feat
     assert edge_assign in ('by_src', 'by_dst')
     self.edge_assign = edge_assign
     self.cache_ratio = float(cache_ratio)
@@ -90,9 +96,13 @@ class PartitionerBase:
         np.save(self.output_dir / f'node_pb_{nt}.npy', node_pbs[nt])
       for et, (rows, cols) in self.edge_index.items():
         owner_nt = et[0] if self.edge_assign == 'by_src' else et[2]
-        self._partition_graph(np.asarray(rows), np.asarray(cols),
-                              node_pbs[owner_nt],
-                              subdir=('graph', as_str(et)), etype=et)
+        edge_pb = self._partition_graph(
+            np.asarray(rows), np.asarray(cols), node_pbs[owner_nt],
+            subdir=('graph', as_str(et)), etype=et)
+        if self.edge_feat and et in self.edge_feat:
+          self._partition_edge_feat(np.asarray(self.edge_feat[et]),
+                                    edge_pb,
+                                    subdir=('edge_feat', as_str(et)))
       if self.node_feat:
         for nt, feats in self.node_feat.items():
           self._partition_feat(np.asarray(feats), node_pbs[nt],
@@ -109,13 +119,19 @@ class PartitionerBase:
           'edge_assign': self.edge_assign,
           'num_nodes': {nt: int(self.num_nodes[nt])
                         for nt in self._ntypes()},
+          'num_edges': {as_str(et): int(len(ei[0]))
+                        for et, ei in self.edge_index.items()},
       }
     else:
       node_pb = self.partition_node()
       np.save(self.output_dir / 'node_pb.npy', node_pb)
       rows, cols = self.edge_index
-      self._partition_graph(np.asarray(rows), np.asarray(cols), node_pb,
-                            subdir=('graph',))
+      edge_pb = self._partition_graph(np.asarray(rows),
+                                      np.asarray(cols), node_pb,
+                                      subdir=('graph',))
+      if self.edge_feat is not None:
+        self._partition_edge_feat(np.asarray(self.edge_feat), edge_pb,
+                                  subdir=('edge_feat',))
       if self.node_feat is not None:
         self._partition_feat(np.asarray(self.node_feat), node_pb,
                              self.node_hotness(), subdir=('node_feat',))
@@ -124,7 +140,8 @@ class PartitionerBase:
                               subdir=('node_label',))
       meta = {'num_parts': self.num_parts, 'hetero': False,
               'edge_assign': self.edge_assign,
-              'num_nodes': int(self.num_nodes)}
+              'num_nodes': int(self.num_nodes),
+              'num_edges': int(len(rows))}
     with open(self.output_dir / 'META.json', 'w') as f:
       json.dump(meta, f, indent=2)
 
@@ -156,6 +173,20 @@ class PartitionerBase:
       np.save(d / 'rows.npy', rows[sel])
       np.save(d / 'cols.npy', cols[sel])
       np.save(d / 'eids.npy', eids[sel])
+    return edge_pb
+
+  def _partition_edge_feat(self, feats, edge_pb, subdir):
+    """Split edge features by the edge partition book (the reference's
+    ``edge_feat_pb`` layout, `distributed/dist_dataset.py:183-193`)."""
+    eids_all = np.arange(feats.shape[0], dtype=np.int64)
+    for p in range(self.num_parts):
+      own = edge_pb == p
+      d = self.output_dir / f'part{p}'
+      for s in subdir:
+        d = d / s
+      d.mkdir(parents=True, exist_ok=True)
+      np.save(d / 'feats.npy', feats[own])
+      np.save(d / 'ids.npy', eids_all[own])
 
   def _partition_feat(self, feats, node_pb, hotness, subdir):
     """Split features by ownership + plan per-partition hot caches
@@ -246,6 +277,13 @@ def load_partition(root, part_idx: int):
       if (ld / 'labels.npy').exists():
         out['node_label'][nt] = (np.load(ld / 'labels.npy'),
                                  np.load(ld / 'ids.npy'))
+    out['edge_feat'] = {}
+    for ets in meta['edge_types']:
+      f = _load_dir_feat(pdir / 'edge_feat' / ets)
+      if f is not None:
+        out['edge_feat'][edge_type_from_str(ets)] = f
+    if not out['edge_feat']:
+      out['edge_feat'] = None
   else:
     out['node_pb'] = TablePartitionBook(np.load(root / 'node_pb.npy'),
                                         meta['num_parts'])
@@ -256,6 +294,7 @@ def load_partition(root, part_idx: int):
         edge_index=(np.load(g / 'rows.npy'), np.load(g / 'cols.npy')),
         eids=np.load(g / 'eids.npy'))
     out['node_feat'] = _load_dir_feat(pdir / 'node_feat')
+    out['edge_feat'] = _load_dir_feat(pdir / 'edge_feat')
     ld = pdir / 'node_label'
     out['node_label'] = ((np.load(ld / 'labels.npy'),
                           np.load(ld / 'ids.npy'))

@@ -1,0 +1,82 @@
+"""Worker program for the two-process jax.distributed smoke test.
+
+Launched by tests/test_multihost.py as ``python _multihost_worker.py
+<coordinator> <num_procs> <proc_id> <out_file>`` with a CPU platform
+and 4 virtual devices per process — the JAX analog of the reference's
+all-local multi-role tests (`test/python/dist_test_utils.py:15-120`):
+the REAL cross-process runtime comes up, the mesh spans both
+processes' devices, and one DistNeighborLoader epoch + one DP step run
+over it.
+"""
+import json
+import sys
+
+coordinator, num_procs, proc_id, out_file = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+
+import numpy as np
+from graphlearn_tpu.parallel import multihost
+
+multihost.initialize(coordinator_address=coordinator,
+                     num_processes=num_procs, process_id=proc_id)
+
+import jax
+
+assert jax.process_count() == num_procs, jax.process_count()
+mesh = multihost.global_mesh()
+num_parts = mesh.devices.size
+assert num_parts == 8, num_parts
+
+N = 64
+rows = np.concatenate([np.arange(N), np.arange(N)])
+cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+feats = (np.arange(N, dtype=np.float32)[:, None]
+         * np.ones((1, 4), np.float32))
+labels = (np.arange(N) % 4).astype(np.int32)
+
+from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                     make_dp_supervised_step, replicate)
+
+# every host builds the SAME sharded dataset (same seed) and feeds the
+# SAME global seed schedule; device_put scatters each host's
+# addressable shards
+ds = DistDataset.from_full_graph(num_parts, rows, cols, node_feat=feats,
+                                 node_label=labels, num_nodes=N, seed=0)
+
+shard = multihost.host_seed_shard(np.arange(N), epoch=0, seed=3)
+hsl = multihost.host_device_slice(num_parts)
+
+bs = 4
+loader = DistNeighborLoader(ds, [2, 2], np.arange(N), batch_size=bs,
+                            shuffle=True, mesh=mesh, seed=0)
+
+import optax
+from graphlearn_tpu.models import GraphSAGE, create_train_state
+
+batches = 0
+first = None
+for batch in loader:
+  if first is None:
+    first = batch
+  batches += 1
+
+model = GraphSAGE(hidden_features=8, out_features=4, num_layers=2)
+tx = optax.adam(1e-2)
+# single-device template for param init: the local addressable piece
+# of the stacked batch
+local_piece = jax.tree_util.tree_map(
+    lambda v: (np.asarray(v.addressable_shards[0].data)[0]
+               if isinstance(v, jax.Array) and v.shape
+               and v.shape[0] == num_parts else v), first)
+state, _ = create_train_state(model, jax.random.key(0), local_piece, tx)
+state = replicate(state, mesh)
+step = make_dp_supervised_step(model.apply, tx, bs, mesh)
+state, loss, correct = step(state, first)
+loss_val = float(np.asarray(loss.addressable_shards[0].data))
+assert np.isfinite(loss_val), loss_val
+
+with open(out_file, 'w') as f:
+  json.dump({'proc': proc_id, 'shard': shard.tolist(),
+             'host_slice': [hsl.start, hsl.stop],
+             'batches': batches, 'loss': loss_val}, f)
+print('WORKER OK', proc_id, loss_val)

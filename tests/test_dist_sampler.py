@@ -251,29 +251,39 @@ def test_bucket_capacity_drops_overflow_not_valid_ids():
 
 def test_exchange_capacity_drops_are_masked():
   """A skewed workload (every seed targets partition 0's range) with a
-  small slack: real drops happen, survivors stay correct."""
-  ds = _ring_dist_dataset(4, contiguous=True)
+  small slack: real drops happen, survivors stay correct.  The ring is
+  sized so the skewed bucket exceeds the MIN_EXCHANGE_CAP floor (tiny
+  exchanges are deliberately exact)."""
+  n = 1024
+  rows = np.concatenate([np.arange(n), np.arange(n)])
+  cols = np.concatenate([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n])
+  node_pb = (np.arange(n) * 4 // n).astype(np.int32)
+  ds = DistDataset.from_full_graph(4, rows, cols, num_nodes=n,
+                                   node_pb=node_pb)
   mesh = make_mesh(4)
   s = DistNeighborSampler(ds, [2], mesh=mesh, seed=0,
                           exchange_slack=0.5)
-  # 16 seeds per device, ALL in partition 0's range [0, 16): buckets
-  # are maximally skewed, caps bind hard
-  seeds = ds.old2new[np.tile(np.arange(16), (4, 1))]
+  # 256 seeds per device, ALL in partition 0's range [0, 256): buckets
+  # are maximally skewed, the cap max(256/4*0.5, 64) = 64 binds hard
+  seeds = ds.old2new[np.tile(np.arange(256), (4, 1))]
   out = s.sample_from_nodes(seeds)
-  rows = np.asarray(out['row'])
-  cols = np.asarray(out['col'])
+  rows_l = np.asarray(out['row'])
+  cols_l = np.asarray(out['col'])
   nodes = np.asarray(out['node'])
   new2old = ds.new2old
   survived = 0
   for p in range(4):
-    m = rows[p] >= 0
-    for r, c in zip(rows[p][m], cols[p][m]):
+    m = rows_l[p] >= 0
+    for r, c in zip(rows_l[p][m], cols_l[p][m]):
       u = new2old[nodes[p, c]]
       v = new2old[nodes[p, r]]
-      assert (v - u) % N in (1, 2)     # still a real ring edge
+      assert (v - u) % n in (1, 2)     # still a real ring edge
       survived += 1
   # the uncapped run yields 2 edges/seed; drops must actually occur
   uncapped = DistNeighborSampler(ds, [2], mesh=mesh, seed=0)
   out_u = uncapped.sample_from_nodes(seeds)
   full = int((np.asarray(out_u['row']) >= 0).sum())
   assert 0 < survived < full
+  # each dropped frontier id loses exactly min(deg, k) = 2 edges
+  st = s.exchange_stats(tick_metrics=False)
+  assert st['dist.frontier.dropped'] * 2 == full - survived

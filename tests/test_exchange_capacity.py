@@ -1,0 +1,135 @@
+"""Capacity-bounded exchange: defaults, telemetry, and sampling bias.
+
+The VERDICT-r1 "#1 scaling risk" items: `exchange_slack` must be a
+defaulted, *measured* mechanism — shuffled loaders cap send buffers at
+2x the balanced share, overflow drops are counted (never invisible),
+and sampling statistics stay unbiased under the default cap.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip('jax')
+
+from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                     make_mesh)
+from graphlearn_tpu.parallel.dist_sampler import (
+    DEFAULT_EXCHANGE_SLACK, DistNeighborSampler, resolve_exchange_slack)
+from graphlearn_tpu.utils.profiling import metrics
+
+N = 512
+DEG = 8
+FANOUT = 4
+
+
+def _regular_graph(seed=0):
+  rng = np.random.default_rng(seed)
+  rows = np.repeat(np.arange(N), DEG)
+  cols = rng.integers(0, N, N * DEG)
+  return rows.astype(np.int64), cols.astype(np.int64)
+
+
+def test_auto_slack_resolution():
+  assert resolve_exchange_slack('auto', True) == DEFAULT_EXCHANGE_SLACK
+  assert resolve_exchange_slack('auto', False) is None
+  assert resolve_exchange_slack(None, True) is None
+  assert resolve_exchange_slack(3.0, False) == 3.0
+  with pytest.raises(ValueError):
+    resolve_exchange_slack('always', True)
+
+
+def test_loader_defaults_capped_only_when_shuffled():
+  rows, cols = _regular_graph()
+  ds = DistDataset.from_full_graph(8, rows, cols, num_nodes=N)
+  shuffled = DistNeighborLoader(ds, [FANOUT], np.arange(N),
+                                batch_size=8, shuffle=True, mesh=make_mesh(8))
+  sequential = DistNeighborLoader(ds, [FANOUT], np.arange(N),
+                                  batch_size=8, shuffle=False, mesh=make_mesh(8))
+  assert shuffled.sampler.exchange_slack == DEFAULT_EXCHANGE_SLACK
+  assert sequential.sampler.exchange_slack is None
+
+
+def test_sampling_unbiased_under_default_cap():
+  """Every edge of a degree-8 graph must be selected with frequency
+  ~= fanout/degree under the 2.0 cap, uniformly across owner
+  partitions (owner-correlated drops would skew per-partition means).
+  """
+  rows, cols = _regular_graph()
+  ds = DistDataset.from_full_graph(8, rows, cols, num_nodes=N, seed=3)
+  epochs = 30
+  loader = DistNeighborLoader(ds, [FANOUT], np.arange(N), batch_size=16,
+                              shuffle=True, mesh=make_mesh(8), with_edge=True,
+                              collect_features=False, seed=11)
+  b_k = 16 * FANOUT
+  counts = np.zeros(N * DEG, np.int64)
+  for _ in range(epochs):
+    for batch in loader:
+      eids = np.asarray(batch.edge)[:, :b_k].reshape(-1)
+      counts += np.bincount(eids[eids >= 0], minlength=N * DEG)
+  freq = counts / epochs                     # per-edge selection freq
+  expect = FANOUT / DEG
+  assert abs(freq.mean() - expect) < 0.02
+  # owner-partition uniformity: edges grouped by their source's owner
+  owner = ds.old2new[rows] * 8 // N          # bounds are equal ranges
+  for p in range(8):
+    sel = freq[owner == p]
+    assert abs(sel.mean() - expect) < 0.03, f'owner {p} biased'
+  # the default cap on this balanced workload loses (almost) nothing
+  st = loader.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.frontier.dropped'] <= 0.01 * st['dist.frontier.offered']
+
+
+def test_overflow_drops_are_counted():
+  """A deliberately starved capacity must (a) drop frontier ids, (b)
+  surface them in exchange_stats AND the global metrics registry, and
+  (c) still never emit a wrong edge."""
+  n2 = 8192
+  rng = np.random.default_rng(2)
+  rows = np.repeat(np.arange(n2), 2)
+  cols = rng.integers(0, n2, n2 * 2)
+  edge_set = set(zip(rows.tolist(), cols.tolist()))
+  ds = DistDataset.from_full_graph(8, rows, cols, num_nodes=n2, seed=5)
+  sampler = DistNeighborSampler(ds, [2], mesh=make_mesh(8),
+                                collect_features=False,
+                                exchange_slack=0.25)
+  # 1024 DISTINCT seeds/device (the inducer dedups repeats): ~128 per
+  # owner against the starved cap max(1024/8*0.25, floor)=64 ->
+  # guaranteed overflow
+  seeds = ds.old2new[np.arange(n2)].reshape(8, 1024)
+  out = sampler.sample_from_nodes(seeds)
+  node = np.asarray(out['node'])
+  row_l = np.asarray(out['row'])
+  col_l = np.asarray(out['col'])
+  new2old = ds.new2old
+  for d in range(8):
+    for i in np.nonzero(row_l[d] >= 0)[0]:
+      u = int(new2old[node[d, col_l[d, i]]])
+      v = int(new2old[node[d, row_l[d, i]]])
+      # emitted direction is transposed (neighbor -> seed)
+      assert (u, v) in edge_set
+  st = sampler.exchange_stats()              # ticks global metrics
+  assert st['dist.frontier.dropped'] > 0
+  snap = metrics.snapshot()
+  assert snap.get('dist.frontier.dropped', 0) >= st['dist.frontier.dropped']
+  # accounting invariant: what was actually sent fits in the slots
+  assert (st['dist.frontier.slots']
+          >= st['dist.frontier.offered'] - st['dist.frontier.dropped'])
+
+
+def test_negative_loss_counter():
+  """On a near-complete bipartite-ish graph strict negatives exhaust
+  their trials; the lost count must reach the telemetry."""
+  n = 32
+  rows = np.repeat(np.arange(n), n)
+  cols = np.tile(np.arange(n), n)
+  from graphlearn_tpu.parallel.dist_sampler import DistLinkNeighborSampler
+  ds = DistDataset.from_full_graph(8, rows, cols, num_nodes=n, seed=7)
+  sampler = DistLinkNeighborSampler(ds, [2], neg_sampling='binary',
+                                    mesh=make_mesh(8), collect_features=False)
+  pairs = np.stack([ds.old2new[rows[:64]], ds.old2new[cols[:64]]],
+                   axis=1).reshape(8, 8, 2)
+  out = sampler.sample_from_edges(pairs)
+  st = sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.negative.lost'] > 0
+  mask = np.asarray(out['metadata']['edge_label_mask'])
+  lab = np.asarray(out['metadata']['edge_label'])
+  assert not mask[lab == 0].any()
