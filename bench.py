@@ -1,98 +1,187 @@
-"""Headline benchmark: neighbor-sampling throughput on one TPU chip.
+"""Headline benchmark: GraphSAGE epoch time + sampling throughput.
 
-Reproduces the reference's metric definition — "Sampled Edges per secs"
-(`benchmarks/api/bench_sampler.py:46-54`: wall-clock around
-`sampler.sample_from_nodes`, edges counted from the sampled topology) —
-on the reference's flagship config: fanout [15, 10, 5], batch 1024
-(`examples/train_sage_ogbn_products.py:16`), on an ogbn-products-scale
-synthetic graph (2.45M nodes, ~62M directed edges).
+PRIMARY metric (BASELINE.json: "GraphSAGE epoch time on
+ogbn-products"): wall-clock of one full training epoch — seed shuffle
+-> multi-hop sampling (fanout [15, 10, 5], batch 1024,
+`examples/train_sage_ogbn_products.py:16`) -> feature/label collation
+-> fused train step — on an ogbn-products-scale synthetic graph (2.45M
+nodes, ~61M directed edges, 100-dim features, ~8% train split).
 
-The reference publishes figures, not numbers (`BASELINE.md`);
-``BASELINE_EDGES_PER_SEC`` is our normalization constant: 100M
-sampled-edges/sec, a mid-range read of GLT's single-A100 scale_up plot
-era. vs_baseline > 1.0 means faster than that nominal A100 figure.
+SECONDARY: the reference's "Sampled Edges per secs" definition
+(`benchmarks/api/bench_sampler.py:46-54`).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Honest variance reporting: the tunnel to the chip swings wall-clock
+several-fold BETWEEN processes, and within a process only the first
+timed burst reflects true device throughput (benchmarks/README,
+"first-burst validity").  So the harness runs ``GLT_BENCH_SESSIONS``
+(default 5) fresh subprocess sessions and reports min/median/max
+across them; the headline `value` is the MEDIAN epoch time.
+
+``vs_baseline`` divides a NOMINAL single-A100 epoch time of 2.0 s into
+the median (the reference publishes figures, not numbers — 2.0 s is a
+mid-range read of public GLT-class A100 pipelines on this workload;
+BASELINE.md documents the absence of published values).  > 1.0 means
+faster than that nominal A100.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 import json
 import os
+import statistics
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from benchmarks.common import NUM_NODES, build_graph  # noqa: E402
+from benchmarks.common import (NUM_NODES, build_graph,  # noqa: E402
+                               build_graph_csr)
 
+#: nominal single-A100 epoch seconds (see module docstring)
+BASELINE_EPOCH_SECS = 2.0
+#: round-1 normalization constant for the secondary sampling metric
 BASELINE_EDGES_PER_SEC = 100e6
 
 FANOUT = (15, 10, 5)
 BATCH = 1024
-WARMUP = 3
-ITERS = 50
+DIM = 100
+CLASSES = 47
+SAMPLE_ITERS = 30
+
+
+def worker():
+  """One fresh-session measurement: epoch time first (the primary,
+  measured on this process's first burst), then sampling throughput."""
+  import jax
+  try:
+    jax.config.update('jax_compilation_cache_dir', '/tmp/glt_jax_cache')
+  except Exception:
+    pass
+  if '--cpu' in sys.argv:
+    jax.config.update('jax_platforms', 'cpu')
+  import jax.numpy as jnp
+  import optax
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.loader import NeighborLoader
+  from graphlearn_tpu.models import (GraphSAGE, create_train_state,
+                                     make_supervised_step)
+  from graphlearn_tpu.sampler import NeighborSampler, NodeSamplerInput
+
+  n = NUM_NODES
+  indptr, indices, eids = build_graph_csr(n)     # cached across sessions
+  rng = np.random.default_rng(0)
+  feats = rng.random((n, DIM), dtype=np.float32)
+  labels = rng.integers(0, CLASSES, n).astype(np.int32)
+  ds = (Dataset()
+        .init_graph((indptr, indices), edge_ids=eids, layout='CSR',
+                    num_nodes=n)
+        .init_node_features(feats, split_ratio=1.0)
+        .init_node_labels(labels))
+  train_idx = rng.permutation(n)[:max(n // 12, 1)]
+  loader = NeighborLoader(ds, list(FANOUT), train_idx, batch_size=BATCH,
+                          shuffle=True, seed=0)
+  model = GraphSAGE(hidden_features=256, out_features=CLASSES,
+                    num_layers=3)
+  tx = optax.adam(3e-3)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), next(iter(loader)), tx)
+  step = make_supervised_step(apply_fn, tx, BATCH)
+
+  # epoch 0 = warmup/compile; epoch 1 = THE measured first burst
+  epoch_secs = None
+  for epoch in range(2):
+    t0 = time.perf_counter()
+    for batch in loader:
+      state, loss, _ = step(state, batch)
+    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    if epoch == 1:
+      epoch_secs = time.perf_counter() - t0
+
+  # secondary: sampling-only throughput, reference metric definition
+  sampler = NeighborSampler(ds.get_graph(), FANOUT, seed=0)
+  srng = np.random.default_rng(1)
+  seed_batches = [srng.integers(0, n, BATCH).astype(np.int32)
+                  for _ in range(3 + SAMPLE_ITERS)]
+  for i in range(3):
+    out = sampler.sample_from_nodes(NodeSamplerInput(node=seed_batches[i]))
+  out.node.block_until_ready()
+  t0 = time.perf_counter()
+  outs = [sampler.sample_from_nodes(NodeSamplerInput(node=seed_batches[3 + i]))
+          for i in range(SAMPLE_ITERS)]
+  for o in outs:
+    o.row.block_until_ready()
+  dt = time.perf_counter() - t0
+  edges = int(sum((o.edge_mask.sum() for o in outs),
+                  jnp.zeros((), jnp.int32)))
+  print(json.dumps({'epoch_secs': epoch_secs,
+                    'edges_per_sec': edges / dt,
+                    'steps': len(loader),
+                    'platform': jax.devices()[0].platform}),
+        flush=True)
 
 
 def main():
-  import jax
-  sys.path.insert(0, '.')
-  from graphlearn_tpu.data import Dataset
-  from graphlearn_tpu.sampler import NeighborSampler, NodeSamplerInput
-
-  if '--cpu' in sys.argv:
-    jax.config.update('jax_platforms', 'cpu')
-  dev = jax.devices()[0]
-
-  rows, cols = build_graph()
-  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=NUM_NODES)
-  g = ds.get_graph()
-  g.lazy_init()
-
-  sampler = NeighborSampler(g, FANOUT, seed=0)
-  rng = np.random.default_rng(1)
-  # Pre-generate seed batches (the reference iterates a pre-built
-  # DataLoader over train_idx likewise); transfer stays in the timer.
-  seed_batches = [rng.integers(0, NUM_NODES, BATCH).astype(np.int32)
-                  for _ in range(WARMUP + ITERS)]
-
-  def one_batch(i):
-    return sampler.sample_from_nodes(
-        NodeSamplerInput(node=seed_batches[i]))
-
-  # Warmup (compile) — not timed.
-  for i in range(WARMUP):
-    out = one_batch(i)
-  out.node.block_until_ready()
-
-  # Best of 3 repetitions: the sampling program is deterministic-cost;
-  # repetition suppresses host/dispatch jitter (which otherwise swings
-  # the measurement several-fold on tunneled chips).  Edge counting
-  # happens ON DEVICE (one scalar pull per rep): bulk device->host
-  # pulls permanently degrade tunneled dispatch (benchmarks/README,
-  # "first-burst validity"), which would poison reps 2-3.
-  import jax.numpy as jnp
-  best_dt, edges = None, 0
-  for _ in range(3):
-    t0 = time.perf_counter()
-    outs = []
-    for i in range(ITERS):
-      outs.append(one_batch(WARMUP + i))
-    for o in outs:
-      o.row.block_until_ready()
-    dt = time.perf_counter() - t0
-    if best_dt is None or dt < best_dt:
-      best_dt = dt
-      edges_dev = sum((o.edge_mask.sum() for o in outs),
-                      jnp.zeros((), jnp.int32))
-      edges = int(edges_dev)       # single tiny transfer, post-timer
-  eps = edges / best_dt
+  sessions = int(os.environ.get('GLT_BENCH_SESSIONS', 5))
+  build_graph_csr(NUM_NODES)      # warm the /tmp graph+CSR caches once
+  results = []
+  session_timeout = int(os.environ.get('GLT_BENCH_SESSION_TIMEOUT', 480))
+  # hard wall for the whole harness: tunnel-slow days must yield a
+  # degraded (fewer-session) number, never a timeout with NO number
+  total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 1500))
+  t_start = time.time()
+  for s in range(sessions):
+    if results and time.time() - t_start > total_budget - session_timeout:
+      print(f'budget: stopping after {len(results)} sessions',
+            file=sys.stderr)
+      break
+    cmd = [sys.executable, os.path.abspath(__file__), '--bench-worker']
+    cmd += [a for a in sys.argv[1:] if a != '--bench-worker']
+    try:
+      out = subprocess.run(cmd, capture_output=True, text=True,
+                           cwd=os.path.dirname(os.path.abspath(__file__)),
+                           timeout=session_timeout)
+    except subprocess.TimeoutExpired:
+      print(f'session {s} timed out after {session_timeout}s',
+            file=sys.stderr)
+      continue
+    line = None
+    for ln in reversed(out.stdout.strip().splitlines()):
+      if ln.startswith('{'):
+        line = ln
+        break
+    if line is None:
+      print(f'session {s} failed:\n{out.stdout[-2000:]}\n'
+            f'{out.stderr[-2000:]}', file=sys.stderr)
+      continue
+    results.append(json.loads(line))
+  if not results:
+    raise SystemExit('all bench sessions failed')
+  ep = sorted(r['epoch_secs'] for r in results)
+  es = sorted(r['edges_per_sec'] for r in results)
+  med_ep = statistics.median(ep)
+  med_es = statistics.median(es)
   print(json.dumps({
-      'metric': f'sampled_edges_per_sec (fanout {list(FANOUT)}, '
-                f'batch {BATCH}, {dev.platform})',
-      'value': round(eps / 1e6, 3),
-      'unit': 'M edges/s',
-      'vs_baseline': round(eps / BASELINE_EDGES_PER_SEC, 4),
+      'metric': f'graphsage_epoch_secs (products-scale synthetic, '
+                f'fanout {list(FANOUT)}, batch {BATCH}, '
+                f'{results[0]["platform"]})',
+      'value': round(med_ep, 4),
+      'unit': 's',
+      'vs_baseline': round(BASELINE_EPOCH_SECS / med_ep, 4),
+      'epoch_secs_min_med_max': [round(ep[0], 4), round(med_ep, 4),
+                                 round(ep[-1], 4)],
+      'sampled_edges_per_sec_M_min_med_max': [
+          round(es[0] / 1e6, 1), round(med_es / 1e6, 1),
+          round(es[-1] / 1e6, 1)],
+      'sampling_vs_a100_nominal': round(med_es / BASELINE_EDGES_PER_SEC,
+                                        2),
+      'sessions': len(results),
+      'steps_per_epoch': results[0]['steps'],
   }))
 
 
 if __name__ == '__main__':
-  main()
+  if '--bench-worker' in sys.argv:
+    worker()
+  else:
+    main()

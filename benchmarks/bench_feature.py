@@ -27,6 +27,9 @@ def main():
   ap.add_argument('--cpu', action='store_true')
   ap.add_argument('--quick', action='store_true')
   ap.add_argument('--dim', type=int, default=128)
+  ap.add_argument('--overlap-only', action='store_true',
+                  help='skip the lookup sweep; run only the prefetch '
+                       'overlap measurement')
   args = ap.parse_args()
 
   import jax
@@ -51,7 +54,7 @@ def main():
     out = sampler.sample_from_nodes(NodeSamplerInput(node=seeds))
     node_sets.append(np.asarray(out.node))
 
-  for split_ratio in (1.0, 0.5, 0.2):
+  for split_ratio in (() if args.overlap_only else (1.0, 0.5, 0.2)):
     for pallas in ((True, False) if split_ratio == 1.0 else (False,)):
       os.environ['GLT_PALLAS'] = '1' if pallas else '0'
       ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
@@ -77,6 +80,85 @@ def main():
            impl=('pallas' if pallas else 'xla'),
            platform=jax.devices()[0].platform)
   os.environ.pop('GLT_PALLAS', None)
+
+  # -- cold-path overlap: prefetch=2 vs synchronous loader ---------------
+  # The batch loop alternates a device compute step with the loader's
+  # cold gather + transfer; double buffering should hide most of the
+  # loader's host time behind the compute (the UVA-overlap parity gap,
+  # `csrc/cuda/unified_tensor.cu:202+`).
+  from graphlearn_tpu.loader import NeighborLoader
+  import jax.numpy as jnp
+
+  @jax.jit
+  def compute(x):
+    for _ in range(8):
+      x = jnp.tanh(x @ x.T) @ x
+    return x
+
+  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
+  ds.init_node_features(feats, sort_func=sort_by_in_degree,
+                        split_ratio=0.2)
+  ds.init_node_labels((np.arange(n) % 4).astype(np.int32))
+  seeds = rng.integers(0, n, 1024 * (4 if args.quick else 16))
+  n_batches = len(seeds) // 1024
+
+  # loader-only pass: the host+transfer time prefetch should hide —
+  # measured FIRST and directly (deriving it from a subtraction is not
+  # robust to tunnel variance between passes)
+  loader = NeighborLoader(ds, [15, 10], seeds, batch_size=1024,
+                          shuffle=True, seed=0)
+  it = iter(loader)
+  b0 = next(it)
+  b0.x.block_until_ready()
+  with Timer() as t:
+    b = None
+    for b in it:
+      b.x.block_until_ready()
+  loader_time = t.dt
+
+  # calibrate device compute to ~the per-batch loader time, so the
+  # pipeline has comparable stages and the overlap claim is testable
+  x0 = b0.x[:512]
+  compute(x0).block_until_ready()
+  with Timer() as t:
+    compute(x0).block_until_ready()
+  reps = max(1, int(loader_time / n_batches / max(t.dt, 1e-6)))
+
+  def step(x):
+    for _ in range(reps):
+      x = compute(x)
+    return x
+
+  with Timer() as t:
+    out = None
+    for _ in range(n_batches):
+      out = step(x0)
+    out.block_until_ready()
+  compute_time = t.dt
+
+  times = {}
+  for depth in (0, 2):
+    loader = NeighborLoader(ds, [15, 10], seeds, batch_size=1024,
+                            shuffle=True, seed=0, prefetch=depth)
+    it = iter(loader)
+    b = next(it)
+    step(b.x[:512]).block_until_ready()
+    with Timer() as t:
+      out = None
+      for b in it:
+        out = step(b.x[:512])
+      out.block_until_ready()
+    times[depth] = t.dt
+  # perfect overlap drives total from L + C to max(L, C): the
+  # hideable span is min(L, C)
+  hideable = min(loader_time, compute_time)
+  hidden = (times[0] - times[2]) / max(hideable, 1e-9)
+  emit('feature_prefetch_overlap', min(hidden, 1.0) * 100,
+       '% hideable time hidden',
+       sync_s=round(times[0], 4), prefetch_s=round(times[2], 4),
+       loader_s=round(loader_time, 4),
+       compute_s=round(compute_time, 4),
+       platform=jax.devices()[0].platform)
 
 
 if __name__ == '__main__':

@@ -52,6 +52,30 @@ def build_graph(num_nodes=NUM_NODES, avg_deg=AVG_DEG, seed=0,
   return rows, cols
 
 
+def build_graph_csr(num_nodes=NUM_NODES, avg_deg=AVG_DEG, seed=0):
+  """CSR form of `build_graph`, cached: the COO->CSR sort costs ~60s
+  at products scale on this box and dominated the per-session cost of
+  the multi-session bench harness.  Returns ``(indptr, indices,
+  edge_ids)`` for ``Dataset.init_graph(layout='CSR')``."""
+  import os
+  path = (f'/tmp/.glt_bench_csr_v{GRAPH_VERSION}'
+          f'_{num_nodes}_{avg_deg}_{seed}.npz')
+  if os.path.exists(path):
+    d = np.load(path)
+    return (d['indptr'].astype(np.int64), d['indices'].astype(np.int64),
+            d['eids'].astype(np.int64))
+  rows, cols = build_graph(num_nodes, avg_deg, seed)
+  order = np.argsort(rows, kind='stable')
+  indices = cols[order]
+  indptr = np.zeros(num_nodes + 1, np.int64)
+  np.cumsum(np.bincount(rows, minlength=num_nodes), out=indptr[1:])
+  tmp = f'{path}.{os.getpid()}.tmp.npz'
+  np.savez(tmp[:-4], indptr=indptr, indices=indices.astype(np.int32),
+           eids=order.astype(np.int32))
+  os.replace(tmp, path)
+  return indptr, indices.astype(np.int64), order.astype(np.int64)
+
+
 def emit(metric: str, value: float, unit: str, baseline: float = None,
          **extra):
   rec = {'metric': metric, 'value': round(float(value), 3), 'unit': unit}

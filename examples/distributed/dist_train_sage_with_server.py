@@ -60,7 +60,7 @@ def main():
   args = ap.parse_args()
   n = args.num_nodes
 
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   port_q = ctx.Queue()
   servers = [ctx.Process(target=run_server,
                          args=(r, args.num_servers, port_q, n),

@@ -56,7 +56,7 @@ def main():
 
   # fork BEFORE any device work: children stay host-only and inherit
   # the array copy-on-write
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   out_q = ctx.Queue()
   per = args.rows // args.workers
   procs = []

@@ -11,6 +11,19 @@ Usage::
 
     python examples/train_sage.py                      # synthetic
     python examples/train_sage.py --data products.npz  # real data
+
+The ``.npz`` schema matches a straight ogbn-products export (keys:
+``rows, cols`` int64 [E]; ``feats`` float32 [N, 100]; ``labels`` int64
+[N] or OGB's [N, 1]; ``train_idx / val_idx / test_idx`` int64) —
+from a torch environment::
+
+    from ogb.nodeproppred import NodePropPredDataset
+    d, labels = NodePropPredDataset('ogbn-products')[0]
+    split = NodePropPredDataset('ogbn-products').get_idx_split()
+    np.savez('products.npz', rows=d['edge_index'][0],
+             cols=d['edge_index'][1], feats=d['node_feat'],
+             labels=labels, train_idx=split['train'],
+             val_idx=split['valid'], test_idx=split['test'])
 """
 import argparse
 import sys
@@ -49,6 +62,11 @@ def main():
   ap.add_argument('--ckpt-dir', type=str, default=None,
                   help='checkpoint/resume directory (resumes if present)')
   ap.add_argument('--cpu', action='store_true')
+  ap.add_argument('--expect-acc', type=float, default=None,
+                  help='fail (exit 1) if final test accuracy is below '
+                       'this threshold — the example-level acceptance '
+                       'check (clustered-graph pattern from '
+                       'tests/test_models.py)')
   args = ap.parse_args()
 
   import jax
@@ -61,6 +79,18 @@ def main():
                                      make_eval_step, make_supervised_step)
 
   data = dict(np.load(args.data)) if args.data else synthetic()
+  # Real-schema robustness (ogbn-products exports): OGB labels are
+  # [N, 1] (squeeze), indices may be any integer dtype, and unlabeled
+  # nodes are nan in some exports (cast via float -> -1 sentinel).
+  labels = np.asarray(data['labels'])
+  if labels.ndim == 2 and labels.shape[1] == 1:
+    labels = labels[:, 0]
+  if np.issubdtype(labels.dtype, np.floating):
+    labels = np.where(np.isnan(labels), -1, labels)
+  data['labels'] = labels.astype(np.int64)
+  for k in ('rows', 'cols', 'train_idx', 'val_idx', 'test_idx'):
+    if k in data:
+      data[k] = np.asarray(data[k]).astype(np.int64).reshape(-1)
   classes = int(data['labels'].max()) + 1
   n = len(data['labels'])
 
@@ -114,7 +144,11 @@ def main():
     c, t = eval_step(state.params, batch)
     correct += int(c)
     total += int(t)
-  print(f'test acc: {correct / max(total, 1):.4f}')
+  acc = correct / max(total, 1)
+  print(f'test acc: {acc:.4f}')
+  if args.expect_acc is not None and acc < args.expect_acc:
+    raise SystemExit(
+        f'test accuracy {acc:.4f} below required {args.expect_acc}')
 
 
 if __name__ == '__main__':

@@ -1,62 +1,48 @@
-"""Vineyard (GraphScope) in-memory graph-store connectors — gated.
+"""Vineyard (GraphScope) connectors — a documented NON-GOAL.
 
-Counterpart of reference `data/vineyard_utils.py:15-55` +
-`csrc/cpu/vineyard_utils.cc` (optional, behind ``WITH_VINEYARD``):
-read CSR topology and vertex/edge feature columns straight from a
-vineyard object store shared with GraphScope.
+The reference optionally reads CSR topology and feature columns from a
+vineyard object store shared with GraphScope (`data/vineyard_utils.py:
+15-55`, `csrc/cpu/vineyard_utils.cc:1-247`, behind ``WITH_VINEYARD``).
+This framework does not implement that integration:
 
-Vineyard is not part of this image (and its client is Linux-x86
-specific); the API surface is kept so GraphScope deployments can drop
-in the real client — every function imports lazily and raises with
-guidance otherwise, exactly like the reference's build-time gate.
+  * vineyard's client is not available in TPU-VM images and cannot be
+    validated here; shipping accessor code that has never executed
+    against a real fragment would be pretend-coverage;
+  * the integration's VALUE in the reference is zero-copy handoff from
+    GraphScope's sampling-adjacent services on the same host — a
+    deployment topology that does not exist on TPU pods, where data
+    arrives via GCS/files into host DRAM anyway.
+
+Supported ingestion paths with the same outcome (arrays into
+`Dataset.init_graph` / `init_node_features` / `init_edge_features`):
+
+  * `graphlearn_tpu.data.table_dataset` — csv / npz / ODPS-style
+    record readers (reference `TableDataset` parity);
+  * any numpy/arrow pipeline producing ``(rows, cols)`` +
+    ``[N, D]`` / ``[E, D]`` arrays.
+
+The reference API names are kept as explicit tombstones so a
+GraphScope user gets actionable guidance instead of an AttributeError.
 """
 from __future__ import annotations
 
-from typing import List, Tuple
-
-import numpy as np
-
-
-def _client():
-  try:
-    import vineyard  # noqa: F401
-    return vineyard
-  except ImportError as e:
-    raise ImportError(
-        'vineyard is not installed; these connectors need a GraphScope '
-        'deployment (pip install vineyard-graphlearn or use '
-        'CsvTableReader/NpzTableReader ingestion instead)') from e
+_MSG = ('vineyard/GraphScope integration is a documented non-goal of '
+        'graphlearn_tpu (no vineyard client on TPU-VM images; see '
+        'data/vineyard_utils.py for rationale). Export the fragment '
+        'to numpy/npz and use Dataset.init_graph / '
+        'data.table_dataset readers instead.')
 
 
-def vineyard_to_csr(sock: str, object_id: str, v_label: int, e_label: int,
-                    edge_dir: str = 'out'
-                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-  """CSR of one (vertex-label, edge-label) fragment
-  (reference ``vineyard_to_csr``, `py_export.cc:52-56`)."""
-  vy = _client()
-  client = vy.connect(sock)
-  frag = client.get(vy.ObjectID(object_id))
-  raise NotImplementedError(
-      f'wire the GraphScope fragment accessors for {type(frag)} here; '
-      'the TPU data plane consumes (indptr, indices, edge_ids) numpy '
-      'arrays via CSRTopo')
+def vineyard_to_csr(*args, **kwargs):
+  """Reference ``vineyard_to_csr`` (`py_export.cc:52-56`): non-goal."""
+  raise NotImplementedError(_MSG)
 
 
-def load_vertex_feature_from_vineyard(sock: str, object_id: str,
-                                      cols: List[str], v_label: int
-                                      ) -> np.ndarray:
-  """Vertex feature columns (reference ``LoadVertexFeatures``)."""
-  _client()
-  raise NotImplementedError(
-      'map the fragment vertex table columns to a [N, D] numpy array '
-      'and feed Dataset.init_node_features')
+def load_vertex_feature_from_vineyard(*args, **kwargs):
+  """Reference ``LoadVertexFeatures``: non-goal."""
+  raise NotImplementedError(_MSG)
 
 
-def load_edge_feature_from_vineyard(sock: str, object_id: str,
-                                    cols: List[str], e_label: int
-                                    ) -> np.ndarray:
-  """Edge feature columns (reference ``LoadEdgeFeatures``)."""
-  _client()
-  raise NotImplementedError(
-      'map the fragment edge table columns to a [E, D] numpy array '
-      'and feed Dataset.init_edge_features')
+def load_edge_feature_from_vineyard(*args, **kwargs):
+  """Reference ``LoadEdgeFeatures``: non-goal."""
+  raise NotImplementedError(_MSG)

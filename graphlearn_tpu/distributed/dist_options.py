@@ -107,7 +107,16 @@ class MpDistSamplingWorkerOptions:
   channel_size: Union[int, str, None] = None  # default 64MB * num_workers
   collect_features: bool = True
   pin_memory: bool = False              # accepted for API parity; no-op
-  mp_start_method: str = 'fork'         # producers are numpy-only
+  #: 'forkserver' (default): workers descend from a clean, unthreaded
+  #: server process; the dataset is staged into POSIX shm once and
+  #: attached zero-copy per worker (`shm_arrays.share_dataset`).
+  #: 'fork' is opt-in zero-copy CoW — SAFE ONLY IF the parent is
+  #: effectively single-threaded at Process.start() time: JAX/XLA
+  #: spawn runtime threads at first backend use, and a fork can
+  #: inherit their held locks mid-operation (undebuggable child
+  #: deadlocks; the CPython DeprecationWarning).  'spawn' also works
+  #: (slower startup, shm staging as forkserver).
+  mp_start_method: str = 'forkserver'
 
   def resolved_capacity(self) -> int:
     return (self.channel_capacity if self.channel_capacity is not None

@@ -4,9 +4,18 @@ Reference `distributed/dist_sampling_producer.py:52-328`:
 ``DistMpSamplingProducer`` spawns N sampling workers which consume
 SAMPLE_ALL commands from a task queue, iterate their seed slice, and
 push messages into the shm channel; ``DistCollocatedSamplingProducer``
-does the same synchronously in-process.  Here the workers are
-numpy/native-only (no device), started with ``fork`` so the graph and
-feature arrays are inherited copy-on-write.
+does the same synchronously in-process.  The workers are numpy/native-
+only (no device).
+
+Start method: ``forkserver`` by default — workers descend from a clean
+server process with no JAX threads (fork-after-JAX can inherit held
+runtime locks and deadlock, the CPython DeprecationWarning), and the
+dataset crosses the boundary through POSIX shared memory
+(`shm_arrays.share_dataset`: one copy at init, zero per worker).
+``fork`` remains opt-in via ``MpDistSamplingWorkerOptions.
+mp_start_method`` for callers whose parent process is known
+single-threaded at spawn time (the copy-on-write zero-copy path);
+safety invariant documented there.
 """
 from __future__ import annotations
 
@@ -74,6 +83,12 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
                           sampling_config=None):
   """Body of one sampling subprocess (reference `_sampling_worker_loop`,
   `dist_sampling_producer.py:52-144`)."""
+  from .shm_arrays import SharedDatasetHandle
+  segs = None
+  if isinstance(dataset, SharedDatasetHandle):
+    # non-fork start: attach zero-copy shm views; hold the segments
+    # for the process lifetime
+    dataset, segs = dataset.materialize()  # noqa: F841 — keepalive
   sampler = _make_sampler(dataset, fanouts, with_edge, collect_features,
                           seed * 7919 + rank)
   while True:
@@ -126,11 +141,18 @@ class MpSamplingProducer:
     self.current_epoch = -1      # stamp of the last dispatched epoch
 
   def init(self) -> None:
+    ds_arg = self.ds
+    self._shm_segs = None
+    if self._ctx.get_start_method() != 'fork':
+      # stage the dataset into POSIX shm once; workers attach
+      # zero-copy instead of unpickling a full copy each
+      from .shm_arrays import share_dataset
+      ds_arg, self._shm_segs = share_dataset(self.ds)
     for r in range(self.opts.num_workers):
       tq = self._ctx.Queue()
       w = self._ctx.Process(
           target=_sampling_worker_loop,
-          args=(r, self.ds, self.fanouts, self.with_edge,
+          args=(r, ds_arg, self.fanouts, self.with_edge,
                 self.opts.collect_features, self.channel, tq, self._seed,
                 self.sampling_config),
           daemon=True)
@@ -188,6 +210,10 @@ class MpSamplingProducer:
         w.terminate()
     self._workers = []
     self._task_queues = []
+    if getattr(self, '_shm_segs', None):
+      from .shm_arrays import release
+      release(self._shm_segs)
+      self._shm_segs = None
 
 
 class CollocatedSamplingProducer:

@@ -1,5 +1,6 @@
 from .transform import Batch, HeteroBatch, to_data, to_hetero_data
 from .node_loader import NodeLoader, SeedBatcher
+from .prefetch import PrefetchIterator
 from .neighbor_loader import NeighborLoader
 from .link_loader import EdgeSeedBatcher, LinkLoader, LinkNeighborLoader
 from .subgraph_loader import SubGraphLoader

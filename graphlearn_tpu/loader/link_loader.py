@@ -23,6 +23,7 @@ from ..sampler.base import (BaseSampler, EdgeSamplerInput, NegativeSampling,
                             SamplerOutput)
 from ..utils.padding import INVALID_ID, pad_1d
 from .node_loader import SeedBatcher
+from .prefetch import PrefetchingLoader
 from .transform import Batch, collate
 
 
@@ -43,22 +44,19 @@ class EdgeSeedBatcher:
     return len(self._idx)
 
   def __iter__(self):
-    self._it = iter(self._idx)
-    return self
-
-  def __next__(self):
-    idx = next(self._it)
-    valid = idx >= 0
-    safe = np.where(valid, idx, 0)
-    r = np.where(valid, self.rows[safe], INVALID_ID).astype(np.int32)
-    c = np.where(valid, self.cols[safe], INVALID_ID).astype(np.int32)
-    lab = None
-    if self.labels is not None:
-      lab = np.where(valid, self.labels[safe], 0)
-    return r, c, lab
+    """Epoch-private iterator (see `SeedBatcher.__iter__`)."""
+    for idx in self._idx:
+      valid = idx >= 0
+      safe = np.where(valid, idx, 0)
+      r = np.where(valid, self.rows[safe], INVALID_ID).astype(np.int32)
+      c = np.where(valid, self.cols[safe], INVALID_ID).astype(np.int32)
+      lab = None
+      if self.labels is not None:
+        lab = np.where(valid, self.labels[safe], 0)
+      yield r, c, lab
 
 
-class LinkLoader:
+class LinkLoader(PrefetchingLoader):
   """Base link loader: seed edges → sampler.sample_from_edges → collate.
 
   Args:
@@ -72,7 +70,8 @@ class LinkLoader:
   def __init__(self, data: Dataset, sampler: BaseSampler, edge_label_index,
                edge_label=None, neg_sampling=None, batch_size: int = 1,
                shuffle: bool = False, drop_last: bool = False,
-               seed: Optional[int] = None, **kwargs):
+               seed: Optional[int] = None, prefetch: int = 0, **kwargs):
+    self.prefetch = int(prefetch)
     self.data = data
     self.sampler = sampler
     self.input_type = None
@@ -96,11 +95,10 @@ class LinkLoader:
     return len(self._batcher)
 
   def __iter__(self) -> Iterator[Batch]:
-    self._it = iter(self._batcher)
-    return self
+    return self._start_epoch(iter(self._batcher))
 
-  def __next__(self) -> Batch:
-    r, c, lab = next(self._it)
+  def _produce(self, seed_iter) -> Batch:
+    r, c, lab = next(seed_iter)
     if lab is not None and self.neg_sampling is not None \
         and self.neg_sampling.is_binary():
       # Reference +1 shift: user labels move up, 0 = negative class

@@ -17,6 +17,7 @@ from ..data.dataset import Dataset
 from ..sampler.base import BaseSampler, NodeSamplerInput
 from ..utils.padding import INVALID_ID, pad_1d
 from ..utils.profiling import metrics, trace
+from .prefetch import PrefetchingLoader
 from .transform import Batch, collate
 
 
@@ -36,8 +37,6 @@ class SeedBatcher:
     self.shuffle = shuffle
     self.drop_last = drop_last
     self._rng = np.random.default_rng(seed)
-    self._epoch_order = None
-    self._pos = 0
 
   def __len__(self) -> int:
     n = len(self.seeds)
@@ -45,36 +44,35 @@ class SeedBatcher:
       return n // self.batch_size
     return -(-n // self.batch_size)
 
-  def reset(self):
-    self._epoch_order = (self._rng.permutation(len(self.seeds))
-                         if self.shuffle else np.arange(len(self.seeds)))
-    self._pos = 0
-
   def __iter__(self):
-    self.reset()
-    return self
-
-  def __next__(self) -> np.ndarray:
+    """Each epoch is a PRIVATE iterator (own order, own position):
+    an abandoned consumer — e.g. an orphaned prefetch worker — can
+    never steal batches from a later epoch."""
     n = len(self.seeds)
-    if self._pos >= n:
-      raise StopIteration
-    end = self._pos + self.batch_size
-    if end > n and self.drop_last:
-      raise StopIteration
-    idx = self._epoch_order[self._pos:end]
-    self._pos = end
-    batch = self.seeds[idx].astype(np.int32)
-    if len(batch) < self.batch_size:
-      if batch.ndim > 1:
-        pad = np.full((self.batch_size - len(batch),) + batch.shape[1:],
-                      INVALID_ID, batch.dtype)
-        batch = np.concatenate([batch, pad])
-      else:
-        batch = pad_1d(batch, self.batch_size, INVALID_ID)
-    return batch
+    order = (self._rng.permutation(n) if self.shuffle
+             else np.arange(n))
+    return self._epoch(order)
+
+  def _epoch(self, order: np.ndarray):
+    n = len(self.seeds)
+    pos = 0
+    while pos < n:
+      end = pos + self.batch_size
+      if end > n and self.drop_last:
+        return
+      batch = self.seeds[order[pos:end]].astype(np.int32)
+      pos = end
+      if len(batch) < self.batch_size:
+        if batch.ndim > 1:
+          pad = np.full((self.batch_size - len(batch),) + batch.shape[1:],
+                        INVALID_ID, batch.dtype)
+          batch = np.concatenate([batch, pad])
+        else:
+          batch = pad_1d(batch, self.batch_size, INVALID_ID)
+      yield batch
 
 
-class NodeLoader:
+class NodeLoader(PrefetchingLoader):
   """Base loader: seeds → sampler → collate.
 
   Args:
@@ -83,12 +81,17 @@ class NodeLoader:
     input_nodes: ``[N]`` seed ids (e.g. the train split).
     batch_size / shuffle / drop_last: epoch iteration controls.
     seed: shuffling seed.
+    prefetch: batches prepared ahead on a worker thread (0 = off;
+      2 = double buffering — overlaps the next batch's host-side
+      sampling + cold-tier gather + transfer dispatch with the current
+      device step; see `loader.prefetch.PrefetchIterator`).
   """
 
   def __init__(self, data: Dataset, sampler: BaseSampler, input_nodes,
                batch_size: int = 1, shuffle: bool = False,
                drop_last: bool = False, seed: Optional[int] = None,
-               **kwargs):
+               prefetch: int = 0, **kwargs):
+    self.prefetch = int(prefetch)
     self.data = data
     self.sampler = sampler
     self.input_type = None
@@ -107,11 +110,10 @@ class NodeLoader:
     return len(self._batcher)
 
   def __iter__(self) -> Iterator[Batch]:
-    self._seed_iter = iter(self._batcher)
-    return self
+    return self._start_epoch(iter(self._batcher))
 
-  def __next__(self) -> Batch:
-    seeds = next(self._seed_iter)
+  def _produce(self, seed_iter) -> Batch:
+    seeds = next(seed_iter)
     with trace('loader.sample'):
       out = self.sampler.sample_from_nodes(
           NodeSamplerInput(node=seeds, input_type=self.input_type))

@@ -1,0 +1,135 @@
+"""Background-thread batch prefetch (double buffering).
+
+The cold-tier feature path host-gathers rows and ``device_put``s them
+inside the batch critical path (`data/feature.py:156-187`) — the
+synchronous analog of the reference's UVA reads
+(`csrc/cuda/unified_tensor.cu:202+`), which overlap with GPU compute
+for free.  `PrefetchIterator` restores that overlap on TPU: a worker
+thread runs the loader's host work (sampling prep, cold gather, the
+async ``device_put`` dispatch) for the NEXT batch while the caller's
+current step executes on device.  JAX dispatch is thread-safe and
+async, so the handed-over batch is already in flight when the consumer
+receives it.
+
+Loaders expose this as ``prefetch=N`` (0 = off, the synchronous
+default; 2 = classic double buffering).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+
+class PrefetchingLoader:
+  """Mixin: epoch iteration with optional background prefetch.
+
+  Subclasses implement ``_produce(seed_iter)`` (one batch or raise
+  StopIteration) and call ``_start_epoch(iter(batcher))`` from
+  ``__iter__``.  Guarantees: each epoch runs on a PRIVATE seed
+  iterator, and starting a new epoch closes the previous epoch's
+  worker — an abandoned ``prefetch > 0`` epoch can neither steal the
+  next epoch's batches nor leak its thread.
+  """
+
+  prefetch: int = 0
+
+  def _start_epoch(self, seed_iter):
+    prev = getattr(self, '_active_prefetch', None)
+    if prev is not None:
+      prev.close()
+    self._active_prefetch = None
+    self._seed_iter = seed_iter
+    if self.prefetch:
+      it = PrefetchIterator(self._epoch_gen(seed_iter), self.prefetch)
+      self._active_prefetch = it
+      return it
+    return self
+
+  def _epoch_gen(self, seed_iter):
+    while True:
+      try:
+        yield self._produce(seed_iter)
+      except StopIteration:
+        return
+
+  def __next__(self):
+    return self._produce(self._seed_iter)
+
+  def _produce(self, seed_iter):
+    raise NotImplementedError
+
+
+class _Failure:
+  """Exception holder crossing the thread boundary."""
+
+  def __init__(self, exc: BaseException):
+    self.exc = exc
+
+
+class PrefetchIterator:
+  """Iterate ``it`` on a daemon worker thread, ``depth`` items ahead.
+
+  Exceptions raised by the producer re-raise at the consumer's
+  ``__next__``; abandoning the iterator mid-epoch stops the worker
+  (the bounded queue is polled against a stop flag, so the thread
+  never blocks forever on a reader that went away).
+  """
+
+  _DONE = object()
+
+  def __init__(self, it: Iterator, depth: int = 2):
+    self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+    self._stop = threading.Event()
+    self._thread = threading.Thread(
+        target=self._run, args=(it,), daemon=True,
+        name='glt-prefetch')
+    self._thread.start()
+
+  def _run(self, it) -> None:
+    try:
+      for item in it:
+        if not self._put(item):
+          return
+      self._put(self._DONE)
+    except BaseException as e:           # noqa: B036 — forwarded
+      self._put(_Failure(e))
+
+  def _put(self, item) -> bool:
+    while not self._stop.is_set():
+      try:
+        self._q.put(item, timeout=0.1)
+        return True
+      except queue.Full:
+        continue
+    return False
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    if self._stop.is_set():
+      raise StopIteration
+    item = self._q.get()
+    if item is self._DONE:
+      self._stop.set()
+      raise StopIteration
+    if isinstance(item, _Failure):
+      self._stop.set()
+      raise item.exc
+    return item
+
+  def close(self) -> None:
+    """Stop the worker and drop buffered batches."""
+    self._stop.set()
+    try:
+      while True:
+        self._q.get_nowait()
+    except queue.Empty:
+      pass
+
+  def __del__(self):
+    try:
+      self.close()
+    except Exception:
+      pass

@@ -41,8 +41,8 @@ class SubGraphLoader(NodeLoader):
                      **kwargs)
     self.max_degree = max_degree
 
-  def __next__(self) -> Batch:
-    seeds = next(self._seed_iter)
+  def _produce(self, seed_iter) -> Batch:
+    seeds = next(seed_iter)
     out = self.sampler.subgraph(NodeSamplerInput(node=seeds),
                                 max_degree=self.max_degree)
     return self._collate_fn(out)

@@ -12,9 +12,11 @@ body runs, and rows stream straight into the VMEM output block.
 Measured on TPU v5e (2.45M x 128 f32 table, 16k-row gather): the DMA
 kernel runs at parity with XLA's native row gather (~0.4 TB/s both,
 tile=32-64 best), so this kernel is kept as the explicit, tunable
-form of the hot-path access — and as the building block for the
-distributed feature exchange, where the same per-row DMA targets
-remote chips via `make_async_remote_copy`.
+form of the hot-path access.  The remote-chip variant of the same
+per-row DMA — owners pushing requested rows straight into requester
+buffers via `make_async_remote_copy` — is implemented and
+interpret-validated in `parallel/rdma_gather.py` (perf qualification
+needs a >= 2-chip slice; the engines default to XLA all_to_all).
 
 Constraints discovered on real hardware (Mosaic tiling rules):
   * Row DMA slices must be lane-aligned: ``D % 128 == 0`` for f32/i32.

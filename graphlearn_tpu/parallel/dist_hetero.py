@@ -329,9 +329,11 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
     feat_nts = tuple(sorted(arrs['feats'])) if self.collect_features else ()
     label_nts = tuple(sorted(arrs['labels']))
     efeat_ets = tuple(sorted(arrs['efeats']))
-    ef_shard_mode = ('mod' if all(
-        self.ds.edge_features[et].mod_sharded for et in efeat_ets)
-        else 'range')
+    # per-TABLE ownership scheme: a mixed mod/range edge_features dict
+    # must not collapse to one global mode (wrong-owner gathers return
+    # silent zeros)
+    ef_modes = {et: ('mod' if self.ds.edge_features[et].mod_sharded
+                     else 'range') for et in efeat_ets}
     num_hops = self.num_hops
     exchange_slack = self.exchange_slack
 
@@ -470,7 +472,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
             (efshards[et],), ebounds[et], all_eids, axis, num_parts,
             exchange_capacity=_slack_cap(all_eids.shape[0], num_parts,
                                          exchange_slack),
-            shard_mode=ef_shard_mode)
+            shard_mode=ef_modes[et])
         ft_stats = ft_stats + jnp.stack(gstats)
 
       neg_lost = (jnp.sum((~neg_ok).astype(jnp.int32))

@@ -1,0 +1,121 @@
+"""Remote-DMA feature exchange prototype (Pallas `make_async_remote_copy`).
+
+The production mesh feature gather (`dist_sampler.dist_gather_multi`)
+answers row requests with a dense reply `all_to_all`: every owner
+first MATERIALIZES its reply rows into a local [P*C, D] buffer, then
+XLA ships it.  This kernel instead pushes each requested row straight
+from the owner's HBM table into the REQUESTER's receive buffer over
+ICI — per-row RDMA, no owner-side reply materialization (one less
+full-payload HBM round trip), fusing the reply exchange and the
+stitch-source layout:
+
+  requester r's receive buffer is ``[P, C, D]``; owner ``o`` writes
+  row ``j`` of r's requests directly at ``recv[o, j]`` — exactly the
+  layout the stitch gather reads.
+
+Every (owner, slot) pair carries exactly one row copy (invalid slots
+push row 0, masked later), so send/receive counts are static and the
+completion waits are symmetric: each device starts P*C sends and waits
+P*C receives of identical byte size.
+
+Status: correctness-validated in Pallas interpret mode on the virtual
+CPU mesh (`tests/test_rdma_gather.py`) and API-complete for real
+slices; it CANNOT be performance-qualified in this environment (one
+physical chip — ICI RDMA needs >= 2), so the production engines keep
+the XLA `all_to_all` path.  On a real slice, drop this function in
+place of `dist_gather` inside the shard_map body and race the two; the
+bucketing, capacity and masking semantics are identical by
+construction (shared `bucket_by_owner`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .dist_sampler import bucket_by_owner
+
+
+def _push_rows_kernel(num_parts: int, axis: str):
+  """Kernel body: push each requested row to its requester's buffer."""
+
+  def kernel(ids_ref, start_ref, shard_ref, out_ref, send_sem, recv_sem):
+    my = jax.lax.axis_index(axis)
+    rows_max = shard_ref.shape[0]
+    c = ids_ref.shape[1]
+    for r in range(num_parts):          # requester
+      for j in range(c):                # its slot on me
+        rid = ids_ref[r, j]
+        local = jnp.clip(rid - start_ref[0], 0, rows_max - 1)
+        pltpu.make_async_remote_copy(
+            src_ref=shard_ref.at[local],
+            dst_ref=out_ref.at[my, j],
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=r,
+            device_id_type=pltpu.DeviceIdType.LOGICAL).start()
+    # symmetric completion: P*C identical-size sends out, P*C in.
+    # Any same-shape descriptor drains the matching semaphore bytes.
+    for r in range(num_parts):
+      for j in range(c):
+        d = pltpu.make_async_remote_copy(
+            src_ref=shard_ref.at[0], dst_ref=out_ref.at[r, j],
+            send_sem=send_sem, recv_sem=recv_sem, device_id=r,
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        d.wait_send()
+        d.wait_recv()
+
+  return kernel
+
+
+def rdma_gather(shard_loc, bounds, ids, axis: str, num_parts: int,
+                exchange_capacity: Optional[int] = None,
+                interpret: Optional[bool] = None):
+  """Distributed row gather with an RDMA reply path.
+
+  Drop-in analog of `dist_sampler.dist_gather` (range-sharded tables):
+  the request ids still travel by one small `all_to_all`; the reply
+  rows travel by per-row remote DMA.  Call INSIDE shard_map over
+  ``axis``.  Returns ``[len(ids), D]`` rows (zero rows for invalid /
+  dropped ids).
+  """
+  if interpret is None:
+    interpret = jax.default_backend() != 'tpu'
+  if interpret is True:
+    # 'on_wait' (the default) only executes a pending copy when a wait
+    # matches it on the SENDING side; our completion waits are
+    # byte-symmetric, not descriptor-matched, so force eager data
+    # movement (hardware semaphores count bytes, matching the
+    # symmetric waits natively)
+    interpret = pltpu.InterpretParams(dma_execution_mode='eager')
+  my_idx = jax.lax.axis_index(axis)
+  my_start = bounds[my_idx]
+  owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(
+      jnp.int32)
+  send, slot_p, slot_j = bucket_by_owner(ids, owner, num_parts, my_idx,
+                                         exchange_capacity)
+  c = send.shape[1]
+  recv_ids = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)  # [P, C]
+  d = shard_loc.shape[1]
+
+  recv = pl.pallas_call(
+      _push_rows_kernel(num_parts, axis),
+      in_specs=[
+          pl.BlockSpec(memory_space=pltpu.SMEM),       # ids [P, C]
+          pl.BlockSpec(memory_space=pltpu.SMEM),       # my_start [1]
+          pl.BlockSpec(memory_space=pl.ANY),        # shard
+      ],
+      out_specs=pl.BlockSpec(memory_space=pl.ANY),  # recv [P, C, D]
+      out_shape=jax.ShapeDtypeStruct((num_parts, c, d), shard_loc.dtype),
+      scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+      compiler_params=pltpu.CompilerParams(has_side_effects=True),
+      interpret=interpret,
+  )(recv_ids.astype(jnp.int32), my_start[None].astype(jnp.int32),
+    shard_loc)
+
+  kept = slot_j >= 0
+  out = recv[slot_p, jnp.where(kept, slot_j, 0)]
+  ok = (ids >= 0) & kept
+  return jnp.where(ok[:, None], out, 0)

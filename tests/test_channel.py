@@ -37,6 +37,12 @@ def _producer_proc(ch, n_msgs):
              'val': np.full((2, 3), float(i), np.float32)})
 
 
+def _stress_producer(ch, rank, per):
+  for i in range(per):
+    ch.send({'tag': np.array([rank, i], np.int64),
+             'pay': np.full(64, rank * 1000 + i, np.int32)})
+
+
 class TestShmChannel:
   def test_roundtrip_same_process(self):
     ch = ShmChannel(capacity=4, shm_size='1MB')
@@ -52,7 +58,7 @@ class TestShmChannel:
 
   def test_cross_process(self):
     ch = ShmChannel(capacity=4, shm_size='1MB')
-    ctx = mp.get_context('fork')
+    ctx = mp.get_context('forkserver')
     p = ctx.Process(target=_producer_proc, args=(ch, 6), daemon=True)
     p.start()
     for i in range(6):
@@ -192,26 +198,29 @@ def test_shm_queue_mpmc_stress():
   import threading
   ch = ShmChannel(capacity=8, shm_size='2MB')
   n_producers, per = 4, 50
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   procs = []
   for r in range(n_producers):
-    def body(rank=r):
-      for i in range(per):
-        ch.send({'tag': np.array([rank, i], np.int64),
-                 'pay': np.full(64, rank * 1000 + i, np.int32)})
-    p = ctx.Process(target=body, daemon=True)
+    # module-level target: forkserver children pickle their target
+    p = ctx.Process(target=_stress_producer, args=(ch, r, per),
+                    daemon=True)
     p.start()
     procs.append(p)
 
+  import time
   got, lock = [], threading.Lock()
+  # deadline-based: forkserver children re-import the package (seconds
+  # of startup before the first send), so a short single-recv timeout
+  # would bail early; the count check still exits promptly when done
+  deadline = time.time() + 120
   def consume():
-    while True:
+    while time.time() < deadline:
       with lock:
         if len(got) >= n_producers * per:
           return
-      m = ch.recv_timeout(2.0)
+      m = ch.recv_timeout(0.5)
       if m is None:
-        return
+        continue
       with lock:
         got.append(m)
 

@@ -159,7 +159,7 @@ def _hetero_server_proc(port_q):
 def test_remote_hetero_loader():
   """Client passes dataset=None: capacities come from the server's
   hetero dataset meta."""
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   port_q = ctx.Queue()
   p = ctx.Process(target=_hetero_server_proc, args=(port_q,),
                   daemon=False)

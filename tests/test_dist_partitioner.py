@@ -50,7 +50,7 @@ def _rank_main(rank, world, port, out_dir, n):
 def test_dist_partition_layout(world, tmp_path):
   n = 60
   port = _free_port()
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   procs = [ctx.Process(target=_rank_main, args=(r, world, port,
                                                 str(tmp_path), n))
            for r in range(world)]
@@ -106,7 +106,7 @@ def test_matches_seeded_book(tmp_path):
     expect[lo:hi] = rng.integers(0, world, hi - lo, dtype=np.int8)
 
   port = _free_port()
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   procs = [ctx.Process(target=_rank_main, args=(r, world, port,
                                                 str(tmp_path), n))
            for r in range(world)]
@@ -144,7 +144,7 @@ def _table_rank_main(rank, world, port, out_dir, n):
 def test_dist_table_partitioner(tmp_path):
   n, world = 40, 2
   port = _free_port()
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   procs = [ctx.Process(target=_table_rank_main,
                        args=(r, world, port, str(tmp_path), n))
            for r in range(world)]

@@ -34,7 +34,7 @@ def _server_proc(port_q):
 
 def test_multi_server_fanout():
   """List-valued server_rank spreads one loader across servers."""
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   procs, ports = [], []
   for _ in range(2):
     q = ctx.Queue()
@@ -68,7 +68,7 @@ def test_multi_server_fanout():
 
 
 def test_remote_loader_epochs():
-  ctx = mp.get_context('fork')
+  ctx = mp.get_context('forkserver')
   port_q = ctx.Queue()
   # non-daemonic: the server itself spawns producer subprocesses
   p = ctx.Process(target=_server_proc, args=(port_q,), daemon=False)
