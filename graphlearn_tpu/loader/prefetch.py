@@ -37,7 +37,11 @@ class PrefetchingLoader:
   def _start_epoch(self, seed_iter):
     prev = getattr(self, '_active_prefetch', None)
     if prev is not None:
+      # close AND join: the old worker may be mid-_produce, and two
+      # workers on one loader would race the sampler's stateful PRNG
+      # key counter (non-reproducible batches)
       prev.close()
+      prev.join()
     self._active_prefetch = None
     self._seed_iter = seed_iter
     if self.prefetch:
@@ -127,6 +131,10 @@ class PrefetchIterator:
         self._q.get_nowait()
     except queue.Empty:
       pass
+
+  def join(self, timeout: float = None) -> None:
+    """Wait for the worker thread to exit (call after `close`)."""
+    self._thread.join(timeout)
 
   def __del__(self):
     try:

@@ -1,0 +1,74 @@
+"""Uniform random walks over the device CSR.
+
+Beyond-parity op: the reference reserves ``SamplingType.RANDOM_WALK``
+(`sampler/base.py:325-331`) but never implements a walker; the
+BASELINE north star names random-walk sampling as a first-class kernel
+(DeepWalk/node2vec-style corpus generation).  TPU-native shape: one
+`lax.scan` over walk steps, each step a fused (degree lookup, uniform
+draw, neighbor gather) over the whole walk batch — static ``[B, L+1]``
+output, INVALID_ID once a walk hits a dead end (matching the padding
+convention everywhere else).
+
+``restart_prob`` adds DeepWalk-with-restart semantics (walks jump back
+to their start node with the given probability each step).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.padding import INVALID_ID
+
+
+@functools.partial(jax.jit,
+                   static_argnames=('walk_length', 'restart_prob'))
+def random_walk(indptr: jax.Array, indices: jax.Array, starts: jax.Array,
+                key: jax.Array, *, walk_length: int,
+                restart_prob: float = 0.0) -> jax.Array:
+  """``[B, walk_length + 1]`` node ids; column 0 = ``starts``.
+
+  Invalid starts (< 0) and dead-end continuations emit INVALID_ID for
+  the rest of the walk.  Each step draws uniformly from the current
+  node's out-neighbors.
+  """
+  b = starts.shape[0]
+  starts = starts.astype(jnp.int32)
+  n = indptr.shape[0] - 1
+
+  def step(cur, k):
+    kk, kr = jax.random.split(k)
+    valid = cur >= 0
+    v = jnp.clip(cur, 0, n - 1)
+    lo = indptr[v]
+    deg = (indptr[v + 1] - lo).astype(jnp.int32)
+    u = jax.random.randint(kk, (b,), 0, jnp.maximum(deg, 1))
+    pos = jnp.clip(lo + u, 0, indices.shape[0] - 1)
+    nxt = jnp.where(valid & (deg > 0), indices[pos].astype(jnp.int32),
+                    INVALID_ID)
+    if restart_prob > 0.0:
+      jump = jax.random.uniform(kr, (b,)) < restart_prob
+      nxt = jnp.where(jump & valid, starts, nxt)
+    return nxt, nxt
+
+  keys = jax.random.split(key, walk_length)
+  _, path = jax.lax.scan(step, starts, keys)
+  return jnp.concatenate([starts[None], path]).T
+
+
+def walk_edges(walks: jax.Array, window: int = 1):
+  """Skip-gram (src, dst) pairs from walks: every ordered pair within
+  ``window`` hops on each walk — the corpus DeepWalk/node2vec trains
+  on.  Returns ``(src, dst)`` of shape ``[B * L' ]`` with INVALID_ID
+  where either endpoint is invalid."""
+  b, l = walks.shape
+  srcs, dsts = [], []
+  for off in range(1, window + 1):
+    srcs.append(walks[:, :l - off].reshape(-1))
+    dsts.append(walks[:, off:].reshape(-1))
+  src = jnp.concatenate(srcs)
+  dst = jnp.concatenate(dsts)
+  ok = (src >= 0) & (dst >= 0)
+  return jnp.where(ok, src, INVALID_ID), jnp.where(ok, dst, INVALID_ID)

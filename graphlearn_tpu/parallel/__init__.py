@@ -10,6 +10,7 @@ from .dist_hetero import (DistHeteroDataset, DistHeteroLinkNeighborLoader,
                           DistHeteroNeighborSampler)
 from .dist_sampler import (DistLinkNeighborLoader, DistLinkNeighborSampler,
                            DistNeighborLoader, DistNeighborSampler,
+                           DistRandomWalker,
                            DistSubGraphLoader, DistSubGraphSampler,
                            bucket_by_owner, dist_edge_exists, dist_gather,
                            dist_sample_negative)

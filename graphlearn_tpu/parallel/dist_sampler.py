@@ -871,6 +871,41 @@ class DistNeighborSampler(ExchangeTelemetry):
                 num_sampled_nodes=nsn, batch=seeds_dev)
 
 
+def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
+                         axis: str = 'data',
+                         exchange_slack: Optional[float] = None):
+  """Jitted SPMD uniform random walk over the sharded CSR: each step
+  is one `_dist_one_hop` with fanout 1 (a uniform neighbor draw
+  through the owner exchange) — the distributed arm of
+  `ops.random_walk` (beyond reference parity; the reference only
+  reserves ``SamplingType.RANDOM_WALK``)."""
+  from .shard_map_compat import shard_map
+
+  def per_device(indptr_s, indices_s, bounds, starts_s, key):
+    cur = starts_s[0].astype(jnp.int32)
+    path = [cur]
+    stats = jnp.zeros((3,), jnp.int32)
+    for h in range(walk_length):
+      nbrs, mask, _, hstats = _dist_one_hop(
+          indptr_s[0], indices_s[0], None, bounds, cur, 1,
+          jax.random.fold_in(key, h), axis, num_parts, False,
+          exchange_capacity=_slack_cap(cur.shape[0], num_parts,
+                                       exchange_slack))
+      stats = stats + jnp.stack(hstats)
+      cur = jnp.where(mask[:, 0], nbrs[:, 0], INVALID_ID).astype(
+          jnp.int32)
+      path.append(cur)
+    walks = jnp.stack(path, axis=1)             # [B, L+1]
+    full = jnp.concatenate(
+        [stats, jnp.zeros((4,), jnp.int32)])
+    return walks[None], full[None]
+
+  specs_in = (P(axis), P(axis), P(), P(axis), P())
+  sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
+                      out_specs=(P(axis), P(axis)))
+  return jax.jit(sharded)
+
+
 class DistSubGraphSampler(DistNeighborSampler):
   """Device-mesh induced-subgraph sampler: multihop closure + one
   full-window distributed hop + local membership/relabel (SEAL at pod
@@ -918,6 +953,62 @@ class DistSubGraphSampler(DistNeighborSampler):
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, seed_local=seed_local, x=x, y=y,
                 num_sampled_nodes=nsn, batch=seeds_dev)
+
+
+class DistRandomWalker(ExchangeTelemetry):
+  """Device-mesh uniform random walks (DeepWalk-corpus generation over
+  a graph larger than one chip) — see `_make_dist_walk_step`.
+
+  Args:
+    dataset: `DistDataset`.
+    walk_length: steps per walk (output is ``[P, B, L+1]``).
+  """
+
+  def __init__(self, dataset: DistDataset, walk_length: int,
+               mesh: Optional[Mesh] = None, axis: str = 'data',
+               seed: int = 0, exchange_slack='auto'):
+    from .dp import make_mesh
+    self.ds = dataset
+    self.walk_length = int(walk_length)
+    self.num_parts = dataset.num_partitions
+    self.mesh = mesh or make_mesh(self.num_parts, axis)
+    self.axis = axis
+    # walk frontiers are sampled neighbors — near-uniformly owned for
+    # shuffled/random partitions, so the capped default applies
+    self.exchange_slack = resolve_exchange_slack(exchange_slack, True)
+    self._base_key = jax.random.key(seed)
+    self._step_cnt = 0
+    self._steps = {}
+    self._arrays_cache = None
+    self._init_stats()
+
+  def _arrays(self):
+    if self._arrays_cache is None:
+      shard = NamedSharding(self.mesh, P(self.axis))
+      repl = NamedSharding(self.mesh, P())
+      g = self.ds.graph
+      self._arrays_cache = (jax.device_put(g.indptr, shard),
+                            jax.device_put(g.indices, shard),
+                            jax.device_put(g.bounds, repl))
+    return self._arrays_cache
+
+  def walk(self, starts_stacked: np.ndarray) -> jax.Array:
+    """``starts_stacked``: ``[P, B]`` per-device start nodes (relabeled
+    space, -1 padded).  Returns ``[P, B, walk_length + 1]``."""
+    b = starts_stacked.shape[1]
+    if b not in self._steps:
+      self._steps[b] = _make_dist_walk_step(
+          self.mesh, self.num_parts, self.walk_length, self.axis,
+          self.exchange_slack)
+    indptr, indices, bounds = self._arrays()
+    self._step_cnt += 1
+    key = jax.random.fold_in(self._base_key, self._step_cnt)
+    starts = jax.device_put(
+        np.asarray(starts_stacked, np.int32),
+        NamedSharding(self.mesh, P(self.axis)))
+    walks, stats = self._steps[b](indptr, indices, bounds, starts, key)
+    self._accumulate_stats(stats)
+    return walks
 
 
 class DistSubGraphLoader:
