@@ -955,58 +955,49 @@ class DistSubGraphSampler(DistNeighborSampler):
                 num_sampled_nodes=nsn, batch=seeds_dev)
 
 
-class DistRandomWalker(ExchangeTelemetry):
+class DistRandomWalker(DistNeighborSampler):
   """Device-mesh uniform random walks (DeepWalk-corpus generation over
   a graph larger than one chip) — see `_make_dist_walk_step`.
+  Subclasses `DistNeighborSampler` for the shared scaffolding (mesh,
+  key stream, device-array cache, step cache, telemetry).
 
   Args:
     dataset: `DistDataset`.
     walk_length: steps per walk (output is ``[P, B, L+1]``).
+    exchange_slack: default EXACT — a dropped frontier id does not
+      under-sample one hop here, it truncates the walk's whole
+      remainder, and walk frontiers are degree-biased (hotness
+      partitioners concentrate them on few owners), so the loaders'
+      capped default would silently empty the corpus.  Pass a float to
+      opt in where partition balance is known.
   """
 
   def __init__(self, dataset: DistDataset, walk_length: int,
-               mesh: Optional[Mesh] = None, axis: str = 'data',
-               seed: int = 0, exchange_slack='auto'):
-    from .dp import make_mesh
-    self.ds = dataset
+               exchange_slack=None, **kwargs):
+    super().__init__(
+        dataset, [], collect_features=False, with_edge=False,
+        # 'auto' resolves to exact here (see class docstring)
+        exchange_slack=resolve_exchange_slack(exchange_slack, False),
+        **kwargs)
     self.walk_length = int(walk_length)
-    self.num_parts = dataset.num_partitions
-    self.mesh = mesh or make_mesh(self.num_parts, axis)
-    self.axis = axis
-    # walk frontiers are sampled neighbors — near-uniformly owned for
-    # shuffled/random partitions, so the capped default applies
-    self.exchange_slack = resolve_exchange_slack(exchange_slack, True)
-    self._base_key = jax.random.key(seed)
-    self._step_cnt = 0
-    self._steps = {}
-    self._arrays_cache = None
-    self._init_stats()
-
-  def _arrays(self):
-    if self._arrays_cache is None:
-      shard = NamedSharding(self.mesh, P(self.axis))
-      repl = NamedSharding(self.mesh, P())
-      g = self.ds.graph
-      self._arrays_cache = (jax.device_put(g.indptr, shard),
-                            jax.device_put(g.indices, shard),
-                            jax.device_put(g.bounds, repl))
-    return self._arrays_cache
 
   def walk(self, starts_stacked: np.ndarray) -> jax.Array:
     """``starts_stacked``: ``[P, B]`` per-device start nodes (relabeled
     space, -1 padded).  Returns ``[P, B, walk_length + 1]``."""
     b = starts_stacked.shape[1]
-    if b not in self._steps:
-      self._steps[b] = _make_dist_walk_step(
+    cfg = ('walk', b)
+    if cfg not in self._steps:
+      self._steps[cfg] = _make_dist_walk_step(
           self.mesh, self.num_parts, self.walk_length, self.axis,
           self.exchange_slack)
-    indptr, indices, bounds = self._arrays()
+    arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
     starts = jax.device_put(
         np.asarray(starts_stacked, np.int32),
         NamedSharding(self.mesh, P(self.axis)))
-    walks, stats = self._steps[b](indptr, indices, bounds, starts, key)
+    walks, stats = self._steps[cfg](arrs['indptr'], arrs['indices'],
+                                    arrs['bounds'], starts, key)
     self._accumulate_stats(stats)
     return walks
 

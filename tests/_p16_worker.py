@@ -1,0 +1,84 @@
+"""Worker for the P=16 scale smoke test (tests/test_scale_p16.py).
+
+Runs one batch of each mesh engine — node, hetero, induced-subgraph —
+on a 16-device virtual CPU mesh (twice the suite's fixed 8), checking
+output validity so compile + execute beyond P=8 is demonstrated, not
+assumed.
+"""
+import json
+import sys
+
+import numpy as np
+import jax
+
+assert len(jax.devices()) == 16, jax.devices()
+
+from graphlearn_tpu.parallel import (DistDataset, DistHeteroNeighborLoader,
+                                     DistNeighborLoader, DistSubGraphLoader,
+                                     make_mesh)
+from graphlearn_tpu.parallel.dist_hetero import DistHeteroDataset
+
+P = 16
+mesh = make_mesh(P)
+out_file = sys.argv[1]
+report = {}
+
+n = 256
+rng = np.random.default_rng(0)
+rows = np.concatenate([np.arange(n), np.arange(n)])
+cols = np.concatenate([(np.arange(n) + 1) % n, (np.arange(n) + 3) % n])
+feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, 4))
+edge_set = set(zip(rows.tolist(), cols.tolist()))
+
+ds = DistDataset.from_full_graph(P, rows, cols, node_feat=feats,
+                                 num_nodes=n)
+loader = DistNeighborLoader(ds, [3, 2], np.arange(n), batch_size=4,
+                            shuffle=True, mesh=mesh, seed=0)
+b = next(iter(loader))
+node = np.asarray(b.node)
+x = np.asarray(b.x)
+nm = np.asarray(b.node_mask)
+rl, cl = np.asarray(b.edge_index)[:, 0], np.asarray(b.edge_index)[:, 1]
+ok_edges = 0
+for p in range(P):
+  m = np.asarray(b.edge_mask)[p]
+  u = ds.new2old[node[p][cl[p][m]]]
+  v = ds.new2old[node[p][rl[p][m]]]
+  assert (((v - u) % n == 1) | ((v - u) % n == 3)).all()
+  ok_edges += int(m.sum())
+  np.testing.assert_allclose(x[p][nm[p]][:, 0], ds.new2old[node[p][nm[p]]])
+report['node_edges'] = ok_edges
+st = loader.sampler.exchange_stats(tick_metrics=False)
+report['dropped'] = st['dist.frontier.dropped']
+
+hds = DistHeteroDataset.from_full_graph(
+    P, {('u', 'to', 'i'): (rng.integers(0, 96, 384),
+                           rng.integers(0, 64, 384))},
+    node_feat_dict={'u': np.arange(96, dtype=np.float32)[:, None]},
+    num_nodes_dict={'u': 96, 'i': 64})
+hl = DistHeteroNeighborLoader(hds, [2], ('u', np.arange(96)),
+                              batch_size=2, shuffle=True, mesh=mesh,
+                              seed=1)
+hb = next(iter(hl))
+assert np.asarray(hb.node_dict['i']).shape[0] == P
+report['hetero_nodes'] = int(
+    (np.asarray(hb.node_dict['i']) >= 0).sum())
+
+sg = DistSubGraphLoader(ds, [2], np.arange(n), batch_size=2, mesh=mesh,
+                        collect_features=False, seed=2)
+sb = next(iter(sg))
+got = 0
+node_s = np.asarray(sb.node)
+ei = np.asarray(sb.edge_index)
+for p in range(P):
+  m = np.asarray(sb.edge_mask)[p]
+  for i in np.nonzero(m)[0]:
+    u = int(ds.new2old[node_s[p, ei[p, 0, i]]])
+    v = int(ds.new2old[node_s[p, ei[p, 1, i]]])
+    assert (u, v) in edge_set
+    got += 1
+report['subgraph_edges'] = got
+
+with open(out_file, 'w') as f:
+  json.dump(report, f)
+print('P16 OK', report)
