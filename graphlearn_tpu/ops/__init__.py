@@ -4,7 +4,7 @@ from .neighbor import (OneHopResult, cal_nbr_prob, default_window,
                        lookup_degree, sample_one_hop)
 from .negative import NegativeSampleResult, edge_in_csr, sample_negative
 from .pallas_gather import gather_rows, pallas_enabled
-from .random_walk import random_walk, walk_edges
+from .random_walk import node2vec_walk, random_walk, walk_edges
 from .subgraph import SubGraphResult, induced_subgraph
 from .unique import (InducerState, UniqueResult, induce_next, init_node,
                      unique_stable)

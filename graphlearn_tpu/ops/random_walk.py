@@ -37,6 +37,8 @@ def random_walk(indptr: jax.Array, indices: jax.Array, starts: jax.Array,
   b = starts.shape[0]
   starts = starts.astype(jnp.int32)
   n = indptr.shape[0] - 1
+  if indices.shape[0] == 0:     # edgeless graph: keep gathers legal;
+    indices = jnp.zeros((1,), indices.dtype)   # deg==0 masks every row
 
   def step(cur, k):
     kk, kr = jax.random.split(k)
@@ -55,6 +57,72 @@ def random_walk(indptr: jax.Array, indices: jax.Array, starts: jax.Array,
 
   keys = jax.random.split(key, walk_length)
   _, path = jax.lax.scan(step, starts, keys)
+  return jnp.concatenate([starts[None], path]).T
+
+
+@functools.partial(
+    jax.jit, static_argnames=('walk_length', 'p', 'q', 'max_degree'))
+def node2vec_walk(indptr: jax.Array, indices: jax.Array,
+                  starts: jax.Array, key: jax.Array, *,
+                  walk_length: int, p: float = 1.0, q: float = 1.0,
+                  max_degree: int = 64) -> jax.Array:
+  """Second-order (node2vec) biased walks, ``[B, walk_length + 1]``.
+
+  Transition weights from ``cur`` given the previous node ``prev``:
+  ``1/p`` back to ``prev``, ``1`` to common neighbors of ``prev``
+  (distance 1), ``1/q`` otherwise (distance 2) — the node2vec
+  search-bias scheme, computed per step over a static ``max_degree``
+  candidate window with a Gumbel-max draw (no alias tables: the CSR
+  binary search `edge_in_csr` answers the distance-1 test, so the
+  whole walker stays allocation-free under jit).  Rows with more than
+  ``max_degree`` out-edges draw from the first ``max_degree``
+  candidates (choose >= the graph's max degree for exactness;
+  ``CSRTopo.max_degree`` reports it).  Requires within-row-sorted
+  columns (`CSRTopo` sorts).  The first step is uniform.
+  """
+  from .negative import edge_in_csr
+
+  b = starts.shape[0]
+  w = max(int(max_degree), 1)   # zero-size window would crash argmax;
+                                # deg==0 rows are masked to INVALID
+  starts = starts.astype(jnp.int32)
+  n = indptr.shape[0] - 1
+  if indices.shape[0] == 0:     # edgeless graph: keep gathers legal
+    indices = jnp.zeros((1,), indices.dtype)
+  num_edges = indices.shape[0]
+  slot = jnp.arange(w, dtype=jnp.int32)
+
+  def step(carry, k):
+    cur, prev = carry
+    valid = cur >= 0
+    v = jnp.clip(cur, 0, n - 1)
+    lo = indptr[v]
+    deg = (indptr[v + 1] - lo).astype(jnp.int32)
+    pos = jnp.clip(lo[:, None] + slot[None, :], 0, num_edges - 1)
+    cand = indices[pos].astype(jnp.int32)            # [B, W]
+    in_win = slot[None, :] < deg[:, None]
+    prev_b = jnp.broadcast_to(prev[:, None], (b, w))
+    is_back = cand == prev_b
+    is_dist1 = edge_in_csr(indptr, indices,
+                           jnp.where(prev_b >= 0, prev_b, 0
+                                     ).reshape(-1),
+                           cand.reshape(-1)).reshape(b, w)
+    logw = jnp.where(
+        is_back, -jnp.log(jnp.float32(p)),
+        jnp.where(is_dist1, 0.0, -jnp.log(jnp.float32(q))))
+    # first step (prev < 0) is uniform
+    logw = jnp.where(prev[:, None] >= 0, logw, 0.0)
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(k, (b, w), minval=1e-20, maxval=1.0)))
+    score = jnp.where(in_win, logw + g, -jnp.inf)
+    pick = jnp.argmax(score, axis=1)
+    nxt = jnp.where(valid & (deg > 0),
+                    cand[jnp.arange(b), pick], INVALID_ID)
+    return (nxt, cur), nxt
+
+  keys = jax.random.split(key, walk_length)
+  _, path = jax.lax.scan(
+      step, (starts, jnp.full((b,), INVALID_ID, jnp.int32)), keys)
   return jnp.concatenate([starts[None], path]).T
 
 

@@ -69,6 +69,48 @@ def test_walk_edges_window():
   assert pairs == {(0, 1), (1, 2), (0, 2)}
 
 
+def test_node2vec_bias_matches_bruteforce_distribution():
+  """Empirical transition frequencies from a fixed (prev, cur) state
+  must match the node2vec weights (1/p back, 1 to common neighbors,
+  1/q otherwise) within sampling noise."""
+  from graphlearn_tpu.ops import node2vec_walk
+  # cur = 1 with neighbors {0 (=prev), 2 (also neighbor of 0), 3};
+  # prev = 0 with neighbors {1, 2}
+  rows = np.array([0, 0, 1, 1, 1, 2, 3])
+  cols = np.array([1, 2, 0, 2, 3, 1, 1])
+  indptr, indices, _ = coo_to_csr(rows, cols, 4)
+  p, q = 4.0, 0.25
+  # force the walk through (0 -> 1): start at 0; 0's first uniform
+  # step may go to 2, so filter walks whose second node is 1
+  m = 40000
+  walks = np.asarray(node2vec_walk(
+      np.asarray(indptr), np.asarray(indices),
+      np.zeros(m, np.int32), jax.random.key(5), walk_length=2,
+      p=p, q=q, max_degree=4))
+  sel = walks[:, 1] == 1
+  third = walks[sel, 2]
+  cnt = {v: int((third == v).sum()) for v in (0, 2, 3)}
+  total = sum(cnt.values())
+  # weights: back to 0 = 1/p; 2 is a neighbor of 0 = 1; 3 = 1/q
+  wts = np.array([1 / p, 1.0, 1 / q])
+  expect = wts / wts.sum()
+  got = np.array([cnt[0], cnt[2], cnt[3]]) / total
+  np.testing.assert_allclose(got, expect, atol=0.02)
+
+
+def test_node2vec_edges_are_real():
+  from graphlearn_tpu.ops import node2vec_walk
+  indptr, indices, rows, cols = _ring_csr()
+  edge_set = set(zip(rows.tolist(), cols.tolist()))
+  walks = np.asarray(node2vec_walk(
+      np.asarray(indptr), np.asarray(indices),
+      np.arange(32, dtype=np.int32), jax.random.key(6),
+      walk_length=6, p=2.0, q=0.5, max_degree=2))
+  for w in walks:
+    for a, b in zip(w[:-1], w[1:]):
+      assert (int(a), int(b)) in edge_set
+
+
 def test_dist_walker_matches_edge_membership():
   indptr, indices, rows, cols = _ring_csr()
   edge_set = set(zip(rows.tolist(), cols.tolist()))
