@@ -38,6 +38,13 @@ class HostDataset:
                         if node_labels is not None else None)
     self.edge_features = (np.asarray(edge_features)
                           if edge_features is not None else None)
+    #: set by `from_partition_dir`: this dataset is ONE partition's
+    #: shard (local edges only over the global node space).  A plain
+    #: `HostNeighborSampler` refuses such datasets — remote
+    #: neighborhoods would silently come back empty; use
+    #: `HostDistNeighborSampler` with peer services instead.
+    self.node_pb: Optional[np.ndarray] = None
+    self.partition_idx: Optional[int] = None
 
   @property
   def num_nodes(self) -> int:
@@ -103,8 +110,11 @@ class HostDataset:
                                   int(ef.ids.max(initial=-1)) + 1))
       efeats = np.zeros((e_total, ef.feats.shape[1]), ef.feats.dtype)
       efeats[ef.ids] = ef.feats
-    return cls(indptr, indices, edge_ids=eids, node_features=feats,
-               node_labels=labels, edge_features=efeats)
+    ds = cls(indptr, indices, edge_ids=eids, node_features=feats,
+             node_labels=labels, edge_features=efeats)
+    ds.node_pb = np.asarray(p['node_pb'].table)
+    ds.partition_idx = int(partition_idx)
+    return ds
 
 
 class HostHeteroDataset:
@@ -139,6 +149,9 @@ class HostHeteroDataset:
                         (node_labels or {}).items()}
     self.edge_features = {tuple(et): np.asarray(v) for et, v in
                           (edge_features or {}).items()}
+    #: see `HostDataset.node_pb` — here a per-node-type dict.
+    self.node_pb = None
+    self.partition_idx = None
 
   @property
   def edge_types(self):
@@ -226,5 +239,9 @@ class HostHeteroDataset:
       full = np.zeros((e_total, f.feats.shape[1]), f.feats.dtype)
       full[f.ids] = f.feats
       efeats[et] = full
-    return cls(csr, num_nodes, node_features=feats, node_labels=labels,
-               edge_features=efeats)
+    ds = cls(csr, num_nodes, node_features=feats, node_labels=labels,
+             edge_features=efeats)
+    ds.node_pb = {nt: np.asarray(pb.table)
+                  for nt, pb in p['node_pb'].items()}
+    ds.partition_idx = int(partition_idx)
+    return ds

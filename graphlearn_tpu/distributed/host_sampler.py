@@ -105,6 +105,16 @@ class HostNeighborSampler:
   def __init__(self, dataset: HostDataset, num_neighbors: Sequence[int],
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0):
+    if getattr(dataset, 'node_pb', None) is not None and \
+        type(self) is HostNeighborSampler:
+      raise ValueError(
+          'HostDataset is a partition shard (node_pb is set): a '
+          'local-only sampler would silently under-sample remote '
+          'neighborhoods and zero-fill remote features.  Use '
+          'HostDistNeighborSampler (graphlearn_tpu.distributed.'
+          'host_dist_sampler) with peer partition services, the mesh '
+          'engine (graphlearn_tpu.parallel), or load the FULL graph '
+          'via HostDataset.from_dataset.')
     self.ds = dataset
     self.fanouts = [int(k) for k in num_neighbors]
     self.with_edge = with_edge
@@ -118,19 +128,51 @@ class HostNeighborSampler:
       self._batch_idx += 1
     return batch_seed
 
+  # -- overridable data-access hooks (the partition-aware subclass in
+  # `host_dist_sampler.py` reroutes these through peer RPC fan-out) ------
+  def _begin_batch(self) -> None:
+    """Per-batch reset hook (dist subclass clears its eid caches)."""
+
+  def _one_hop(self, frontier: np.ndarray, k: int, hop_seed: int):
+    """Sample ``k`` neighbors of each frontier id; returns
+    ``(nbrs [n,k], mask [n,k], eids [n,k] | None)``."""
+    return native.sample_one_hop(
+        self.ds.indptr, self.ds.indices, frontier, k, seed=hop_seed,
+        edge_ids=self.ds.edge_ids, with_edge_ids=self.with_edge)
+
+  def _gather_node_features(self, ids: np.ndarray) -> np.ndarray:
+    return self.ds.node_features[ids]
+
+  def _gather_node_labels(self, ids: np.ndarray) -> np.ndarray:
+    return self.ds.node_labels[ids]
+
+  def _gather_edge_features(self, eids: np.ndarray) -> np.ndarray:
+    return self.ds.edge_features[eids]
+
+  @property
+  def _has_node_features(self) -> bool:
+    return self.ds.node_features is not None
+
+  @property
+  def _has_node_labels(self) -> bool:
+    return self.ds.node_labels is not None
+
+  @property
+  def _has_edge_features(self) -> bool:
+    return self.ds.edge_features is not None
+
   def _expand(self, seeds: np.ndarray, batch_seed: int):
     """Multi-hop expansion shared by node/link/subgraph modes; returns
     ``(inducer, seed_local, rows, cols, eids, num_sampled)``."""
+    self._begin_batch()
     ind = native.CpuInducer(capacity_hint=max(len(seeds) * 4, 64))
     seed_local = ind.init_nodes(seeds)
     frontier = ind.all_nodes()
     rows_acc, cols_acc, eids_acc = [], [], []
     num_sampled = [ind.num_nodes]
     for h, k in enumerate(self.fanouts):
-      nbrs, mask, eids = native.sample_one_hop(
-          self.ds.indptr, self.ds.indices, frontier, k,
-          seed=batch_seed * 1000003 + h, edge_ids=self.ds.edge_ids,
-          with_edge_ids=self.with_edge)
+      nbrs, mask, eids = self._one_hop(frontier, k,
+                                       batch_seed * 1000003 + h)
       before = ind.num_nodes
       new_nodes, rl, cl = ind.induce_next(frontier, nbrs, mask)
       keep = rl.reshape(-1) >= 0
@@ -162,16 +204,17 @@ class HostNeighborSampler:
     }
     if eids is not None:
       msg['eids'] = eids
-      if (self.collect_features
-          and self.ds.edge_features is not None):
+      if self.collect_features and self._has_edge_features:
         # per-edge feature rows by global eid — the reference's efeats
         # collation (`dist_neighbor_sampler.py:600-673`)
         msg['efeats'] = np.ascontiguousarray(
-            self.ds.edge_features[eids])
-    if self.collect_features and self.ds.node_features is not None:
-      msg['nfeats'] = np.ascontiguousarray(self.ds.node_features[nodes])
-    if self.ds.node_labels is not None:
-      msg['nlabels'] = np.ascontiguousarray(self.ds.node_labels[nodes])
+            self._gather_edge_features(eids))
+    if self.collect_features and self._has_node_features:
+      msg['nfeats'] = np.ascontiguousarray(
+          self._gather_node_features(nodes))
+    if self._has_node_labels:
+      msg['nlabels'] = np.ascontiguousarray(
+          self._gather_node_labels(nodes))
     return msg
 
   def sample_from_nodes(self, seeds: np.ndarray,
@@ -319,6 +362,13 @@ class HostHeteroNeighborSampler:
   def __init__(self, dataset: HostHeteroDataset, num_neighbors,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0):
+    if getattr(dataset, 'node_pb', None) is not None:
+      raise ValueError(
+          'HostHeteroDataset is a partition shard (node_pb is set): a '
+          'local-only sampler would silently under-sample remote '
+          'neighborhoods.  Use the mesh engine '
+          '(graphlearn_tpu.parallel.DistHeteroNeighborSampler) or load '
+          'the FULL graph via HostHeteroDataset.from_dataset.')
     from ..sampler.hetero_neighbor_sampler import normalize_fanouts
     self.ds = dataset
     self.etypes, self.fanouts, self.num_hops = normalize_fanouts(

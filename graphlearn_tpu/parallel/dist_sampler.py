@@ -250,7 +250,8 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
 
 def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
                       exchange_capacity: Optional[int] = None,
-                      shard_mode: str = 'range'):
+                      shard_mode: str = 'range',
+                      hot_counts: Optional[jax.Array] = None):
   """Distributed row gather from several sharded tables that share an
   ownership scheme: ``out_t[i] = table_t[ids[i]]`` (the collective-era
   `DistFeature.async_get`, `distributed/dist_feature.py:134-269`).
@@ -264,6 +265,9 @@ def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
   feature + label collection share a single exchange.  Invalid ids
   (-1) return zero rows; ids past ``exchange_capacity`` per owner
   return zero rows too (callers choosing a capacity accept that tail).
+  ``hot_counts`` (``[P]``, tiered feature stores) marks the FIRST
+  table HBM-partial: rows past the owner's hot count return zero and
+  the caller overlays them from the host cold tier post-step.
   Returns ``(outs, stats)`` with the (offered, dropped, slots)
   telemetry triple.
   """
@@ -289,13 +293,16 @@ def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
   sj = jnp.where(kept, slot_j, 0)
   ok = (ids >= 0) & kept
   outs = []
-  for shard_loc in shard_locs:
+  for t, shard_loc in enumerate(shard_locs):
+    row_valid = valid
+    if t == 0 and hot_counts is not None:
+      row_valid = valid & (local < hot_counts[my_idx])
     idx = jnp.clip(local, 0, shard_loc.shape[0] - 1)
     rows = shard_loc[idx]
     if rows.ndim == 1:
-      rows = jnp.where(valid, rows, 0)
+      rows = jnp.where(row_valid, rows, 0)
     else:
-      rows = jnp.where(valid[:, None], rows, 0)
+      rows = jnp.where(row_valid[:, None], rows, 0)
     reply = jax.lax.all_to_all(
         rows.reshape((num_parts, cw) + rows.shape[1:]), axis, 0, 0,
         tiled=True)
@@ -370,7 +377,8 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
                         collect_labels, with_cache, fshard, lshard,
                         cids, crows, axis, num_parts, exchange_slack,
                         collect_edge_features=False, efshard=None,
-                        ebounds=None, ef_shard_mode='mod'):
+                        ebounds=None, ef_shard_mode='mod',
+                        hot_counts=None):
   """Per-device multihop expansion + feature/label collection — the
   shared body of the node and link SPMD steps.  When
   ``collect_edge_features`` is set, every sampled edge's feature row is
@@ -430,7 +438,8 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
     got, gstats = dist_gather_multi(
         tables, bounds, state.nodes, axis, num_parts,
         exchange_capacity=_slack_cap(node_cap, num_parts,
-                                     exchange_slack))
+                                     exchange_slack),
+        hot_counts=hot_counts if collect_features else None)
     got = list(got)
     ft_stats = ft_stats + jnp.stack(gstats)
     if collect_features:
@@ -454,17 +463,20 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     with_cache: bool = False,
                     exchange_slack: Optional[float] = None,
                     collect_edge_features: bool = False,
-                    ef_shard_mode: str = 'mod'):
+                    ef_shard_mode: str = 'mod', tiered: bool = False):
   """Build the jitted SPMD sample(+collect) step.
 
   ``exchange_slack``: per-destination exchange capacity as a multiple
   of the balanced share (``frontier/P``); None = uncapped (full
   frontier width, ~P x padding).  See `bucket_by_owner`.
+  ``tiered``: the feature table is HBM-partial — owners zero rows past
+  their hot count (``hcounts``) and the caller overlays the cold tier.
   """
   from .shard_map_compat import shard_map
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-                 lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
+                 lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts,
+                 key):
     (state, row, col, edge, seed_local, x, y, ef, nsn,
      stats) = _expand_and_collect(
         indptr_s[0], indices_s[0], eids_s[0] if with_edge else None,
@@ -479,7 +491,8 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
         axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
         collect_edge_features=collect_edge_features,
         efshard=efshard_s[0] if collect_edge_features else None,
-        ebounds=ebounds, ef_shard_mode=ef_shard_mode)
+        ebounds=ebounds, ef_shard_mode=ef_shard_mode,
+        hot_counts=hcounts if tiered else None)
 
     def lead(v):   # re-add the shard axis for stacked outputs
       return None if v is None else v[None]
@@ -488,17 +501,17 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
             lead(ef), lead(nsn), lead(stats))
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P(axis), P(axis), P(axis), P(), P())
+              P(axis), P(axis), P(axis), P(), P(), P())
   specs_out = tuple(P(axis) for _ in range(11))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-           lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
+           lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts, key):
     return sharded(indptr_s, indices_s, eids_s, bounds, seeds_s,
                    fshard_s, lshard_s, cids_s, crows_s, efshard_s,
-                   ebounds, key)
+                   ebounds, hcounts, key)
 
   return step
 
@@ -513,7 +526,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                          with_cache: bool = False,
                          exchange_slack: Optional[float] = None,
                          collect_edge_features: bool = False,
-                         ef_shard_mode: str = 'mod'):
+                         ef_shard_mode: str = 'mod',
+                         tiered: bool = False):
   """Build the jitted SPMD LINK sample step: per-device seed edges +
   collective strict negatives + the shared expansion body.
 
@@ -526,7 +540,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
   from .shard_map_compat import shard_map
 
   def per_device(indptr_s, indices_s, eids_s, bounds, pairs_s, fshard_s,
-                 lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
+                 lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts,
+                 key):
     indptr = indptr_s[0]
     indices = indices_s[0]
     pairs = pairs_s[0]                       # [B, 2|3]
@@ -567,7 +582,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
         axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
         collect_edge_features=collect_edge_features,
         efshard=efshard_s[0] if collect_edge_features else None,
-        ebounds=ebounds, ef_shard_mode=ef_shard_mode)
+        ebounds=ebounds, ef_shard_mode=ef_shard_mode,
+        hot_counts=hcounts if tiered else None)
 
     b = batch
     sl = seed_local
@@ -612,17 +628,17 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
             + tuple(lead(m) for m in md))
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P(axis), P(axis), P(axis), P(), P())
+              P(axis), P(axis), P(axis), P(), P(), P())
   specs_out = tuple(P(axis) for _ in range(17))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, pairs_s, fshard_s,
-           lshard_s, cids_s, crows_s, efshard_s, ebounds, key):
+           lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts, key):
     return sharded(indptr_s, indices_s, eids_s, bounds, pairs_s,
                    fshard_s, lshard_s, cids_s, crows_s, efshard_s,
-                   ebounds, key)
+                   ebounds, hcounts, key)
 
   return step
 
@@ -633,7 +649,8 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
                              collect_features: bool, collect_labels: bool,
                              axis: str = 'data',
                              with_cache: bool = False,
-                             exchange_slack: Optional[float] = None):
+                             exchange_slack: Optional[float] = None,
+                             tiered: bool = False):
   """Build the jitted SPMD INDUCED-SUBGRAPH step — the device-mesh
   analog of reference ``DistNeighborSampler._subgraph``
   (`distributed/dist_neighbor_sampler.py:456-516`).
@@ -652,7 +669,7 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
   from .shard_map_compat import shard_map
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-                 lshard_s, cids_s, crows_s, key):
+                 lshard_s, cids_s, crows_s, hcounts, key):
     (state, _row, _col, _edge, seed_local, x, y, _ef, nsn,
      stats) = _expand_and_collect(
         indptr_s[0], indices_s[0], None, bounds, seeds_s[0], key,
@@ -663,7 +680,8 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
         lshard=lshard_s[0] if collect_labels else None,
         cids=cids_s[0] if with_cache else None,
         crows=crows_s[0] if with_cache else None,
-        axis=axis, num_parts=num_parts, exchange_slack=exchange_slack)
+        axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
+        hot_counts=hcounts if tiered else None)
 
     nodes = state.nodes                              # [node_cap]
     nbrs, mask, eids, hstats = _dist_one_hop(
@@ -695,16 +713,16 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
             lead(stats))
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P(axis), P(axis), P())
+              P(axis), P(axis), P(), P())
   specs_out = tuple(P(axis) for _ in range(10))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-           lshard_s, cids_s, crows_s, key):
+           lshard_s, cids_s, crows_s, hcounts, key):
     return sharded(indptr_s, indices_s, eids_s, bounds, seeds_s,
-                   fshard_s, lshard_s, cids_s, crows_s, key)
+                   fshard_s, lshard_s, cids_s, crows_s, hcounts, key)
 
   return step
 
@@ -726,6 +744,12 @@ class ExchangeTelemetry:
     self._stats_acc = jnp.zeros((len(EXCHANGE_STAT_NAMES),), jnp.int32)
     self._stats_total = np.zeros(len(EXCHANGE_STAT_NAMES), np.int64)
     self._stats_pending = 0
+    # host-side cold-tier counters (tiered feature stores only):
+    # lookups = valid node-table entries per step, misses = entries
+    # served from the host-DRAM cold tier.
+    self._cold_lookups = 0
+    self._cold_misses = 0
+    self._cold_reported = (0, 0)
 
   def _accumulate_stats(self, stats_stacked) -> None:
     self._stats_acc = self._stats_acc + jnp.sum(stats_stacked, axis=0)
@@ -747,11 +771,24 @@ class ExchangeTelemetry:
     self._stats_total += delta
     out = {f'dist.{n}': int(v)
            for n, v in zip(EXCHANGE_STAT_NAMES, self._stats_total)}
+    out['dist.feature.cold_lookups'] = self._cold_lookups
+    out['dist.feature.cold_misses'] = self._cold_misses
+    out['dist.feature.cold_hit_rate'] = (
+        1.0 - self._cold_misses / self._cold_lookups
+        if self._cold_lookups else 1.0)
     if tick_metrics:
       from ..utils.profiling import metrics
       for n, d in zip(EXCHANGE_STAT_NAMES, delta):
         if d:
           metrics.inc(f'dist.{n}', float(d))
+      lk, ms = self._cold_reported
+      if self._cold_lookups > lk:
+        metrics.inc('dist.feature.cold_lookups',
+                    float(self._cold_lookups - lk))
+      if self._cold_misses > ms:
+        metrics.inc('dist.feature.cold_misses',
+                    float(self._cold_misses - ms))
+      self._cold_reported = (self._cold_lookups, self._cold_misses)
     return out
 
 
@@ -791,6 +828,12 @@ class DistNeighborSampler(ExchangeTelemetry):
                   and dataset.edge_features.mod_sharded) else 'range')
     self.with_cache = (self.collect_features
                        and dataset.node_features.has_cache)
+    # tiered store: HBM shards hold only each partition's hot rows;
+    # cold rows live in host DRAM and are overlaid post-step
+    # (`_maybe_overlay_cold`) — VERDICT r2 item 1 / reference
+    # `data/feature.py:174-206` + `csrc/cuda/unified_tensor.cu:202+`.
+    self.tiered = (self.collect_features
+                   and dataset.node_features.is_tiered)
     # SURVEY §7 "partition-aware capacity tuning": e.g. 2.0 sends
     # 2x the balanced share per destination instead of the full
     # frontier (P/2 x fewer exchanged bytes); overflowed ids lose
@@ -827,12 +870,16 @@ class DistNeighborSampler(ExchangeTelemetry):
       else:
         efshards = np.zeros((self.num_parts, 1, 1), np.float32)
         ebounds = np.zeros(self.num_parts + 1, np.int64)
+      hcounts = (self.ds.node_features.hot_counts
+                 if self.collect_features
+                 else np.zeros(self.num_parts, np.int32))
       self._device_arrays = dict(
           indptr=put(g.indptr, shard), indices=put(g.indices, shard),
           eids=put(g.edge_ids, shard), bounds=put(g.bounds, repl),
           fshards=put(fshards, shard), lshards=put(lshards, shard),
           cids=put(cids, shard), crows=put(crows, shard),
-          efshards=put(efshards, shard), ebounds=put(ebounds, repl))
+          efshards=put(efshards, shard), ebounds=put(ebounds, repl),
+          hcounts=put(np.asarray(hcounts, np.int32), repl))
     return self._device_arrays
 
   def node_capacity(self, batch_size: int) -> int:
@@ -853,7 +900,7 @@ class DistNeighborSampler(ExchangeTelemetry):
           self.axis, with_cache=self.with_cache,
           exchange_slack=self.exchange_slack,
           collect_edge_features=self.collect_edge_features,
-          ef_shard_mode=self._ef_shard_mode)
+          ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -864,11 +911,61 @@ class DistNeighborSampler(ExchangeTelemetry):
         self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
                          arrs['bounds'], seeds_dev, arrs['fshards'],
                          arrs['lshards'], arrs['cids'], arrs['crows'],
-                         arrs['efshards'], arrs['ebounds'], key)
+                         arrs['efshards'], arrs['ebounds'],
+                         arrs['hcounts'], key)
     self._accumulate_stats(stats)
+    x = self._maybe_overlay_cold(x, nodes)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, seed_local=seed_local, x=x, y=y, ef=ef,
                 num_sampled_nodes=nsn, batch=seeds_dev)
+
+  def _maybe_overlay_cold(self, x, nodes):
+    """Overlay host-DRAM cold-tier rows onto the exchanged features.
+
+    Tiered stores serve only HBM-hot rows through the all_to_all
+    (owners zero rows past their hot count); the cold remainder is
+    host-gathered into a COMPACT replicated buffer and expanded on
+    device by a rank map — the same compact-transfer trade as the
+    single-chip mixed path (`data/feature.py.__getitem__`), stacked.
+    The explicit, per-batch analog of the reference's UVA reads
+    (`csrc/cuda/unified_tensor.cu:202+`).  Costs one device sync for
+    the node table — the honest price of exceeding HBM.
+    """
+    if not self.tiered or x is None:
+      return x
+    nf = self.ds.node_features
+    bounds = self.ds.graph.bounds
+    nodes_h = np.asarray(jax.device_get(nodes)).astype(np.int64)
+    owner = np.clip(np.searchsorted(bounds, nodes_h, side='right') - 1,
+                    0, self.num_parts - 1)
+    valid = nodes_h >= 0
+    local = np.where(valid, nodes_h - bounds[owner], 0)
+    cold = valid & (local >= nf.hot_counts[owner])
+    self._cold_lookups += int(valid.sum())
+    n_cold = int(cold.sum())
+    self._cold_misses += n_cold
+    if n_cold == 0:
+      return x
+    from ..utils.padding import next_power_of_two
+    cold_pad = next_power_of_two(n_cold)
+    compact = np.zeros((cold_pad, nf.cold_host.shape[1]),
+                       nf.cold_host.dtype)
+    compact[:n_cold] = nf.cold_host[nodes_h[cold]]
+    flat = cold.reshape(-1)
+    rank = np.where(flat, np.cumsum(flat) - 1,
+                    0).astype(np.int32).reshape(cold.shape)
+    shard = NamedSharding(self.mesh, P(self.axis))
+    repl = NamedSharding(self.mesh, P())
+    return _overlay_cold_rows(x, jax.device_put(cold, shard),
+                              jax.device_put(rank, shard),
+                              jax.device_put(compact, repl))
+
+
+@jax.jit
+def _overlay_cold_rows(x, mask, rank, compact):
+  """``x[p, i] = compact[rank[p, i]] where mask`` — the device half of
+  the cold-tier overlay (`DistNeighborSampler._maybe_overlay_cold`)."""
+  return jnp.where(mask[..., None], compact[rank], x)
 
 
 def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
@@ -937,7 +1034,7 @@ class DistSubGraphSampler(DistNeighborSampler):
           self.mesh, self.num_parts, self.fanouts, node_cap,
           self.max_degree, self.with_edge, self.collect_features,
           self.collect_labels, self.axis, with_cache=self.with_cache,
-          exchange_slack=self.exchange_slack)
+          exchange_slack=self.exchange_slack, tiered=self.tiered)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -948,8 +1045,9 @@ class DistSubGraphSampler(DistNeighborSampler):
         self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
                          arrs['bounds'], seeds_dev, arrs['fshards'],
                          arrs['lshards'], arrs['cids'], arrs['crows'],
-                         key)
+                         arrs['hcounts'], key)
     self._accumulate_stats(stats)
+    x = self._maybe_overlay_cold(x, nodes)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, seed_local=seed_local, x=x, y=y,
                 num_sampled_nodes=nsn, batch=seeds_dev)
@@ -1192,7 +1290,7 @@ class DistLinkNeighborSampler(DistNeighborSampler):
           self.axis, with_cache=self.with_cache,
           exchange_slack=self.exchange_slack,
           collect_edge_features=self.collect_edge_features,
-          ef_shard_mode=self._ef_shard_mode)
+          ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -1204,8 +1302,10 @@ class DistLinkNeighborSampler(DistNeighborSampler):
         self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
                          arrs['bounds'], pairs_dev, arrs['fshards'],
                          arrs['lshards'], arrs['cids'], arrs['crows'],
-                         arrs['efshards'], arrs['ebounds'], key)
+                         arrs['efshards'], arrs['ebounds'],
+                         arrs['hcounts'], key)
     self._accumulate_stats(stats)
+    x = self._maybe_overlay_cold(x, nodes)
     md = {'seed_local': seed_local}
     if self.neg_mode == 'triplet':
       md.update(src_index=src_idx, dst_pos_index=dst_pos,
