@@ -27,6 +27,8 @@ from .dist_sampling_producer import (CollocatedSamplingProducer,
 from .dist_server import (DistServer, get_server, init_server,
                           wait_and_shutdown_server)
 from .host_dataset import HostDataset, HostHeteroDataset
+from .host_dist_sampler import (HostDistNeighborSampler,
+                                PartitionService, connect_peers)
 from .host_sampler import HostHeteroNeighborSampler, HostNeighborSampler
 
 __all__ = [
@@ -39,7 +41,8 @@ __all__ = [
     'DistServer', 'get_server', 'init_server', 'wait_and_shutdown_server',
     'DistClient', 'get_client', 'init_client', 'shutdown_client',
     'HostDataset', 'HostHeteroDataset', 'HostNeighborSampler',
-    'HostHeteroNeighborSampler',
+    'HostHeteroNeighborSampler', 'HostDistNeighborSampler',
+    'PartitionService', 'connect_peers',
     'DistPartitionManager', 'DistRandomPartitioner', 'node_range',
     'DistTableRandomPartitioner',
 ]

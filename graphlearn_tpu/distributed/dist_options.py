@@ -38,11 +38,19 @@ class HostSamplingConfig:
     neg_mode / neg_amount: link-mode negative sampling spec.
     input_type: hetero seed type — a node type (node mode) or an edge
       type 3-tuple (link mode); None for homogeneous datasets.
+    peer_addrs: partitioned deployments only — ``[(host, port), ...]``
+      of every partition's `PartitionService` (index = partition):
+      producers fed a SHARD dataset build a cross-server
+      `HostDistNeighborSampler` fanning each hop/feature lookup out to
+      these peers (reference `_sample_one_hop` remote path,
+      `dist_neighbor_sampler.py:542-598`).  None + full dataset =
+      plain local sampler; None + shard dataset = refused.
   """
   sampling_type: str = 'node'
   neg_mode: Optional[str] = None       # 'binary' | 'triplet'
   neg_amount: float = 1.0
   input_type: Union[str, tuple, None] = None
+  peer_addrs: Optional[tuple] = None
 
   def expansion_seeds(self, batch_size: int) -> int:
     """EXACT number of node seeds entering multi-hop expansion for a

@@ -37,8 +37,26 @@ class MpCommand(enum.Enum):
   STOP = 1
 
 
-def _make_sampler(dataset, fanouts, with_edge, collect_features, seed):
-  """Homo/hetero host sampler by dataset kind."""
+def _make_sampler(dataset, fanouts, with_edge, collect_features, seed,
+                  peer_addrs=None):
+  """Homo/hetero host sampler by dataset kind; a SHARD dataset +
+  ``peer_addrs`` builds the cross-server `HostDistNeighborSampler`
+  (each worker owns its peer sockets — `RpcClient` connects lazily
+  per thread, so construction after fork/forkserver is safe)."""
+  if (getattr(dataset, 'node_pb', None) is not None
+      and peer_addrs is not None):
+    if isinstance(dataset, HostHeteroDataset):
+      raise ValueError(
+          'cross-server hetero sampling is not implemented in the host '
+          'runtime; use the mesh engine '
+          '(graphlearn_tpu.parallel.DistHeteroNeighborSampler)')
+    from .host_dist_sampler import (HostDistNeighborSampler,
+                                    connect_peers)
+    return HostDistNeighborSampler(
+        dataset, fanouts,
+        connect_peers(list(peer_addrs), dataset.partition_idx),
+        with_edge=with_edge, collect_features=collect_features,
+        seed=seed)
   cls = (HostHeteroNeighborSampler
          if isinstance(dataset, HostHeteroDataset) else HostNeighborSampler)
   return cls(dataset, fanouts, with_edge=with_edge,
@@ -90,7 +108,9 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
     # for the process lifetime
     dataset, segs = dataset.materialize()  # noqa: F841 — keepalive
   sampler = _make_sampler(dataset, fanouts, with_edge, collect_features,
-                          seed * 7919 + rank)
+                          seed * 7919 + rank,
+                          peer_addrs=getattr(sampling_config,
+                                             'peer_addrs', None))
   while True:
     try:
       cmd, payload = task_queue.get(timeout=5.0)
@@ -226,7 +246,9 @@ class CollocatedSamplingProducer:
                collect_features: bool = True, shuffle: bool = False,
                seed: int = 0, sampling_config=None):
     self.sampler = _make_sampler(dataset, num_neighbors, with_edge,
-                                 collect_features, seed)
+                                 collect_features, seed,
+                                 peer_addrs=getattr(sampling_config,
+                                                    'peer_addrs', None))
     self.batch_size = int(batch_size)
     self.shuffle = shuffle
     self.sampling_config = sampling_config

@@ -145,6 +145,14 @@ def init_server(num_servers: int, num_clients: int, rank: int,
                'start_new_epoch_sampling', 'fetch_one_sampled_message',
                'destroy_sampling_producer', 'exit'):
     rpc.register(name, getattr(srv, name))
+  if getattr(dataset, 'node_pb', None) is not None and \
+      not isinstance(getattr(dataset, 'node_pb'), dict):
+    # shard-backed server: also serve this partition to peer samplers
+    # (one-hop / node-data / out-edge handlers on the SAME port), so a
+    # `HostSamplingConfig(peer_addrs=[every server's (host, port)])`
+    # lets producers fan each hop out across the server fleet
+    from .host_dist_sampler import PartitionService
+    PartitionService(dataset, server=rpc)
   rpc.start()
   srv.port = rpc.port
   _server, _rpc_server = srv, rpc

@@ -19,6 +19,24 @@ from ..typing import as_str, reverse_edge_type
 from .host_dataset import HostDataset, HostHeteroDataset
 
 
+def shard_out_edges(ds, nodes: np.ndarray, with_edge: bool):
+  """ALL out-edges of ``nodes`` on a host CSR in one vectorized pass (a
+  per-node loop would dominate the producer hot path at SEAL closure
+  sizes): returns ``(src_pos, nbrs, eids | None)``, ``src_pos``
+  indexing into ``nodes``."""
+  starts = ds.indptr[nodes]
+  degs = ds.indptr[nodes + 1] - starts
+  total = int(degs.sum())
+  # flat positions of every node's out-edges in `indices`
+  off = np.repeat(np.cumsum(degs) - degs, degs)
+  flat = (np.arange(total) - off
+          + np.repeat(starts, degs)) if total else np.empty(0, np.int64)
+  src_pos = np.repeat(np.arange(len(nodes), dtype=np.int64), degs)
+  eids = (ds.edge_ids[flat] if (with_edge and ds.edge_ids is not None)
+          else None)
+  return src_pos, ds.indices[flat], eids
+
+
 def sorted_cols(indptr: np.ndarray, indices: np.ndarray) -> np.ndarray:
   """Within-row-sorted column view of an (unsorted) CSR, enabling
   vectorized membership tests."""
@@ -148,6 +166,11 @@ class HostNeighborSampler:
 
   def _gather_edge_features(self, eids: np.ndarray) -> np.ndarray:
     return self.ds.edge_features[eids]
+
+  def _closure_out_edges(self, nodes: np.ndarray):
+    """ALL out-edges of ``nodes`` (the induced-subgraph scan source);
+    see :func:`shard_out_edges`."""
+    return shard_out_edges(self.ds, nodes, self.with_edge)
 
   @property
   def _has_node_features(self) -> bool:
@@ -312,28 +335,15 @@ class HostNeighborSampler:
     ind, seed_local, _r, _c, _e, num_sampled = self._expand(
         seeds, batch_seed)
     nodes = ind.all_nodes()
-    # membership + relabel over the closure set: one vectorized pass
-    # (a per-node loop here would dominate the producer hot path at
-    # SEAL closure sizes)
+    # membership + relabel over the closure set, one vectorized pass
     order = np.argsort(nodes)
     snodes = nodes[order]
-    indptr, indices = self.ds.indptr, self.ds.indices
-    starts = indptr[nodes]
-    degs = indptr[nodes + 1] - starts
-    total = int(degs.sum())
-    # flat positions of every closure node's out-edges in `indices`
-    off = np.repeat(np.cumsum(degs) - degs, degs)
-    flat = (np.arange(total) - off
-            + np.repeat(starts, degs)) if total else np.empty(0, np.int64)
-    src_l = np.repeat(np.arange(len(nodes), dtype=np.int64), degs)
-    nb = indices[flat]
+    src_l, nb, flat_eids = self._closure_out_edges(nodes)
     pos = np.clip(np.searchsorted(snodes, nb), 0, max(len(snodes) - 1, 0))
     keep = (snodes[pos] == nb) if len(snodes) else np.zeros(0, bool)
     rows = src_l[keep]
     cols = order[pos[keep]]
-    eids = (self.ds.edge_ids[flat][keep]
-            if (self.with_edge and self.ds.edge_ids is not None)
-            else None)
+    eids = flat_eids[keep] if flat_eids is not None else None
     msg = self._finish(seeds, ind, seed_local, rows, cols, eids,
                        num_sampled)
     msg['#META.mapping'] = seed_local

@@ -79,6 +79,10 @@ class SharedDatasetHandle:
           node_features=get(self.fields['node_features']),
           node_labels=get(self.fields['node_labels']),
           edge_features=get(self.fields['edge_features']))
+      # shard identity survives the boundary so workers can build the
+      # cross-server sampler (`host_dist_sampler.py`)
+      ds.node_pb = get(self.fields.get('node_pb'))
+      ds.partition_idx = self.meta.get('partition_idx')
       return ds, segs
     csr = {et: (get(ip), get(ix), get(ei))
            for et, (ip, ix, ei) in self.fields['csr'].items()}
@@ -90,6 +94,10 @@ class SharedDatasetHandle:
                      for nt, h in self.fields['node_labels'].items()},
         edge_features={et: get(h)
                        for et, h in self.fields['edge_features'].items()})
+    pb = self.fields.get('node_pb')
+    ds.node_pb = ({nt: get(h) for nt, h in pb.items()}
+                  if pb is not None else None)
+    ds.partition_idx = self.meta.get('partition_idx')
     return ds, segs
 
 
@@ -104,6 +112,7 @@ def share_dataset(ds):
     return h
 
   if isinstance(ds, HostHeteroDataset):
+    pb = getattr(ds, 'node_pb', None)
     fields = {
         'csr': {et: tuple(put(a) for a in csr)
                 for et, csr in ds.csr.items()},
@@ -112,18 +121,22 @@ def share_dataset(ds):
         'node_labels': {nt: put(a) for nt, a in ds.node_labels.items()},
         'edge_features': {et: put(a)
                           for et, a in ds.edge_features.items()},
+        'node_pb': ({nt: put(a) for nt, a in pb.items()}
+                    if pb is not None else None),
     }
-    return (SharedDatasetHandle('hetero', fields,
-                                {'num_nodes': dict(ds.num_nodes)}),
-            segs)
+    meta = {'num_nodes': dict(ds.num_nodes),
+            'partition_idx': getattr(ds, 'partition_idx', None)}
+    return SharedDatasetHandle('hetero', fields, meta), segs
   fields = {
       'indptr': put(ds.indptr), 'indices': put(ds.indices),
       'edge_ids': put(ds.edge_ids),
       'node_features': put(ds.node_features),
       'node_labels': put(ds.node_labels),
       'edge_features': put(ds.edge_features),
+      'node_pb': put(getattr(ds, 'node_pb', None)),
   }
-  return SharedDatasetHandle('homo', fields, {}), segs
+  meta = {'partition_idx': getattr(ds, 'partition_idx', None)}
+  return SharedDatasetHandle('homo', fields, meta), segs
 
 
 def release(segs) -> None:

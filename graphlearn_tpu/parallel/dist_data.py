@@ -86,7 +86,10 @@ def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
     num_parts = int(node_pb.max()) + 1 if node_pb.size else 1
   # contiguous relabel: sort nodes by (partition[, -hotness], old id).
   if hotness is not None:
-    order = np.lexsort((np.arange(num_nodes), -np.asarray(hotness),
+    hot = np.asarray(hotness)
+    if hot.dtype.kind == 'u':
+      hot = hot.astype(np.int64)   # unsigned negation would wrap
+    order = np.lexsort((np.arange(num_nodes), -hot,
                         node_pb))                    # new id -> old id
   else:
     order = np.argsort(node_pb, kind='stable')       # new id -> old id
