@@ -1,4 +1,5 @@
-"""Headline benchmark: GraphSAGE epoch time + sampling throughput.
+"""Headline benchmark: GraphSAGE epoch time + sampling throughput
++ distributed (virtual-mesh) loader section.
 
 PRIMARY metric (BASELINE.json: "GraphSAGE epoch time on
 ogbn-products"): wall-clock of one full training epoch — seed shuffle
@@ -8,14 +9,22 @@ ogbn-products"): wall-clock of one full training epoch — seed shuffle
 nodes, ~61M directed edges, 100-dim features, ~8% train split).
 
 SECONDARY: the reference's "Sampled Edges per secs" definition
-(`benchmarks/api/bench_sampler.py:46-54`).
+(`benchmarks/api/bench_sampler.py:46-54`), and a `dist` section — a
+P=8 virtual-CPU-mesh distributed loader epoch (edges/sec/chip,
+padding-waste %, drop rate from the exchange telemetry; labeled
+"virtual CPU mesh — relative only", the intent of reference
+`benchmarks/api/bench_dist_neighbor_loader.py`).
 
 Honest variance reporting: the tunnel to the chip swings wall-clock
 several-fold BETWEEN processes, and within a process only the first
 timed burst reflects true device throughput (benchmarks/README,
-"first-burst validity").  So the harness runs ``GLT_BENCH_SESSIONS``
-(default 5) fresh subprocess sessions and reports min/median/max
-across them; the headline `value` is the MEDIAN epoch time.
+"first-burst validity").  The harness runs ``GLT_BENCH_SESSIONS``
+(default 5) fresh subprocess sessions and reports min/median/max; the
+headline `value` is the MEDIAN epoch time.  Session 0 runs the full
+protocol (warmup epoch + measured epoch); later sessions run a FAST
+protocol (3-batch warmup covers the compile, then one measured epoch)
+so a slow-tunnel day still yields >= 3 sessions inside the budget
+(r2's harness lost 3 of 5 sessions to one 480 s timeout).
 
 ``vs_baseline`` divides a NOMINAL single-A100 epoch time of 2.0 s into
 the median (the reference publishes figures, not numbers — 2.0 s is a
@@ -36,7 +45,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from benchmarks.common import (NUM_NODES, build_graph,  # noqa: E402
-                               build_graph_csr)
+                               build_graph_csr, cpu_mesh_env)
 
 #: nominal single-A100 epoch seconds (see module docstring)
 BASELINE_EPOCH_SECS = 2.0
@@ -49,10 +58,17 @@ DIM = 100
 CLASSES = 47
 SAMPLE_ITERS = 30
 
+#: dist section: smaller graph (CPU mesh), reference bench workload
+DIST_PARTS = 8
+DIST_NODES = 500_000
+DIST_DIM = 64
 
-def worker():
+
+def worker(fast: bool):
   """One fresh-session measurement: epoch time first (the primary,
-  measured on this process's first burst), then sampling throughput."""
+  measured on this process's first burst), then sampling throughput.
+  ``fast`` warms up on 3 batches (covers the compile — every batch
+  shares one static shape) instead of a full epoch."""
   import jax
   try:
     jax.config.update('jax_compilation_cache_dir', '/tmp/glt_jax_cache')
@@ -88,27 +104,37 @@ def worker():
       model, jax.random.key(0), next(iter(loader)), tx)
   step = make_supervised_step(apply_fn, tx, BATCH)
 
-  # epoch 0 = warmup/compile; epoch 1 = THE measured first burst
+  # warmup covers compile; the next epoch is THE measured first burst
+  if fast:
+    for i, batch in enumerate(loader):
+      state, loss, _ = step(state, batch)
+      if i >= 2:
+        break
+    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    epochs = (1,)
+  else:
+    epochs = (0, 1)
   epoch_secs = None
-  for epoch in range(2):
+  for epoch in epochs:
     t0 = time.perf_counter()
     for batch in loader:
       state, loss, _ = step(state, batch)
     jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    if epoch == 1:
+    if epoch == 1 or fast:
       epoch_secs = time.perf_counter() - t0
 
   # secondary: sampling-only throughput, reference metric definition
+  iters = 10 if fast else SAMPLE_ITERS
   sampler = NeighborSampler(ds.get_graph(), FANOUT, seed=0)
   srng = np.random.default_rng(1)
   seed_batches = [srng.integers(0, n, BATCH).astype(np.int32)
-                  for _ in range(3 + SAMPLE_ITERS)]
+                  for _ in range(3 + iters)]
   for i in range(3):
     out = sampler.sample_from_nodes(NodeSamplerInput(node=seed_batches[i]))
   out.node.block_until_ready()
   t0 = time.perf_counter()
   outs = [sampler.sample_from_nodes(NodeSamplerInput(node=seed_batches[3 + i]))
-          for i in range(SAMPLE_ITERS)]
+          for i in range(iters)]
   for o in outs:
     o.row.block_until_ready()
   dt = time.perf_counter() - t0
@@ -117,46 +143,140 @@ def worker():
   print(json.dumps({'epoch_secs': epoch_secs,
                     'edges_per_sec': edges / dt,
                     'steps': len(loader),
+                    'mode': 'fast' if fast else 'full',
                     'platform': jax.devices()[0].platform}),
         flush=True)
+
+
+def dist_worker():
+  """P=8 virtual-mesh distributed loader epoch (VERDICT r2 item 3):
+  the reference dist-bench workload (batch 1024, fanout [15,10,5]) on
+  the mesh engine, with capacity-capped exchanges and telemetry-backed
+  padding/drop accounting.  CPU-mesh numbers are RELATIVE (no ICI);
+  the label says so."""
+  import jax
+  from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                       make_mesh)
+  assert len(jax.devices()) == DIST_PARTS, jax.devices()
+  rows, cols = build_graph(DIST_NODES)
+  rng = np.random.default_rng(0)
+  feats = rng.random((DIST_NODES, DIST_DIM), dtype=np.float32)
+  labels = rng.integers(0, CLASSES, DIST_NODES).astype(np.int32)
+  ds = DistDataset.from_full_graph(DIST_PARTS, rows, cols,
+                                   node_feat=feats, node_label=labels,
+                                   num_nodes=DIST_NODES)
+  seeds = rng.permutation(DIST_NODES)[:BATCH * DIST_PARTS * 4]
+  loader = DistNeighborLoader(ds, list(FANOUT), seeds, batch_size=BATCH,
+                              shuffle=True, mesh=make_mesh(DIST_PARTS),
+                              seed=0)
+  it = iter(loader)
+  b = next(it)                      # compile + warm
+  b.x.block_until_ready()
+  edges = 0
+  t0 = time.perf_counter()
+  n_batches = 0
+  for b in it:
+    edges += int(np.asarray(b.edge_mask.sum()))
+    n_batches += 1
+  dt = time.perf_counter() - t0
+  st = loader.sampler.exchange_stats(tick_metrics=False)
+  sent = st['dist.frontier.offered'] - st['dist.frontier.dropped']
+  waste = 100.0 * (1 - sent / max(st['dist.frontier.slots'], 1))
+  drop = 100.0 * st['dist.frontier.dropped'] / max(
+      st['dist.frontier.offered'], 1)
+  print(json.dumps({
+      'label': 'virtual CPU mesh - relative only',
+      'num_parts': DIST_PARTS, 'batch': BATCH, 'fanout': list(FANOUT),
+      'num_nodes': DIST_NODES, 'batches': n_batches,
+      'edges_per_sec_per_chip': round(edges / dt / DIST_PARTS, 1),
+      'seeds_per_sec': round(n_batches * BATCH * DIST_PARTS / dt, 1),
+      'padding_waste_pct': round(waste, 2),
+      'drop_rate_pct': round(drop, 3),
+  }), flush=True)
+
+
+def _run_session(fast: bool, timeout: int):
+  cmd = [sys.executable, os.path.abspath(__file__), '--bench-worker']
+  if fast:
+    cmd.append('--fast')
+  cmd += [a for a in sys.argv[1:]
+          if a not in ('--bench-worker', '--fast')]
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         cwd=os.path.dirname(os.path.abspath(__file__)),
+                         timeout=timeout)
+  except subprocess.TimeoutExpired:
+    print(f'session timed out after {timeout}s', file=sys.stderr)
+    return None
+  for ln in reversed(out.stdout.strip().splitlines()):
+    if ln.startswith('{'):
+      return json.loads(ln)
+  print(f'session failed:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}',
+        file=sys.stderr)
+  return None
+
+
+def _run_dist_section(timeout: int):
+  cmd = [sys.executable, os.path.abspath(__file__), '--dist-worker']
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         cwd=os.path.dirname(os.path.abspath(__file__)),
+                         env=cpu_mesh_env(DIST_PARTS), timeout=timeout)
+  except subprocess.TimeoutExpired:
+    return {'error': f'dist section timed out after {timeout}s'}
+  for ln in reversed(out.stdout.strip().splitlines()):
+    if ln.startswith('{'):
+      return json.loads(ln)
+  return {'error': f'dist section failed: {out.stderr[-500:]}'}
 
 
 def main():
   sessions = int(os.environ.get('GLT_BENCH_SESSIONS', 5))
   build_graph_csr(NUM_NODES)      # warm the /tmp graph+CSR caches once
-  results = []
   session_timeout = int(os.environ.get('GLT_BENCH_SESSION_TIMEOUT', 480))
+  # fast sessions do LESS WORK, not less time: on a tunnel-slow day
+  # the full session may eat its whole timeout, and the fast protocol
+  # (half the work) still needs most of it
+  fast_timeout = session_timeout
   # hard wall for the whole harness: tunnel-slow days must yield a
   # degraded (fewer-session) number, never a timeout with NO number
   total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 1500))
+  # measured ~5.5 min on this box (compile dominates); the wall keeps
+  # a wedged mesh from eating the whole budget, not a perf target
+  dist_timeout = int(os.environ.get('GLT_BENCH_DIST_TIMEOUT', 600))
   t_start = time.time()
-  for s in range(sessions):
-    if results and time.time() - t_start > total_budget - session_timeout:
+
+  def budget_left():
+    return total_budget - (time.time() - t_start)
+
+  results = []
+  attempts = 0
+  # session 0 full, the rest fast; keep attempting (within budget)
+  # until the floor is met — never fewer because one timed out.  The
+  # floor respects an EXPLICIT lower GLT_BENCH_SESSIONS (smoke runs).
+  floor = min(3, sessions)
+  while attempts < sessions + 3 and (len(results) < sessions
+                                     or len(results) < floor):
+    fast = attempts > 0
+    tmo = fast_timeout if fast else session_timeout
+    # the session floor is the hard deliverable (r2 shipped 2): only
+    # once it's met does the budget guard start reserving the dist phase
+    reserve = dist_timeout if len(results) >= floor else 60
+    if results and budget_left() < tmo + reserve:
       print(f'budget: stopping after {len(results)} sessions',
             file=sys.stderr)
       break
-    cmd = [sys.executable, os.path.abspath(__file__), '--bench-worker']
-    cmd += [a for a in sys.argv[1:] if a != '--bench-worker']
-    try:
-      out = subprocess.run(cmd, capture_output=True, text=True,
-                           cwd=os.path.dirname(os.path.abspath(__file__)),
-                           timeout=session_timeout)
-    except subprocess.TimeoutExpired:
-      print(f'session {s} timed out after {session_timeout}s',
-            file=sys.stderr)
-      continue
-    line = None
-    for ln in reversed(out.stdout.strip().splitlines()):
-      if ln.startswith('{'):
-        line = ln
-        break
-    if line is None:
-      print(f'session {s} failed:\n{out.stdout[-2000:]}\n'
-            f'{out.stderr[-2000:]}', file=sys.stderr)
-      continue
-    results.append(json.loads(line))
+    if attempts >= sessions and len(results) >= 3:
+      break
+    r = _run_session(fast, tmo)
+    attempts += 1
+    if r is not None:
+      results.append(r)
   if not results:
     raise SystemExit('all bench sessions failed')
+
+  dist = _run_dist_section(min(dist_timeout, max(int(budget_left()), 60)))
+
   ep = sorted(r['epoch_secs'] for r in results)
   es = sorted(r['edges_per_sec'] for r in results)
   med_ep = statistics.median(ep)
@@ -176,12 +296,16 @@ def main():
       'sampling_vs_a100_nominal': round(med_es / BASELINE_EDGES_PER_SEC,
                                         2),
       'sessions': len(results),
+      'session_modes': [r['mode'] for r in results],
       'steps_per_epoch': results[0]['steps'],
+      'dist': dist,
   }))
 
 
 if __name__ == '__main__':
-  if '--bench-worker' in sys.argv:
-    worker()
+  if '--dist-worker' in sys.argv:
+    dist_worker()
+  elif '--bench-worker' in sys.argv:
+    worker(fast='--fast' in sys.argv)
   else:
     main()

@@ -74,6 +74,47 @@ def capacity_worker(num_parts: int, slack, batch: int, fanout,
        frontier_dropped=st['dist.frontier.dropped'])
 
 
+def subgraph_worker(num_parts: int, hop_chunk, batch: int,
+                    num_nodes: int):
+  """SEAL-at-scale envelope (VERDICT r2 item 7): induced-subgraph
+  loader with the full-window hop CHUNKED, so the widest all_to_all is
+  ``[P, chunk, max_degree]`` regardless of closure size — the config
+  that aborted at P>=16 when the window spanned the whole node table."""
+  import jax
+  from graphlearn_tpu.parallel import (DistDataset, DistSubGraphLoader,
+                                       make_mesh)
+  assert len(jax.devices()) == num_parts
+  rows, cols = build_graph(num_nodes)
+  ds = DistDataset.from_full_graph(num_parts, rows, cols,
+                                   num_nodes=num_nodes)
+  seeds = np.random.default_rng(1).integers(0, num_nodes,
+                                            batch * num_parts * 3)
+  max_degree = int(np.diff(ds.graph.indptr, axis=1).max())
+  loader = DistSubGraphLoader(ds, [5, 5], seeds, batch_size=batch,
+                              shuffle=True, mesh=make_mesh(num_parts),
+                              collect_features=False, seed=0,
+                              hop_chunk=hop_chunk)
+  node_cap = loader.sampler.node_capacity(batch)
+  it = iter(loader)
+  b = next(it)
+  b.node.block_until_ready()
+  with Timer() as t:
+    n_batches = 0
+    last = None
+    for b in it:
+      last = b
+      n_batches += 1
+    last.node.block_until_ready()
+  chunk = hop_chunk or node_cap
+  emit('dist_subgraph_capacity',
+       n_batches * batch * num_parts / t.dt, 'seeds/s',
+       num_parts=num_parts,
+       hop_chunk=('none' if hop_chunk is None else hop_chunk),
+       node_cap=node_cap, max_degree=max_degree, batch=batch,
+       window_exchange_width=num_parts * min(chunk, node_cap)
+       * max_degree)
+
+
 def capacity_sweep(quick: bool):
   import json
   fanout = [15, 10, 5]
@@ -101,6 +142,15 @@ def capacity_sweep(quick: bool):
            '--batch', batch, '--nodes', n,
            '--fanout', ','.join(map(str, fanout))],
           env=cpu_mesh_env(p))
+  # SEAL envelope: chunked full-window hops keep the exact subgraph
+  # scan bounded where the unchunked window aborted at P>=16
+  sg_n = 50_000 if quick else 100_000
+  for p, chunk in ((8, 'none'), (8, 512), (16, 512)):
+    run_in_fresh_process(
+        script,
+        ['--subgraph-worker', '--num-parts', p, '--hop-chunk', chunk,
+         '--batch', 32, '--nodes', sg_n],
+        env=cpu_mesh_env(p))
 
 
 def main():
@@ -110,7 +160,9 @@ def main():
   ap.add_argument('--dim', type=int, default=64)
   ap.add_argument('--capacity-sweep', action='store_true')
   ap.add_argument('--capacity-worker', action='store_true')
+  ap.add_argument('--subgraph-worker', action='store_true')
   ap.add_argument('--slack', default='exact')
+  ap.add_argument('--hop-chunk', default='none')
   ap.add_argument('--batch', type=int, default=1024)
   ap.add_argument('--nodes', type=int, default=500_000)
   ap.add_argument('--fanout', default='15,10,5')
@@ -123,6 +175,10 @@ def main():
     slack = None if args.slack == 'exact' else float(args.slack)
     capacity_worker(args.num_parts, slack, args.batch,
                     [int(k) for k in args.fanout.split(',')], args.nodes)
+    return
+  if args.subgraph_worker:
+    chunk = None if args.hop_chunk == 'none' else int(args.hop_chunk)
+    subgraph_worker(args.num_parts, chunk, args.batch, args.nodes)
     return
 
   import jax

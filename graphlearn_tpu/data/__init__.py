@@ -6,3 +6,5 @@ from .dataset import Dataset
 from .table_dataset import (CsvTableReader, NpzTableReader, OdpsTableReader,
                             TableDataset, TableReader, read_edge_table,
                             read_node_table)
+from .ogb import (load_ogb_dir, ogb_to_dataset, partition_ogb,
+                  save_binary)

@@ -1,0 +1,177 @@
+"""OGB on-disk layout ingestion (offline-friendly, torch-free).
+
+The reference consumes OGB datasets through the `ogb` package
+(`examples/train_sage_ogbn_products.py:20-30`,
+`examples/igbh/dataset.py`); that package needs network access and
+torch.  This module reads the layouts OGB materializes ON DISK, so a
+host that already holds the data (e.g. a TPU-VM with a mounted bucket)
+can ingest without either dependency:
+
+  * the **raw CSV layout** (``<root>/raw/edge.csv.gz``,
+    ``node-feat.csv.gz``, ``node-label.csv.gz``,
+    ``num-node-list.csv.gz``; splits under
+    ``<root>/split/<name>/{train,valid,test}.csv.gz``) — what
+    ``ogb.nodeproppred`` unzips for every node-property dataset;
+  * a **binary layout** (``edge_index.npy``/``.npz`` + optional
+    ``node_feat.npy``, ``node_label.npy``, ``train_idx.npy``,
+    ``valid_idx.npy``, ``test_idx.npy``) — the fast path users export
+    once with `save_binary` and load in seconds at products scale.
+
+`load_ogb_dir` auto-detects the layout; `ogb_to_dataset` builds the
+single-chip `Dataset`; `partition_ogb` writes the offline partition
+layout the distributed engines load (`partition/base.py`).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ['load_ogb_dir', 'ogb_to_dataset', 'partition_ogb',
+           'save_binary']
+
+
+def _read_csv_gz(path: Path, dtype) -> np.ndarray:
+  """Comma-separated .csv.gz -> ndarray (no pandas dependency)."""
+  with gzip.open(path, 'rt') as f:
+    first = f.readline()
+    ncols = first.count(',') + 1
+  data = np.loadtxt(path, delimiter=',', dtype=dtype, ndmin=2)
+  return data if ncols > 1 else data.reshape(-1)
+
+
+def _find_split_dir(root: Path) -> Optional[Path]:
+  split = root / 'split'
+  if not split.is_dir():
+    return None
+  subs = sorted(d for d in split.iterdir() if d.is_dir())
+  return subs[0] if subs else split
+
+
+def load_ogb_dir(root) -> Dict[str, np.ndarray]:
+  """Read an OGB node-property dataset directory.
+
+  Returns ``{'edge_index': [2, E], 'num_nodes': int,
+  'node_feat': [N, D] | None, 'node_label': [N] | None,
+  'train_idx'/'valid_idx'/'test_idx': [..] | None}``.
+  """
+  root = Path(root)
+  if not root.exists():
+    raise FileNotFoundError(f'OGB dataset dir not found: {root}')
+  # binary layout first (fast path)
+  for stem in ('edge_index.npy', 'edge_index.npz'):
+    p = root / stem
+    if p.exists():
+      return _load_binary(root)
+  raw = root / 'raw'
+  if not (raw / 'edge.csv.gz').exists():
+    raise FileNotFoundError(
+        f'neither binary (edge_index.npy) nor raw CSV (raw/edge.csv.gz) '
+        f'layout under {root}')
+  edges = _read_csv_gz(raw / 'edge.csv.gz', np.int64)
+  edge_index = edges.T                          # [2, E]
+  nn_path = raw / 'num-node-list.csv.gz'
+  if nn_path.exists():
+    num_nodes = int(np.atleast_1d(_read_csv_gz(nn_path, np.int64))[0])
+  else:
+    num_nodes = int(edge_index.max()) + 1
+  out = {'edge_index': edge_index, 'num_nodes': num_nodes,
+         'node_feat': None, 'node_label': None,
+         'train_idx': None, 'valid_idx': None, 'test_idx': None}
+  nf = raw / 'node-feat.csv.gz'
+  if nf.exists():
+    out['node_feat'] = _read_csv_gz(nf, np.float32)
+  nl = raw / 'node-label.csv.gz'
+  if nl.exists():
+    out['node_label'] = np.atleast_1d(
+        _read_csv_gz(nl, np.int64).reshape(-1))
+  split = _find_split_dir(root)
+  if split is not None:
+    for name in ('train', 'valid', 'test'):
+      p = split / f'{name}.csv.gz'
+      if p.exists():
+        out[f'{name}_idx'] = np.atleast_1d(
+            _read_csv_gz(p, np.int64).reshape(-1))
+  return out
+
+
+def _load_binary(root: Path) -> Dict[str, np.ndarray]:
+  def maybe(stem):
+    for suffix in ('.npy', '.npz'):
+      p = root / f'{stem}{suffix}'
+      if p.exists():
+        d = np.load(p)
+        return d[d.files[0]] if hasattr(d, 'files') else d
+    return None
+  ei = maybe('edge_index')
+  if ei.shape[0] != 2:
+    ei = ei.T
+  feat = maybe('node_feat')
+  label = maybe('node_label')
+  n = maybe('num_nodes')
+  num_nodes = (int(np.atleast_1d(n)[0]) if n is not None
+               else (feat.shape[0] if feat is not None
+                     else int(ei.max()) + 1))
+  return {'edge_index': np.asarray(ei, np.int64), 'num_nodes': num_nodes,
+          'node_feat': feat,
+          'node_label': (np.asarray(label).reshape(-1)
+                         if label is not None else None),
+          'train_idx': maybe('train_idx'), 'valid_idx': maybe('valid_idx'),
+          'test_idx': maybe('test_idx')}
+
+
+def save_binary(root, out_dir) -> None:
+  """One-time raw-CSV -> binary conversion (seconds to reload after)."""
+  d = load_ogb_dir(root)
+  out = Path(out_dir)
+  out.mkdir(parents=True, exist_ok=True)
+  np.save(out / 'edge_index.npy', d['edge_index'])
+  np.save(out / 'num_nodes.npy', np.array([d['num_nodes']]))
+  for key in ('node_feat', 'node_label', 'train_idx', 'valid_idx',
+              'test_idx'):
+    if d[key] is not None:
+      np.save(out / f'{key}.npy', d[key])
+
+
+def ogb_to_dataset(root, split_ratio: float = 1.0,
+                   sort_hot: bool = False, dtype=None):
+  """Build a single-chip `Dataset` (+ split indices) from an OGB dir.
+
+  ``sort_hot`` applies the in-degree hot-row reorder before the
+  hot/cold feature split (`sort_by_in_degree`, reference
+  `data/reorder.py:19-31` — the `train_sage_ogbn_products` recipe).
+  Returns ``(dataset, splits)`` with ``splits = {'train': ..., ...}``.
+  """
+  from .dataset import Dataset
+  from .reorder import sort_by_in_degree
+  d = load_ogb_dir(root)
+  rows, cols = d['edge_index']
+  ds = Dataset().init_graph((rows, cols), layout='COO',
+                            num_nodes=d['num_nodes'])
+  if d['node_feat'] is not None:
+    ds.init_node_features(
+        d['node_feat'],
+        sort_func=sort_by_in_degree if sort_hot else None,
+        split_ratio=split_ratio, dtype=dtype)
+  if d['node_label'] is not None:
+    ds.init_node_labels(d['node_label'].astype(np.int32))
+  splits = {k: d[f'{k}_idx'] for k in ('train', 'valid', 'test')
+            if d[f'{k}_idx'] is not None}
+  return ds, splits
+
+
+def partition_ogb(root, out_dir, num_parts: int, seed: int = 0) -> None:
+  """Write the offline partition layout for an OGB dir — feeds
+  `DistDataset.from_partition_dir` / `HostDataset.from_partition_dir`
+  (reference `examples/distributed/partition_ogbn_dataset.py`)."""
+  from ..partition import RandomPartitioner
+  d = load_ogb_dir(root)
+  RandomPartitioner(out_dir, num_parts, d['num_nodes'],
+                    (d['edge_index'][0], d['edge_index'][1]),
+                    node_feat=d['node_feat'],
+                    node_label=(d['node_label'].astype(np.int32)
+                                if d['node_label'] is not None else None),
+                    seed=seed).partition()

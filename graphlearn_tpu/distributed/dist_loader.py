@@ -35,12 +35,11 @@ WorkerOptions = Union[CollocatedDistSamplingWorkerOptions,
 
 
 def edge_capacity(batch_size: int, fanouts: Sequence[int]) -> int:
-  """Static bound on total sampled edges across hops."""
-  total, width = 0, batch_size
-  for k in fanouts:
-    width *= int(k)
-    total += width
-  return max(round_up(total, 8), 8)
+  """Static bound on total sampled edges across hops — the ONE
+  worst-case count (`utils.padding.max_sampled_edges`) rounded to the
+  loader's lane multiple."""
+  from ..utils.padding import max_sampled_edges
+  return max(round_up(max_sampled_edges(batch_size, fanouts), 8), 8)
 
 
 class DistLoader:

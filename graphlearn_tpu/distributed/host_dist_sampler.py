@@ -291,7 +291,7 @@ class HostDistNeighborSampler(HostNeighborSampler):
       raise RuntimeError(
           'edge-feature cache miss: an emitted eid was never sampled '
           f'({eids[~found][:5]} ...)')
-    return cat_rows[order][pos]
+    return cat_rows[order[pos]]
 
   def _closure_out_edges(self, nodes: np.ndarray):
     """Ownership-split induced-subgraph scan: local shard scan + one

@@ -26,9 +26,17 @@ Constraints discovered on real hardware (Mosaic tiling rules):
     sublane tiling) — bf16 tables always take the XLA path.
   * 1-D arrays tile at 1024 elements, so *CSR neighbor-window* gathers
     at arbitrary ``indptr`` offsets are not DMA-able without a 4KB+
-    aligned overfetch per seed; the neighbor sampler's XLA gather
-    (`ops/neighbor.py`) already exceeds the reference baseline ~15x on
-    v5e, so sampling stays on XLA by design.
+    aligned overfetch per seed.  MEASURED (r3, `ops/pallas_window.py`
+    + `benchmarks/bench_pallas_window.py`, v5e, products-scale 61M-edge
+    CSR, 8192 seeds x 128-wide windows): the aligned-overfetch DMA
+    kernel (two (8,128) units = 8 KB per seed, lane+sublane-rotate
+    extraction, best tile 8) reaches **8.9 GB/s of useful window
+    bytes** vs the XLA element gather's **362 GB/s** — a 40x loss
+    (16x of it inherent overfetch, the rest per-row DMA latency that
+    small 8 KB transfers cannot amortize).  The full
+    `sample_one_hop` runs at ~385 M seeds/s (k=15) on the same input.
+    Sampling therefore stays on XLA as a measured decision, no longer
+    a design assertion.
 """
 from __future__ import annotations
 
@@ -133,7 +141,7 @@ def _gather_rows_dma(table: jax.Array, idx: jax.Array, *,
   grid_spec = pltpu.PrefetchScalarGridSpec(
       num_scalar_prefetch=1,
       grid=(bp // tile,),
-      in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+      in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
       out_specs=pl.BlockSpec(
           (tile, d), lambda t, idx_ref: (t, 0), memory_space=pltpu.VMEM),
       scratch_shapes=[pltpu.SemaphoreType.DMA((tile,))],

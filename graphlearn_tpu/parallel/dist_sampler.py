@@ -650,7 +650,8 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
                              axis: str = 'data',
                              with_cache: bool = False,
                              exchange_slack: Optional[float] = None,
-                             tiered: bool = False):
+                             tiered: bool = False,
+                             hop_chunk: Optional[int] = None):
   """Build the jitted SPMD INDUCED-SUBGRAPH step — the device-mesh
   analog of reference ``DistNeighborSampler._subgraph``
   (`distributed/dist_neighbor_sampler.py:456-516`).
@@ -665,8 +666,21 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
   all_gather is needed; edge (u, v) is emitted exactly once, by u's
   window, in natural (source, dest) direction like the single-chip
   `ops.subgraph.induced_subgraph`.
+
+  ``hop_chunk`` bounds the full-window exchange: the node table is
+  scanned in chunks of that many closure nodes, so every all_to_all
+  buffer is ``[P, chunk]`` requests / ``[P, chunk, max_degree]``
+  replies instead of ``[P, node_cap]`` — the SEAL-at-scale envelope
+  (VERDICT r2 item 7): peak exchange width becomes
+  ``chunk * P * max_degree`` regardless of closure size, at the cost
+  of ``ceil(node_cap / chunk)`` serialized exchanges.  Results are
+  EXACT either way (each chunk's window is still unsampled).
   """
   from .shard_map_compat import shard_map
+  chunk = node_cap if hop_chunk is None else max(int(hop_chunk), 1)
+  chunk = min(chunk, node_cap)
+  n_chunks = -(-node_cap // chunk)
+  pad_cap = n_chunks * chunk
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
                  lshard_s, cids_s, crows_s, hcounts, key):
@@ -684,12 +698,31 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
         hot_counts=hcounts if tiered else None)
 
     nodes = state.nodes                              # [node_cap]
-    nbrs, mask, eids, hstats = _dist_one_hop(
-        indptr_s[0], indices_s[0], eids_s[0] if with_edge else None,
-        bounds, nodes, max_degree, key, axis, num_parts, with_edge,
-        exchange_capacity=_slack_cap(node_cap, num_parts,
-                                     exchange_slack))
-    stats = stats.at[:3].add(jnp.stack(hstats))
+    nodes_pad = jnp.concatenate(
+        [nodes, jnp.full((pad_cap - node_cap,), INVALID_ID,
+                         nodes.dtype)]) if pad_cap > node_cap else nodes
+    nbrs_parts, mask_parts, eids_parts = [], [], []
+    for ci in range(n_chunks):
+      frontier_c = jax.lax.dynamic_slice_in_dim(nodes_pad, ci * chunk,
+                                                chunk)
+      nb, mk, ei, hstats = _dist_one_hop(
+          indptr_s[0], indices_s[0], eids_s[0] if with_edge else None,
+          bounds, frontier_c, max_degree,
+          # per-chunk fold: with a truncating max_degree the window
+          # draws must stay independent across chunks
+          jax.random.fold_in(key, ci), axis, num_parts,
+          with_edge,
+          exchange_capacity=_slack_cap(chunk, num_parts,
+                                       exchange_slack))
+      stats = stats.at[:3].add(jnp.stack(hstats))
+      nbrs_parts.append(nb)
+      mask_parts.append(mk)
+      if with_edge:
+        eids_parts.append(ei)
+    nbrs = jnp.concatenate(nbrs_parts)[:node_cap]
+    mask = jnp.concatenate(mask_parts)[:node_cap]
+    eids = (jnp.concatenate(eids_parts)[:node_cap] if with_edge
+            else None)
     big = jnp.iinfo(jnp.int32).max
     keyed = jnp.where(nodes >= 0, nodes, big)
     order = jnp.argsort(keyed)
@@ -1011,15 +1044,21 @@ class DistSubGraphSampler(DistNeighborSampler):
   Args:
     max_degree: static per-node neighbor window for the induced scan;
       None = the sharded graph's true max degree (exact results).
+    hop_chunk: closure nodes per full-window exchange — bounds the
+      all_to_all to ``[P, chunk, max_degree]`` (SEAL-at-scale
+      envelope; see `_make_dist_subgraph_step`).  None = one
+      node_cap-wide exchange.
   """
 
   def __init__(self, dataset: DistDataset, num_neighbors,
-               max_degree: Optional[int] = None, **kwargs):
+               max_degree: Optional[int] = None,
+               hop_chunk: Optional[int] = None, **kwargs):
     super().__init__(dataset, num_neighbors, **kwargs)
     if max_degree is None:
       g = dataset.graph
       max_degree = int(np.diff(g.indptr, axis=1).max())
     self.max_degree = max(int(max_degree), 1)
+    self.hop_chunk = hop_chunk
 
   def sample_subgraph(self, seeds_stacked: np.ndarray):
     """``seeds_stacked``: ``[P, B]`` per-device seed batches (relabeled
@@ -1034,7 +1073,8 @@ class DistSubGraphSampler(DistNeighborSampler):
           self.mesh, self.num_parts, self.fanouts, node_cap,
           self.max_degree, self.with_edge, self.collect_features,
           self.collect_labels, self.axis, with_cache=self.with_cache,
-          exchange_slack=self.exchange_slack, tiered=self.tiered)
+          exchange_slack=self.exchange_slack, tiered=self.tiered,
+          hop_chunk=self.hop_chunk)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -1114,20 +1154,24 @@ class DistSubGraphLoader:
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                max_degree: Optional[int] = None, seed: int = 0,
-               input_space: str = 'old', exchange_slack='auto'):
+               input_space: str = 'old', exchange_slack='auto',
+               hop_chunk: Optional[int] = None):
     from ..loader.node_loader import SeedBatcher
     # 'auto' resolves to EXACT here, shuffled or not: a dropped
     # closure node under a capacity cap loses its whole neighbor
     # window, making the "induced subgraph" silently wrong (for
     # neighbor sampling a drop is a statistical under-sample; for
     # SEAL/DRNL it corrupts labels).  An explicit float still opts in.
+    # `hop_chunk` is the scale lever that keeps exact affordable: it
+    # bounds every full-window exchange to [P, chunk, max_degree].
     if exchange_slack == 'auto':
       exchange_slack = None
     self.sampler = DistSubGraphSampler(
         dataset, num_neighbors, max_degree=max_degree, mesh=mesh,
         with_edge=with_edge, collect_features=collect_features,
         seed=seed,
-        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
+        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle),
+        hop_chunk=hop_chunk)
     self.ds = dataset
     seeds = np.asarray(input_nodes).reshape(-1)
     if input_space == 'old' and dataset.old2new is not None:

@@ -91,3 +91,30 @@ def test_mesh_subgraph_truncated_window_counts_drops():
       u = int(new2old[node[p, ei[p, 0, i]]])
       v = int(new2old[node[p, ei[p, 1, i]]])
       assert (u, v) in edge_set
+
+
+def test_mesh_subgraph_hop_chunk_exact():
+  """Chunked full-window hops (the SEAL-at-scale bound, hop_chunk)
+  must produce the SAME subgraphs as one node_cap-wide exchange — the
+  window is exact either way, only the exchange width changes."""
+  rows, cols = _graph()
+  feats = np.tile(np.arange(N, dtype=np.float32)[:, None], (1, 3))
+  ds = DistDataset.from_full_graph(8, rows, cols, node_feat=feats,
+                                   num_nodes=N)
+  results = []
+  for chunk in (None, 8):
+    loader = DistSubGraphLoader(ds, [3, 3], np.arange(16), batch_size=2,
+                                shuffle=False, mesh=make_mesh(8),
+                                with_edge=True, seed=0, hop_chunk=chunk)
+    edges = []
+    for batch in loader:
+      node = np.asarray(batch.node)
+      ei = np.asarray(batch.edge_index)
+      em = np.asarray(batch.edge_mask)
+      for p in range(8):
+        es = {(int(ds.new2old[node[p, ei[p, 0, i]]]),
+               int(ds.new2old[node[p, ei[p, 1, i]]]))
+              for i in np.nonzero(em[p])[0]}
+        edges.append(es)
+    results.append(edges)
+  assert results[0] == results[1]
