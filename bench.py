@@ -233,14 +233,18 @@ def _run_dist_section(timeout: int):
 def main():
   sessions = int(os.environ.get('GLT_BENCH_SESSIONS', 5))
   build_graph_csr(NUM_NODES)      # warm the /tmp graph+CSR caches once
-  session_timeout = int(os.environ.get('GLT_BENCH_SESSION_TIMEOUT', 480))
-  # fast sessions do LESS WORK, not less time: on a tunnel-slow day
-  # the full session may eat its whole timeout, and the fast protocol
-  # (half the work) still needs most of it
+  # measured ~410 s per session on an idle box (fixed overhead — the
+  # ~1 GB feature device_put over the tunnel — dominates); 600 leaves
+  # headroom for load without letting a wedged chip eat the budget
+  session_timeout = int(os.environ.get('GLT_BENCH_SESSION_TIMEOUT', 600))
+  # fast sessions do LESS WORK, not less time: the fixed overhead is
+  # identical, so a shorter timeout would just re-lose them on slow
+  # days (r2's failure mode)
   fast_timeout = session_timeout
   # hard wall for the whole harness: tunnel-slow days must yield a
-  # degraded (fewer-session) number, never a timeout with NO number
-  total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 1500))
+  # degraded (fewer-session) number, never a timeout with NO number;
+  # sized for 3 x 600 s sessions + the dist phase
+  total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 2400))
   # measured ~5.5 min on this box (compile dominates); the wall keeps
   # a wedged mesh from eating the whole budget, not a perf target
   dist_timeout = int(os.environ.get('GLT_BENCH_DIST_TIMEOUT', 600))
@@ -260,11 +264,13 @@ def main():
     fast = attempts > 0
     tmo = fast_timeout if fast else session_timeout
     # the session floor is the hard deliverable (r2 shipped 2): only
-    # once it's met does the budget guard start reserving the dist phase
+    # once it's met does the budget guard start reserving the dist
+    # phase.  The wall also binds with ZERO results — a wedged chip
+    # must fail within ~the budget, not after sessions+3 timeouts.
     reserve = dist_timeout if len(results) >= floor else 60
-    if results and budget_left() < tmo + reserve:
-      print(f'budget: stopping after {len(results)} sessions',
-            file=sys.stderr)
+    if attempts > 0 and budget_left() < tmo + reserve:
+      print(f'budget: stopping after {len(results)} sessions '
+            f'({attempts} attempts)', file=sys.stderr)
       break
     if attempts >= sessions and len(results) >= 3:
       break

@@ -35,6 +35,12 @@ def main():
   ap.add_argument('--fanout', type=int, nargs='+', default=[4, 4])
   ap.add_argument('--hidden', type=int, default=64)
   ap.add_argument('--heads', type=int, default=2)
+  ap.add_argument('--split-ratio', type=float, default=1.0,
+                  help='fraction of each node type\'s feature rows in '
+                       'HBM; < 1 tiers the rest to host DRAM — the '
+                       'IGBH-large "features exceed aggregate HBM" '
+                       'lever (cold misses overlaid per batch, '
+                       'hit rate in exchange_stats)')
   args = ap.parse_args()
 
   import jax
@@ -58,8 +64,8 @@ def main():
     assert disk_parts == num_parts, (
         f'partition layout has {disk_parts} parts but the mesh has '
         f'{num_parts} devices — repartition or set --num-parts')
-    ds = DistHeteroDataset.from_partition_dir(args.partition_dir,
-                                              num_parts)
+    ds = DistHeteroDataset.from_partition_dir(
+        args.partition_dir, num_parts, split_ratio=args.split_ratio)
     assert PAPER in ds.node_labels, 'training needs paper labels'
     npaper = ds.num_nodes_dict()[PAPER]
     classes = int(np.max(ds.node_labels[PAPER])) + 1
@@ -68,7 +74,8 @@ def main():
     npaper, classes = len(topic), int(topic.max()) + 1
     ds = DistHeteroDataset.from_full_graph(
         num_parts, edges, node_feat_dict=feats,
-        node_label_dict={PAPER: topic}, num_nodes_dict=nnodes)
+        node_label_dict={PAPER: topic}, num_nodes_dict=nnodes,
+        split_ratio=args.split_ratio)
 
   bs = args.batch_size
   loader = DistHeteroNeighborLoader(

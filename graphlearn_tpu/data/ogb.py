@@ -34,6 +34,17 @@ __all__ = ['load_ogb_dir', 'ogb_to_dataset', 'partition_ogb',
            'save_binary']
 
 
+def _squeeze_labels(label) -> Optional[np.ndarray]:
+  """[N] / [N, 1] -> [N]; multi-task [N, K>1] keeps its shape
+  (flattening would silently misalign labels with nodes)."""
+  if label is None:
+    return None
+  label = np.asarray(label)
+  if label.ndim == 1 or label.shape[1] == 1:
+    return label.reshape(-1)
+  return label
+
+
 def _read_csv_gz(path: Path, dtype) -> np.ndarray:
   """Comma-separated .csv.gz -> ndarray (no pandas dependency)."""
   with gzip.open(path, 'rt') as f:
@@ -55,7 +66,8 @@ def load_ogb_dir(root) -> Dict[str, np.ndarray]:
   """Read an OGB node-property dataset directory.
 
   Returns ``{'edge_index': [2, E], 'num_nodes': int,
-  'node_feat': [N, D] | None, 'node_label': [N] | None,
+  'node_feat': [N, D] | None, 'node_label': [N] (single-task) or
+  [N, K] (multi-task, e.g. ogbn-proteins) | None,
   'train_idx'/'valid_idx'/'test_idx': [..] | None}``.
   """
   root = Path(root)
@@ -86,8 +98,7 @@ def load_ogb_dir(root) -> Dict[str, np.ndarray]:
     out['node_feat'] = _read_csv_gz(nf, np.float32)
   nl = raw / 'node-label.csv.gz'
   if nl.exists():
-    out['node_label'] = np.atleast_1d(
-        _read_csv_gz(nl, np.int64).reshape(-1))
+    out['node_label'] = _squeeze_labels(_read_csv_gz(nl, np.int64))
   split = _find_split_dir(root)
   if split is not None:
     for name in ('train', 'valid', 'test'):
@@ -117,8 +128,7 @@ def _load_binary(root: Path) -> Dict[str, np.ndarray]:
                      else int(ei.max()) + 1))
   return {'edge_index': np.asarray(ei, np.int64), 'num_nodes': num_nodes,
           'node_feat': feat,
-          'node_label': (np.asarray(label).reshape(-1)
-                         if label is not None else None),
+          'node_label': _squeeze_labels(label),
           'train_idx': maybe('train_idx'), 'valid_idx': maybe('valid_idx'),
           'test_idx': maybe('test_idx')}
 

@@ -42,7 +42,8 @@ from ..utils.padding import INVALID_ID
 from .dist_data import build_dist_edge_feature, build_dist_feature
 from .dist_sampler import (ExchangeTelemetry, NEG_TRIALS, _dist_one_hop,
                            _slack_cap, dist_gather_multi,
-                           dist_sample_negative, resolve_exchange_slack)
+                           dist_sample_negative, overlay_cold_host,
+                           resolve_exchange_slack)
 
 
 class DistHeteroDataset:
@@ -90,11 +91,15 @@ class DistHeteroDataset:
                       node_feat_dict=None, node_label_dict=None,
                       num_nodes_dict=None, node_pb_dict=None,
                       seed: int = 0, edge_feat_dict=None,
-                      edge_ids_dict=None) -> 'DistHeteroDataset':
+                      edge_ids_dict=None,
+                      split_ratio: float = 1.0) -> 'DistHeteroDataset':
     """In-memory partition + shard (testing & single-host path) — the
     hetero analog of `DistDataset.from_full_graph`.  ``edge_ids_dict``
     preserves caller-global edge ids (``edge_feat_dict`` rows index by
-    them); defaults to input order per etype."""
+    them); defaults to input order per etype.  ``split_ratio < 1``
+    tiers every node-type feature store (HBM hot / host-DRAM cold,
+    hotness = cross-etype in-degree) — the IGBH-scale lever
+    (`build_dist_feature`)."""
     node_feat_dict = node_feat_dict or {}
     node_label_dict = node_label_dict or {}
     num_nodes_dict = dict(num_nodes_dict or {})
@@ -108,6 +113,15 @@ class DistHeteroDataset:
     for nt, f in node_feat_dict.items():
       num_nodes_dict[nt] = max(num_nodes_dict.get(nt, 0), len(f))
 
+    hotness = {}
+    if split_ratio < 1.0:
+      # hotness = in-degree summed over every etype landing on nt
+      hotness = {nt: np.zeros(num_nodes_dict[nt], np.int64)
+                 for nt in ntypes}
+      for (s, _, d), (rows, cols) in edge_index_dict.items():
+        hotness[d] += np.bincount(np.asarray(cols),
+                                  minlength=num_nodes_dict[d])
+
     rng = np.random.default_rng(seed)
     node_pb_dict = dict(node_pb_dict or {})
     old2new, bounds = {}, {}
@@ -120,7 +134,10 @@ class DistHeteroDataset:
         for p in range(num_parts):
           pb[perm[p::num_parts]] = p
         node_pb_dict[nt] = pb
-      order = np.argsort(pb, kind='stable')
+      if nt in hotness:
+        order = np.lexsort((np.arange(n), -hotness[nt], pb))
+      else:
+        order = np.argsort(pb, kind='stable')
       m = np.empty(n, dtype=np.int64)
       m[order] = np.arange(n)
       old2new[nt] = m
@@ -135,7 +152,8 @@ class DistHeteroDataset:
           bounds[s], num_parts,
           edge_ids=(edge_ids_dict or {}).get(et))
 
-    feats = {nt: build_dist_feature(f, old2new[nt], bounds[nt])
+    feats = {nt: build_dist_feature(f, old2new[nt], bounds[nt],
+                                    split_ratio=split_ratio)
              for nt, f in node_feat_dict.items()}
     labels = {}
     for nt, lab in node_label_dict.items():
@@ -147,10 +165,12 @@ class DistHeteroDataset:
                edge_features=efeats)
 
   @classmethod
-  def from_partition_dir(cls, root, num_parts: Optional[int] = None
+  def from_partition_dir(cls, root, num_parts: Optional[int] = None,
+                         split_ratio: float = 1.0
                          ) -> 'DistHeteroDataset':
     """Assemble from the offline partitioner's hetero layout
-    (`partition/base.py` hetero branch; reference `DistDataset.load`)."""
+    (`partition/base.py` hetero branch; reference `DistDataset.load`).
+    ``split_ratio < 1`` tiers every node-type feature store."""
     from ..partition import load_partition
     p0 = load_partition(root, 0)
     meta = p0['meta']
@@ -210,7 +230,7 @@ class DistHeteroDataset:
         num_nodes_dict={nt: int(meta['num_nodes'][nt])
                         for nt in meta['node_types']},
         node_pb_dict=node_pb_dict, edge_feat_dict=edge_feat_dict,
-        edge_ids_dict=edge_ids_dict)
+        edge_ids_dict=edge_ids_dict, split_ratio=split_ratio)
 
 
 def _build_etype_graph(rows_new: np.ndarray, cols_new: np.ndarray,
@@ -289,7 +309,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
       repl = NamedSharding(self.mesh, P())
       put = jax.device_put
       arrs = {'graphs': {}, 'bounds': {}, 'feats': {}, 'labels': {},
-              'efeats': {}}
+              'efeats': {}, 'hcounts': {}}
       for et in self.etypes:
         g = self.ds.graphs[et]
         arrs['graphs'][et] = (put(g.indptr, shard), put(g.indices, shard),
@@ -299,6 +319,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
       if self.collect_features:
         for nt, f in self.ds.node_features.items():
           arrs['feats'][nt] = put(f.shards, shard)
+          arrs['hcounts'][nt] = put(
+              np.asarray(f.hot_counts, np.int32), repl)
         if self.with_edge:
           # only fanout-selected etypes sample edges; features of
           # unselected etypes would never be gathered (and their
@@ -329,6 +351,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
     feat_nts = tuple(sorted(arrs['feats'])) if self.collect_features else ()
     label_nts = tuple(sorted(arrs['labels']))
     efeat_ets = tuple(sorted(arrs['efeats']))
+    tiered_nts = {nt: self.ds.node_features[nt].is_tiered
+                  for nt in feat_nts}
     # per-TABLE ownership scheme: a mixed mod/range edge_features dict
     # must not collapse to one global mode (wrong-owner gathers return
     # silent zeros)
@@ -338,7 +362,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
     exchange_slack = self.exchange_slack
 
     def per_device(graphs_t, bounds_t, feats_t, labels_t, efeats_t,
-                   ebounds_t, seeds_s, key):
+                   ebounds_t, hcounts_t, seeds_s, key):
       graphs = {et: tuple(a[0] for a in g)
                 for et, g in zip(etypes, graphs_t)}
       bounds = dict(zip(ntypes, bounds_t))
@@ -346,6 +370,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
       lshards = {nt: l[0] for nt, l in zip(label_nts, labels_t)}
       efshards = {et: f[0] for et, f in zip(efeat_ets, efeats_t)}
       ebounds = dict(zip(efeat_ets, ebounds_t))
+      hcounts = dict(zip(feat_nts, hcounts_t))
       seeds = seeds_s[0]
 
       neg_ok = None
@@ -452,7 +477,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
             (fshards[nt],), bounds[nt], states[nt].nodes, axis,
             num_parts,
             exchange_capacity=_slack_cap(table_cap[nt], num_parts,
-                                         exchange_slack))
+                                         exchange_slack),
+            hot_counts=hcounts[nt] if tiered_nts[nt] else None)
         ft_stats = ft_stats + jnp.stack(gstats)
       y = {}
       for nt in label_nts:
@@ -517,6 +543,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
         tuple(sh for _ in label_nts),             # label shards
         tuple(sh for _ in efeat_ets),             # edge-feature shards
         tuple(rp for _ in efeat_ets),             # edge-feature bounds
+        tuple(rp for _ in feat_nts),              # feature hot counts
         sh,                                       # seeds
         rp,                                       # key
     )
@@ -533,6 +560,25 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
     meta = dict(ntypes=ntypes, feat_nts=feat_nts, label_nts=label_nts,
                 seed_types=seed_types, efeat_ets=efeat_ets)
     return jax.jit(sharded), meta
+
+  def _overlay_cold_types(self, feat_nts, ntypes, x_t, node_t):
+    """Per-node-type cold-tier overlay (+ telemetry) for tiered
+    feature stores — the hetero arm of
+    `dist_sampler.overlay_cold_host`."""
+    out = []
+    for nt, x in zip(feat_nts, x_t):
+      nf = self.ds.node_features[nt]
+      if x is None or not nf.is_tiered:
+        out.append(x)
+        continue
+      nodes = node_t[ntypes.index(nt)]
+      x, lookups, misses = overlay_cold_host(
+          x, nodes, self.ds.bounds[nt], nf.hot_counts, nf.cold_host,
+          self.mesh, self.axis, self.num_parts)
+      self._cold_lookups += lookups
+      self._cold_misses += misses
+      out.append(x)
+    return tuple(out)
 
   def sample_from_nodes(self, input_type: NodeType,
                         seeds_stacked: np.ndarray):
@@ -556,10 +602,14 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
     labels_t = tuple(arrs['labels'][nt] for nt in meta['label_nts'])
     efeats_t = tuple(arrs['efeats'][et][0] for et in meta['efeat_ets'])
     ebounds_t = tuple(arrs['efeats'][et][1] for et in meta['efeat_ets'])
+    hcounts_t = tuple(arrs['hcounts'][nt] for nt in meta['feat_nts'])
     (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t, ef_t,
      nsn_t, _, stats) = step(graphs_t, bounds_t, feats_t, labels_t,
-                             efeats_t, ebounds_t, seeds_dev, key)
+                             efeats_t, ebounds_t, hcounts_t, seeds_dev,
+                             key)
     self._accumulate_stats(stats)
+    x_t = self._overlay_cold_types(meta['feat_nts'], meta['ntypes'],
+                                   x_t, node_t)
     seed_local = sl_t[meta['seed_types'].index(input_type)]
     ntypes = meta['ntypes']
     out = dict(
@@ -633,10 +683,14 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
     labels_t = tuple(arrs['labels'][nt] for nt in meta['label_nts'])
     efeats_t = tuple(arrs['efeats'][e][0] for e in meta['efeat_ets'])
     ebounds_t = tuple(arrs['efeats'][e][1] for e in meta['efeat_ets'])
+    hcounts_t = tuple(arrs['hcounts'][nt] for nt in meta['feat_nts'])
     (node_t, cnt_t, row_t, col_t, eid_t, sl_t, x_t, y_t, ef_t, nsn_t,
      neg_ok, stats) = step(graphs_t, bounds_t, feats_t, labels_t,
-                           efeats_t, ebounds_t, pairs_dev, key)
+                           efeats_t, ebounds_t, hcounts_t, pairs_dev,
+                           key)
     self._accumulate_stats(stats)
+    x_t = self._overlay_cold_types(meta['feat_nts'], meta['ntypes'],
+                                   x_t, node_t)
     ntypes = meta['ntypes']
     seed_types = meta['seed_types']
     sl = dict(zip(seed_types, sl_t))

@@ -953,52 +953,65 @@ class DistNeighborSampler(ExchangeTelemetry):
                 num_sampled_nodes=nsn, batch=seeds_dev)
 
   def _maybe_overlay_cold(self, x, nodes):
-    """Overlay host-DRAM cold-tier rows onto the exchanged features.
-
-    Tiered stores serve only HBM-hot rows through the all_to_all
-    (owners zero rows past their hot count); the cold remainder is
-    host-gathered into a COMPACT replicated buffer and expanded on
-    device by a rank map — the same compact-transfer trade as the
-    single-chip mixed path (`data/feature.py.__getitem__`), stacked.
-    The explicit, per-batch analog of the reference's UVA reads
-    (`csrc/cuda/unified_tensor.cu:202+`).  Costs one device sync for
-    the node table — the honest price of exceeding HBM.
-    """
+    """Overlay host-DRAM cold-tier rows onto the exchanged features
+    (see :func:`overlay_cold_host`) and tick the cold telemetry."""
     if not self.tiered or x is None:
       return x
     nf = self.ds.node_features
-    bounds = self.ds.graph.bounds
-    nodes_h = np.asarray(jax.device_get(nodes)).astype(np.int64)
-    owner = np.clip(np.searchsorted(bounds, nodes_h, side='right') - 1,
-                    0, self.num_parts - 1)
-    valid = nodes_h >= 0
-    local = np.where(valid, nodes_h - bounds[owner], 0)
-    cold = valid & (local >= nf.hot_counts[owner])
-    self._cold_lookups += int(valid.sum())
-    n_cold = int(cold.sum())
-    self._cold_misses += n_cold
-    if n_cold == 0:
-      return x
-    from ..utils.padding import next_power_of_two
-    cold_pad = next_power_of_two(n_cold)
-    compact = np.zeros((cold_pad, nf.cold_host.shape[1]),
-                       nf.cold_host.dtype)
-    compact[:n_cold] = nf.cold_host[nodes_h[cold]]
-    flat = cold.reshape(-1)
-    rank = np.where(flat, np.cumsum(flat) - 1,
-                    0).astype(np.int32).reshape(cold.shape)
-    shard = NamedSharding(self.mesh, P(self.axis))
-    repl = NamedSharding(self.mesh, P())
-    return _overlay_cold_rows(x, jax.device_put(cold, shard),
-                              jax.device_put(rank, shard),
-                              jax.device_put(compact, repl))
+    x, lookups, misses = overlay_cold_host(
+        x, nodes, self.ds.graph.bounds, nf.hot_counts, nf.cold_host,
+        self.mesh, self.axis, self.num_parts)
+    self._cold_lookups += lookups
+    self._cold_misses += misses
+    return x
 
 
 @jax.jit
 def _overlay_cold_rows(x, mask, rank, compact):
   """``x[p, i] = compact[rank[p, i]] where mask`` — the device half of
-  the cold-tier overlay (`DistNeighborSampler._maybe_overlay_cold`)."""
+  the cold-tier overlay (`overlay_cold_host`)."""
   return jnp.where(mask[..., None], compact[rank], x)
+
+
+def overlay_cold_host(x, nodes, bounds, hot_counts, cold_host, mesh,
+                      axis: str, num_parts: int):
+  """Serve cold-tier rows (host DRAM) for node-table entries the HBM
+  exchange zeroed — shared by the homo and hetero mesh engines.
+
+  Tiered stores serve only HBM-hot rows through the all_to_all
+  (owners zero rows past their hot count); the cold remainder is
+  host-gathered into a COMPACT replicated buffer and expanded on
+  device by a rank map — the same compact-transfer trade as the
+  single-chip mixed path (`data/feature.py.__getitem__`), stacked.
+  The explicit, per-batch analog of the reference's UVA reads
+  (`csrc/cuda/unified_tensor.cu:202+`).  Costs one device sync for
+  the node table — the honest price of exceeding HBM.
+
+  Returns ``(x', lookups, misses)`` for the caller's telemetry.
+  """
+  from ..utils.padding import next_power_of_two
+  nodes_h = np.asarray(jax.device_get(nodes)).astype(np.int64)
+  owner = np.clip(np.searchsorted(bounds, nodes_h, side='right') - 1,
+                  0, num_parts - 1)
+  valid = nodes_h >= 0
+  local = np.where(valid, nodes_h - bounds[owner], 0)
+  cold = valid & (local >= hot_counts[owner])
+  lookups = int(valid.sum())
+  n_cold = int(cold.sum())
+  if n_cold == 0:
+    return x, lookups, 0
+  cold_pad = next_power_of_two(n_cold)
+  compact = np.zeros((cold_pad, cold_host.shape[1]), cold_host.dtype)
+  compact[:n_cold] = cold_host[nodes_h[cold]]
+  flat = cold.reshape(-1)
+  rank = np.where(flat, np.cumsum(flat) - 1,
+                  0).astype(np.int32).reshape(cold.shape)
+  shard = NamedSharding(mesh, P(axis))
+  repl = NamedSharding(mesh, P())
+  out = _overlay_cold_rows(x, jax.device_put(cold, shard),
+                           jax.device_put(rank, shard),
+                           jax.device_put(compact, repl))
+  return out, lookups, n_cold
 
 
 def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,

@@ -214,20 +214,22 @@ def test_hetero_partition_roundtrip_host_dataset():
       for u in range(NU):
         for j in range(indptr[u], indptr[u + 1]):
           assert (u, int(indices[j])) in edge_set
-      # a loader over the shard's own seeds works end-to-end
-      owned_u = np.nonzero(
-          np.diff(indptr) > 0)[0] if idx == 0 else np.arange(NU)
-      if len(owned_u):
-        loader = DistNeighborLoader(shard, [2], ('u', owned_u[:8]),
-                                    batch_size=4, to_device=False)
-        for batch in loader:
-          ei = np.asarray(batch.edge_index_dict[REV])
-          u_ids = np.asarray(batch.node_dict['u'])
-          i_ids = np.asarray(batch.node_dict['i'])
-          m = ei[0] >= 0
-          for a, b in zip(u_ids[ei[1, m]].tolist(),
-                          i_ids[ei[0, m]].tolist()):
-            assert (a, b) in edge_set
+      # a local-only loader over a SHARD is REFUSED (r3 guard: it
+      # would silently under-sample remote neighborhoods); the full
+      # graph still loads fine
+      with pytest.raises(ValueError, match='partition shard'):
+        DistNeighborLoader(shard, [2], ('u', np.arange(8)),
+                           batch_size=4, to_device=False)
+    loader = DistNeighborLoader(ds, [2], ('u', np.arange(8)),
+                                batch_size=4, to_device=False)
+    for batch in loader:
+      ei = np.asarray(batch.edge_index_dict[REV])
+      u_ids = np.asarray(batch.node_dict['u'])
+      i_ids = np.asarray(batch.node_dict['i'])
+      m = ei[0] >= 0
+      for a, b in zip(u_ids[ei[1, m]].tolist(),
+                      i_ids[ei[0, m]].tolist()):
+        assert (a, b) in edge_set
 
 
 def test_hetero_error_paths_and_config_reuse():

@@ -141,3 +141,22 @@ def test_accuracy_harness_ingestion_path(tmp_path):
     correct += int(c)
     total += int(t)
   assert correct / total > 0.9, correct / total
+
+
+def test_multitask_labels_keep_shape(tmp_path):
+  """Multi-column label tables (ogbn-proteins style) must keep [N, K]
+  — flattening would silently misalign labels with nodes."""
+  _write_raw(tmp_path)
+  raw = tmp_path / 'raw'
+  (raw / 'node-label.csv.gz').unlink()
+  lab = np.arange(N * 3).reshape(N, 3)
+  with gzip.open(raw / 'node-label.csv.gz', 'wt') as f:
+    for row in lab:
+      f.write(','.join(str(v) for v in row) + '\n')
+  d = load_ogb_dir(tmp_path)
+  assert d['node_label'].shape == (N, 3)
+  np.testing.assert_array_equal(d['node_label'], lab)
+  out = tmp_path / 'bin'
+  save_binary(tmp_path, out)
+  d2 = load_ogb_dir(out)
+  assert d2['node_label'].shape == (N, 3)
