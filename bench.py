@@ -184,7 +184,7 @@ def dist_worker():
   waste = 100.0 * (1 - sent / max(st['dist.frontier.slots'], 1))
   drop = 100.0 * st['dist.frontier.dropped'] / max(
       st['dist.frontier.offered'], 1)
-  print(json.dumps({
+  out = {
       'label': 'virtual CPU mesh - relative only',
       'num_parts': DIST_PARTS, 'batch': BATCH, 'fanout': list(FANOUT),
       'num_nodes': DIST_NODES, 'batches': n_batches,
@@ -192,7 +192,40 @@ def dist_worker():
       'seeds_per_sec': round(n_batches * BATCH * DIST_PARTS / dt, 1),
       'padding_waste_pct': round(waste, 2),
       'drop_rate_pct': round(drop, 3),
-  }), flush=True)
+  }
+  # base numbers are safe NOW: if the tiered phase below times out or
+  # fails, the harness parser takes the last printed JSON line — this
+  # one — instead of losing everything
+  print(json.dumps(out), flush=True)
+  # tiered store in the MEASURED path (r2 weak #1: the cold tier never
+  # appeared in a bench number): same workload, 30% of each
+  # partition's rows in "HBM", the rest served by the host overlay
+  ds_t = DistDataset.from_full_graph(DIST_PARTS, rows, cols,
+                                     node_feat=feats, node_label=labels,
+                                     num_nodes=DIST_NODES,
+                                     split_ratio=0.3)
+  lt = DistNeighborLoader(ds_t, list(FANOUT),
+                          seeds[:BATCH * DIST_PARTS * 4],
+                          batch_size=BATCH, shuffle=True,
+                          mesh=make_mesh(DIST_PARTS), seed=0)
+  it = iter(lt)
+  b = next(it)
+  b.x.block_until_ready()
+  t0 = time.perf_counter()
+  nt = 0
+  for b in it:
+    b.x.block_until_ready()
+    nt += 1
+  dt_t = time.perf_counter() - t0
+  st_t = lt.sampler.exchange_stats(tick_metrics=False)
+  out['tiered'] = {
+      'split_ratio': 0.3,
+      'seeds_per_sec': round(nt * BATCH * DIST_PARTS / max(dt_t, 1e-9),
+                             1),
+      'cold_hit_rate': round(st_t['dist.feature.cold_hit_rate'], 4),
+      'cold_misses': st_t['dist.feature.cold_misses'],
+  }
+  print(json.dumps(out), flush=True)
 
 
 def _run_session(fast: bool, timeout: int):

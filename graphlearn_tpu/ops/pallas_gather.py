@@ -28,15 +28,18 @@ Constraints discovered on real hardware (Mosaic tiling rules):
     at arbitrary ``indptr`` offsets are not DMA-able without a 4KB+
     aligned overfetch per seed.  MEASURED (r3, `ops/pallas_window.py`
     + `benchmarks/bench_pallas_window.py`, v5e, products-scale 61M-edge
-    CSR, 8192 seeds x 128-wide windows): the aligned-overfetch DMA
-    kernel (two (8,128) units = 8 KB per seed, lane+sublane-rotate
-    extraction, best tile 8) reaches **8.9 GB/s of useful window
-    bytes** vs the XLA element gather's **362 GB/s** — a 40x loss
-    (16x of it inherent overfetch, the rest per-row DMA latency that
-    small 8 KB transfers cannot amortize).  The full
-    `sample_one_hop` runs at ~385 M seeds/s (k=15) on the same input.
-    Sampling therefore stays on XLA as a measured decision, no longer
-    a design assertion.
+    CSR, 8192 seeds x 128-wide windows, table repack hoisted out of
+    the timed loop): the aligned-overfetch DMA kernel (two (8,128)
+    units = 8 KB per seed, lane+sublane-rotate extraction, tile 16-32)
+    reaches **~100-117 GB/s of useful window bytes** vs the XLA
+    element gather's **~230-460 GB/s** across runs (tunnel-day
+    variance) — XLA wins ~2.4-4x, consistent with the DMA path's
+    16x inherent overfetch (8 KB moved per 512 B used) partially
+    offset by its streaming efficiency.  The full `sample_one_hop`
+    runs at ~430 M seeds/s (k=15) on the same input.  Sampling
+    therefore stays on XLA as a measured decision, no longer a design
+    assertion; a sub-4KB-aligned DMA primitive would be the thing to
+    revisit.
 """
 from __future__ import annotations
 

@@ -564,20 +564,24 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
   def _overlay_cold_types(self, feat_nts, ntypes, x_t, node_t):
     """Per-node-type cold-tier overlay (+ telemetry) for tiered
     feature stores — the hetero arm of
-    `dist_sampler.overlay_cold_host`."""
-    out = []
-    for nt, x in zip(feat_nts, x_t):
+    `dist_sampler.overlay_cold_host`.  All tiered node tables come
+    down in ONE device_get (one sync per batch, like the homo path),
+    not one per type."""
+    tiered = [(i, nt) for i, (nt, x) in enumerate(zip(feat_nts, x_t))
+              if x is not None and self.ds.node_features[nt].is_tiered]
+    if not tiered:
+      return x_t
+    fetched = jax.device_get([node_t[ntypes.index(nt)]
+                              for _, nt in tiered])
+    out = list(x_t)
+    for (i, nt), nodes_h in zip(tiered, fetched):
       nf = self.ds.node_features[nt]
-      if x is None or not nf.is_tiered:
-        out.append(x)
-        continue
-      nodes = node_t[ntypes.index(nt)]
-      x, lookups, misses = overlay_cold_host(
-          x, nodes, self.ds.bounds[nt], nf.hot_counts, nf.cold_host,
-          self.mesh, self.axis, self.num_parts)
+      out[i], lookups, misses = overlay_cold_host(
+          out[i], node_t[ntypes.index(nt)], self.ds.bounds[nt],
+          nf.hot_counts, nf.cold_host, self.mesh, self.axis,
+          self.num_parts, nodes_host=nodes_h)
       self._cold_lookups += lookups
       self._cold_misses += misses
-      out.append(x)
     return tuple(out)
 
   def sample_from_nodes(self, input_type: NodeType,

@@ -974,7 +974,7 @@ def _overlay_cold_rows(x, mask, rank, compact):
 
 
 def overlay_cold_host(x, nodes, bounds, hot_counts, cold_host, mesh,
-                      axis: str, num_parts: int):
+                      axis: str, num_parts: int, nodes_host=None):
   """Serve cold-tier rows (host DRAM) for node-table entries the HBM
   exchange zeroed — shared by the homo and hetero mesh engines.
 
@@ -988,9 +988,12 @@ def overlay_cold_host(x, nodes, bounds, hot_counts, cold_host, mesh,
   the node table — the honest price of exceeding HBM.
 
   Returns ``(x', lookups, misses)`` for the caller's telemetry.
+  ``nodes_host`` skips the device_get when the caller already fetched
+  the table (the hetero engine batches ONE sync over all node types).
   """
   from ..utils.padding import next_power_of_two
-  nodes_h = np.asarray(jax.device_get(nodes)).astype(np.int64)
+  nodes_h = np.asarray(nodes_host if nodes_host is not None
+                       else jax.device_get(nodes)).astype(np.int64)
   owner = np.clip(np.searchsorted(bounds, nodes_h, side='right') - 1,
                   0, num_parts - 1)
   valid = nodes_h >= 0
