@@ -11,10 +11,17 @@ This launcher runs both roles as local processes (the SURVEY §4
 all-local pattern); on a real deployment run the two blocks on
 different hosts with real addresses.
 
+``--partitioned`` shows the r3 cross-server tier: the graph is
+partitioned offline, every server owns ONE shard (not a full copy),
+and producers fan each hop / feature lookup out to peer servers over
+RPC (`HostSamplingConfig.peer_addrs` -> `HostDistNeighborSampler`) —
+the reference's `_sample_one_hop` remote path
+(`dist_neighbor_sampler.py:542-598`).
+
 Usage::
 
     python examples/distributed/dist_train_sage_with_server.py \
-        [--num-servers 2] [--epochs 2]
+        [--num-servers 2] [--epochs 2] [--partitioned]
 """
 import argparse
 import multiprocessing as mp
@@ -34,15 +41,20 @@ def synthetic(n):
   return clustered_graph(n=n)
 
 
-def run_server(rank, num_servers, port_q, n):
+def run_server(rank, num_servers, port_q, n, partition_dir=None):
   """One sampling host (reference `init_server` +
-  `wait_and_shutdown_server`, `dist_server.py:158-211`)."""
+  `wait_and_shutdown_server`, `dist_server.py:158-211`).  With
+  ``partition_dir`` the server owns ONE shard and also serves its
+  partition to peers (auto-registered `PartitionService`)."""
   sys.path.insert(0, str(Path(__file__).resolve().parent.parent.parent))
   from graphlearn_tpu.distributed import (HostDataset, init_server,
                                           wait_and_shutdown_server)
-  rows, cols, feats, labels = synthetic(n)
-  ds = HostDataset.from_coo(rows, cols, n, node_features=feats,
-                            node_labels=labels)
+  if partition_dir is not None:
+    ds = HostDataset.from_partition_dir(partition_dir, rank)
+  else:
+    rows, cols, feats, labels = synthetic(n)
+    ds = HostDataset.from_coo(rows, cols, n, node_features=feats,
+                              node_labels=labels)
   srv = init_server(num_servers=num_servers, num_clients=1, rank=rank,
                     dataset=ds, host='127.0.0.1', port=0)
   port_q.put((rank, srv.port))
@@ -57,13 +69,29 @@ def main():
   ap.add_argument('--fanout', type=int, nargs='+', default=[10, 5])
   ap.add_argument('--hidden', type=int, default=64)
   ap.add_argument('--num-nodes', type=int, default=4096)
+  ap.add_argument('--partitioned', action='store_true',
+                  help='each server owns ONE shard; hops/features fan '
+                       'out to peer servers over RPC (r3 cross-server '
+                       'tier) instead of every server holding a full '
+                       'graph copy')
   args = ap.parse_args()
   n = args.num_nodes
+
+  partition_dir = None
+  if args.partitioned:
+    import tempfile
+    from graphlearn_tpu.partition import RandomPartitioner
+    rows, cols, feats, labels = synthetic(n)
+    partition_dir = tempfile.mkdtemp(prefix='glt_parts_')
+    RandomPartitioner(partition_dir, args.num_servers, n, (rows, cols),
+                      node_feat=feats, node_label=labels,
+                      seed=0).partition()
 
   ctx = mp.get_context('forkserver')
   port_q = ctx.Queue()
   servers = [ctx.Process(target=run_server,
-                         args=(r, args.num_servers, port_q, n),
+                         args=(r, args.num_servers, port_q, n,
+                               partition_dir),
                          daemon=False)
              for r in range(args.num_servers)]
   for p in servers:
@@ -74,20 +102,23 @@ def main():
   import jax
   import optax
   from graphlearn_tpu.distributed import (
-      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
-      shutdown_client)
+      DistNeighborLoader, HostSamplingConfig,
+      RemoteDistSamplingWorkerOptions, init_client, shutdown_client)
   from graphlearn_tpu.models import (GraphSAGE, create_train_state,
                                      make_supervised_step)
 
-  init_client([('127.0.0.1', ports[r]) for r in range(args.num_servers)],
-              rank=0, num_clients=1)
+  addrs = [('127.0.0.1', ports[r]) for r in range(args.num_servers)]
+  init_client(addrs, rank=0, num_clients=1)
+  cfg = (HostSamplingConfig(sampling_type='node',
+                            peer_addrs=tuple(addrs))
+         if args.partitioned else None)
   loader = DistNeighborLoader(
       None, args.fanout, np.arange(n), batch_size=args.batch_size,
       shuffle=True,
       worker_options=RemoteDistSamplingWorkerOptions(
           server_rank=list(range(args.num_servers)), num_workers=2,
           prefetch_size=4),
-      seed=0)
+      sampling_config=cfg, seed=0)
 
   model = GraphSAGE(hidden_features=args.hidden, out_features=8,
                     num_layers=2)
@@ -111,6 +142,9 @@ def main():
   shutdown_client()            # client-0 tells every server to exit
   for p in servers:
     p.join(timeout=30)
+  if partition_dir is not None:
+    import shutil
+    shutil.rmtree(partition_dir, ignore_errors=True)
   print('done')
 
 

@@ -94,9 +94,6 @@ class LinkLoader(PrefetchingLoader):
   def __len__(self):
     return len(self._batcher)
 
-  def __iter__(self) -> Iterator[Batch]:
-    return self._start_epoch(iter(self._batcher))
-
   def _produce(self, seed_iter) -> Batch:
     r, c, lab = next(seed_iter)
     if lab is not None and self.neg_sampling is not None \

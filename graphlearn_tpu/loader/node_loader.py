@@ -109,9 +109,6 @@ class NodeLoader(PrefetchingLoader):
   def __len__(self) -> int:
     return len(self._batcher)
 
-  def __iter__(self) -> Iterator[Batch]:
-    return self._start_epoch(iter(self._batcher))
-
   def _produce(self, seed_iter) -> Batch:
     seeds = next(seed_iter)
     with trace('loader.sample'):

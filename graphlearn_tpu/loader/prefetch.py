@@ -25,14 +25,21 @@ class PrefetchingLoader:
   """Mixin: epoch iteration with optional background prefetch.
 
   Subclasses implement ``_produce(seed_iter)`` (one batch or raise
-  StopIteration) and call ``_start_epoch(iter(batcher))`` from
-  ``__iter__``.  Guarantees: each epoch runs on a PRIVATE seed
-  iterator, and starting a new epoch closes the previous epoch's
-  worker — an abandoned ``prefetch > 0`` epoch can neither steal the
-  next epoch's batches nor leak its thread.
+  StopIteration) and keep their seed source at ``self._batcher`` (the
+  default ``__iter__`` starts an epoch over ``iter(self._batcher)``;
+  override for a different source).  Guarantees: each epoch runs on a
+  PRIVATE seed iterator; ``iter(loader)`` always starts a NEW epoch
+  while ``iter()`` on the RETURNED iterator continues it (torch
+  DataLoader semantics, identical for prefetch 0 and > 0); starting a
+  new epoch closes the previous epoch's worker — an abandoned
+  ``prefetch > 0`` epoch can neither steal the next epoch's batches
+  nor leak its thread.
   """
 
   prefetch: int = 0
+
+  def __iter__(self):
+    return self._start_epoch(iter(self._batcher))
 
   def _start_epoch(self, seed_iter):
     prev = getattr(self, '_active_prefetch', None)
@@ -48,7 +55,18 @@ class PrefetchingLoader:
       it = PrefetchIterator(self._epoch_gen(seed_iter), self.prefetch)
       self._active_prefetch = it
       return it
-    return self
+    return _SyncEpochIterator(self, seed_iter)
+
+  def close(self) -> None:
+    """Stop an abandoned prefetch worker and drop its buffered batches
+    (depth x device-stacked pytrees otherwise stay resident until the
+    next epoch or loader GC).  Call after breaking out of a
+    ``prefetch > 0`` epoch early."""
+    prev = getattr(self, '_active_prefetch', None)
+    if prev is not None:
+      prev.close()
+      prev.join()
+      self._active_prefetch = None
 
   def _epoch_gen(self, seed_iter):
     while True:
@@ -58,10 +76,27 @@ class PrefetchingLoader:
         return
 
   def __next__(self):
+    # legacy direct-next path: consumes the most recent epoch's stream
     return self._produce(self._seed_iter)
 
   def _produce(self, seed_iter):
     raise NotImplementedError
+
+
+class _SyncEpochIterator:
+  """One synchronous epoch: ``iter()`` returns itself, so a warm-up
+  ``next()`` followed by a for-loop CONTINUES the epoch — the same
+  contract as the prefetching iterator."""
+
+  def __init__(self, loader: 'PrefetchingLoader', seed_iter):
+    self._loader = loader
+    self._seed_iter = seed_iter
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    return self._loader._produce(self._seed_iter)
 
 
 class _Failure:

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..loader.prefetch import PrefetchingLoader
 from ..ops.unique import init_node, induce_next
 from ..sampler.hetero_neighbor_sampler import (_plan_capacities,
                                                normalize_fanouts)
@@ -754,12 +755,14 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
         batch=pairs_dev[:, :, 0], metadata=md, input_type=et)
 
 
-class DistHeteroNeighborLoader:
+class DistHeteroNeighborLoader(PrefetchingLoader):
   """Distributed hetero loader: stacked `HeteroBatch`-shaped pytrees
   (leading axis = device), ready for a DP hetero train step.
 
   The facade reference users reach via ``DistNeighborLoader`` on a
   hetero `DistDataset` (`distributed/dist_neighbor_loader.py:27-94`).
+  ``prefetch=N`` overlaps the next batch's host work (incl. tiered
+  cold overlays) with the current device step.
   """
 
   def __init__(self, dataset: DistHeteroDataset, num_neighbors,
@@ -767,8 +770,9 @@ class DistHeteroNeighborLoader:
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto'):
+               exchange_slack='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
+    self.prefetch = int(prefetch)
     input_type, seeds = input_nodes
     self.input_type = input_type
     self.sampler = DistHeteroNeighborSampler(
@@ -787,13 +791,9 @@ class DistHeteroNeighborLoader:
   def __len__(self):
     return len(self._batcher)
 
-  def __iter__(self):
-    self._it = iter(self._batcher)
-    return self
-
-  def __next__(self):
+  def _produce(self, seed_iter):
     from ..loader.transform import HeteroBatch
-    flat = next(self._it)
+    flat = next(seed_iter)
     seeds = flat.reshape(self.num_parts, self.batch_size)
     out = self.sampler.sample_from_nodes(self.input_type, seeds)
     ei = {et: jnp.stack([out['row'][et], out['col'][et]], axis=1)
@@ -815,7 +815,7 @@ class DistHeteroNeighborLoader:
         metadata=md)
 
 
-class DistHeteroLinkNeighborLoader:
+class DistHeteroLinkNeighborLoader(PrefetchingLoader):
   """Distributed hetero link-prediction loader over the device mesh
   (the hetero arm of `dist_sampler.DistLinkNeighborLoader`; reference
   users reach it via ``DistLinkNeighborLoader`` on a hetero dataset,
@@ -835,9 +835,10 @@ class DistHeteroLinkNeighborLoader:
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto'):
+               exchange_slack='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     from ..sampler.base import NegativeSampling
+    self.prefetch = int(prefetch)
     from .dist_sampler import pack_link_seeds
     input_type, pairs = edge_label_index
     self.input_type = tuple(input_type)
@@ -867,13 +868,9 @@ class DistHeteroLinkNeighborLoader:
   def __len__(self):
     return len(self._batcher)
 
-  def __iter__(self):
-    self._it = iter(self._batcher)
-    return self
-
-  def __next__(self):
+  def _produce(self, seed_iter):
     from ..loader.transform import HeteroBatch
-    flat = next(self._it)
+    flat = next(seed_iter)
     pairs = flat.reshape(self.num_parts, self.batch_size, -1)
     out = self.sampler.sample_from_edges(self.input_type, pairs,
                                          neg_sampling=self.neg_sampling)

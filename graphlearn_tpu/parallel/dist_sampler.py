@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..loader.prefetch import PrefetchingLoader
 from ..ops.negative import edge_in_csr
 from ..ops.neighbor import sample_one_hop
 from ..ops.unique import init_node, induce_next
@@ -1156,7 +1157,7 @@ class DistRandomWalker(DistNeighborSampler):
     return walks
 
 
-class DistSubGraphLoader:
+class DistSubGraphLoader(PrefetchingLoader):
   """Distributed induced-subgraph loader over the device mesh — the
   mesh-engine arm of reference ``DistSubGraphLoader``
   (`distributed/dist_subgraph_loader.py:28-89`); the host-runtime arm
@@ -1171,8 +1172,9 @@ class DistSubGraphLoader:
                with_edge: bool = False, collect_features: bool = True,
                max_degree: Optional[int] = None, seed: int = 0,
                input_space: str = 'old', exchange_slack='auto',
-               hop_chunk: Optional[int] = None):
+               hop_chunk: Optional[int] = None, prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
+    self.prefetch = int(prefetch)
     # 'auto' resolves to EXACT here, shuffled or not: a dropped
     # closure node under a capacity cap loses its whole neighbor
     # window, making the "induced subgraph" silently wrong (for
@@ -1200,13 +1202,9 @@ class DistSubGraphLoader:
   def __len__(self):
     return len(self._batcher)
 
-  def __iter__(self):
-    self._it = iter(self._batcher)
-    return self
-
-  def __next__(self):
+  def _produce(self, seed_iter):
     from ..loader.transform import Batch
-    flat = next(self._it)
+    flat = next(seed_iter)
     seeds = flat.reshape(self.num_parts, self.batch_size)
     out = self.sampler.sample_subgraph(seeds)
     edge_index = jnp.stack([out['row'], out['col']], axis=1)
@@ -1220,12 +1218,16 @@ class DistSubGraphLoader:
                   'mapping': out['seed_local']})
 
 
-class DistNeighborLoader:
+class DistNeighborLoader(PrefetchingLoader):
   """Distributed loader facade (reference ``DistNeighborLoader``,
   `distributed/dist_neighbor_loader.py:27-94`).
 
   Splits the (relabeled) seed set across the mesh, yields stacked
   `Batch` pytrees ready for the DP train step: leading axis = device.
+  ``prefetch=N`` runs the host side of the NEXT batch (seed prep, the
+  collective dispatch, the tiered store's cold overlay) on a worker
+  thread while the current step trains — the overlap tiered stores
+  need, since their overlay syncs on the node table per batch.
   """
 
   def __init__(self, dataset: DistDataset, num_neighbors, input_nodes,
@@ -1233,8 +1235,9 @@ class DistNeighborLoader:
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto'):
+               exchange_slack='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
+    self.prefetch = int(prefetch)
     self.sampler = DistNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
@@ -1252,13 +1255,9 @@ class DistNeighborLoader:
   def __len__(self):
     return len(self._batcher)
 
-  def __iter__(self):
-    self._it = iter(self._batcher)
-    return self
-
-  def __next__(self):
+  def _produce(self, seed_iter):
     from ..loader.transform import Batch
-    flat = next(self._it)                          # [P * B]
+    flat = next(seed_iter)                         # [P * B]
     seeds = flat.reshape(self.num_parts, self.batch_size)
     out = self.sampler.sample_from_nodes(seeds)
     edge_index = jnp.stack([out['row'], out['col']], axis=1)  # [P, 2, E]
@@ -1379,7 +1378,7 @@ class DistLinkNeighborSampler(DistNeighborSampler):
                 batch=pairs_dev[:, :, 0], metadata=md)
 
 
-class DistLinkNeighborLoader:
+class DistLinkNeighborLoader(PrefetchingLoader):
   """Distributed link-prediction loader over the device mesh
   (reference ``DistLinkNeighborLoader``,
   `distributed/dist_link_neighbor_loader.py:30-153`): seed edges split
@@ -1400,8 +1399,9 @@ class DistLinkNeighborLoader:
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto'):
+               exchange_slack='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
+    self.prefetch = int(prefetch)
     self.sampler = DistLinkNeighborSampler(
         dataset, num_neighbors, neg_sampling=neg_sampling, mesh=mesh,
         with_edge=with_edge, collect_features=collect_features,
@@ -1422,13 +1422,9 @@ class DistLinkNeighborLoader:
   def __len__(self):
     return len(self._batcher)
 
-  def __iter__(self):
-    self._it = iter(self._batcher)
-    return self
-
-  def __next__(self):
+  def _produce(self, seed_iter):
     from ..loader.transform import Batch
-    flat = next(self._it)                          # [P * B, 2|3]
+    flat = next(seed_iter)                         # [P * B, 2|3]
     pairs = flat.reshape(self.num_parts, self.batch_size, -1)
     out = self.sampler.sample_from_edges(pairs)
     edge_index = jnp.stack([out['row'], out['col']], axis=1)
