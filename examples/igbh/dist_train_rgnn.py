@@ -28,6 +28,12 @@ def main():
   ap.add_argument('--model', choices=['rgat', 'rsage'], default='rsage')
   ap.add_argument('--partition-dir', type=str, default=None,
                   help='hetero partition layout from RandomPartitioner')
+  ap.add_argument('--igbh-root', type=str, default=None,
+                  help='REAL IGBH directory (the reference npy layout, '
+                       'examples/igbh/dataset.py) — loaded via '
+                       'graphlearn_tpu.data.load_igbh_dir')
+  ap.add_argument('--igbh-size', default='tiny',
+                  choices=['tiny', 'small', 'medium', 'large', 'full'])
   ap.add_argument('--num-parts', type=int, default=None)
   ap.add_argument('--epochs', type=int, default=3)
   ap.add_argument('--batch-size', type=int, default=64,
@@ -69,6 +75,23 @@ def main():
     assert PAPER in ds.node_labels, 'training needs paper labels'
     npaper = ds.num_nodes_dict()[PAPER]
     classes = int(np.max(ds.node_labels[PAPER])) + 1
+    train_idx = np.arange(npaper)
+  elif args.igbh_root:
+    from graphlearn_tpu.data import load_igbh_dir
+    # default mmap: tables stay on disk until the shard build slices
+    # them (at large/full, partition offline with
+    # `graphlearn_tpu.data.partition_igbh` + --partition-dir instead
+    # of this in-memory path)
+    d = load_igbh_dir(args.igbh_root, args.igbh_size)
+    npaper = d['num_nodes_dict'][PAPER]
+    classes = int(d['paper_labels'].max()) + 1
+    ds = DistHeteroDataset.from_full_graph(
+        num_parts, d['edge_index_dict'],
+        node_feat_dict=d['node_feat_dict'],
+        node_label_dict={PAPER: d['paper_labels'].astype(np.int32)},
+        num_nodes_dict=d['num_nodes_dict'],
+        split_ratio=args.split_ratio)
+    train_idx = d['train_idx']          # reference 60% convention
   else:
     edges, feats, nnodes, topic = synthetic()
     npaper, classes = len(topic), int(topic.max()) + 1
@@ -76,10 +99,11 @@ def main():
         num_parts, edges, node_feat_dict=feats,
         node_label_dict={PAPER: topic}, num_nodes_dict=nnodes,
         split_ratio=args.split_ratio)
+    train_idx = np.arange(npaper)
 
   bs = args.batch_size
   loader = DistHeteroNeighborLoader(
-      ds, args.fanout, (PAPER, np.arange(npaper)), batch_size=bs,
+      ds, args.fanout, (PAPER, train_idx), batch_size=bs,
       shuffle=True, mesh=mesh, seed=0)
 
   batch0 = next(iter(loader))

@@ -8,3 +8,4 @@ from .table_dataset import (CsvTableReader, NpzTableReader, OdpsTableReader,
                             read_node_table)
 from .ogb import (load_ogb_dir, ogb_to_dataset, partition_ogb,
                   save_binary)
+from .igbh import igbh_num_classes, load_igbh_dir, partition_igbh

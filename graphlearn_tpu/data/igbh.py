@@ -1,0 +1,107 @@
+"""IGBH (Illinois Graph Benchmark, heterogeneous) on-disk ingestion.
+
+Torch-free reader for the IGBH npy layout the reference consumes
+through its `IGBHeteroDataset` (`examples/igbh/dataset.py:51-157`):
+
+    <root>/<size>/processed/
+        <src>__<rel>__<dst>/edge_index.npy        # [E, 2] int
+        <node_type>/node_feat.npy                 # [N, D]
+        paper/node_label_19.npy | node_label_2K.npy
+
+Sizes: tiny / small / medium / large / full.  Splits follow the
+reference's convention: paper ids ordered so train = first 60%,
+val = next 20%, test = the rest (`dataset.py:151-157`).
+
+``mmap=True`` (default) keeps feature tables on disk until sliced —
+at IGBH-large (~600 M nodes) materializing them up front is neither
+possible nor needed: the partitioner streams chunks and the tiered
+distributed store (`DistHeteroDataset.from_full_graph(split_ratio=…)`)
+keeps only hot rows in HBM.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ['load_igbh_dir', 'igbh_num_classes', 'partition_igbh']
+
+#: label-file -> class-count mapping (reference `dataset.py:96`)
+LABEL_FILES = {False: ('node_label_19.npy', 19),
+               True: ('node_label_2K.npy', 2983)}
+
+
+def igbh_num_classes(use_label_2k: bool = False) -> int:
+  return LABEL_FILES[bool(use_label_2k)][1]
+
+
+def load_igbh_dir(root, dataset_size: str = 'tiny',
+                  use_label_2k: bool = False, mmap: bool = True,
+                  in_memory: Optional[bool] = None) -> Dict:
+  """Read an IGBH directory.
+
+  Returns ``{'edge_index_dict': {(s, rel, d): (rows, cols)},
+  'node_feat_dict': {ntype: [N, D]}, 'paper_labels': [N_paper],
+  'num_nodes_dict': {...}, 'train_idx'/'val_idx'/'test_idx': [...]}``.
+  Edge/feature dirs are DISCOVERED (``<s>__<rel>__<d>`` naming), so
+  the large/full extras (journal, conference) come in automatically.
+  """
+  if in_memory is not None:      # reference flag name, inverted sense
+    mmap = not in_memory
+  base = Path(root) / dataset_size / 'processed'
+  if not base.is_dir():
+    raise FileNotFoundError(f'IGBH processed dir not found: {base}')
+  mode = 'r' if mmap else None
+  edge_index_dict = {}
+  node_feat_dict = {}
+  for d in sorted(base.iterdir()):
+    if not d.is_dir():
+      continue
+    if '__' in d.name:
+      p = d / 'edge_index.npy'
+      if p.exists():
+        s, rel, t = d.name.split('__')
+        ei = np.load(p, mmap_mode=mode)
+        edge_index_dict[(s, rel, t)] = (ei[:, 0], ei[:, 1])
+    else:
+      p = d / 'node_feat.npy'
+      if p.exists():
+        node_feat_dict[d.name] = np.load(p, mmap_mode=mode)
+  if 'paper' not in node_feat_dict:
+    raise FileNotFoundError(f'no paper/node_feat.npy under {base}')
+  label_file, _ = LABEL_FILES[bool(use_label_2k)]
+  labels = np.load(base / 'paper' / label_file, mmap_mode=mode)
+  labels = np.asarray(labels).reshape(-1).astype(np.int64)
+  num_nodes = {nt: f.shape[0] for nt, f in node_feat_dict.items()}
+  n_paper = num_nodes['paper']
+  n_train = int(n_paper * 0.6)
+  n_val = int(n_paper * 0.2)
+  return {
+      'edge_index_dict': edge_index_dict,
+      'node_feat_dict': node_feat_dict,
+      'paper_labels': labels,
+      'num_nodes_dict': num_nodes,
+      'train_idx': np.arange(0, n_train),
+      'val_idx': np.arange(n_train, n_train + n_val),
+      'test_idx': np.arange(n_train + n_val, n_paper),
+  }
+
+
+def partition_igbh(root, out_dir, num_parts: int,
+                   dataset_size: str = 'tiny',
+                   use_label_2k: bool = False, seed: int = 0) -> None:
+  """Write the offline HETERO partition layout for an IGBH dir —
+  feeds `DistHeteroDataset.from_partition_dir` /
+  `HostHeteroDataset.from_partition_dir` (the role of reference
+  `examples/igbh/partition.py`)."""
+  from ..partition import RandomPartitioner
+  d = load_igbh_dir(root, dataset_size, use_label_2k)
+  RandomPartitioner(
+      out_dir, num_parts, d['num_nodes_dict'],
+      {et: (np.asarray(r), np.asarray(c))
+       for et, (r, c) in d['edge_index_dict'].items()},
+      node_feat={nt: np.asarray(f)
+                 for nt, f in d['node_feat_dict'].items()},
+      node_label={'paper': d['paper_labels'].astype(np.int32)},
+      seed=seed).partition()

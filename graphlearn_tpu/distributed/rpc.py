@@ -96,11 +96,25 @@ class RpcServer:
   def __init__(self, host: str = '0.0.0.0', port: int = 0):
     registry: Dict[str, Callable] = {}
     self._registry = registry
+    active: set = set()
+    closed = [False]
+    alock = threading.Lock()
+    self._active, self._alock, self._closed = active, alock, closed
 
     class Handler(socketserver.BaseRequestHandler):
       def handle(self):
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with alock:
+          if closed[0]:
+            # accepted just as shutdown() snapshotted the set: self-
+            # close instead of serving a "dead" server's connection
+            try:
+              sock.close()
+            except OSError:
+              pass
+            return
+          active.add(sock)
         try:
           while True:
             name, args, kwargs = recv_obj(sock)
@@ -115,6 +129,9 @@ class RpcServer:
             send_obj(sock, result)
         except (ConnectionError, EOFError, OSError):
           return
+        finally:
+          with alock:
+            active.discard(sock)
 
     class Server(socketserver.ThreadingTCPServer):
       daemon_threads = True
@@ -133,8 +150,25 @@ class RpcServer:
     self._thread.start()
 
   def shutdown(self) -> None:
+    """Stop accepting AND sever live connections: handler threads are
+    daemons blocked in recv, so without the severing a "shut down"
+    server keeps answering pooled peers indefinitely — callers (and
+    failure tests) must see a dead peer as ConnectionError, not as a
+    healthy endpoint."""
     self._server.shutdown()
     self._server.server_close()
+    with self._alock:
+      self._closed[0] = True
+      conns = list(self._active)
+    for s in conns:
+      try:
+        s.shutdown(socket.SHUT_RDWR)
+      except OSError:
+        pass
+      try:
+        s.close()
+      except OSError:
+        pass
 
 
 class RpcClient:

@@ -42,14 +42,10 @@ class PrefetchingLoader:
     return self._start_epoch(iter(self._batcher))
 
   def _start_epoch(self, seed_iter):
-    prev = getattr(self, '_active_prefetch', None)
-    if prev is not None:
-      # close AND join: the old worker may be mid-_produce, and two
-      # workers on one loader would race the sampler's stateful PRNG
-      # key counter (non-reproducible batches)
-      prev.close()
-      prev.join()
-    self._active_prefetch = None
+    # close AND join any previous worker: it may be mid-_produce, and
+    # two workers on one loader would race the sampler's stateful PRNG
+    # key counter (non-reproducible batches)
+    self.close()
     self._seed_iter = seed_iter
     if self.prefetch:
       it = PrefetchIterator(self._epoch_gen(seed_iter), self.prefetch)
@@ -76,7 +72,12 @@ class PrefetchingLoader:
         return
 
   def __next__(self):
-    # legacy direct-next path: consumes the most recent epoch's stream
+    # legacy direct-next path: consumes the most recent epoch's stream.
+    # With an active prefetch worker, delegate — calling _produce here
+    # would race the worker on the same seed generator.
+    it = getattr(self, '_active_prefetch', None)
+    if it is not None:
+      return next(it)
     return self._produce(self._seed_iter)
 
   def _produce(self, seed_iter):

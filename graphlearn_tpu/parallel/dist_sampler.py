@@ -1053,6 +1053,32 @@ def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
   return jax.jit(sharded)
 
 
+#: `hop_chunk='auto'` engages chunking once one full-window reply
+#: buffer (``node_cap * max_degree`` int32 per destination device)
+#: would exceed this many elements — 16M = 64 MB, comfortably inside
+#: HBM while keeping the all_to_all rendezvous bounded at any P.
+SUBGRAPH_WINDOW_BUDGET = 1 << 24
+
+
+def resolve_hop_chunk(hop_chunk, node_cap: int,
+                      max_degree: int) -> Optional[int]:
+  """Resolve the subgraph samplers' ``'auto'``: chunk only when the
+  full-window exchange would exceed `SUBGRAPH_WINDOW_BUDGET` elements
+  (results are EXACT either way; chunking costs serialized exchanges,
+  so small configs keep the single wide one)."""
+  if isinstance(hop_chunk, str):
+    if hop_chunk != 'auto':
+      raise ValueError(f'unknown hop_chunk {hop_chunk!r}')
+    if node_cap * max_degree <= SUBGRAPH_WINDOW_BUDGET:
+      return None
+    # round DOWN so chunk * max_degree never exceeds the budget (the
+    # MIN_EXCHANGE_CAP floor may for degenerate max_degree — a floor,
+    # not a violation of intent)
+    return max(SUBGRAPH_WINDOW_BUDGET // max_degree // 8 * 8,
+               MIN_EXCHANGE_CAP)
+  return hop_chunk
+
+
 class DistSubGraphSampler(DistNeighborSampler):
   """Device-mesh induced-subgraph sampler: multihop closure + one
   full-window distributed hop + local membership/relabel (SEAL at pod
@@ -1063,13 +1089,14 @@ class DistSubGraphSampler(DistNeighborSampler):
       None = the sharded graph's true max degree (exact results).
     hop_chunk: closure nodes per full-window exchange — bounds the
       all_to_all to ``[P, chunk, max_degree]`` (SEAL-at-scale
-      envelope; see `_make_dist_subgraph_step`).  None = one
+      envelope; see `_make_dist_subgraph_step`).  ``'auto'`` (default)
+      chunks only past `SUBGRAPH_WINDOW_BUDGET`; None = always one
       node_cap-wide exchange.
   """
 
   def __init__(self, dataset: DistDataset, num_neighbors,
                max_degree: Optional[int] = None,
-               hop_chunk: Optional[int] = None, **kwargs):
+               hop_chunk='auto', **kwargs):
     super().__init__(dataset, num_neighbors, **kwargs)
     if max_degree is None:
       g = dataset.graph
@@ -1091,7 +1118,8 @@ class DistSubGraphSampler(DistNeighborSampler):
           self.max_degree, self.with_edge, self.collect_features,
           self.collect_labels, self.axis, with_cache=self.with_cache,
           exchange_slack=self.exchange_slack, tiered=self.tiered,
-          hop_chunk=self.hop_chunk)
+          hop_chunk=resolve_hop_chunk(self.hop_chunk, node_cap,
+                                      self.max_degree))
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -1172,7 +1200,7 @@ class DistSubGraphLoader(PrefetchingLoader):
                with_edge: bool = False, collect_features: bool = True,
                max_degree: Optional[int] = None, seed: int = 0,
                input_space: str = 'old', exchange_slack='auto',
-               hop_chunk: Optional[int] = None, prefetch: int = 0):
+               hop_chunk='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
     # 'auto' resolves to EXACT here, shuffled or not: a dropped

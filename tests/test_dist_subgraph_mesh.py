@@ -118,3 +118,28 @@ def test_mesh_subgraph_hop_chunk_exact():
         edges.append(es)
     results.append(edges)
   assert results[0] == results[1]
+
+
+def test_hop_chunk_auto_resolution():
+  """'auto' keeps one wide exchange below the window budget and
+  bounds the chunk above it."""
+  from graphlearn_tpu.parallel.dist_sampler import (
+      SUBGRAPH_WINDOW_BUDGET, resolve_hop_chunk)
+  assert resolve_hop_chunk(None, 10**9, 64) is None
+  assert resolve_hop_chunk(512, 10**9, 64) == 512
+  assert resolve_hop_chunk('auto', 1000, 64) is None
+  big_cap = SUBGRAPH_WINDOW_BUDGET // 64 + 1000
+  chunk = resolve_hop_chunk('auto', big_cap, 64)
+  assert chunk is not None and chunk * 64 <= SUBGRAPH_WINDOW_BUDGET
+  with pytest.raises(ValueError, match='hop_chunk'):
+    resolve_hop_chunk('bogus', 10, 10)
+
+
+def test_hop_chunk_auto_respects_budget_any_degree():
+  from graphlearn_tpu.parallel.dist_sampler import (
+      MIN_EXCHANGE_CAP, SUBGRAPH_WINDOW_BUDGET, resolve_hop_chunk)
+  for md in (7, 64, 1000, 4097):
+    chunk = resolve_hop_chunk('auto', 10**9, md)
+    assert chunk is not None
+    assert (chunk * md <= SUBGRAPH_WINDOW_BUDGET
+            or chunk == MIN_EXCHANGE_CAP)

@@ -151,3 +151,19 @@ def test_missing_peer_raises(deployment):
   shards, _, addrs = deployment
   with pytest.raises(ValueError, match='no peer client'):
     HostDistNeighborSampler(shards[0], [2], {})
+
+
+def test_dead_peer_raises_not_hangs(deployment):
+  """A peer that dies mid-epoch must surface a prompt error (socket
+  reset), never a silent under-sample or an indefinite hang — the
+  host-runtime arm of the failure-handling story."""
+  shards, services, addrs = deployment
+  sampler = HostDistNeighborSampler(shards[0], [2],
+                                    connect_peers(addrs, 0), seed=0)
+  # first batch works
+  sampler.sample_from_nodes(np.arange(4, dtype=np.int64))
+  services[1].shutdown()
+  with pytest.raises((ConnectionError, OSError)):
+    # remote-owned seeds force RPC to the dead peer
+    for _ in range(4):
+      sampler.sample_from_nodes(np.arange(N, dtype=np.int64))
