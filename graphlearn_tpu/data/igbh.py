@@ -38,7 +38,9 @@ def igbh_num_classes(use_label_2k: bool = False) -> int:
 
 def load_igbh_dir(root, dataset_size: str = 'tiny',
                   use_label_2k: bool = False, mmap: bool = True,
-                  in_memory: Optional[bool] = None) -> Dict:
+                  in_memory: Optional[bool] = None,
+                  add_reverse: bool = True,
+                  symmetrize_cites: bool = True) -> Dict:
   """Read an IGBH directory.
 
   Returns ``{'edge_index_dict': {(s, rel, d): (rows, cols)},
@@ -46,6 +48,16 @@ def load_igbh_dir(root, dataset_size: str = 'tiny',
   'num_nodes_dict': {...}, 'train_idx'/'val_idx'/'test_idx': [...]}``.
   Edge/feature dirs are DISCOVERED (``<s>__<rel>__<d>`` naming), so
   the large/full extras (journal, conference) come in automatically.
+
+  The reference trains on a CONSTRUCTED graph, not the raw relations
+  (`dataset.py:79-96`): ``add_reverse`` synthesizes
+  ``(d, rev_<rel>, s)`` for every cross-type relation (so e.g. author
+  -> paper message passing and sampling exist), and
+  ``symmetrize_cites`` rebuilds ``paper cites paper`` as
+  both-directions + one self-loop per paper (the reference's
+  to_undirected + remove/add_self_loops).  Both default on to match
+  the reference recipe; reversed/symmetrized relations materialize
+  those edge arrays (the rest stay mmap).
   """
   if in_memory is not None:      # reference flag name, inverted sense
     mmap = not in_memory
@@ -70,6 +82,25 @@ def load_igbh_dir(root, dataset_size: str = 'tiny',
         node_feat_dict[d.name] = np.load(p, mmap_mode=mode)
   if 'paper' not in node_feat_dict:
     raise FileNotFoundError(f'no paper/node_feat.npy under {base}')
+  if symmetrize_cites and ('paper', 'cites', 'paper') in edge_index_dict:
+    r, c = edge_index_dict[('paper', 'cites', 'paper')]
+    r = np.asarray(r, np.int64)
+    c = np.asarray(c, np.int64)
+    keep = r != c                       # remove_self_loops
+    n_paper = int(node_feat_dict['paper'].shape[0])
+    # both directions, COALESCED (to_undirected dedupes), + self loops
+    key = np.unique(np.concatenate([r[keep] * n_paper + c[keep],
+                                    c[keep] * n_paper + r[keep]]))
+    loops = np.arange(n_paper, dtype=np.int64)
+    edge_index_dict[('paper', 'cites', 'paper')] = (
+        np.concatenate([key // n_paper, loops]),
+        np.concatenate([key % n_paper, loops]))
+  if add_reverse:
+    for (s, rel, t) in list(edge_index_dict):
+      if s != t:
+        r, c = edge_index_dict[(s, rel, t)]
+        edge_index_dict[(t, f'rev_{rel}', s)] = (np.asarray(c),
+                                                 np.asarray(r))
   label_file, _ = LABEL_FILES[bool(use_label_2k)]
   labels = np.load(base / 'paper' / label_file, mmap_mode=mode)
   labels = np.asarray(labels).reshape(-1).astype(np.int64)

@@ -581,8 +581,9 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
           out[i], node_t[ntypes.index(nt)], self.ds.bounds[nt],
           nf.hot_counts, nf.cold_host, self.mesh, self.axis,
           self.num_parts, nodes_host=nodes_h)
-      self._cold_lookups += lookups
-      self._cold_misses += misses
+      with self._stats_lock:
+        self._cold_lookups += lookups
+        self._cold_misses += misses
     return tuple(out)
 
   def sample_from_nodes(self, input_type: NodeType,

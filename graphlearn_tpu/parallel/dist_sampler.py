@@ -775,6 +775,11 @@ class ExchangeTelemetry:
   STATS_DRAIN_INTERVAL = 64
 
   def _init_stats(self) -> None:
+    import threading
+    # prefetch workers (`loader.prefetch`) call the sampler from a
+    # second thread; the read-modify-write on the accumulators must
+    # not interleave with an exchange_stats() drain
+    self._stats_lock = threading.Lock()
     self._stats_acc = jnp.zeros((len(EXCHANGE_STAT_NAMES),), jnp.int32)
     self._stats_total = np.zeros(len(EXCHANGE_STAT_NAMES), np.int64)
     self._stats_pending = 0
@@ -786,9 +791,11 @@ class ExchangeTelemetry:
     self._cold_reported = (0, 0)
 
   def _accumulate_stats(self, stats_stacked) -> None:
-    self._stats_acc = self._stats_acc + jnp.sum(stats_stacked, axis=0)
-    self._stats_pending += 1
-    if self._stats_pending >= self.STATS_DRAIN_INTERVAL:
+    with self._stats_lock:
+      self._stats_acc = self._stats_acc + jnp.sum(stats_stacked, axis=0)
+      self._stats_pending += 1
+      drain = self._stats_pending >= self.STATS_DRAIN_INTERVAL
+    if drain:
       self.exchange_stats()
 
   def exchange_stats(self, tick_metrics: bool = True):
@@ -799,30 +806,38 @@ class ExchangeTelemetry:
     into the global `utils.profiling.metrics` registry so overflow
     drops are never invisible.
     """
-    delta = np.asarray(jax.device_get(self._stats_acc), np.int64)
-    self._stats_acc = jnp.zeros_like(self._stats_acc)
-    self._stats_pending = 0
-    self._stats_total += delta
+    # the WHOLE drain runs under the lock (a prefetch worker's
+    # interval drain may race a caller's): totals and the reported-
+    # watermark are read-modify-write shared state too.  Only the
+    # registry ticks happen outside, on snapshot values.
+    with self._stats_lock:
+      acc = self._stats_acc
+      self._stats_acc = jnp.zeros_like(acc)
+      self._stats_pending = 0
+      delta = np.asarray(jax.device_get(acc), np.int64)
+      self._stats_total += delta
+      totals = self._stats_total.copy()
+      cold_lookups, cold_misses = self._cold_lookups, self._cold_misses
+      cold_delta = (0, 0)
+      if tick_metrics:
+        lk, ms = self._cold_reported
+        cold_delta = (cold_lookups - lk, cold_misses - ms)
+        self._cold_reported = (cold_lookups, cold_misses)
     out = {f'dist.{n}': int(v)
-           for n, v in zip(EXCHANGE_STAT_NAMES, self._stats_total)}
-    out['dist.feature.cold_lookups'] = self._cold_lookups
-    out['dist.feature.cold_misses'] = self._cold_misses
+           for n, v in zip(EXCHANGE_STAT_NAMES, totals)}
+    out['dist.feature.cold_lookups'] = cold_lookups
+    out['dist.feature.cold_misses'] = cold_misses
     out['dist.feature.cold_hit_rate'] = (
-        1.0 - self._cold_misses / self._cold_lookups
-        if self._cold_lookups else 1.0)
+        1.0 - cold_misses / cold_lookups if cold_lookups else 1.0)
     if tick_metrics:
       from ..utils.profiling import metrics
       for n, d in zip(EXCHANGE_STAT_NAMES, delta):
         if d:
           metrics.inc(f'dist.{n}', float(d))
-      lk, ms = self._cold_reported
-      if self._cold_lookups > lk:
-        metrics.inc('dist.feature.cold_lookups',
-                    float(self._cold_lookups - lk))
-      if self._cold_misses > ms:
-        metrics.inc('dist.feature.cold_misses',
-                    float(self._cold_misses - ms))
-      self._cold_reported = (self._cold_lookups, self._cold_misses)
+      if cold_delta[0] > 0:
+        metrics.inc('dist.feature.cold_lookups', float(cold_delta[0]))
+      if cold_delta[1] > 0:
+        metrics.inc('dist.feature.cold_misses', float(cold_delta[1]))
     return out
 
 
@@ -962,8 +977,9 @@ class DistNeighborSampler(ExchangeTelemetry):
     x, lookups, misses = overlay_cold_host(
         x, nodes, self.ds.graph.bounds, nf.hot_counts, nf.cold_host,
         self.mesh, self.axis, self.num_parts)
-    self._cold_lookups += lookups
-    self._cold_misses += misses
+    with self._stats_lock:
+      self._cold_lookups += lookups
+      self._cold_misses += misses
     return x
 
 

@@ -43,7 +43,8 @@ def _write_igbh(root, size='tiny'):
 
 def test_load_igbh_dir(tmp_path):
   edges, feats, labels = _write_igbh(tmp_path)
-  d = load_igbh_dir(tmp_path, 'tiny')
+  d = load_igbh_dir(tmp_path, 'tiny', add_reverse=False,
+                    symmetrize_cites=False)
   assert set(d['edge_index_dict']) == set(edges)
   for et, ei in edges.items():
     np.testing.assert_array_equal(d['edge_index_dict'][et][0], ei[:, 0])
@@ -97,3 +98,32 @@ def test_igbh_partition_roundtrip_to_hetero_engine(tmp_path):
 def test_missing_dir_raises(tmp_path):
   with pytest.raises(FileNotFoundError):
     load_igbh_dir(tmp_path, 'tiny')
+
+
+def test_reference_graph_construction(tmp_path):
+  """Default load matches the reference recipe (dataset.py:79-96):
+  cites symmetrized with one self-loop per paper, every cross-type
+  relation mirrored as rev_*."""
+  edges, feats, labels = _write_igbh(tmp_path)
+  d = load_igbh_dir(tmp_path, 'tiny')
+  ets = set(d['edge_index_dict'])
+  assert ('author', 'rev_written_by', 'paper') in ets
+  assert ('institute', 'rev_affiliated_to', 'author') in ets
+  assert ('fos', 'rev_topic', 'paper') in ets
+  assert ('paper', 'rev_cites', 'paper') not in ets   # same-type: no rev
+  # cites: undirected + self loops
+  r, c = d['edge_index_dict'][('paper', 'cites', 'paper')]
+  got = set(zip(r.tolist(), c.tolist()))
+  raw = edges[('paper', 'cites', 'paper')]
+  expect = set()
+  for a, b in zip(raw[:, 0].tolist(), raw[:, 1].tolist()):
+    if a != b:
+      expect.add((a, b))
+      expect.add((b, a))
+  expect |= {(v, v) for v in range(NP_)}
+  assert got == expect
+  # reverse arrays mirror the forward ones
+  fr, fc = d['edge_index_dict'][('paper', 'written_by', 'author')]
+  rr, rc = d['edge_index_dict'][('author', 'rev_written_by', 'paper')]
+  np.testing.assert_array_equal(fr, rc)
+  np.testing.assert_array_equal(fc, rr)
