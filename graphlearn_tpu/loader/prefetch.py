@@ -39,6 +39,15 @@ class PrefetchingLoader:
   prefetch: int = 0
 
   def __iter__(self):
+    ctl = getattr(self, '_adaptive', None)
+    if ctl is not None:
+      # join any still-live prefetch worker BEFORE retuning: a worker
+      # mid-_produce must not trace against the new capacity while
+      # the finished epoch's telemetry is being attributed to the old
+      self.close()
+      if getattr(self, '_epoch_count', 0) > 0:
+        ctl.on_epoch_end()
+      self._epoch_count = getattr(self, '_epoch_count', 0) + 1
     return self._start_epoch(iter(self._batcher))
 
   def _start_epoch(self, seed_iter):

@@ -773,13 +773,19 @@ class DistHeteroNeighborLoader(PrefetchingLoader):
                seed: int = 0, input_space: str = 'old',
                exchange_slack='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
+    from .dist_sampler import DEFAULT_EXCHANGE_SLACK, AdaptiveSlack
     self.prefetch = int(prefetch)
     input_type, seeds = input_nodes
     self.input_type = input_type
+    slack = resolve_exchange_slack(exchange_slack, shuffle)
     self.sampler = DistHeteroNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
-        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
+        exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
+                        else slack))
+    self._adaptive = (AdaptiveSlack(self.sampler)
+                      if slack == 'adaptive' else None)
+    self._epoch_count = 0
     self.ds = dataset
     seeds = np.asarray(seeds).reshape(-1)
     if input_space == 'old' and input_type in dataset.old2new:
@@ -848,10 +854,15 @@ class DistHeteroLinkNeighborLoader(PrefetchingLoader):
     ns = (NegativeSampling.cast(neg_sampling)
           if neg_sampling is not None else None)
     self.neg_sampling = ns
+    from .dist_sampler import DEFAULT_EXCHANGE_SLACK, AdaptiveSlack
+    slack = resolve_exchange_slack(exchange_slack, shuffle)
     self.sampler = DistHeteroNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
-        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
+        exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
+                        else slack))
+    self._adaptive = (AdaptiveSlack(self.sampler)
+                      if slack == 'adaptive' else None)
     rows, cols, colsarr = pack_link_seeds(
         pairs, edge_label, ns.mode if ns is not None else None)
     s_t, _, d_t = self.input_type

@@ -350,12 +350,95 @@ def resolve_exchange_slack(exchange_slack, shuffle: bool):
   """Resolve the loaders' ``'auto'`` default: capped at
   `DEFAULT_EXCHANGE_SLACK` for shuffled seeds (near-balanced owner
   buckets), exact for sequential seeds (contiguous ranges can land
-  entirely on one owner and a cap would drop most of them)."""
+  entirely on one owner and a cap would drop most of them).
+  ``'adaptive'`` passes through — the loaders attach an
+  `AdaptiveSlack` controller (shuffled seeds only)."""
   if isinstance(exchange_slack, str):
+    if exchange_slack == 'adaptive':
+      if not shuffle:
+        raise ValueError(
+            "exchange_slack='adaptive' needs shuffle=True: sequential "
+            'seed ranges can land entirely on one owner, where any '
+            'cap silently drops most of a batch')
+      return 'adaptive'
     if exchange_slack != 'auto':
       raise ValueError(f'unknown exchange_slack {exchange_slack!r}')
     return DEFAULT_EXCHANGE_SLACK if shuffle else None
   return exchange_slack
+
+
+#: `AdaptiveSlack` ladder, tightest first.  2.0 is the static default;
+#: the controller walks DOWN when an epoch ends drop-free (less
+#: padding = smaller exchanges) and UP on drops, pinning after the
+#: first reversal so it never oscillates.
+SLACK_LADDER = (1.25, 1.5, 2.0, 3.0, None)
+
+#: per-epoch frontier drop-rate above which the controller widens.
+ADAPTIVE_DROP_TOLERANCE = 1e-3
+
+
+class AdaptiveSlack:
+  """Epoch-level exchange-capacity tuner (SURVEY §7 "partition-aware
+  capacity tuning", made self-tuning).
+
+  The static trade: a capacity of ``slack``x the balanced share
+  shrinks every all_to_all by ``P/slack`` but drops frontier ids when
+  ownership skews.  The right slack depends on the partition balance,
+  which the telemetry measures per epoch — so the controller walks the
+  `SLACK_LADDER` on epoch boundaries: drop-free epochs tighten one
+  rung, a dropping epoch widens one rung, and the first tighten ->
+  widen reversal PINS the setting (no oscillation).  Each change
+  clears the sampler's step cache (one recompile, amortized over the
+  remaining epochs).
+  """
+
+  def __init__(self, sampler: 'DistNeighborSampler',
+               start: float = DEFAULT_EXCHANGE_SLACK):
+    self.sampler = sampler
+    self._idx = SLACK_LADDER.index(start)
+    self._pinned = False
+    self._tightened_from = None
+    self._last = {}
+    sampler.exchange_slack = SLACK_LADDER[self._idx]
+
+  @property
+  def slack(self):
+    return SLACK_LADDER[self._idx]
+
+  def _set(self, idx: int) -> None:
+    if idx == self._idx:
+      return
+    self._idx = idx
+    self.sampler.exchange_slack = SLACK_LADDER[idx]
+    self.sampler._steps.clear()       # new capacity = new program
+
+  #: ALL loss channels the shared slack caps gate — a clean frontier
+  #: with skewed feature buckets must still read as "dropping"
+  OFFER_KEYS = ('dist.frontier.offered', 'dist.feature.offered')
+  DROP_KEYS = ('dist.frontier.dropped', 'dist.feature.dropped',
+               'dist.negative.lost')
+
+  def on_epoch_end(self) -> None:
+    """Inspect the epoch's exchange telemetry and retune.  Ticks the
+    metrics registry (a drain here must not swallow the epoch's
+    residual delta from the global counters)."""
+    st = self.sampler.exchange_stats()
+    offered = sum(st[k] - self._last.get(k, 0) for k in self.OFFER_KEYS)
+    dropped = sum(st[k] - self._last.get(k, 0) for k in self.DROP_KEYS)
+    self._last = {k: st[k] for k in self.OFFER_KEYS + self.DROP_KEYS}
+    if self._pinned or offered <= 0:
+      return
+    rate = dropped / offered
+    if rate > ADAPTIVE_DROP_TOLERANCE:
+      # widen; if this reverses our own tighten, pin there
+      wider = min(self._idx + 1, len(SLACK_LADDER) - 1)
+      self._set(wider)
+      if self._tightened_from is not None and \
+          wider >= self._tightened_from:
+        self._pinned = True
+    elif self._idx > 0:
+      self._tightened_from = self._idx
+      self._set(self._idx - 1)
 
 
 #: per-destination capacity floor: exchanges this small gain nothing
@@ -1173,6 +1256,12 @@ class DistRandomWalker(DistNeighborSampler):
 
   def __init__(self, dataset: DistDataset, walk_length: int,
                exchange_slack=None, **kwargs):
+    if exchange_slack == 'adaptive':
+      raise ValueError(
+          "exchange_slack='adaptive' is not supported for random "
+          'walks: a dropped frontier id truncates the whole walk '
+          'remainder, so the walker stays exact (pass a float to opt '
+          'into a cap where partition balance is known)')
     super().__init__(
         dataset, [], collect_features=False, with_edge=False,
         # 'auto' resolves to exact here (see class docstring)
@@ -1226,6 +1315,12 @@ class DistSubGraphLoader(PrefetchingLoader):
     # SEAL/DRNL it corrupts labels).  An explicit float still opts in.
     # `hop_chunk` is the scale lever that keeps exact affordable: it
     # bounds every full-window exchange to [P, chunk, max_degree].
+    if exchange_slack == 'adaptive':
+      raise ValueError(
+          "exchange_slack='adaptive' is not supported for induced "
+          'subgraphs: any capacity drop corrupts SEAL/DRNL labels, so '
+          'the loader stays exact (hop_chunk bounds the exchange '
+          'instead)')
     if exchange_slack == 'auto':
       exchange_slack = None
     self.sampler = DistSubGraphSampler(
@@ -1282,10 +1377,15 @@ class DistNeighborLoader(PrefetchingLoader):
                exchange_slack='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
+    slack = resolve_exchange_slack(exchange_slack, shuffle)
     self.sampler = DistNeighborSampler(
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
-        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
+        exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
+                        else slack))
+    self._adaptive = (AdaptiveSlack(self.sampler)
+                      if slack == 'adaptive' else None)
+    self._epoch_count = 0
     self.ds = dataset
     seeds = np.asarray(input_nodes).reshape(-1)
     if input_space == 'old' and dataset.old2new is not None:
@@ -1446,11 +1546,16 @@ class DistLinkNeighborLoader(PrefetchingLoader):
                exchange_slack='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
+    slack = resolve_exchange_slack(exchange_slack, shuffle)
     self.sampler = DistLinkNeighborSampler(
         dataset, num_neighbors, neg_sampling=neg_sampling, mesh=mesh,
         with_edge=with_edge, collect_features=collect_features,
-        seed=seed, exchange_slack=resolve_exchange_slack(exchange_slack,
-                                                         shuffle))
+        seed=seed,
+        exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
+                        else slack))
+    self._adaptive = (AdaptiveSlack(self.sampler)
+                      if slack == 'adaptive' else None)
+    self._epoch_count = 0
     rows, cols, colsarr = pack_link_seeds(edge_label_index, edge_label,
                                           self.sampler.neg_mode)
     if input_space == 'old' and dataset.old2new is not None:

@@ -1,0 +1,127 @@
+"""Adaptive exchange capacity (SURVEY §7 "partition-aware capacity
+tuning", self-tuning arm): the controller tightens on drop-free
+epochs, widens on drops, and pins after its first reversal — verified
+on a balanced and a deliberately skewed partition book."""
+import numpy as np
+import pytest
+
+from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                     make_mesh)
+from graphlearn_tpu.parallel.dist_sampler import (DEFAULT_EXCHANGE_SLACK,
+                                                  SLACK_LADDER,
+                                                  AdaptiveSlack)
+
+N = 256
+P = 4
+
+
+def _dataset(balanced=True):
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  feats = np.arange(N, dtype=np.float32)[:, None] * np.ones((1, 3),
+                                                            np.float32)
+  if balanced:
+    node_pb = (np.arange(N) % P).astype(np.int32)
+  else:
+    # partition 0 owns 85% of the nodes: every shuffled batch's
+    # frontier floods owner 0 past any capped share
+    node_pb = np.zeros(N, np.int32)
+    node_pb[int(N * 0.85):] = np.arange(N - int(N * 0.85)) % (P - 1) + 1
+  return DistDataset.from_full_graph(P, rows, cols, node_feat=feats,
+                                     num_nodes=N, node_pb=node_pb)
+
+
+def _epochs(loader, n):
+  for _ in range(n):
+    for b in loader:
+      pass
+    yield loader._adaptive
+
+
+def test_adaptive_tightens_when_balanced():
+  loader = DistNeighborLoader(_dataset(True), [2, 2], np.arange(N),
+                              batch_size=8, shuffle=True,
+                              mesh=make_mesh(P), seed=0,
+                              exchange_slack='adaptive')
+  assert loader._adaptive.slack == DEFAULT_EXCHANGE_SLACK
+  ctl = None
+  for ctl in _epochs(loader, 3):
+    pass
+  # drop-free balanced epochs walk DOWN the ladder
+  assert ctl.slack is not None
+  assert ctl.slack < DEFAULT_EXCHANGE_SLACK
+  st = loader.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.frontier.dropped'] == 0
+  # batches stay provenance-correct at the tightened capacity
+  for b in loader:
+    nodes = np.asarray(b.node)
+    x = np.asarray(b.x)
+    for p in range(P):
+      m = nodes[p] >= 0
+      np.testing.assert_allclose(
+          x[p][m][:, 0],
+          loader.ds.new2old[nodes[p][m]].astype(np.float32))
+
+
+def test_adaptive_widens_and_pins_when_skewed():
+  # batch 64/device: hop-2 frontiers (256 ids) exceed the capped
+  # shares, so the 85% owner drops ids at every finite slack —
+  # MIN_EXCHANGE_CAP makes smaller frontiers effectively exact
+  loader = DistNeighborLoader(_dataset(False), [2, 2], np.arange(N),
+                              batch_size=64, shuffle=True,
+                              mesh=make_mesh(P), seed=0,
+                              exchange_slack='adaptive')
+  hist = []
+  for ctl in _epochs(loader, 5):
+    hist.append(ctl.slack)
+  # the controller must end wider than the default (or pinned after a
+  # reversal), and once pinned it stops moving
+  idx = SLACK_LADDER.index(hist[-1])
+  assert idx > SLACK_LADDER.index(DEFAULT_EXCHANGE_SLACK) or ctl._pinned
+  if ctl._pinned:
+    assert hist[-1] == hist[-2]
+  st = loader.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.frontier.dropped'] > 0
+
+
+def test_adaptive_requires_shuffle():
+  with pytest.raises(ValueError, match='adaptive'):
+    DistNeighborLoader(_dataset(True), [2], np.arange(N), batch_size=8,
+                       shuffle=False, mesh=make_mesh(P),
+                       exchange_slack='adaptive')
+
+
+def test_adaptive_controller_unit():
+  """Ladder mechanics without a mesh: fake sampler counters."""
+  class FakeSampler:
+    exchange_slack = None
+    _steps = {}
+
+    def __init__(self):
+      self.offered = 0
+      self.dropped = 0
+
+    def exchange_stats(self, tick_metrics=True):
+      return {'dist.frontier.offered': self.offered,
+              'dist.frontier.dropped': self.dropped,
+              'dist.feature.offered': 0, 'dist.feature.dropped': 0,
+              'dist.negative.lost': 0}
+
+  s = FakeSampler()
+  ctl = AdaptiveSlack(s)
+  assert s.exchange_slack == DEFAULT_EXCHANGE_SLACK
+  # clean epoch: tighten
+  s.offered = 1000
+  ctl.on_epoch_end()
+  assert ctl.slack == 1.5
+  # clean again: tighten to the floor
+  s.offered = 2000
+  ctl.on_epoch_end()
+  assert ctl.slack == 1.25
+  # drops: widen back, and that reversal pins
+  s.offered, s.dropped = 3000, 100
+  ctl.on_epoch_end()
+  assert ctl.slack == 1.5 and ctl._pinned
+  s.offered, s.dropped = 4000, 200
+  ctl.on_epoch_end()
+  assert ctl.slack == 1.5          # pinned: no further movement
