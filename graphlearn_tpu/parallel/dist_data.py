@@ -63,6 +63,30 @@ class DistGraph:
     return self.indptr.shape[1] - 1
 
 
+def relabel_by_partition(node_pb: np.ndarray, num_parts: int,
+                         hotness: Optional[np.ndarray] = None):
+  """THE contiguous-ownership relabel — single definition shared by
+  every loader path (a host-local and a single-controller load of the
+  same layout must agree on the id space, or precomputed seeds/splits
+  mis-address every row).  Sort nodes by (partition[, -hotness],
+  old id); returns ``(old2new, counts, bounds)``."""
+  node_pb = np.asarray(node_pb)
+  num_nodes = len(node_pb)
+  if hotness is not None:
+    hot = np.asarray(hotness)
+    if hot.dtype.kind == 'u':
+      hot = hot.astype(np.int64)   # unsigned negation would wrap
+    order = np.lexsort((np.arange(num_nodes), -hot,
+                        node_pb))                    # new id -> old id
+  else:
+    order = np.argsort(node_pb, kind='stable')       # new id -> old id
+  old2new = np.empty(num_nodes, dtype=np.int64)
+  old2new[order] = np.arange(num_nodes)
+  counts = np.bincount(node_pb, minlength=num_parts)
+  bounds = np.concatenate([[0], np.cumsum(counts)])
+  return old2new, counts, bounds
+
+
 def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
                      node_pb: np.ndarray, num_nodes: int,
                      edge_ids: Optional[np.ndarray] = None,
@@ -84,19 +108,8 @@ def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
   node_pb = np.asarray(node_pb)
   if num_parts is None:
     num_parts = int(node_pb.max()) + 1 if node_pb.size else 1
-  # contiguous relabel: sort nodes by (partition[, -hotness], old id).
-  if hotness is not None:
-    hot = np.asarray(hotness)
-    if hot.dtype.kind == 'u':
-      hot = hot.astype(np.int64)   # unsigned negation would wrap
-    order = np.lexsort((np.arange(num_nodes), -hot,
-                        node_pb))                    # new id -> old id
-  else:
-    order = np.argsort(node_pb, kind='stable')       # new id -> old id
-  old2new = np.empty(num_nodes, dtype=np.int64)
-  old2new[order] = np.arange(num_nodes)
-  counts = np.bincount(node_pb, minlength=num_parts)
-  bounds = np.concatenate([[0], np.cumsum(counts)])
+  old2new, counts, bounds = relabel_by_partition(node_pb, num_parts,
+                                                 hotness)
 
   rows_n = old2new[np.asarray(rows)]
   cols_n = old2new[np.asarray(cols)]
@@ -284,13 +297,19 @@ class DistDataset:
   """
 
   def __init__(self, graph: DistGraph, node_features=None, node_labels=None,
-               old2new: Optional[np.ndarray] = None, edge_features=None):
+               old2new: Optional[np.ndarray] = None, edge_features=None,
+               host_parts: Optional[np.ndarray] = None):
     self.graph = graph
     self.node_features = node_features
     self.node_labels = node_labels
     self.edge_features = edge_features
     self.old2new = old2new
     self.new2old = (np.argsort(old2new) if old2new is not None else None)
+    #: multi-host: the partition indices THIS process materialized
+    #: (stacked arrays then hold only these, in this order) — see
+    #: `from_partition_dir(host_parts=...)`.  None = all partitions.
+    self.host_parts = (np.asarray(host_parts, np.int64)
+                       if host_parts is not None else None)
 
   @property
   def num_partitions(self) -> int:
@@ -339,12 +358,26 @@ class DistDataset:
 
   @classmethod
   def from_partition_dir(cls, root, num_parts: Optional[int] = None,
-                         split_ratio: float = 1.0) -> 'DistDataset':
+                         split_ratio: float = 1.0,
+                         host_parts=None) -> 'DistDataset':
     """Assemble from the offline partitioner's layout
     (reference `DistDataset.load`, `distributed/dist_dataset.py:77-164`).
-    Loads every partition on this host (single-controller JAX).
     ``split_ratio < 1`` tiers the node-feature store (HBM hot /
-    host-DRAM cold; hotness = in-degree)."""
+    host-DRAM cold; hotness = in-degree).
+
+    ``host_parts`` (multi-host): materialize ONLY those partitions'
+    graph/feature/label tensors on this process — the others live on
+    their own hosts and enter the mesh via
+    `jax.make_array_from_single_device_arrays` (the sampler's
+    host-local put).  At IGBH scale this is what keeps per-host RAM
+    at ``1/num_hosts`` of the dataset instead of all of it.  Pass
+    `multihost.host_partition_ids(mesh)`.  Host-local constraints
+    (v1): untiered only, no edge features, the offline cache plan is
+    not applied.
+    """
+    if host_parts is not None:
+      return cls._from_partition_dir_host_local(
+          root, num_parts, split_ratio, host_parts)
     from ..partition import load_partition
     parts = []
     p0 = load_partition(root, 0)
@@ -396,3 +429,94 @@ class DistDataset:
         efeats[p['edge_feat'].ids] = p['edge_feat'].feats
       ef = build_dist_edge_feature(efeats, num_parts)
     return cls(g, nf, nl, old2new, edge_features=ef)
+
+  @classmethod
+  def _from_partition_dir_host_local(cls, root, num_parts, split_ratio,
+                                     host_parts) -> 'DistDataset':
+    """Materialize only ``host_parts`` (see `from_partition_dir`).
+
+    Global quantities (relabel, bounds, padding widths) come from the
+    tiny per-layout metadata — ``node_pb.npy`` and mmap'd array
+    SHAPES — never from other hosts' tensors.
+    """
+    import json as _json
+    from pathlib import Path
+    from ..utils.topo import coo_to_csr
+    root = Path(root)
+    if split_ratio < 1.0:
+      raise NotImplementedError(
+          'host-local loading is untiered (v1): the cold overlay runs '
+          'at the REQUESTER, which would need every remote '
+          "partition's cold rows in local DRAM — the very thing "
+          'host_parts avoids.  Serve beyond-HBM tables via more hosts '
+          'or single-controller from_partition_dir(split_ratio=...).')
+    with open(root / 'META.json') as f:
+      meta = _json.load(f)
+    if meta['hetero']:
+      raise NotImplementedError('host-local loading is homogeneous (v1)')
+    if meta.get('edge_assign', 'by_src') != 'by_src':
+      raise NotImplementedError(
+          "host-local loading needs edge_assign='by_src' layouts: "
+          'each partition dir must hold exactly its own rows '
+          "(by_dst layouts re-bucket globally — use the "
+          'single-controller from_partition_dir)')
+    num_parts = num_parts or meta['num_parts']
+    host_parts = np.asarray(host_parts, np.int64)
+    node_pb = np.load(root / 'node_pb.npy')
+    old2new, counts, bounds = relabel_by_partition(node_pb, num_parts)
+    max_nodes = int(counts.max()) if num_parts else 0
+    # padding widths need only array SHAPES: mmap reads the header
+    edge_counts = [
+        np.load(root / f'part{i}' / 'graph' / 'rows.npy',
+                mmap_mode='r').shape[0] for i in range(num_parts)]
+    max_edges = max(max(edge_counts), 1)
+
+    pl = len(host_parts)
+    indptr_s = np.zeros((pl, max_nodes + 1), np.int64)
+    indices_s = np.full((pl, max_edges), -1, np.int32)
+    eids_s = np.full((pl, max_edges), -1, np.int64)
+    feats_s = labels_s = None
+    if (root / 'part0' / 'edge_feat').exists():
+      raise NotImplementedError(
+          'host-local loading does not serve edge features (v1)')
+    if (root / 'part0' / 'node_feat' / 'cache_ids.npy').exists():
+      import warnings
+      warnings.warn(
+          'host-local loading ignores the offline feature-cache plan '
+          '(cache_ids/cache_feats): formerly cache-served lookups will '
+          'ride the all_to_all', stacklevel=3)
+    for j, p in enumerate(host_parts):
+      gdir = root / f'part{p}' / 'graph'
+      rows = np.load(gdir / 'rows.npy')
+      cols = np.load(gdir / 'cols.npy')
+      eids = np.load(gdir / 'eids.npy')
+      local_rows = old2new[rows] - bounds[p]
+      if len(local_rows) and (local_rows.min() < 0
+                              or local_rows.max() >= counts[p]):
+        raise ValueError(
+            f'partition {p} holds edges whose src it does not own '
+            '(corrupt or non-by_src layout)')
+      iptr, idx, eid = coo_to_csr(local_rows, old2new[cols],
+                                  int(counts[p]), eids)
+      indptr_s[j, :len(iptr)] = iptr
+      indptr_s[j, len(iptr):] = iptr[-1]
+      indices_s[j, :len(idx)] = idx
+      eids_s[j, :len(eid)] = eid
+      fdir = root / f'part{p}' / 'node_feat'
+      if (fdir / 'feats.npy').exists():
+        feats = np.load(fdir / 'feats.npy')
+        ids = np.load(fdir / 'ids.npy')
+        if feats_s is None:
+          feats_s = np.zeros((pl, max_nodes, feats.shape[1]),
+                             feats.dtype)
+        feats_s[j, old2new[ids] - bounds[p]] = feats
+      ldir = root / f'part{p}' / 'node_label'
+      if (ldir / 'labels.npy').exists():
+        lab = np.load(ldir / 'labels.npy')
+        ids = np.load(ldir / 'ids.npy')
+        if labels_s is None:
+          labels_s = np.zeros((pl, max_nodes), lab.dtype)
+        labels_s[j, old2new[ids] - bounds[p]] = lab
+    g = DistGraph(indptr_s, indices_s, eids_s, bounds)
+    nf = (DistFeature(feats_s, bounds) if feats_s is not None else None)
+    return cls(g, nf, labels_s, old2new, host_parts=host_parts)

@@ -979,6 +979,26 @@ class DistNeighborSampler(ExchangeTelemetry):
     self._device_arrays = None
     self._init_stats()
 
+  def _put_stacked(self, arr_local: np.ndarray) -> jax.Array:
+    """Host-local put: this process holds only its partitions' slices
+    (`DistDataset.host_parts`); assemble the GLOBAL ``[P, ...]`` array
+    from per-device single-shard puts — no host ever materializes
+    another host's tensors (the multi-host RAM story)."""
+    from .multihost import host_partition_ids
+    flat = self.mesh.devices.reshape(-1)
+    mine = host_partition_ids(self.mesh).tolist()
+    hp = list(np.asarray(self.ds.host_parts))
+    if mine != hp:
+      raise ValueError(
+          f'host_parts {hp} != this process\'s mesh positions {mine} '
+          '— load with multihost.host_partition_ids(mesh)')
+    assert arr_local.shape[0] == len(mine), (arr_local.shape, mine)
+    shards = [jax.device_put(arr_local[j:j + 1], flat[i])
+              for j, i in enumerate(mine)]
+    return jax.make_array_from_single_device_arrays(
+        (self.num_parts,) + tuple(arr_local.shape[1:]),
+        NamedSharding(self.mesh, P(self.axis)), shards)
+
   def _arrays(self):
     if self._device_arrays is None:
       shard = NamedSharding(self.mesh, P(self.axis))
@@ -1005,12 +1025,30 @@ class DistNeighborSampler(ExchangeTelemetry):
       hcounts = (self.ds.node_features.hot_counts
                  if self.collect_features
                  else np.zeros(self.num_parts, np.int32))
+      if getattr(self.ds, 'host_parts', None) is not None:
+        # stacked arrays hold ONLY this host's partitions: assemble
+        # the global sharded arrays shard-by-shard.  Placeholder
+        # tables must match the LOCAL stack height.
+        pl = len(self.ds.host_parts)
+        if not self.collect_features:
+          fshards = np.zeros((pl, 1, 1), np.float32)
+        if not self.collect_labels:
+          lshards = np.zeros((pl, 1), np.int32)
+        if not self.with_cache:
+          cids = cids[:pl]
+          crows = crows[:pl]
+        if not self.collect_edge_features:
+          efshards = efshards[:pl]
+        putS = self._put_stacked
+      else:
+        putS = lambda a: put(a, shard)       # noqa: E731
       self._device_arrays = dict(
-          indptr=put(g.indptr, shard), indices=put(g.indices, shard),
-          eids=put(g.edge_ids, shard), bounds=put(g.bounds, repl),
-          fshards=put(fshards, shard), lshards=put(lshards, shard),
-          cids=put(cids, shard), crows=put(crows, shard),
-          efshards=put(efshards, shard), ebounds=put(ebounds, repl),
+          indptr=putS(g.indptr), indices=putS(g.indices),
+          eids=putS(g.edge_ids), bounds=put(g.bounds, repl),
+          fshards=putS(np.asarray(fshards)),
+          lshards=putS(np.asarray(lshards)),
+          cids=putS(cids), crows=putS(crows),
+          efshards=putS(efshards), ebounds=put(ebounds, repl),
           hcounts=put(np.asarray(hcounts, np.int32), repl))
     return self._device_arrays
 

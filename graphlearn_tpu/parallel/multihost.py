@@ -21,7 +21,11 @@ Typical launch (same script on every host)::
     from graphlearn_tpu.parallel import multihost
     multihost.initialize()                  # env-driven on TPU pods
     mesh = multihost.global_mesh()
-    ds = DistDataset.from_partition_dir(root, mesh.devices.size)
+    ds = DistDataset.from_partition_dir(
+        root, mesh.devices.size,
+        # each host materializes ONLY its partitions' tensors
+        # (per-host RAM = 1/num_hosts of the dataset)
+        host_parts=multihost.host_partition_ids(mesh))
     seeds = multihost.host_seed_shard(all_seeds, epoch=e, seed=0)
     loader = DistNeighborLoader(ds, fanouts, seeds, mesh=mesh, ...)
 """
@@ -70,6 +74,16 @@ def host_device_slice(num_parts: Optional[int] = None) -> slice:
   per_host = num_parts // jax.process_count()
   lo = jax.process_index() * per_host
   return slice(lo, lo + per_host)
+
+
+def host_partition_ids(mesh: Mesh) -> np.ndarray:
+  """The partition indices whose devices live on THIS process, in mesh
+  order — feed `DistDataset.from_partition_dir(host_parts=...)` so
+  each host materializes only the shards its devices will hold."""
+  flat = mesh.devices.reshape(-1)
+  return np.asarray([i for i, d in enumerate(flat)
+                     if d.process_index == jax.process_index()],
+                    np.int64)
 
 
 def host_seed_shard(seeds: np.ndarray, epoch: int = 0, seed: int = 0,

@@ -13,6 +13,7 @@ import sys
 
 coordinator, num_procs, proc_id, out_file = (
     sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+partition_dir = sys.argv[5] if len(sys.argv) > 5 else None
 
 import numpy as np
 from graphlearn_tpu.parallel import multihost
@@ -75,8 +76,33 @@ state, loss, correct = step(state, first)
 loss_val = float(np.asarray(loss.addressable_shards[0].data))
 assert np.isfinite(loss_val), loss_val
 
+host_local = {}
+if partition_dir is not None:
+  # HOST-LOCAL loading: this process materializes ONLY its mesh
+  # positions' partitions; the sampler assembles the global arrays
+  # shard-by-shard.  Feature provenance is checked on the local
+  # addressable pieces (feat[v, 0] == old id v).
+  hp = multihost.host_partition_ids(mesh)
+  ds2 = DistDataset.from_partition_dir(partition_dir, num_parts,
+                                       host_parts=hp)
+  loader2 = DistNeighborLoader(ds2, [2, 2], np.arange(N), batch_size=4,
+                               shuffle=True, mesh=mesh, seed=5)
+  b2 = next(iter(loader2))
+  checked = 0
+  for ns, xs in zip(b2.node.addressable_shards,
+                    b2.x.addressable_shards):
+    nodes = np.asarray(ns.data)[0]
+    x = np.asarray(xs.data)[0]
+    m = nodes >= 0
+    old = ds2.new2old[nodes[m]]
+    np.testing.assert_allclose(x[m][:, 0], old.astype(np.float32))
+    checked += int(m.sum())
+  host_local = {'host_parts': hp.tolist(),
+                'provenance_rows': checked}
+
 with open(out_file, 'w') as f:
   json.dump({'proc': proc_id, 'shard': shard.tolist(),
              'host_slice': [hsl.start, hsl.stop],
-             'batches': batches, 'loss': loss_val}, f)
+             'batches': batches, 'loss': loss_val,
+             'host_local': host_local}, f)
 print('WORKER OK', proc_id, loss_val)

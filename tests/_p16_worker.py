@@ -79,6 +79,37 @@ for p in range(P):
     got += 1
 report['subgraph_edges'] = got
 
+# tiered store + chunked SEAL window at P=16 (the r3 scale levers)
+ds_t = DistDataset.from_full_graph(P, rows, cols, node_feat=feats,
+                                   num_nodes=n, split_ratio=0.5)
+tl = DistNeighborLoader(ds_t, [3, 2], np.arange(n), batch_size=4,
+                        shuffle=True, mesh=mesh, seed=3)
+tb = next(iter(tl))
+node_t = np.asarray(tb.node)
+x_t = np.asarray(tb.x)
+for p in range(P):
+  m = node_t[p] >= 0
+  np.testing.assert_allclose(x_t[p][m][:, 0],
+                             ds_t.new2old[node_t[p][m]])
+st = tl.sampler.exchange_stats(tick_metrics=False)
+report['tiered_cold_misses'] = st['dist.feature.cold_misses']
+assert report['tiered_cold_misses'] > 0
+
+sgc = DistSubGraphLoader(ds, [2], np.arange(n), batch_size=2, mesh=mesh,
+                         collect_features=False, seed=2, hop_chunk=16)
+scb = next(iter(sgc))
+node_c = np.asarray(scb.node)
+eic = np.asarray(scb.edge_index)
+chunked = 0
+for p in range(P):
+  m = np.asarray(scb.edge_mask)[p]
+  for i in np.nonzero(m)[0]:
+    u = int(ds.new2old[node_c[p, eic[p, 0, i]]])
+    v = int(ds.new2old[node_c[p, eic[p, 1, i]]])
+    assert (u, v) in edge_set
+    chunked += 1
+report['subgraph_edges_chunked'] = chunked
+
 with open(out_file, 'w') as f:
   json.dump(report, f)
 print('P16 OK', report)

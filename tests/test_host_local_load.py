@@ -1,0 +1,91 @@
+"""Host-local partition loading (`from_partition_dir(host_parts=...)`):
+this process materializes only its partitions' tensors and the sampler
+assembles the global sharded arrays shard-by-shard
+(`make_array_from_single_device_arrays`) — the multi-host RAM story.
+Single-process equivalence here (host_parts = every partition must
+reproduce the full load bit-for-bit); the REAL 2-process arm runs in
+tests/test_multihost.py.
+"""
+import numpy as np
+import pytest
+
+from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                     make_mesh)
+from graphlearn_tpu.partition import RandomPartitioner
+
+P, N = 8, 128
+
+
+def _write(root):
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  feats = np.arange(N, dtype=np.float32)[:, None] * np.ones((1, 3),
+                                                            np.float32)
+  labels = (np.arange(N) % 5).astype(np.int32)
+  RandomPartitioner(root, P, N, (rows, cols), node_feat=feats,
+                    node_label=labels, seed=0).partition()
+
+
+def test_host_local_equals_full_load(tmp_path):
+  _write(tmp_path)
+  full = DistDataset.from_partition_dir(tmp_path)
+  local = DistDataset.from_partition_dir(tmp_path,
+                                         host_parts=np.arange(P))
+  np.testing.assert_array_equal(full.graph.bounds, local.graph.bounds)
+  np.testing.assert_array_equal(full.old2new, local.old2new)
+  np.testing.assert_array_equal(full.graph.indptr, local.graph.indptr)
+  # CSR column ORDER within a row may differ (independent sorts);
+  # compare per-row sets via a canonical sort
+  for p in range(P):
+    for r in range(full.graph.max_local_nodes):
+      a = np.sort(full.graph.indices[p][full.graph.indptr[p][r]:
+                                        full.graph.indptr[p][r + 1]])
+      b = np.sort(local.graph.indices[p][local.graph.indptr[p][r]:
+                                         local.graph.indptr[p][r + 1]])
+      np.testing.assert_array_equal(a, b)
+  np.testing.assert_array_equal(full.node_features.shards,
+                                local.node_features.shards)
+  np.testing.assert_array_equal(full.node_labels, local.node_labels)
+
+
+def test_host_local_loader_epoch(tmp_path):
+  _write(tmp_path)
+  ds = DistDataset.from_partition_dir(tmp_path,
+                                      host_parts=np.arange(P))
+  loader = DistNeighborLoader(ds, [2, 2], np.arange(N), batch_size=4,
+                              shuffle=True, mesh=make_mesh(P), seed=0)
+  nb = 0
+  for b in loader:
+    nodes = np.asarray(b.node)
+    x = np.asarray(b.x)
+    y = np.asarray(b.y)
+    for p in range(P):
+      m = nodes[p] >= 0
+      old = ds.new2old[nodes[p][m]]
+      np.testing.assert_allclose(x[p][m][:, 0], old.astype(np.float32))
+      np.testing.assert_array_equal(y[p][m], old % 5)
+    nb += 1
+  assert nb == len(loader)
+
+
+def test_host_local_guards(tmp_path):
+  _write(tmp_path)
+  with pytest.raises(NotImplementedError, match='untiered'):
+    DistDataset.from_partition_dir(tmp_path, split_ratio=0.5,
+                                   host_parts=np.arange(P))
+  ds = DistDataset.from_partition_dir(tmp_path, host_parts=[0, 1])
+  loader = DistNeighborLoader(ds, [2], np.arange(N), batch_size=4,
+                              shuffle=True, mesh=make_mesh(P), seed=0)
+  # single process owns ALL 8 mesh positions but only loaded 2 shards:
+  # the put must refuse, not silently mis-place
+  with pytest.raises(ValueError, match='host_parts'):
+    next(iter(loader))
+
+
+def test_host_local_rejects_by_dst_layout(tmp_path):
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  RandomPartitioner(tmp_path, P, N, (rows, cols), seed=0,
+                    edge_assign='by_dst').partition()
+  with pytest.raises(NotImplementedError, match='by_src'):
+    DistDataset.from_partition_dir(tmp_path, host_parts=np.arange(P))

@@ -40,6 +40,18 @@ def test_two_process_distributed_epoch(tmp_path):
       flags + ' --xla_force_host_platform_device_count=4').strip()
   env['PYTHONPATH'] = (str(Path(__file__).resolve().parent.parent)
                        + os.pathsep + env.get('PYTHONPATH', ''))
+  # partition layout for the HOST-LOCAL loading phase: each process
+  # materializes only its 4 mesh positions' shards
+  from graphlearn_tpu.partition import RandomPartitioner
+  n = 64
+  rows = np.concatenate([np.arange(n), np.arange(n)])
+  cols = np.concatenate([(np.arange(n) + 1) % n, (np.arange(n) + 2) % n])
+  feats = (np.arange(n, dtype=np.float32)[:, None]
+           * np.ones((1, 4), np.float32))
+  pdir = tmp_path / 'parts'
+  RandomPartitioner(pdir, 8, n, (rows, cols), node_feat=feats,
+                    node_label=(np.arange(n) % 4).astype(np.int32),
+                    seed=0).partition()
   procs = []
   outs = []
   for pid in range(2):
@@ -47,7 +59,7 @@ def test_two_process_distributed_epoch(tmp_path):
     outs.append(out)
     procs.append(subprocess.Popen(
         [sys.executable, str(worker), f'localhost:{port}', '2',
-         str(pid), str(out)],
+         str(pid), str(out), str(pdir)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True))
   results = []
@@ -70,3 +82,9 @@ def test_two_process_distributed_epoch(tmp_path):
   assert r0['batches'] == r1['batches'] == 64 // (4 * 8)
   assert np.isfinite(r0['loss'])
   assert abs(r0['loss'] - r1['loss']) < 1e-5
+  # host-local loading: each process materialized ITS 4 partitions and
+  # the assembled global batch carried provenance-correct features
+  assert r0['host_local']['host_parts'] == [0, 1, 2, 3]
+  assert r1['host_local']['host_parts'] == [4, 5, 6, 7]
+  assert r0['host_local']['provenance_rows'] > 0
+  assert r1['host_local']['provenance_rows'] > 0
