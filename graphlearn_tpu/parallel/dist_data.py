@@ -87,6 +87,71 @@ def relabel_by_partition(node_pb: np.ndarray, num_parts: int,
   return old2new, counts, bounds
 
 
+def stack_partition_csr(root, host_parts, subpath: str,
+                        old2new_src, old2new_dst, bounds_src, counts_src,
+                        num_parts: int):
+  """Shared host-local CSR stacking (homo + hetero loaders): pad
+  widths from mmap'd shapes over ALL partitions, materialize only
+  ``host_parts`` — one definition so the two loaders cannot drift.
+
+  ``subpath``: dir under ``part{i}/`` holding rows/cols/eids
+  (``'graph'`` or ``'graph/<etype>'``).  Returns
+  ``(indptr_s, indices_s, eids_s)`` stacked ``[len(host_parts), ...]``.
+  """
+  from pathlib import Path
+  from ..utils.topo import coo_to_csr
+  root = Path(root)
+  edge_counts = [
+      np.load(root / f'part{i}' / subpath / 'rows.npy',
+              mmap_mode='r').shape[0] for i in range(num_parts)]
+  max_edges = max(max(edge_counts), 1)
+  max_nodes = int(counts_src.max()) if num_parts else 0
+  pl = len(host_parts)
+  indptr_s = np.zeros((pl, max_nodes + 1), np.int64)
+  indices_s = np.full((pl, max_edges), -1, np.int32)
+  eids_s = np.full((pl, max_edges), -1, np.int64)
+  for j, p in enumerate(host_parts):
+    gdir = root / f'part{p}' / subpath
+    rows = np.load(gdir / 'rows.npy')
+    cols = np.load(gdir / 'cols.npy')
+    eids = np.load(gdir / 'eids.npy')
+    local_rows = old2new_src[rows] - bounds_src[p]
+    if len(local_rows) and (local_rows.min() < 0
+                            or local_rows.max() >= counts_src[p]):
+      raise ValueError(
+          f'partition {p} ({subpath}) holds edges whose src it does '
+          'not own (corrupt or non-by_src layout)')
+    iptr, idx, eid = coo_to_csr(local_rows, old2new_dst[cols],
+                                int(counts_src[p]), eids)
+    indptr_s[j, :len(iptr)] = iptr
+    indptr_s[j, len(iptr):] = iptr[-1]
+    indices_s[j, :len(idx)] = idx
+    eids_s[j, :len(eid)] = eid
+  return indptr_s, indices_s, eids_s
+
+
+def scatter_partition_rows(root, host_parts, subpath: str, fname: str,
+                           old2new, bounds, max_nodes: int):
+  """Shared host-local row scatter (features ``fname='feats'`` or
+  labels ``fname='labels'``): stack ``[len(host_parts), max_nodes
+  (, D)]`` with each partition's owned rows placed at their local
+  offsets; None when the files do not exist."""
+  from pathlib import Path
+  root = Path(root)
+  out = None
+  for j, p in enumerate(host_parts):
+    d = root / f'part{p}' / subpath
+    if not (d / f'{fname}.npy').exists():
+      continue
+    vals = np.load(d / f'{fname}.npy')
+    ids = np.load(d / 'ids.npy')
+    if out is None:
+      out = np.zeros((len(host_parts), max_nodes) + vals.shape[1:],
+                     vals.dtype)
+    out[j, old2new[ids] - bounds[p]] = vals
+  return out
+
+
 def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
                      node_pb: np.ndarray, num_nodes: int,
                      edge_ids: Optional[np.ndarray] = None,
@@ -441,7 +506,6 @@ class DistDataset:
     """
     import json as _json
     from pathlib import Path
-    from ..utils.topo import coo_to_csr
     root = Path(root)
     if split_ratio < 1.0:
       raise NotImplementedError(
@@ -465,17 +529,6 @@ class DistDataset:
     node_pb = np.load(root / 'node_pb.npy')
     old2new, counts, bounds = relabel_by_partition(node_pb, num_parts)
     max_nodes = int(counts.max()) if num_parts else 0
-    # padding widths need only array SHAPES: mmap reads the header
-    edge_counts = [
-        np.load(root / f'part{i}' / 'graph' / 'rows.npy',
-                mmap_mode='r').shape[0] for i in range(num_parts)]
-    max_edges = max(max(edge_counts), 1)
-
-    pl = len(host_parts)
-    indptr_s = np.zeros((pl, max_nodes + 1), np.int64)
-    indices_s = np.full((pl, max_edges), -1, np.int32)
-    eids_s = np.full((pl, max_edges), -1, np.int64)
-    feats_s = labels_s = None
     if (root / 'part0' / 'edge_feat').exists():
       raise NotImplementedError(
           'host-local loading does not serve edge features (v1)')
@@ -485,38 +538,15 @@ class DistDataset:
           'host-local loading ignores the offline feature-cache plan '
           '(cache_ids/cache_feats): formerly cache-served lookups will '
           'ride the all_to_all', stacklevel=3)
-    for j, p in enumerate(host_parts):
-      gdir = root / f'part{p}' / 'graph'
-      rows = np.load(gdir / 'rows.npy')
-      cols = np.load(gdir / 'cols.npy')
-      eids = np.load(gdir / 'eids.npy')
-      local_rows = old2new[rows] - bounds[p]
-      if len(local_rows) and (local_rows.min() < 0
-                              or local_rows.max() >= counts[p]):
-        raise ValueError(
-            f'partition {p} holds edges whose src it does not own '
-            '(corrupt or non-by_src layout)')
-      iptr, idx, eid = coo_to_csr(local_rows, old2new[cols],
-                                  int(counts[p]), eids)
-      indptr_s[j, :len(iptr)] = iptr
-      indptr_s[j, len(iptr):] = iptr[-1]
-      indices_s[j, :len(idx)] = idx
-      eids_s[j, :len(eid)] = eid
-      fdir = root / f'part{p}' / 'node_feat'
-      if (fdir / 'feats.npy').exists():
-        feats = np.load(fdir / 'feats.npy')
-        ids = np.load(fdir / 'ids.npy')
-        if feats_s is None:
-          feats_s = np.zeros((pl, max_nodes, feats.shape[1]),
-                             feats.dtype)
-        feats_s[j, old2new[ids] - bounds[p]] = feats
-      ldir = root / f'part{p}' / 'node_label'
-      if (ldir / 'labels.npy').exists():
-        lab = np.load(ldir / 'labels.npy')
-        ids = np.load(ldir / 'ids.npy')
-        if labels_s is None:
-          labels_s = np.zeros((pl, max_nodes), lab.dtype)
-        labels_s[j, old2new[ids] - bounds[p]] = lab
+    indptr_s, indices_s, eids_s = stack_partition_csr(
+        root, host_parts, 'graph', old2new, old2new, bounds, counts,
+        num_parts)
+    feats_s = scatter_partition_rows(root, host_parts, 'node_feat',
+                                     'feats', old2new, bounds,
+                                     max_nodes)
+    labels_s = scatter_partition_rows(root, host_parts, 'node_label',
+                                      'labels', old2new, bounds,
+                                      max_nodes)
     g = DistGraph(indptr_s, indices_s, eids_s, bounds)
     nf = (DistFeature(feats_s, bounds) if feats_s is not None else None)
     return cls(g, nf, labels_s, old2new, host_parts=host_parts)

@@ -62,7 +62,7 @@ class DistHeteroDataset:
   """
 
   def __init__(self, graphs, bounds, node_features=None, node_labels=None,
-               old2new=None, edge_features=None):
+               old2new=None, edge_features=None, host_parts=None):
     self.graphs = dict(graphs)
     self.bounds = {nt: np.asarray(b, np.int64) for nt, b in bounds.items()}
     self.node_features = dict(node_features or {})
@@ -71,6 +71,10 @@ class DistHeteroDataset:
                           for et, f in (edge_features or {}).items()}
     self.old2new = dict(old2new or {})
     self.new2old = {nt: np.argsort(m) for nt, m in self.old2new.items()}
+    #: multi-host: partition indices THIS process materialized (see
+    #: `DistDataset.host_parts`).  None = all partitions.
+    self.host_parts = (np.asarray(host_parts, np.int64)
+                       if host_parts is not None else None)
 
   @property
   def num_partitions(self) -> int:
@@ -167,11 +171,17 @@ class DistHeteroDataset:
 
   @classmethod
   def from_partition_dir(cls, root, num_parts: Optional[int] = None,
-                         split_ratio: float = 1.0
-                         ) -> 'DistHeteroDataset':
+                         split_ratio: float = 1.0,
+                         host_parts=None) -> 'DistHeteroDataset':
     """Assemble from the offline partitioner's hetero layout
     (`partition/base.py` hetero branch; reference `DistDataset.load`).
-    ``split_ratio < 1`` tiers every node-type feature store."""
+    ``split_ratio < 1`` tiers every node-type feature store.
+    ``host_parts`` materializes only this process's partitions (see
+    `DistDataset.from_partition_dir`); same v1 limits — untiered, no
+    edge features, by_src layouts."""
+    if host_parts is not None:
+      return _hetero_host_local(cls, root, num_parts, split_ratio,
+                                host_parts)
     from ..partition import load_partition
     p0 = load_partition(root, 0)
     meta = p0['meta']
@@ -232,6 +242,66 @@ class DistHeteroDataset:
                         for nt in meta['node_types']},
         node_pb_dict=node_pb_dict, edge_feat_dict=edge_feat_dict,
         edge_ids_dict=edge_ids_dict, split_ratio=split_ratio)
+
+
+def _hetero_host_local(cls, root, num_parts, split_ratio, host_parts):
+  """Host-local arm of `DistHeteroDataset.from_partition_dir`:
+  materialize only ``host_parts`` — global relabels/bounds/padding
+  from per-type ``node_pb_*`` files and mmap'd array shapes, local
+  CSR/feature/label stacks from this host's partition dirs only."""
+  import json as _json
+  from pathlib import Path
+  from ..typing import as_str, edge_type_from_str
+  from .dist_data import (DistFeature, DistGraph, relabel_by_partition,
+                          scatter_partition_rows, stack_partition_csr)
+  root = Path(root)
+  if split_ratio < 1.0:
+    raise NotImplementedError(
+        'host-local loading is untiered (v1) — see '
+        'DistDataset.from_partition_dir')
+  with open(root / 'META.json') as f:
+    meta = _json.load(f)
+  assert meta['hetero'], 'homogeneous layout: use DistDataset'
+  if meta.get('edge_assign', 'by_src') != 'by_src':
+    raise NotImplementedError(
+        "host-local loading needs edge_assign='by_src' layouts")
+  num_parts = num_parts or meta['num_parts']
+  host_parts = np.asarray(host_parts, np.int64)
+
+  old2new, bounds, counts = {}, {}, {}
+  for nt in meta['node_types']:
+    pb = np.load(root / f'node_pb_{nt}.npy')
+    old2new[nt], counts[nt], bounds[nt] = relabel_by_partition(
+        pb, num_parts)
+  etypes = [edge_type_from_str(ets) for ets in meta['edge_types']]
+  if any((root / 'part0' / 'edge_feat' / as_str(et)).exists()
+         for et in etypes):
+    raise NotImplementedError(
+        'host-local loading does not serve edge features (v1)')
+
+  graphs = {}
+  for et in etypes:
+    s, _, d = et
+    indptr_s, indices_s, eids_s = stack_partition_csr(
+        root, host_parts, f'graph/{as_str(et)}', old2new[s], old2new[d],
+        bounds[s], counts[s], num_parts)
+    graphs[et] = DistGraph(indptr_s, indices_s, eids_s, bounds[s])
+
+  feats, labels = {}, {}
+  for nt in meta['node_types']:
+    max_nodes = int(counts[nt].max())
+    fs = scatter_partition_rows(root, host_parts, f'node_feat/{nt}',
+                                'feats', old2new[nt], bounds[nt],
+                                max_nodes)
+    ls = scatter_partition_rows(root, host_parts, f'node_label/{nt}',
+                                'labels', old2new[nt], bounds[nt],
+                                max_nodes)
+    if fs is not None:
+      feats[nt] = DistFeature(fs, bounds[nt])
+    if ls is not None:
+      labels[nt] = ls
+  return cls(graphs, bounds, feats, labels, old2new,
+             host_parts=host_parts)
 
 
 def _build_etype_graph(rows_new: np.ndarray, cols_new: np.ndarray,
@@ -306,20 +376,27 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
 
   def _arrays(self):
     if self._device_arrays is None:
+      from .dist_sampler import put_stacked_host_local
       shard = NamedSharding(self.mesh, P(self.axis))
       repl = NamedSharding(self.mesh, P())
       put = jax.device_put
+      if getattr(self.ds, 'host_parts', None) is not None:
+        putS = lambda a: put_stacked_host_local(    # noqa: E731
+            self.mesh, self.axis, self.num_parts, self.ds.host_parts,
+            np.asarray(a))
+      else:
+        putS = lambda a: put(np.asarray(a), shard)  # noqa: E731
       arrs = {'graphs': {}, 'bounds': {}, 'feats': {}, 'labels': {},
               'efeats': {}, 'hcounts': {}}
       for et in self.etypes:
         g = self.ds.graphs[et]
-        arrs['graphs'][et] = (put(g.indptr, shard), put(g.indices, shard),
-                              put(g.edge_ids, shard))
+        arrs['graphs'][et] = (putS(g.indptr), putS(g.indices),
+                              putS(g.edge_ids))
       for nt, b in self.ds.bounds.items():
         arrs['bounds'][nt] = put(b, repl)
       if self.collect_features:
         for nt, f in self.ds.node_features.items():
-          arrs['feats'][nt] = put(f.shards, shard)
+          arrs['feats'][nt] = putS(f.shards)
           arrs['hcounts'][nt] = put(
               np.asarray(f.hot_counts, np.int32), repl)
         if self.with_edge:
@@ -328,10 +405,10 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
           # eids_acc keys don't exist in the step)
           for et, f in self.ds.edge_features.items():
             if et in self.etypes:
-              arrs['efeats'][et] = (put(f.shards, shard),
+              arrs['efeats'][et] = (putS(f.shards),
                                     put(f.bounds, repl))
       for nt, l in self.ds.node_labels.items():
-        arrs['labels'][nt] = put(np.asarray(l), shard)
+        arrs['labels'][nt] = putS(l)
       self._device_arrays = arrs
     return self._device_arrays
 

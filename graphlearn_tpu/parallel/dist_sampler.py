@@ -924,6 +924,28 @@ class ExchangeTelemetry:
     return out
 
 
+def put_stacked_host_local(mesh: Mesh, axis: str, num_parts: int,
+                           host_parts, arr_local: np.ndarray) -> jax.Array:
+  """Host-local put: this process holds only its partitions' slices
+  (`DistDataset.host_parts`); assemble the GLOBAL ``[P, ...]`` array
+  from per-device single-shard puts — no host ever materializes
+  another host's tensors (the multi-host RAM story)."""
+  from .multihost import host_partition_ids
+  flat = mesh.devices.reshape(-1)
+  mine = host_partition_ids(mesh).tolist()
+  hp = list(np.asarray(host_parts))
+  if mine != hp:
+    raise ValueError(
+        f'host_parts {hp} != this process\'s mesh positions {mine} '
+        '— load with multihost.host_partition_ids(mesh)')
+  assert arr_local.shape[0] == len(mine), (arr_local.shape, mine)
+  shards = [jax.device_put(arr_local[j:j + 1], flat[i])
+            for j, i in enumerate(mine)]
+  return jax.make_array_from_single_device_arrays(
+      (num_parts,) + tuple(arr_local.shape[1:]),
+      NamedSharding(mesh, P(axis)), shards)
+
+
 class DistNeighborSampler(ExchangeTelemetry):
   """Device-mesh distributed sampler (+ feature/label collection).
 
@@ -980,24 +1002,8 @@ class DistNeighborSampler(ExchangeTelemetry):
     self._init_stats()
 
   def _put_stacked(self, arr_local: np.ndarray) -> jax.Array:
-    """Host-local put: this process holds only its partitions' slices
-    (`DistDataset.host_parts`); assemble the GLOBAL ``[P, ...]`` array
-    from per-device single-shard puts — no host ever materializes
-    another host's tensors (the multi-host RAM story)."""
-    from .multihost import host_partition_ids
-    flat = self.mesh.devices.reshape(-1)
-    mine = host_partition_ids(self.mesh).tolist()
-    hp = list(np.asarray(self.ds.host_parts))
-    if mine != hp:
-      raise ValueError(
-          f'host_parts {hp} != this process\'s mesh positions {mine} '
-          '— load with multihost.host_partition_ids(mesh)')
-    assert arr_local.shape[0] == len(mine), (arr_local.shape, mine)
-    shards = [jax.device_put(arr_local[j:j + 1], flat[i])
-              for j, i in enumerate(mine)]
-    return jax.make_array_from_single_device_arrays(
-        (self.num_parts,) + tuple(arr_local.shape[1:]),
-        NamedSharding(self.mesh, P(self.axis)), shards)
+    return put_stacked_host_local(self.mesh, self.axis, self.num_parts,
+                                  self.ds.host_parts, arr_local)
 
   def _arrays(self):
     if self._device_arrays is None:

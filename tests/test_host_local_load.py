@@ -89,3 +89,50 @@ def test_host_local_rejects_by_dst_layout(tmp_path):
                     edge_assign='by_dst').partition()
   with pytest.raises(NotImplementedError, match='by_src'):
     DistDataset.from_partition_dir(tmp_path, host_parts=np.arange(P))
+
+
+def test_hetero_host_local_equals_full(tmp_path):
+  """Hetero host-local loading (host_parts = all) must match the full
+  load's id spaces and serve provenance-correct batches."""
+  from graphlearn_tpu.parallel import (DistHeteroDataset,
+                                       DistHeteroNeighborLoader)
+  U, I = 'u', 'i'
+  ET = (U, 'to', I)
+  REV = (I, 'rev_to', U)
+  nu, ni = 48, 24
+  urow = np.repeat(np.arange(nu), 2)
+  icol = np.stack([np.arange(nu) % ni, (np.arange(nu) + 1) % ni],
+                  1).reshape(-1)
+  ufeat = np.tile(np.arange(nu, dtype=np.float32)[:, None], (1, 3))
+  ifeat = np.tile(np.arange(ni, dtype=np.float32)[:, None], (1, 3))
+  RandomPartitioner(tmp_path, P,
+                    num_nodes={U: nu, I: ni},
+                    edge_index={ET: (urow, icol), REV: (icol, urow)},
+                    node_feat={U: ufeat, I: ifeat},
+                    node_label={U: (np.arange(nu) % 4).astype(np.int32)},
+                    seed=0).partition()
+  full = DistHeteroDataset.from_partition_dir(tmp_path)
+  local = DistHeteroDataset.from_partition_dir(
+      tmp_path, host_parts=np.arange(P))
+  for nt in (U, I):
+    np.testing.assert_array_equal(full.bounds[nt], local.bounds[nt])
+    np.testing.assert_array_equal(full.old2new[nt], local.old2new[nt])
+    np.testing.assert_array_equal(full.node_features[nt].shards,
+                                  local.node_features[nt].shards)
+  np.testing.assert_array_equal(np.asarray(full.node_labels[U]),
+                                local.node_labels[U])
+  loader = DistHeteroNeighborLoader(local, [2, 2], (U, np.arange(nu)),
+                                    batch_size=2, shuffle=True,
+                                    mesh=make_mesh(P), seed=0)
+  nb = 0
+  for b in loader:
+    for nt in (U, I):
+      nodes = np.asarray(b.node_dict[nt])
+      x = np.asarray(b.x_dict[nt])
+      for p in range(P):
+        m = nodes[p] >= 0
+        np.testing.assert_allclose(
+            x[p][m][:, 0],
+            local.new2old[nt][nodes[p][m]].astype(np.float32))
+    nb += 1
+  assert nb == len(loader)
