@@ -47,6 +47,10 @@ def main():
                        'IGBH-large "features exceed aggregate HBM" '
                        'lever (cold misses overlaid per batch, '
                        'hit rate in exchange_stats)')
+  ap.add_argument('--host-local', action='store_true',
+                  help='with --partition-dir on a multi-host pod: each '
+                       'process materializes only ITS partitions '
+                       '(per-host RAM = 1/num_hosts of the dataset)')
   args = ap.parse_args()
 
   import jax
@@ -70,11 +74,17 @@ def main():
     assert disk_parts == num_parts, (
         f'partition layout has {disk_parts} parts but the mesh has '
         f'{num_parts} devices — repartition or set --num-parts')
+    from graphlearn_tpu.parallel import multihost
     ds = DistHeteroDataset.from_partition_dir(
-        args.partition_dir, num_parts, split_ratio=args.split_ratio)
+        args.partition_dir, num_parts, split_ratio=args.split_ratio,
+        host_parts=(multihost.host_partition_ids(mesh)
+                    if args.host_local else None))
     assert PAPER in ds.node_labels, 'training needs paper labels'
     npaper = ds.num_nodes_dict()[PAPER]
-    classes = int(np.max(ds.node_labels[PAPER])) + 1
+    # host-local shards see only local labels: the class count (and so
+    # the model width) must agree GLOBALLY across processes
+    classes = multihost.global_max(
+        int(np.max(ds.node_labels[PAPER])), mesh) + 1
     train_idx = np.arange(npaper)
   elif args.igbh_root:
     from graphlearn_tpu.data import load_igbh_dir

@@ -86,6 +86,24 @@ def host_partition_ids(mesh: Mesh) -> np.ndarray:
                     np.int64)
 
 
+def global_max(value: int, mesh: Mesh) -> int:
+  """Max of a per-process host scalar across every process of the mesh
+  — e.g. the class count over host-local label shards (each host sees
+  only its partitions; model widths must agree globally).  Works
+  unchanged single-process."""
+  import jax.numpy as jnp
+  from jax.sharding import NamedSharding, PartitionSpec
+  axis = mesh.axis_names[0]
+  flat = mesh.devices.reshape(-1)
+  shards = [jax.device_put(np.asarray([value], np.int64), flat[i])
+            for i in host_partition_ids(mesh)]
+  g = jax.make_array_from_single_device_arrays(
+      (flat.size,), NamedSharding(mesh, PartitionSpec(axis)), shards)
+  out = jax.jit(jnp.max,
+                out_shardings=NamedSharding(mesh, PartitionSpec()))(g)
+  return int(out)
+
+
 def host_seed_shard(seeds: np.ndarray, epoch: int = 0, seed: int = 0,
                     shuffle: bool = True) -> np.ndarray:
   """This host's disjoint slice of the (globally shuffled) seed set.

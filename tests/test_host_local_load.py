@@ -136,3 +136,47 @@ def test_hetero_host_local_equals_full(tmp_path):
             local.new2old[nt][nodes[p][m]].astype(np.float32))
     nb += 1
   assert nb == len(loader)
+
+
+def test_hetero_host_local_csr_and_guard(tmp_path):
+  """Hetero arm of the homo checks: per-etype CSR equality against the
+  full load, and the sampler's put refusing a host_parts/mesh
+  mismatch."""
+  from graphlearn_tpu.parallel import (DistHeteroDataset,
+                                       DistHeteroNeighborLoader)
+  U, I = 'u', 'i'
+  ET = (U, 'to', I)
+  REV = (I, 'rev_to', U)
+  nu, ni = 48, 24
+  urow = np.repeat(np.arange(nu), 2)
+  icol = np.stack([np.arange(nu) % ni, (np.arange(nu) + 1) % ni],
+                  1).reshape(-1)
+  RandomPartitioner(tmp_path, P,
+                    num_nodes={U: nu, I: ni},
+                    edge_index={ET: (urow, icol), REV: (icol, urow)},
+                    node_feat={U: np.ones((nu, 2), np.float32)},
+                    seed=0).partition()
+  full = DistHeteroDataset.from_partition_dir(tmp_path)
+  local = DistHeteroDataset.from_partition_dir(
+      tmp_path, host_parts=np.arange(P))
+  for et in (ET, REV):
+    gf, gl = full.graphs[et], local.graphs[et]
+    np.testing.assert_array_equal(gf.indptr, gl.indptr)
+    for p in range(P):
+      for r in range(gf.max_local_nodes):
+        a = np.sort(gf.indices[p][gf.indptr[p][r]:gf.indptr[p][r + 1]])
+        b = np.sort(gl.indices[p][gl.indptr[p][r]:gl.indptr[p][r + 1]])
+        np.testing.assert_array_equal(a, b)
+  bad = DistHeteroDataset.from_partition_dir(tmp_path,
+                                             host_parts=[0, 1])
+  loader = DistHeteroNeighborLoader(bad, [2], (U, np.arange(nu)),
+                                    batch_size=2, shuffle=True,
+                                    mesh=make_mesh(P), seed=0)
+  with pytest.raises(ValueError, match='host_parts'):
+    next(iter(loader))
+
+
+def test_multihost_global_max():
+  from graphlearn_tpu.parallel import multihost
+  mesh = make_mesh(P)
+  assert multihost.global_max(7, mesh) == 7
