@@ -14,7 +14,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .conv import GATConv, GCNConv, SAGEConv
+from .conv import GINConv, GATConv, GCNConv, SAGEConv
 
 
 class BasicGNN(nn.Module):
@@ -57,6 +57,15 @@ class GCN(BasicGNN):
 
   def make_conv(self, out_features: int, idx: int) -> nn.Module:
     return GCNConv(out_features, dtype=self.dtype, name=f'conv{idx}')
+
+
+class GIN(BasicGNN):
+  """GIN stack (sum aggregator + per-layer MLP) — the
+  expressiveness-maximal member of the standard zoo."""
+
+  def make_conv(self, out_features: int, idx: int) -> nn.Module:
+    return GINConv(out_features, hidden_features=self.hidden_features,
+                   train_eps=True, dtype=self.dtype, name=f'conv{idx}')
 
 
 class GAT(BasicGNN):

@@ -54,6 +54,21 @@ def segment_max(data: jax.Array, segment_ids: jax.Array,
   return jnp.where(jnp.isfinite(out), out, 0.0)
 
 
+def segment_softmax(e: jax.Array, dst: jax.Array, num_segments: int,
+                    valid: jax.Array) -> jax.Array:
+  """Masked per-target softmax over edge scores ``e`` ``[E, h]`` —
+  THE attention normalizer (GAT/GATv2 share it): route invalid edges
+  out of range, subtract the per-target max, exp, normalize."""
+  dsafe = jnp.where(valid, dst, num_segments)
+  dc = jnp.clip(dst, 0, num_segments - 1)
+  e = jnp.where(valid[:, None], e, -jnp.inf)
+  emax = jax.ops.segment_max(e, dsafe, num_segments=num_segments)
+  emax = jnp.where(jnp.isfinite(emax), emax, 0.0)
+  ex = jnp.where(valid[:, None], jnp.exp(e - emax[dc]), 0.0)
+  denom = jax.ops.segment_sum(ex, dsafe, num_segments=num_segments)
+  return ex / jnp.maximum(denom[dc], 1e-16)
+
+
 class SAGEConv(nn.Module):
   """GraphSAGE convolution (mean aggregator).
 
@@ -122,6 +137,44 @@ class GCNConv(nn.Module):
     return agg + h * self_w.astype(h.dtype)[:, None]
 
 
+class GINConv(nn.Module):
+  """Graph isomorphism convolution (sum aggregator + MLP).
+
+  ``out[v] = MLP((1 + eps) * x[v] + sum_{u→v} x[u])`` — the
+  expressiveness-maximal aggregator of the standard zoo (Xu et al.);
+  masked edges route to the out-of-range segment like every conv
+  here.  ``train_eps`` learns the self-weight; otherwise eps stays a
+  constant.
+  """
+  out_features: int
+  hidden_features: Optional[int] = None
+  eps: float = 0.0
+  train_eps: bool = False
+  dtype: Optional[jnp.dtype] = None
+
+  @nn.compact
+  def __call__(self, x: jax.Array, edge_index: jax.Array,
+               edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
+    n = x.shape[0]
+    src, dst = edge_index[0], edge_index[1]
+    valid = edge_mask if edge_mask is not None else (dst >= 0)
+    dsafe = jnp.where(valid, dst, n)
+    msg = x[jnp.clip(src, 0, n - 1)]
+    agg = jax.ops.segment_sum(msg, dsafe, num_segments=n)
+    if self.train_eps:
+      eps = self.param('eps', nn.initializers.constant(self.eps),
+                       ()).astype(x.dtype)
+    else:
+      eps = self.eps
+    h = (1.0 + eps) * x + agg
+    hidden = self.hidden_features or self.out_features
+    h = nn.Dense(hidden, dtype=self.dtype, name='mlp_0')(h)
+    h = nn.relu(h)
+    return nn.Dense(self.out_features, dtype=self.dtype, name='mlp_1')(h)
+
+
 class GATConv(nn.Module):
   """Graph attention convolution (masked softmax over incoming edges)."""
   out_features: int
@@ -153,15 +206,48 @@ class GATConv(nn.Module):
     sc = jnp.clip(src, 0, n - 1)
     e = nn.leaky_relu(alpha_src[sc] + alpha_dst[jnp.clip(dst, 0, n - 1)],
                       self.negative_slope)          # [E, h]
-    e = jnp.where(valid[:, None], e, -jnp.inf)
-    # segment softmax: subtract per-target max, exp, normalize.
-    emax = jax.ops.segment_max(e, dsafe, num_segments=n)
-    emax = jnp.where(jnp.isfinite(emax), emax, 0.0)
-    ex = jnp.where(valid[:, None],
-                   jnp.exp(e - emax[jnp.clip(dst, 0, n - 1)]), 0.0)
-    denom = jax.ops.segment_sum(ex, dsafe, num_segments=n)
-    w = ex / jnp.maximum(denom[jnp.clip(dst, 0, n - 1)], 1e-16)
+    w = segment_softmax(e, dst, n, valid)
     msg = z[sc] * w.astype(z.dtype)[:, :, None]      # [E, h, f]
+    agg = jax.ops.segment_sum(msg.reshape(-1, h * f), dsafe,
+                              num_segments=n).reshape(n, h, f)
+    if self.concat:
+      return agg.reshape(n, h * f)
+    return agg.mean(axis=1)
+
+
+class GATv2Conv(nn.Module):
+  """GATv2 attention (Brody et al.): the score applies the nonlinearity
+  BEFORE the attention vector — ``e(u, v) = a^T leaky_relu(W_s x[u] +
+  W_d x[v])`` — fixing GAT's static-attention limitation.  Same masked
+  segment-softmax machinery as `GATConv`."""
+  out_features: int
+  heads: int = 1
+  concat: bool = True
+  negative_slope: float = 0.2
+  dtype: Optional[jnp.dtype] = None
+
+  @nn.compact
+  def __call__(self, x: jax.Array, edge_index: jax.Array,
+               edge_mask: Optional[jax.Array] = None) -> jax.Array:
+    if self.dtype is not None:
+      x = x.astype(self.dtype)
+    n = x.shape[0]
+    h, f = self.heads, self.out_features
+    src, dst = edge_index[0], edge_index[1]
+    valid = edge_mask if edge_mask is not None else (dst >= 0)
+    dsafe = jnp.where(valid, dst, n)
+    sc = jnp.clip(src, 0, n - 1)
+    dc = jnp.clip(dst, 0, n - 1)
+    z_src = nn.Dense(h * f, use_bias=False, dtype=self.dtype,
+                     name='lin_src')(x).reshape(n, h, f)
+    z_dst = nn.Dense(h * f, use_bias=False, dtype=self.dtype,
+                     name='lin_dst')(x).reshape(n, h, f)
+    att = self.param('att', nn.initializers.glorot_uniform(), (h, f))
+    pre = nn.leaky_relu(z_src[sc] + z_dst[dc],
+                        self.negative_slope)         # [E, h, f]
+    e = (pre * att[None].astype(pre.dtype)).sum(-1).astype(jnp.float32)
+    w = segment_softmax(e, dst, n, valid)
+    msg = z_src[sc] * w.astype(z_src.dtype)[:, :, None]
     agg = jax.ops.segment_sum(msg.reshape(-1, h * f), dsafe,
                               num_segments=n).reshape(n, h, f)
     if self.concat:
