@@ -69,6 +69,21 @@ def segment_softmax(e: jax.Array, dst: jax.Array, num_segments: int,
   return ex / jnp.maximum(denom[dc], 1e-16)
 
 
+def _attention_aggregate(z_src_sel: jax.Array, w: jax.Array,
+                         dst: jax.Array, valid: jax.Array, n: int,
+                         heads: int, features: int,
+                         concat: bool) -> jax.Array:
+  """Shared GAT/GATv2 tail: weight edge messages by the softmaxed
+  scores, scatter into node slots, merge heads."""
+  dsafe = jnp.where(valid, dst, n)
+  msg = z_src_sel * w.astype(z_src_sel.dtype)[:, :, None]  # [E, h, f]
+  agg = jax.ops.segment_sum(msg.reshape(-1, heads * features), dsafe,
+                            num_segments=n).reshape(n, heads, features)
+  if concat:
+    return agg.reshape(n, heads * features)
+  return agg.mean(axis=1)
+
+
 class SAGEConv(nn.Module):
   """GraphSAGE convolution (mean aggregator).
 
@@ -192,7 +207,6 @@ class GATConv(nn.Module):
     h, f = self.heads, self.out_features
     src, dst = edge_index[0], edge_index[1]
     valid = edge_mask if edge_mask is not None else (dst >= 0)
-    dsafe = jnp.where(valid, dst, n)
     z = nn.Dense(h * f, use_bias=False,
                  dtype=self.dtype)(x).reshape(n, h, f)
     a_src = self.param('att_src', nn.initializers.glorot_uniform(),
@@ -207,12 +221,8 @@ class GATConv(nn.Module):
     e = nn.leaky_relu(alpha_src[sc] + alpha_dst[jnp.clip(dst, 0, n - 1)],
                       self.negative_slope)          # [E, h]
     w = segment_softmax(e, dst, n, valid)
-    msg = z[sc] * w.astype(z.dtype)[:, :, None]      # [E, h, f]
-    agg = jax.ops.segment_sum(msg.reshape(-1, h * f), dsafe,
-                              num_segments=n).reshape(n, h, f)
-    if self.concat:
-      return agg.reshape(n, h * f)
-    return agg.mean(axis=1)
+    return _attention_aggregate(z[sc], w, dst, valid, n, h, f,
+                                self.concat)
 
 
 class GATv2Conv(nn.Module):
@@ -235,7 +245,6 @@ class GATv2Conv(nn.Module):
     h, f = self.heads, self.out_features
     src, dst = edge_index[0], edge_index[1]
     valid = edge_mask if edge_mask is not None else (dst >= 0)
-    dsafe = jnp.where(valid, dst, n)
     sc = jnp.clip(src, 0, n - 1)
     dc = jnp.clip(dst, 0, n - 1)
     z_src = nn.Dense(h * f, use_bias=False, dtype=self.dtype,
@@ -247,9 +256,5 @@ class GATv2Conv(nn.Module):
                         self.negative_slope)         # [E, h, f]
     e = (pre * att[None].astype(pre.dtype)).sum(-1).astype(jnp.float32)
     w = segment_softmax(e, dst, n, valid)
-    msg = z_src[sc] * w.astype(z_src.dtype)[:, :, None]
-    agg = jax.ops.segment_sum(msg.reshape(-1, h * f), dsafe,
-                              num_segments=n).reshape(n, h, f)
-    if self.concat:
-      return agg.reshape(n, h * f)
-    return agg.mean(axis=1)
+    return _attention_aggregate(z_src[sc], w, dst, valid, n, h, f,
+                                self.concat)
