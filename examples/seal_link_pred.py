@@ -14,11 +14,23 @@ positives, random non-edges negatives.
 Usage::
 
     python examples/seal_link_pred.py [--epochs 3] [--cpu]
+    python examples/seal_link_pred.py --data cora.npz \
+        [--expect-acc 0.8]                 # real-graph run
 
     # pod-scale extraction: enclosing subgraphs sampled by the
     # device-mesh engine (P links in flight per SPMD step):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/seal_link_pred.py --mesh
+
+The ``.npz`` schema is any COO edge list (the reference runs Cora;
+positives/negatives are drawn from the given graph exactly like its
+`train_test_split_edges` flow)::
+
+    # torch environment
+    from torch_geometric.datasets import Planetoid
+    data = Planetoid('data', name='Cora')[0]
+    np.savez('cora.npz', rows=data.edge_index[0],
+             cols=data.edge_index[1])
 """
 import argparse
 import sys
@@ -81,9 +93,13 @@ def synthetic(n=600, clusters=6, deg=6, seed=0):
 
 def main():
   ap = argparse.ArgumentParser()
+  ap.add_argument('--data', type=str, default=None,
+                  help='real COO edge-list .npz (docstring schema)')
   ap.add_argument('--epochs', type=int, default=3)
   ap.add_argument('--num-links', type=int, default=256)
   ap.add_argument('--max-label', type=int, default=16)
+  ap.add_argument('--expect-acc', type=float, default=None,
+                  help='fail (exit 1) below this test accuracy')
   ap.add_argument('--cpu', action='store_true')
   ap.add_argument('--mesh', action='store_true',
                   help='extract enclosing subgraphs with the device-'
@@ -100,19 +116,38 @@ def main():
   from graphlearn_tpu.loader import SubGraphLoader
   from graphlearn_tpu.models import DGCNN
 
-  rows, cols, cl = synthetic()
-  n = len(cl)
+  if args.data:
+    d = np.load(args.data)
+    rows = np.asarray(d['rows'], np.int64)
+    cols = np.asarray(d['cols'], np.int64)
+    n = int(max(rows.max(), cols.max())) + 1
+  else:
+    rows, cols, cl = synthetic()
+    n = len(cl)
   edge_set = set(zip(rows.tolist(), cols.tolist()))
-  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
 
   rng = np.random.default_rng(1)
   m = args.num_links
   pos_idx = rng.choice(len(rows), m, replace=False)
   pos = np.stack([rows[pos_idx], cols[pos_idx]], 1)
+  # the TARGET links (and their reverses) are REMOVED from the graph
+  # the subgraphs are extracted from — otherwise the u-v edge itself
+  # leaks the label and the classifier learns edge detection, not link
+  # prediction (the reference's train_test_split_edges does the same)
+  pos_pairs = set(map(tuple, pos.tolist()))
+  drop = np.fromiter(
+      ((r, c) in pos_pairs or (c, r) in pos_pairs
+       for r, c in zip(rows.tolist(), cols.tolist())), bool, len(rows))
+  obs_rows, obs_cols = rows[~drop], cols[~drop]
+  ds = Dataset().init_graph((obs_rows, obs_cols), layout='COO',
+                            num_nodes=n)
   neg = []
   while len(neg) < m:
     u, v = rng.integers(0, n, 2)
-    if (u, v) not in edge_set and u != v:
+    # check BOTH directions: DRNL/BFS treats the graph as undirected,
+    # so a one-direction export must not admit (v, u)-edges as
+    # negatives
+    if (u, v) not in edge_set and (v, u) not in edge_set and u != v:
       neg.append((u, v))
   pairs = np.concatenate([pos, np.asarray(neg)])
   labels = np.concatenate([np.ones(m), np.zeros(m)]).astype(np.int32)
@@ -126,7 +161,8 @@ def main():
     from graphlearn_tpu.parallel import (DistDataset, DistSubGraphLoader,
                                          make_mesh)
     num_parts = len(jax.devices())
-    dds = DistDataset.from_full_graph(num_parts, rows, cols, num_nodes=n)
+    dds = DistDataset.from_full_graph(num_parts, obs_rows, obs_cols,
+                                      num_nodes=n)
     loader = DistSubGraphLoader(dds, [8], pairs.reshape(-1),
                                 batch_size=2, mesh=make_mesh(num_parts),
                                 collect_features=False, seed=0)
@@ -209,7 +245,11 @@ def main():
       int(predict(params, jnp.asarray(lab), jnp.asarray(ei),
                   jnp.asarray(em), jnp.asarray(nm))) == int(y)
       for lab, ei, em, nm, y in sub[ntr:])
-  print(f'test acc: {correct / max(len(sub) - ntr, 1):.4f}')
+  acc = correct / max(len(sub) - ntr, 1)
+  print(f'test acc: {acc:.4f}')
+  if args.expect_acc is not None and acc < args.expect_acc:
+    raise SystemExit(
+        f'test accuracy {acc:.4f} below required {args.expect_acc}')
 
 
 if __name__ == '__main__':
