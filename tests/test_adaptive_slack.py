@@ -125,3 +125,30 @@ def test_adaptive_controller_unit():
   s.offered, s.dropped = 4000, 200
   ctl.on_epoch_end()
   assert ctl.slack == 1.5          # pinned: no further movement
+
+
+def test_adaptive_with_tiered_store_and_prefetch():
+  """The three r3 levers compose: adaptive capacity retunes across
+  epochs while the tiered store's cold overlay and the prefetch worker
+  keep serving ground-truth features at every slack visited."""
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N, (np.arange(N) + 2) % N])
+  feats = np.arange(N, dtype=np.float32)[:, None] * np.ones((1, 3),
+                                                            np.float32)
+  ds = DistDataset.from_full_graph(P, rows, cols, node_feat=feats,
+                                   num_nodes=N, split_ratio=0.4)
+  loader = DistNeighborLoader(ds, [2, 2], np.arange(N), batch_size=16,
+                              shuffle=True, mesh=make_mesh(P), seed=1,
+                              exchange_slack='adaptive', prefetch=2)
+  for _ in range(3):
+    for b in loader:
+      nodes = np.asarray(b.node)
+      x = np.asarray(b.x)
+      for p in range(P):
+        m = nodes[p] >= 0
+        np.testing.assert_allclose(
+            x[p][m][:, 0], ds.new2old[nodes[p][m]].astype(np.float32))
+  st = loader.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.feature.cold_misses'] > 0
+  assert loader._adaptive.slack != DEFAULT_EXCHANGE_SLACK or \
+      loader._adaptive._pinned
