@@ -123,6 +123,19 @@ def worker(fast: bool):
     if epoch == 1 or fast:
       epoch_secs = time.perf_counter() - t0
 
+  # fused whole-epoch program (loader.FusedEpoch): same workload, ONE
+  # lax.scan XLA program per epoch — measures what removing per-step
+  # dispatch buys on this chip.  Warm run compiles; second run timed.
+  from graphlearn_tpu.loader import FusedEpoch
+  fused = FusedEpoch(ds, list(FANOUT), train_idx, apply_fn, tx,
+                     batch_size=BATCH, shuffle=True, seed=0)
+  state, _ = fused.run(state)           # donates state; per-batch done
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  t0 = time.perf_counter()
+  state, _ = fused.run(state)
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  fused_secs = time.perf_counter() - t0
+
   # secondary: sampling-only throughput, reference metric definition
   iters = 10 if fast else SAMPLE_ITERS
   sampler = NeighborSampler(ds.get_graph(), FANOUT, seed=0)
@@ -141,6 +154,7 @@ def worker(fast: bool):
   edges = int(sum((o.edge_mask.sum() for o in outs),
                   jnp.zeros((), jnp.int32)))
   print(json.dumps({'epoch_secs': epoch_secs,
+                    'epoch_secs_fused': fused_secs,
                     'edges_per_sec': edges / dt,
                     'steps': len(loader),
                     'mode': 'fast' if fast else 'full',
@@ -228,6 +242,55 @@ def dist_worker():
                              1),
       'cold_hit_rate': round(st_t['dist.feature.cold_hit_rate'], 4),
       'cold_misses': st_t['dist.feature.cold_misses'],
+  }
+  print(json.dumps(out), flush=True)
+
+  # fused distributed epoch (parallel.FusedDistEpoch): the SAME
+  # workload WITH the DP train step, per-batch dispatch vs one scan
+  # program — the dispatch-overhead measurement, mesh edition.
+  import optax
+  from graphlearn_tpu.models import GraphSAGE, create_train_state
+  from graphlearn_tpu.parallel import (FusedDistEpoch,
+                                       make_dp_supervised_step,
+                                       replicate)
+  model = GraphSAGE(hidden_features=64, out_features=CLASSES,
+                    num_layers=len(FANOUT))
+  tx = optax.adam(3e-3)
+  mesh = make_mesh(DIST_PARTS)
+  it = iter(DistNeighborLoader(ds, list(FANOUT),
+                               seeds[:BATCH * DIST_PARTS * 4],
+                               batch_size=BATCH, shuffle=True,
+                               mesh=mesh, seed=0))
+  b0 = next(it)
+  state, apply_fn = create_train_state(model, jax.random.key(0), b0, tx)
+  step = make_dp_supervised_step(apply_fn, tx, BATCH, mesh)
+  state = replicate(state, mesh)
+  state, _, _ = step(state, b0)                 # compile + warm
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  t0 = time.perf_counter()
+  nb = 0
+  for b in it:
+    state, _, _ = step(state, b)
+    nb += 1
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  dt_loop = time.perf_counter() - t0
+  fused = FusedDistEpoch(ds, list(FANOUT),
+                         seeds[:BATCH * DIST_PARTS * 4],
+                         apply_fn, tx, batch_size=BATCH, mesh=mesh,
+                         shuffle=True, seed=0)
+  state, _ = fused.run(state)                   # compile + warm
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  t0 = time.perf_counter()
+  state, _ = fused.run(state)
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  dt_fused = time.perf_counter() - t0
+  out['fused_train'] = {
+      'label': 'loader+DP step per batch vs FusedDistEpoch, '
+               'virtual CPU mesh - relative only',
+      'seeds_per_sec_per_batch': round(
+          nb * BATCH * DIST_PARTS / max(dt_loop, 1e-9), 1),
+      'seeds_per_sec_fused': round(
+          len(fused) * BATCH * DIST_PARTS / max(dt_fused, 1e-9), 1),
   }
   print(json.dumps(out), flush=True)
 
@@ -322,6 +385,9 @@ def main():
 
   ep = sorted(r['epoch_secs'] for r in results)
   es = sorted(r['edges_per_sec'] for r in results)
+  # only sessions that measured the fused path count toward its stats
+  fu = sorted(r['epoch_secs_fused'] for r in results
+              if 'epoch_secs_fused' in r)
   med_ep = statistics.median(ep)
   med_es = statistics.median(es)
   print(json.dumps({
@@ -338,6 +404,12 @@ def main():
           round(es[-1] / 1e6, 1)],
       'sampling_vs_a100_nominal': round(med_es / BASELINE_EDGES_PER_SEC,
                                         2),
+      'fused_epoch_secs_min_med_max': (
+          [round(fu[0], 4), round(statistics.median(fu), 4),
+           round(fu[-1], 4)] if fu else None),
+      'fused_vs_baseline': (round(
+          BASELINE_EPOCH_SECS / statistics.median(fu), 4) if fu
+          else None),
       'sessions': len(results),
       'session_modes': [r['mode'] for r in results],
       'steps_per_epoch': results[0]['steps'],

@@ -61,6 +61,9 @@ def main():
                   help='fraction of features resident in HBM')
   ap.add_argument('--ckpt-dir', type=str, default=None,
                   help='checkpoint/resume directory (resumes if present)')
+  ap.add_argument('--fused', action='store_true',
+                  help='train each epoch as ONE fused lax.scan program '
+                       '(loader.FusedEpoch; needs --split-ratio 1.0)')
   ap.add_argument('--cpu', action='store_true')
   ap.add_argument('--expect-acc', type=float, default=None,
                   help='fail (exit 1) if final test accuracy is below '
@@ -126,15 +129,26 @@ def main():
       state = jax.tree_util.tree_map(jax.numpy.asarray, restored)
       print(f'resumed from epoch {start_epoch}')
 
+  fused = None
+  if args.fused:
+    from graphlearn_tpu.loader import FusedEpoch
+    fused = FusedEpoch(ds, args.fanout, data['train_idx'], apply_fn, tx,
+                       batch_size=bs, shuffle=True, seed=0)
+
   for epoch in range(start_epoch or 0, args.epochs):
     t0 = time.perf_counter()
-    tot = cnt = 0
-    for batch in train_loader:
-      state, loss, _ = train_step(state, batch)
-      tot += float(loss)
-      cnt += 1
+    if fused is not None:
+      state, stats = fused.run(state)
+      mean_loss, cnt = stats['loss'], len(fused)
+    else:
+      tot = cnt = 0
+      for batch in train_loader:
+        state, loss, _ = train_step(state, batch)
+        tot += float(loss)
+        cnt += 1
+      mean_loss = tot / max(cnt, 1)
     dt = time.perf_counter() - t0
-    print(f'epoch {epoch}: loss {tot / max(cnt, 1):.4f}  '
+    print(f'epoch {epoch}: loss {mean_loss:.4f}  '
           f'({dt:.2f}s, {cnt} steps)')
     if ckpt is not None:
       ckpt.save(epoch + 1, state)

@@ -1,0 +1,204 @@
+"""Whole-epoch fused training: sample → collate → train in ONE program.
+
+The per-batch path (`NeighborLoader` + `make_supervised_step`) dispatches
+several XLA programs per step — sample, label gather, feature gather,
+train step — each ~1 ms of device work on the headline config, so host
+dispatch latency is a visible fraction of the epoch.  The reference has
+the same shape (its loader feeds a separate DDP step per batch,
+`examples/train_sage_ogbn_products.py:90-130`) and eats the overhead in
+CUDA-stream pipelining; the TPU-idiomatic answer is stronger: put the
+WHOLE epoch under one `jax.jit` as a `lax.scan` over seed batches.
+
+  * seeds for all steps upload once per epoch as a ``[S, B]`` array;
+  * the scan body = multi-hop sample → device collate → optax update,
+    compiled once and reused for every epoch of the same length;
+  * no host↔device chatter inside the epoch at all — the host enqueues
+    one program and blocks on the final state.
+
+Constraints (checked at construction):
+  * features and labels must be fully device-resident
+    (``Feature.split_ratio == 1.0``) — a host cold tier needs a host
+    round trip per batch, which is exactly what `NeighborLoader`'s
+    prefetching path is for;
+  * homogeneous graphs (the hetero per-type dict collation is
+    per-batch territory).
+
+This is a TPU-first capability with no reference counterpart: the
+torch loader cannot fuse Python-loop epochs into one graph.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..data.dataset import Dataset
+from ..models.train import TrainState, make_supervised_step
+from ..sampler.neighbor_sampler import NeighborSampler, _multihop_sample
+from ..utils.profiling import metrics
+from .node_loader import SeedBatcher
+from .transform import Batch, _gather_labels
+
+
+class EpochStats:
+  """Lazy epoch statistics: holds DEVICE arrays; any numeric access
+  syncs.  Epoch loops that don't read stats dispatch epochs back to
+  back with zero host↔device round trips — on a tunneled chip each
+  eager ``float()`` costs a full RTT, which measured SLOWER than the
+  per-batch loop before this was made lazy."""
+
+  def __init__(self, losses: jax.Array, correct: jax.Array,
+               valid: jax.Array):
+    self.losses = losses
+
+    self._correct = correct
+    self._valid = valid
+
+  @property
+  def loss(self) -> float:
+    return float(self.losses.mean())
+
+  @property
+  def correct(self) -> int:
+    return int(self._correct)
+
+  @property
+  def seeds(self) -> int:
+    return int(self._valid)
+
+  @property
+  def accuracy(self) -> float:
+    return self.correct / max(self.seeds, 1)
+
+  def __getitem__(self, key: str):
+    return getattr(self, key)
+
+  def __repr__(self):
+    return f'EpochStats(steps={self.losses.shape[0]}, <lazy>)'
+
+
+class FusedEpoch:
+  """One-program supervised training epochs over neighbor sampling.
+
+  Example::
+
+      fused = FusedEpoch(dataset, [15, 10, 5], train_idx, apply_fn, tx,
+                         batch_size=1024, shuffle=True, seed=0)
+      for epoch in range(10):
+        state, stats = fused.run(state)
+        print(stats['loss'], stats['accuracy'])
+
+  Args:
+    data: `Dataset` with a homogeneous graph, fully device-resident
+      features (``split_ratio == 1.0``) and integer labels.
+    num_neighbors: per-hop fanouts.
+    input_nodes: seed ids (or boolean mask) — e.g. the train split.
+    apply_fn / tx: model apply function and optax transformation, the
+      same pair `make_supervised_step` takes.
+    batch_size / shuffle / drop_last / seed: epoch iteration controls
+      (`SeedBatcher` semantics — the tail batch is INVALID_ID-padded).
+    sort_locality: forwarded to the sampler's hop kernel.
+  """
+
+  def __init__(self, data: Dataset, num_neighbors: Sequence[int],
+               input_nodes, apply_fn: Callable,
+               tx: optax.GradientTransformation, batch_size: int,
+               shuffle: bool = True, drop_last: bool = False,
+               seed: Optional[int] = None, sort_locality: bool = True):
+    if data.is_hetero:
+      raise ValueError('FusedEpoch is homogeneous-only; use the '
+                       'per-batch NeighborLoader for hetero graphs')
+    feat = data.node_features
+    if feat is None:
+      raise ValueError('FusedEpoch needs node features')
+    if feat.hot_rows < feat.size(0):
+      raise ValueError(
+          f'FusedEpoch needs fully device-resident features '
+          f'(split_ratio == 1.0); this Feature keeps '
+          f'{feat.size(0) - feat.hot_rows} rows on host. '
+          f'Use NeighborLoader(prefetch=2) for tiered tables.')
+    labels = data.get_node_label_device()
+    if labels is None:
+      raise ValueError('FusedEpoch needs node labels')
+
+    self.data = data
+    self.batch_size = int(batch_size)
+    self.fanouts = tuple(int(k) for k in num_neighbors)
+    self.sort_locality = bool(sort_locality)
+
+    graph = data.get_graph()
+    self._indptr = graph.indptr
+    self._indices = graph.indices
+    self._feat = feat
+    self._labels = labels
+
+    # identical capacity arithmetic to the per-batch sampler, so fused
+    # and per-batch programs see the same static shapes
+    ref = NeighborSampler(graph, self.fanouts, seed=0)
+    self._node_cap = ref.node_capacity(self.batch_size)
+
+    input_nodes = np.asarray(input_nodes)
+    if input_nodes.dtype == np.bool_:
+      input_nodes = np.nonzero(input_nodes)[0]
+    self._batcher = SeedBatcher(input_nodes, self.batch_size, shuffle,
+                                drop_last, seed)
+    self._base_key = jax.random.key(seed or 0)
+    self._epoch_idx = 0
+    self._step = make_supervised_step(apply_fn, tx, self.batch_size)
+    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,))
+
+  def __len__(self) -> int:
+    return len(self._batcher)
+
+  # -- the one program ------------------------------------------------------
+
+  def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
+                key: jax.Array):
+    """``[S, B]`` seed batches → S fused sample+collate+train steps."""
+
+    def body(state, xs):
+      i, seeds = xs
+      (nodes, _count, row, col, _edge, emask, seed_local, _nsn,
+       _nse) = _multihop_sample(
+           self._indptr, self._indices, None, seeds,
+           jax.random.fold_in(key, i),
+           fanouts=self.fanouts, node_cap=self._node_cap,
+           with_edge=False, sort_locality=self.sort_locality)
+      batch = Batch(
+          x=self._feat._device_get(nodes),
+          y=_gather_labels(self._labels, nodes),
+          edge_index=jnp.stack([row, col]),
+          node=nodes, node_mask=nodes >= 0, edge_mask=emask,
+          batch=seeds, batch_size=self.batch_size,
+          metadata={'seed_local': seed_local})
+      state, loss, correct = self._step(state, batch)
+      return state, (loss, correct, jnp.sum(seeds >= 0))
+
+    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
+    state, (losses, corrects, valids) = jax.lax.scan(
+        body, state, (steps, seeds_all))
+    return state, losses, jnp.sum(corrects), jnp.sum(valids)
+
+  # -- host driver ----------------------------------------------------------
+
+  def run(self, state: TrainState) -> Tuple[TrainState, dict]:
+    """Run one epoch; returns ``(state, stats)`` with per-step losses,
+    their mean, and train accuracy over this epoch's seeds.
+
+    The input ``state`` is DONATED to the epoch program (its buffers
+    are reused for the output state) — thread the returned state
+    forward and don't touch the argument again, exactly as with a
+    donated jitted train step.
+
+    ``stats`` is LAZY (`EpochStats`): reading ``.loss`` etc. syncs on
+    the epoch; a loop that ignores it never blocks."""
+    seeds = np.stack(list(self._batcher))          # [S, B], host shuffle
+    self._epoch_idx += 1
+    key = jax.random.fold_in(self._base_key, self._epoch_idx)
+    state, losses, correct, valid = self._compiled(
+        state, jnp.asarray(seeds), key)
+    metrics.inc('loader.batches', seeds.shape[0])
+    return state, EpochStats(losses, correct, valid)

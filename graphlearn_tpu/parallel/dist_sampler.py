@@ -1063,20 +1063,28 @@ class DistNeighborSampler(ExchangeTelemetry):
     cap = min(cap, batch_size + self.ds.graph.num_nodes)
     return round_up(cap, 8)
 
-  def sample_from_nodes(self, seeds_stacked: np.ndarray):
-    """``seeds_stacked``: ``[P, B]`` per-device seed batches (relabeled
-    id space, -1 padded).  Returns stacked pytree pieces."""
-    b = seeds_stacked.shape[1]
-    node_cap = self.node_capacity(b)
-    cfg = (b,)
+  def step_for_batch(self, batch_size: int):
+    """The compiled SPMD step for per-device batches of ``batch_size``
+    (built once per size).  Signature: ``step(indptr, indices, eids,
+    bounds, seeds, fshards, lshards, cids, crows, efshards, ebounds,
+    hcounts, key)`` — also the scan body of `FusedDistEpoch`."""
+    cfg = (int(batch_size),)
     if cfg not in self._steps:
       self._steps[cfg] = _make_dist_step(
-          self.mesh, self.num_parts, self.fanouts, node_cap,
+          self.mesh, self.num_parts, self.fanouts,
+          self.node_capacity(int(batch_size)),
           self.with_edge, self.collect_features, self.collect_labels,
           self.axis, with_cache=self.with_cache,
           exchange_slack=self.exchange_slack,
           collect_edge_features=self.collect_edge_features,
           ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
+    return self._steps[cfg]
+
+  def sample_from_nodes(self, seeds_stacked: np.ndarray):
+    """``seeds_stacked``: ``[P, B]`` per-device seed batches (relabeled
+    id space, -1 padded).  Returns stacked pytree pieces."""
+    b = seeds_stacked.shape[1]
+    step = self.step_for_batch(b)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -1084,11 +1092,11 @@ class DistNeighborSampler(ExchangeTelemetry):
         np.asarray(seeds_stacked, dtype=np.int32),
         NamedSharding(self.mesh, P(self.axis)))
     (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats) = \
-        self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
-                         arrs['bounds'], seeds_dev, arrs['fshards'],
-                         arrs['lshards'], arrs['cids'], arrs['crows'],
-                         arrs['efshards'], arrs['ebounds'],
-                         arrs['hcounts'], key)
+        step(arrs['indptr'], arrs['indices'], arrs['eids'],
+             arrs['bounds'], seeds_dev, arrs['fshards'],
+             arrs['lshards'], arrs['cids'], arrs['crows'],
+             arrs['efshards'], arrs['ebounds'],
+             arrs['hcounts'], key)
     self._accumulate_stats(stats)
     x = self._maybe_overlay_cold(x, nodes)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
