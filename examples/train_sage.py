@@ -132,8 +132,10 @@ def main():
   fused = None
   if args.fused:
     from graphlearn_tpu.loader import FusedEpoch
+    # remat: the merged epoch program needs the checkpointed backward
+    # to fit HBM at products-scale batch x fanout (FusedEpoch docs)
     fused = FusedEpoch(ds, args.fanout, data['train_idx'], apply_fn, tx,
-                       batch_size=bs, shuffle=True, seed=0)
+                       batch_size=bs, shuffle=True, seed=0, remat=True)
 
   for epoch in range(start_epoch or 0, args.epochs):
     t0 = time.perf_counter()

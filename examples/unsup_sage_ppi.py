@@ -41,6 +41,9 @@ def main():
   ap.add_argument('--epochs', type=int, default=10)
   ap.add_argument('--batch-size', type=int, default=512)
   ap.add_argument('--hidden', type=int, default=64)
+  ap.add_argument('--fused', action='store_true',
+                  help='train each epoch as ONE fused lax.scan program '
+                       '(loader.FusedLinkEpoch)')
   ap.add_argument('--cpu', action='store_true')
   args = ap.parse_args()
 
@@ -91,14 +94,28 @@ def main():
       model, jax.random.key(0), next(iter(loader)), tx)
   step = make_unsupervised_step(apply_fn, tx)
 
+  fused = None
+  if args.fused:
+    from graphlearn_tpu.loader import FusedLinkEpoch
+    fused = FusedLinkEpoch(
+        ds, [10, 10], (train_rows, train_cols), apply_fn, tx,
+        batch_size=args.batch_size,
+        neg_sampling=NegativeSampling('binary', 1.0), shuffle=True,
+        seed=0)
+
   for epoch in range(args.epochs):
     t0 = time.perf_counter()
-    tot = cnt = 0
-    for batch in loader:
-      state, loss = step(state, batch)
-      tot += float(loss)
-      cnt += 1
-    print(f'epoch {epoch}: link loss {tot / max(cnt, 1):.4f} '
+    if fused is not None:
+      state, stats = fused.run(state)
+      mean_loss = stats['loss']
+    else:
+      tot = cnt = 0
+      for batch in loader:
+        state, loss = step(state, batch)
+        tot += float(loss)
+        cnt += 1
+      mean_loss = tot / max(cnt, 1)
+    print(f'epoch {epoch}: link loss {mean_loss:.4f} '
           f'({time.perf_counter() - t0:.2f}s)')
 
   # Eval: do learned embeddings score intra-cluster pairs above

@@ -4,4 +4,4 @@ from .prefetch import PrefetchIterator
 from .neighbor_loader import NeighborLoader
 from .link_loader import EdgeSeedBatcher, LinkLoader, LinkNeighborLoader
 from .subgraph_loader import SubGraphLoader
-from .fused import FusedEpoch
+from .fused import EpochStats, FusedEpoch, FusedLinkEpoch

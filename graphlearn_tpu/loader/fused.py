@@ -36,9 +36,15 @@ import numpy as np
 import optax
 
 from ..data.dataset import Dataset
+from ..data.feature import _device_gather
 from ..models.train import TrainState, make_supervised_step
-from ..sampler.neighbor_sampler import NeighborSampler, _multihop_sample
+from ..ops.negative import sample_negative
+from ..ops.pallas_gather import pallas_enabled
+from ..sampler.base import NegativeSampling
+from ..sampler.neighbor_sampler import (NeighborSampler, _multihop_sample,
+                                        _triplet_neg_dst)
 from ..utils.profiling import metrics
+from .link_loader import EdgeSeedBatcher
 from .node_loader import SeedBatcher
 from .transform import Batch, _gather_labels
 
@@ -101,13 +107,20 @@ class FusedEpoch:
     batch_size / shuffle / drop_last / seed: epoch iteration controls
       (`SeedBatcher` semantics — the tail batch is INVALID_ID-padded).
     sort_locality: forwarded to the sampler's hop kernel.
+    remat: rematerialize the model forward in the backward pass
+      (`jax.checkpoint`).  The fused program holds the sampler's
+      buffers AND the training activations live together; at large
+      ``batch_size x fanout`` products that joint peak can exceed HBM
+      where the separate per-batch programs fit — remat trades the
+      recompute FLOPs for that headroom.
   """
 
   def __init__(self, data: Dataset, num_neighbors: Sequence[int],
                input_nodes, apply_fn: Callable,
                tx: optax.GradientTransformation, batch_size: int,
                shuffle: bool = True, drop_last: bool = False,
-               seed: Optional[int] = None, sort_locality: bool = True):
+               seed: Optional[int] = None, sort_locality: bool = True,
+               remat: bool = False):
     if data.is_hetero:
       raise ValueError('FusedEpoch is homogeneous-only; use the '
                        'per-batch NeighborLoader for hetero graphs')
@@ -130,10 +143,14 @@ class FusedEpoch:
     self.sort_locality = bool(sort_locality)
 
     graph = data.get_graph()
-    self._indptr = graph.indptr
-    self._indices = graph.indices
-    self._feat = feat
-    self._labels = labels
+    # The big tables go through the jit boundary as ARGUMENTS, never
+    # closures: a closed-over device array becomes a jaxpr CONSTANT
+    # bundled with the program — on a tunneled chip the ~1 GB feature
+    # table made the fused compile take >20 minutes; as parameters the
+    # already-resident buffers are just referenced.
+    self._dev = dict(indptr=graph.indptr, indices=graph.indices,
+                     hot=feat.hot_tier, id2index=feat._id2index_dev,
+                     labels=labels)
 
     # identical capacity arithmetic to the per-batch sampler, so fused
     # and per-batch programs see the same static shapes
@@ -147,8 +164,12 @@ class FusedEpoch:
                                 drop_last, seed)
     self._base_key = jax.random.key(seed or 0)
     self._epoch_idx = 0
-    self._step = make_supervised_step(apply_fn, tx, self.batch_size)
-    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,))
+    self._apply_fn = apply_fn
+    step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
+    self._step = make_supervised_step(step_apply, tx, self.batch_size)
+    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
+                             static_argnums=(4,))
+    self._compiled_eval = jax.jit(self._eval_fn, static_argnums=(4,))
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -156,24 +177,13 @@ class FusedEpoch:
   # -- the one program ------------------------------------------------------
 
   def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
-                key: jax.Array):
+                key: jax.Array, dev: dict, use_pallas: bool):
     """``[S, B]`` seed batches → S fused sample+collate+train steps."""
 
     def body(state, xs):
       i, seeds = xs
-      (nodes, _count, row, col, _edge, emask, seed_local, _nsn,
-       _nse) = _multihop_sample(
-           self._indptr, self._indices, None, seeds,
-           jax.random.fold_in(key, i),
-           fanouts=self.fanouts, node_cap=self._node_cap,
-           with_edge=False, sort_locality=self.sort_locality)
-      batch = Batch(
-          x=self._feat._device_get(nodes),
-          y=_gather_labels(self._labels, nodes),
-          edge_index=jnp.stack([row, col]),
-          node=nodes, node_mask=nodes >= 0, edge_mask=emask,
-          batch=seeds, batch_size=self.batch_size,
-          metadata={'seed_local': seed_local})
+      batch = self._sample_collate(seeds, jax.random.fold_in(key, i),
+                                   dev, use_pallas)
       state, loss, correct = self._step(state, batch)
       return state, (loss, correct, jnp.sum(seeds >= 0))
 
@@ -182,7 +192,59 @@ class FusedEpoch:
         body, state, (steps, seeds_all))
     return state, losses, jnp.sum(corrects), jnp.sum(valids)
 
+  def _sample_collate(self, seeds: jax.Array, key: jax.Array,
+                      dev: dict, use_pallas: bool) -> Batch:
+    """The shared scan-body front half: one fused multi-hop sample +
+    all-device collation (same programs as the per-batch path).
+    ``use_pallas`` comes from the host driver so the GLT_PALLAS
+    kill-switch keeps working between epochs (the per-batch contract,
+    `data/feature.py:39-40`)."""
+    (nodes, _count, row, col, _edge, emask, seed_local, _nsn,
+     _nse) = _multihop_sample(
+         dev['indptr'], dev['indices'], None, seeds, key,
+         fanouts=self.fanouts, node_cap=self._node_cap,
+         with_edge=False, sort_locality=self.sort_locality)
+    return Batch(
+        x=_device_gather(dev['hot'], nodes, dev['id2index'],
+                         use_pallas=use_pallas),
+        y=_gather_labels(dev['labels'], nodes),
+        edge_index=jnp.stack([row, col]),
+        node=nodes, node_mask=nodes >= 0, edge_mask=emask,
+        batch=seeds, batch_size=self.batch_size,
+        metadata={'seed_local': seed_local})
+
+  def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
+               dev: dict, use_pallas: bool):
+    """Scan twin of `make_eval_step` over ``[S, B]`` eval seeds."""
+    from ..models.train import make_eval_step
+    eval_step = make_eval_step(self._apply_fn, self.batch_size)
+
+    def body(carry, xs):
+      i, seeds = xs
+      batch = self._sample_collate(seeds, jax.random.fold_in(key, i),
+                                   dev, use_pallas)
+      correct, total = eval_step(params, batch)
+      return carry, (correct, total)
+
+    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
+    _, (correct, total) = jax.lax.scan(body, 0, (steps, seeds_all))
+    return jnp.sum(correct), jnp.sum(total)
+
   # -- host driver ----------------------------------------------------------
+
+  def evaluate(self, params, input_nodes) -> float:
+    """Accuracy over ``input_nodes`` (e.g. the test split) as one scan
+    program — the fused counterpart of a `make_eval_step` loop."""
+    ids = np.asarray(input_nodes)
+    if ids.dtype == np.bool_:
+      ids = np.nonzero(ids)[0]
+    ev = SeedBatcher(ids, self.batch_size, shuffle=False)
+    seeds = np.stack(list(ev))
+    # disjoint from train folds (epochs count up from 1)
+    key = jax.random.fold_in(self._base_key, 2**31 - 1)
+    correct, total = self._compiled_eval(params, jnp.asarray(seeds), key,
+                                         self._dev, pallas_enabled())
+    return float(int(correct) / max(int(total), 1))
 
   def run(self, state: TrainState) -> Tuple[TrainState, dict]:
     """Run one epoch; returns ``(state, stats)`` with per-step losses,
@@ -199,6 +261,197 @@ class FusedEpoch:
     self._epoch_idx += 1
     key = jax.random.fold_in(self._base_key, self._epoch_idx)
     state, losses, correct, valid = self._compiled(
-        state, jnp.asarray(seeds), key)
+        state, jnp.asarray(seeds), key, self._dev, pallas_enabled())
     metrics.inc('loader.batches', seeds.shape[0])
     return state, EpochStats(losses, correct, valid)
+
+
+class FusedLinkEpoch:
+  """One-program link-prediction (unsupervised) training epochs.
+
+  The link twin of `FusedEpoch`, fusing the `LinkNeighborLoader` +
+  unsupervised-step loop: the scan body draws negatives, expands
+  multi-hop neighborhoods around the positive + negative endpoints,
+  collates, and applies the binary (sigmoid) or triplet (max-margin)
+  link loss — the objective of the reference's unsupervised SAGE
+  (`examples/graph_sage_unsup_ppi.py:41-45`).
+
+  The seed/negative/metadata assembly mirrors
+  `sampler.neighbor_sampler.NeighborSampler.sample_from_edges`
+  (binary: `neighbor_sampler.py:255-282`, triplet: `:284-300`) in
+  functional form (keys passed in, not held); the parity test pins
+  the two paths together.
+
+  Args:
+    data: `Dataset` with fully device-resident features (labels
+      optional — link training is label-free unless ``edge_label``).
+    num_neighbors: per-hop fanouts.
+    edge_label_index: ``[2, E]`` (or ``(rows, cols)``) seed edges.
+    apply_fn / tx: model apply fn (emits embeddings) + optax transform.
+    batch_size: seed-EDGE batch size.
+    neg_sampling: `NegativeSampling` spec or mode string
+      (default binary, amount 1).
+    edge_label: optional ``[E]`` positive labels (binary mode gets the
+      reference's +1 shift: 0 = sampled negative).
+    remat: checkpoint the model forward — same merged-program HBM
+      hazard as `FusedEpoch` (and the link seed width is LARGER:
+      ``2B + negatives`` endpoints per batch).
+  """
+
+  def __init__(self, data: Dataset, num_neighbors, edge_label_index,
+               apply_fn: Callable, tx: optax.GradientTransformation,
+               batch_size: int, neg_sampling='binary', edge_label=None,
+               shuffle: bool = True, drop_last: bool = False,
+               seed: Optional[int] = None, sort_locality: bool = True,
+               remat: bool = False):
+    if data.is_hetero:
+      raise ValueError('FusedLinkEpoch is homogeneous-only')
+    feat = data.node_features
+    if feat is None or feat.hot_rows < feat.size(0):
+      raise ValueError(
+          'FusedLinkEpoch needs fully device-resident features '
+          '(split_ratio == 1.0); use LinkNeighborLoader(prefetch=2) '
+          'for tiered tables.')
+    self.data = data
+    self.batch_size = int(batch_size)
+    self.fanouts = tuple(int(k) for k in num_neighbors)
+    self.sort_locality = bool(sort_locality)
+    self.neg = NegativeSampling.cast(neg_sampling)
+
+    graph = data.get_graph()
+    self._num_nodes = graph.num_nodes
+    # big tables as jit arguments, not closures (see FusedEpoch note)
+    self._dev = dict(indptr=graph.indptr, indices=graph.indices,
+                     hot=feat.hot_tier, id2index=feat._id2index_dev,
+                     labels=data.get_node_label_device())
+
+    if isinstance(edge_label_index, (tuple, list)):
+      rows, cols = edge_label_index
+    else:
+      ei = np.asarray(edge_label_index)
+      rows, cols = ei[0], ei[1]
+    self._batcher = EdgeSeedBatcher(rows, cols, edge_label,
+                                    self.batch_size, shuffle, drop_last,
+                                    seed)
+
+    b = self.batch_size
+    if self.neg.is_binary():
+      self._num_neg = self.neg.sample_size(b)
+      seed_width = 2 * b + 2 * self._num_neg
+    else:
+      self._amount = int(np.ceil(float(self.neg.amount)))
+      self._num_neg = b * self._amount
+      seed_width = 2 * b + self._num_neg
+    ref = NeighborSampler(graph, self.fanouts, seed=0)
+    self._node_cap = ref.node_capacity(seed_width)
+
+    self._base_key = jax.random.key(seed or 0)
+    self._epoch_idx = 0
+    from ..models.train import make_unsupervised_step
+    step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
+    self._step = make_unsupervised_step(step_apply, tx)
+    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
+                             static_argnums=(6,))
+
+  def __len__(self) -> int:
+    return len(self._batcher)
+
+  def _link_batch(self, src: jax.Array, dst: jax.Array,
+                  label: Optional[jax.Array], key: jax.Array,
+                  dev: dict, use_pallas: bool) -> Batch:
+    """Functional seeds+negatives+metadata assembly (see class doc)."""
+    b = self.batch_size
+    pair_valid = (src >= 0) & (dst >= 0)
+    k_neg = jax.random.fold_in(key, 0)
+    k_hop = jax.random.fold_in(key, 1)
+    pos_label = (label if label is not None
+                 else jnp.ones((b,), jnp.int32))
+
+    if self.neg.is_binary():
+      nn = self._num_neg
+      nres = sample_negative(dev['indptr'], dev['indices'], nn, k_neg,
+                             strict=True, padding=True)
+      seeds = jnp.concatenate([src, dst, nres.rows, nres.cols])
+      sl, out = self._expand(seeds, k_hop, dev)
+      metadata = {
+          'edge_label_index': jnp.stack([
+              jnp.concatenate([sl[:b], sl[2 * b:2 * b + nn]]),
+              jnp.concatenate([sl[b:2 * b], sl[2 * b + nn:]])]),
+          'edge_label': jnp.concatenate(
+              [pos_label, jnp.zeros((nn,), pos_label.dtype)]),
+          'edge_label_mask': jnp.concatenate(
+              [pair_valid, jnp.ones((nn,), jnp.bool_)]),
+          'seed_local': sl,
+      }
+    else:
+      amount = self._amount
+      neg_dst = _triplet_neg_dst(dev['indptr'], dev['indices'], src,
+                                 k_neg, amount=amount,
+                                 num_nodes=self._num_nodes)
+      seeds = jnp.concatenate([src, dst, neg_dst.reshape(-1)])
+      sl, out = self._expand(seeds, k_hop, dev)
+      metadata = {
+          'src_index': sl[:b],
+          'dst_pos_index': sl[b:2 * b],
+          'dst_neg_index': sl[2 * b:].reshape(b, amount),
+          'pair_mask': pair_valid,
+          'seed_local': sl,
+      }
+    nodes, row, col, emask = out
+    return Batch(
+        x=_device_gather(dev['hot'], nodes, dev['id2index'],
+                         use_pallas=use_pallas),
+        y=(_gather_labels(dev['labels'], nodes)
+           if dev['labels'] is not None else None),
+        edge_index=jnp.stack([row, col]),
+        node=nodes, node_mask=nodes >= 0, edge_mask=emask,
+        batch=seeds, batch_size=self.batch_size, metadata=metadata)
+
+  def _expand(self, seeds: jax.Array, key: jax.Array, dev: dict):
+    (nodes, _count, row, col, _edge, emask, seed_local, _nsn,
+     _nse) = _multihop_sample(
+         dev['indptr'], dev['indices'], None, seeds, key,
+         fanouts=self.fanouts, node_cap=self._node_cap,
+         with_edge=False, sort_locality=self.sort_locality)
+    return seed_local, (nodes, row, col, emask)
+
+  def _epoch_fn(self, state: TrainState, srcs: jax.Array,
+                dsts: jax.Array, labels: Optional[jax.Array],
+                key: jax.Array, dev: dict, use_pallas: bool):
+    def body(state, xs):
+      i, src, dst, lab = xs
+      batch = self._link_batch(src, dst, lab,
+                               jax.random.fold_in(key, i), dev,
+                               use_pallas)
+      state, loss = self._step(state, batch)
+      return state, (loss, jnp.sum((src >= 0) & (dst >= 0)))
+
+    steps = jnp.arange(srcs.shape[0], dtype=jnp.int32)
+    labs = (labels if labels is not None
+            else jnp.ones_like(srcs))             # constant positive label
+    state, (losses, valids) = jax.lax.scan(
+        body, state, (steps, srcs, dsts, labs))
+    return state, losses, jnp.sum(valids)
+
+  def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
+    """One epoch; ``state`` is DONATED (thread the returned one).
+    ``stats.seeds`` counts valid seed EDGES; accuracy is meaningless
+    for the unsupervised objective and reads 0."""
+    srcs, dsts, labs = [], [], []
+    for r, c, lab in self._batcher:
+      srcs.append(r)
+      dsts.append(c)
+      if lab is not None:
+        # reference +1 shift (loader/link_loader.py:146-186): user
+        # labels move up so 0 means "sampled negative"
+        labs.append(lab + 1 if self.neg.is_binary() else lab)
+    srcs = jnp.asarray(np.stack(srcs))
+    dsts = jnp.asarray(np.stack(dsts))
+    labels = (jnp.asarray(np.stack(labs).astype(np.int32))
+              if labs else None)
+    self._epoch_idx += 1
+    key = jax.random.fold_in(self._base_key, self._epoch_idx)
+    state, losses, valid = self._compiled(state, srcs, dsts, labels, key,
+                                          self._dev, pallas_enabled())
+    metrics.inc('loader.batches', srcs.shape[0])
+    return state, EpochStats(losses, jnp.zeros((), jnp.int32), valid)

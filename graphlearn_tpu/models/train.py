@@ -132,12 +132,16 @@ def link_loss_from_metadata(emb: jax.Array, metadata: dict) -> jax.Array:
 
 
 def make_unsupervised_step(apply_fn, tx: optax.GradientTransformation):
+  """Build a jitted link-loss step.  The loss dispatches binary vs
+  triplet by the batch's (static) metadata keys
+  (`link_loss_from_metadata`), so one builder serves both the
+  per-batch loaders and `loader.fused.FusedLinkEpoch`."""
 
   @jax.jit
   def step(state: TrainState, batch):
     def loss_fn(params):
       emb = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
-      return unsupervised_link_loss(emb, batch.metadata)
+      return link_loss_from_metadata(emb, batch.metadata)
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     updates, opt_state = tx.update(grads, state.opt_state, state.params)

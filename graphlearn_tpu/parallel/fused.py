@@ -64,6 +64,11 @@ class FusedDistEpoch:
     shuffle / drop_last / seed: epoch iteration controls.
     exchange_slack: static capacity factor (``'auto'`` → the shuffled
       default; ``'adaptive'`` is rejected, see module docstring).
+    remat: checkpoint the model forward (`jax.checkpoint`) — the fused
+      program holds sampler buffers and training activations live
+      together, and at large batch x fanout that joint peak can exceed
+      per-chip HBM where the separate per-batch programs fit (see
+      `loader.fused.FusedEpoch`).
   """
 
   def __init__(self, dataset: DistDataset, num_neighbors, input_nodes,
@@ -72,7 +77,7 @@ class FusedDistEpoch:
                axis: str = 'data', shuffle: bool = True,
                drop_last: bool = False, seed: int = 0,
                input_space: str = 'old',
-               exchange_slack='auto'):
+               exchange_slack='auto', remat: bool = False):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None or dataset.node_labels is None:
       raise ValueError('FusedDistEpoch needs node features and labels')
@@ -104,7 +109,8 @@ class FusedDistEpoch:
                                 shuffle, drop_last, seed)
     self._base_key = jax.random.key(seed)
     self._epoch_idx = 0
-    self._dp_step = make_dp_supervised_step(apply_fn, tx,
+    step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
+    self._dp_step = make_dp_supervised_step(step_apply, tx,
                                             self.batch_size, self.mesh,
                                             axis)
     self._dist_step = self.sampler.step_for_batch(self.batch_size)
