@@ -166,6 +166,12 @@ def main():
   ap.add_argument('--batch', type=int, default=1024)
   ap.add_argument('--nodes', type=int, default=500_000)
   ap.add_argument('--fanout', default='15,10,5')
+  ap.add_argument('--fused', action='store_true',
+                  help='also time parallel.FusedDistEpoch (whole '
+                       'epoch = one SPMD scan program, WITH the DP '
+                       'train step) against the per-batch loader + '
+                       'DP-step loop — expect minutes of CPU-mesh '
+                       'compile at the default batch')
   args = ap.parse_args()
 
   if args.capacity_sweep:
@@ -215,6 +221,52 @@ def main():
     emit('dist_loader_seeds_per_sec',
          batches * global_batch / t.dt / 1e3, 'K seeds/s',
          batch=batch_size, num_parts=num_parts,
+         platform=jax.devices()[0].platform)
+
+  if args.fused:
+    # fused whole-epoch vs per-batch loader + DP step, same workload
+    # (the dispatch-overhead measurement, mesh edition)
+    import optax
+    from graphlearn_tpu.models import GraphSAGE, create_train_state
+    from graphlearn_tpu.parallel import (FusedDistEpoch,
+                                         make_dp_supervised_step,
+                                         replicate)
+    bs = 256 if args.quick else 512
+    fanout = [10, 5]
+    model = GraphSAGE(hidden_features=64, out_features=47, num_layers=2)
+    tx = optax.adam(3e-3)
+    it = iter(DistNeighborLoader(ds, fanout, seeds, batch_size=bs,
+                                 shuffle=True, mesh=mesh, seed=0))
+    b0 = next(it)
+    b0_local = jax.tree_util.tree_map(lambda x: x[0], b0)
+    state, apply_fn = create_train_state(model, jax.random.key(0),
+                                         b0_local, tx)
+    step = make_dp_supervised_step(apply_fn, tx, bs, mesh)
+    state = replicate(state, mesh)
+    state, _, _ = step(state, b0)               # compile + warm
+    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    nb = 0
+    with Timer() as t:
+      for b in it:
+        state, _, _ = step(state, b)
+        nb += 1
+      jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    emit('dist_train_seeds_per_sec', nb * bs * num_parts / t.dt / 1e3,
+         'K seeds/s', mode='per-batch', batch=bs, num_parts=num_parts,
+         platform=jax.devices()[0].platform)
+
+    fused = FusedDistEpoch(ds, fanout, seeds, apply_fn, tx,
+                           batch_size=bs, mesh=mesh, shuffle=True,
+                           seed=0)
+    for _ in range(2):                  # compile + donated recompile
+      state, _ = fused.run(state)
+    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    with Timer() as t:
+      state, _ = fused.run(state)
+      jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+    emit('dist_train_seeds_per_sec',
+         len(fused) * bs * num_parts / t.dt / 1e3, 'K seeds/s',
+         mode='fused', batch=bs, num_parts=num_parts,
          platform=jax.devices()[0].platform)
 
 

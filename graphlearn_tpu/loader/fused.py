@@ -238,6 +238,8 @@ class FusedEpoch:
     ids = np.asarray(input_nodes)
     if ids.dtype == np.bool_:
       ids = np.nonzero(ids)[0]
+    if ids.size == 0:
+      raise ValueError('evaluate() got an empty split')
     ev = SeedBatcher(ids, self.batch_size, shuffle=False)
     seeds = np.stack(list(ev))
     # disjoint from train folds (epochs count up from 1)
