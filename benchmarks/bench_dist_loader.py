@@ -232,7 +232,8 @@ def main():
                                          make_dp_supervised_step,
                                          replicate)
     bs = 256 if args.quick else 512
-    fanout = [10, 5]
+    fanout = [10, 5]   # matches the loader phase above (NOT --fanout,
+                       # which parameterizes the capacity workers)
     model = GraphSAGE(hidden_features=64, out_features=47, num_layers=2)
     tx = optax.adam(3e-3)
     it = iter(DistNeighborLoader(ds, fanout, seeds, batch_size=bs,
@@ -252,8 +253,8 @@ def main():
         nb += 1
       jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
     emit('dist_train_seeds_per_sec', nb * bs * num_parts / t.dt / 1e3,
-         'K seeds/s', mode='per-batch', batch=bs, num_parts=num_parts,
-         platform=jax.devices()[0].platform)
+         'K seeds/s', mode='per-batch', batch=bs, fanout=fanout,
+         num_parts=num_parts, platform=jax.devices()[0].platform)
 
     fused = FusedDistEpoch(ds, fanout, seeds, apply_fn, tx,
                            batch_size=bs, mesh=mesh, shuffle=True,
@@ -266,7 +267,7 @@ def main():
       jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
     emit('dist_train_seeds_per_sec',
          len(fused) * bs * num_parts / t.dt / 1e3, 'K seeds/s',
-         mode='fused', batch=bs, num_parts=num_parts,
+         mode='fused', batch=bs, fanout=fanout, num_parts=num_parts,
          platform=jax.devices()[0].platform)
 
 

@@ -133,6 +133,10 @@ def main():
   ap.add_argument('--expect-acc', type=float, default=None,
                   help='fail (exit 1) below this test accuracy — the '
                        'acceptance check on real data')
+  ap.add_argument('--fused', action='store_true',
+                  help='train each epoch as ONE fused lax.scan program '
+                       '(loader.FusedHeteroEpoch; needs '
+                       '--split-ratio 1.0)')
   ap.add_argument('--cpu', action='store_true')
   args = ap.parse_args()
 
@@ -194,7 +198,22 @@ def main():
     return model.apply(params, batch.x_dict, batch.edge_index_dict,
                        batch.edge_mask_dict)
 
+  fused = None
+  if args.fused:
+    import jax.numpy as jnp
+    from graphlearn_tpu.loader import FusedHeteroEpoch
+    from graphlearn_tpu.models.train import TrainState
+    fused = FusedHeteroEpoch(ds, [4, 4], (P, train_idx), model.apply,
+                             tx, batch_size=bs, shuffle=True, seed=0,
+                             remat=True)
+    fstate = TrainState(params, opt, jnp.zeros((), jnp.int32))
+
   for epoch in range(args.epochs):
+    if fused is not None:
+      fstate, stats = fused.run(fstate)
+      print(f'epoch {epoch}: loss {stats["loss"]:.4f}')
+      params = fstate.params
+      continue
     tot = cnt = 0
     for batch in loader:
       params, opt, loss = step(params, opt, batch)

@@ -4,4 +4,5 @@ from .prefetch import PrefetchIterator
 from .neighbor_loader import NeighborLoader
 from .link_loader import EdgeSeedBatcher, LinkLoader, LinkNeighborLoader
 from .subgraph_loader import SubGraphLoader
-from .fused import EpochStats, FusedEpoch, FusedLinkEpoch
+from .fused import (EpochStats, FusedEpoch, FusedHeteroEpoch,
+                    FusedLinkEpoch)

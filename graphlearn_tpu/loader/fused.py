@@ -86,7 +86,52 @@ class EpochStats:
     return f'EpochStats(steps={self.losses.shape[0]}, <lazy>)'
 
 
-class FusedEpoch:
+class _SupervisedScanEpoch:
+  """Shared epoch driver for the supervised fused twins: subclasses
+  supply ``_sample_collate(seeds, key, dev, use_pallas) -> batch`` and
+  ``_step(state, batch) -> (state, loss, correct)`` plus the
+  ``_batcher`` / ``_base_key`` / ``_dev`` / ``_compiled`` state; this
+  mixin owns the scan body and the host driver so the donation and
+  stats contracts cannot drift between the homo and hetero paths."""
+
+  def __len__(self) -> int:
+    return len(self._batcher)
+
+  def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
+                key: jax.Array, dev: dict, use_pallas: bool):
+    """``[S, B]`` seed batches → S fused sample+collate+train steps."""
+
+    def body(state, xs):
+      i, seeds = xs
+      batch = self._sample_collate(seeds, jax.random.fold_in(key, i),
+                                   dev, use_pallas)
+      state, loss, correct = self._step(state, batch)
+      return state, (loss, correct, jnp.sum(seeds >= 0))
+
+    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
+    state, (losses, corrects, valids) = jax.lax.scan(
+        body, state, (steps, seeds_all))
+    return state, losses, jnp.sum(corrects), jnp.sum(valids)
+
+  def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
+    """Run one epoch; returns ``(state, stats)``.
+
+    The input ``state`` is DONATED to the epoch program (its buffers
+    are reused for the output state) — thread the returned state
+    forward and don't touch the argument again, exactly as with a
+    donated jitted train step.  ``stats`` is LAZY (`EpochStats`):
+    reading ``.loss`` etc. syncs on the epoch; a loop that ignores it
+    never blocks."""
+    seeds = np.stack(list(self._batcher))          # [S, B], host shuffle
+    self._epoch_idx += 1
+    key = jax.random.fold_in(self._base_key, self._epoch_idx)
+    state, losses, correct, valid = self._compiled(
+        state, jnp.asarray(seeds), key, self._dev, pallas_enabled())
+    metrics.inc('loader.batches', seeds.shape[0])
+    return state, EpochStats(losses, correct, valid)
+
+
+class FusedEpoch(_SupervisedScanEpoch):
   """One-program supervised training epochs over neighbor sampling.
 
   Example::
@@ -171,26 +216,7 @@ class FusedEpoch:
                              static_argnums=(4,))
     self._compiled_eval = jax.jit(self._eval_fn, static_argnums=(4,))
 
-  def __len__(self) -> int:
-    return len(self._batcher)
-
-  # -- the one program ------------------------------------------------------
-
-  def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
-                key: jax.Array, dev: dict, use_pallas: bool):
-    """``[S, B]`` seed batches → S fused sample+collate+train steps."""
-
-    def body(state, xs):
-      i, seeds = xs
-      batch = self._sample_collate(seeds, jax.random.fold_in(key, i),
-                                   dev, use_pallas)
-      state, loss, correct = self._step(state, batch)
-      return state, (loss, correct, jnp.sum(seeds >= 0))
-
-    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
-    state, (losses, corrects, valids) = jax.lax.scan(
-        body, state, (steps, seeds_all))
-    return state, losses, jnp.sum(corrects), jnp.sum(valids)
+  # __len__ / _epoch_fn / run come from _SupervisedScanEpoch
 
   def _sample_collate(self, seeds: jax.Array, key: jax.Array,
                       dev: dict, use_pallas: bool) -> Batch:
@@ -248,24 +274,155 @@ class FusedEpoch:
                                          self._dev, pallas_enabled())
     return float(int(correct) / max(int(total), 1))
 
-  def run(self, state: TrainState) -> Tuple[TrainState, dict]:
-    """Run one epoch; returns ``(state, stats)`` with per-step losses,
-    their mean, and train accuracy over this epoch's seeds.
+class FusedHeteroEpoch(_SupervisedScanEpoch):
+  """One-program supervised training epochs on a HETERO graph.
 
-    The input ``state`` is DONATED to the epoch program (its buffers
-    are reused for the output state) — thread the returned state
-    forward and don't touch the argument again, exactly as with a
-    donated jitted train step.
+  The hetero twin of `FusedEpoch`: the scan body runs the fused
+  per-type multi-hop program (`sampler.hetero_neighbor_sampler.
+  _hetero_multihop` — the same program the per-batch
+  `HeteroNeighborSampler` dispatches), collates per-type feature
+  dicts on device, and applies a supervised step whose loss lives on
+  the seed type's slots — the objective of the reference's HGT / RGNN
+  examples (`examples/hetero/train_hgt_mag.py:90-130`,
+  `examples/igbh/train_rgnn.py`).
 
-    ``stats`` is LAZY (`EpochStats`): reading ``.loss`` etc. syncs on
-    the epoch; a loop that ignores it never blocks."""
-    seeds = np.stack(list(self._batcher))          # [S, B], host shuffle
-    self._epoch_idx += 1
-    key = jax.random.fold_in(self._base_key, self._epoch_idx)
-    state, losses, correct, valid = self._compiled(
-        state, jnp.asarray(seeds), key, self._dev, pallas_enabled())
-    metrics.inc('loader.batches', seeds.shape[0])
-    return state, EpochStats(losses, correct, valid)
+  ``apply_fn(params, x_dict, edge_index_dict, edge_mask_dict)`` must
+  return the TARGET type's logits (the `HGT`/`RGCN`/`HeteroConv`
+  model contract).
+
+  Args:
+    data: hetero `Dataset`; every node type's features fully
+      device-resident, labels present for the seed type.
+    num_neighbors: per-hop fanouts (list or ``{EdgeType: list}``).
+    input_nodes: ``(node_type, ids)`` seed spec.
+    apply_fn / tx: model apply + optax transform.
+    batch_size / shuffle / drop_last / seed: epoch controls.
+    remat: checkpoint the model forward (see `FusedEpoch`).
+  """
+
+  def __init__(self, data: Dataset, num_neighbors, input_nodes,
+               apply_fn: Callable, tx: optax.GradientTransformation,
+               batch_size: int, shuffle: bool = True,
+               drop_last: bool = False, seed: Optional[int] = None,
+               sort_locality: bool = True, remat: bool = False):
+    from ..sampler.hetero_neighbor_sampler import (HeteroNeighborSampler,
+                                                   _plan_capacities)
+    if not data.is_hetero:
+      raise ValueError('FusedHeteroEpoch needs a hetero Dataset; use '
+                       'FusedEpoch for homogeneous graphs')
+    if (not isinstance(input_nodes, tuple)
+        or not isinstance(input_nodes[0], str)):
+      raise ValueError('input_nodes must be (node_type, ids)')
+    self.input_type, ids = input_nodes
+    feats = data.node_features
+    if not isinstance(feats, dict) or not feats:
+      raise ValueError('FusedHeteroEpoch needs per-type node features')
+    for nt, f in feats.items():
+      if f.hot_rows < f.size(0):
+        raise ValueError(
+            f'feature table for {nt!r} keeps rows on host; '
+            f'FusedHeteroEpoch needs split_ratio == 1.0 everywhere '
+            f'(use NeighborLoader(prefetch=2) for tiered tables)')
+    labels = data.get_node_label_device(self.input_type)
+    if labels is None:
+      raise ValueError(
+          f'FusedHeteroEpoch needs labels for {self.input_type!r}')
+
+    self.data = data
+    self.batch_size = int(batch_size)
+    self.sort_locality = bool(sort_locality)
+
+    graphs = {et: data.get_graph(et) for et in data.get_edge_types()}
+    # reuse the per-batch sampler's planning so fused and per-batch
+    # programs share static shapes and the same _hetero_multihop
+    ref = HeteroNeighborSampler(graphs, num_neighbors,
+                                num_nodes=data.num_nodes_dict(), seed=0,
+                                sort_locality=sort_locality)
+    self._etypes = ref.etypes
+    self._fanouts_t = tuple(ref.fanouts[et] for et in ref.etypes)
+    self._num_hops = ref.num_hops
+    ntypes, table_cap, frontier_caps, _ = _plan_capacities(
+        ref.etypes, ref.fanouts, {self.input_type: self.batch_size},
+        ref.num_hops, ref._num_nodes)
+    self._table_caps = tuple(sorted(table_cap.items()))
+    self._frontier_caps_t = tuple(
+        tuple(sorted(fc.items())) for fc in frontier_caps)
+
+    # big tables as jit arguments, not closures (see FusedEpoch note)
+    self._dev = dict(
+        graphs={et: (g.indptr, g.indices, None)
+                for et, g in graphs.items()},
+        hot={nt: f.hot_tier for nt, f in feats.items()},
+        id2index={nt: f._id2index_dev for nt, f in feats.items()},
+        labels=labels)
+
+    ids = np.asarray(ids)
+    if ids.dtype == np.bool_:
+      ids = np.nonzero(ids)[0]
+    self._batcher = SeedBatcher(ids, self.batch_size, shuffle,
+                                drop_last, seed)
+    self._base_key = jax.random.key(seed or 0)
+    self._epoch_idx = 0
+    step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
+    self._step = self._make_step(step_apply, tx)
+    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
+                             static_argnums=(4,))
+
+  def _make_step(self, apply_fn, tx):
+    bs = self.batch_size
+    it = self.input_type
+
+    from ..models.train import supervised_loss
+
+    def step(state: TrainState, batch):
+      def loss_fn(params):
+        logits = apply_fn(params, batch.x_dict, batch.edge_index_dict,
+                          batch.edge_mask_dict)
+        loss = supervised_loss(logits, batch.y_dict[it],
+                               batch.batch_dict[it], bs)
+        return loss, logits
+
+      (loss, logits), grads = jax.value_and_grad(
+          loss_fn, has_aux=True)(state.params)
+      updates, opt_state = tx.update(grads, state.opt_state,
+                                     state.params)
+      params = optax.apply_updates(state.params, updates)
+      valid = batch.batch_dict[it] >= 0
+      pred = jnp.argmax(logits[:bs], axis=-1)
+      correct = jnp.sum((pred == batch.y_dict[it][:bs]) & valid)
+      return (TrainState(params, opt_state, state.step + 1), loss,
+              correct)
+
+    return step
+
+  def _sample_collate(self, seeds: jax.Array, key: jax.Array,
+                      dev: dict, use_pallas: bool):
+    from ..sampler.hetero_neighbor_sampler import _hetero_multihop
+    from .transform import HeteroBatch
+    (node, _cnt, row, col, _eid, emask, seed_locals, _nsn) = \
+        _hetero_multihop(
+            dev['graphs'], (seeds,), key,
+            etypes=self._etypes, fanouts_t=self._fanouts_t,
+            seed_types=(self.input_type,), num_hops=self._num_hops,
+            table_caps=self._table_caps,
+            frontier_caps_t=self._frontier_caps_t,
+            with_edge=False, sort_locality=self.sort_locality)
+    x_dict = {nt: _device_gather(dev['hot'][nt], ids,
+                                 dev['id2index'][nt],
+                                 use_pallas=use_pallas)
+              for nt, ids in node.items() if nt in dev['hot']}
+    y = _gather_labels(dev['labels'], node[self.input_type])
+    ei_dict = {et: jnp.stack([row[et], col[et]]) for et in row}
+    return HeteroBatch(
+        x_dict=x_dict, y_dict={self.input_type: y},
+        edge_index_dict=ei_dict,
+        edge_attr_dict={},
+        node_dict=dict(node),
+        node_mask_dict={nt: ids >= 0 for nt, ids in node.items()},
+        edge_mask_dict=dict(emask),
+        batch_dict={self.input_type: seeds},
+        batch_size=self.batch_size,
+        metadata={'seed_local': seed_locals[self.input_type]})
 
 
 class FusedLinkEpoch:
