@@ -64,13 +64,15 @@ DIST_NODES = 500_000
 DIST_DIM = 64
 
 
-def worker(fast: bool):
+def worker(fast: bool, fused_only: bool = False):
   """One fresh-session measurement: epoch time first (the primary,
-  measured on this process's first burst), then sampling throughput,
-  then (time permitting) the fused whole-epoch program.  ``fast``
-  warms up on 3 batches (covers the compile — every batch shares one
-  static shape) instead of a full epoch."""
-  t_session = time.time()
+  measured on this process's first burst), then sampling throughput.
+  ``fast`` warms up on 3 batches (covers the compile — every batch
+  shares one static shape) instead of a full epoch.  ``fused_only``
+  is the DEDICATED fused session: same setup, then only the
+  whole-epoch `FusedEpoch` measurement — it gets its own session
+  because its fresh compile (~250 s, see below) cannot share a 600 s
+  budget with the primary phases."""
   import jax
   try:
     jax.config.update('jax_compilation_cache_dir', '/tmp/glt_jax_cache')
@@ -104,6 +106,34 @@ def worker(fast: bool):
   tx = optax.adam(3e-3)
   state, apply_fn = create_train_state(
       model, jax.random.key(0), next(iter(loader)), tx)
+
+  if fused_only:
+    result = {'mode': 'fused-session',
+              'platform': jax.devices()[0].platform}
+    try:
+      # compile FRESH, never from the /tmp cache: executing the
+      # DESERIALIZED cached fused program crashes the tunneled TPU
+      # worker ("TPU device error"), while the same program compiled
+      # from scratch runs clean — reproduced both ways back to back.
+      jax.config.update('jax_compilation_cache_dir', None)
+      from graphlearn_tpu.loader import FusedEpoch
+      fused = FusedEpoch(ds, list(FANOUT), train_idx, apply_fn, tx,
+                         batch_size=BATCH, shuffle=True, seed=0,
+                         remat=True)
+      # two warm runs: first compile, second the donated-input
+      # recompile; the third run is the steady state
+      for _ in range(2):
+        state, _ = fused.run(state)
+      jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+      t0 = time.perf_counter()
+      state, _ = fused.run(state)
+      jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+      result['epoch_secs_fused'] = time.perf_counter() - t0
+    except Exception as e:          # noqa: BLE001
+      result['fused_error'] = f'{type(e).__name__}: {e}'[:200]
+    print(json.dumps(result), flush=True)
+    return
+
   step = make_supervised_step(apply_fn, tx, BATCH)
 
   # warmup covers compile; the next epoch is THE measured first burst
@@ -142,41 +172,12 @@ def worker(fast: bool):
   dt = time.perf_counter() - t0
   edges = int(sum((o.edge_mask.sum() for o in outs),
                   jnp.zeros((), jnp.int32)))
-  result = {'epoch_secs': epoch_secs,
-            'edges_per_sec': edges / dt,
-            'steps': len(loader),
-            'mode': 'fast' if fast else 'full',
-            'platform': jax.devices()[0].platform}
-  # the primary numbers are safe NOW: the harness parser takes the
-  # LAST complete JSON line, so a failure in the bonus fused phase
-  # below can only lose the bonus, never the headline
-  print(json.dumps(result), flush=True)
-
-  # fused whole-epoch program (loader.FusedEpoch): same workload, ONE
-  # lax.scan XLA program per epoch — measures what removing per-step
-  # dispatch buys on this chip.  remat=True: at this batch x fanout
-  # the merged program's joint sampler+activation liveness needs the
-  # checkpointed backward to fit HBM (measured: the non-remat program
-  # hard-crashes the worker at node_cap ~938k, and XLA's allocator
-  # does not catch it).  BONUS phase: runs last and only with time to
-  # spare, so a slow day can never cost a session its primary numbers
-  # (the session timeout is GLT_BENCH_SESSION_TIMEOUT, default 600 s).
-  deadline = float(os.environ.get('GLT_BENCH_FUSED_DEADLINE', 450))
-  if time.time() - t_session < deadline:
-    from graphlearn_tpu.loader import FusedEpoch
-    fused = FusedEpoch(ds, list(FANOUT), train_idx, apply_fn, tx,
-                       batch_size=BATCH, shuffle=True, seed=0,
-                       remat=True)
-    # two warm runs: first compile, second the donated-input
-    # recompile; the third run is the steady state
-    for _ in range(2):
-      state, _ = fused.run(state)
-    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    t0 = time.perf_counter()
-    state, _ = fused.run(state)
-    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    result['epoch_secs_fused'] = time.perf_counter() - t0
-    print(json.dumps(result), flush=True)
+  print(json.dumps({'epoch_secs': epoch_secs,
+                    'edges_per_sec': edges / dt,
+                    'steps': len(loader),
+                    'mode': 'fast' if fast else 'full',
+                    'platform': jax.devices()[0].platform}),
+        flush=True)
 
 
 def dist_worker():
@@ -276,12 +277,13 @@ def dist_worker():
   # tests/test_fused_dist_epoch.py and the standalone benchmark.
 
 
-def _run_session(fast: bool, timeout: int):
-  cmd = [sys.executable, os.path.abspath(__file__), '--bench-worker']
+def _run_session(fast: bool, timeout: int, fused: bool = False):
+  cmd = [sys.executable, os.path.abspath(__file__),
+         '--fused-session' if fused else '--bench-worker']
   if fast:
     cmd.append('--fast')
   cmd += [a for a in sys.argv[1:]
-          if a not in ('--bench-worker', '--fast')]
+          if a not in ('--bench-worker', '--fused-session', '--fast')]
   try:
     out = subprocess.run(cmd, capture_output=True, text=True,
                          cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -289,9 +291,10 @@ def _run_session(fast: bool, timeout: int):
     stdout = out.stdout or ''
     stderr = out.stderr or ''
   except subprocess.TimeoutExpired as e:
-    # the worker prints its PRIMARY result line before the bonus
-    # fused phase — salvage it from the partial capture instead of
-    # losing the session to a bonus-phase overrun
+    # each session prints one complete JSON line as soon as its
+    # numbers exist — salvage whatever made it out before the kill
+    # (a timed-out fused session has nothing to salvage; primary
+    # sessions keep their result)
     print(f'session timed out after {timeout}s (parsing partial '
           f'output)', file=sys.stderr)
     stdout = e.stdout or b''
@@ -359,11 +362,13 @@ def main():
   fast_timeout = session_timeout
   # hard wall for the whole harness: tunnel-slow days must yield a
   # degraded (fewer-session) number, never a timeout with NO number;
-  # sized for 3 x 600 s sessions + the dist phase
-  total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 2400))
+  # sized for 3 x 600 s slow-day sessions + the fused session + the
+  # dist phase (fast days fit all 5 primary sessions instead)
+  total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 3000))
   # measured ~5.5 min on this box (compile dominates); the wall keeps
   # a wedged mesh from eating the whole budget, not a perf target
   dist_timeout = int(os.environ.get('GLT_BENCH_DIST_TIMEOUT', 600))
+  fused_timeout = int(os.environ.get('GLT_BENCH_FUSED_TIMEOUT', 600))
   t_start = time.time()
 
   def budget_left():
@@ -380,10 +385,13 @@ def main():
     fast = attempts > 0
     tmo = fast_timeout if fast else session_timeout
     # the session floor is the hard deliverable (r2 shipped 2): only
-    # once it's met does the budget guard start reserving the dist
-    # phase.  The wall also binds with ZERO results — a wedged chip
-    # must fail within ~the budget, not after sessions+3 timeouts.
-    reserve = dist_timeout if len(results) >= floor else 60
+    # once it's met does the budget guard start reserving the fused
+    # session and the dist phase (which itself self-clamps to the
+    # remaining budget).  The wall also binds with ZERO results — a
+    # wedged chip must fail within ~the budget, not after sessions+3
+    # timeouts.
+    reserve = (dist_timeout + fused_timeout
+               if len(results) >= floor else 60)
     if attempts > 0 and budget_left() < tmo + reserve:
       print(f'budget: stopping after {len(results)} sessions '
             f'({attempts} attempts)', file=sys.stderr)
@@ -397,13 +405,24 @@ def main():
   if not results:
     raise SystemExit('all bench sessions failed')
 
+  # dedicated fused session (whole-epoch FusedEpoch, fresh compile —
+  # ~350-450 s): bonus, only with budget to spare beyond the dist
+  # phase; a failure or skip costs nothing but the fused stats
+  fused_res = None
+  # the dist phase self-clamps to whatever remains (60 s floor), so
+  # only a small cushion is reserved beyond the fused session itself
+  if budget_left() > fused_timeout + 120:
+    fused_res = _run_session(True, fused_timeout, fused=True)
+  else:
+    print(f'budget: skipping the fused session '
+          f'({budget_left():.0f}s left)', file=sys.stderr)
+
   dist = _run_dist_section(min(dist_timeout, max(int(budget_left()), 60)))
 
   ep = sorted(r['epoch_secs'] for r in results)
   es = sorted(r['edges_per_sec'] for r in results)
-  # only sessions that measured the fused path count toward its stats
-  fu = sorted(r['epoch_secs_fused'] for r in results
-              if 'epoch_secs_fused' in r)
+  fu = ([fused_res['epoch_secs_fused']]
+        if fused_res and 'epoch_secs_fused' in fused_res else [])
   med_ep = statistics.median(ep)
   med_es = statistics.median(es)
   print(json.dumps({
@@ -420,12 +439,10 @@ def main():
           round(es[-1] / 1e6, 1)],
       'sampling_vs_a100_nominal': round(med_es / BASELINE_EDGES_PER_SEC,
                                         2),
-      'fused_epoch_secs_min_med_max': (
-          [round(fu[0], 4), round(statistics.median(fu), 4),
-           round(fu[-1], 4)] if fu else None),
-      'fused_vs_baseline': (round(
-          BASELINE_EPOCH_SECS / statistics.median(fu), 4) if fu
-          else None),
+      'fused_epoch_secs': round(fu[0], 4) if fu else None,
+      'fused_vs_baseline': (round(BASELINE_EPOCH_SECS / fu[0], 4)
+                            if fu else None),
+      'fused_error': (fused_res or {}).get('fused_error'),
       'sessions': len(results),
       'session_modes': [r['mode'] for r in results],
       'steps_per_epoch': results[0]['steps'],
@@ -436,6 +453,8 @@ def main():
 if __name__ == '__main__':
   if '--dist-worker' in sys.argv:
     dist_worker()
+  elif '--fused-session' in sys.argv:
+    worker(fast=True, fused_only=True)
   elif '--bench-worker' in sys.argv:
     worker(fast='--fast' in sys.argv)
   else:

@@ -56,6 +56,10 @@ def main():
   ap.add_argument('--split-ratio', type=float, default=1.0)
   ap.add_argument('--assert', dest='do_assert', action='store_true',
                   help=f'exit 1 if test accuracy < {ACCURACY_BAR}')
+  ap.add_argument('--fused', action='store_true',
+                  help='train each epoch as ONE fused lax.scan program '
+                       '(loader.FusedEpoch, remat backward; needs '
+                       '--split-ratio 1.0)')
   ap.add_argument('--cpu', action='store_true')
   args = ap.parse_args()
 
@@ -97,14 +101,25 @@ def main():
   train_step = make_supervised_step(apply_fn, tx, bs)
   eval_step = make_eval_step(apply_fn, bs)
 
+  fused = None
+  if args.fused:
+    from graphlearn_tpu.loader import FusedEpoch
+    fused = FusedEpoch(ds, [15, 10, 5], splits['train'], apply_fn, tx,
+                       batch_size=bs, shuffle=True, seed=0, remat=True)
+
   for epoch in range(args.epochs):
     t0 = time.perf_counter()
-    tot = cnt = 0
-    for batch in train_loader:
-      state, loss, _ = train_step(state, batch)
-      tot += float(loss)
-      cnt += 1
-    print(f'epoch {epoch}: loss {tot / max(cnt, 1):.4f} '
+    if fused is not None:
+      state, stats = fused.run(state)
+      mean_loss = stats['loss']
+    else:
+      tot = cnt = 0
+      for batch in train_loader:
+        state, loss, _ = train_step(state, batch)
+        tot += float(loss)
+        cnt += 1
+      mean_loss = tot / max(cnt, 1)
+    print(f'epoch {epoch}: loss {mean_loss:.4f} '
           f'({time.perf_counter() - t0:.2f}s)')
 
   correct = total = 0

@@ -369,31 +369,15 @@ class FusedHeteroEpoch(_SupervisedScanEpoch):
                              static_argnums=(4,))
 
   def _make_step(self, apply_fn, tx):
-    bs = self.batch_size
+    from ..models.train import make_extracted_supervised_step
     it = self.input_type
 
-    from ..models.train import supervised_loss
+    def extract(params, batch):
+      logits = apply_fn(params, batch.x_dict, batch.edge_index_dict,
+                        batch.edge_mask_dict)
+      return logits, batch.y_dict[it], batch.batch_dict[it]
 
-    def step(state: TrainState, batch):
-      def loss_fn(params):
-        logits = apply_fn(params, batch.x_dict, batch.edge_index_dict,
-                          batch.edge_mask_dict)
-        loss = supervised_loss(logits, batch.y_dict[it],
-                               batch.batch_dict[it], bs)
-        return loss, logits
-
-      (loss, logits), grads = jax.value_and_grad(
-          loss_fn, has_aux=True)(state.params)
-      updates, opt_state = tx.update(grads, state.opt_state,
-                                     state.params)
-      params = optax.apply_updates(state.params, updates)
-      valid = batch.batch_dict[it] >= 0
-      pred = jnp.argmax(logits[:bs], axis=-1)
-      correct = jnp.sum((pred == batch.y_dict[it][:bs]) & valid)
-      return (TrainState(params, opt_state, state.step + 1), loss,
-              correct)
-
-    return step
+    return make_extracted_supervised_step(extract, tx, self.batch_size)
 
   def _sample_collate(self, seeds: jax.Array, key: jax.Array,
                       dev: dict, use_pallas: bool):

@@ -43,27 +43,42 @@ def supervised_loss(logits: jax.Array, y: jax.Array, batch_seeds: jax.Array,
   return (ce * valid).sum() / jnp.maximum(valid.sum(), 1.0)
 
 
+def make_extracted_supervised_step(extract: Callable,
+                                   tx: optax.GradientTransformation,
+                                   batch_size: int):
+  """Build ``(state, batch) -> (state, loss, correct)`` from an
+  ``extract(params, batch) -> (logits, y, seeds)`` adapter — ONE
+  update body (masked seed-slot CE, optax update, masked correct
+  count) shared by the homogeneous and hetero step builders and the
+  fused epoch runners."""
+
+  def step(state: TrainState, batch):
+    def loss_fn(params):
+      logits, y, seeds = extract(params, batch)
+      loss = supervised_loss(logits, y, seeds, batch_size)
+      return loss, (logits, y, seeds)
+
+    (loss, (logits, y, seeds)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params)
+    updates, opt_state = tx.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    valid = seeds >= 0
+    pred = jnp.argmax(logits[:batch_size], axis=-1)
+    correct = jnp.sum((pred == y[:batch_size]) & valid)
+    return TrainState(params, opt_state, state.step + 1), loss, correct
+
+  return step
+
+
 def make_supervised_step(apply_fn, tx: optax.GradientTransformation,
                          batch_size: int):
   """Build a jitted ``(state, batch) -> (state, loss, correct)`` step."""
 
-  @jax.jit
-  def step(state: TrainState, batch):
-    def loss_fn(params):
-      logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
-      loss = supervised_loss(logits, batch.y, batch.batch, batch_size)
-      return loss, logits
+  def extract(params, batch):
+    logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+    return logits, batch.y, batch.batch
 
-    (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-        state.params)
-    updates, opt_state = tx.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    valid = batch.batch >= 0
-    pred = jnp.argmax(logits[:batch_size], axis=-1)
-    correct = jnp.sum((pred == batch.y[:batch_size]) & valid)
-    return TrainState(params, opt_state, state.step + 1), loss, correct
-
-  return step
+  return jax.jit(make_extracted_supervised_step(extract, tx, batch_size))
 
 
 def make_eval_step(apply_fn, batch_size: int):
