@@ -221,15 +221,18 @@ def main():
       cnt += 1
     print(f'epoch {epoch}: loss {tot / max(cnt, 1):.4f}')
 
-  correct = total = 0
-  for batch in test_loader:
-    pred = np.argmax(np.asarray(logits_fn(params, batch))[:bs], axis=1)
-    seeds = np.asarray(batch.batch_dict[P])
-    valid = seeds >= 0
-    correct += int((pred[valid] == np.asarray(batch.y_dict[P][:bs])[valid])
-                   .sum())
-    total += int(valid.sum())
-  acc = correct / max(total, 1)
+  if fused is not None:
+    acc = fused.evaluate(params, test_idx)
+  else:
+    correct = total = 0
+    for batch in test_loader:
+      pred = np.argmax(np.asarray(logits_fn(params, batch))[:bs], axis=1)
+      seeds = np.asarray(batch.batch_dict[P])
+      valid = seeds >= 0
+      correct += int((pred[valid]
+                      == np.asarray(batch.y_dict[P][:bs])[valid]).sum())
+      total += int(valid.sum())
+    acc = correct / max(total, 1)
   print(f'test acc: {acc:.4f}')
   if args.expect_acc is not None and acc < args.expect_acc:
     raise SystemExit(

@@ -130,6 +130,42 @@ class _SupervisedScanEpoch:
     metrics.inc('loader.batches', seeds.shape[0])
     return state, EpochStats(losses, correct, valid)
 
+  def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
+               dev: dict, use_pallas: bool):
+    """Scan twin of a `make_eval_step` loop over ``[S, B]`` seeds —
+    accuracy on the seed slots via the subclass's eval extract."""
+    bs = self.batch_size
+
+    def body(carry, xs):
+      i, seeds = xs
+      batch = self._sample_collate(seeds, jax.random.fold_in(key, i),
+                                   dev, use_pallas)
+      logits, y, seeds_b = self._eval_extract(params, batch)
+      valid = seeds_b >= 0
+      pred = jnp.argmax(logits[:bs], axis=-1)
+      return carry, (jnp.sum((pred == y[:bs]) & valid),
+                     jnp.sum(valid))
+
+    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
+    _, (correct, total) = jax.lax.scan(body, 0, (steps, seeds_all))
+    return jnp.sum(correct), jnp.sum(total)
+
+  def evaluate(self, params, input_nodes) -> float:
+    """Accuracy over ``input_nodes`` (e.g. the test split) as one scan
+    program — the fused counterpart of a `make_eval_step` loop."""
+    ids = np.asarray(input_nodes)
+    if ids.dtype == np.bool_:
+      ids = np.nonzero(ids)[0]
+    if ids.size == 0:
+      raise ValueError('evaluate() got an empty split')
+    ev = SeedBatcher(ids, self.batch_size, shuffle=False)
+    seeds = np.stack(list(ev))
+    # disjoint from train folds (epochs count up from 1)
+    key = jax.random.fold_in(self._base_key, 2**31 - 1)
+    correct, total = self._compiled_eval(params, jnp.asarray(seeds), key,
+                                         self._dev, pallas_enabled())
+    return float(int(correct) / max(int(total), 1))
+
 
 class FusedEpoch(_SupervisedScanEpoch):
   """One-program supervised training epochs over neighbor sampling.
@@ -239,40 +275,11 @@ class FusedEpoch(_SupervisedScanEpoch):
         batch=seeds, batch_size=self.batch_size,
         metadata={'seed_local': seed_local})
 
-  def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
-               dev: dict, use_pallas: bool):
-    """Scan twin of `make_eval_step` over ``[S, B]`` eval seeds."""
-    from ..models.train import make_eval_step
-    eval_step = make_eval_step(self._apply_fn, self.batch_size)
+  def _eval_extract(self, params, batch):
+    logits = self._apply_fn(params, batch.x, batch.edge_index,
+                            batch.edge_mask)
+    return logits, batch.y, batch.batch
 
-    def body(carry, xs):
-      i, seeds = xs
-      batch = self._sample_collate(seeds, jax.random.fold_in(key, i),
-                                   dev, use_pallas)
-      correct, total = eval_step(params, batch)
-      return carry, (correct, total)
-
-    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
-    _, (correct, total) = jax.lax.scan(body, 0, (steps, seeds_all))
-    return jnp.sum(correct), jnp.sum(total)
-
-  # -- host driver ----------------------------------------------------------
-
-  def evaluate(self, params, input_nodes) -> float:
-    """Accuracy over ``input_nodes`` (e.g. the test split) as one scan
-    program — the fused counterpart of a `make_eval_step` loop."""
-    ids = np.asarray(input_nodes)
-    if ids.dtype == np.bool_:
-      ids = np.nonzero(ids)[0]
-    if ids.size == 0:
-      raise ValueError('evaluate() got an empty split')
-    ev = SeedBatcher(ids, self.batch_size, shuffle=False)
-    seeds = np.stack(list(ev))
-    # disjoint from train folds (epochs count up from 1)
-    key = jax.random.fold_in(self._base_key, 2**31 - 1)
-    correct, total = self._compiled_eval(params, jnp.asarray(seeds), key,
-                                         self._dev, pallas_enabled())
-    return float(int(correct) / max(int(total), 1))
 
 class FusedHeteroEpoch(_SupervisedScanEpoch):
   """One-program supervised training epochs on a HETERO graph.
@@ -363,10 +370,12 @@ class FusedHeteroEpoch(_SupervisedScanEpoch):
                                 drop_last, seed)
     self._base_key = jax.random.key(seed or 0)
     self._epoch_idx = 0
+    self._apply_fn = apply_fn
     step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
     self._step = self._make_step(step_apply, tx)
     self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
                              static_argnums=(4,))
+    self._compiled_eval = jax.jit(self._eval_fn, static_argnums=(4,))
 
   def _make_step(self, apply_fn, tx):
     from ..models.train import make_extracted_supervised_step
@@ -378,6 +387,12 @@ class FusedHeteroEpoch(_SupervisedScanEpoch):
       return logits, batch.y_dict[it], batch.batch_dict[it]
 
     return make_extracted_supervised_step(extract, tx, self.batch_size)
+
+  def _eval_extract(self, params, batch):
+    it = self.input_type
+    logits = self._apply_fn(params, batch.x_dict, batch.edge_index_dict,
+                            batch.edge_mask_dict)
+    return logits, batch.y_dict[it], batch.batch_dict[it]
 
   def _sample_collate(self, seeds: jax.Array, key: jax.Array,
                       dev: dict, use_pallas: bool):

@@ -92,6 +92,23 @@ def test_fused_hetero_batch_matches_loader_structure():
   assert fb.y_dict[U].shape == batch0.y_dict[U].shape
 
 
+def test_fused_hetero_evaluate():
+  """The fused eval pass agrees with training accuracy on the learned
+  task (same seed-type slots, same masking)."""
+  ds = _dataset()
+  tx = optax.adam(1e-2)
+  model, state, _ = _model_state(ds, tx)
+  fused = FusedHeteroEpoch(ds, [3, 3], (U, np.arange(48)), model.apply,
+                           tx, batch_size=16, shuffle=True, seed=0)
+  for _ in range(25):
+    state, stats = fused.run(state)
+  acc = fused.evaluate(state.params, np.arange(48))
+  assert acc > 0.8
+  assert abs(acc - stats['accuracy']) < 0.25
+  with pytest.raises(ValueError, match='empty'):
+    fused.evaluate(state.params, np.zeros(48, dtype=bool))
+
+
 def test_fused_hetero_remat_trains():
   ds = _dataset()
   tx = optax.adam(1e-2)
