@@ -37,7 +37,8 @@ import optax
 
 from ..data.dataset import Dataset
 from ..data.feature import _device_gather
-from ..models.train import TrainState, make_supervised_step
+from ..models.train import (TrainState, make_extracted_eval_step,
+                            make_extracted_supervised_step)
 from ..ops.negative import sample_negative
 from ..ops.pallas_gather import pallas_enabled
 from ..sampler.base import NegativeSampling
@@ -133,18 +134,14 @@ class _SupervisedScanEpoch:
   def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
                dev: dict, use_pallas: bool):
     """Scan twin of a `make_eval_step` loop over ``[S, B]`` seeds —
-    accuracy on the seed slots via the subclass's eval extract."""
-    bs = self.batch_size
+    accuracy on the seed slots via the subclass's ``_eval_step``."""
 
     def body(carry, xs):
       i, seeds = xs
       batch = self._sample_collate(seeds, jax.random.fold_in(key, i),
                                    dev, use_pallas)
-      logits, y, seeds_b = self._eval_extract(params, batch)
-      valid = seeds_b >= 0
-      pred = jnp.argmax(logits[:bs], axis=-1)
-      return carry, (jnp.sum((pred == y[:bs]) & valid),
-                     jnp.sum(valid))
+      correct, total = self._eval_step(params, batch)
+      return carry, (correct, total)
 
     steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
     _, (correct, total) = jax.lax.scan(body, 0, (steps, seeds_all))
@@ -245,12 +242,23 @@ class FusedEpoch(_SupervisedScanEpoch):
                                 drop_last, seed)
     self._base_key = jax.random.key(seed or 0)
     self._epoch_idx = 0
-    self._apply_fn = apply_fn
     step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
-    self._step = make_supervised_step(step_apply, tx, self.batch_size)
+    # ONE extract per apply variant pins the train and eval paths to
+    # the same batch-field contract
+    self._step = make_extracted_supervised_step(
+        self._extract_with(step_apply), tx, self.batch_size)
+    self._eval_step = make_extracted_eval_step(
+        self._extract_with(apply_fn), self.batch_size)
     self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
                              static_argnums=(4,))
     self._compiled_eval = jax.jit(self._eval_fn, static_argnums=(4,))
+
+  @staticmethod
+  def _extract_with(apply):
+    def extract(params, batch):
+      logits = apply(params, batch.x, batch.edge_index, batch.edge_mask)
+      return logits, batch.y, batch.batch
+    return extract
 
   # __len__ / _epoch_fn / run come from _SupervisedScanEpoch
 
@@ -274,12 +282,6 @@ class FusedEpoch(_SupervisedScanEpoch):
         node=nodes, node_mask=nodes >= 0, edge_mask=emask,
         batch=seeds, batch_size=self.batch_size,
         metadata={'seed_local': seed_local})
-
-  def _eval_extract(self, params, batch):
-    logits = self._apply_fn(params, batch.x, batch.edge_index,
-                            batch.edge_mask)
-    return logits, batch.y, batch.batch
-
 
 class FusedHeteroEpoch(_SupervisedScanEpoch):
   """One-program supervised training epochs on a HETERO graph.
@@ -370,29 +372,24 @@ class FusedHeteroEpoch(_SupervisedScanEpoch):
                                 drop_last, seed)
     self._base_key = jax.random.key(seed or 0)
     self._epoch_idx = 0
-    self._apply_fn = apply_fn
     step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
-    self._step = self._make_step(step_apply, tx)
+    self._step = make_extracted_supervised_step(
+        self._extract_with(step_apply), tx, self.batch_size)
+    self._eval_step = make_extracted_eval_step(
+        self._extract_with(apply_fn), self.batch_size)
     self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
                              static_argnums=(4,))
     self._compiled_eval = jax.jit(self._eval_fn, static_argnums=(4,))
 
-  def _make_step(self, apply_fn, tx):
-    from ..models.train import make_extracted_supervised_step
+  def _extract_with(self, apply):
     it = self.input_type
 
     def extract(params, batch):
-      logits = apply_fn(params, batch.x_dict, batch.edge_index_dict,
-                        batch.edge_mask_dict)
+      logits = apply(params, batch.x_dict, batch.edge_index_dict,
+                     batch.edge_mask_dict)
       return logits, batch.y_dict[it], batch.batch_dict[it]
 
-    return make_extracted_supervised_step(extract, tx, self.batch_size)
-
-  def _eval_extract(self, params, batch):
-    it = self.input_type
-    logits = self._apply_fn(params, batch.x_dict, batch.edge_index_dict,
-                            batch.edge_mask_dict)
-    return logits, batch.y_dict[it], batch.batch_dict[it]
+    return extract
 
   def _sample_collate(self, seeds: jax.Array, key: jax.Array,
                       dev: dict, use_pallas: bool):

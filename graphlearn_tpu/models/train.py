@@ -81,17 +81,28 @@ def make_supervised_step(apply_fn, tx: optax.GradientTransformation,
   return jax.jit(make_extracted_supervised_step(extract, tx, batch_size))
 
 
-def make_eval_step(apply_fn, batch_size: int):
+def make_extracted_eval_step(extract: Callable, batch_size: int):
+  """``(params, batch) -> (correct, total)`` from the same extract
+  adapter `make_extracted_supervised_step` takes — ONE definition of
+  the masked seed-slot accuracy."""
 
-  @jax.jit
   def step(params, batch):
-    logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
-    valid = batch.batch >= 0
+    logits, y, seeds = extract(params, batch)
+    valid = seeds >= 0
     pred = jnp.argmax(logits[:batch_size], axis=-1)
-    correct = jnp.sum((pred == batch.y[:batch_size]) & valid)
+    correct = jnp.sum((pred == y[:batch_size]) & valid)
     return correct, jnp.sum(valid)
 
   return step
+
+
+def make_eval_step(apply_fn, batch_size: int):
+
+  def extract(params, batch):
+    logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+    return logits, batch.y, batch.batch
+
+  return jax.jit(make_extracted_eval_step(extract, batch_size))
 
 
 def unsupervised_link_loss(emb: jax.Array, metadata: dict) -> jax.Array:
