@@ -8,7 +8,7 @@ from . import multihost
 from .dist_hetero import (DistHeteroDataset, DistHeteroLinkNeighborLoader,
                           DistHeteroNeighborLoader,
                           DistHeteroNeighborSampler)
-from .fused import FusedDistEpoch
+from .fused import FusedDistEpoch, FusedDistLinkEpoch
 from .dist_sampler import (DistLinkNeighborLoader, DistLinkNeighborSampler,
                            DistNeighborLoader, DistNeighborSampler,
                            DistRandomWalker,

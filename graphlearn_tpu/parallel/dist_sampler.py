@@ -1494,6 +1494,35 @@ def pack_link_seeds(edge_label_index, edge_label,
   return rows, cols, colsarr
 
 
+def pack_link_seeds_relabeled(edge_label_index, edge_label,
+                              neg_mode: Optional[str], dataset,
+                              input_space: str) -> np.ndarray:
+  """`pack_link_seeds` + the ``input_space`` old→new endpoint remap —
+  the one constructor-side contract shared by `DistLinkNeighborLoader`
+  and `FusedDistLinkEpoch`.  Returns the packed ``[E, 2|3]`` pairs."""
+  rows, cols, colsarr = pack_link_seeds(edge_label_index, edge_label,
+                                        neg_mode)
+  if input_space == 'old' and dataset.old2new is not None:
+    colsarr[0] = dataset.old2new[rows]
+    colsarr[1] = dataset.old2new[cols]
+  return np.stack(colsarr, axis=1)
+
+
+def link_step_metadata(neg_mode: Optional[str], seed_local, eli, elab,
+                       elab_mask, src_idx, dst_pos, dst_neg) -> dict:
+  """Map a link step's label outputs to the metadata dict
+  `link_loss_from_metadata` dispatches on — ONE definition for the
+  per-batch sampler and the fused epoch twin."""
+  md = {'seed_local': seed_local}
+  if neg_mode == 'triplet':
+    md.update(src_index=src_idx, dst_pos_index=dst_pos,
+              dst_neg_index=dst_neg, pair_mask=src_idx >= 0)
+  else:
+    md.update(edge_label_index=eli, edge_label=elab,
+              edge_label_mask=elab_mask)
+  return md
+
+
 class DistLinkNeighborSampler(DistNeighborSampler):
   """Device-mesh LINK sampler: per-device seed edges + collective
   strict negatives + endpoint expansion — the SPMD analog of the
@@ -1529,16 +1558,17 @@ class DistLinkNeighborSampler(DistNeighborSampler):
       return 2 * b + b * amount, b * amount
     return 2 * b, 0
 
-  def sample_from_edges(self, pairs_stacked: np.ndarray):
-    """``pairs_stacked``: ``[P, B, 2|3]`` per-device (src, dst[, label])
-    seed edges in the relabeled id space, -1 padded."""
-    p, b = pairs_stacked.shape[:2]
+  def step_for_pairs(self, batch_size: int, width: int):
+    """The compiled SPMD link step for ``[P, batch_size, width]`` seed
+    edges (built once per (batch, width)) — also the scan body of
+    `FusedDistLinkEpoch`."""
+    b = int(batch_size)
     exp_seeds, num_neg = self._expansion_seeds(b)
-    node_cap = self.node_capacity(exp_seeds)
-    cfg = ('link', b, pairs_stacked.shape[2])
+    cfg = ('link', b, int(width))
     if cfg not in self._steps:
       self._steps[cfg] = _make_dist_link_step(
-          self.mesh, self.num_parts, self.fanouts, node_cap, b,
+          self.mesh, self.num_parts, self.fanouts,
+          self.node_capacity(exp_seeds), b,
           self.ds.graph.num_nodes, self.neg_mode, num_neg,
           self.neg_amount,
           self.with_edge, self.collect_features, self.collect_labels,
@@ -1546,6 +1576,13 @@ class DistLinkNeighborSampler(DistNeighborSampler):
           exchange_slack=self.exchange_slack,
           collect_edge_features=self.collect_edge_features,
           ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
+    return self._steps[cfg]
+
+  def sample_from_edges(self, pairs_stacked: np.ndarray):
+    """``pairs_stacked``: ``[P, B, 2|3]`` per-device (src, dst[, label])
+    seed edges in the relabeled id space, -1 padded."""
+    p, b = pairs_stacked.shape[:2]
+    step = self.step_for_pairs(b, pairs_stacked.shape[2])
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -1554,21 +1591,15 @@ class DistLinkNeighborSampler(DistNeighborSampler):
         NamedSharding(self.mesh, P(self.axis)))
     (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats,
      eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
-        self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
-                         arrs['bounds'], pairs_dev, arrs['fshards'],
-                         arrs['lshards'], arrs['cids'], arrs['crows'],
-                         arrs['efshards'], arrs['ebounds'],
-                         arrs['hcounts'], key)
+        step(arrs['indptr'], arrs['indices'], arrs['eids'],
+             arrs['bounds'], pairs_dev, arrs['fshards'],
+             arrs['lshards'], arrs['cids'], arrs['crows'],
+             arrs['efshards'], arrs['ebounds'],
+             arrs['hcounts'], key)
     self._accumulate_stats(stats)
     x = self._maybe_overlay_cold(x, nodes)
-    md = {'seed_local': seed_local}
-    if self.neg_mode == 'triplet':
-      md.update(src_index=src_idx, dst_pos_index=dst_pos,
-                dst_neg_index=dst_neg,
-                pair_mask=src_idx >= 0)
-    else:
-      md.update(edge_label_index=eli, edge_label=elab,
-                edge_label_mask=elab_mask)
+    md = link_step_metadata(self.neg_mode, seed_local, eli, elab,
+                            elab_mask, src_idx, dst_pos, dst_neg)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, x=x, y=y, ef=ef, num_sampled_nodes=nsn,
                 batch=pairs_dev[:, :, 0], metadata=md)
@@ -1608,12 +1639,9 @@ class DistLinkNeighborLoader(PrefetchingLoader):
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0
-    rows, cols, colsarr = pack_link_seeds(edge_label_index, edge_label,
-                                          self.sampler.neg_mode)
-    if input_space == 'old' and dataset.old2new is not None:
-      colsarr[0] = dataset.old2new[rows]
-      colsarr[1] = dataset.old2new[cols]
-    self.pairs = np.stack(colsarr, axis=1)
+    self.pairs = pack_link_seeds_relabeled(
+        edge_label_index, edge_label, self.sampler.neg_mode, dataset,
+        input_space)
     self.num_parts = dataset.num_partitions
     self.batch_size = int(batch_size)
     self._batcher = SeedBatcher(self.pairs,

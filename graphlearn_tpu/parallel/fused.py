@@ -37,8 +37,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.train import TrainState
 from .dist_data import DistDataset
-from .dist_sampler import DistNeighborSampler, resolve_exchange_slack
-from .dp import make_dp_supervised_step
+from .dist_sampler import (DistLinkNeighborSampler, DistNeighborSampler,
+                           link_step_metadata, pack_link_seeds_relabeled,
+                           resolve_exchange_slack)
+from .dp import make_dp_supervised_step, make_dp_unsupervised_step
 
 
 class FusedDistEpoch:
@@ -169,3 +171,135 @@ class FusedDistEpoch:
         state, seeds_dev, key, self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
     return state, EpochStats(losses, correct, valid)
+
+
+class FusedDistLinkEpoch:
+  """One-program data-parallel LINK-PREDICTION epochs on the mesh.
+
+  The link member of the fused mesh family: the scan body runs the
+  full distributed link step (per-device seed edges + collective
+  strict negatives against the GLOBAL sharded graph + endpoint
+  expansion + feature collection — the same program
+  `DistLinkNeighborSampler` dispatches per batch) followed by the DP
+  unsupervised update (`make_dp_unsupervised_step`: binary sigmoid or
+  max-margin triplet link loss by the metadata keys, pmean gradients).
+
+  Same constraints as `FusedDistEpoch`: non-tiered feature store and
+  a static exchange slack.
+
+  Args:
+    dataset: `DistDataset` (sharded, non-tiered features).
+    num_neighbors: per-hop fanouts for the endpoint expansion.
+    edge_label_index: ``[2, E]`` (or ``(rows, cols)``) seed edges.
+    apply_fn / tx: embedding model apply + optax transform.
+    batch_size: PER-DEVICE seed-edge batch size.
+    neg_sampling: ``'binary'`` / ``('triplet', amount)``.
+    edge_label: optional labels (binary mode applies the reference's
+      +1 shift via `pack_link_seeds`).
+    remat: checkpoint the model forward (see `FusedDistEpoch`).
+  """
+
+  def __init__(self, dataset: DistDataset, num_neighbors,
+               edge_label_index, apply_fn: Callable,
+               tx: optax.GradientTransformation, batch_size: int,
+               neg_sampling='binary', edge_label=None,
+               mesh: Optional[Mesh] = None, axis: str = 'data',
+               shuffle: bool = True, drop_last: bool = False,
+               seed: int = 0, input_space: str = 'old',
+               exchange_slack='auto', remat: bool = False):
+    from ..loader.node_loader import SeedBatcher
+    if dataset.node_features is None:
+      raise ValueError('FusedDistLinkEpoch needs node features')
+    if dataset.node_features.is_tiered:
+      raise ValueError(
+          'FusedDistLinkEpoch needs a non-tiered feature store; use '
+          'DistLinkNeighborLoader(prefetch=2) for tiered tables')
+    if exchange_slack == 'adaptive':
+      raise ValueError(
+          "exchange_slack='adaptive' retunes between batches on the "
+          "host; FusedDistLinkEpoch takes a static slack ('auto' or "
+          'a number) — or use DistLinkNeighborLoader')
+    slack = resolve_exchange_slack(exchange_slack, shuffle)
+    self.sampler = DistLinkNeighborSampler(
+        dataset, num_neighbors, neg_sampling=neg_sampling, mesh=mesh,
+        axis=axis, collect_features=True, seed=seed,
+        exchange_slack=slack)
+    self.ds = dataset
+    self.mesh = self.sampler.mesh
+    self.axis = axis
+    self.num_parts = dataset.num_partitions
+    self.batch_size = int(batch_size)
+
+    self.pairs = pack_link_seeds_relabeled(        # [E, 2|3]
+        edge_label_index, edge_label, self.sampler.neg_mode, dataset,
+        input_space)
+    self._batcher = SeedBatcher(self.pairs,
+                                self.batch_size * self.num_parts,
+                                shuffle, drop_last, seed)
+    self._base_key = jax.random.key(seed)
+    self._epoch_idx = 0
+    step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
+    self._dp_step = make_dp_unsupervised_step(step_apply, tx, self.mesh,
+                                              axis)
+    self._dist_step = self.sampler.step_for_pairs(
+        self.batch_size, self.pairs.shape[1])
+    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,))
+
+  def __len__(self) -> int:
+    return len(self._batcher)
+
+  # -- the one program ------------------------------------------------------
+
+  def _epoch_fn(self, state: TrainState, pairs_all: jax.Array,
+                key: jax.Array, arrs: dict):
+    """``[S, P, B, 2|3]`` seed-edge batches → S fused
+    negatives+exchange+collect+train steps."""
+    from ..loader.transform import Batch
+
+    def body(state, xs):
+      i, pairs = xs
+      (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn, stats,
+       eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
+          self._dist_step(
+              arrs['indptr'], arrs['indices'], arrs['eids'],
+              arrs['bounds'], pairs, arrs['fshards'], arrs['lshards'],
+              arrs['cids'], arrs['crows'], arrs['efshards'],
+              arrs['ebounds'], arrs['hcounts'],
+              jax.random.fold_in(key, i))
+      md = link_step_metadata(self.sampler.neg_mode, seed_local, eli,
+                              elab, elab_mask, src_idx, dst_pos,
+                              dst_neg)
+      batch = Batch(
+          x=x, y=y, edge_index=jnp.stack([row, col], axis=1),
+          edge_attr=ef, node=nodes, node_mask=nodes >= 0,
+          edge_mask=row >= 0, edge=edge, batch=pairs[:, :, 0],
+          batch_size=self.batch_size, num_sampled_nodes=nsn,
+          metadata=md)
+      state, loss = self._dp_step(state, batch)
+      valid = jnp.sum((pairs[:, :, 0] >= 0) & (pairs[:, :, 1] >= 0))
+      return state, (loss, valid, stats)
+
+    steps = jnp.arange(pairs_all.shape[0], dtype=jnp.int32)
+    state, (losses, valids, stats) = jax.lax.scan(
+        body, state, (steps, pairs_all))
+    return state, losses, jnp.sum(valids), jnp.sum(stats, axis=0)
+
+  # -- host driver ----------------------------------------------------------
+
+  def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
+    """One epoch; ``state`` must be mesh-replicated and is DONATED.
+    ``stats.seeds`` counts valid seed EDGES; accuracy reads 0 (the
+    unsupervised objective has no accuracy)."""
+    from ..loader.fused import EpochStats
+    flat = np.stack(list(self._batcher))           # [S, P*B, 2|3]
+    pairs = flat.reshape(-1, self.num_parts, self.batch_size,
+                         flat.shape[-1])
+    self._epoch_idx += 1
+    key = jax.random.fold_in(self._base_key, self._epoch_idx)
+    pairs_dev = jax.device_put(
+        pairs.astype(np.int32),
+        NamedSharding(self.mesh, P(None, self.axis)))
+    state, losses, valid, stats = self._compiled(
+        state, pairs_dev, key, self.sampler._arrays())
+    self.sampler._accumulate_stats(stats)
+    return state, EpochStats(losses, jnp.zeros((), jnp.int32), valid)
