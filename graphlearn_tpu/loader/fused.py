@@ -28,6 +28,7 @@ torch loader cannot fuse Python-loop epochs into one graph.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Optional, Sequence, Tuple
 
 import jax
@@ -48,6 +49,57 @@ from ..utils.profiling import metrics
 from .link_loader import EdgeSeedBatcher
 from .node_loader import SeedBatcher
 from .transform import Batch, _gather_labels
+
+
+@contextlib.contextmanager
+def _fresh_compile():
+  """Force any compile inside the block to bypass the persistent
+  compilation cache.  Executing a DESERIALIZED cached fused-epoch
+  executable crashes the tunneled TPU worker ("TPU device error")
+  while the same program compiled fresh runs clean — reproduced both
+  ways back to back (see benchmarks/README).  Unlike the cache DIR
+  (latched at the first compile of the process, after which config
+  updates are ignored), the enable flag is consulted at EVERY
+  compile, and it is not part of the jit trace context, so toggling
+  it here neither retraces nor invalidates already-compiled epochs.
+  The flag's own State context manager scopes the flip to THIS
+  thread (a global jax.config.update here could re-enable the cache
+  under another thread's in-flight guarded compile, or clobber a
+  caller's own flag context on exit).  The State object lives in
+  jax._src (no stability guarantee); if a jax upgrade moves it, fall
+  back to the public-but-global update so the crash-avoidance bypass
+  degrades to process-wide instead of silently dying."""
+  try:
+    from jax._src.config import enable_compilation_cache as _state
+  except ImportError:
+    _state = None
+  if _state is not None:
+    with _state(False):
+      yield
+    return
+  prev = jax.config.jax_enable_compilation_cache
+  jax.config.update('jax_enable_compilation_cache', False)
+  try:
+    yield
+  finally:
+    jax.config.update('jax_enable_compilation_cache', prev)
+
+
+def _uncached_jit(fn, **jit_kwargs):
+  """`jax.jit` whose every call runs under `_fresh_compile` — the
+  bypass is attached to the callable ONCE, so no dispatch site can
+  forget it.  Compiles (the first call and the donated-layout
+  recompile on the second) skip the persistent cache; in-memory
+  executable hits are unaffected.  Use this for any products-scale
+  scan program."""
+  compiled = jax.jit(fn, **jit_kwargs)
+
+  def call(*args, **kwargs):
+    with _fresh_compile():
+      return compiled(*args, **kwargs)
+
+  call.jitted = compiled         # escape hatch for lower()/inspection
+  return call
 
 
 class EpochStats:
@@ -157,8 +209,10 @@ class _SupervisedScanEpoch:
       raise ValueError('evaluate() got an empty split')
     ev = SeedBatcher(ids, self.batch_size, shuffle=False)
     seeds = np.stack(list(ev))
-    # disjoint from train folds (epochs count up from 1)
-    key = jax.random.fold_in(self._base_key, 2**31 - 1)
+    # eval keys live in their own fold DOMAIN (base -> 0 -> 1); train
+    # keys are base -> epoch with epoch >= 1, so no epoch-counter
+    # value (wraparound included) can alias a train sampling key
+    key = jax.random.fold_in(jax.random.fold_in(self._base_key, 0), 1)
     correct, total = self._compiled_eval(params, jnp.asarray(seeds), key,
                                          self._dev, pallas_enabled())
     return float(int(correct) / max(int(total), 1))
@@ -249,9 +303,10 @@ class FusedEpoch(_SupervisedScanEpoch):
         self._extract_with(step_apply), tx, self.batch_size)
     self._eval_step = make_extracted_eval_step(
         self._extract_with(apply_fn), self.batch_size)
-    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
+    self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
                              static_argnums=(4,))
-    self._compiled_eval = jax.jit(self._eval_fn, static_argnums=(4,))
+    self._compiled_eval = _uncached_jit(self._eval_fn,
+                                        static_argnums=(4,))
 
   @staticmethod
   def _extract_with(apply):
@@ -377,9 +432,10 @@ class FusedHeteroEpoch(_SupervisedScanEpoch):
         self._extract_with(step_apply), tx, self.batch_size)
     self._eval_step = make_extracted_eval_step(
         self._extract_with(apply_fn), self.batch_size)
-    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
+    self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
                              static_argnums=(4,))
-    self._compiled_eval = jax.jit(self._eval_fn, static_argnums=(4,))
+    self._compiled_eval = _uncached_jit(self._eval_fn,
+                                        static_argnums=(4,))
 
   def _extract_with(self, apply):
     it = self.input_type
@@ -505,7 +561,7 @@ class FusedLinkEpoch:
     from ..models.train import make_unsupervised_step
     step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
     self._step = make_unsupervised_step(step_apply, tx)
-    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,),
+    self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
                              static_argnums=(6,))
 
   def __len__(self) -> int:
@@ -598,8 +654,12 @@ class FusedLinkEpoch:
       dsts.append(c)
       if lab is not None:
         # reference +1 shift (loader/link_loader.py:146-186): user
-        # labels move up so 0 means "sampled negative"
-        labs.append(lab + 1 if self.neg.is_binary() else lab)
+        # labels move up so 0 means "sampled negative"; only VALID
+        # pair slots shift — the batcher zero-pads the tail, and a
+        # padded slot must not read as a phantom positive to metadata
+        # consumers that skip edge_label_mask
+        labs.append(np.where((r >= 0) & (c >= 0), lab + 1, 0)
+                    if self.neg.is_binary() else lab)
     srcs = jnp.asarray(np.stack(srcs))
     dsts = jnp.asarray(np.stack(dsts))
     labels = (jnp.asarray(np.stack(labs).astype(np.int32))

@@ -99,8 +99,11 @@ class LinkLoader(PrefetchingLoader):
     if lab is not None and self.neg_sampling is not None \
         and self.neg_sampling.is_binary():
       # Reference +1 shift: user labels move up, 0 = negative class
-      # (`loader/link_loader.py:146-186`).
-      lab = lab + 1
+      # (`loader/link_loader.py:146-186`).  Only VALID pair slots
+      # shift — the batcher zero-pads the tail, and a padded slot must
+      # not read as a phantom positive to metadata consumers that skip
+      # edge_label_mask (same contract as FusedLinkEpoch.run).
+      lab = np.where((r >= 0) & (c >= 0), lab + 1, 0)
     out = self.sampler.sample_from_edges(
         EdgeSamplerInput(row=r, col=c, label=lab,
                          input_type=self.input_type,

@@ -35,6 +35,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..loader.fused import _uncached_jit
 from ..models.train import TrainState
 from .dist_data import DistDataset
 from .dist_sampler import (DistLinkNeighborSampler, DistNeighborSampler,
@@ -116,7 +117,11 @@ class FusedDistEpoch:
                                             self.batch_size, self.mesh,
                                             axis)
     self._dist_step = self.sampler.step_for_batch(self.batch_size)
-    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,))
+    # _uncached_jit: never serve this program from the persistent
+    # compilation cache — deserialized big scan programs crash the
+    # tunneled TPU worker, and CPU AOT entries cross-loaded between
+    # target-feature sets SIGILL (see loader.fused._fresh_compile)
+    self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,))
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -243,7 +248,8 @@ class FusedDistLinkEpoch:
                                               axis)
     self._dist_step = self.sampler.step_for_pairs(
         self.batch_size, self.pairs.shape[1])
-    self._compiled = jax.jit(self._epoch_fn, donate_argnums=(0,))
+    self._compiled = _uncached_jit(       # see FusedDistEpoch note
+        self._epoch_fn, donate_argnums=(0,))
 
   def __len__(self) -> int:
     return len(self._batcher)
