@@ -1,5 +1,5 @@
 """Headline benchmark: GraphSAGE epoch time + sampling throughput
-+ distributed (virtual-mesh) loader section.
++ distributed (virtual-mesh) loader section + fused whole-epoch number.
 
 PRIMARY metric (BASELINE.json: "GraphSAGE epoch time on
 ogbn-products"): wall-clock of one full training epoch — seed shuffle
@@ -7,32 +7,47 @@ ogbn-products"): wall-clock of one full training epoch — seed shuffle
 `examples/train_sage_ogbn_products.py:16`) -> feature/label collation
 -> fused train step — on an ogbn-products-scale synthetic graph (2.45M
 nodes, ~61M directed edges, 100-dim features, ~8% train split).
+When the dedicated fused session lands, the HEADLINE `value` is the
+whole-epoch `FusedEpoch` time (the same epoch as ONE XLA program);
+the per-batch epoch median is always reported alongside.
 
 SECONDARY: the reference's "Sampled Edges per secs" definition
-(`benchmarks/api/bench_sampler.py:46-54`), and a `dist` section — a
-P=8 virtual-CPU-mesh distributed loader epoch (edges/sec/chip,
-padding-waste %, drop rate from the exchange telemetry; labeled
-"virtual CPU mesh — relative only", the intent of reference
+(`benchmarks/api/bench_sampler.py:46-54`), a feature-gather roofline
+phase (`achieved_hbm_frac` — bytes moved / HBM peak, v5e 819 GB/s),
+and a `dist` section — a P=8 virtual-CPU-mesh distributed loader epoch
+(edges/sec/chip, padding-waste %, drop rate from exchange telemetry;
+labeled "virtual CPU mesh — relative only", the intent of reference
 `benchmarks/api/bench_dist_neighbor_loader.py`).
+
+INDESTRUCTIBLE-ARTIFACT CONTRACT (r3 shipped rc=124 with NO number
+because the aggregate printed only once, at the very end): the full
+cumulative aggregate JSON line — same schema, updated stats — is
+printed after EVERY completed phase (each primary session, the dist
+section, the fused session).  The driver's last-JSON-line salvage
+therefore always finds the newest complete headline no matter where
+the process is killed.  The default total budget is 1200 s (was
+3000 s, which overran the driver's wall); phases run in the order
+primary -> dist -> fused -> extra primary sessions and each clamps
+itself to the remaining budget.
 
 Honest variance reporting: the tunnel to the chip swings wall-clock
 several-fold BETWEEN processes, and within a process only the first
 timed burst reflects true device throughput (benchmarks/README,
-"first-burst validity").  The harness runs ``GLT_BENCH_SESSIONS``
-(default 5) fresh subprocess sessions and reports min/median/max; the
-headline `value` is the MEDIAN epoch time.  Session 0 runs the full
-protocol (warmup epoch + measured epoch); later sessions run a FAST
-protocol (3-batch warmup covers the compile, then one measured epoch)
-so a slow-tunnel day still yields >= 3 sessions inside the budget
-(r2's harness lost 3 of 5 sessions to one 480 s timeout).
+"first-burst validity").  Sessions are fresh subprocesses; the
+per-batch headline is the MEDIAN over completed sessions (min/med/max
+reported).  Every session runs the FAST protocol (3-batch warmup
+covers the compile, then one measured epoch): measured per-session
+cost is ~410 s, dominated by the fixed ~1 GB feature device_put over
+the tunnel, so a "full" warmup epoch buys nothing but risk.
 
 ``vs_baseline`` divides a NOMINAL single-A100 epoch time of 2.0 s into
-the median (the reference publishes figures, not numbers — 2.0 s is a
-mid-range read of public GLT-class A100 pipelines on this workload;
+the headline (the reference publishes figures, not numbers — 2.0 s is
+a mid-range read of public GLT-class A100 pipelines on this workload;
 BASELINE.md documents the absence of published values).  > 1.0 means
 faster than that nominal A100.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line per completed phase; the LAST line is the
+artifact: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 import json
 import os
@@ -51,6 +66,9 @@ from benchmarks.common import (NUM_NODES, build_graph,  # noqa: E402
 BASELINE_EPOCH_SECS = 2.0
 #: round-1 normalization constant for the secondary sampling metric
 BASELINE_EDGES_PER_SEC = 100e6
+#: TPU v5e peak HBM bandwidth, bytes/s (public spec; the roofline
+#: denominator for `achieved_hbm_frac`)
+HBM_PEAK = {'tpu': 819e9}
 
 FANOUT = (15, 10, 5)
 BATCH = 1024
@@ -64,28 +82,38 @@ DIST_NODES = 500_000
 DIST_DIM = 64
 
 
+def _sample_window_bytes(batch, fanouts):
+  """Analytic upper bound on HBM bytes the multihop sampler's window
+  gathers move per batch: each hop gathers a ``W = default_window(k)``
+  wide int32 window of `indices` per frontier node (`ops/neighbor.py`
+  — the exact-without-replacement path; hub nodes with ``deg > W``
+  read only k draws, so this is an upper bound).  The same
+  bytes-over-peak accounting as the Pallas window writeup
+  (`ops/pallas_gather.py:26-42`)."""
+  from graphlearn_tpu.ops.neighbor import default_window
+  frontier, total = batch, 0
+  for k in fanouts:
+    total += frontier * default_window(k) * 4
+    frontier *= k
+  return total
+
+
 def worker(fast: bool, fused_only: bool = False):
   """One fresh-session measurement: epoch time first (the primary,
-  measured on this process's first burst), then sampling throughput.
-  ``fast`` warms up on 3 batches (covers the compile — every batch
-  shares one static shape) instead of a full epoch.  ``fused_only``
-  is the DEDICATED fused session: same setup, then only the
-  whole-epoch `FusedEpoch` measurement — it gets its own session
-  because its fresh compile (~250 s, see below) cannot share a 600 s
-  budget with the primary phases."""
+  measured on this process's first burst), then sampling throughput,
+  then the feature-gather roofline phase.  ``fused_only`` is the
+  DEDICATED fused session: same setup, then only the whole-epoch
+  `FusedEpoch` measurement — it gets its own session because its
+  fresh compile (~250 s) cannot share a 600 s budget with the primary
+  phases.  (The fused program itself always bypasses the persistent
+  compilation cache — `loader.fused._uncached_jit`, pinned in the
+  class after r3's poisoned-cache TPU-worker crashes — so enabling
+  the /tmp cache here only speeds the small setup compiles.)"""
   import jax
-  if not fused_only:
-    # NO compilation cache in the fused session — not even for the
-    # setup compiles: jax initializes the cache once, at the FIRST
-    # compile, and later config updates are ignored, so setting the
-    # dir to None just before the fused compile would be a no-op and
-    # the fused program would still load the poisoned cached
-    # executable (see below)
-    try:
-      jax.config.update('jax_compilation_cache_dir',
-                        '/tmp/glt_jax_cache')
-    except Exception:
-      pass
+  try:
+    jax.config.update('jax_compilation_cache_dir', '/tmp/glt_jax_cache')
+  except Exception:
+    pass
   if '--cpu' in sys.argv:
     jax.config.update('jax_platforms', 'cpu')
   import jax.numpy as jnp
@@ -109,30 +137,42 @@ def worker(fast: bool, fused_only: bool = False):
   train_idx = rng.permutation(n)[:max(n // 12, 1)]
   loader = NeighborLoader(ds, list(FANOUT), train_idx, batch_size=BATCH,
                           shuffle=True, seed=0)
+  platform = jax.devices()[0].platform
+  # the ~1 GB feature upload happens OUTSIDE the compile timing — it
+  # is transfer, not compilation, and it dominates the session cost
+  feat = ds.node_features
+  feat.lazy_init()
+  feat.hot_tier.block_until_ready()
+  # sampler-pipeline compile = wall of the very first batch
+  t0 = time.perf_counter()
+  it0 = iter(loader)
+  first_batch = next(it0)
+  first_batch.x.block_until_ready()
+  sampler_compile = time.perf_counter() - t0
   model = GraphSAGE(hidden_features=256, out_features=CLASSES,
                     num_layers=3)
   tx = optax.adam(3e-3)
   state, apply_fn = create_train_state(
-      model, jax.random.key(0), next(iter(loader)), tx)
+      model, jax.random.key(0), first_batch, tx)
 
   if fused_only:
-    result = {'mode': 'fused-session',
-              'platform': jax.devices()[0].platform}
+    result = {'mode': 'fused-session', 'platform': platform}
     try:
-      # compiles FRESH, never from the /tmp cache (never configured in
-      # this process — see the fused_only gate at the top): executing
-      # the DESERIALIZED cached fused program crashes the tunneled TPU
-      # worker ("TPU device error"), while the same program compiled
-      # from scratch runs clean — reproduced both ways back to back.
       from graphlearn_tpu.loader import FusedEpoch
       fused = FusedEpoch(ds, list(FANOUT), train_idx, apply_fn, tx,
                          batch_size=BATCH, shuffle=True, seed=0,
                          remat=True)
       # two warm runs: first compile, second the donated-input
-      # recompile; the third run is the steady state
+      # recompile; the third run is the steady state.  Both compile
+      # walls are REPORTED (VERDICT r3 #4: compile time is a real
+      # deployment cost and was untracked).
+      compile_secs = []
       for _ in range(2):
+        t0 = time.perf_counter()
         state, _ = fused.run(state)
-      jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+        jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+        compile_secs.append(round(time.perf_counter() - t0, 1))
+      result['fused_compile_secs'] = compile_secs
       t0 = time.perf_counter()
       state, _ = fused.run(state)
       jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
@@ -144,26 +184,29 @@ def worker(fast: bool, fused_only: bool = False):
 
   step = make_supervised_step(apply_fn, tx, BATCH)
 
-  # warmup covers compile; the next epoch is THE measured first burst
-  if fast:
-    for i, batch in enumerate(loader):
-      state, loss, _ = step(state, batch)
-      if i >= 2:
-        break
-    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    epochs = (1,)
-  else:
-    epochs = (0, 1)
-  epoch_secs = None
-  for epoch in epochs:
-    t0 = time.perf_counter()
-    for batch in loader:
-      state, loss, _ = step(state, batch)
-    jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
-    if epoch == 1 or fast:
-      epoch_secs = time.perf_counter() - t0
+  # step compile = wall of the first train-step call; together with
+  # the sampler compile above this is the per-batch pipeline's full
+  # compile cost (VERDICT r3 #4: compile time tracked in the artifact)
+  t0 = time.perf_counter()
+  state, loss, _ = step(state, first_batch)
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  compile_secs = sampler_compile + time.perf_counter() - t0
+  # warmup: two more batches cover the donated-layout recompile;
+  # the next epoch is THE measured first burst
+  for i, batch in enumerate(it0):
+    state, loss, _ = step(state, batch)
+    if i >= 1:
+      break
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
 
-  # secondary: sampling-only throughput, reference metric definition
+  t0 = time.perf_counter()
+  for batch in loader:
+    state, loss, _ = step(state, batch)
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  epoch_secs = time.perf_counter() - t0
+
+  # secondary: sampling-only throughput, reference metric definition,
+  # plus the window-bytes roofline fraction
   iters = 10 if fast else SAMPLE_ITERS
   sampler = NeighborSampler(ds.get_graph(), FANOUT, seed=0)
   srng = np.random.default_rng(1)
@@ -180,11 +223,57 @@ def worker(fast: bool, fused_only: bool = False):
   dt = time.perf_counter() - t0
   edges = int(sum((o.edge_mask.sum() for o in outs),
                   jnp.zeros((), jnp.int32)))
+  sample_hbm = (iters * _sample_window_bytes(BATCH, FANOUT) / dt
+                / HBM_PEAK[platform] if platform in HBM_PEAK else None)
+
+  # roofline phase: feature-store row gather as ONE long program (a
+  # fori_loop of random-row gathers) so the tunnel's
+  # post-first-burst dispatch overhead (~0.1-0.3 s PER program,
+  # benchmarks/README) amortizes against >= 0.7 s of device work at
+  # peak — N small dispatches here measured the tunnel, not HBM.
+  # Still a lower bound (the dispatch overhead is inside the wall).
+  gather_hbm = gather_gbps = None
+  if platform in HBM_PEAK:
+    giters, grows = 1500, 1 << 20
+    from graphlearn_tpu.ops.pallas_gather import gather_rows
+
+    @jax.jit
+    def gather_burst(table, key):
+      # ids are DENSE ASCENDING (random start, stride 2) — the hot
+      # path's actual pattern: the sampler's node table is
+      # sorted-unique (sort_locality), ~40% dense at products scale,
+      # and gathered through `gather_rows` (the feature store's
+      # primitive).  Fully-random ids measured 37 GB/s on this table
+      # (true random-row bandwidth) vs the sorted pattern's streaming
+      # rate — report the pattern the store actually sees.
+      def body(i, acc):
+        k = jax.random.fold_in(key, i)
+        start = jax.random.randint(k, (), 0, table.shape[0] - 2 * grows)
+        ids = start + 2 * jnp.arange(grows, dtype=jnp.int32)
+        return acc + gather_rows(table, ids).sum(dtype=jnp.float32)
+      return jax.lax.fori_loop(0, giters, body, jnp.float32(0))
+
+    hot = feat.hot_tier
+    gather_burst(hot, jax.random.key(1)).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    gather_burst(hot, jax.random.key(2)).block_until_ready()
+    gdt = time.perf_counter() - t0
+    gather_bytes = giters * grows * DIM * 4
+    gather_hbm = gather_bytes / gdt / HBM_PEAK[platform]
+    gather_gbps = gather_bytes / gdt / 1e9
+
   print(json.dumps({'epoch_secs': epoch_secs,
                     'edges_per_sec': edges / dt,
+                    'compile_secs': round(compile_secs, 1),
+                    'sample_hbm_frac': (round(sample_hbm, 4)
+                                        if sample_hbm else None),
+                    'gather_hbm_frac': (round(gather_hbm, 4)
+                                        if gather_hbm else None),
+                    'gather_gbps': (round(gather_gbps, 1)
+                                    if gather_gbps else None),
                     'steps': len(loader),
-                    'mode': 'fast' if fast else 'full',
-                    'platform': jax.devices()[0].platform}),
+                    'mode': 'fast',
+                    'platform': platform}),
         flush=True)
 
 
@@ -216,8 +305,10 @@ def dist_worker():
                               shuffle=True, mesh=make_mesh(DIST_PARTS),
                               seed=0)
   it = iter(loader)
+  t0 = time.perf_counter()
   b = next(it)                      # compile + warm
   b.x.block_until_ready()
+  compile_secs = time.perf_counter() - t0
   edges = 0
   t0 = time.perf_counter()
   n_batches = 0
@@ -234,6 +325,7 @@ def dist_worker():
       'label': 'virtual CPU mesh - relative only',
       'num_parts': DIST_PARTS, 'batch': BATCH, 'fanout': list(FANOUT),
       'num_nodes': DIST_NODES, 'batches': n_batches,
+      'compile_secs': round(compile_secs, 1),
       'edges_per_sec_per_chip': round(edges / dt / DIST_PARTS, 1),
       'seeds_per_sec': round(n_batches * BATCH * DIST_PARTS / dt, 1),
       'padding_waste_pct': round(waste, 2),
@@ -357,6 +449,91 @@ def _run_dist_section(timeout: int):
   return {'error': f'dist section {cause}: {stderr[-500:]}'}
 
 
+def _run_envelope_row(num_parts: int, batch: int, timeout: int):
+  """One P-row of the scale envelope (VERDICT r3 #6): spawn the tiny
+  `bench_dist_loader.py --envelope-worker` config on a ``num_parts``
+  virtual mesh and parse its JSON line (None on failure/timeout)."""
+  script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'benchmarks', 'bench_dist_loader.py')
+  cmd = [sys.executable, script, '--envelope-worker', '--num-parts',
+         str(num_parts), '--mode', 'homo', '--batch', str(batch),
+         '--nodes', '20000']
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         env=cpu_mesh_env(num_parts), timeout=timeout)
+  except subprocess.TimeoutExpired:
+    return None
+  for ln in reversed((out.stdout or '').strip().splitlines()):
+    if ln.startswith('{'):
+      try:
+        return json.loads(ln)
+      except json.JSONDecodeError:
+        continue
+  return None
+
+
+def _aggregate(results, fused_res, dist):
+  """The full artifact schema from whatever phases have completed so
+  far.  The HEADLINE `value` is the fused whole-epoch time when the
+  fused session has landed, else the per-batch epoch median; the
+  metric string names which.  Printed after EVERY completed phase —
+  the last JSON line on stdout is always the newest complete
+  aggregate, so a kill at ANY point leaves a parseable artifact."""
+  ep = sorted(r['epoch_secs'] for r in results)
+  es = sorted(r['edges_per_sec'] for r in results)
+  cs = sorted(r['compile_secs'] for r in results if 'compile_secs' in r)
+  fu = ([fused_res['epoch_secs_fused']]
+        if fused_res and 'epoch_secs_fused' in fused_res else [])
+  med_ep = statistics.median(ep) if ep else None
+  med_es = statistics.median(es) if es else None
+  platform = (results[0]['platform'] if results
+              else (fused_res or {}).get('platform', '?'))
+  shape = (f'products-scale synthetic, fanout {list(FANOUT)}, '
+           f'batch {BATCH}, {platform}')
+  if fu:
+    metric = f'graphsage_fused_epoch_secs ({shape})'
+    value = round(fu[0], 4)
+  elif med_ep is not None:
+    metric = f'graphsage_epoch_secs ({shape})'
+    value = round(med_ep, 4)
+  else:
+    metric = f'graphsage_epoch_secs ({shape})'
+    value = None
+  hbm = {}
+  for k in ('sample_hbm_frac', 'gather_hbm_frac'):
+    v = [r[k] for r in results if r.get(k) is not None]
+    if v:
+      hbm[k.replace('_hbm_frac', '')] = round(statistics.median(v), 4)
+  return {
+      'metric': metric,
+      'value': value,
+      'unit': 's',
+      'vs_baseline': (round(BASELINE_EPOCH_SECS / value, 4)
+                      if value else None),
+      'epoch_secs_min_med_max': ([round(ep[0], 4), round(med_ep, 4),
+                                  round(ep[-1], 4)] if ep else None),
+      'epoch_vs_baseline': (round(BASELINE_EPOCH_SECS / med_ep, 4)
+                            if med_ep else None),
+      'sampled_edges_per_sec_M_min_med_max': (
+          [round(es[0] / 1e6, 1), round(med_es / 1e6, 1),
+           round(es[-1] / 1e6, 1)] if es else None),
+      'sampling_vs_a100_nominal': (round(med_es / BASELINE_EDGES_PER_SEC,
+                                         2) if med_es else None),
+      'fused_epoch_secs': round(fu[0], 4) if fu else None,
+      'fused_vs_baseline': (round(BASELINE_EPOCH_SECS / fu[0], 4)
+                            if fu else None),
+      'fused_compile_secs': (fused_res or {}).get('fused_compile_secs'),
+      'fused_error': (fused_res or {}).get('fused_error'),
+      'compile_secs_med': (round(statistics.median(cs), 1)
+                           if cs else None),
+      'achieved_hbm_frac': hbm or None,
+      'sessions': len(results),
+      'session_modes': [r['mode'] for r in results],
+      'steps_per_epoch': results[0]['steps'] if results else None,
+      'dist': dist,
+  }
+
+
 def main():
   sessions = int(os.environ.get('GLT_BENCH_SESSIONS', 5))
   build_graph_csr(NUM_NODES)      # warm the /tmp graph+CSR caches once
@@ -364,15 +541,12 @@ def main():
   # ~1 GB feature device_put over the tunnel — dominates); 600 leaves
   # headroom for load without letting a wedged chip eat the budget
   session_timeout = int(os.environ.get('GLT_BENCH_SESSION_TIMEOUT', 600))
-  # fast sessions do LESS WORK, not less time: the fixed overhead is
-  # identical, so a shorter timeout would just re-lose them on slow
-  # days (r2's failure mode)
-  fast_timeout = session_timeout
-  # hard wall for the whole harness: tunnel-slow days must yield a
-  # degraded (fewer-session) number, never a timeout with NO number;
-  # sized for 3 x 600 s slow-day sessions + the fused session + the
-  # dist phase (fast days fit all 5 primary sessions instead)
-  total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 3000))
+  # hard wall for the whole harness, sized INSIDE the driver's wall
+  # (r3's 3000 s default overran it and shipped nothing): one primary
+  # session + the dist phase + the fused session fit a typical day
+  # (~410 + ~330 + ~450 s); slow days degrade phase by phase, each
+  # one leaving a fresh cumulative artifact line behind
+  total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 1200))
   # measured ~5.5 min on this box (compile dominates); the wall keeps
   # a wedged mesh from eating the whole budget, not a perf target
   dist_timeout = int(os.environ.get('GLT_BENCH_DIST_TIMEOUT', 600))
@@ -382,81 +556,82 @@ def main():
   def budget_left():
     return total_budget - (time.time() - t_start)
 
-  results = []
+  results, fused_res, dist = [], None, None
+
+  def emit():
+    """The indestructible-artifact contract: full cumulative
+    aggregate after every completed phase."""
+    if results or fused_res or dist:
+      print(json.dumps(_aggregate(results, fused_res, dist)),
+            flush=True)
+
+  # phase 1 — one primary session (epoch + sampling + roofline).
+  # Retry up to 3 attempts while nothing has landed and the budget
+  # still leaves room for the later phases to salvage something.
   attempts = 0
-  # session 0 full, the rest fast; keep attempting (within budget)
-  # until the floor is met — never fewer because one timed out.  The
-  # floor respects an EXPLICIT lower GLT_BENCH_SESSIONS (smoke runs).
-  floor = min(3, sessions)
-  while attempts < sessions + 3 and (len(results) < sessions
-                                     or len(results) < floor):
-    fast = attempts > 0
-    tmo = fast_timeout if fast else session_timeout
-    # the session floor is the hard deliverable (r2 shipped 2): only
-    # once it's met does the budget guard start reserving the fused
-    # session and the dist phase (which itself self-clamps to the
-    # remaining budget).  The wall also binds with ZERO results — a
-    # wedged chip must fail within ~the budget, not after sessions+3
-    # timeouts.
-    reserve = (dist_timeout + fused_timeout
-               if len(results) >= floor else 60)
-    if attempts > 0 and budget_left() < tmo + reserve:
-      print(f'budget: stopping after {len(results)} sessions '
-            f'({attempts} attempts)', file=sys.stderr)
+  while not results and attempts < 3:
+    tmo = int(min(session_timeout, max(budget_left() - 60, 120)))
+    if budget_left() < 180:
+      print(f'budget: giving up on primary after {attempts} attempts',
+            file=sys.stderr)
       break
-    if attempts >= sessions and len(results) >= 3:
-      break
-    r = _run_session(fast, tmo)
+    r = _run_session(True, tmo)
     attempts += 1
     if r is not None:
       results.append(r)
-  if not results:
-    raise SystemExit('all bench sessions failed')
+      emit()
 
-  # dedicated fused session (whole-epoch FusedEpoch, fresh compile —
-  # ~350-450 s): bonus, only with budget to spare beyond the dist
-  # phase; a failure or skip costs nothing but the fused stats
-  fused_res = None
-  # reserve a realistic dist-phase cushion (measured ~330 s) beyond
-  # the fused session itself: the bonus must never starve the dist
-  # numbers out of the artifact
-  if budget_left() > fused_timeout + 400:
-    fused_res = _run_session(True, fused_timeout, fused=True)
+  # phase 2 — dist section (CPU mesh; tunnel-independent)
+  if budget_left() > 90:
+    dist = _run_dist_section(
+        int(min(dist_timeout, max(budget_left() - 30, 60))))
+    emit()
+  else:
+    print(f'budget: skipping dist ({budget_left():.0f}s left)',
+          file=sys.stderr)
+
+  # phase 3 — dedicated fused session (whole-epoch FusedEpoch, fresh
+  # compile, ~350-450 s): lands the HEADLINE number
+  if budget_left() > 150:
+    fused_res = _run_session(
+        True, int(min(fused_timeout, max(budget_left() - 10, 120))),
+        fused=True)
+    emit()
   else:
     print(f'budget: skipping the fused session '
           f'({budget_left():.0f}s left)', file=sys.stderr)
 
-  dist = _run_dist_section(min(dist_timeout, max(int(budget_left()), 60)))
+  # opportunistic — per-P scale-envelope rows for the dist section
+  # (VERDICT r3 #6): P=16/64 homo exchange accounting; the full sweep
+  # (P<=128, hetero, chunked-SEAL) is
+  # `benchmarks/bench_dist_loader.py --capacity-sweep`
+  if isinstance(dist, dict) and 'error' not in dist \
+      and budget_left() > 300:
+    env_rows = []
+    for p_, bsz in ((16, 64), (64, 32)):
+      if budget_left() < 200:
+        break
+      r = _run_envelope_row(p_, bsz,
+                            int(min(280, max(budget_left() - 30, 60))))
+      if r is not None:
+        env_rows.append(r)
+    if env_rows:
+      dist['scale_envelope'] = env_rows
+      emit()
 
-  ep = sorted(r['epoch_secs'] for r in results)
-  es = sorted(r['edges_per_sec'] for r in results)
-  fu = ([fused_res['epoch_secs_fused']]
-        if fused_res and 'epoch_secs_fused' in fused_res else [])
-  med_ep = statistics.median(ep)
-  med_es = statistics.median(es)
-  print(json.dumps({
-      'metric': f'graphsage_epoch_secs (products-scale synthetic, '
-                f'fanout {list(FANOUT)}, batch {BATCH}, '
-                f'{results[0]["platform"]})',
-      'value': round(med_ep, 4),
-      'unit': 's',
-      'vs_baseline': round(BASELINE_EPOCH_SECS / med_ep, 4),
-      'epoch_secs_min_med_max': [round(ep[0], 4), round(med_ep, 4),
-                                 round(ep[-1], 4)],
-      'sampled_edges_per_sec_M_min_med_max': [
-          round(es[0] / 1e6, 1), round(med_es / 1e6, 1),
-          round(es[-1] / 1e6, 1)],
-      'sampling_vs_a100_nominal': round(med_es / BASELINE_EDGES_PER_SEC,
-                                        2),
-      'fused_epoch_secs': round(fu[0], 4) if fu else None,
-      'fused_vs_baseline': (round(BASELINE_EPOCH_SECS / fu[0], 4)
-                            if fu else None),
-      'fused_error': (fused_res or {}).get('fused_error'),
-      'sessions': len(results),
-      'session_modes': [r['mode'] for r in results],
-      'steps_per_epoch': results[0]['steps'],
-      'dist': dist,
-  }))
+  # phase 4 — extra primary sessions stabilize the per-batch median
+  # (fast days only; each one re-emits the cumulative aggregate)
+  while (len(results) < sessions and attempts < sessions + 3
+         and budget_left() > session_timeout * 0.75):
+    r = _run_session(True, int(min(session_timeout, budget_left())))
+    attempts += 1
+    if r is not None:
+      results.append(r)
+      emit()
+
+  if not (results or fused_res or dist):
+    raise SystemExit('all bench phases failed')
+  emit()                            # final (possibly repeated) line
 
 
 if __name__ == '__main__':

@@ -115,6 +115,84 @@ def subgraph_worker(num_parts: int, hop_chunk, batch: int,
        * max_degree)
 
 
+def envelope_worker(num_parts: int, mode: str, batch: int,
+                    num_nodes: int, epochs: int = 3):
+  """Scale-envelope probe at ``num_parts`` VIRTUAL devices (VERDICT r3
+  #6: past P=32): a deliberately tiny workload — the point is the
+  PER-P exchange behavior (padding waste, drops, adaptive-slack
+  convergence), not throughput, since 64-128 virtual devices
+  oversubscribe this box's cores ~10x.  ``mode``: 'homo' (adaptive
+  slack, several epochs so the controller can walk), 'hetero'
+  (per-type exchanges, adaptive), 'seal' (chunked full-window
+  subgraph hop).  Prints ONE JSON line."""
+  import json
+  import time
+  import jax
+  from graphlearn_tpu.parallel import make_mesh
+  assert len(jax.devices()) == num_parts, len(jax.devices())
+  rows, cols = build_graph(num_nodes)
+  rng = np.random.default_rng(1)
+  mesh = make_mesh(num_parts)
+  out = {'metric': 'dist_scale_envelope', 'num_parts': num_parts,
+         'mode': mode, 'batch': batch, 'num_nodes': num_nodes}
+  if mode == 'seal':
+    from graphlearn_tpu.parallel import DistDataset, DistSubGraphLoader
+    ds = DistDataset.from_full_graph(num_parts, rows, cols,
+                                     num_nodes=num_nodes)
+    seeds = rng.integers(0, num_nodes, batch * num_parts * 2)
+    loader = DistSubGraphLoader(ds, [5, 5], seeds, batch_size=batch,
+                                shuffle=True, mesh=mesh,
+                                collect_features=False, seed=0,
+                                hop_chunk=256)
+    epochs = 1
+  elif mode == 'hetero':
+    from graphlearn_tpu.parallel import DistHeteroNeighborLoader
+    from graphlearn_tpu.parallel.dist_hetero import DistHeteroDataset
+    nu = num_nodes
+    ni = num_nodes // 2
+    ds = DistHeteroDataset.from_full_graph(
+        num_parts,
+        {('u', 'to', 'i'): (rows % nu, cols % ni),
+         ('i', 'rev', 'u'): (cols % ni, rows % nu)},
+        num_nodes_dict={'u': nu, 'i': ni})
+    seeds = rng.integers(0, nu, batch * num_parts * 2)
+    loader = DistHeteroNeighborLoader(ds, [5, 5], ('u', seeds),
+                                      batch_size=batch, shuffle=True,
+                                      mesh=mesh,
+                                      collect_features=False, seed=0,
+                                      exchange_slack='adaptive')
+  else:
+    from graphlearn_tpu.parallel import DistDataset, DistNeighborLoader
+    ds = DistDataset.from_full_graph(num_parts, rows, cols,
+                                     num_nodes=num_nodes)
+    seeds = rng.integers(0, num_nodes, batch * num_parts * 2)
+    loader = DistNeighborLoader(ds, [5, 5], seeds, batch_size=batch,
+                                shuffle=True, mesh=mesh,
+                                collect_features=False, seed=0,
+                                exchange_slack='adaptive')
+  t0 = time.perf_counter()
+  b = next(iter(loader))
+  jax.block_until_ready(b)
+  out['compile_secs'] = round(time.perf_counter() - t0, 1)
+  n_seeds = 0
+  t0 = time.perf_counter()
+  for _ in range(epochs):
+    for b in loader:
+      n_seeds += batch * num_parts
+  jax.block_until_ready(b)
+  dt = time.perf_counter() - t0
+  st = loader.sampler.exchange_stats(tick_metrics=False)
+  sent = st['dist.frontier.offered'] - st['dist.frontier.dropped']
+  out.update(
+      seeds_per_sec=round(n_seeds / dt, 1),
+      padding_waste_pct=round(
+          100.0 * (1 - sent / max(st['dist.frontier.slots'], 1)), 2),
+      drop_rate_pct=round(100.0 * st['dist.frontier.dropped']
+                          / max(st['dist.frontier.offered'], 1), 3),
+      slack_final=getattr(loader.sampler, 'exchange_slack', None))
+  print(json.dumps(out), flush=True)
+
+
 def capacity_sweep(quick: bool):
   import json
   fanout = [15, 10, 5]
@@ -151,6 +229,18 @@ def capacity_sweep(quick: bool):
         ['--subgraph-worker', '--num-parts', p, '--hop-chunk', chunk,
          '--batch', 32, '--nodes', sg_n],
         env=cpu_mesh_env(p))
+  # scale envelope past P=32 (VERDICT r3 #6): P=64/128 homo with
+  # adaptive slack, hetero and chunked-SEAL at P=64 — tiny shapes (the
+  # virtual devices oversubscribe the cores; the exchange accounting,
+  # not throughput, is the deliverable)
+  env_n = 20_000 if quick else 50_000
+  for p, mode, batch in ((64, 'homo', 64), (128, 'homo', 32),
+                         (64, 'hetero', 32), (64, 'seal', 8)):
+    run_in_fresh_process(
+        script,
+        ['--envelope-worker', '--num-parts', p, '--mode', mode,
+         '--batch', batch, '--nodes', env_n],
+        env=cpu_mesh_env(p))
 
 
 def main():
@@ -161,6 +251,8 @@ def main():
   ap.add_argument('--capacity-sweep', action='store_true')
   ap.add_argument('--capacity-worker', action='store_true')
   ap.add_argument('--subgraph-worker', action='store_true')
+  ap.add_argument('--envelope-worker', action='store_true')
+  ap.add_argument('--mode', default='homo')
   ap.add_argument('--slack', default='exact')
   ap.add_argument('--hop-chunk', default='none')
   ap.add_argument('--batch', type=int, default=1024)
@@ -185,6 +277,9 @@ def main():
   if args.subgraph_worker:
     chunk = None if args.hop_chunk == 'none' else int(args.hop_chunk)
     subgraph_worker(args.num_parts, chunk, args.batch, args.nodes)
+    return
+  if args.envelope_worker:
+    envelope_worker(args.num_parts, args.mode, args.batch, args.nodes)
     return
 
   import jax

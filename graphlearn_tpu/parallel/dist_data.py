@@ -152,6 +152,188 @@ def scatter_partition_rows(root, host_parts, subpath: str, fname: str,
   return out
 
 
+_SCAN_CHUNK = 1 << 22
+
+
+def partition_in_degree(root, subpath: str, num_nodes: int,
+                        num_parts: int) -> np.ndarray:
+  """Chunked in-degree (OLD id space) over every partition dir's
+  ``cols.npy`` — the host-local twin of the single-controller
+  ``np.bincount(concat(cols))`` hotness (`from_partition_dir`), so a
+  host-local and a single-controller load of the same tiered layout
+  produce THE SAME relabel.  mmap + fixed chunks keep RAM at
+  O(num_nodes) counts, never O(E) edges."""
+  from pathlib import Path
+  root = Path(root)
+  deg = np.zeros(num_nodes, np.int64)
+  for i in range(num_parts):
+    cols = np.load(root / f'part{i}' / subpath / 'cols.npy',
+                   mmap_mode='r')
+    for s in range(0, len(cols), _SCAN_CHUNK):
+      deg += np.bincount(np.asarray(cols[s:s + _SCAN_CHUNK]),
+                         minlength=num_nodes)
+  return deg
+
+
+def stack_partition_csr_rebucket(root, host_parts, subpath: str,
+                                 node_pb, old2new_src, old2new_dst,
+                                 bounds_src, counts_src, num_parts: int):
+  """Host-local CSR stacking for ``by_dst`` layouts: partition dirs
+  bucket edges by DST owner, so one src's out-edges are scattered
+  across ALL dirs — re-bucket them by SRC owner with chunked mmap
+  scans (the host-local twin of the reference's chunked re-bucketing,
+  `partition/base.py:218-290`).  Pass 1 counts edges per src
+  partition for the global pad width; pass 2 materializes only
+  ``host_parts``.  RAM stays O(this host's edges), never O(E)."""
+  from pathlib import Path
+  from ..utils.topo import coo_to_csr
+  root = Path(root)
+  node_pb = np.asarray(node_pb)
+  # pass 1 — per-src-partition edge counts over every dir
+  counts_e = np.zeros(num_parts, np.int64)
+  for i in range(num_parts):
+    rows_f = np.load(root / f'part{i}' / subpath / 'rows.npy',
+                     mmap_mode='r')
+    for s in range(0, len(rows_f), _SCAN_CHUNK):
+      chunk = np.asarray(rows_f[s:s + _SCAN_CHUNK])
+      counts_e += np.bincount(node_pb[chunk], minlength=num_parts)
+  max_edges = max(int(counts_e.max()), 1)
+  max_nodes = int(counts_src.max()) if num_parts else 0
+  pl = len(host_parts)
+  # pass 2 — ONE more scan over the files, each chunk bucketed into
+  # per-host-part accumulators (not one full scan per part: at IGBH
+  # scale with P=64 that multiplies tens of GB of reads by P)
+  part_of = {int(p): j for j, p in enumerate(host_parts)}
+  acc = [([], [], []) for _ in range(pl)]
+  for i in range(num_parts):
+    gdir = root / f'part{i}' / subpath
+    rows_f = np.load(gdir / 'rows.npy', mmap_mode='r')
+    cols_f = np.load(gdir / 'cols.npy', mmap_mode='r')
+    eids_f = np.load(gdir / 'eids.npy', mmap_mode='r')
+    for s in range(0, len(rows_f), _SCAN_CHUNK):
+      chunk = np.asarray(rows_f[s:s + _SCAN_CHUNK])
+      owner_c = node_pb[chunk]
+      cchunk = echunk = None
+      for p, j in part_of.items():
+        sel = owner_c == p
+        if sel.any():
+          if cchunk is None:
+            cchunk = np.asarray(cols_f[s:s + _SCAN_CHUNK])
+            echunk = np.asarray(eids_f[s:s + _SCAN_CHUNK])
+          acc[j][0].append(chunk[sel])
+          acc[j][1].append(cchunk[sel])
+          acc[j][2].append(echunk[sel])
+  indptr_s = np.zeros((pl, max_nodes + 1), np.int64)
+  indices_s = np.full((pl, max_edges), -1, np.int32)
+  eids_s = np.full((pl, max_edges), -1, np.int64)
+  for j, p in enumerate(host_parts):
+    rs, cs, es = acc[j]
+    rows = np.concatenate(rs) if rs else np.empty(0, np.int64)
+    cols = np.concatenate(cs) if cs else np.empty(0, np.int64)
+    eids = np.concatenate(es) if es else np.empty(0, np.int64)
+    local_rows = old2new_src[rows] - bounds_src[p]
+    iptr, idx, eid = coo_to_csr(local_rows, old2new_dst[cols],
+                                int(counts_src[p]), eids)
+    indptr_s[j, :len(iptr)] = iptr
+    indptr_s[j, len(iptr):] = iptr[-1]
+    indices_s[j, :len(idx)] = idx
+    eids_s[j, :len(eid)] = eid
+  return indptr_s, indices_s, eids_s
+
+
+def stack_mod_edge_features(root, host_parts, subpath: str,
+                            num_parts: int, num_edges: int):
+  """Host-local MOD-sharded edge-feature stacking: shard ``p`` row
+  ``r`` holds edge ``r * P + p`` (`build_dist_edge_feature`
+  semantics), built by scanning every partition dir's
+  ``edge_feat/{feats,ids}.npy`` and materializing only the rows whose
+  ``eid % P`` lands in ``host_parts`` — RAM is 1/num_hosts of the
+  table while file reads stay global (the layout lives on shared
+  storage, exactly like the reference's per-process `load_partition`
+  reads).  Returns a `DistFeature` or None."""
+  from pathlib import Path
+  root = Path(root)
+  part_set = {int(p): j for j, p in enumerate(host_parts)}
+  pl = len(host_parts)
+  rows_max = max(-(-num_edges // num_parts), 1)
+  shards = None
+  for i in range(num_parts):
+    d = root / f'part{i}' / subpath
+    if not (d / 'feats.npy').exists():
+      continue
+    ids = np.load(d / 'ids.npy')
+    feats = np.load(d / 'feats.npy', mmap_mode='r')
+    if shards is None:
+      de = feats.shape[1] if feats.ndim > 1 else 1
+      shards = np.zeros((pl, rows_max, de), feats.dtype)
+    owner = ids % num_parts
+    for p, j in part_set.items():
+      sel = owner == p
+      if sel.any():
+        vals = np.asarray(feats[sel])
+        shards[j, ids[sel] // num_parts] = (
+            vals if vals.ndim > 1 else vals[:, None])
+  if shards is None:
+    return None
+  return DistFeature(shards, np.arange(num_parts + 1, dtype=np.int64),
+                     mod_sharded=True)
+
+
+def tiered_local_feature(fs: np.ndarray, counts: np.ndarray,
+                         split_ratio: float, host_parts,
+                         bounds) -> 'DistFeature':
+  """Tier a host-local feature stack: slice each partition's hot rows
+  (hottest-first after the hotness relabel) into the HBM shard and
+  keep the FULL local stack as this host's cold tier.  ONE definition
+  shared by the homo and hetero host-local loaders — the rounding and
+  clamp must stay bit-identical to `build_dist_feature` or the
+  host-local/single-controller relabel parity breaks."""
+  hot_counts = np.ceil(counts * float(split_ratio)).astype(np.int64)
+  hot_max = max(int(hot_counts.max()), 1)
+  shards = np.zeros((len(host_parts), hot_max, fs.shape[-1]), fs.dtype)
+  for j, p in enumerate(host_parts):
+    shards[j, :hot_counts[p]] = fs[j, :hot_counts[p]]
+  return DistFeature(shards, bounds, hot_counts=hot_counts,
+                     cold_local=fs)
+
+
+def stack_partition_cache(root, host_parts, subpath: str, old2new,
+                          num_parts: int):
+  """Host-local offline-cache-plan stacking: every partition's cache
+  file is self-contained (its own REMOTE-hot rows), so each host reads
+  only its partitions' files; the pad width ``C`` comes from mmap'd
+  SHAPES across all partitions (the stacked arrays must agree
+  globally).  Returns ``(cache_ids [pl, C], cache_rows [pl, C, D])``
+  sorted by relabeled id, or ``(None, None)``."""
+  from pathlib import Path
+  root = Path(root)
+  sizes = []
+  for i in range(num_parts):
+    f = root / f'part{i}' / subpath / 'cache_ids.npy'
+    sizes.append(np.load(f, mmap_mode='r').shape[0] if f.exists() else 0)
+  cmax = max(sizes, default=0)
+  if cmax == 0:
+    return None, None
+  pl = len(host_parts)
+  ids_out = np.full((pl, cmax), CACHE_PAD_ID, np.int32)
+  rows_out = None
+  for j, p in enumerate(host_parts):
+    d = root / f'part{p}' / subpath
+    if not (d / 'cache_ids.npy').exists():
+      continue
+    cid = np.load(d / 'cache_ids.npy')
+    cfeat = np.load(d / 'cache_feats.npy')
+    if rows_out is None:
+      rows_out = np.zeros((pl, cmax, cfeat.shape[1]), cfeat.dtype)
+    new = old2new[cid].astype(np.int32)
+    order = np.argsort(new)
+    ids_out[j, :len(cid)] = new[order]
+    rows_out[j, :len(cid)] = cfeat[order]
+  if rows_out is None:
+    return None, None
+  return ids_out, rows_out
+
+
 def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
                      node_pb: np.ndarray, num_nodes: int,
                      edge_ids: Optional[np.ndarray] = None,
@@ -223,6 +405,12 @@ class DistFeature:
       `data/feature.py:174-206`): cold misses are host-gathered per
       batch and overlaid post-exchange (`DistNeighborSampler.
       _overlay_cold`).  None = fully HBM-resident.
+    cold_local: optional ``[len(host_parts), max_nodes, D]`` host-DRAM
+      stack holding only THIS HOST'S partitions' rows (local offsets)
+      — the multi-host form of the cold tier: each host keeps
+      1/num_hosts of the cold bytes and serves them at the OWNER via
+      the second-gather overlay (`dist_sampler.overlay_cold_owner`).
+      Mutually exclusive with ``cold_host``.
     cache_ids: optional ``[P, C]`` SORTED (relabeled) ids of remote
       rows partition ``p`` caches locally, ``CACHE_PAD_ID``-padded —
       the collective-era `cat_feature_cache`
@@ -233,7 +421,7 @@ class DistFeature:
 
   def __init__(self, shards, bounds, cache_ids=None, cache_rows=None,
                mod_sharded: bool = False, hot_counts=None,
-               cold_host=None):
+               cold_host=None, cold_local=None):
     self.shards = np.asarray(shards)
     self.bounds = np.asarray(bounds, dtype=np.int64)
     self.hot_counts = (np.asarray(hot_counts, np.int32)
@@ -241,6 +429,9 @@ class DistFeature:
                        else np.diff(self.bounds).astype(np.int32))
     self.cold_host = (np.asarray(cold_host)
                       if cold_host is not None else None)
+    self.cold_local = (np.asarray(cold_local)
+                       if cold_local is not None else None)
+    assert self.cold_host is None or self.cold_local is None
     self.cache_ids = (np.asarray(cache_ids, np.int32)
                       if cache_ids is not None else None)
     self.cache_rows = (np.asarray(cache_rows)
@@ -259,7 +450,7 @@ class DistFeature:
 
   @property
   def is_tiered(self) -> bool:
-    return self.cold_host is not None
+    return self.cold_host is not None or self.cold_local is not None
 
 
 def build_feature_cache(cache_ids_old, cache_feats, old2new, num_parts):
@@ -436,9 +627,14 @@ class DistDataset:
     `jax.make_array_from_single_device_arrays` (the sampler's
     host-local put).  At IGBH scale this is what keeps per-host RAM
     at ``1/num_hosts`` of the dataset instead of all of it.  Pass
-    `multihost.host_partition_ids(mesh)`.  Host-local constraints
-    (v1): untiered only, no edge features, the offline cache plan is
-    not applied.
+    `multihost.host_partition_ids(mesh)`.  The host-local arm serves
+    the FULL composition (reference parity `data/feature.py:174-206`
+    + `partition/base.py:502-647`): tiered stores (``split_ratio <
+    1`` keeps only hot rows in HBM; each host's cold rows stay in its
+    own DRAM and are owner-served per batch,
+    `dist_sampler.overlay_cold_owner`), edge features (mod-sharded,
+    built host-locally), the offline cache plan, and ``by_dst``
+    layouts (chunked re-bucketing).
     """
     if host_parts is not None:
       return cls._from_partition_dir_host_local(
@@ -500,47 +696,38 @@ class DistDataset:
                                      host_parts) -> 'DistDataset':
     """Materialize only ``host_parts`` (see `from_partition_dir`).
 
-    Global quantities (relabel, bounds, padding widths) come from the
-    tiny per-layout metadata — ``node_pb.npy`` and mmap'd array
-    SHAPES — never from other hosts' tensors.
+    Global quantities (relabel, bounds, padding widths, hotness) come
+    from the tiny per-layout metadata — ``node_pb.npy``, chunked mmap
+    scans, and mmap'd array SHAPES — never from other hosts' tensors.
     """
     import json as _json
     from pathlib import Path
     root = Path(root)
-    if split_ratio < 1.0:
-      raise NotImplementedError(
-          'host-local loading is untiered (v1): the cold overlay runs '
-          'at the REQUESTER, which would need every remote '
-          "partition's cold rows in local DRAM — the very thing "
-          'host_parts avoids.  Serve beyond-HBM tables via more hosts '
-          'or single-controller from_partition_dir(split_ratio=...).')
     with open(root / 'META.json') as f:
       meta = _json.load(f)
     if meta['hetero']:
-      raise NotImplementedError('host-local loading is homogeneous (v1)')
-    if meta.get('edge_assign', 'by_src') != 'by_src':
-      raise NotImplementedError(
-          "host-local loading needs edge_assign='by_src' layouts: "
-          'each partition dir must hold exactly its own rows '
-          "(by_dst layouts re-bucket globally — use the "
-          'single-controller from_partition_dir)')
+      raise ValueError(
+          'hetero layout: use DistHeteroDataset.from_partition_dir')
     num_parts = num_parts or meta['num_parts']
     host_parts = np.asarray(host_parts, np.int64)
     node_pb = np.load(root / 'node_pb.npy')
-    old2new, counts, bounds = relabel_by_partition(node_pb, num_parts)
+    # the relabel must MATCH a single-controller load of the same
+    # (layout, split_ratio): tiered loads order rows within each
+    # partition by in-degree hotness, computed here by chunked scan
+    hotness = (partition_in_degree(root, 'graph', len(node_pb),
+                                   num_parts)
+               if split_ratio < 1.0 else None)
+    old2new, counts, bounds = relabel_by_partition(node_pb, num_parts,
+                                                   hotness)
     max_nodes = int(counts.max()) if num_parts else 0
-    if (root / 'part0' / 'edge_feat').exists():
-      raise NotImplementedError(
-          'host-local loading does not serve edge features (v1)')
-    if (root / 'part0' / 'node_feat' / 'cache_ids.npy').exists():
-      import warnings
-      warnings.warn(
-          'host-local loading ignores the offline feature-cache plan '
-          '(cache_ids/cache_feats): formerly cache-served lookups will '
-          'ride the all_to_all', stacklevel=3)
-    indptr_s, indices_s, eids_s = stack_partition_csr(
-        root, host_parts, 'graph', old2new, old2new, bounds, counts,
-        num_parts)
+    if meta.get('edge_assign', 'by_src') == 'by_src':
+      indptr_s, indices_s, eids_s = stack_partition_csr(
+          root, host_parts, 'graph', old2new, old2new, bounds, counts,
+          num_parts)
+    else:
+      indptr_s, indices_s, eids_s = stack_partition_csr_rebucket(
+          root, host_parts, 'graph', node_pb, old2new, old2new, bounds,
+          counts, num_parts)
     feats_s = scatter_partition_rows(root, host_parts, 'node_feat',
                                      'feats', old2new, bounds,
                                      max_nodes)
@@ -548,5 +735,17 @@ class DistDataset:
                                       'labels', old2new, bounds,
                                       max_nodes)
     g = DistGraph(indptr_s, indices_s, eids_s, bounds)
-    nf = (DistFeature(feats_s, bounds) if feats_s is not None else None)
-    return cls(g, nf, labels_s, old2new, host_parts=host_parts)
+    nf = None
+    if feats_s is not None:
+      if split_ratio < 1.0:
+        nf = tiered_local_feature(feats_s, counts, split_ratio,
+                                  host_parts, bounds)
+      else:
+        nf = DistFeature(feats_s, bounds)
+      cids, crows = stack_partition_cache(root, host_parts, 'node_feat',
+                                          old2new, num_parts)
+      nf.cache_ids, nf.cache_rows = cids, crows
+    ef = stack_mod_edge_features(root, host_parts, 'edge_feat',
+                                 num_parts, int(meta['num_edges']))
+    return cls(g, nf, labels_s, old2new, edge_features=ef,
+               host_parts=host_parts)

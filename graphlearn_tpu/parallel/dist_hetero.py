@@ -177,8 +177,9 @@ class DistHeteroDataset:
     (`partition/base.py` hetero branch; reference `DistDataset.load`).
     ``split_ratio < 1`` tiers every node-type feature store.
     ``host_parts`` materializes only this process's partitions (see
-    `DistDataset.from_partition_dir`); same v1 limits — untiered, no
-    edge features, by_src layouts."""
+    `DistDataset.from_partition_dir`) and serves the full composition:
+    tiered stores (owner-served cold tiers, `overlay_cold_owner`),
+    per-etype edge features, and ``by_dst`` layouts."""
     if host_parts is not None:
       return _hetero_host_local(cls, root, num_parts, split_ratio,
                                 host_parts)
@@ -246,45 +247,58 @@ class DistHeteroDataset:
 
 def _hetero_host_local(cls, root, num_parts, split_ratio, host_parts):
   """Host-local arm of `DistHeteroDataset.from_partition_dir`:
-  materialize only ``host_parts`` — global relabels/bounds/padding
-  from per-type ``node_pb_*`` files and mmap'd array shapes, local
-  CSR/feature/label stacks from this host's partition dirs only."""
+  materialize only ``host_parts`` — global relabels/bounds/padding/
+  hotness from per-type ``node_pb_*`` files, chunked mmap scans, and
+  mmap'd array shapes; local CSR/feature/label/edge-feature stacks
+  from per-partition files.  Tiered stores get per-type owner-served
+  cold stacks (`DistFeature.cold_local`); ``by_dst`` layouts are
+  re-bucketed by src owner with chunked scans."""
   import json as _json
   from pathlib import Path
   from ..typing import as_str, edge_type_from_str
-  from .dist_data import (DistFeature, DistGraph, relabel_by_partition,
-                          scatter_partition_rows, stack_partition_csr)
+  from .dist_data import (DistFeature, DistGraph, partition_in_degree,
+                          relabel_by_partition, scatter_partition_rows,
+                          stack_mod_edge_features, stack_partition_csr,
+                          stack_partition_csr_rebucket,
+                          tiered_local_feature)
   root = Path(root)
-  if split_ratio < 1.0:
-    raise NotImplementedError(
-        'host-local loading is untiered (v1) — see '
-        'DistDataset.from_partition_dir')
   with open(root / 'META.json') as f:
     meta = _json.load(f)
   assert meta['hetero'], 'homogeneous layout: use DistDataset'
-  if meta.get('edge_assign', 'by_src') != 'by_src':
-    raise NotImplementedError(
-        "host-local loading needs edge_assign='by_src' layouts")
+  by_src = meta.get('edge_assign', 'by_src') == 'by_src'
   num_parts = num_parts or meta['num_parts']
   host_parts = np.asarray(host_parts, np.int64)
-
-  old2new, bounds, counts = {}, {}, {}
-  for nt in meta['node_types']:
-    pb = np.load(root / f'node_pb_{nt}.npy')
-    old2new[nt], counts[nt], bounds[nt] = relabel_by_partition(
-        pb, num_parts)
   etypes = [edge_type_from_str(ets) for ets in meta['edge_types']]
-  if any((root / 'part0' / 'edge_feat' / as_str(et)).exists()
-         for et in etypes):
-    raise NotImplementedError(
-        'host-local loading does not serve edge features (v1)')
+
+  # hotness per node type = in-degree summed over etypes landing on it
+  # (the from_full_graph tiering policy, chunked) — MUST match the
+  # single-controller relabel of the same (layout, split_ratio)
+  hotness = {}
+  if split_ratio < 1.0:
+    hotness = {nt: np.zeros(int(meta['num_nodes'][nt]), np.int64)
+               for nt in meta['node_types']}
+    for et in etypes:
+      hotness[et[2]] += partition_in_degree(
+          root, f'graph/{as_str(et)}', int(meta['num_nodes'][et[2]]),
+          num_parts)
+
+  node_pbs, old2new, bounds, counts = {}, {}, {}, {}
+  for nt in meta['node_types']:
+    node_pbs[nt] = np.load(root / f'node_pb_{nt}.npy')
+    old2new[nt], counts[nt], bounds[nt] = relabel_by_partition(
+        node_pbs[nt], num_parts, hotness.get(nt))
 
   graphs = {}
   for et in etypes:
     s, _, d = et
-    indptr_s, indices_s, eids_s = stack_partition_csr(
-        root, host_parts, f'graph/{as_str(et)}', old2new[s], old2new[d],
-        bounds[s], counts[s], num_parts)
+    if by_src:
+      indptr_s, indices_s, eids_s = stack_partition_csr(
+          root, host_parts, f'graph/{as_str(et)}', old2new[s],
+          old2new[d], bounds[s], counts[s], num_parts)
+    else:
+      indptr_s, indices_s, eids_s = stack_partition_csr_rebucket(
+          root, host_parts, f'graph/{as_str(et)}', node_pbs[s],
+          old2new[s], old2new[d], bounds[s], counts[s], num_parts)
     graphs[et] = DistGraph(indptr_s, indices_s, eids_s, bounds[s])
 
   feats, labels = {}, {}
@@ -297,11 +311,23 @@ def _hetero_host_local(cls, root, num_parts, split_ratio, host_parts):
                                 'labels', old2new[nt], bounds[nt],
                                 max_nodes)
     if fs is not None:
-      feats[nt] = DistFeature(fs, bounds[nt])
+      if split_ratio < 1.0:
+        feats[nt] = tiered_local_feature(fs, counts[nt], split_ratio,
+                                         host_parts, bounds[nt])
+      else:
+        feats[nt] = DistFeature(fs, bounds[nt])
     if ls is not None:
       labels[nt] = ls
+
+  efeats = {}
+  for et in etypes:
+    ef = stack_mod_edge_features(
+        root, host_parts, f'edge_feat/{as_str(et)}', num_parts,
+        int(meta.get('num_edges', {}).get(as_str(et), 0)))
+    if ef is not None:
+      efeats[et] = ef
   return cls(graphs, bounds, feats, labels, old2new,
-             host_parts=host_parts)
+             edge_features=efeats, host_parts=host_parts)
 
 
 def _build_etype_graph(rows_new: np.ndarray, cols_new: np.ndarray,
@@ -642,22 +668,41 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
   def _overlay_cold_types(self, feat_nts, ntypes, x_t, node_t):
     """Per-node-type cold-tier overlay (+ telemetry) for tiered
     feature stores — the hetero arm of
-    `dist_sampler.overlay_cold_host`.  All tiered node tables come
-    down in ONE device_get (one sync per batch, like the homo path),
-    not one per type."""
+    `dist_sampler.overlay_cold_host` / `overlay_cold_owner`.  All
+    requester-side (``cold_host``) node tables come down in ONE
+    device_get (one sync per batch, like the homo path); owner-served
+    (``cold_local``, host-local layouts) types run the second-gather
+    protocol, which reads only this process's addressable shards."""
+    from .dist_sampler import overlay_cold_owner
     tiered = [(i, nt) for i, (nt, x) in enumerate(zip(feat_nts, x_t))
               if x is not None and self.ds.node_features[nt].is_tiered]
     if not tiered:
       return x_t
-    fetched = jax.device_get([node_t[ntypes.index(nt)]
-                              for _, nt in tiered])
+    host_side = [(i, nt) for i, nt in tiered
+                 if self.ds.node_features[nt].cold_host is not None]
+    fetched = (jax.device_get([node_t[ntypes.index(nt)]
+                               for _, nt in host_side])
+               if host_side else [])
     out = list(x_t)
-    for (i, nt), nodes_h in zip(tiered, fetched):
+    for (i, nt), nodes_h in zip(host_side, fetched):
       nf = self.ds.node_features[nt]
       out[i], lookups, misses = overlay_cold_host(
           out[i], node_t[ntypes.index(nt)], self.ds.bounds[nt],
           nf.hot_counts, nf.cold_host, self.mesh, self.axis,
           self.num_parts, nodes_host=nodes_h)
+      with self._stats_lock:
+        self._cold_lookups += lookups
+        self._cold_misses += misses
+    hp = (self.ds.host_parts if self.ds.host_parts is not None
+          else np.arange(self.num_parts))
+    for i, nt in tiered:
+      nf = self.ds.node_features[nt]
+      if nf.cold_host is not None:
+        continue
+      out[i], lookups, misses = overlay_cold_owner(
+          out[i], node_t[ntypes.index(nt)], self.ds.bounds[nt],
+          nf.hot_counts, nf.cold_local, self.mesh, self.axis,
+          self.num_parts, hp, cache_ids=nf.cache_ids)
       with self._stats_lock:
         self._cold_lookups += lookups
         self._cold_misses += misses

@@ -1105,13 +1105,23 @@ class DistNeighborSampler(ExchangeTelemetry):
 
   def _maybe_overlay_cold(self, x, nodes):
     """Overlay host-DRAM cold-tier rows onto the exchanged features
-    (see :func:`overlay_cold_host`) and tick the cold telemetry."""
+    (requester-side `overlay_cold_host` for single-controller
+    ``cold_host`` tables; owner-served `overlay_cold_owner` for
+    host-local ``cold_local`` stacks) and tick the cold telemetry."""
     if not self.tiered or x is None:
       return x
     nf = self.ds.node_features
-    x, lookups, misses = overlay_cold_host(
-        x, nodes, self.ds.graph.bounds, nf.hot_counts, nf.cold_host,
-        self.mesh, self.axis, self.num_parts)
+    if nf.cold_host is not None:
+      x, lookups, misses = overlay_cold_host(
+          x, nodes, self.ds.graph.bounds, nf.hot_counts, nf.cold_host,
+          self.mesh, self.axis, self.num_parts)
+    else:
+      hp = (self.ds.host_parts if self.ds.host_parts is not None
+            else np.arange(self.num_parts))
+      x, lookups, misses = overlay_cold_owner(
+          x, nodes, self.ds.graph.bounds, nf.hot_counts, nf.cold_local,
+          self.mesh, self.axis, self.num_parts, hp,
+          cache_ids=nf.cache_ids)
     with self._stats_lock:
       self._cold_lookups += lookups
       self._cold_misses += misses
@@ -1167,6 +1177,139 @@ def overlay_cold_host(x, nodes, bounds, hot_counts, cold_host, mesh,
                            jax.device_put(rank, shard),
                            jax.device_put(compact, repl))
   return out, lookups, n_cold
+
+
+def _local_shards_stacked(arr, host_parts) -> np.ndarray:
+  """This process's shards of a dim-0-sharded global array, stacked
+  ``[len(host_parts), ...]`` in ``host_parts`` order — the read half
+  of `put_stacked_host_local` (multi-host safe: only addressable
+  shards are touched)."""
+  by_part = {}
+  for s in arr.addressable_shards:
+    by_part[int(s.index[0].start or 0)] = np.asarray(s.data)[0]
+  return np.stack([by_part[int(p)] for p in host_parts])
+
+
+def _global_max_int(v: int) -> int:
+  """Agree on ``max(v)`` across processes — the request-capacity
+  handshake of the owner-served cold overlay (every process must
+  compile/run identical [P, P, C] programs or the collectives
+  deadlock).  Single-process: the local value."""
+  if jax.process_count() == 1:
+    return int(v)
+  from jax.experimental import multihost_utils
+  return int(multihost_utils.process_allgather(
+      np.asarray([v], np.int64)).max())
+
+
+@functools.lru_cache(maxsize=None)
+def _cold_overlay_programs(mesh: Mesh, axis: str, num_parts: int):
+  """The two tiny collectives of the owner-served cold overlay
+  (`overlay_cold_owner`), cached per mesh: request-id all_to_all and
+  reply all_to_all + scatter."""
+  from .shard_map_compat import shard_map
+  s3 = P(axis, None, None)
+  s2 = P(axis, None)
+  s4 = P(axis, None, None, None)
+
+  def _exch(req):                                  # [1, P, C]
+    return jax.lax.all_to_all(req[0], axis, 0, 0, tiled=True)[None]
+
+  exchange_requests = jax.jit(shard_map(
+      _exch, mesh=mesh, in_specs=(s3,), out_specs=s3))
+
+  def _scatter(x, replies, mask, owner_idx, slot_idx):
+    rep = jax.lax.all_to_all(replies[0], axis, 0, 0,
+                             tiled=True)           # [P, C, D] by owner
+    rows = rep[owner_idx[0], slot_idx[0]]          # [cap, D]
+    return jnp.where(mask[0][:, None], rows, x[0])[None]
+
+  scatter_replies = jax.jit(shard_map(
+      _scatter, mesh=mesh, in_specs=(s3, s4, s2, s2, s2),
+      out_specs=s3))
+  return exchange_requests, scatter_replies
+
+
+def overlay_cold_owner(x, nodes, bounds, hot_counts, cold_local, mesh,
+                       axis: str, num_parts: int, host_parts,
+                       cache_ids=None, nodes_host=None):
+  """OWNER-served cold-tier overlay — the multi-host form
+  (`DistFeature.cold_local`): each host holds only its own
+  partitions' cold rows, so a requester cannot gather them locally
+  (the `overlay_cold_host` path needs the full ``[N, D]`` table).
+  Instead the cold rows ride a second per-batch gather, the
+  collective analog of the reference's RPC feature fan-out against
+  per-host UVA tables (`distributed/dist_feature.py:134-269` +
+  `data/feature.py:174-206`):
+
+    1. each process reads ITS devices' sampled-node shards and marks
+       rows the HBM exchange zeroed (past the owner's hot count and
+       not served by the local remote-hot cache);
+    2. processes agree on a power-of-two request capacity ``C``
+       (`_global_max_int` — all processes must run identical
+       programs);
+    3. one all_to_all ships the ``[P, P, C]`` request ids to owners;
+    4. each owner host gathers the requested rows from its DRAM stack
+       (this is THE host round trip — the honest price of exceeding
+       HBM, same as the requester-side path);
+    5. one all_to_all ships replies back; a scatter overlays them.
+
+  Works identically under a single controller (every partition is
+  addressable) — the virtual-mesh tests drive the same code path the
+  multi-host deployment runs.  Returns ``(x', lookups, misses)``.
+  """
+  from ..utils.padding import next_power_of_two
+  hp = [int(p) for p in host_parts]
+  nodes_l = (nodes_host if nodes_host is not None
+             else _local_shards_stacked(nodes, hp)).astype(np.int64)
+  pl, cap = nodes_l.shape
+  valid = nodes_l >= 0
+  owner = np.clip(np.searchsorted(bounds, nodes_l, side='right') - 1,
+                  0, num_parts - 1)
+  local = np.where(valid, nodes_l - bounds[owner], 0)
+  cold = valid & (local >= hot_counts[owner])
+  if cache_ids is not None:
+    # cache-served rows already carry correct values — skip them
+    for j in range(pl):
+      cid = np.asarray(cache_ids[j])
+      pos = np.clip(np.searchsorted(cid, nodes_l[j]), 0, len(cid) - 1)
+      cold[j] &= ~((cid[pos] == nodes_l[j]) & valid[j])
+  lookups = int(valid.sum())
+  counts = np.zeros((pl, num_parts), np.int64)
+  for j in range(pl):
+    counts[j] = np.bincount(owner[j][cold[j]], minlength=num_parts)
+  c_req = _global_max_int(int(counts.max(initial=0)))
+  if c_req == 0:
+    return x, lookups, 0
+  n_cold = int(cold.sum())
+  c_pad = next_power_of_two(c_req)
+  req = np.full((pl, num_parts, c_pad), -1, np.int32)
+  owner_idx = np.zeros((pl, cap), np.int32)
+  slot_idx = np.zeros((pl, cap), np.int32)
+  for j in range(pl):
+    for q in np.nonzero(counts[j])[0]:
+      sel = cold[j] & (owner[j] == q)
+      ids = nodes_l[j][sel]
+      req[j, q, :len(ids)] = ids
+      owner_idx[j][sel] = q
+      slot_idx[j][sel] = np.arange(len(ids), dtype=np.int32)
+
+  exchange_requests, scatter_replies = _cold_overlay_programs(
+      mesh, axis, num_parts)
+  putS = functools.partial(put_stacked_host_local, mesh, axis,
+                           num_parts, hp)
+  req_at_owner = exchange_requests(putS(req))
+  ro = _local_shards_stacked(req_at_owner, hp)     # [pl, P, C]
+  d = cold_local.shape[-1]
+  replies = np.zeros((pl, num_parts, c_pad, d), cold_local.dtype)
+  for j, p in enumerate(hp):
+    ids = ro[j].astype(np.int64)
+    loc = np.where(ids >= 0, ids - bounds[p], 0)
+    loc = np.clip(loc, 0, cold_local.shape[1] - 1)
+    replies[j] = np.where((ids >= 0)[..., None], cold_local[j][loc], 0)
+  x2 = scatter_replies(x, putS(replies), putS(cold),
+                       putS(owner_idx), putS(slot_idx))
+  return x2, lookups, n_cold
 
 
 def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,

@@ -14,6 +14,7 @@ import sys
 coordinator, num_procs, proc_id, out_file = (
     sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
 partition_dir = sys.argv[5] if len(sys.argv) > 5 else None
+rich_dir = sys.argv[6] if len(sys.argv) > 6 else None
 
 import numpy as np
 from graphlearn_tpu.parallel import multihost
@@ -100,9 +101,49 @@ if partition_dir is not None:
   host_local = {'host_parts': hp.tolist(),
                 'provenance_rows': checked}
 
+composed = {}
+if rich_dir is not None:
+  # the COMPOSED host-local path (r4, the IGBH-large enabler): tiered
+  # store + offline cache plan + edge features, all host-local.  Cold
+  # rows are OWNER-served across the two REAL processes
+  # (`overlay_cold_owner`: process_allgather capacity handshake + two
+  # cross-process collectives + each owner gathering from its own
+  # DRAM stack); provenance (feat[v, 0] == old id + 1, efeat[e, 0] ==
+  # eid) proves every byte arrived from the right host.
+  hp = multihost.host_partition_ids(mesh)
+  ds3 = DistDataset.from_partition_dir(rich_dir, num_parts,
+                                       split_ratio=0.4, host_parts=hp)
+  assert ds3.node_features.cold_local is not None
+  assert ds3.node_features.has_cache
+  assert ds3.edge_features is not None
+  loader3 = DistNeighborLoader(ds3, [2, 2], np.arange(N), batch_size=4,
+                               shuffle=True, with_edge=True, mesh=mesh,
+                               seed=7)
+  b3 = next(iter(loader3))
+  checked3 = 0
+  for ns, xs in zip(b3.node.addressable_shards,
+                    b3.x.addressable_shards):
+    nodes = np.asarray(ns.data)[0]
+    x = np.asarray(xs.data)[0]
+    m = nodes >= 0
+    old = ds3.new2old[nodes[m]]
+    np.testing.assert_allclose(x[m][:, 0], old.astype(np.float32) + 1)
+    checked3 += int(m.sum())
+  for es, eas, ems in zip(b3.edge.addressable_shards,
+                          b3.edge_attr.addressable_shards,
+                          b3.edge_mask.addressable_shards):
+    eid = np.asarray(es.data)[0]
+    ea = np.asarray(eas.data)[0]
+    em = np.asarray(ems.data)[0]
+    np.testing.assert_allclose(ea[em][:, 0], eid[em])
+  st3 = loader3.sampler.exchange_stats(tick_metrics=False)
+  composed = {'provenance_rows': checked3,
+              'cold_misses': int(st3['dist.feature.cold_misses']),
+              'cold_lookups': int(st3['dist.feature.cold_lookups'])}
+
 with open(out_file, 'w') as f:
   json.dump({'proc': proc_id, 'shard': shard.tolist(),
              'host_slice': [hsl.start, hsl.stop],
              'batches': batches, 'loss': loss_val,
-             'host_local': host_local}, f)
+             'host_local': host_local, 'composed': composed}, f)
 print('WORKER OK', proc_id, loss_val)

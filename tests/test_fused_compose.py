@@ -11,6 +11,11 @@ from graphlearn_tpu.loader import FusedEpoch, NeighborLoader
 from graphlearn_tpu.models import GraphSAGE, create_train_state
 from graphlearn_tpu.utils import Checkpointer
 from graphlearn_tpu.utils.profiling import metrics
+import pytest
+
+#: CPU-mesh scan-compile heavy (multi-minute): excluded from the
+#: default run, selected by `pytest -m slow` (see pyproject.toml)
+pytestmark = pytest.mark.slow
 
 
 def _dataset(n=90, d=8, classes=3, seed=0):

@@ -13,6 +13,10 @@ from graphlearn_tpu.models import GraphSAGE, create_train_state
 from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
                                      FusedDistEpoch, make_mesh, replicate)
 
+#: CPU-mesh scan-compile heavy (multi-minute): excluded from the
+#: default run, selected by `pytest -m slow` (see pyproject.toml)
+pytestmark = pytest.mark.slow
+
 N = 256
 CLASSES = 4
 P_PARTS = 4

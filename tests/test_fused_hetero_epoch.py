@@ -12,6 +12,10 @@ from graphlearn_tpu.loader import FusedHeteroEpoch, NeighborLoader
 from graphlearn_tpu.models import RGCN
 from graphlearn_tpu.models.train import TrainState
 
+#: CPU-mesh scan-compile heavy (multi-minute): excluded from the
+#: default run, selected by `pytest -m slow` (see pyproject.toml)
+pytestmark = pytest.mark.slow
+
 U, I = 'user', 'item'
 ET_UI = (U, 'clicks', I)
 ET_IU = (I, 'rev_clicks', U)

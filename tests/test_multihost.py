@@ -20,6 +20,10 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+#: CPU-mesh scan-compile heavy (multi-minute): excluded from the
+#: default run, selected by `pytest -m slow` (see pyproject.toml)
+pytestmark = pytest.mark.slow
+
 
 def _free_port() -> int:
   with socket.socket() as s:
@@ -52,6 +56,18 @@ def test_two_process_distributed_epoch(tmp_path):
   RandomPartitioner(pdir, 8, n, (rows, cols), node_feat=feats,
                     node_label=(np.arange(n) % 4).astype(np.int32),
                     seed=0).partition()
+  # rich layout for the COMPOSED phase (r4): provenance features
+  # (col 0 = old id + 1), edge features encoding eids, cache plan —
+  # loaded host-local + tiered by the workers
+  e = len(rows)
+  efeat = np.stack([np.arange(e), rows, cols], 1).astype(np.float32)
+  feats2 = np.tile((np.arange(n, dtype=np.float32) + 1)[:, None],
+                   (1, 4))
+  pdir2 = tmp_path / 'rich'
+  RandomPartitioner(pdir2, 8, n, (rows, cols), node_feat=feats2,
+                    node_label=(np.arange(n) % 4).astype(np.int32),
+                    edge_feat=efeat, cache_ratio=0.1,
+                    seed=0).partition()
   procs = []
   outs = []
   for pid in range(2):
@@ -59,7 +75,7 @@ def test_two_process_distributed_epoch(tmp_path):
     outs.append(out)
     procs.append(subprocess.Popen(
         [sys.executable, str(worker), f'localhost:{port}', '2',
-         str(pid), str(out), str(pdir)],
+         str(pid), str(out), str(pdir), str(pdir2)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True))
   results = []
@@ -88,3 +104,10 @@ def test_two_process_distributed_epoch(tmp_path):
   assert r1['host_local']['host_parts'] == [4, 5, 6, 7]
   assert r0['host_local']['provenance_rows'] > 0
   assert r1['host_local']['provenance_rows'] > 0
+  # composed phase: tiered + cache + edge features host-local, with
+  # cold rows OWNER-served across the two real processes
+  for r in (r0, r1):
+    assert r['composed']['provenance_rows'] > 0
+    assert r['composed']['cold_misses'] > 0
+    assert (r['composed']['cold_lookups']
+            >= r['composed']['cold_misses'])

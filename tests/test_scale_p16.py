@@ -12,6 +12,11 @@ import json
 import subprocess
 import sys
 from pathlib import Path
+import pytest
+
+#: CPU-mesh scan-compile heavy (multi-minute): excluded from the
+#: default run, selected by `pytest -m slow` (see pyproject.toml)
+pytestmark = pytest.mark.slow
 
 REPO = Path(__file__).resolve().parent.parent
 
