@@ -98,7 +98,7 @@ def _sample_window_bytes(batch, fanouts):
   return total
 
 
-def worker(fast: bool, fused_only: bool = False):
+def worker(fused_only: bool = False):
   """One fresh-session measurement: epoch time first (the primary,
   measured on this process's first burst), then sampling throughput,
   then the feature-gather roofline phase.  ``fused_only`` is the
@@ -207,7 +207,7 @@ def worker(fast: bool, fused_only: bool = False):
 
   # secondary: sampling-only throughput, reference metric definition,
   # plus the window-bytes roofline fraction
-  iters = 10 if fast else SAMPLE_ITERS
+  iters = SAMPLE_ITERS
   sampler = NeighborSampler(ds.get_graph(), FANOUT, seed=0)
   srng = np.random.default_rng(1)
   seed_batches = [srng.integers(0, n, BATCH).astype(np.int32)
@@ -272,7 +272,7 @@ def worker(fast: bool, fused_only: bool = False):
                     'gather_gbps': (round(gather_gbps, 1)
                                     if gather_gbps else None),
                     'steps': len(loader),
-                    'mode': 'fast',
+                    'mode': 'primary',
                     'platform': platform}),
         flush=True)
 
@@ -369,21 +369,76 @@ def dist_worker():
   }
   print(json.dumps(out), flush=True)
 
-  # NOTE: the FusedDistEpoch-vs-per-batch comparison lives in
-  # `benchmarks/bench_dist_loader.py --fused`, NOT here: its two
-  # extra CPU-mesh scan compiles need >20 min at this batch size
-  # (measured), which no session budget survives.  The artifact keeps
-  # base+tiered; the fused mesh path is covered by
-  # tests/test_fused_dist_epoch.py and the standalone benchmark.
+  # fused mesh epoch vs per-batch DP loop, SAME shape (r4: previously
+  # exiled to `bench_dist_loader.py --fused` on an r3 note claiming
+  # >20 min of scan compile at this batch — re-measured this round:
+  # the [10,5]/h64-2-layer/B=512 fused program compiles in ~17 s, so
+  # the comparison rides in the artifact; the >20 min regime is the
+  # HEADLINE model shape [15,10,5]/h256-3-layer, tracked by
+  # `benchmarks/bench_compile.py`)
+  import optax
+  from graphlearn_tpu.models import GraphSAGE, create_train_state
+  from graphlearn_tpu.parallel import (FusedDistEpoch,
+                                       local_batch_piece,
+                                       make_dp_supervised_step,
+                                       replicate)
+  b2, fan2 = 512, [10, 5]
+  mesh2 = make_mesh(DIST_PARTS)
+  seeds2 = rng.permutation(DIST_NODES)[:b2 * DIST_PARTS * 4]
+  it2 = iter(DistNeighborLoader(ds, fan2, seeds2, batch_size=b2,
+                                shuffle=True, mesh=mesh2, seed=0))
+  b0 = next(it2)
+  b0_local = local_batch_piece(b0, DIST_PARTS)
+  model = GraphSAGE(hidden_features=64, out_features=CLASSES,
+                    num_layers=2)
+  tx = optax.adam(3e-3)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), b0_local, tx)
+  step = make_dp_supervised_step(apply_fn, tx, b2, mesh2)
+  state = replicate(state, mesh2)
+  t0 = time.perf_counter()
+  state, _, _ = step(state, b0)
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  pb_compile = time.perf_counter() - t0
+  npb = 0
+  t0 = time.perf_counter()
+  for b in it2:
+    state, _, _ = step(state, b)
+    npb += 1
+  jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
+  pb_dt = time.perf_counter() - t0
+  fused = FusedDistEpoch(ds, fan2, seeds2, apply_fn, tx, batch_size=b2,
+                         mesh=mesh2, shuffle=True, seed=0)
+  fstate, _ = create_train_state(model, jax.random.key(1), b0_local, tx)
+  fstate = replicate(fstate, mesh2)
+  t0 = time.perf_counter()
+  fstate, _ = fused.run(fstate)
+  jax.tree_util.tree_leaves(fstate.params)[0].block_until_ready()
+  f_compile = time.perf_counter() - t0
+  fstate, _ = fused.run(fstate)         # donated-layout recompile
+  jax.tree_util.tree_leaves(fstate.params)[0].block_until_ready()
+  t0 = time.perf_counter()
+  fstate, _ = fused.run(fstate)
+  jax.tree_util.tree_leaves(fstate.params)[0].block_until_ready()
+  f_dt = time.perf_counter() - t0
+  pb_rate = npb * b2 * DIST_PARTS / max(pb_dt, 1e-9)
+  f_rate = len(fused) * b2 * DIST_PARTS / max(f_dt, 1e-9)
+  out['fused_mesh'] = {
+      'batch': b2, 'fanout': fan2,
+      'per_batch_seeds_per_sec': round(pb_rate, 1),
+      'fused_seeds_per_sec': round(f_rate, 1),
+      'fused_vs_per_batch': round(f_rate / max(pb_rate, 1e-9), 2),
+      'per_batch_compile_secs': round(pb_compile, 1),
+      'fused_compile_secs': round(f_compile, 1),
+  }
+  print(json.dumps(out), flush=True)
 
 
-def _run_session(fast: bool, timeout: int, fused: bool = False):
+def _run_session(timeout: int, fused: bool = False):
   cmd = [sys.executable, os.path.abspath(__file__),
          '--fused-session' if fused else '--bench-worker']
-  if fast:
-    cmd.append('--fast')
   cmd += [a for a in sys.argv[1:]
-          if a not in ('--bench-worker', '--fused-session', '--fast')]
+          if a not in ('--bench-worker', '--fused-session')]
   try:
     out = subprocess.run(cmd, capture_output=True, text=True,
                          cwd=os.path.dirname(os.path.abspath(__file__)),
@@ -575,7 +630,7 @@ def main():
       print(f'budget: giving up on primary after {attempts} attempts',
             file=sys.stderr)
       break
-    r = _run_session(True, tmo)
+    r = _run_session(tmo)
     attempts += 1
     if r is not None:
       results.append(r)
@@ -594,7 +649,7 @@ def main():
   # compile, ~350-450 s): lands the HEADLINE number
   if budget_left() > 150:
     fused_res = _run_session(
-        True, int(min(fused_timeout, max(budget_left() - 10, 120))),
+        int(min(fused_timeout, max(budget_left() - 10, 120))),
         fused=True)
     emit()
   else:
@@ -623,7 +678,7 @@ def main():
   # (fast days only; each one re-emits the cumulative aggregate)
   while (len(results) < sessions and attempts < sessions + 3
          and budget_left() > session_timeout * 0.75):
-    r = _run_session(True, int(min(session_timeout, budget_left())))
+    r = _run_session(int(min(session_timeout, budget_left())))
     attempts += 1
     if r is not None:
       results.append(r)
@@ -638,8 +693,8 @@ if __name__ == '__main__':
   if '--dist-worker' in sys.argv:
     dist_worker()
   elif '--fused-session' in sys.argv:
-    worker(fast=True, fused_only=True)
+    worker(fused_only=True)
   elif '--bench-worker' in sys.argv:
-    worker(fast='--fast' in sys.argv)
+    worker()
   else:
     main()

@@ -153,7 +153,7 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
     ds = DistHeteroDataset.from_full_graph(
         num_parts,
         {('u', 'to', 'i'): (rows % nu, cols % ni),
-         ('i', 'rev', 'u'): (cols % ni, rows % nu)},
+         ('i', 'rev_to', 'u'): (cols % ni, rows % nu)},
         num_nodes_dict={'u': nu, 'i': ni})
     seeds = rng.integers(0, nu, batch * num_parts * 2)
     loader = DistHeteroNeighborLoader(ds, [5, 5], ('u', seeds),
@@ -262,8 +262,10 @@ def main():
                   help='also time parallel.FusedDistEpoch (whole '
                        'epoch = one SPMD scan program, WITH the DP '
                        'train step) against the per-batch loader + '
-                       'DP-step loop — expect minutes of CPU-mesh '
-                       'compile at the default batch')
+                       'DP-step loop — ~17 s of CPU-mesh compile at '
+                       'the default shape (r4 measurement); the '
+                       'multi-minute regime is the big-model shape, '
+                       'see benchmarks/bench_compile.py')
   args = ap.parse_args()
 
   if args.capacity_sweep:

@@ -1,4 +1,5 @@
-from .dp import (DataParallelLoader, make_dp_supervised_step,
+from .dp import (DataParallelLoader, local_batch_piece,
+                 make_dp_supervised_step,
                  make_dp_unsupervised_step, make_mesh,
                  replicate, shard_stacked, stack_batches)
 from .dist_data import (DistDataset, DistFeature, DistGraph,

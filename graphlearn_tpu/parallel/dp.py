@@ -39,6 +39,20 @@ def replicate(tree, mesh: Mesh):
   return jax.device_put(tree, NamedSharding(mesh, P()))
 
 
+def local_batch_piece(batch, num_parts: int):
+  """One device's slice of a ``[P, ...]``-stacked batch pytree — the
+  single-device template `create_train_state` wants for param init
+  under the mesh engines.  Reads only ADDRESSABLE shards, so it works
+  on multi-host meshes where ``np.asarray(global_array)`` would not;
+  leaves without the leading device axis pass through."""
+  def pick(v):
+    if (isinstance(v, jax.Array) and v.ndim
+        and v.shape[0] == num_parts):
+      return np.asarray(v.addressable_shards[0].data)[0]
+    return v
+  return jax.tree_util.tree_map(pick, batch)
+
+
 def shard_stacked(tree, mesh: Mesh, axis: str = 'data'):
   """Place a stacked (leading device axis) pytree sharded over ``axis``."""
   return jax.device_put(tree, NamedSharding(mesh, P(axis)))

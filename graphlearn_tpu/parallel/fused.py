@@ -72,6 +72,11 @@ class FusedDistEpoch:
       together, and at large batch x fanout that joint peak can exceed
       per-chip HBM where the separate per-batch programs fit (see
       `loader.fused.FusedEpoch`).
+    fast_compile: compile the epoch program with the expensive LLVM
+      passes OFF (`loader.fused._FAST_COMPILE_OPTIONS`) — measured on
+      the 8-device CPU mesh at the headline shape: ~38% off the scan
+      compile wall for a modest runtime cost; for dev iteration and
+      CPU-mesh validation.
   """
 
   def __init__(self, dataset: DistDataset, num_neighbors, input_nodes,
@@ -80,7 +85,8 @@ class FusedDistEpoch:
                axis: str = 'data', shuffle: bool = True,
                drop_last: bool = False, seed: int = 0,
                input_space: str = 'old',
-               exchange_slack='auto', remat: bool = False):
+               exchange_slack='auto', remat: bool = False,
+               fast_compile: bool = False):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None or dataset.node_labels is None:
       raise ValueError('FusedDistEpoch needs node features and labels')
@@ -121,7 +127,8 @@ class FusedDistEpoch:
     # compilation cache — deserialized big scan programs crash the
     # tunneled TPU worker, and CPU AOT entries cross-loaded between
     # target-feature sets SIGILL (see loader.fused._fresh_compile)
-    self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,))
+    self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
+                                   fast_compile=fast_compile)
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -211,7 +218,8 @@ class FusedDistLinkEpoch:
                mesh: Optional[Mesh] = None, axis: str = 'data',
                shuffle: bool = True, drop_last: bool = False,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto', remat: bool = False):
+               exchange_slack='auto', remat: bool = False,
+               fast_compile: bool = False):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None:
       raise ValueError('FusedDistLinkEpoch needs node features')
@@ -249,7 +257,7 @@ class FusedDistLinkEpoch:
     self._dist_step = self.sampler.step_for_pairs(
         self.batch_size, self.pairs.shape[1])
     self._compiled = _uncached_jit(       # see FusedDistEpoch note
-        self._epoch_fn, donate_argnums=(0,))
+        self._epoch_fn, donate_argnums=(0,), fast_compile=fast_compile)
 
   def __len__(self) -> int:
     return len(self._batcher)

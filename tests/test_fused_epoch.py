@@ -141,6 +141,7 @@ def test_fused_epoch_refuses_missing_labels():
     FusedEpoch(ds2, [4, 3], np.arange(90), apply_fn, tx, batch_size=32)
 
 
+@pytest.mark.slow
 def test_fused_evaluate_matches_eval_loop():
   """fused.evaluate == a make_eval_step loop over the same split
   (different sampling keys; on a well-separated task both sides must
@@ -165,6 +166,7 @@ def test_fused_evaluate_matches_eval_loop():
   assert abs(acc_fused - correct / total) < 0.15
 
 
+@pytest.mark.slow
 def test_fused_link_epoch_trains():
   """Binary-mode fused link training: loss decreases and positive
   pairs end up scoring above sampled negatives."""
@@ -193,6 +195,7 @@ def test_fused_link_epoch_trains():
   assert stats['loss'] < 0.62       # below ln(2): pos/neg separated
 
 
+@pytest.mark.slow
 def test_fused_link_triplet_trains():
   from graphlearn_tpu.loader import FusedLinkEpoch
   from graphlearn_tpu.sampler import NegativeSampling
@@ -217,6 +220,7 @@ def test_fused_link_triplet_trains():
   assert stats['loss'] < first['loss']
 
 
+@pytest.mark.slow
 def test_fused_link_step_matches_manual_batch():
   """Parity pin for the duplicated seed/metadata assembly: one-batch
   fused link epoch == manual sample_negative + _multihop_sample +
@@ -258,6 +262,7 @@ def test_fused_link_step_matches_manual_batch():
                              loss_manual, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_matches_per_batch_loss_scale():
   """Fused and per-batch paths train to comparable losses on the same
   task (not bit-identical: the key schedules differ by design)."""

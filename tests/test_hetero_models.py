@@ -1,5 +1,6 @@
 """Hetero model tests: RGCN/HGT forward + training on a learnable
 bipartite task (user labels recoverable from item neighborhoods)."""
+import pytest
 import numpy as np
 import jax
 import optax
@@ -49,6 +50,7 @@ def _etypes_in_batches(loader):
   return tuple(batch.edge_index_dict.keys())
 
 
+@pytest.mark.slow
 def test_rgcn_trains_on_bipartite_task():
   ds = _dataset(d=12)
   bs = 16

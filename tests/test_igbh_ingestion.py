@@ -62,6 +62,7 @@ def test_load_igbh_dir(tmp_path):
   assert igbh_num_classes() == 19
 
 
+@pytest.mark.slow
 def test_igbh_partition_roundtrip_to_hetero_engine(tmp_path):
   """partition_igbh -> DistHeteroDataset (tiered) -> loader epoch with
   provenance — the full IGBH pipeline minus the real download."""

@@ -114,6 +114,7 @@ def test_learns_through_fused_epoch():
 
 def test_learns_through_dist_loader():
   from graphlearn_tpu.parallel import (DistNeighborLoader,
+                                       local_batch_piece,
                                        make_dp_supervised_step,
                                        make_mesh, replicate)
   num_parts = 8
@@ -128,10 +129,7 @@ def test_learns_through_dist_loader():
                               shuffle=True, mesh=mesh, seed=0)
   model, tx = _model_tx()
   first = next(iter(loader))
-  local_piece = jax.tree_util.tree_map(
-      lambda v: (np.asarray(v.addressable_shards[0].data)[0]
-                 if isinstance(v, jax.Array) and v.shape
-                 and v.shape[0] == num_parts else v), first)
+  local_piece = local_batch_piece(first, num_parts)
   state, apply_fn = create_train_state(model, jax.random.key(0),
                                        local_piece, tx)
   state = replicate(state, mesh)

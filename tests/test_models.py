@@ -1,6 +1,7 @@
 """Model-family tests: correctness of masked aggregation and that a
 few steps of training reduce loss on a learnable synthetic task."""
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 import optax
@@ -229,6 +230,7 @@ def test_dgcnn_learns_graph_label():
   assert correct >= 7, correct
 
 
+@pytest.mark.slow
 def test_gin_and_gatv2_convs_mask_and_learn():
   """New zoo members (r3): masked padded edges contribute nothing, and
   an L-layer stack learns the clustered-graph task."""

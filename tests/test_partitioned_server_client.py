@@ -41,6 +41,7 @@ def _shard_server_proc(root, rank, port_q):
   wait_and_shutdown_server(timeout=120)
 
 
+@pytest.mark.slow
 def test_partitioned_server_client_loader(tmp_path):
   _write_partitions(tmp_path)
   ctx = mp.get_context('forkserver')

@@ -112,6 +112,7 @@ def test_prefetch_early_abandonment_stops_worker():
   assert not thread.is_alive()
 
 
+@pytest.mark.slow
 def test_mesh_loader_prefetch_matches_sync():
   """prefetch=2 on the mesh loaders yields the SAME batches as the
   synchronous path (same seed stream), overlapped on a worker thread."""
