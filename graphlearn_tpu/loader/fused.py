@@ -57,32 +57,57 @@ def _fresh_compile():
   compilation cache.  Executing a DESERIALIZED cached fused-epoch
   executable crashes the tunneled TPU worker ("TPU device error")
   while the same program compiled fresh runs clean — reproduced both
-  ways back to back (see benchmarks/README).  Unlike the cache DIR
-  (latched at the first compile of the process, after which config
-  updates are ignored), the enable flag is consulted at EVERY
-  compile, and it is not part of the jit trace context, so toggling
-  it here neither retraces nor invalidates already-compiled epochs.
-  The flag's own State context manager scopes the flip to THIS
-  thread (a global jax.config.update here could re-enable the cache
-  under another thread's in-flight guarded compile, or clobber a
-  caller's own flag context on exit).  The State object lives in
-  jax._src (no stability guarantee); if a jax upgrade moves it, fall
-  back to the public-but-global update so the crash-avoidance bypass
-  degrades to process-wide instead of silently dying."""
+  ways back to back (see benchmarks/README).
+
+  Two latches must be defeated (both verified against jax 0.9):
+
+  * ``jax_enable_compilation_cache`` is consulted through
+    ``compilation_cache.is_cache_used``, which CACHES its answer at
+    the process's first compile — so flipping the flag alone is a
+    no-op once any setup compile has latched the cache on (this
+    exact failure shipped a cache-HIT "fused compile" of 2 s where a
+    fresh compile takes ~70 s).  ``reset_cache()`` clears that latch
+    before and after the block, so compiles inside re-evaluate the
+    (disabled) flag and compiles after re-latch it fresh.
+  * the cache DIR itself also latches at first use; never touched
+    here.
+
+  The flag flip uses the State's thread-local context manager, but
+  the latch reset is PROCESS-global: a compile racing on another
+  thread during the block can latch the cache off for itself (safe
+  direction — it merely recompiles).  Neither knob is part of the
+  jit trace context, so nothing here retraces or invalidates
+  already-compiled epochs.  Both symbols live in jax._src (no
+  stability guarantee); if an upgrade moves them, the degraded path
+  disables the persistent cache for the REST OF THE PROCESS and
+  warns — crash avoidance beats cache reuse, and a scoped restore
+  would be theater (the global flag alone cannot un-latch an
+  already-enabled cache, the exact no-op this function exists to
+  avoid).  Best effort only: against a cache latched on BEFORE the
+  first fused dispatch even that may not bite — the warning tells
+  the operator to pin jax or clear the cache dir."""
   try:
+    from jax._src import compilation_cache as _cc
     from jax._src.config import enable_compilation_cache as _state
-  except ImportError:
-    _state = None
-  if _state is not None:
-    with _state(False):
-      yield
+    _reset = _cc.reset_cache
+  except (ImportError, AttributeError):
+    _reset = _state = None
+  if _state is not None and _reset is not None:
+    _reset()
+    try:
+      with _state(False):
+        yield
+    finally:
+      _reset()
     return
-  prev = jax.config.jax_enable_compilation_cache
+  import warnings
+  warnings.warn(
+      'jax internals moved (jax._src.compilation_cache/config): the '
+      'fused-program compilation-cache bypass cannot be scoped; '
+      'disabling the persistent compilation cache process-wide for '
+      'safety (see loader.fused._fresh_compile)', stacklevel=3)
   jax.config.update('jax_enable_compilation_cache', False)
-  try:
-    yield
-  finally:
-    jax.config.update('jax_enable_compilation_cache', prev)
+  yield
 
 
 #: `fast_compile` option: skip the EXPENSIVE LLVM passes for a big
