@@ -27,8 +27,9 @@ section, the fused session).  The driver's last-JSON-line salvage
 therefore always finds the newest complete headline no matter where
 the process is killed.  The default total budget is 1200 s (was
 3000 s, which overran the driver's wall); phases run in the order
-primary -> dist -> fused -> extra primary sessions and each clamps
-itself to the remaining budget.
+primary -> fused -> dist -> scale-envelope -> extra primary sessions
+(the headline fused session outranks the CPU-mesh dist section for
+budget) and each clamps itself to the remaining budget.
 
 Honest variance reporting: the tunnel to the chip swings wall-clock
 several-fold BETWEEN processes, and within a process only the first
@@ -642,17 +643,10 @@ def main():
       results.append(r)
       emit()
 
-  # phase 2 — dist section (CPU mesh; tunnel-independent)
-  if budget_left() > 90:
-    dist = _run_dist_section(
-        int(min(dist_timeout, max(budget_left() - 30, 60))))
-    emit()
-  else:
-    print(f'budget: skipping dist ({budget_left():.0f}s left)',
-          file=sys.stderr)
-
-  # phase 3 — dedicated fused session (whole-epoch FusedEpoch, fresh
-  # compile, ~350-450 s): lands the HEADLINE number
+  # phase 2 — dedicated fused session (whole-epoch FusedEpoch,
+  # ALWAYS a fresh compile after the latch fix, ~400-500 s): lands
+  # the HEADLINE number, so it outranks the dist section for budget —
+  # the dist worker salvages per-phase no matter how little remains
   if budget_left() > 150:
     fused_res = _run_session(
         int(min(fused_timeout, max(budget_left() - 10, 120))),
@@ -661,6 +655,17 @@ def main():
   else:
     print(f'budget: skipping the fused session '
           f'({budget_left():.0f}s left)', file=sys.stderr)
+
+  # phase 3 — dist section (CPU mesh; tunnel-independent; emits a
+  # complete JSON line after EVERY internal phase, so even a heavily
+  # clamped timeout records base numbers)
+  if budget_left() > 90:
+    dist = _run_dist_section(
+        int(min(dist_timeout, max(budget_left() - 30, 60))))
+    emit()
+  else:
+    print(f'budget: skipping dist ({budget_left():.0f}s left)',
+          file=sys.stderr)
 
   # opportunistic — per-P scale-envelope rows for the dist section
   # (VERDICT r3 #6): P=16/64 homo exchange accounting; the full sweep
