@@ -232,7 +232,12 @@ def worker(fused_only: bool = False):
   # post-first-burst dispatch overhead (~0.1-0.3 s PER program,
   # benchmarks/README) amortizes against >= 0.7 s of device work at
   # peak — N small dispatches here measured the tunnel, not HBM.
-  # Still a lower bound (the dispatch overhead is inside the wall).
+  # A LOWER bound in two ways: dispatch overhead sits inside the
+  # wall, and the serialized loop (reduce-carried dependency) runs
+  # the gather slower than the epoch's pipelined per-batch programs
+  # (r4 probes: ~38 GB/s D=100 / ~48 GB/s D=128 in this regime; the
+  # async-dispatch regime could not be measured cleanly — the tunnel
+  # elides repeat executions outside the first timed window).
   gather_hbm = gather_gbps = None
   if platform in HBM_PEAK:
     giters, grows = 1500, 1 << 20

@@ -57,6 +57,16 @@ from ..utils.padding import round_up
 # Rows gathered per grid step == async copies in flight.
 _TILE = 32
 
+#: Max ids the DMA kernel accepts: the id vector is SCALAR-PREFETCHED
+#: into SMEM (1 MB on v5e), so ``4 * B`` bytes must fit with headroom
+#: for the grid machinery — discovered the hard way at B=2^20 ids
+#: ("Allocation (size=4194304) would exceed memory (size=1048576)",
+#: space=smem).  Products-scale collation gathers ~938k ids, so ANY
+#: lane-aligned table would have crashed here without this guard;
+#: bigger gathers fall back to the XLA take (measured at parity for
+#: large dense id sets anyway).
+_MAX_DMA_IDS = 1 << 17
+
 
 def pallas_enabled() -> bool:
   """Use Pallas kernels?  Default: only on a real TPU backend.
@@ -89,9 +99,11 @@ def gather_rows(table: jax.Array, idx: jax.Array, *,
   """Gather ``table[idx]`` rows via per-row async DMA.
 
   Callers use it unconditionally: it falls back to ``jnp.take`` when
-  Pallas is disabled (:func:`pallas_enabled`) or the table layout is
-  not DMA-able (unaligned ``D``, sub-32-bit dtype).  Out-of-range ids
-  are clamped to the last row, matching ``jnp.take``'s TPU semantics.
+  Pallas is disabled (:func:`pallas_enabled`), the table layout is
+  not DMA-able (unaligned ``D``, sub-32-bit dtype), or the id vector
+  exceeds the SMEM scalar-prefetch budget (`_MAX_DMA_IDS`).
+  Out-of-range ids are clamped to the last row, matching
+  ``jnp.take``'s TPU semantics.
 
   The env flag is re-read on every call (this plain wrapper dispatches
   to jitted implementations, so ``GLT_PALLAS=0`` works mid-process as
@@ -111,7 +123,8 @@ def gather_rows(table: jax.Array, idx: jax.Array, *,
       return _xla_take(table, idx)
     interpret = _interpret_default()
   d = table.shape[1]
-  if not interpret and (d % 128 != 0 or not _dma_supported(table.dtype)):
+  if not interpret and (d % 128 != 0 or not _dma_supported(table.dtype)
+                        or idx.shape[0] > _MAX_DMA_IDS):
     return _xla_take(table, idx)
   return _gather_rows_dma(table, idx, tile=tile, interpret=interpret)
 

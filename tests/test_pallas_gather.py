@@ -69,3 +69,30 @@ def test_feature_store_uses_kernel(monkeypatch):
   np.testing.assert_array_equal(out[1], np.zeros(128, np.float32))
   np.testing.assert_allclose(out[0], feats[5], rtol=0, atol=0)
   np.testing.assert_allclose(out[2], feats[199], rtol=0, atol=0)
+
+
+def test_dma_id_budget_routes_to_take(monkeypatch):
+  """Oversized id vectors must NEVER reach the DMA kernel: the ids
+  are scalar-prefetched into SMEM (1 MB), and products-scale
+  collation gathers ~938k ids — 4x the budget (r4 discovery: the
+  kernel aborts with an smem allocation error at 2^20 ids, so any
+  lane-aligned table at that batch would have crashed)."""
+  import jax.numpy as jnp
+  from graphlearn_tpu.ops import pallas_gather as pg
+  called = {}
+
+  def spy(table, idx, **k):
+    # no pass-through: reaching the kernel at all IS the failure, and
+    # the real kernel with interpret=False would die in lowering
+    # before the assert below could fire
+    called['dma'] = True
+    return pg._xla_take(table, idx)
+
+  monkeypatch.setattr(pg, '_gather_rows_dma', spy)
+  table = jnp.arange(64 * 128, dtype=jnp.float32).reshape(64, 128)
+  big = jnp.zeros((pg._MAX_DMA_IDS + 8,), jnp.int32)
+  out = pg.gather_rows(table, big, interpret=False)
+  assert 'dma' not in called, 'oversized ids reached the DMA kernel'
+  assert out.shape == (pg._MAX_DMA_IDS + 8, 128)
+  np.testing.assert_array_equal(np.asarray(out[0]),
+                                np.asarray(table[0]))
