@@ -46,6 +46,17 @@ def main():
                   help='train each epoch as ONE SPMD lax.scan program '
                        '(parallel.FusedDistEpoch; non-tiered stores, '
                        'static exchange slack)')
+  ap.add_argument('--split-ratio', type=float, default=1.0,
+                  help='< 1 tiers the feature store: hottest rows per '
+                       'shard in HBM, the rest in host DRAM (cold '
+                       'overlay per batch) — serves tables beyond '
+                       'aggregate HBM')
+  ap.add_argument('--host-local', action='store_true',
+                  help='with --partition-dir on a multi-host mesh: '
+                       'materialize only THIS process\'s partitions '
+                       '(tiered cold rows stay owner-side, edge '
+                       'features and the offline cache plan are '
+                       'served host-locally)')
   args = ap.parse_args()
 
   import jax
@@ -59,15 +70,24 @@ def main():
   mesh = make_mesh(num_parts)
 
   if args.partition_dir:
-    ds = DistDataset.from_partition_dir(args.partition_dir, num_parts)
+    from graphlearn_tpu.parallel import multihost
+    ds = DistDataset.from_partition_dir(
+        args.partition_dir, num_parts, split_ratio=args.split_ratio,
+        host_parts=(multihost.host_partition_ids(mesh)
+                    if args.host_local else None))
   else:
     rows, cols, feats, labels = synthetic()
     ds = DistDataset.from_full_graph(num_parts, rows, cols,
                                      node_feat=feats, node_label=labels,
-                                     num_nodes=len(labels))
+                                     num_nodes=len(labels),
+                                     split_ratio=args.split_ratio)
   assert ds.node_labels is not None, 'training needs labels'
   n = ds.graph.num_nodes
-  num_classes = int(np.max(np.asarray(ds.node_labels))) + 1
+  # host-local shards see only local labels: the class count (and so
+  # the model width) must agree GLOBALLY across processes
+  from graphlearn_tpu.parallel import multihost
+  num_classes = multihost.global_max(
+      int(np.max(np.asarray(ds.node_labels))), mesh) + 1
 
   bs = args.batch_size
   loader = DistNeighborLoader(ds, args.fanout, np.arange(n),
