@@ -166,7 +166,9 @@ def worker(fused_only: bool = False):
       # two warm runs: first compile, second the donated-input
       # recompile; the third run is the steady state.  Both compile
       # walls are REPORTED (VERDICT r3 #4: compile time is a real
-      # deployment cost and was untracked).
+      # deployment cost and was untracked), and the line is
+      # CHECKPOINTED after them so a timeout mid-measure still
+      # salvages the compile numbers.
       compile_secs = []
       for _ in range(2):
         t0 = time.perf_counter()
@@ -174,6 +176,7 @@ def worker(fused_only: bool = False):
         jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
         compile_secs.append(round(time.perf_counter() - t0, 1))
       result['fused_compile_secs'] = compile_secs
+      print(json.dumps(result), flush=True)
       t0 = time.perf_counter()
       state, _ = fused.run(state)
       jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
@@ -205,6 +208,15 @@ def worker(fused_only: bool = False):
     state, loss, _ = step(state, batch)
   jax.tree_util.tree_leaves(state.params)[0].block_until_ready()
   epoch_secs = time.perf_counter() - t0
+  # CHECKPOINT the line after every phase (same contract as the dist
+  # worker): a slow-day timeout mid-sampling or mid-gather must not
+  # cost the already-measured PRIMARY number — _run_session salvages
+  # the last complete line from partial stdout
+  result = {'epoch_secs': epoch_secs,
+            'compile_secs': round(compile_secs, 1),
+            'steps': len(loader), 'mode': 'primary',
+            'platform': platform}
+  print(json.dumps(result), flush=True)
 
   # secondary: sampling-only throughput, reference metric definition,
   # plus the window-bytes roofline fraction
@@ -226,6 +238,10 @@ def worker(fused_only: bool = False):
                   jnp.zeros((), jnp.int32)))
   sample_hbm = (iters * _sample_window_bytes(BATCH, FANOUT) / dt
                 / HBM_PEAK[platform] if platform in HBM_PEAK else None)
+  result.update(edges_per_sec=edges / dt,
+                sample_hbm_frac=(round(sample_hbm, 4)
+                                 if sample_hbm else None))
+  print(json.dumps(result), flush=True)
 
   # roofline phase: feature-store row gather as ONE long program (a
   # fori_loop of random-row gathers) so the tunnel's
@@ -268,19 +284,11 @@ def worker(fused_only: bool = False):
     gather_hbm = gather_bytes / gdt / HBM_PEAK[platform]
     gather_gbps = gather_bytes / gdt / 1e9
 
-  print(json.dumps({'epoch_secs': epoch_secs,
-                    'edges_per_sec': edges / dt,
-                    'compile_secs': round(compile_secs, 1),
-                    'sample_hbm_frac': (round(sample_hbm, 4)
-                                        if sample_hbm else None),
-                    'gather_hbm_frac': (round(gather_hbm, 4)
-                                        if gather_hbm else None),
-                    'gather_gbps': (round(gather_gbps, 1)
-                                    if gather_gbps else None),
-                    'steps': len(loader),
-                    'mode': 'primary',
-                    'platform': platform}),
-        flush=True)
+  result.update(gather_hbm_frac=(round(gather_hbm, 4)
+                                 if gather_hbm else None),
+                gather_gbps=(round(gather_gbps, 1)
+                             if gather_gbps else None))
+  print(json.dumps(result), flush=True)
 
 
 def dist_worker():
@@ -546,8 +554,12 @@ def _aggregate(results, fused_res, dist):
   metric string names which.  Printed after EVERY completed phase —
   the last JSON line on stdout is always the newest complete
   aggregate, so a kill at ANY point leaves a parseable artifact."""
-  ep = sorted(r['epoch_secs'] for r in results)
-  es = sorted(r['edges_per_sec'] for r in results)
+  # salvaged sessions may carry only a PREFIX of the phases (the
+  # worker checkpoints its line after each one) — aggregate whatever
+  # keys exist
+  ep = sorted(r['epoch_secs'] for r in results if 'epoch_secs' in r)
+  es = sorted(r['edges_per_sec'] for r in results
+              if 'edges_per_sec' in r)
   cs = sorted(r['compile_secs'] for r in results if 'compile_secs' in r)
   fu = ([fused_res['epoch_secs_fused']]
         if fused_res and 'epoch_secs_fused' in fused_res else [])
