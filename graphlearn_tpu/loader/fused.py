@@ -516,6 +516,16 @@ class FusedHeteroEpoch(_SupervisedScanEpoch):
         metadata={'seed_local': seed_locals[self.input_type]})
 
 
+def _as_edge_pairs(edge_label_index):
+  """Normalize ``(rows, cols)`` / ``[2, E]`` seed-edge forms — one
+  definition for `FusedLinkEpoch.__init__` and its `evaluate`."""
+  if isinstance(edge_label_index, (tuple, list)):
+    rows, cols = edge_label_index
+    return rows, cols
+  ei = np.asarray(edge_label_index)
+  return ei[0], ei[1]
+
+
 class FusedLinkEpoch:
   """One-program link-prediction (unsupervised) training epochs.
 
@@ -575,11 +585,7 @@ class FusedLinkEpoch:
                      hot=feat.hot_tier, id2index=feat._id2index_dev,
                      labels=data.get_node_label_device())
 
-    if isinstance(edge_label_index, (tuple, list)):
-      rows, cols = edge_label_index
-    else:
-      ei = np.asarray(edge_label_index)
-      rows, cols = ei[0], ei[1]
+    rows, cols = _as_edge_pairs(edge_label_index)
     self._batcher = EdgeSeedBatcher(rows, cols, edge_label,
                                     self.batch_size, shuffle, drop_last,
                                     seed)
@@ -599,12 +605,78 @@ class FusedLinkEpoch:
     self._epoch_idx = 0
     from ..models.train import make_unsupervised_step
     step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
+    self._apply = apply_fn            # un-remat'd: evaluate() is fwd-only
     self._step = make_unsupervised_step(step_apply, tx)
     self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
                              static_argnums=(6,))
+    self._compiled_eval = _uncached_jit(self._auc_fn,
+                                        static_argnums=(5,))
 
   def __len__(self) -> int:
     return len(self._batcher)
+
+  def _auc_fn(self, params, srcs: jax.Array, dsts: jax.Array,
+              key: jax.Array, dev: dict, use_pallas: bool):
+    """Scan body of `evaluate`: per batch, draw strict negatives,
+    expand + embed, score endpoint pairs, and accumulate the
+    pairwise (pos > neg) win counts — the batched rank-sum AUC."""
+    b = self.batch_size
+
+    def body(carry, xs):
+      i, src, dst = xs
+      batch = self._link_batch(src, dst, None,
+                               jax.random.fold_in(key, i), dev,
+                               use_pallas)
+      emb = self._apply(params, batch.x, batch.edge_index,
+                        batch.edge_mask)
+      eli = batch.metadata['edge_label_index']      # [2, b + nn]
+      mask = batch.metadata['edge_label_mask']
+      score = (emb[eli[0]] * emb[eli[1]]).sum(-1)
+      # binary layout is static: first b slots positive, rest negative
+      ps, ns = score[:b], score[b:]
+      pv, nv = mask[:b], mask[b:]
+      pair_ok = pv[:, None] & nv[None, :]
+      # float32 accumulation: int32 pair counts overflow past ~2k
+      # products-scale batches (b * nn pairs each)
+      wins = (jnp.sum((ps[:, None] > ns[None, :]) & pair_ok,
+                      dtype=jnp.float32)
+              + 0.5 * jnp.sum((ps[:, None] == ns[None, :]) & pair_ok,
+                              dtype=jnp.float32))
+      return carry, (wins, jnp.sum(pair_ok, dtype=jnp.float32))
+
+    steps = jnp.arange(srcs.shape[0], dtype=jnp.int32)
+    _, (wins, totals) = jax.lax.scan(body, 0, (steps, srcs, dsts))
+    return jnp.sum(wins), jnp.sum(totals)
+
+  def evaluate(self, params, edge_label_index, seed: int = 0) -> float:
+    """Held-out link AUC over ``edge_label_index`` as ONE scan
+    program — the fused counterpart of the reference's unsupervised
+    eval loop (score held-out positives against freshly drawn strict
+    negatives; `examples/graph_sage_unsup_ppi.py` computes the same
+    ranking metric on host).  Scores are embedding dot products (the
+    binary link objective's logit); the batched rank-sum estimator
+    averages all pos x neg comparisons per batch.  Binary mode only
+    (triplet mode's per-src negatives make precision@rank the right
+    metric instead)."""
+    if not self.neg.is_binary():
+      raise ValueError('evaluate() needs binary negative sampling')
+    rows, cols = _as_edge_pairs(edge_label_index)
+    if len(np.asarray(rows)) == 0:
+      raise ValueError('evaluate() got an empty split')
+    ev = EdgeSeedBatcher(rows, cols, None, self.batch_size,
+                         shuffle=False)
+    srcs, dsts = [], []
+    for r, c, _ in ev:
+      srcs.append(r)
+      dsts.append(c)
+    # eval fold domain disjoint from train epochs (see
+    # _SupervisedScanEpoch.evaluate)
+    key = jax.random.fold_in(jax.random.fold_in(self._base_key, 0),
+                             1 + seed)
+    wins, total = self._compiled_eval(
+        params, jnp.asarray(np.stack(srcs)), jnp.asarray(np.stack(dsts)),
+        key, self._dev, pallas_enabled())
+    return float(wins) / max(float(total), 1.0)
 
   def _link_batch(self, src: jax.Array, dst: jax.Array,
                   label: Optional[jax.Array], key: jax.Array,

@@ -281,3 +281,40 @@ def test_fused_matches_per_batch_loss_scale():
   for _ in range(10):
     s_fused, stats = fused.run(s_fused)
   assert abs(float(loss_loop) - stats['loss']) < 0.5
+
+
+def test_fused_link_evaluate_auc():
+  """`FusedLinkEpoch.evaluate`: held-out link AUC as one scan
+  program.  Untrained embeddings must score near chance; after
+  training on the clustered graph, held-out WITHIN-cluster edges
+  must rank above strict random negatives (mostly cross-cluster)."""
+  from graphlearn_tpu.loader import FusedLinkEpoch
+  ds, labels = _cluster_dataset()
+  g = ds.get_graph()
+  rows = np.repeat(np.arange(90), np.diff(np.asarray(g.indptr)))
+  cols = np.asarray(g.indices)
+  perm = np.random.default_rng(1).permutation(len(rows))
+  train_sel, eval_sel = perm[:256], perm[256:352]
+  model = GraphSAGE(hidden_features=16, out_features=8, num_layers=2)
+  import optax as _optax
+  tx = _optax.adam(1e-2)
+  loader = NeighborLoader(ds, [4, 3], np.arange(90), batch_size=32)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), next(iter(loader)), tx)
+  fused = FusedLinkEpoch(ds, [4, 3], (rows[train_sel], cols[train_sel]),
+                         apply_fn, tx, batch_size=32,
+                         neg_sampling='binary', shuffle=True, seed=0)
+  eval_edges = (rows[eval_sel], cols[eval_sel])
+  auc0 = fused.evaluate(state.params, eval_edges)
+  assert 0.2 < auc0 < 0.8, f'untrained AUC {auc0} not near chance'
+  for _ in range(20):
+    state, _ = fused.run(state)
+  auc1 = fused.evaluate(state.params, eval_edges)
+  assert auc1 > 0.8, f'trained AUC {auc1} <= 0.8'
+  assert auc1 > auc0
+  # triplet mode refuses: precision@rank is its metric, not this AUC
+  tri = FusedLinkEpoch(ds, [4, 3], eval_edges, apply_fn, tx,
+                       batch_size=32, neg_sampling=('triplet', 1),
+                       seed=0)
+  with pytest.raises(ValueError, match='binary'):
+    tri.evaluate(state.params, eval_edges)
