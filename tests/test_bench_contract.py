@@ -1,0 +1,98 @@
+"""The bench artifact contract, pinned (VERDICT r3 #1).
+
+Round 3 shipped rc=124 with NO perf number because the aggregate JSON
+printed only once, at the very end.  The contract since r4: the FULL
+cumulative aggregate prints after every completed phase, tolerates
+prefix-only (salvaged) session dicts, and the headline `value` is the
+fused whole-epoch time when the fused session landed.  These tests
+import the harness module directly (no chip, no subprocesses) and pin
+the schema a driver's last-JSON-line salvage depends on.
+"""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+_BENCH = Path(__file__).resolve().parent.parent / 'bench.py'
+
+
+@pytest.fixture(scope='module')
+def bench():
+  spec = importlib.util.spec_from_file_location('bench_under_test',
+                                                _BENCH)
+  mod = importlib.util.module_from_spec(spec)
+  argv = sys.argv
+  sys.argv = ['bench.py']
+  try:
+    spec.loader.exec_module(mod)
+  finally:
+    sys.argv = argv
+  return mod
+
+
+def _primary(**extra):
+  r = {'epoch_secs': 0.25, 'compile_secs': 6.0, 'steps': 200,
+       'mode': 'primary', 'platform': 'tpu'}
+  r.update(extra)
+  return r
+
+
+FULL = dict(edges_per_sec=1.6e9, sample_hbm_frac=0.11,
+            gather_hbm_frac=0.05, gather_gbps=38.0)
+
+
+def test_aggregate_full_schema(bench):
+  fused = {'mode': 'fused-session', 'platform': 'tpu',
+           'fused_compile_secs': [70.0, 66.0],
+           'epoch_secs_fused': 0.007}
+  dist = {'label': 'virtual CPU mesh - relative only',
+          'edges_per_sec_per_chip': 2e4}
+  out = bench._aggregate([_primary(**FULL)], fused, dist)
+  json.dumps(out)                         # must be JSON-serializable
+  assert out['metric'].startswith('graphsage_fused_epoch_secs')
+  assert out['value'] == 0.007            # fused IS the headline
+  assert out['vs_baseline'] == pytest.approx(2.0 / 0.007, rel=1e-3)
+  assert out['epoch_secs_min_med_max'][1] == 0.25
+  assert out['fused_compile_secs'] == [70.0, 66.0]
+  assert out['achieved_hbm_frac'] == {'sample': 0.11, 'gather': 0.05}
+  assert out['dist'] is dist
+
+
+def test_aggregate_prefix_only_sessions(bench):
+  """Salvaged sessions carry only the phases that finished: an
+  epoch-only line plus a compile-only fused line must still produce
+  a parseable aggregate with the per-batch headline."""
+  fused_partial = {'mode': 'fused-session', 'platform': 'tpu',
+                   'fused_compile_secs': [70.0, 66.0]}
+  out = bench._aggregate([_primary()], fused_partial, None)
+  json.dumps(out)
+  assert out['metric'].startswith('graphsage_epoch_secs')
+  assert out['value'] == 0.25
+  assert out['fused_epoch_secs'] is None
+  assert out['fused_compile_secs'] == [70.0, 66.0]
+  assert out['sampled_edges_per_sec_M_min_med_max'] is None
+  assert out['achieved_hbm_frac'] is None
+
+
+def test_aggregate_mixed_sessions_median(bench):
+  rs = [_primary(**FULL),
+        _primary(epoch_secs=0.35),           # salvaged: epoch only
+        _primary(epoch_secs=0.30, **FULL)]
+  out = bench._aggregate(rs, None, None)
+  assert out['epoch_secs_min_med_max'] == [0.25, 0.3, 0.35]
+  # sampling median over the two sessions that reached that phase
+  assert out['sampled_edges_per_sec_M_min_med_max'][1] == 1600.0
+  assert out['sessions'] == 3
+
+
+def test_aggregate_dist_only(bench):
+  """A day where every chip session dies must still leave a
+  parseable line with the dist numbers."""
+  dist = {'label': 'virtual CPU mesh - relative only'}
+  out = bench._aggregate([], None, dist)
+  json.dumps(out)
+  assert out['value'] is None
+  assert out['dist'] is dist
+  assert out['sessions'] == 0
