@@ -76,6 +76,49 @@ def build_graph_csr(num_nodes=NUM_NODES, avg_deg=AVG_DEG, seed=0):
   return indptr, indices.astype(np.int64), order.astype(np.int64)
 
 
+def build_graph_csr_device(num_nodes=NUM_NODES, avg_deg=AVG_DEG, seed=0):
+  """Device-side twin of `build_graph_csr`: the same power-law-ish
+  edge recipe (0.3 hub mixture, squared-uniform hub targets) generated
+  and CSR-sorted entirely on the accelerator.  Zero host↔device
+  transfer — on a tunneled chip the host CSR's ~0.5 GB upload swings
+  from ~3 s to minutes with tunnel weather, and it dominated the old
+  per-session fixed cost.  The graph is statistically identical to the
+  host generator's but NOT bit-identical (different RNG); same-seed
+  calls are deterministic across sessions, which is what
+  cross-session comparability needs.
+
+  Returns device ``(indptr, indices, edge_ids)`` for
+  ``Dataset.init_graph(layout='CSR')``'s device-native path.
+  """
+  import jax
+  import jax.numpy as jnp
+
+  @jax.jit
+  def build(key):
+    e = num_nodes * avg_deg
+    k1, k2, k3 = jax.random.split(key, 3)
+    rows = jax.random.randint(k1, (e,), 0, num_nodes, jnp.int32)
+    hub = jax.random.uniform(k2, (e,)) < 0.3
+    u = jax.random.uniform(k3, (e,))
+    hub_cols = (u * u * num_nodes).astype(jnp.int32)
+    unif_cols = (u * num_nodes).astype(jnp.int32)
+    cols = jnp.where(hub, hub_cols, unif_cols)
+    # canonical sorted-CSR (cols ascending within each row) via
+    # two-pass stable lexsort — a fused int64 key would truncate to
+    # int32 without jax_enable_x64; the strict-negative sampler's
+    # `edge_in_csr` binary search requires the sorted form
+    by_col = jnp.argsort(cols, stable=True)
+    order = by_col[jnp.argsort(rows[by_col], stable=True)]
+    indices = cols[order]
+    rows_sorted = rows[order]
+    indptr = jnp.searchsorted(
+        rows_sorted, jnp.arange(num_nodes + 1, dtype=jnp.int32),
+        side='left').astype(jnp.int32)
+    return indptr, indices, order.astype(jnp.int32)
+
+  return build(jax.random.key(seed))
+
+
 def emit(metric: str, value: float, unit: str, baseline: float = None,
          **extra):
   rec = {'metric': metric, 'value': round(float(value), 3), 'unit': unit}

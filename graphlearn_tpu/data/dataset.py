@@ -53,6 +53,16 @@ class Dataset:
     # retain the explicit hetero counts for num_nodes_dict()
     self._explicit_num_nodes = num_nodes if isinstance(num_nodes, dict) \
         else None
+    import jax
+    if (layout == 'CSR' and isinstance(edge_index, (tuple, list))
+        and len(edge_index) == 2
+        and isinstance(edge_index[0], jax.Array)):
+      # device-native path: arrays already on device in canonical
+      # sorted-CSR form (see `Graph.from_device_arrays`) — no host
+      # round trip, no re-sort
+      self.graph = Graph.from_device_arrays(edge_index[0], edge_index[1],
+                                            edge_ids=edge_ids)
+      return self
     if isinstance(edge_index, dict):
       topos = {}
       for etype, ei in edge_index.items():
@@ -116,6 +126,12 @@ class Dataset:
 
   def _build_feature(self, feats, id2idx, sort_func, split_ratio, device,
                      dtype, topo: Optional[CSRTopo]) -> Feature:
+    import jax
+    if isinstance(feats, jax.Array):
+      # device-native tables go straight to Feature (which validates
+      # split_ratio == 1.0); convert_to_array would pull them to host
+      return Feature(feats, id2index=id2idx, split_ratio=split_ratio,
+                     device=device, dtype=dtype)
     feats = convert_to_array(feats)
     if sort_func is not None and id2idx is None and topo is not None \
         and 0.0 < split_ratio < 1.0:
@@ -152,6 +168,12 @@ class Dataset:
     (`data/dataset.py:207-219`)."""
     if node_label_data is None:
       return self
+    import jax
+    if isinstance(node_label_data, jax.Array):
+      # device-native labels: already where collation needs them
+      self.node_labels = node_label_data
+      self._device_labels = {None: node_label_data}
+      return self
     if isinstance(node_label_data, dict):
       self.node_labels = {k: convert_to_array(v)
                           for k, v in node_label_data.items()}
@@ -171,8 +193,10 @@ class Dataset:
     if cache is None:
       cache = self._device_labels = {}
     if ntype not in cache:
+      import jax
       import jax.numpy as jnp
-      cache[ntype] = jnp.asarray(np.asarray(lab))
+      cache[ntype] = (lab if isinstance(lab, jax.Array)
+                      else jnp.asarray(np.asarray(lab)))
     return cache[ntype]
 
   def num_nodes_dict(self) -> Dict[NodeType, int]:

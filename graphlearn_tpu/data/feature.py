@@ -51,6 +51,33 @@ def _device_gather(hot: jax.Array, ids: jax.Array, id2index, *,
   return jnp.where(valid[:, None], out, 0)
 
 
+class _DeviceFeatsShim:
+  """Stand-in for ``_host_feats`` when the table was constructed from
+  a device array: shape/dtype metadata come from the device array;
+  element access (rare — `host_get` and test assertions) pulls the
+  table to host ONCE and caches it."""
+
+  def __init__(self, arr: jax.Array):
+    self._arr = arr
+    self._np = None
+
+  shape = property(lambda self: self._arr.shape)
+  dtype = property(lambda self: self._arr.dtype)
+  ndim = property(lambda self: self._arr.ndim)
+
+  def _pull(self) -> np.ndarray:
+    if self._np is None:
+      self._np = np.asarray(self._arr)
+    return self._np
+
+  def __getitem__(self, key):
+    return self._pull()[key]
+
+  def __array__(self, dtype=None):
+    a = self._pull()
+    return a if dtype is None else a.astype(dtype)
+
+
 class Feature:
   """Hot/cold split feature table addressed by global ids.
 
@@ -71,6 +98,30 @@ class Feature:
                split_ratio: float = 1.0,
                device: Optional[jax.Device] = None,
                dtype=None):
+    if isinstance(feature_array, jax.Array):
+      # device-native construction (tables produced on device — e.g.
+      # `benchmarks/common.build_products_device`): the array IS the
+      # hot tier; pulling it to host just to re-upload would cost a
+      # full tunnel round trip per GB.
+      if float(split_ratio) != 1.0:
+        raise ValueError('device-resident feature input requires '
+                         'split_ratio == 1.0 (a cold tier lives on '
+                         'host by definition)')
+      feats = feature_array if feature_array.ndim > 1 \
+          else feature_array[:, None]
+      self._host_feats = _DeviceFeatsShim(feats)
+      self._id2index_host = (np.asarray(id2index, dtype=np.int64)
+                             if id2index is not None
+                             and not isinstance(id2index, jax.Array)
+                             else None)
+      self.split_ratio = 1.0
+      self._device = device
+      self._dtype = dtype
+      self._hot = feats if dtype is None else feats.astype(dtype)
+      self._id2index_dev = (None if id2index is None
+                            else jnp.asarray(id2index, jnp.int32))
+      self.hot_rows = feats.shape[0]
+      return
     feats = convert_to_array(feature_array)
     if feats.ndim == 1:
       feats = feats[:, None]
@@ -138,6 +189,13 @@ class Feature:
     if (isinstance(ids, jax.Array)
         and self.hot_rows >= self._host_feats.shape[0]):
       return self._device_get(ids)
+    if self._id2index_dev is not None and self._id2index_host is None:
+      # device-native table with a device-only id2index: the host
+      # remap below would silently SKIP the mapping — route host ids
+      # through the all-device path instead (table is fully hot by
+      # the device-native constructor's contract)
+      return self._device_get(jnp.asarray(np.asarray(ids),
+                                          dtype=jnp.int32))
     ids_host = np.asarray(ids)
     valid = ids_host >= 0
     idx = np.where(valid, ids_host, 0)

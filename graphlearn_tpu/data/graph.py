@@ -20,6 +20,53 @@ import numpy as np
 from .topology import CSRTopo
 
 
+class DeviceCSRTopo:
+  """CSR topology whose arrays already live on device.
+
+  The device-native construction path: graphs built *on* the TPU
+  (synthetic benchmarks, on-device ETL, arrays produced by another jit
+  program) wrap here without a host round trip — ``np.asarray`` on a
+  1 GB device array would pull it through the tunnel just to push it
+  back.  The caller guarantees canonical sorted-CSR form (the
+  host-side :class:`~graphlearn_tpu.data.topology.CSRTopo` constructor
+  is where un-canonical input gets fixed up).  Host-only consumers
+  (``to_coo`` etc.) intentionally do not exist on this shim; accessing
+  ``indptr``/``indices`` yields the device arrays.
+  """
+
+  def __init__(self, indptr, indices, edge_ids=None):
+    self._indptr = indptr
+    self._indices = indices
+    self._edge_ids = edge_ids
+    self._max_degree = None
+
+  indptr = property(lambda self: self._indptr)
+  indices = property(lambda self: self._indices)
+  edge_ids = property(lambda self: self._edge_ids)
+
+  @property
+  def num_nodes(self) -> int:
+    return self._indptr.shape[0] - 1
+
+  @property
+  def num_edges(self) -> int:
+    return self._indices.shape[0]
+
+  @property
+  def degrees(self) -> jax.Array:
+    return self._indptr[1:] - self._indptr[:-1]
+
+  @property
+  def max_degree(self) -> int:
+    if self._max_degree is None:
+      self._max_degree = int(jnp.max(self.degrees))   # one scalar pull
+    return self._max_degree
+
+  def __repr__(self):
+    return (f'DeviceCSRTopo(num_nodes={self.num_nodes}, '
+            f'num_edges={self.num_edges})')
+
+
 class Graph:
   """A graph object holding topology ready for device sampling.
 
@@ -44,6 +91,29 @@ class Graph:
     self._indptr = None
     self._indices = None
     self._edge_ids = None
+
+  @classmethod
+  def from_device_arrays(cls, indptr: jax.Array, indices: jax.Array,
+                         edge_ids: Optional[jax.Array] = None) -> 'Graph':
+    """Wrap device-resident sorted-CSR arrays without a host round
+    trip (see :class:`DeviceCSRTopo`).  Dtypes are narrowed on device
+    (indices/edge_ids to int32; indptr to int32 when the edge count
+    allows), mirroring what `lazy_init` does for host input."""
+    num_edges = indices.shape[0]
+    ptr_dtype = (jnp.int32 if num_edges < np.iinfo(np.int32).max
+                 else jnp.int64)
+    g = cls.__new__(cls)
+    g.csr_topo = DeviceCSRTopo(indptr.astype(ptr_dtype),
+                               indices.astype(jnp.int32),
+                               None if edge_ids is None
+                               else edge_ids.astype(jnp.int32))
+    g.mode = 'device'
+    g._device = None
+    g.with_edge_ids = edge_ids is not None
+    g._indptr = g.csr_topo.indptr
+    g._indices = g.csr_topo.indices
+    g._edge_ids = g.csr_topo.edge_ids
+    return g
 
   # Lazy init mirrors reference `data/graph.py:160-188` (`lazy_init`).
   def lazy_init(self):

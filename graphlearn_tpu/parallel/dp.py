@@ -105,6 +105,28 @@ def make_dp_supervised_step(apply_fn: Callable,
   return step
 
 
+def make_dp_eval_step(apply_fn: Callable, batch_size: int, mesh: Mesh,
+                      axis: str = 'data'):
+  """SPMD evaluation step: ``(params, stacked_batch) -> (correct,
+  total)``, both psum-reduced over the mesh axis — the eval
+  counterpart of `make_dp_supervised_step` (mirrors the single-chip
+  `models.train.make_extracted_eval_step` contract)."""
+  from .shard_map_compat import shard_map
+
+  def per_device(params, batch):
+    batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+    logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+    valid = batch.batch >= 0
+    pred = jnp.argmax(logits[:batch_size], axis=-1)
+    correct = jax.lax.psum(
+        jnp.sum((pred == batch.y[:batch_size]) & valid), axis)
+    total = jax.lax.psum(jnp.sum(valid), axis)
+    return correct, total
+
+  return shard_map(per_device, mesh=mesh, in_specs=(P(), P(axis)),
+                   out_specs=(P(), P()))
+
+
 def make_dp_unsupervised_step(apply_fn: Callable,
                               tx: optax.GradientTransformation,
                               mesh: Mesh, axis: str = 'data'):

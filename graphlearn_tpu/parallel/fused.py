@@ -41,7 +41,8 @@ from .dist_data import DistDataset
 from .dist_sampler import (DistLinkNeighborSampler, DistNeighborSampler,
                            link_step_metadata, pack_link_seeds_relabeled,
                            resolve_exchange_slack)
-from .dp import make_dp_supervised_step, make_dp_unsupervised_step
+from .dp import (make_dp_eval_step, make_dp_supervised_step,
+                 make_dp_unsupervised_step)
 
 
 class FusedDistEpoch:
@@ -122,6 +123,9 @@ class FusedDistEpoch:
     self._dp_step = make_dp_supervised_step(step_apply, tx,
                                             self.batch_size, self.mesh,
                                             axis)
+    # un-remat'd: evaluate() is forward-only
+    self._dp_eval = make_dp_eval_step(apply_fn, self.batch_size,
+                                      self.mesh, axis)
     self._dist_step = self.sampler.step_for_batch(self.batch_size)
     # _uncached_jit: never serve this program from the persistent
     # compilation cache — deserialized big scan programs crash the
@@ -129,32 +133,42 @@ class FusedDistEpoch:
     # target-feature sets SIGILL (see loader.fused._fresh_compile)
     self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
                                    fast_compile=fast_compile)
+    self._compiled_eval = _uncached_jit(self._eval_fn,
+                                        fast_compile=fast_compile)
 
   def __len__(self) -> int:
     return len(self._batcher)
 
   # -- the one program ------------------------------------------------------
 
+  def _collate(self, seeds: jax.Array, key_i: jax.Array, arrs: dict):
+    """One fused distributed sample+collect: shared front half of the
+    train and eval scan bodies (the same program `DistNeighborSampler`
+    dispatches per batch)."""
+    from ..loader.transform import Batch
+    (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn,
+     stats) = self._dist_step(
+         arrs['indptr'], arrs['indices'], arrs['eids'], arrs['bounds'],
+         seeds, arrs['fshards'], arrs['lshards'], arrs['cids'],
+         arrs['crows'], arrs['efshards'], arrs['ebounds'],
+         arrs['hcounts'], key_i)
+    batch = Batch(
+        x=x, y=y, edge_index=jnp.stack([row, col], axis=1),
+        edge_attr=ef, node=nodes, node_mask=nodes >= 0,
+        edge_mask=row >= 0, edge=edge, batch=seeds,
+        batch_size=self.batch_size,
+        num_sampled_nodes=nsn, metadata={'seed_local': seed_local})
+    return batch, stats
+
   def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
                 key: jax.Array, arrs: dict):
     """``[S, P, B]`` seed batches → S fused exchange+collect+train
     steps; outputs per-step losses and the summed telemetry."""
-    from ..loader.transform import Batch
 
     def body(state, xs):
       i, seeds = xs
-      (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn,
-       stats) = self._dist_step(
-           arrs['indptr'], arrs['indices'], arrs['eids'], arrs['bounds'],
-           seeds, arrs['fshards'], arrs['lshards'], arrs['cids'],
-           arrs['crows'], arrs['efshards'], arrs['ebounds'],
-           arrs['hcounts'], jax.random.fold_in(key, i))
-      batch = Batch(
-          x=x, y=y, edge_index=jnp.stack([row, col], axis=1),
-          edge_attr=ef, node=nodes, node_mask=nodes >= 0,
-          edge_mask=row >= 0, edge=edge, batch=seeds,
-          batch_size=self.batch_size,
-          num_sampled_nodes=nsn, metadata={'seed_local': seed_local})
+      batch, stats = self._collate(seeds, jax.random.fold_in(key, i),
+                                   arrs)
       state, loss, correct = self._dp_step(state, batch)
       return state, (loss, correct, jnp.sum(seeds >= 0), stats)
 
@@ -163,6 +177,53 @@ class FusedDistEpoch:
         body, state, (steps, seeds_all))
     return (state, losses, jnp.sum(corrects), jnp.sum(valids),
             jnp.sum(stats, axis=0))
+
+  def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
+               arrs: dict):
+    """Scan twin of an eval loop over ``[S, P, B]`` seeds — accuracy
+    on the seed slots, psum'd over the mesh (`make_dp_eval_step`)."""
+
+    def body(carry, xs):
+      i, seeds = xs
+      batch, stats = self._collate(seeds, jax.random.fold_in(key, i),
+                                   arrs)
+      correct, total = self._dp_eval(params, batch)
+      return carry, (correct, total, stats)
+
+    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
+    _, (correct, total, stats) = jax.lax.scan(
+        body, 0, (steps, seeds_all))
+    return jnp.sum(correct), jnp.sum(total), jnp.sum(stats, axis=0)
+
+  def evaluate(self, params, input_nodes,
+               input_space: str = 'old') -> float:
+    """Accuracy over ``input_nodes`` (e.g. the test split) as ONE
+    SPMD scan program — the mesh twin of
+    `loader.fused._SupervisedScanEpoch.evaluate`
+    (VERDICT r4 #5: dist fused training could not eval without
+    leaving the fused path)."""
+    from ..loader.node_loader import SeedBatcher
+    ids = np.asarray(input_nodes).reshape(-1)
+    if ids.dtype == np.bool_:
+      ids = np.nonzero(ids)[0]
+    if ids.size == 0:
+      raise ValueError('evaluate() got an empty split')
+    if input_space == 'old' and self.ds.old2new is not None:
+      ids = self.ds.old2new[ids]
+    ev = SeedBatcher(ids, self.batch_size * self.num_parts,
+                     shuffle=False)
+    seeds = np.stack(list(ev)).reshape(-1, self.num_parts,
+                                       self.batch_size)
+    # eval keys live in their own fold DOMAIN (base -> 0 -> 1); train
+    # keys are base -> epoch with epoch >= 1 (loader.fused contract)
+    key = jax.random.fold_in(jax.random.fold_in(self._base_key, 0), 1)
+    seeds_dev = jax.device_put(
+        seeds.astype(np.int32),
+        NamedSharding(self.mesh, P(None, self.axis)))
+    correct, total, stats = self._compiled_eval(
+        params, seeds_dev, key, self.sampler._arrays())
+    self.sampler._accumulate_stats(stats)
+    return float(int(correct) / max(int(total), 1))
 
   # -- host driver ----------------------------------------------------------
 
@@ -256,8 +317,11 @@ class FusedDistLinkEpoch:
                                               axis)
     self._dist_step = self.sampler.step_for_pairs(
         self.batch_size, self.pairs.shape[1])
+    self._apply = apply_fn            # un-remat'd: evaluate() is fwd-only
     self._compiled = _uncached_jit(       # see FusedDistEpoch note
         self._epoch_fn, donate_argnums=(0,), fast_compile=fast_compile)
+    self._compiled_eval = _uncached_jit(self._auc_fn,
+                                        fast_compile=fast_compile)
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -268,27 +332,11 @@ class FusedDistLinkEpoch:
                 key: jax.Array, arrs: dict):
     """``[S, P, B, 2|3]`` seed-edge batches → S fused
     negatives+exchange+collect+train steps."""
-    from ..loader.transform import Batch
 
     def body(state, xs):
       i, pairs = xs
-      (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn, stats,
-       eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
-          self._dist_step(
-              arrs['indptr'], arrs['indices'], arrs['eids'],
-              arrs['bounds'], pairs, arrs['fshards'], arrs['lshards'],
-              arrs['cids'], arrs['crows'], arrs['efshards'],
-              arrs['ebounds'], arrs['hcounts'],
-              jax.random.fold_in(key, i))
-      md = link_step_metadata(self.sampler.neg_mode, seed_local, eli,
-                              elab, elab_mask, src_idx, dst_pos,
-                              dst_neg)
-      batch = Batch(
-          x=x, y=y, edge_index=jnp.stack([row, col], axis=1),
-          edge_attr=ef, node=nodes, node_mask=nodes >= 0,
-          edge_mask=row >= 0, edge=edge, batch=pairs[:, :, 0],
-          batch_size=self.batch_size, num_sampled_nodes=nsn,
-          metadata=md)
+      batch, stats = self._link_batch(pairs, jax.random.fold_in(key, i),
+                                      arrs)
       state, loss = self._dp_step(state, batch)
       valid = jnp.sum((pairs[:, :, 0] >= 0) & (pairs[:, :, 1] >= 0))
       return state, (loss, valid, stats)
@@ -297,6 +345,105 @@ class FusedDistLinkEpoch:
     state, (losses, valids, stats) = jax.lax.scan(
         body, state, (steps, pairs_all))
     return state, losses, jnp.sum(valids), jnp.sum(stats, axis=0)
+
+  def _link_batch(self, pairs: jax.Array, key_i: jax.Array, arrs: dict):
+    """One fused distributed link sample+collect (negatives +
+    endpoint expansion + features): shared front half of the train
+    and eval scan bodies."""
+    from ..loader.transform import Batch
+    (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn, stats,
+     eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
+        self._dist_step(
+            arrs['indptr'], arrs['indices'], arrs['eids'],
+            arrs['bounds'], pairs, arrs['fshards'], arrs['lshards'],
+            arrs['cids'], arrs['crows'], arrs['efshards'],
+            arrs['ebounds'], arrs['hcounts'], key_i)
+    md = link_step_metadata(self.sampler.neg_mode, seed_local, eli,
+                            elab, elab_mask, src_idx, dst_pos, dst_neg)
+    batch = Batch(
+        x=x, y=y, edge_index=jnp.stack([row, col], axis=1),
+        edge_attr=ef, node=nodes, node_mask=nodes >= 0,
+        edge_mask=row >= 0, edge=edge, batch=pairs[:, :, 0],
+        batch_size=self.batch_size, num_sampled_nodes=nsn, metadata=md)
+    return batch, stats
+
+  def _auc_fn(self, params, pairs_all: jax.Array, key: jax.Array,
+              arrs: dict):
+    """Scan body of `evaluate`: per batch, the full distributed link
+    step (fresh strict negatives), per-device embedding + pairwise
+    (pos > neg) win counts, psum'd over the mesh — the SPMD twin of
+    `loader.fused.FusedLinkEpoch._auc_fn` (batched rank-sum AUC,
+    per-device positive/negative blocks)."""
+    from .shard_map_compat import shard_map
+    b, axis = self.batch_size, self.axis
+
+    def per_device(params, batch):
+      batch = jax.tree_util.tree_map(lambda v: v[0], batch)
+      emb = self._apply(params, batch.x, batch.edge_index,
+                        batch.edge_mask)
+      eli = batch.metadata['edge_label_index']      # [2, b + nn]
+      mask = batch.metadata['edge_label_mask']
+      score = (emb[eli[0]] * emb[eli[1]]).sum(-1)
+      ps, ns = score[:b], score[b:]
+      pv, nv = mask[:b], mask[b:]
+      pair_ok = pv[:, None] & nv[None, :]
+      # float32 accumulation: int32 pair counts overflow past ~2k
+      # products-scale batches
+      wins = (jnp.sum((ps[:, None] > ns[None, :]) & pair_ok,
+                      dtype=jnp.float32)
+              + 0.5 * jnp.sum((ps[:, None] == ns[None, :]) & pair_ok,
+                              dtype=jnp.float32))
+      wins = jax.lax.psum(wins, axis)
+      total = jax.lax.psum(jnp.sum(pair_ok, dtype=jnp.float32), axis)
+      return wins, total
+
+    auc_step = shard_map(per_device, mesh=self.mesh,
+                         in_specs=(P(), P(self.axis)),
+                         out_specs=(P(), P()))
+
+    def body(carry, xs):
+      i, pairs = xs
+      batch, stats = self._link_batch(pairs, jax.random.fold_in(key, i),
+                                      arrs)
+      wins, total = auc_step(params, batch)
+      return carry, (wins, total, stats)
+
+    steps = jnp.arange(pairs_all.shape[0], dtype=jnp.int32)
+    _, (wins, totals, stats) = jax.lax.scan(body, 0, (steps, pairs_all))
+    return jnp.sum(wins), jnp.sum(totals), jnp.sum(stats, axis=0)
+
+  def evaluate(self, params, edge_label_index,
+               input_space: str = 'old') -> float:
+    """Held-out link AUC over ``edge_label_index`` as ONE SPMD scan
+    program — the mesh twin of `loader.fused.FusedLinkEpoch.evaluate`
+    (VERDICT r4 #5).  Binary negative-sampling mode only (triplet
+    mode's per-src negatives make precision@rank the right metric)."""
+    from ..loader.node_loader import SeedBatcher
+    if self.sampler.neg_mode != 'binary':
+      raise ValueError('evaluate() needs binary negative sampling')
+    pairs = pack_link_seeds_relabeled(edge_label_index, None, 'binary',
+                                      self.ds, input_space)
+    if pairs.shape[0] == 0:
+      raise ValueError('evaluate() got an empty split')
+    # eval batches must carry the SAME pair width the compiled dist
+    # step was built for
+    if pairs.shape[1] != self.pairs.shape[1]:
+      pad = np.ones((pairs.shape[0],
+                     self.pairs.shape[1] - pairs.shape[1]), np.int64)
+      pairs = np.concatenate([pairs, pad], axis=1)
+    ev = SeedBatcher(pairs, self.batch_size * self.num_parts,
+                     shuffle=False)
+    stacked = np.stack(list(ev)).reshape(-1, self.num_parts,
+                                         self.batch_size,
+                                         pairs.shape[1])
+    key = jax.random.fold_in(jax.random.fold_in(self._base_key, 0), 1)
+    pairs_dev = jax.device_put(
+        stacked.astype(np.int32),
+        NamedSharding(self.mesh, P(None, self.axis)))
+    wins, total, stats = self._compiled_eval(
+        params, pairs_dev, key, self.sampler._arrays())
+    self.sampler._accumulate_stats(stats)
+    return float(wins) / max(float(total), 1.0)
 
   # -- host driver ----------------------------------------------------------
 

@@ -77,6 +77,12 @@ def test_fused_dist_epoch_trains():
   # telemetry flowed out of the fused program
   st = fused.sampler.exchange_stats(tick_metrics=False)
   assert st['dist.frontier.offered'] > 0
+  # evaluate(): one SPMD scan program, same graph as the train split's
+  # accuracy (VERDICT r4 #5 — dist fused eval without leaving the
+  # fused path).  Params are replicated; pass the replicated leaf tree.
+  acc = fused.evaluate(state.params, np.arange(N))
+  assert acc > 0.6
+  assert abs(acc - stats['accuracy']) < 0.25
 
 
 def test_fused_dist_matches_per_batch_engine():
@@ -145,6 +151,10 @@ def test_fused_dist_link_epoch_trains():
   assert stats['loss'] < 0.67
   st = fused.sampler.exchange_stats(tick_metrics=False)
   assert st['dist.frontier.offered'] > 0
+  # evaluate(): held-out link AUC as one SPMD scan program — trained
+  # positives must rank above fresh strict negatives (VERDICT r4 #5)
+  auc = fused.evaluate(state.params, (rows[:128], cols[:128]))
+  assert 0.6 < auc <= 1.0
 
 
 def _neighbors_of(ds, r):
