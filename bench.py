@@ -85,9 +85,15 @@ SAMPLE_ITERS = 30
 EPOCHS_PER_SESSION = 2
 
 #: dist section: smaller graph (CPU mesh), reference bench workload
+#: shape at half batch — r5 shrank it (batch 1024, 4 batches/epoch,
+#: 500k nodes needed ~100 s/batch on the 8x-oversubscribed virtual
+#: mesh and could not finish 3 adaptive epochs inside any budget);
+#: numbers remain RELATIVE, the config is in the artifact
 DIST_PARTS = 8
-DIST_NODES = 500_000
+DIST_NODES = 200_000
 DIST_DIM = 64
+DIST_BATCH = 512
+DIST_BATCHES_PER_EPOCH = 2
 
 
 def _pull(x) -> float:
@@ -114,6 +120,22 @@ def _sample_window_bytes(batch, fanouts):
     total += frontier * default_window(k) * 4
     frontier *= k
   return total
+
+
+def _tree_step_flops(batch, fanouts, dim, hidden, classes):
+  """Analytic fwd+bwd matmul FLOPs of one tree-layout SAGE step
+  (`models.tree.TreeSAGE`): layer ``l`` applies its self+neighbor
+  matmul pair to every level that still matters."""
+  sizes = [batch]
+  for k in fanouts:
+    sizes.append(sizes[-1] * int(k))
+  num_layers = len(fanouts)
+  dims = [dim] + [hidden] * (num_layers - 1) + [classes]
+  fwd = 0
+  for l in range(num_layers):
+    rows = sum(sizes[t] for t in range(num_layers - l))
+    fwd += 2 * rows * dims[l] * dims[l + 1] * 2
+  return 3 * fwd
 
 
 def _sage_step_flops(node_cap, fanouts, batch, dim, hidden, classes,
@@ -205,66 +227,61 @@ def worker(fused_only: bool = False):
       model, jax.random.key(0), first_batch, tx)
 
   if fused_only:
+    # the fused HEADLINE is the TREE-LAYOUT epoch (`FusedTreeEpoch` —
+    # scatter-free, sort-free; measured 12x the subgraph fused path's
+    # step rate on this chip, r5 decomposition in
+    # loader/fused_tree.py).  The subgraph fused path (the reference's
+    # dedup estimator) is measured after it when budget remains.
+    tree_flops = _tree_step_flops(BATCH, FANOUT, DIM, 256, CLASSES)
     result = {'mode': 'fused-session', 'platform': platform,
               'epoch_floor_secs': round(epoch_floor, 4),
+              'fused_layout': 'tree',
+              'tree_step_flops': tree_flops,
               'setup_secs': setup_secs, 'steps': steps}
     try:
-      from graphlearn_tpu.loader import FusedEpoch
-      fused = FusedEpoch(ds, list(FANOUT), train_idx, apply_fn, tx,
-                         batch_size=BATCH, shuffle=True, seed=0,
-                         remat=True)
-      # wall 1 = compile + first run; wall 2 = the donated-layout
-      # recompile + run.  Both compile walls are REPORTED and the
-      # line is CHECKPOINTED after them (timeout salvage).
-      compile_secs = []
-      for _ in range(2):
-        t0 = time.perf_counter()
-        state, _ = fused.run(state)
-        _pull_state(state)
-        compile_secs.append(round(time.perf_counter() - t0, 1))
-      result['fused_compile_secs'] = compile_secs
+      # chunked programs are watchdog-safe AND cache-safe (r5 re-test,
+      # `loader.fused._uncached_jit` docstring) — opt into the
+      # persistent cache so later sessions/rounds compile in ~12 s
+      os.environ.setdefault('GLT_FUSED_COMPILE_CACHE', '1')
+      from graphlearn_tpu.loader import FusedEpoch, FusedTreeEpoch
+      from graphlearn_tpu.models import TreeSAGE
+      tree = TreeSAGE(hidden_features=256, out_features=CLASSES,
+                      num_layers=3)
+      fused = FusedTreeEpoch(ds, list(FANOUT), train_idx, tree, tx,
+                             batch_size=BATCH, shuffle=True, seed=0,
+                             max_steps_per_program=100)
+      tstate = fused.init_state(jax.random.key(0))
+      t0 = time.perf_counter()
+      tstate, _ = fused.run(tstate)
+      _pull_state(tstate)
+      result['fused_compile_secs'] = round(time.perf_counter() - t0, 1)
       print(json.dumps(result), flush=True)
       runs = []
       for _ in range(3):            # distinct epoch keys per run
         t0 = time.perf_counter()
-        state, _ = fused.run(state)
-        _pull_state(state)
+        tstate, _ = fused.run(tstate)
+        _pull_state(tstate)
         runs.append(round(time.perf_counter() - t0, 4))
       result['fused_epoch_runs'] = runs
       med = statistics.median(runs)
       result['epoch_secs_fused'] = med
       result['suspect_elision'] = bool(med < epoch_floor)
       result['train_step_mfu'] = (
-          round(step_flops / (med / steps) / F32_PEAK, 4)
+          round(tree_flops / (med / steps) / F32_PEAK, 4)
           if med >= epoch_floor else None)
       print(json.dumps(result), flush=True)
-      # bf16 variant: bf16 feature storage + bf16 model compute (the
-      # TPU-idiomatic config — MXU at half precision, f32 params).
-      # Reported alongside, not as the headline, until the acceptance
-      # harness validates accuracy parity on real data.  Reuses the
-      # existing device graph (only the table dtype differs) instead
-      # of re-sorting 61M edges into a duplicate CSR.
-      from graphlearn_tpu.data import Dataset
-      model16 = GraphSAGE(hidden_features=256, out_features=CLASSES,
-                          num_layers=3, dtype=jnp.bfloat16)
-      g = ds.get_graph()
-      ds16 = (Dataset()
-              .init_graph((g.indptr, g.indices), layout='CSR',
-                          num_nodes=n)
-              .init_node_features(
-                  ds.node_features.hot_tier.astype(jnp.bfloat16))
-              .init_node_labels(ds.get_node_label_device()))
-      state16, apply16 = create_train_state(
-          model16, jax.random.key(0), first_batch, tx)
-      fused16 = FusedEpoch(ds16, list(FANOUT), train_idx, apply16, tx,
-                           batch_size=BATCH, shuffle=True, seed=0,
-                           remat=True)
+      # bf16 compute variant (MXU half precision, f32 params)
+      tree16 = TreeSAGE(hidden_features=256, out_features=CLASSES,
+                        num_layers=3, dtype=jnp.bfloat16)
+      fused16 = FusedTreeEpoch(ds, list(FANOUT), train_idx, tree16, tx,
+                               batch_size=BATCH, shuffle=True, seed=0,
+                               max_steps_per_program=100)
+      state16 = fused16.init_state(jax.random.key(0))
       t0 = time.perf_counter()
-      for _ in range(2):            # compile + donated-layout recompile
-        state16, _ = fused16.run(state16)
-        _pull_state(state16)
-      result['fused_bf16_compile_secs'] = round(time.perf_counter() - t0,
-                                                1)
+      state16, _ = fused16.run(state16)
+      _pull_state(state16)
+      result['fused_bf16_compile_secs'] = round(
+          time.perf_counter() - t0, 1)
       runs16 = []
       for _ in range(2):
         t0 = time.perf_counter()
@@ -273,9 +290,36 @@ def worker(fused_only: bool = False):
         runs16.append(round(time.perf_counter() - t0, 4))
       result['fused_epoch_runs_bf16'] = runs16
       med16 = statistics.median(runs16)
-      # bf16 floor: half the table-read bytes
+      # same floor as f32: only the COMPUTE dtype is bf16 here — the
+      # feature table (the floor's byte source) stays f32
       result['fused_epoch_secs_bf16'] = (
-          med16 if med16 >= epoch_floor / 2 else None)
+          med16 if med16 >= epoch_floor else None)
+      print(json.dumps(result), flush=True)
+      # subgraph fused path (the reference's dedup estimator), chunked
+      # under the tunnel's ~70 s execution watchdog.  Measured on a
+      # 96-step SUBSET (one chunk): a full 200-step epoch of this
+      # path runs ~90 s (its step is scatter-bound, the very thing
+      # the tree layout removes) and would not fit the session budget
+      # — the artifact reports its honest ms/step instead.
+      if os.environ.get('GLT_BENCH_SUBGRAPH_FUSED', '1') != '0':
+        sub_steps = 96
+        sub = FusedEpoch(ds, list(FANOUT), train_idx[:BATCH * sub_steps],
+                         apply_fn, tx, batch_size=BATCH, shuffle=True,
+                         seed=0, remat=True,
+                         max_steps_per_program=sub_steps)
+        t0 = time.perf_counter()
+        state, _ = sub.run(state)
+        _pull_state(state)
+        result['fused_subgraph_compile_secs'] = round(
+            time.perf_counter() - t0, 1)       # compile + first run
+        t0 = time.perf_counter()
+        state, _ = sub.run(state)
+        _pull_state(state)
+        sub_dt = time.perf_counter() - t0
+        result['fused_subgraph_ms_per_step'] = round(
+            1000 * sub_dt / sub_steps, 1)
+        result['fused_subgraph_epoch_secs_est'] = round(
+            sub_dt / sub_steps * steps, 2)
     except Exception as e:          # noqa: BLE001
       result['fused_error'] = f'{type(e).__name__}: {e}'[:200]
     print(json.dumps(result), flush=True)
@@ -379,9 +423,10 @@ def worker(fused_only: bool = False):
   # widths 256B-16KB; `ops/pallas_gather.py` documents the kernel
   # attempts) — achieved/achievable is reported against the best
   # measured row rate this session.
-  if peak and n >= (1 << 22):
+  if peak and n > (1 << 21) + 8:
     # (the n guard keeps the GLT_BENCH_NODES smoke knob from driving
-    # randint maxval negative and measuring clamped garbage accesses)
+    # randint maxval negative — ids span [start, start + 2*grows) —
+    # and measuring clamped garbage accesses)
     grows = 1 << 20
     from jax import lax
 
@@ -437,6 +482,95 @@ def worker(fused_only: bool = False):
   print(json.dumps(result), flush=True)
 
 
+#: hetero session: ogbn-mag-scale synthetic (reference workload:
+#: `examples/hetero/train_hgt_mag.py:102-121` — paper/author/cites/
+#: writes, 349 classes)
+MAG_PAPER, MAG_AUTHOR, MAG_CLASSES, MAG_DIM = 736_389, 1_134_649, 349, 128
+
+
+def hetero_worker():
+  """On-chip `FusedHeteroEpoch` measurement (VERDICT r4 #8): RGCN
+  training epochs on a device-built MAG-scale hetero graph as one
+  scan program per chunk, pull-protocol timed."""
+  import jax
+  try:
+    jax.config.update('jax_compilation_cache_dir', '/tmp/glt_jax_cache')
+  except Exception:
+    pass
+  if '--cpu' in sys.argv:
+    jax.config.update('jax_platforms', 'cpu')
+  import jax.numpy as jnp
+  import optax
+  os.environ.setdefault('GLT_FUSED_COMPILE_CACHE', '1')
+  from benchmarks.common import build_bipartite_csr_device
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.loader import FusedHeteroEpoch, NeighborLoader  # noqa: F401
+  from graphlearn_tpu.models import RGCN
+  from graphlearn_tpu.models.train import TrainState
+
+  t_setup = time.perf_counter()
+  np_, na = MAG_PAPER, MAG_AUTHOR
+  if os.environ.get('GLT_BENCH_NODES'):          # smoke knob
+    np_ = int(os.environ['GLT_BENCH_NODES'])
+    na = np_ * 3 // 2
+  P_, A = 'paper', 'author'
+  cites = build_bipartite_csr_device(np_, np_, 7, seed=1)
+  writes = build_bipartite_csr_device(na, np_, 7, seed=2)
+  rev = build_bipartite_csr_device(np_, na, 4, seed=3)
+  kf1, kf2, kl = jax.random.split(jax.random.key(9), 3)
+  etypes = {(P_, 'cites', P_): cites, (A, 'writes', P_): writes,
+            (P_, 'rev_writes', A): rev}
+  ds = (Dataset()
+        .init_graph(etypes, layout='CSR',
+                    num_nodes={P_: np_, A: na})
+        .init_node_features(
+            {P_: jax.random.uniform(kf1, (np_, MAG_DIM), jnp.float32),
+             A: jax.random.uniform(kf2, (na, MAG_DIM), jnp.float32)})
+        .init_node_labels(
+            {P_: jax.random.randint(kl, (np_,), 0, MAG_CLASSES,
+                                    jnp.int32)}))
+  _pull(ds.node_features[P_].hot_tier[0])
+  result = {'mode': 'hetero-session',
+            'platform': jax.devices()[0].platform,
+            'setup_secs': round(time.perf_counter() - t_setup, 1),
+            'paper': np_, 'author': na, 'classes': MAG_CLASSES}
+  batch, fanouts, steps = 512, [10, 10], 64
+  train_idx = np.random.default_rng(0).permutation(np_)[:batch * steps]
+  model = RGCN(etypes=tuple(etypes.keys()), hidden_features=128,
+               out_features=MAG_CLASSES, num_layers=2,
+               target_ntype=P_)
+  tx = optax.adam(1e-3)
+  fused = FusedHeteroEpoch(ds, fanouts, (P_, train_idx), model.apply,
+                           tx, batch_size=batch, shuffle=True, seed=0,
+                           max_steps_per_program=steps)
+  result.update(batch=batch, fanouts=fanouts, steps=steps)
+  # init params from one tiny traced batch via the fused machinery's
+  # own collation (shapes only)
+  seeds0 = jnp.asarray(train_idx[:batch].astype(np.int32))
+  b0 = fused._sample_collate(seeds0, jax.random.key(0), fused._dev,
+                             False)
+  params = model.init(jax.random.key(0), b0.x_dict,
+                      b0.edge_index_dict, b0.edge_mask_dict)
+  state = TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+  t0 = time.perf_counter()
+  state, _ = fused.run(state)
+  _pull_state(state)
+  result['fused_hetero_compile_secs'] = round(time.perf_counter() - t0,
+                                              1)
+  print(json.dumps(result), flush=True)
+  runs = []
+  for _ in range(2):
+    t0 = time.perf_counter()
+    state, stats = fused.run(state)
+    _pull_state(state)
+    runs.append(round(time.perf_counter() - t0, 4))
+  result['fused_hetero_epoch_runs'] = runs
+  result['fused_hetero_epoch_secs'] = statistics.median(runs)
+  result['fused_hetero_ms_per_step'] = round(
+      1000 * statistics.median(runs) / steps, 1)
+  print(json.dumps(result), flush=True)
+
+
 def dist_worker():
   """P=8 virtual-mesh distributed loader run (VERDICT r4 #3): the
   reference dist-bench workload (batch 1024, fanout [15,10,5]) on the
@@ -462,9 +596,11 @@ def dist_worker():
   ds = DistDataset.from_full_graph(DIST_PARTS, rows, cols,
                                    node_feat=feats, node_label=labels,
                                    num_nodes=DIST_NODES)
-  seeds = rng.permutation(DIST_NODES)[:BATCH * DIST_PARTS * 4]
+  seeds = rng.permutation(DIST_NODES)[
+      :DIST_BATCH * DIST_PARTS * DIST_BATCHES_PER_EPOCH]
   mesh = make_mesh(DIST_PARTS)
-  loader = DistNeighborLoader(ds, list(FANOUT), seeds, batch_size=BATCH,
+  loader = DistNeighborLoader(ds, list(FANOUT), seeds,
+                              batch_size=DIST_BATCH,
                               shuffle=True, mesh=mesh, seed=0,
                               exchange_slack='adaptive')
   epochs = int(os.environ.get('GLT_BENCH_DIST_EPOCHS', 3))
@@ -489,13 +625,14 @@ def dist_worker():
       st['dist.frontier.offered'], 1)
   out = {
       'label': 'virtual CPU mesh - relative only',
-      'num_parts': DIST_PARTS, 'batch': BATCH, 'fanout': list(FANOUT),
+      'num_parts': DIST_PARTS, 'batch': DIST_BATCH,
+      'fanout': list(FANOUT),
       'num_nodes': DIST_NODES, 'batches': n_batches, 'epochs': epochs,
       'compile_secs': round(compile_secs or 0.0, 1),
       'edges_per_sec_per_chip': round(
           edges / max(dt - (compile_secs or 0), 1e-9) / DIST_PARTS, 1),
       'seeds_per_sec': round(
-          n_batches * BATCH * DIST_PARTS
+          n_batches * DIST_BATCH * DIST_PARTS
           / max(dt - (compile_secs or 0), 1e-9), 1),
       'exchange_slack': 'adaptive',
       'padding_waste_pct_by_epoch': waste_by_epoch,
@@ -513,9 +650,8 @@ def dist_worker():
                                      split_ratio=0.3)
   # prefetch=2: the next batch's cold-tier overlay (a host sync) runs
   # on a worker thread while the current batch computes
-  lt = DistNeighborLoader(ds_t, list(FANOUT),
-                          seeds[:BATCH * DIST_PARTS * 4],
-                          batch_size=BATCH, shuffle=True,
+  lt = DistNeighborLoader(ds_t, list(FANOUT), seeds,
+                          batch_size=DIST_BATCH, shuffle=True,
                           mesh=mesh, seed=0, prefetch=2)
   it = iter(lt)
   b = next(it)
@@ -529,8 +665,8 @@ def dist_worker():
   st_t = lt.sampler.exchange_stats(tick_metrics=False)
   out['tiered'] = {
       'split_ratio': 0.3, 'prefetch': 2,
-      'seeds_per_sec': round(nt * BATCH * DIST_PARTS / max(dt_t, 1e-9),
-                             1),
+      'seeds_per_sec': round(
+          nt * DIST_BATCH * DIST_PARTS / max(dt_t, 1e-9), 1),
       'cold_hit_rate': round(st_t['dist.feature.cold_hit_rate'], 4),
       'cold_misses': st_t['dist.feature.cold_misses'],
   }
@@ -669,6 +805,27 @@ def _run_dist_section(timeout: int):
   return {'error': f'dist section {cause}: {stderr[-500:]}'}
 
 
+def _run_hetero_session(timeout: int):
+  """Spawn the hetero fused session; parse its last JSON line."""
+  cmd = [sys.executable, os.path.abspath(__file__), '--hetero-session']
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         cwd=os.path.dirname(os.path.abspath(__file__)),
+                         timeout=timeout)
+    stdout = out.stdout or ''
+  except subprocess.TimeoutExpired as e:
+    stdout = e.stdout or b''
+    if isinstance(stdout, bytes):
+      stdout = stdout.decode(errors='replace')
+  for ln in reversed(stdout.strip().splitlines()):
+    if ln.startswith('{'):
+      try:
+        return json.loads(ln)
+      except json.JSONDecodeError:
+        continue
+  return None
+
+
 def _run_envelope_row(num_parts: int, batch: int, timeout: int):
   """One P-row of the scale envelope: spawn the tiny
   `bench_dist_loader.py --envelope-worker` config on a ``num_parts``
@@ -692,7 +849,7 @@ def _run_envelope_row(num_parts: int, batch: int, timeout: int):
   return None
 
 
-def _aggregate(results, fused_res, dist):
+def _aggregate(results, fused_res, dist, hetero=None):
   """The full artifact schema from whatever phases have completed so
   far.  The HEADLINE `value` is the fused whole-epoch time when the
   fused session has landed (and passed its floor check), else the
@@ -702,9 +859,14 @@ def _aggregate(results, fused_res, dist):
   ep = sorted(r['epoch_secs'] for r in results
               if r.get('epoch_secs') is not None)
   # spread over FLOOR-VALID runs only: an elision-flagged wall must
-  # not reappear as the series min (the r5 protocol's whole point)
-  all_runs = [e for r in results for e in r.get('epoch_runs', [])
-              if e >= r.get('epoch_floor_secs', 0.0)]
+  # not reappear as the series min (the r5 protocol's whole point);
+  # salvaged sessions without per-run lists contribute their median
+  all_runs = []
+  for r in results:
+    runs = r.get('epoch_runs') or (
+        [r['epoch_secs']] if r.get('epoch_secs') is not None else [])
+    floor = r.get('epoch_floor_secs', 0.0)
+    all_runs += [e for e in runs if e >= floor]
   es = sorted(r['edges_per_sec'] for r in results
               if 'edges_per_sec' in r)
   cs = sorted(r['compile_secs'] for r in results if 'compile_secs' in r)
@@ -764,10 +926,19 @@ def _aggregate(results, fused_res, dist):
       'sampling_vs_a100_nominal': (round(med_es / BASELINE_EDGES_PER_SEC,
                                          2) if med_es else None),
       'fused_epoch_secs': round(fu[0], 4) if fu else None,
+      'fused_layout': (fused_res or {}).get('fused_layout'),
       'fused_epoch_runs': (fused_res or {}).get('fused_epoch_runs'),
       'fused_vs_baseline': (round(BASELINE_EPOCH_SECS / fu[0], 4)
                             if fu else None),
+      'fused_epoch_secs_bf16': (fused_res or {}).get(
+          'fused_epoch_secs_bf16'),
+      'fused_subgraph_ms_per_step': (fused_res or {}).get(
+          'fused_subgraph_ms_per_step'),
+      'fused_subgraph_epoch_secs_est': (fused_res or {}).get(
+          'fused_subgraph_epoch_secs_est'),
       'fused_compile_secs': (fused_res or {}).get('fused_compile_secs'),
+      'fused_bf16_compile_secs': (fused_res or {}).get(
+          'fused_bf16_compile_secs'),
       'fused_error': (fused_res or {}).get('fused_error'),
       'fused_suspect_elision': (fused_res or {}).get('suspect_elision'),
       'train_step_mfu': (round(statistics.median(mfu), 4)
@@ -776,6 +947,11 @@ def _aggregate(results, fused_res, dist):
                            if cs else None),
       'achieved_hbm_frac': hbm or None,
       'gather_roofline': gather or None,
+      'fused_hetero_epoch_secs': (hetero or {}).get(
+          'fused_hetero_epoch_secs'),
+      'fused_hetero_ms_per_step': (hetero or {}).get(
+          'fused_hetero_ms_per_step'),
+      'hetero': hetero,
       'sessions': len(results),
       'session_modes': [r['mode'] for r in results],
       'steps_per_epoch': results[0]['steps'] if results else None,
@@ -798,13 +974,13 @@ def main():
   def budget_left():
     return total_budget - (time.time() - t_start)
 
-  results, fused_res, dist = [], None, None
+  results, fused_res, dist, hetero = [], None, None, None
 
   def emit():
     """The indestructible-artifact contract: full cumulative
     aggregate after every completed phase."""
-    if results or fused_res or dist:
-      print(json.dumps(_aggregate(results, fused_res, dist)),
+    if results or fused_res or dist or hetero:
+      print(json.dumps(_aggregate(results, fused_res, dist, hetero)),
             flush=True)
 
   # phase 1 — one primary session (epochs + sampling + roofline).
@@ -842,6 +1018,12 @@ def main():
     print(f'budget: skipping dist ({budget_left():.0f}s left)',
           file=sys.stderr)
 
+  # phase 3b — hetero fused session (VERDICT r4 #8), fast days only
+  if budget_left() > 320:
+    hetero = _run_hetero_session(
+        int(min(600, max(budget_left() - 20, 120))))
+    emit()
+
   # phase 4 — extra primary sessions stabilize the per-batch median
   while (len(results) < sessions and attempts < sessions + 3
          and budget_left() > session_timeout * 0.75):
@@ -874,6 +1056,8 @@ def main():
 if __name__ == '__main__':
   if '--dist-worker' in sys.argv:
     dist_worker()
+  elif '--hetero-session' in sys.argv:
+    hetero_worker()
   elif '--fused-session' in sys.argv:
     worker(fused_only=True)
   elif '--bench-worker' in sys.argv:

@@ -115,6 +115,64 @@ def subgraph_worker(num_parts: int, hop_chunk, batch: int,
        * max_degree)
 
 
+#: IGBH-large shapes for the memory envelope (PUBLIC IGB paper
+#: figures, approximate — exact counts come from the npy headers when
+#: the dataset is on disk; every type carries 1024-dim f32 features,
+#: `reference examples/igbh/download_igbh_large.sh`).
+IGBH_LARGE_SHAPES = {
+    'nodes': {'paper': 100e6, 'author': 100e6, 'fos': 0.7e6,
+              'institute': 0.03e6, 'journal': 0.05e6,
+              'conference': 0.005e6},
+    'feat_dim': 1024,
+    'edges': 2.2e9,           # directed, pre-reverse; x2 with reverse
+}
+
+
+def memory_envelope(num_parts: int = 128, hbm_gb: float = 95.0,
+                    split_ratio: float = 0.25, feat_bytes: int = 4):
+  """BASELINE north-star check (VERDICT r4 #9): does IGBH-large fit a
+  v5p-128 pod under the host-local tiered layout?  Array-residency
+  bytes per chip, analytic from `IGBH_LARGE_SHAPES`:
+
+    * features: ``split_ratio`` of each type's rows in HBM (hotness
+      prefix), the rest in that host's DRAM (`DistFeature.cold_local`);
+    * topology: CSR int32 indices + per-part indptr, by-src sharded,
+      x2 for the reverse-edge types the RGNN recipes add;
+    * labels/books: int32 paper labels + O(P) range books (negligible).
+
+  Exchange/activation peaks ride on top but are capacity-bounded
+  (``exchange_slack`` x balanced share; the [P, C] buffers at batch
+  1024, fanout [15,10,5] are tens of MB — `capacity_sweep` measures
+  them).  Returns the per-chip table; `--memory-envelope` prints it.
+  """
+  n_total = sum(IGBH_LARGE_SHAPES['nodes'].values())
+  d = IGBH_LARGE_SHAPES['feat_dim']
+  e = IGBH_LARGE_SHAPES['edges'] * 2          # with reverse etypes
+  feat_total = n_total * d * feat_bytes
+  feat_hbm_chip = feat_total * split_ratio / num_parts
+  feat_host_chip = feat_total * (1 - split_ratio) / num_parts
+  topo_chip = (e * 4) / num_parts + n_total * 4 / num_parts
+  labels_chip = IGBH_LARGE_SHAPES['nodes']['paper'] * 4 / num_parts
+  hbm_chip = feat_hbm_chip + topo_chip + labels_chip
+  return {
+      'config': f'IGBH-large on v5p-{num_parts} '
+                f'(split_ratio={split_ratio}, f32 feats)',
+      'nodes_M': round(n_total / 1e6, 1),
+      'feat_total_GB': round(feat_total / 1e9, 1),
+      'per_chip_feat_hbm_GB': round(feat_hbm_chip / 1e9, 2),
+      'per_chip_topo_GB': round(topo_chip / 1e9, 2),
+      'per_chip_hbm_GB': round(hbm_chip / 1e9, 2),
+      'per_chip_hbm_frac_of_v5p': round(hbm_chip / (hbm_gb * 1e9), 3),
+      'per_host_cold_dram_GB': round(feat_host_chip * 4 / 1e9, 1),
+      'fits': bool(hbm_chip < 0.7 * hbm_gb * 1e9),
+      'note': ('fully-HBM (split_ratio=1.0) also fits: '
+               f'{round((feat_total + e * 4) / num_parts / 1e9, 1)} '
+               f'GB/chip vs {hbm_gb} GB v5p HBM; the tiered layout is '
+               'for bf16-less full-dim features plus headroom, and '
+               'IGBH-full (~5.5x)'),
+  }
+
+
 def envelope_worker(num_parts: int, mode: str, batch: int,
                     num_nodes: int, epochs: int = 3):
   """Scale-envelope probe at ``num_parts`` VIRTUAL devices (VERDICT r3
@@ -190,6 +248,9 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
       drop_rate_pct=round(100.0 * st['dist.frontier.dropped']
                           / max(st['dist.frontier.offered'], 1), 3),
       slack_final=getattr(loader.sampler, 'exchange_slack', None))
+  # the BASELINE north-star memory check rides along on every
+  # envelope row (VERDICT r4 #9)
+  out['memory_envelope_v5p128'] = memory_envelope(128)
   print(json.dumps(out), flush=True)
 
 
@@ -252,6 +313,9 @@ def main():
   ap.add_argument('--capacity-worker', action='store_true')
   ap.add_argument('--subgraph-worker', action='store_true')
   ap.add_argument('--envelope-worker', action='store_true')
+  ap.add_argument('--memory-envelope', action='store_true',
+                  help='print the IGBH-large-on-v5p-128 per-chip '
+                       'memory table (VERDICT r4 #9)')
   ap.add_argument('--mode', default='homo')
   ap.add_argument('--slack', default='exact')
   ap.add_argument('--hop-chunk', default='none')
@@ -279,6 +343,11 @@ def main():
   if args.subgraph_worker:
     chunk = None if args.hop_chunk == 'none' else int(args.hop_chunk)
     subgraph_worker(args.num_parts, chunk, args.batch, args.nodes)
+    return
+  if args.memory_envelope:
+    import json
+    print(json.dumps(memory_envelope(args.num_parts or 128)),
+          flush=True)
     return
   if args.envelope_worker:
     envelope_worker(args.num_parts, args.mode, args.batch, args.nodes)

@@ -119,6 +119,34 @@ def build_graph_csr_device(num_nodes=NUM_NODES, avg_deg=AVG_DEG, seed=0):
   return build(jax.random.key(seed))
 
 
+def build_bipartite_csr_device(n_src: int, n_dst: int, avg_deg: int,
+                               seed: int = 0, hub_frac: float = 0.3):
+  """Device-built sorted-CSR for one (src -> dst) edge type — the
+  hetero sibling of `build_graph_csr_device` (same hub mixture,
+  zero host↔device transfer, deterministic per seed)."""
+  import jax
+  import jax.numpy as jnp
+
+  @jax.jit
+  def build(key):
+    e = n_src * avg_deg
+    k1, k2, k3 = jax.random.split(key, 3)
+    rows = jax.random.randint(k1, (e,), 0, n_src, jnp.int32)
+    hub = jax.random.uniform(k2, (e,)) < hub_frac
+    u = jax.random.uniform(k3, (e,))
+    cols = jnp.where(hub, (u * u * n_dst).astype(jnp.int32),
+                     (u * n_dst).astype(jnp.int32))
+    by_col = jnp.argsort(cols, stable=True)
+    order = by_col[jnp.argsort(rows[by_col], stable=True)]
+    indices = cols[order]
+    rows_sorted = rows[order]
+    indptr = jnp.searchsorted(
+        rows_sorted, jnp.arange(n_src + 1, dtype=jnp.int32),
+        side='left').astype(jnp.int32)
+    return indptr, indices
+  return build(jax.random.key(seed))
+
+
 def emit(metric: str, value: float, unit: str, baseline: float = None,
          **extra):
   rec = {'metric': metric, 'value': round(float(value), 3), 'unit': unit}

@@ -54,14 +54,28 @@ class Dataset:
     self._explicit_num_nodes = num_nodes if isinstance(num_nodes, dict) \
         else None
     import jax
-    if (layout == 'CSR' and isinstance(edge_index, (tuple, list))
-        and len(edge_index) == 2
-        and isinstance(edge_index[0], jax.Array)):
+
+    def _is_device_csr(ei):
+      return (isinstance(ei, (tuple, list)) and len(ei) == 2
+              and isinstance(ei[0], jax.Array))
+
+    if layout == 'CSR' and _is_device_csr(edge_index):
       # device-native path: arrays already on device in canonical
       # sorted-CSR form (see `Graph.from_device_arrays`) — no host
       # round trip, no re-sort
       self.graph = Graph.from_device_arrays(edge_index[0], edge_index[1],
                                             edge_ids=edge_ids)
+      return self
+    if (layout == 'CSR' and isinstance(edge_index, dict)
+        and all(_is_device_csr(ei) for ei in edge_index.values())):
+      # hetero device-native path (per-etype device CSR)
+      self.graph = {
+          etype: Graph.from_device_arrays(
+              ei[0], ei[1],
+              edge_ids=(edge_ids.get(etype)
+                        if isinstance(edge_ids, dict) else None))
+          for etype, ei in edge_index.items()
+      }
       return self
     if isinstance(edge_index, dict):
       topos = {}
@@ -175,8 +189,11 @@ class Dataset:
       self._device_labels = {None: node_label_data}
       return self
     if isinstance(node_label_data, dict):
-      self.node_labels = {k: convert_to_array(v)
-                          for k, v in node_label_data.items()}
+      # device arrays stay device-resident (the get_node_label_device
+      # cache path recognizes them); host values convert as before
+      self.node_labels = {
+          k: v if isinstance(v, jax.Array) else convert_to_array(v)
+          for k, v in node_label_data.items()}
     else:
       self.node_labels = convert_to_array(node_label_data)
     self._device_labels = None      # re-upload on next collate

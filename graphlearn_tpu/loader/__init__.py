@@ -6,3 +6,4 @@ from .link_loader import EdgeSeedBatcher, LinkLoader, LinkNeighborLoader
 from .subgraph_loader import SubGraphLoader
 from .fused import (EpochStats, FusedEpoch, FusedHeteroEpoch,
                     FusedLinkEpoch)
+from .fused_tree import FusedTreeEpoch

@@ -9,14 +9,30 @@ grid step.  The table stays in HBM (``memory_space=ANY``), row ids are
 scalar-prefetched into SMEM so the DMA addresses are known before the
 body runs, and rows stream straight into the VMEM output block.
 
-Measured on TPU v5e (2.45M x 128 f32 table, 16k-row gather): the DMA
-kernel runs at parity with XLA's native row gather (~0.4 TB/s both,
-tile=32-64 best), so this kernel is kept as the explicit, tunable
-form of the hot-path access.  The remote-chip variant of the same
-per-row DMA — owners pushing requested rows straight into requester
-buffers via `make_async_remote_copy` — is implemented and
-interpret-validated in `parallel/rdma_gather.py` (perf qualification
-needs a >= 2-chip slice; the engines default to XLA all_to_all).
+r5 ROOFLINE VERDICT (elision-proof protocol — AOT-compiled programs,
+first-execution walls, value pulls; the earlier "~0.4 TB/s parity"
+readings predate it and were tunnel artifacts): on v5e at
+products-scale id sets (1M rows/call), the row gather is
+DESCRIPTOR-BOUND at ~80-100M rows/s regardless of row width —
+512 B rows: ~51 GB/s; 256 B (bf16): ~24 GB/s; 4 KB blocked rows:
+~123 GB/s (30M rows/s); 16 KB: ~143 GB/s — while contiguous
+streaming reads run 216-480 GB/s (day variance).  Consequences:
+lane-padding D=100→128 and bf16 storage do NOT move the gather wall
+(same rows/s), and THIS kernel's per-row DMA caps at ~26-33 GB/s
+(tile 32→128 sweep; issue-cost-bound at ~15 ns/row).  A
+streaming-select kernel (stream the covering range, extract wanted
+rows in VMEM) is the only path past the bound, but Mosaic rejects
+every extraction formulation tried: `jnp.take` on a VMEM block
+(shape-mismatch on lowering), `take_along_axis` (internal compiler
+error), per-row dynamic VMEM load/store in a fori_loop (internal
+compiler error).  The XLA gather therefore stands at ~0.9-1.0 of the
+measured achievable row rate, and `bench.py` reports
+`gather_achieved_vs_achievable` against that basis.  The remote-chip
+variant of the per-row DMA — owners pushing requested rows straight
+into requester buffers via `make_async_remote_copy` — is implemented
+and interpret-validated in `parallel/rdma_gather.py` (perf
+qualification needs a >= 2-chip slice; the engines default to XLA
+all_to_all).
 
 Constraints discovered on real hardware (Mosaic tiling rules):
   * Row DMA slices must be lane-aligned: ``D % 128 == 0`` for f32/i32.

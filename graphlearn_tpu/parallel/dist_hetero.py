@@ -695,14 +695,28 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
         self._cold_misses += misses
     hp = (self.ds.host_parts if self.ds.host_parts is not None
           else np.arange(self.num_parts))
-    for i, nt in tiered:
+    # ONE capacity handshake for every owner-served type (ADVICE r4:
+    # a per-(type, batch) allgather dominates at large P x many
+    # types): plan all types first, agree on all capacities in a
+    # single `_global_max_vec`, then execute each overlay
+    from .dist_sampler import _global_max_vec, plan_cold_requests
+    owner_served = [(i, nt) for i, nt in tiered
+                    if self.ds.node_features[nt].cold_host is None]
+    plans = []
+    for i, nt in owner_served:
       nf = self.ds.node_features[nt]
-      if nf.cold_host is not None:
-        continue
+      plans.append(plan_cold_requests(
+          node_t[ntypes.index(nt)], self.ds.bounds[nt], nf.hot_counts,
+          hp, cache_ids=nf.cache_ids))
+    agreed = _global_max_vec(
+        [int(p[5].max(initial=0)) for p in plans]) if plans else []
+    for (i, nt), plan, cap in zip(owner_served, plans, agreed):
+      nf = self.ds.node_features[nt]
       out[i], lookups, misses = overlay_cold_owner(
           out[i], node_t[ntypes.index(nt)], self.ds.bounds[nt],
           nf.hot_counts, nf.cold_local, self.mesh, self.axis,
-          self.num_parts, hp, cache_ids=nf.cache_ids)
+          self.num_parts, hp, cache_ids=nf.cache_ids, plan_=plan,
+          agreed_capacity=cap)
       with self._stats_lock:
         self._cold_lookups += lookups
         self._cold_misses += misses

@@ -26,4 +26,9 @@ jax.config.update('jax_platforms', 'cpu')
 # Tests assert SEMANTICS (provenance, masks, parity), not kernel perf:
 # skipping XLA's heavy optimization passes cuts the CPU-mesh compile
 # wall ~35% across the suite (measured) with identical test outcomes.
-jax.config.update('jax_disable_most_optimizations', True)
+# GLT_TEST_NO_FAST_XLA=1 runs under the PRODUCTION pass pipeline —
+# `tests/test_optimization_canary.py` re-runs a parity slice that way
+# in-suite so an optimization-pass numerics bug cannot hide behind
+# this flag (ADVICE r4).
+if os.environ.get('GLT_TEST_NO_FAST_XLA') != '1':
+  jax.config.update('jax_disable_most_optimizations', True)

@@ -96,3 +96,25 @@ def test_aggregate_dist_only(bench):
   assert out['value'] is None
   assert out['dist'] is dist
   assert out['sessions'] == 0
+
+
+def test_aggregate_floor_filters_elided_runs(bench):
+  """r5 protocol: a wall below the session's analytic HBM floor must
+  not reappear as the artifact's series min."""
+  r = _primary(epoch_runs=[0.007, 8.2, 8.4], epoch_secs=8.3,
+               epoch_floor_secs=1.5)
+  out = bench._aggregate([r], None, None)
+  assert out['epoch_secs_min_med_max'][0] == 8.2
+  assert out['protocol'].startswith('r5')
+
+
+def test_aggregate_elision_suspect_fused_not_headline(bench):
+  """A fused number flagged suspect_elision must NOT become the
+  headline value."""
+  fused = {'mode': 'fused-session', 'platform': 'tpu',
+           'fused_compile_secs': 62.0, 'epoch_secs_fused': 0.007,
+           'suspect_elision': True, 'fused_layout': 'tree'}
+  out = bench._aggregate([_primary()], fused, None)
+  assert out['metric'].startswith('graphsage_epoch_secs')
+  assert out['value'] == 0.25
+  assert out['fused_suspect_elision'] is True

@@ -106,3 +106,33 @@ def test_build_graph_csr_device_valid():
                                                 seed=1)
   np.testing.assert_array_equal(np.asarray(indptr), np.asarray(indptr2))
   np.testing.assert_array_equal(np.asarray(indices), np.asarray(indices2))
+
+
+def test_device_native_hetero_dataset():
+  """Per-etype device CSR + device feature/label dicts (the bench's
+  hetero session path) behave like the host construction."""
+  rng = np.random.default_rng(2)
+  nu, ni, e = 60, 40, 300
+  rows = rng.integers(0, nu, e)
+  cols = rng.integers(0, ni, e)
+  order = np.lexsort((cols, rows))
+  rows, cols = rows[order], cols[order]
+  indptr = np.searchsorted(rows, np.arange(nu + 1)).astype(np.int64)
+  fu = rng.random((nu, 6), np.float32)
+  fi = rng.random((ni, 6), np.float32)
+  lab = rng.integers(0, 3, nu).astype(np.int32)
+  et = ('u', 'to', 'i')
+  ds = (Dataset()
+        .init_graph({et: (jnp.asarray(indptr), jnp.asarray(cols))},
+                    layout='CSR', num_nodes={'u': nu, 'i': ni})
+        .init_node_features({'u': jnp.asarray(fu), 'i': jnp.asarray(fi)})
+        .init_node_labels({'u': jnp.asarray(lab)}))
+  g = ds.get_graph(et)
+  assert g.num_edges == e
+  assert ds.num_nodes_dict() == {'u': nu, 'i': ni}
+  np.testing.assert_array_equal(
+      np.asarray(ds.get_node_label_device('u')), lab)
+  ids = jnp.asarray([0, 5, -1], jnp.int32)
+  np.testing.assert_allclose(
+      np.asarray(ds.node_features['i'][ids]),
+      np.vstack([fi[[0, 5]], np.zeros((1, 6), np.float32)]))

@@ -1,0 +1,152 @@
+"""FusedTreeEpoch / TreeSAGE: the scatter-free tree-layout flagship
+path — masked-math parity with a numpy reference, learnability,
+epoch-length chunk reuse, and the padded-step no-op guard."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from graphlearn_tpu.data import Dataset
+from graphlearn_tpu.loader import FusedTreeEpoch
+from graphlearn_tpu.models import TreeSAGE, tree_level_sizes
+
+N = 240
+CLASSES = 4
+
+
+def _planted_dataset(seed=0):
+  """Community graph: labels recoverable from neighborhoods."""
+  rng = np.random.default_rng(seed)
+  labels = (np.arange(N) % CLASSES).astype(np.int32)
+  rows, cols = [], []
+  for v in range(N):
+    for _ in range(6):
+      if rng.random() < 0.85:
+        u = int(rng.choice(np.nonzero(labels == labels[v])[0]))
+      else:
+        u = int(rng.integers(0, N))
+      rows.append(v)
+      cols.append(u)
+  feats = np.eye(CLASSES, 8, dtype=np.float32)[labels]
+  feats += rng.normal(0, 0.4, feats.shape).astype(np.float32)
+  return (Dataset()
+          .init_graph((np.asarray(rows), np.asarray(cols)),
+                      layout='COO', num_nodes=N)
+          .init_node_features(feats)
+          .init_node_labels(labels)), feats, labels
+
+
+def test_tree_sage_matches_numpy_reference():
+  """One TreeSAGE forward == hand-computed masked tree math."""
+  rng = np.random.default_rng(1)
+  b, k1, k2, d, h, c = 3, 2, 2, 5, 4, 3
+  sizes = tree_level_sizes(b, (k1, k2))
+  assert sizes == (3, 6, 12)
+  xs = [rng.standard_normal((s, d)).astype(np.float32) for s in sizes]
+  masks = [rng.random(s) < 0.8 for s in sizes]
+  masks[0][:] = True
+  model = TreeSAGE(hidden_features=h, out_features=c, num_layers=2)
+  params = model.init(jax.random.key(0),
+                      [jnp.asarray(x) for x in xs],
+                      [jnp.asarray(m) for m in masks])
+  out = np.asarray(model.apply(params,
+                               [jnp.asarray(x) for x in xs],
+                               [jnp.asarray(m) for m in masks]))
+
+  def dense(p, x, bias=True):
+    y = x @ np.asarray(p['kernel'])
+    return y + np.asarray(p['bias']) if bias else y
+
+  p = params['params']
+  hs = [x * m[:, None] for x, m in zip(xs, masks)]
+
+  def level_step(parent, child, cmask, lp, act):
+    k = child.shape[0] // parent.shape[0]
+    cd = child.reshape(parent.shape[0], k, -1)
+    cm = cmask.reshape(parent.shape[0], k)
+    # the mask gates the sum (not just the count): hidden-layer
+    # activations of invalid slots are relu(bias) != 0
+    mean = ((cd * cm[..., None]).sum(1)
+            / np.maximum(cm.sum(1), 1)[:, None])
+    y = dense(lp[0], parent) + dense(lp[1], mean, bias=False)
+    return np.maximum(y, 0) if act else y
+
+  l0 = (p['layer0_self'], p['layer0_neigh'])
+  l1 = (p['layer1_self'], p['layer1_neigh'])
+  h0 = level_step(hs[0], hs[1], masks[1], l0, act=True)
+  h1 = level_step(hs[1], hs[2], masks[2], l0, act=True)
+  ref = level_step(h0, h1, masks[1], l1, act=False)
+  np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_tree_epoch_learns():
+  ds, _, labels = _planted_dataset()
+  model = TreeSAGE(hidden_features=16, out_features=CLASSES,
+                   num_layers=2)
+  tx = optax.adam(1e-2)
+  fused = FusedTreeEpoch(ds, [4, 3], np.arange(N), model, tx,
+                         batch_size=32, shuffle=True, seed=0)
+  state = fused.init_state(jax.random.key(0))
+  state, first = fused.run(state)
+  for _ in range(14):
+    state, stats = fused.run(state)
+  assert stats['seeds'] == N
+  assert stats['loss'] < first['loss']
+  assert stats['accuracy'] > 0.6, stats['accuracy']
+  acc = fused.evaluate(state.params, np.arange(N))
+  assert acc > 0.6, acc
+
+
+def test_fused_tree_chunked_reuses_one_program():
+  """max_steps_per_program: ONE compiled [chunk, B] program serves an
+  epoch whose length does not divide the chunk, padded tail steps are
+  state no-ops, and losses come back trimmed to real steps."""
+  ds, _, _ = _planted_dataset()
+  model = TreeSAGE(hidden_features=8, out_features=CLASSES,
+                   num_layers=2)
+  tx = optax.adam(1e-2)
+  # 240/32 = 7.5 -> 8 seed batches; chunk 3 -> dispatches 3+3+2(pad 1)
+  fused = FusedTreeEpoch(ds, [3, 2], np.arange(N), model, tx,
+                         batch_size=32, shuffle=True, seed=0,
+                         max_steps_per_program=3)
+  state = fused.init_state(jax.random.key(0))
+  state, stats = fused.run(state)
+  assert stats.losses.shape[0] == len(fused) == 8
+  assert stats['seeds'] == N
+  # a second, SHORTER seed set reuses the same compiled program
+  fused2 = FusedTreeEpoch(ds, [3, 2], np.arange(64), model, tx,
+                          batch_size=32, shuffle=True, seed=0,
+                          max_steps_per_program=3)
+  fused2._compiled = fused._compiled       # shared executable cache
+  state2 = fused2.init_state(jax.random.key(1))
+  state2, stats2 = fused2.run(state2)
+  assert stats2.losses.shape[0] == len(fused2) == 2
+  assert stats2['seeds'] == 64
+
+
+def test_fused_tree_padded_step_is_noop():
+  """A dispatch whose steps are ALL padding must leave params
+  bit-identical (adam moments included)."""
+  ds, _, _ = _planted_dataset()
+  model = TreeSAGE(hidden_features=8, out_features=CLASSES,
+                   num_layers=2)
+  tx = optax.adam(1e-2)
+  fused = FusedTreeEpoch(ds, [3, 2], np.arange(N), model, tx,
+                         batch_size=32, seed=0)
+  state = fused.init_state(jax.random.key(0))
+  pad = jnp.full((2, 32), -1, jnp.int32)
+  before = jax.tree_util.tree_map(np.asarray, state.params)
+  state2, *_ = fused._compiled(state, pad, jax.random.key(5),
+                               fused._dev, False)
+  after = jax.tree_util.tree_map(np.asarray, state2.params)
+  jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+
+
+def test_tree_level_count_validation():
+  ds, _, _ = _planted_dataset()
+  model = TreeSAGE(hidden_features=8, out_features=CLASSES,
+                   num_layers=3)
+  with pytest.raises(ValueError, match='num_layers'):
+    FusedTreeEpoch(ds, [3, 2], np.arange(N), model, optax.adam(1e-2),
+                   batch_size=32)
