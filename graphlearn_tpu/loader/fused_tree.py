@@ -18,7 +18,21 @@ Measured v5e decomposition that motivated this (r5, products scale,
 fanout [15,10,5], batch 1024): subgraph fused step ~440 ms/step =
 ~104 ms sort-based sampling + ~7 ms collation + ~205 ms model
 (scatter-dominated) + overheads.  The tree path replaces both
-dominant terms with streaming ops.
+dominant terms with streaming ops and lands at **35.6 ms/step**
+(f32; 32.8 bf16), decomposed (steady-state AOT protocol) as
+~19.8 ms sampling + ~9.9 ms feature gather + ~5.9 ms model+optax.
+That residual is the chip's GATHER-DESCRIPTOR bound, not slack: the
+step issues ~2.2 M descriptor-bound gathers (938k feature rows +
+~937k neighbor-id elements + ~340k indptr degrees), and at the
+measured ~80 M descriptors/s (`ops/pallas_gather.py` roofline) the
+analytic floor is ~27 ms — the step runs at ~76% of it.
+``replace=True`` window-free draws were measured within 7% of the
+Gumbel-top-k path (the descriptors dominate either way), so the
+without-replacement default stands.  One-time cost note: the FIRST
+execution of a freshly loaded program carries ~5-7 s of on-chip
+program load on the tunneled setup; steady-state timings start at
+the second execution (two independent timing paths agree at
+~36 ms/step).
 
 Also the epoch-length compile story (VERDICT r4 #4):
 ``max_steps_per_program`` runs the epoch as ceil(S/chunk) dispatches
