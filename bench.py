@@ -738,6 +738,34 @@ def dist_worker():
     out['fused_mesh']['eval_error'] = f'{type(e).__name__}: {e}'[:160]
   print(json.dumps(out), flush=True)
 
+  # TREE-layout mesh epochs (r5 flagship, distributed form): same
+  # shape as the fused_mesh comparison above
+  try:
+    from graphlearn_tpu.models import TreeSAGE
+    from graphlearn_tpu.parallel import FusedDistTreeEpoch
+    tmodel = TreeSAGE(hidden_features=64, out_features=CLASSES,
+                      num_layers=2)
+    tfused = FusedDistTreeEpoch(ds, fan2, seeds2, tmodel, tx,
+                                batch_size=b2, mesh=mesh,
+                                shuffle=True, seed=0)
+    tstate = tfused.init_state(jax.random.key(2))
+    t0 = time.perf_counter()
+    tstate, _ = tfused.run(tstate)
+    jax.tree_util.tree_leaves(tstate.params)[0].block_until_ready()
+    t_compile = time.perf_counter() - t0
+    tstate, _ = tfused.run(tstate)       # donated-layout recompile
+    jax.tree_util.tree_leaves(tstate.params)[0].block_until_ready()
+    t0 = time.perf_counter()
+    tstate, _ = tfused.run(tstate)
+    jax.tree_util.tree_leaves(tstate.params)[0].block_until_ready()
+    t_dt = time.perf_counter() - t0
+    out['fused_mesh']['tree_seeds_per_sec'] = round(
+        len(tfused) * b2 * DIST_PARTS / max(t_dt, 1e-9), 1)
+    out['fused_mesh']['tree_compile_secs'] = round(t_compile, 1)
+  except Exception as e:            # noqa: BLE001
+    out['fused_mesh']['tree_error'] = f'{type(e).__name__}: {e}'[:160]
+  print(json.dumps(out), flush=True)
+
 
 def _run_session(timeout: int, fused: bool = False):
   cmd = [sys.executable, os.path.abspath(__file__),

@@ -9,7 +9,8 @@ from . import multihost
 from .dist_hetero import (DistHeteroDataset, DistHeteroLinkNeighborLoader,
                           DistHeteroNeighborLoader,
                           DistHeteroNeighborSampler)
-from .fused import FusedDistEpoch, FusedDistLinkEpoch
+from .fused import (FusedDistEpoch, FusedDistLinkEpoch,
+                    FusedDistTreeEpoch)
 from .dist_sampler import (DistLinkNeighborLoader, DistLinkNeighborSampler,
                            DistNeighborLoader, DistNeighborSampler,
                            DistRandomWalker,

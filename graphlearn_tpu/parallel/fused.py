@@ -45,7 +45,45 @@ from .dp import (make_dp_eval_step, make_dp_supervised_step,
                  make_dp_unsupervised_step)
 
 
-class FusedDistEpoch:
+class _MeshEpochDriver:
+  """Host-driver pieces shared by the three fused mesh classes, so
+  the seed/key/device-put contracts cannot drift between them."""
+
+  def _next_epoch_key(self):
+    self._epoch_idx += 1
+    return jax.random.fold_in(self._base_key, self._epoch_idx)
+
+  def _eval_key(self):
+    """Eval keys live in their own fold DOMAIN (base -> 0 -> 1);
+    train keys are base -> epoch with epoch >= 1, so no epoch-counter
+    value can alias a train sampling key (the loader.fused
+    contract)."""
+    return jax.random.fold_in(jax.random.fold_in(self._base_key, 0), 1)
+
+  def _put_batches(self, arr: np.ndarray) -> jax.Array:
+    """``[S, P, ...]`` host batches → device, sharded over the mesh
+    axis on dim 1."""
+    return jax.device_put(
+        arr.astype(np.int32),
+        NamedSharding(self.mesh, P(None, self.axis)))
+
+  def _stack_eval_seeds(self, input_nodes, input_space: str):
+    """Relabel + batch an eval split into ``[S, P, B]``."""
+    from ..loader.node_loader import SeedBatcher
+    ids = np.asarray(input_nodes).reshape(-1)
+    if ids.dtype == np.bool_:
+      ids = np.nonzero(ids)[0]
+    if ids.size == 0:
+      raise ValueError('evaluate() got an empty split')
+    if input_space == 'old' and self.ds.old2new is not None:
+      ids = self.ds.old2new[ids]
+    ev = SeedBatcher(ids, self.batch_size * self.num_parts,
+                     shuffle=False)
+    return np.stack(list(ev)).reshape(-1, self.num_parts,
+                                      self.batch_size)
+
+
+class FusedDistEpoch(_MeshEpochDriver):
   """One-program data-parallel training epochs on the mesh engine.
 
   Example::
@@ -202,26 +240,10 @@ class FusedDistEpoch:
     `loader.fused._SupervisedScanEpoch.evaluate`
     (VERDICT r4 #5: dist fused training could not eval without
     leaving the fused path)."""
-    from ..loader.node_loader import SeedBatcher
-    ids = np.asarray(input_nodes).reshape(-1)
-    if ids.dtype == np.bool_:
-      ids = np.nonzero(ids)[0]
-    if ids.size == 0:
-      raise ValueError('evaluate() got an empty split')
-    if input_space == 'old' and self.ds.old2new is not None:
-      ids = self.ds.old2new[ids]
-    ev = SeedBatcher(ids, self.batch_size * self.num_parts,
-                     shuffle=False)
-    seeds = np.stack(list(ev)).reshape(-1, self.num_parts,
-                                       self.batch_size)
-    # eval keys live in their own fold DOMAIN (base -> 0 -> 1); train
-    # keys are base -> epoch with epoch >= 1 (loader.fused contract)
-    key = jax.random.fold_in(jax.random.fold_in(self._base_key, 0), 1)
-    seeds_dev = jax.device_put(
-        seeds.astype(np.int32),
-        NamedSharding(self.mesh, P(None, self.axis)))
+    seeds = self._stack_eval_seeds(input_nodes, input_space)
     correct, total, stats = self._compiled_eval(
-        params, seeds_dev, key, self.sampler._arrays())
+        params, self._put_batches(seeds), self._eval_key(),
+        self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
     return float(int(correct) / max(int(total), 1))
 
@@ -235,18 +257,270 @@ class FusedDistEpoch:
     from ..loader.fused import EpochStats
     flat = np.stack(list(self._batcher))           # [S, P*B]
     seeds = flat.reshape(-1, self.num_parts, self.batch_size)
-    self._epoch_idx += 1
-    key = jax.random.fold_in(self._base_key, self._epoch_idx)
-    seeds_dev = jax.device_put(
-        seeds.astype(np.int32),
-        NamedSharding(self.mesh, P(None, self.axis)))
     state, losses, correct, valid, stats = self._compiled(
-        state, seeds_dev, key, self.sampler._arrays())
+        state, self._put_batches(seeds), self._next_epoch_key(),
+        self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
     return state, EpochStats(losses, correct, valid)
 
 
-class FusedDistLinkEpoch:
+class FusedDistTreeEpoch(_MeshEpochDriver):
+  """One-program TREE-LAYOUT data-parallel epochs over the mesh.
+
+  The distributed twin of `loader.fused_tree.FusedTreeEpoch` — the
+  flagship scatter-free/sort-free path running against a graph
+  SHARDED over the devices: each hop exchanges the per-device level
+  frontier to its owners (`_dist_one_hop` — windows come back in the
+  tree layout, no dedup/induce step exists at all), all levels'
+  features + the seed labels ride ONE capacity-capped
+  `dist_gather_multi` exchange, `models.tree.TreeSAGE` aggregates by
+  reshape + masked mean, and the optax update pmean-averages
+  gradients — the whole epoch as one `lax.scan` SPMD program.
+
+  Measured motivation (r5, single chip): the tree layout runs
+  12.4x the subgraph fused step; this class carries the same design
+  to the mesh, where the reference has no fused counterpart at all.
+
+  Capacity semantics: level ids past the feature exchange's
+  per-owner capacity return ZERO rows (counted in
+  ``dist.feature.dropped``) while staying valid in the mean's count
+  — the same explicit-overflow contract as the subgraph engines
+  (`dist_gather_multi`); ``exchange_slack`` tunes it.
+
+  Args:
+    dataset: `DistDataset` (sharded, NON-tiered features + labels).
+    num_neighbors: per-hop fanouts; ``len == model.num_layers``.
+    input_nodes: global seed ids (``input_space`` as in the loaders).
+    model: a `TreeSAGE`-shaped flax module.
+    tx: optax transformation.
+    batch_size: PER-DEVICE seed batch size.
+    mesh / axis / shuffle / drop_last / seed / exchange_slack /
+    remat / fast_compile: as `FusedDistEpoch`.
+  """
+
+  def __init__(self, dataset: DistDataset, num_neighbors, input_nodes,
+               model, tx: optax.GradientTransformation,
+               batch_size: int, mesh: Optional[Mesh] = None,
+               axis: str = 'data', shuffle: bool = True,
+               drop_last: bool = False, seed: int = 0,
+               input_space: str = 'old', exchange_slack='auto',
+               remat: bool = False, fast_compile: bool = False):
+    from ..loader.node_loader import SeedBatcher
+    if dataset.node_features is None or dataset.node_labels is None:
+      raise ValueError('FusedDistTreeEpoch needs node features and '
+                       'labels')
+    if dataset.node_features.is_tiered:
+      raise ValueError(
+          'FusedDistTreeEpoch needs a non-tiered feature store; use '
+          'DistNeighborLoader(prefetch=2) for tiered tables')
+    if exchange_slack == 'adaptive':
+      raise ValueError(
+          "exchange_slack='adaptive' retunes on the host between "
+          "batches; FusedDistTreeEpoch takes a static slack")
+    self.fanouts = tuple(int(k) for k in num_neighbors)
+    if getattr(model, 'num_layers', len(self.fanouts)) != \
+        len(self.fanouts):
+      raise ValueError(
+          f'model.num_layers={model.num_layers} must equal '
+          f'len(num_neighbors)={len(self.fanouts)}')
+    # reuse the sampler scaffolding (mesh, device arrays, telemetry)
+    # with no induce machinery — the DistRandomWalker pattern
+    self.sampler = DistNeighborSampler(
+        dataset, [], mesh=mesh, axis=axis, collect_features=True,
+        seed=seed,
+        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
+    self.ds = dataset
+    self.model = model
+    self.tx = tx
+    self.mesh = self.sampler.mesh
+    self.axis = axis
+    self.num_parts = dataset.num_partitions
+    self.batch_size = int(batch_size)
+    seeds = np.asarray(input_nodes).reshape(-1)
+    if input_space == 'old' and dataset.old2new is not None:
+      seeds = dataset.old2new[seeds]
+    self._batcher = SeedBatcher(seeds, self.batch_size * self.num_parts,
+                                shuffle, drop_last, seed)
+    self._base_key = jax.random.key(seed)
+    self._epoch_idx = 0
+    apply = model.apply
+    self._apply = jax.checkpoint(apply) if remat else apply
+    self._eval_apply = apply
+    self._sharded_step = self._make_sharded(train=True)
+    self._sharded_eval = self._make_sharded(train=False)
+    self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
+                                   fast_compile=fast_compile)
+    self._compiled_eval = _uncached_jit(self._eval_fn,
+                                        fast_compile=fast_compile)
+
+  def __len__(self) -> int:
+    return len(self._batcher)
+
+  def init_state(self, rng) -> TrainState:
+    d = self.ds.node_features.feature_dim
+    sizes = [self.batch_size]
+    for k in self.fanouts:
+      sizes.append(sizes[-1] * k)
+    xs = [jnp.zeros((s, d), jnp.float32) for s in sizes]
+    masks = [jnp.ones((s,), jnp.bool_) for s in sizes]
+    params = self.model.init(rng, xs, masks)
+    from .dp import replicate
+    return replicate(
+        TrainState(params, self.tx.init(params),
+                   jnp.zeros((), jnp.int32)), self.mesh)
+
+  # -- per-device body ------------------------------------------------------
+
+  def _expand_collect(self, seeds, key, indptr_s, indices_s, bounds,
+                      fshards_s, lshards_s):
+    """Tree expansion + one fused feature/label exchange for one
+    device's ``[B]`` seed slice.  Returns (xs, masks, y, stats7)."""
+    from .dist_sampler import (_dist_one_hop, _slack_cap,
+                               dist_gather_multi)
+    slack = self.sampler.exchange_slack
+    levels, frontier = [seeds], seeds
+    fstats = jnp.zeros((3,), jnp.int32)
+    for h, k in enumerate(self.fanouts):
+      nbrs, mask, _, st = _dist_one_hop(
+          indptr_s, indices_s, None, bounds, frontier, int(k),
+          jax.random.fold_in(key, h), self.axis, self.num_parts,
+          False, sort_locality=False,
+          exchange_capacity=_slack_cap(frontier.shape[0],
+                                       self.num_parts, slack))
+      fstats = fstats + jnp.stack(st)
+      nxt = jnp.where(mask, nbrs, -1).reshape(-1)
+      levels.append(nxt)
+      frontier = nxt
+    all_ids = jnp.concatenate(levels)
+    (feats, labels), gst = dist_gather_multi(
+        (fshards_s, lshards_s), bounds, all_ids, self.axis,
+        self.num_parts,
+        exchange_capacity=_slack_cap(all_ids.shape[0], self.num_parts,
+                                     slack))
+    sizes = [lvl.shape[0] for lvl in levels]
+    xs, off = [], 0
+    for s in sizes:
+      xs.append(feats[off:off + s])
+      off += s
+    masks = [lvl >= 0 for lvl in levels]
+    y = labels[:self.batch_size]
+    stats7 = jnp.concatenate(
+        [fstats, jnp.stack(gst), jnp.zeros((1,), jnp.int32)])
+    return xs, masks, y, stats7
+
+  def _make_sharded(self, train: bool):
+    from .shard_map_compat import shard_map
+    axis = self.axis
+    b = self.batch_size
+
+    def per_device(state_or_params, seeds_s, key, indptr_s, indices_s,
+                   bounds, fshards_s, lshards_s):
+      seeds = seeds_s[0]
+      xs, masks, y, stats7 = self._expand_collect(
+          seeds, key, indptr_s[0], indices_s[0], bounds, fshards_s[0],
+          lshards_s[0])
+      valid = seeds >= 0
+      if not train:
+        logits = self._eval_apply(state_or_params, xs, masks)
+        correct = jax.lax.psum(
+            jnp.sum((jnp.argmax(logits, -1) == y) & valid), axis)
+        total = jax.lax.psum(jnp.sum(valid), axis)
+        return correct, total, stats7[None]
+      state = state_or_params
+
+      def loss_fn(params):
+        logits = self._apply(params, xs, masks)
+        vf = valid.astype(logits.dtype)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, y.astype(jnp.int32))
+        return (ce * vf).sum() / jnp.maximum(vf.sum(), 1.0), logits
+
+      (loss, logits), grads = jax.value_and_grad(
+          loss_fn, has_aux=True)(state.params)
+      grads = jax.lax.pmean(grads, axis)
+      loss = jax.lax.pmean(loss, axis)
+      updates, opt_state = self.tx.update(grads, state.opt_state,
+                                          state.params)
+      params = optax.apply_updates(state.params, updates)
+      new_state = TrainState(params, opt_state, state.step + 1)
+      any_valid = jax.lax.psum(jnp.sum(valid), axis) > 0
+      state = jax.tree_util.tree_map(
+          lambda new, old: jnp.where(any_valid, new, old),
+          new_state, state)
+      correct = jax.lax.psum(
+          jnp.sum((jnp.argmax(logits[:b], -1) == y) & valid), axis)
+      return state, loss, correct, jax.lax.psum(jnp.sum(valid), axis), \
+          stats7[None]
+
+    ax = self.axis
+    if train:
+      out_specs = (P(), P(), P(), P(), P(ax))
+    else:
+      out_specs = (P(), P(), P(ax))
+    return shard_map(
+        per_device, mesh=self.mesh,
+        in_specs=(P(), P(ax), P(), P(ax), P(ax), P(), P(ax), P(ax)),
+        out_specs=out_specs)
+
+  # -- the one program ------------------------------------------------------
+
+  def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
+                key: jax.Array, arrs: dict):
+    def body(state, xs_in):
+      i, seeds = xs_in
+      state, loss, correct, valid, stats = self._sharded_step(
+          state, seeds, jax.random.fold_in(key, i), arrs['indptr'],
+          arrs['indices'], arrs['bounds'], arrs['fshards'],
+          arrs['lshards'])
+      return state, (loss, correct, valid, stats)
+
+    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
+    state, (losses, corrects, valids, stats) = jax.lax.scan(
+        body, state, (steps, seeds_all))
+    return (state, losses, jnp.sum(corrects), jnp.sum(valids),
+            jnp.sum(stats, axis=0))
+
+  def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
+               arrs: dict):
+    def body(carry, xs_in):
+      i, seeds = xs_in
+      correct, total, stats = self._sharded_eval(
+          params, seeds, jax.random.fold_in(key, i), arrs['indptr'],
+          arrs['indices'], arrs['bounds'], arrs['fshards'],
+          arrs['lshards'])
+      return carry, (correct, total, stats)
+
+    steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
+    _, (correct, total, stats) = jax.lax.scan(
+        body, 0, (steps, seeds_all))
+    return jnp.sum(correct), jnp.sum(total), jnp.sum(stats, axis=0)
+
+  # -- host driver ----------------------------------------------------------
+
+  def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
+    """One epoch; ``state`` must be mesh-replicated (`init_state`
+    does it) and is DONATED."""
+    from ..loader.fused import EpochStats
+    flat = np.stack(list(self._batcher))           # [S, P*B]
+    seeds = flat.reshape(-1, self.num_parts, self.batch_size)
+    state, losses, correct, valid, stats = self._compiled(
+        state, self._put_batches(seeds), self._next_epoch_key(),
+        self.sampler._arrays())
+    self.sampler._accumulate_stats(stats)
+    return state, EpochStats(losses, correct, valid)
+
+  def evaluate(self, params, input_nodes,
+               input_space: str = 'old') -> float:
+    """Accuracy over ``input_nodes`` as ONE SPMD scan program."""
+    seeds = self._stack_eval_seeds(input_nodes, input_space)
+    correct, total, stats = self._compiled_eval(
+        params, self._put_batches(seeds), self._eval_key(),
+        self.sampler._arrays())
+    self.sampler._accumulate_stats(stats)
+    return float(int(correct) / max(int(total), 1))
+
+
+class FusedDistLinkEpoch(_MeshEpochDriver):
   """One-program data-parallel LINK-PREDICTION epochs on the mesh.
 
   The link member of the fused mesh family: the scan body runs the
@@ -436,12 +710,9 @@ class FusedDistLinkEpoch:
     stacked = np.stack(list(ev)).reshape(-1, self.num_parts,
                                          self.batch_size,
                                          pairs.shape[1])
-    key = jax.random.fold_in(jax.random.fold_in(self._base_key, 0), 1)
-    pairs_dev = jax.device_put(
-        stacked.astype(np.int32),
-        NamedSharding(self.mesh, P(None, self.axis)))
     wins, total, stats = self._compiled_eval(
-        params, pairs_dev, key, self.sampler._arrays())
+        params, self._put_batches(stacked), self._eval_key(),
+        self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
     return float(wins) / max(float(total), 1.0)
 
@@ -455,12 +726,8 @@ class FusedDistLinkEpoch:
     flat = np.stack(list(self._batcher))           # [S, P*B, 2|3]
     pairs = flat.reshape(-1, self.num_parts, self.batch_size,
                          flat.shape[-1])
-    self._epoch_idx += 1
-    key = jax.random.fold_in(self._base_key, self._epoch_idx)
-    pairs_dev = jax.device_put(
-        pairs.astype(np.int32),
-        NamedSharding(self.mesh, P(None, self.axis)))
     state, losses, valid, stats = self._compiled(
-        state, pairs_dev, key, self.sampler._arrays())
+        state, self._put_batches(pairs), self._next_epoch_key(),
+        self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
     return state, EpochStats(losses, jnp.zeros((), jnp.int32), valid)

@@ -215,3 +215,48 @@ def test_fused_dist_refuses_adaptive_slack():
     FusedDistEpoch(ds, [3, 2], np.arange(N), apply_fn, tx,
                    batch_size=16, mesh=make_mesh(P_PARTS),
                    exchange_slack='adaptive')
+
+
+def test_fused_dist_tree_epoch_trains():
+  """The mesh tree path: sharded-graph tree expansion + one fused
+  feature/label exchange + pmean DP updates learn the planted
+  communities, evaluate() agrees, and telemetry flows."""
+  from graphlearn_tpu.models import TreeSAGE
+  from graphlearn_tpu.parallel import FusedDistTreeEpoch
+  ds = _dist_dataset()
+  mesh = make_mesh(P_PARTS)
+  tx = optax.adam(1e-2)
+  model = TreeSAGE(hidden_features=16, out_features=CLASSES,
+                   num_layers=2)
+  fused = FusedDistTreeEpoch(ds, [4, 3], np.arange(N), model, tx,
+                             batch_size=16, mesh=mesh, shuffle=True,
+                             seed=0)
+  assert len(fused) == N // (16 * P_PARTS)
+  state = fused.init_state(jax.random.key(0))
+  state, first = fused.run(state)
+  for _ in range(14):
+    state, stats = fused.run(state)
+  assert stats['seeds'] == N
+  assert stats['loss'] < first['loss']
+  assert stats['accuracy'] > 0.6, stats['accuracy']
+  acc = fused.evaluate(state.params, np.arange(N))
+  assert acc > 0.6, acc
+  st = fused.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.frontier.offered'] > 0
+  assert st['dist.feature.offered'] > 0
+
+
+def test_fused_dist_tree_refuses_tiered_and_adaptive():
+  from graphlearn_tpu.models import TreeSAGE
+  from graphlearn_tpu.parallel import FusedDistTreeEpoch
+  model = TreeSAGE(hidden_features=8, out_features=CLASSES,
+                   num_layers=2)
+  tx = optax.adam(1e-2)
+  with pytest.raises(ValueError, match='non-tiered'):
+    FusedDistTreeEpoch(_dist_dataset(split_ratio=0.5), [3, 2],
+                       np.arange(N), model, tx, batch_size=16,
+                       mesh=make_mesh(P_PARTS))
+  with pytest.raises(ValueError, match='adaptive'):
+    FusedDistTreeEpoch(_dist_dataset(), [3, 2], np.arange(N), model,
+                       tx, batch_size=16, mesh=make_mesh(P_PARTS),
+                       exchange_slack='adaptive')
