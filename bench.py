@@ -1046,11 +1046,17 @@ def main():
     print(f'budget: skipping dist ({budget_left():.0f}s left)',
           file=sys.stderr)
 
-  # phase 3b — hetero fused session (VERDICT r4 #8), fast days only
-  if budget_left() > 320:
+  # phase 3b — hetero fused session (VERDICT r4 #8).  ~100-150 s with
+  # a warm compile cache (the MAG-scale graph builders and the RGCN
+  # scan all cache); it outranks extra primary sessions — a unique
+  # datum beats another sample of an existing one
+  if budget_left() > 200:
     hetero = _run_hetero_session(
-        int(min(600, max(budget_left() - 20, 120))))
+        int(min(480, max(budget_left() - 20, 120))))
     emit()
+  else:
+    print(f'budget: skipping hetero ({budget_left():.0f}s left)',
+          file=sys.stderr)
 
   # phase 4 — extra primary sessions stabilize the per-batch median
   while (len(results) < sessions and attempts < sessions + 3

@@ -37,9 +37,16 @@ def main():
                   help='time loader.FusedEpoch (whole-epoch lax.scan '
                        'program, remat backward) instead of the '
                        'per-batch loop')
+  ap.add_argument('--tree', action='store_true',
+                  help='time loader.FusedTreeEpoch + models.TreeSAGE '
+                       '(scatter-free/sort-free tree layout — the r5 '
+                       'flagship, 12.4x the subgraph fused step on '
+                       'v5e); combine with --bf16 for MXU compute')
   args = ap.parse_args()
   if args.epochs < 1:
     ap.error('--epochs must be >= 1 (epoch 0 is the untimed warmup)')
+  if args.tree and args.fused:
+    ap.error('--tree and --fused are mutually exclusive')
 
   import jax
   if args.cpu:
@@ -63,18 +70,43 @@ def main():
   # ogbn-products train split is ~196k seeds (8%); mirror that ratio
   train_idx = rng.permutation(n)[:max(n // 12, 1)]
   bs = 1024
+  import jax.numpy as jnp
+  tx = optax.adam(3e-3)
+
+  times = []
+  if args.tree:
+    # the tree path needs none of the per-batch loader/model setup
+    from graphlearn_tpu.loader import FusedTreeEpoch
+    from graphlearn_tpu.models import TreeSAGE
+    tmodel = TreeSAGE(hidden_features=args.hidden,
+                      out_features=args.classes, num_layers=3,
+                      dtype=jnp.bfloat16 if args.bf16 else None)
+    tree = FusedTreeEpoch(ds, [15, 10, 5], train_idx, tmodel, tx,
+                          batch_size=bs, shuffle=True, seed=0,
+                          max_steps_per_program=100)
+    tstate = tree.init_state(jax.random.key(0))
+    for _ in range(2):               # compile + program-load warmup
+      tstate, _ = tree.run(tstate)
+    float(jnp.sum(jax.tree_util.tree_leaves(tstate.params)[0]))
+    for epoch in range(args.epochs):
+      t0 = time.perf_counter()
+      tstate, _ = tree.run(tstate)
+      float(jnp.sum(jax.tree_util.tree_leaves(tstate.params)[0]))
+      times.append(time.perf_counter() - t0)
+    emit('train_epoch_secs', float(np.median(times)), 's',
+         epochs=args.epochs, steps=len(tree), mode='tree-fused',
+         platform=jax.devices()[0].platform)
+    return
+
   loader = NeighborLoader(ds, [15, 10, 5], train_idx, batch_size=bs,
                           shuffle=True, seed=0)
-  import jax.numpy as jnp
   model = GraphSAGE(hidden_features=args.hidden, out_features=args.classes,
                     num_layers=3,
                     dtype=jnp.bfloat16 if args.bf16 else None)
-  tx = optax.adam(3e-3)
   state, apply_fn = create_train_state(
       model, jax.random.key(0), next(iter(loader)), tx)
   step = make_supervised_step(apply_fn, tx, bs)
 
-  times = []
   if args.fused:
     from graphlearn_tpu.loader import FusedEpoch
     fused = FusedEpoch(ds, [15, 10, 5], train_idx, apply_fn, tx,

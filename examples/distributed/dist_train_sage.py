@@ -42,6 +42,12 @@ def main():
                   help='per-device seed batch')
   ap.add_argument('--fanout', type=int, nargs='+', default=[10, 5])
   ap.add_argument('--hidden', type=int, default=64)
+  ap.add_argument('--tree', action='store_true',
+                  help='TREE-layout fused mesh epochs '
+                       '(parallel.FusedDistTreeEpoch + TreeSAGE): the '
+                       'scatter-free/sort-free flagship, distributed '
+                       '— measured 3.9x the subgraph fused rate on '
+                       'the 8-device CPU mesh (r5)')
   ap.add_argument('--fused', action='store_true',
                   help='train each epoch as ONE SPMD lax.scan program '
                        '(parallel.FusedDistEpoch; non-tiered stores, '
@@ -90,12 +96,35 @@ def main():
       int(np.max(np.asarray(ds.node_labels))), mesh) + 1
 
   bs = args.batch_size
+  tx = optax.adam(1e-3)
+
+  if args.tree:
+    # the tree path needs none of the per-batch loader/model setup
+    from graphlearn_tpu.models import TreeSAGE
+    from graphlearn_tpu.parallel import FusedDistTreeEpoch
+    tmodel = TreeSAGE(hidden_features=args.hidden,
+                      out_features=num_classes,
+                      num_layers=len(args.fanout))
+    tree = FusedDistTreeEpoch(ds, args.fanout, np.arange(n), tmodel,
+                              tx, batch_size=bs, mesh=mesh,
+                              shuffle=True, seed=0)
+    tstate = tree.init_state(jax.random.key(0))
+    for epoch in range(args.epochs):
+      t0 = time.perf_counter()
+      tstate, stats = tree.run(tstate)
+      print(f'epoch {epoch}: loss {stats["loss"]:.4f}  '
+            f'train acc {stats["accuracy"]:.4f}  '
+            f'({time.perf_counter() - t0:.2f}s, {len(tree)} steps x '
+            f'{num_parts} devices, tree-fused)')
+    acc = tree.evaluate(tstate.params, np.arange(n))
+    print(f'eval acc: {acc:.4f}')
+    return
+
   loader = DistNeighborLoader(ds, args.fanout, np.arange(n),
                               batch_size=bs, shuffle=True, mesh=mesh,
                               seed=0)
   model = GraphSAGE(hidden_features=args.hidden,
                     out_features=num_classes, num_layers=2)
-  tx = optax.adam(1e-3)
   b0 = next(iter(loader))
   single = jax.tree_util.tree_map(lambda v: v[0], b0)
   state, _ = create_train_state(model, jax.random.key(0), single, tx)

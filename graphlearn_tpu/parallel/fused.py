@@ -82,6 +82,30 @@ class _MeshEpochDriver:
     return np.stack(list(ev)).reshape(-1, self.num_parts,
                                       self.batch_size)
 
+  def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
+    """Run one epoch; ``state`` must be mesh-replicated and is
+    DONATED — thread the returned state forward.  ``stats`` is LAZY
+    (`loader.fused.EpochStats`)."""
+    from ..loader.fused import EpochStats
+    flat = np.stack(list(self._batcher))           # [S, P*B]
+    seeds = flat.reshape(-1, self.num_parts, self.batch_size)
+    state, losses, correct, valid, stats = self._compiled(
+        state, self._put_batches(seeds), self._next_epoch_key(),
+        self.sampler._arrays())
+    self.sampler._accumulate_stats(stats)
+    return state, EpochStats(losses, correct, valid)
+
+  def evaluate(self, params, input_nodes,
+               input_space: str = 'old') -> float:
+    """Accuracy over ``input_nodes`` (e.g. the test split) as ONE
+    SPMD scan program (VERDICT r4 #5)."""
+    seeds = self._stack_eval_seeds(input_nodes, input_space)
+    correct, total, stats = self._compiled_eval(
+        params, self._put_batches(seeds), self._eval_key(),
+        self.sampler._arrays())
+    self.sampler._accumulate_stats(stats)
+    return float(int(correct) / max(int(total), 1))
+
 
 class FusedDistEpoch(_MeshEpochDriver):
   """One-program data-parallel training epochs on the mesh engine.
@@ -233,35 +257,8 @@ class FusedDistEpoch(_MeshEpochDriver):
         body, 0, (steps, seeds_all))
     return jnp.sum(correct), jnp.sum(total), jnp.sum(stats, axis=0)
 
-  def evaluate(self, params, input_nodes,
-               input_space: str = 'old') -> float:
-    """Accuracy over ``input_nodes`` (e.g. the test split) as ONE
-    SPMD scan program — the mesh twin of
-    `loader.fused._SupervisedScanEpoch.evaluate`
-    (VERDICT r4 #5: dist fused training could not eval without
-    leaving the fused path)."""
-    seeds = self._stack_eval_seeds(input_nodes, input_space)
-    correct, total, stats = self._compiled_eval(
-        params, self._put_batches(seeds), self._eval_key(),
-        self.sampler._arrays())
-    self.sampler._accumulate_stats(stats)
-    return float(int(correct) / max(int(total), 1))
-
-  # -- host driver ----------------------------------------------------------
-
-  def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
-    """Run one epoch; ``state`` must be mesh-replicated (`dp.replicate`)
-    and is DONATED — thread the returned state forward.  ``stats`` is
-    LAZY (`loader.fused.EpochStats`): reading ``.loss`` etc. syncs on
-    the epoch; a loop that ignores it never blocks."""
-    from ..loader.fused import EpochStats
-    flat = np.stack(list(self._batcher))           # [S, P*B]
-    seeds = flat.reshape(-1, self.num_parts, self.batch_size)
-    state, losses, correct, valid, stats = self._compiled(
-        state, self._put_batches(seeds), self._next_epoch_key(),
-        self.sampler._arrays())
-    self.sampler._accumulate_stats(stats)
-    return state, EpochStats(losses, correct, valid)
+  # run()/evaluate() come from `_MeshEpochDriver` — one host driver
+  # for the supervised mesh twins (VERDICT r4 #5 wired there)
 
 
 class FusedDistTreeEpoch(_MeshEpochDriver):
@@ -357,10 +354,9 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     return len(self._batcher)
 
   def init_state(self, rng) -> TrainState:
+    from ..models.tree import tree_level_sizes
     d = self.ds.node_features.feature_dim
-    sizes = [self.batch_size]
-    for k in self.fanouts:
-      sizes.append(sizes[-1] * k)
+    sizes = tree_level_sizes(self.batch_size, self.fanouts)
     xs = [jnp.zeros((s, d), jnp.float32) for s in sizes]
     masks = [jnp.ones((s,), jnp.bool_) for s in sizes]
     params = self.model.init(rng, xs, masks)
@@ -495,29 +491,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         body, 0, (steps, seeds_all))
     return jnp.sum(correct), jnp.sum(total), jnp.sum(stats, axis=0)
 
-  # -- host driver ----------------------------------------------------------
-
-  def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
-    """One epoch; ``state`` must be mesh-replicated (`init_state`
-    does it) and is DONATED."""
-    from ..loader.fused import EpochStats
-    flat = np.stack(list(self._batcher))           # [S, P*B]
-    seeds = flat.reshape(-1, self.num_parts, self.batch_size)
-    state, losses, correct, valid, stats = self._compiled(
-        state, self._put_batches(seeds), self._next_epoch_key(),
-        self.sampler._arrays())
-    self.sampler._accumulate_stats(stats)
-    return state, EpochStats(losses, correct, valid)
-
-  def evaluate(self, params, input_nodes,
-               input_space: str = 'old') -> float:
-    """Accuracy over ``input_nodes`` as ONE SPMD scan program."""
-    seeds = self._stack_eval_seeds(input_nodes, input_space)
-    correct, total, stats = self._compiled_eval(
-        params, self._put_batches(seeds), self._eval_key(),
-        self.sampler._arrays())
-    self.sampler._accumulate_stats(stats)
-    return float(int(correct) / max(int(total), 1))
+  # run()/evaluate() come from `_MeshEpochDriver`
 
 
 class FusedDistLinkEpoch(_MeshEpochDriver):
