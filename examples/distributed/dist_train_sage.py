@@ -64,6 +64,8 @@ def main():
                        'features and the offline cache plan are '
                        'served host-locally)')
   args = ap.parse_args()
+  if args.tree and args.fused:
+    ap.error('--tree and --fused are mutually exclusive')
 
   import jax
   import optax
