@@ -1058,21 +1058,19 @@ def main():
     print(f'budget: skipping hetero ({budget_left():.0f}s left)',
           file=sys.stderr)
 
-  # phase 4 — extra primary sessions stabilize the per-batch median
-  while (len(results) < sessions and attempts < sessions + 3
-         and budget_left() > session_timeout * 0.75):
-    r = _run_session(int(min(session_timeout, budget_left())))
-    attempts += 1
-    if r is not None:
-      results.append(r)
-      emit()
-
-  # opportunistic — per-P scale-envelope rows for the dist section
-  if isinstance(dist, dict) and 'error' not in dist \
-      and budget_left() > 300:
+  # phase 3c — per-P scale-envelope rows for the dist section (each
+  # ~60-120 s; a new datum, so it outranks extra primary samples —
+  # the r5 runs where this sat after phase 4 never reached it)
+  if not (isinstance(dist, dict) and 'error' not in dist):
+    print('skipping envelope rows: no dist section to attach to',
+          file=sys.stderr)
+  elif budget_left() <= 160:
+    print(f'budget: skipping envelope rows ({budget_left():.0f}s left)',
+          file=sys.stderr)
+  else:
     env_rows = []
     for p_, bsz in ((16, 64), (64, 32)):
-      if budget_left() < 200:
+      if budget_left() < 130:
         break
       r = _run_envelope_row(p_, bsz,
                             int(min(280, max(budget_left() - 30, 60))))
@@ -1080,6 +1078,15 @@ def main():
         env_rows.append(r)
     if env_rows:
       dist['scale_envelope'] = env_rows
+      emit()
+
+  # phase 4 — extra primary sessions stabilize the per-batch median
+  while (len(results) < sessions and attempts < sessions + 3
+         and budget_left() > session_timeout * 0.75):
+    r = _run_session(int(min(session_timeout, budget_left())))
+    attempts += 1
+    if r is not None:
+      results.append(r)
       emit()
 
   if not (results or fused_res or dist):

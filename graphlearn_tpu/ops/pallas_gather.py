@@ -85,19 +85,17 @@ _MAX_DMA_IDS = 1 << 17
 
 
 def pallas_enabled() -> bool:
-  """Use Pallas kernels?  Default: only on a real TPU backend.
+  """Use the Pallas per-row DMA gather?  Default: NO since r5.
 
-  ``GLT_PALLAS=0`` forces the XLA paths everywhere; ``GLT_PALLAS=1``
-  forces Pallas (interpret-mode off-TPU — for debugging only).
+  The r5 elision-proof roofline (module docstring) put the per-row
+  DMA at ~26-33 GB/s vs XLA's ~51 GB/s on the same sorted 1M-row
+  pattern — the earlier "parity at 0.4 TB/s" reading that justified
+  a TPU-on default was a tunnel timing artifact.  XLA is now the
+  default everywhere; ``GLT_PALLAS=1`` opts the DMA kernel back in
+  (on-TPU, or interpret-mode off-TPU for debugging).
   """
-  flag = os.environ.get('GLT_PALLAS')
-  if flag is not None:
-    flag = flag.strip().lower()
-    if flag in ('1', 'true', 'on', 'yes'):
-      return True
-    if flag in ('0', 'false', 'off', 'no', ''):
-      return False
-  return jax.default_backend() == 'tpu'
+  return os.environ.get('GLT_PALLAS', '').strip().lower() in (
+      '1', 'true', 'on', 'yes')
 
 
 def _interpret_default() -> bool:
