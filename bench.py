@@ -109,17 +109,9 @@ def _pull_state(state) -> float:
 
 
 def _sample_window_bytes(batch, fanouts):
-  """Analytic upper bound on HBM bytes the multihop sampler's window
-  gathers move per batch: each hop gathers a ``W = default_window(k)``
-  wide int32 window of `indices` per frontier node (`ops/neighbor.py`
-  — the exact-without-replacement path; hub nodes with ``deg > W``
-  read only k draws, so this is an upper bound)."""
-  from graphlearn_tpu.ops.neighbor import default_window
-  frontier, total = batch, 0
-  for k in fanouts:
-    total += frontier * default_window(k) * 4
-    frontier *= k
-  return total
+  """See `benchmarks.common.sample_window_bytes` (one definition)."""
+  from benchmarks.common import sample_window_bytes
+  return sample_window_bytes(batch, fanouts)
 
 
 def _tree_step_flops(batch, fanouts, dim, hidden, classes):
@@ -375,26 +367,12 @@ def worker(fused_only: bool = False):
   # loop approaches this number).  AOT-compiled, first execution,
   # value pull.
   iters = SAMPLE_ITERS
-  from jax import lax
-  from graphlearn_tpu.sampler.neighbor_sampler import _multihop_sample
+  from benchmarks.common import make_sample_burst
   g = ds.get_graph()
   srng = np.random.default_rng(1)
   seeds_all = jnp.asarray(
       srng.integers(0, n, (iters, BATCH)).astype(np.int32))
-
-  def sample_burst(indptr, indices, seeds_all, key):
-    def body(carry, xs):
-      i, seeds = xs
-      (_nodes, _count, _row, _col, _edge, emask, _sl, _nsn,
-       _nse) = _multihop_sample(
-           indptr, indices, None, seeds, jax.random.fold_in(key, i),
-           fanouts=FANOUT, node_cap=node_cap, with_edge=False,
-           sort_locality=True)
-      return carry + jnp.sum(emask, dtype=jnp.int32), None
-    steps_ax = jnp.arange(iters, dtype=jnp.int32)
-    total, _ = lax.scan(body, jnp.int32(0), (steps_ax, seeds_all))
-    return total
-
+  sample_burst = make_sample_burst(FANOUT, node_cap, iters)
   comp = jax.jit(sample_burst).lower(
       g.indptr, g.indices, seeds_all, jax.random.key(11)).compile()
   t0 = time.perf_counter()

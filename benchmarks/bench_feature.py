@@ -9,6 +9,13 @@ kernel vs the XLA gather on the hot tier.
 Usage::
 
     python benchmarks/bench_feature.py [--cpu] [--quick]
+
+r5 PROTOCOL CAVEAT: this sweep still times dispatch loops with
+`block_until_ready`, which the tunneled chip can under-report by
+orders of magnitude (elided executions — see benchmarks/README
+"r5 protocol note").  Its numbers are comparative between configs in
+one run, NOT absolute; the authoritative pull-protocol numbers are
+`bench.py`'s (gather roofline, epoch walls).
 """
 import argparse
 import os

@@ -7,6 +7,13 @@ single-node half — the number the training loop actually sees.
 Usage::
 
     python benchmarks/bench_loader.py [--cpu] [--quick]
+
+r5 PROTOCOL CAVEAT: this sweep still times dispatch loops with
+`block_until_ready`, which the tunneled chip can under-report by
+orders of magnitude (elided executions — see benchmarks/README
+"r5 protocol note").  Its numbers are comparative between configs in
+one run, NOT absolute; the authoritative pull-protocol numbers are
+`bench.py`'s (gather roofline, epoch walls).
 """
 import argparse
 import sys

@@ -30,7 +30,10 @@ def run_one(fanout, batch, quick: bool, cpu: bool):
   if cpu:
     jax.config.update('jax_platforms', 'cpu')
   from graphlearn_tpu.data import Dataset
-  from graphlearn_tpu.sampler import NeighborSampler, NodeSamplerInput
+  from graphlearn_tpu.sampler import NeighborSampler
+
+  import jax.numpy as jnp
+  from benchmarks.common import make_sample_burst, sample_window_bytes
 
   n = 200_000 if quick else None
   iters = 5 if quick else 20
@@ -41,28 +44,36 @@ def run_one(fanout, batch, quick: bool, cpu: bool):
   g.lazy_init()
   rng = np.random.default_rng(1)
   sampler = NeighborSampler(g, list(fanout), seed=0)
-  seed_batches = [rng.integers(0, n, batch).astype(np.int32)
-                  for _ in range(iters)]
+  node_cap = sampler.node_capacity(batch)
+  seeds_all = jnp.asarray(
+      rng.integers(0, n, (iters, batch)).astype(np.int32))
 
-  def one(i):
-    return sampler.sample_from_nodes(
-        NodeSamplerInput(node=seed_batches[i]))
-
-  out = one(0)
-  out.row.block_until_ready()          # compile
-  # ONE timed burst: on tunneled chips only the first burst per
-  # process measures true throughput (dispatch degrades after it) —
-  # the sweep isolates each config in a fresh process.
-  outs = []
-  with Timer() as t:
-    for i in range(iters):
-      outs.append(one(i))
-    for o in outs:
-      o.row.block_until_ready()
-  edges = sum(int(np.asarray(o.edge_mask).sum()) for o in outs)
-  emit('sampler_edges_per_sec', edges / t.dt / 1e6, 'M edges/s',
+  # r5 pull protocol (see bench.py / benchmarks/README): the whole
+  # burst is ONE scan program — a per-batch dispatch loop measures
+  # tunnel dispatch latency, and `block_until_ready` walls are not
+  # trustworthy.  The FIRST execution carries ~5-7 s of program load
+  # and the SECOND can be ELIDED — time both, keep the second only
+  # if it clears the analytic window-bytes floor, else fall back to
+  # the first (overstated by the load cost, flagged).
+  burst = make_sample_burst(fanout, node_cap, iters)
+  comp = jax.jit(burst).lower(g.indptr, g.indices, seeds_all,
+                              jax.random.key(5)).compile()
+  with Timer() as t1:
+    edges = int(comp(g.indptr, g.indices, seeds_all,
+                     jax.random.key(6)))
+  with Timer() as t2:
+    edges = int(comp(g.indptr, g.indices, seeds_all,
+                     jax.random.key(7)))
+  platform = jax.devices()[0].platform
+  floor = (iters * sample_window_bytes(batch, fanout) / 819e9
+           if platform == 'tpu' else 0.0)
+  suspect = t2.dt < floor
+  dt = t1.dt if suspect else t2.dt
+  emit('sampler_edges_per_sec', edges / dt / 1e6, 'M edges/s',
        fanout=list(fanout), batch=batch,
-       platform=jax.devices()[0].platform)
+       first_exec_secs=round(t1.dt, 4), steady_secs=round(t2.dt, 4),
+       floor_secs=round(floor, 4), suspect_elision=bool(suspect),
+       platform=platform)
 
 
 def main():

@@ -147,6 +147,46 @@ def build_bipartite_csr_device(n_src: int, n_dst: int, avg_deg: int,
   return build(jax.random.key(seed))
 
 
+def sample_window_bytes(batch: int, fanouts) -> int:
+  """Analytic upper bound on HBM bytes one multihop sample's window
+  gathers move (`ops/neighbor.py` exact-without-replacement path) —
+  the elision-floor basis for sampling walls (r5 protocol)."""
+  from graphlearn_tpu.ops.neighbor import default_window
+  frontier, total = batch, 0
+  for k in fanouts:
+    total += frontier * default_window(k) * 4
+    frontier *= k
+  return total
+
+
+def make_sample_burst(fanouts, node_cap: int, iters: int):
+  """The r5 sampling-throughput program, ONE definition for
+  `bench.py` and `bench_sampler.py`: a scan over ``[iters, B]`` seed
+  batches whose body is the fused multihop sampler, returning the
+  accepted-edge total (the value pull that forces real execution).
+  Named unpacking so a `_multihop_sample` signature change fails
+  loudly instead of summing the wrong array."""
+  import jax
+  import jax.numpy as jnp
+  from jax import lax
+  from graphlearn_tpu.sampler.neighbor_sampler import _multihop_sample
+
+  def burst(indptr, indices, seeds_all, key):
+    def body(acc, xs):
+      i, seeds = xs
+      (_nodes, _count, _row, _col, _edge, emask, _seed_local, _nsn,
+       _nse) = _multihop_sample(
+           indptr, indices, None, seeds, jax.random.fold_in(key, i),
+           fanouts=tuple(fanouts), node_cap=node_cap, with_edge=False,
+           sort_locality=True)
+      return acc + jnp.sum(emask, dtype=jnp.int32), None
+    total, _ = lax.scan(body, jnp.int32(0), (
+        jnp.arange(iters, dtype=jnp.int32), seeds_all))
+    return total
+
+  return burst
+
+
 def emit(metric: str, value: float, unit: str, baseline: float = None,
          **extra):
   rec = {'metric': metric, 'value': round(float(value), 3), 'unit': unit}
