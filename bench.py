@@ -50,8 +50,20 @@ a mid-range read of public GLT-class A100 pipelines on this workload;
 BASELINE.md documents the absence of published values).  > 1.0 means
 faster than that nominal A100.
 
-Prints ONE JSON line per completed phase; the LAST line is the
-artifact: {"metric", "value", "unit", "vs_baseline", ...}.
+ARTIFACT CONTRACT (r6): the FULL aggregate JSON is written to
+`BENCH_ARTIFACT.json` (`GLT_BENCH_ARTIFACT` overrides the path) after
+every completed phase — atomic replace, so a kill at any point leaves
+the newest complete artifact on disk.  Stdout carries only a SHORT
+summary line (<= 2000 chars, `telemetry.sink.summary_line`) naming the
+artifact file: r5's evidence chain broke because the full aggregate
+outgrew the driver's 2000-char stdout tail (`BENCH_r05.json`
+"parsed": null).  The dist section also runs with the flight recorder
+on, writing per-hop padding / slack-transition / exchange events to
+`BENCH_TELEMETRY.jsonl` (`GLT_TELEMETRY_JSONL` overrides).
+
+`--trace-dir DIR` captures an xprof trace (TensorBoard profile plugin
+format) around the fused session's epoch dispatches, which carry
+`StepTraceAnnotation` step markers.
 """
 import json
 import os
@@ -94,6 +106,15 @@ DIST_NODES = 200_000
 DIST_DIM = 64
 DIST_BATCH = 512
 DIST_BATCHES_PER_EPOCH = 2
+
+
+def _arg_after(flag: str):
+  """Value following ``flag`` on argv (None when absent)."""
+  if flag in sys.argv:
+    i = sys.argv.index(flag)
+    if i + 1 < len(sys.argv):
+      return sys.argv[i + 1]
+  return None
 
 
 def _pull(x) -> float:
@@ -243,17 +264,34 @@ def worker(fused_only: bool = False):
                              batch_size=BATCH, shuffle=True, seed=0,
                              max_steps_per_program=100)
       tstate = fused.init_state(jax.random.key(0))
-      t0 = time.perf_counter()
-      tstate, _ = fused.run(tstate)
-      _pull_state(tstate)
-      result['fused_compile_secs'] = round(time.perf_counter() - t0, 1)
-      print(json.dumps(result), flush=True)
+      # --trace-dir: xprof capture around the headline epochs (the
+      # fused drivers wrap each dispatch in a StepTraceAnnotation, so
+      # the timeline segments by chunk).  The finally covers the
+      # COMPILE dispatch too — jax materializes the trace only on
+      # stop_trace, and the compile is the most expensive thing the
+      # flag exists to profile.
+      trace_dir = _arg_after('--trace-dir')
       runs = []
-      for _ in range(3):            # distinct epoch keys per run
+      try:
+        if trace_dir:
+          from graphlearn_tpu.utils.profiling import start_trace
+          start_trace(trace_dir)
+          result['trace_dir'] = trace_dir
         t0 = time.perf_counter()
         tstate, _ = fused.run(tstate)
         _pull_state(tstate)
-        runs.append(round(time.perf_counter() - t0, 4))
+        result['fused_compile_secs'] = round(time.perf_counter() - t0,
+                                             1)
+        print(json.dumps(result), flush=True)
+        for _ in range(3):          # distinct epoch keys per run
+          t0 = time.perf_counter()
+          tstate, _ = fused.run(tstate)
+          _pull_state(tstate)
+          runs.append(round(time.perf_counter() - t0, 4))
+      finally:
+        if trace_dir:
+          from graphlearn_tpu.utils.profiling import stop_trace
+          stop_trace()
       result['fused_epoch_runs'] = runs
       med = statistics.median(runs)
       result['epoch_secs_fused'] = med
@@ -566,6 +604,23 @@ def dist_worker():
   # killed the worker mid-phase when tried.
   from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
                                        make_mesh)
+  from graphlearn_tpu.telemetry import recorder
+  # flight recorder ON for the dist section: per-hop padding fill,
+  # slack-ladder transitions, exchange/cold-tier deltas land in a
+  # JSONL next to the artifact (costs one nsn sync per batch — this
+  # section measures exchange accounting, not dispatch latency)
+  jsonl_path = os.environ.get('GLT_TELEMETRY_JSONL',
+                              'BENCH_TELEMETRY.jsonl')
+  # fresh flight log per bench run: close any import-time file handle
+  # FIRST (with GLT_TELEMETRY_JSONL set, the recorder enabled at
+  # import holding this very path — unlinking under it would orphan
+  # the inode and lose every event), then unlink, then (re)open
+  recorder.disable()
+  try:
+    os.unlink(jsonl_path)
+  except OSError:
+    pass
+  recorder.enable(jsonl_path)
   assert len(jax.devices()) == DIST_PARTS, jax.devices()
   rows, cols = build_graph(DIST_NODES)
   rng = np.random.default_rng(0)
@@ -616,10 +671,24 @@ def dist_worker():
       'padding_waste_pct_by_epoch': waste_by_epoch,
       'padding_waste_pct': waste_by_epoch[-1] if waste_by_epoch else None,
       'drop_rate_pct': round(drop, 3),
+      # cluster-wide derived aggregates (== host-local on this
+      # single-controller mesh; sums host cold counters at multi-host)
+      'cluster': loader.sampler.cluster_exchange_stats(),
+      'flight_recorder': jsonl_path,
+      'slack_transitions': len(recorder.events('slack.transition')),
+      # the adaptive phase runs recorder-ON (it IS the attribution
+      # phase); its seeds/edges rates carry the per-batch nsn sync +
+      # JSONL writes.  All later timed windows run recorder-off.
+      'recorder_on_during_adaptive': True,
   }
   # adaptive-phase numbers are safe NOW: if the later phases time out,
   # the harness takes the last printed JSON line
   print(json.dumps(out), flush=True)
+  # recorder OFF for the remaining TIMED windows (README: attribution
+  # on, throughput off — the per-batch nsn sync + JSONL writes must
+  # not ride inside a measured loop); re-enabled briefly around the
+  # fused warm run below so its hop events still land in the JSONL
+  recorder.disable()
   # tiered store in the MEASURED path: same workload, 30% of each
   # partition's rows in "HBM", the rest served by the host overlay
   ds_t = DistDataset.from_full_graph(DIST_PARTS, rows, cols,
@@ -693,8 +762,13 @@ def dist_worker():
   fstate, _ = fused.run(fstate)
   jax.tree_util.tree_leaves(fstate.params)[0].block_until_ready()
   f_compile = time.perf_counter() - t0
+  # warm run with the recorder ON: the fused epoch's per-hop
+  # padding-fill events land in the JSONL without touching the timed
+  # window below
+  recorder.enable(jsonl_path)
   fstate, _ = fused.run(fstate)         # donated-layout recompile
   jax.tree_util.tree_leaves(fstate.params)[0].block_until_ready()
+  recorder.disable()
   t0 = time.perf_counter()
   fstate, _ = fused.run(fstate)
   jax.tree_util.tree_leaves(fstate.params)[0].block_until_ready()
@@ -965,6 +1039,46 @@ def _aggregate(results, fused_res, dist, hetero=None):
   }
 
 
+_SINK = None
+
+
+def _sink_module():
+  """Load `telemetry/sink.py` directly by file path: the sink is
+  json/os/tempfile-only, and loading it this way keeps the driver
+  process free of the full package (and jax) import chain."""
+  global _SINK
+  if _SINK is None:
+    import importlib.util
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     'graphlearn_tpu', 'telemetry', 'sink.py')
+    spec = importlib.util.spec_from_file_location('_bench_sink', p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _SINK = mod
+  return _SINK
+
+
+def _emit_artifact(art):
+  """The r6 artifact sink contract: write the FULL aggregate to the
+  artifact file (atomic) and return the short stdout summary line —
+  always <= 2000 chars, always naming the artifact file.  The driver's
+  last-JSON-line salvage parses the summary; the evidence lives in the
+  file.
+
+  Degrades, never dies: if the sink cannot write (read-only cwd, disk
+  full), the FULL aggregate JSON goes to stdout exactly as before r6 —
+  a sink failure must not cost the measurement (the indestructible-
+  artifact contract this sink exists to strengthen)."""
+  try:
+    sink = _sink_module()
+    path = sink.write_artifact(art)
+    return sink.summary_line(art, artifact=path)
+  except Exception as e:            # noqa: BLE001 — degrade to stdout
+    print(f'artifact sink failed ({type(e).__name__}: {e}); '
+          f'falling back to full JSON on stdout', file=sys.stderr)
+    return json.dumps(art)
+
+
 def main():
   sessions = int(os.environ.get('GLT_BENCH_SESSIONS', 4))
   session_timeout = int(os.environ.get('GLT_BENCH_SESSION_TIMEOUT', 420))
@@ -984,10 +1098,11 @@ def main():
 
   def emit():
     """The indestructible-artifact contract: full cumulative
-    aggregate after every completed phase."""
+    aggregate to the artifact FILE after every completed phase;
+    stdout gets only the bounded summary line."""
     if results or fused_res or dist or hetero:
-      print(json.dumps(_aggregate(results, fused_res, dist, hetero)),
-            flush=True)
+      print(_emit_artifact(_aggregate(results, fused_res, dist,
+                                      hetero)), flush=True)
 
   # phase 1 — one primary session (epochs + sampling + roofline).
   attempts = 0

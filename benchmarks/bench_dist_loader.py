@@ -252,6 +252,8 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
   # envelope row (VERDICT r4 #9)
   out['memory_envelope_v5p128'] = memory_envelope(128)
   print(json.dumps(out), flush=True)
+  from benchmarks.common import tee_record
+  tee_record(out)
 
 
 def capacity_sweep(quick: bool):

@@ -194,6 +194,21 @@ def emit(metric: str, value: float, unit: str, baseline: float = None,
     rec['vs_baseline'] = round(float(value) / baseline, 4)
   rec.update(extra)
   print(json.dumps(rec), flush=True)
+  tee_record(rec)
+
+
+def tee_record(rec: dict) -> None:
+  """File-artifact tee for sweep records: every emitted config line
+  also appends to the JSONL sidecar (`telemetry.sink.append_record`,
+  `GLT_BENCH_RECORDS` overrides the path, default
+  ``BENCH_ARTIFACT.jsonl``) — line-atomic across the sweeps' fresh
+  subprocesses, so a truncated stdout capture no longer loses
+  measurements.  Best-effort: a sink failure never kills a bench."""
+  try:
+    from graphlearn_tpu.telemetry import sink
+    sink.append_record(rec)
+  except Exception:               # noqa: BLE001 — telemetry is optional
+    pass
 
 
 class Timer:

@@ -7,7 +7,7 @@ distributed (ICI-collective) runtime — built for TPU from the ground
 up: static shapes + masks, counter-based PRNG, pjit/shard_map
 parallelism instead of RPC.
 """
-from . import data, loader, ops, sampler, utils
+from . import data, loader, ops, sampler, telemetry, utils
 from .typing import (EdgeType, NodeType, RangePartitionBook, Split,
                      TablePartitionBook, as_str, reverse_edge_type)
 

@@ -13,14 +13,51 @@ process-portable form of one sampled mini-batch (the reference uses
 from __future__ import annotations
 
 import abc
+import time
 from typing import Dict
 
 import numpy as np
 
 SampleMessage = Dict[str, np.ndarray]
 
+#: a send/recv that blocks longer than this counts as a ring STALL —
+#: the producer outran the consumer (send) or starved it (recv).
+STALL_SECS = 0.01
 
-class ChannelBase(abc.ABC):
+
+class ChannelTelemetry:
+  """Ring occupancy/stall instrumentation shared by the channels.
+
+  Concrete channels wrap their blocking queue ops in :meth:`_timed`:
+  every call ticks ``channel.<op>.calls`` in the metrics registry;
+  calls that blocked past `STALL_SECS` tick ``channel.<op>.stalls`` /
+  ``.stall_secs`` and emit a ``channel.stall`` flight-recorder event
+  carrying the ring occupancy when the transport exposes one
+  (`_occupancy`; -1 = unknown).  Cheap when the recorder is off: two
+  perf_counter reads and two counter ticks per message.
+  """
+
+  def _occupancy(self) -> int:
+    """Messages currently queued; -1 when the transport can't say."""
+    return -1
+
+  def _timed(self, op: str, fn, *args):
+    from ..telemetry.recorder import recorder
+    from ..utils.profiling import metrics
+    t0 = time.perf_counter()
+    out = fn(*args)
+    dt = time.perf_counter() - t0
+    metrics.inc(f'channel.{op}.calls')
+    if dt > STALL_SECS:
+      metrics.inc(f'channel.{op}.stalls')
+      metrics.inc(f'channel.{op}.stall_secs', dt)
+      recorder.emit('channel.stall', op=op, secs=round(dt, 6),
+                    occupancy=self._occupancy(),
+                    channel=type(self).__name__)
+    return out
+
+
+class ChannelBase(ChannelTelemetry, abc.ABC):
   """Abstract producer->consumer sample-message queue."""
 
   @abc.abstractmethod

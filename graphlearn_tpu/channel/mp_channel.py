@@ -16,10 +16,16 @@ class MpChannel(ChannelBase):
     self._q = mp.get_context('spawn').Queue(maxsize)
 
   def send(self, msg: SampleMessage) -> None:
-    self._q.put(msg)
+    self._timed('send', self._q.put, msg)
 
   def recv(self) -> SampleMessage:
-    return self._q.get()
+    return self._timed('recv', self._q.get)
+
+  def _occupancy(self) -> int:
+    try:
+      return int(self._q.qsize())
+    except (NotImplementedError, OSError):
+      return -1
 
   def empty(self) -> bool:
     return self._q.empty()

@@ -30,10 +30,16 @@ class ShmChannel(ChannelBase):
     self._q = ShmQueue(num_slots=capacity, slot_bytes=slot)
 
   def send(self, msg: SampleMessage) -> None:
-    self._q.put(msg)
+    self._timed('send', self._q.put, msg)
 
   def recv(self) -> SampleMessage:
-    return self._q.get()
+    return self._timed('recv', self._q.get)
+
+  def _occupancy(self) -> int:
+    try:
+      return int(self._q.qsize())
+    except Exception:             # noqa: BLE001 — native probe only
+      return -1
 
   def recv_timeout(self, timeout: float):
     """Dequeue with a timeout; ``None`` when nothing arrived — the

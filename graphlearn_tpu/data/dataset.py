@@ -56,19 +56,53 @@ class Dataset:
     import jax
 
     def _is_device_csr(ei):
+      # BOTH halves must be device arrays: a mixed (jax.Array, numpy)
+      # pair used to slip through on the first element alone and
+      # reach `Graph.from_device_arrays` with a host indices array
       return (isinstance(ei, (tuple, list)) and len(ei) == 2
-              and isinstance(ei[0], jax.Array))
+              and isinstance(ei[0], jax.Array)
+              and isinstance(ei[1], jax.Array))
+
+    def _check_device_csr(ei, nn, etype=None):
+      # the device-native path trusts the arrays as canonical CSR; the
+      # one cheap invariant we CAN check is the indptr row count
+      # against an explicit num_nodes (shape metadata, no device sync)
+      if nn is None:
+        return
+      got = int(ei[0].shape[0]) - 1
+      if got != int(nn):
+        where = f' for edge type {etype!r}' if etype is not None else ''
+        raise ValueError(
+            f'device CSR indptr{where} implies {got} nodes '
+            f'(indptr.shape[0] - 1) but num_nodes={int(nn)} was given')
 
     if layout == 'CSR' and _is_device_csr(edge_index):
       # device-native path: arrays already on device in canonical
       # sorted-CSR form (see `Graph.from_device_arrays`) — no host
       # round trip, no re-sort
+      _check_device_csr(edge_index,
+                        num_nodes if not isinstance(num_nodes, dict)
+                        else None)
       self.graph = Graph.from_device_arrays(edge_index[0], edge_index[1],
                                             edge_ids=edge_ids)
       return self
     if (layout == 'CSR' and isinstance(edge_index, dict)
         and all(_is_device_csr(ei) for ei in edge_index.values())):
       # hetero device-native path (per-etype device CSR)
+      if num_nodes is not None:
+        for etype, ei in edge_index.items():
+          if isinstance(num_nodes, dict):
+            # keyed by edge type, or by node type (the CSR row count
+            # is the SOURCE type's node count) — same resolution as
+            # the host path below
+            nn = num_nodes.get(etype)
+            if nn is None and isinstance(etype, tuple):
+              nn = num_nodes.get(etype[0])
+          else:
+            # a scalar applies to every etype's row dimension, the
+            # host path's behavior
+            nn = num_nodes
+          _check_device_csr(ei, nn, etype=etype)
       self.graph = {
           etype: Graph.from_device_arrays(
               ei[0], ei[1],
@@ -142,6 +176,16 @@ class Dataset:
                      dtype, topo: Optional[CSRTopo]) -> Feature:
     import jax
     if isinstance(feats, jax.Array):
+      if sort_func is not None:
+        # the hotness reorder runs on HOST rows before upload; on a
+        # device-resident table it would be silently skipped — and a
+        # fully-hot table (the device-native contract) has no cold
+        # tier for the ordering to matter to.  Reorder before
+        # `device_put` and pass `id2idx`, or drop the sorter.
+        raise ValueError(
+            'sort_func cannot reorder a device-resident feature '
+            'table; apply the reorder on host (and pass id2idx) '
+            'before putting the table on device')
       # device-native tables go straight to Feature (which validates
       # split_ratio == 1.0); convert_to_array would pull them to host
       return Feature(feats, id2index=id2idx, split_ratio=split_ratio,

@@ -117,7 +117,13 @@ class Feature:
       self.split_ratio = 1.0
       self._device = device
       self._dtype = dtype
-      self._hot = feats if dtype is None else feats.astype(dtype)
+      hot = feats if dtype is None else feats.astype(dtype)
+      if device is not None and device not in feats.devices():
+        # an explicit device that differs from where the table lives
+        # must move it — silently keeping the old placement made the
+        # `device=` argument a no-op on the device-native path
+        hot = jax.device_put(hot, device)
+      self._hot = hot
       self._id2index_dev = (None if id2index is None
                             else jnp.asarray(id2index, jnp.int32))
       self.hot_rows = feats.shape[0]

@@ -45,7 +45,7 @@ from ..ops.pallas_gather import pallas_enabled
 from ..sampler.base import NegativeSampling
 from ..sampler.neighbor_sampler import (NeighborSampler, _multihop_sample,
                                         _triplet_neg_dst)
-from ..utils.profiling import metrics
+from ..utils.profiling import metrics, step_annotation
 from .link_loader import EdgeSeedBatcher
 from .node_loader import SeedBatcher
 from .transform import Batch, _gather_labels
@@ -125,7 +125,8 @@ def _fresh_compile():
 _FAST_COMPILE_OPTIONS = {'xla_llvm_disable_expensive_passes': True}
 
 
-def _uncached_jit(fn, fast_compile: bool = False, **jit_kwargs):
+def _uncached_jit(fn, fast_compile: bool = False,
+                  cacheable: bool = False, **jit_kwargs):
   """`jax.jit` whose every call runs under `_fresh_compile` — the
   bypass is attached to the callable ONCE, so no dispatch site can
   forget it.  Compiles (the first call and the donated-layout
@@ -134,28 +135,62 @@ def _uncached_jit(fn, fast_compile: bool = False, **jit_kwargs):
   scan program.  ``fast_compile`` trades runtime for compile wall
   (see `_FAST_COMPILE_OPTIONS`).
 
-  ``GLT_FUSED_COMPILE_CACHE=1`` opts back INTO the persistent cache:
+  ``GLT_FUSED_COMPILE_CACHE=1`` opts back INTO the persistent cache,
+  but only for callables built with ``cacheable=True`` (the fused
+  classes pass it when ``max_steps_per_program`` bounds the program):
   the r5 re-test of the r3 "deserialized executable crashes the TPU
   worker" finding showed a CHUNKED tree-epoch program loading from
   the cache and running value-pulled-correct in a fresh process
   (12.3 s vs 67.7 s fresh, identical losses) — the r3 crash is now
   attributed to the tunnel's ~70 s execution watchdog killing
   FULL-LENGTH programs (whose "successful" fresh runs were elided,
-  benchmarks/README "Execution watchdog").  The bypass stays the
-  default until a multi-round burn-in; `bench.py`'s fused session
-  sets the flag for the chunk-bounded tree program."""
+  benchmarks/README "Execution watchdog"), so full-length programs
+  never opt in.  The env var is read at DISPATCH time, not wrap
+  time, so a harness that sets it after construction (or clears it
+  between epochs) still takes effect.
+
+  Every dispatch feeds the telemetry plane: an in-memory executable
+  hit ticks ``fused.compile.hits``; a dispatch that compiled ticks
+  ``fused.compile.misses`` + ``fused.compile.secs`` and emits a
+  ``fused.compile`` flight-recorder event whose ``secs`` is the wall
+  of that dispatch (compile + first execution — the same definition
+  bench.py's compile numbers use)."""
   import os as _os
+  import time as _time
+  from ..telemetry.recorder import recorder
   if fast_compile:
     jit_kwargs = dict(jit_kwargs,
                       compiler_options=_FAST_COMPILE_OPTIONS)
   compiled = jax.jit(fn, **jit_kwargs)
-  if _os.environ.get('GLT_FUSED_COMPILE_CACHE') == '1':
-    compiled.jitted = compiled
-    return compiled
+  name = getattr(fn, '__qualname__', None) or getattr(
+      fn, '__name__', 'jit_fn')
+
+  def _cache_size() -> int:
+    try:
+      return compiled._cache_size()
+    except Exception:             # noqa: BLE001 — jax internals moved
+      return -1
 
   def call(*args, **kwargs):
-    with _fresh_compile():
-      return compiled(*args, **kwargs)
+    use_cache = (cacheable and
+                 _os.environ.get('GLT_FUSED_COMPILE_CACHE') == '1')
+    before = _cache_size()
+    t0 = _time.perf_counter()
+    if use_cache:
+      out = compiled(*args, **kwargs)
+    else:
+      with _fresh_compile():
+        out = compiled(*args, **kwargs)
+    after = _cache_size()
+    if after >= 0 and after > before:
+      dt = _time.perf_counter() - t0
+      metrics.inc('fused.compile.misses')
+      metrics.inc('fused.compile.secs', dt)
+      recorder.emit('fused.compile', fn=name, secs=round(dt, 3),
+                    persistent_cache=bool(use_cache))
+    elif after >= 0:
+      metrics.inc('fused.compile.hits')
+    return out
 
   call.jitted = compiled         # escape hatch for lower()/inspection
   return call
@@ -266,13 +301,20 @@ class _SupervisedScanEpoch:
     for c0, real, part in parts:
       # single-program epochs keep the r4 key schedule exactly
       ck = key if len(parts) == 1 else jax.random.fold_in(key, c0)
-      state, ls, c, v = self._compiled(
-          state, jnp.asarray(part), ck, self._dev, pallas_enabled())
+      with step_annotation('fused_epoch', self._next_dispatch()):
+        state, ls, c, v = self._compiled(
+            state, jnp.asarray(part), ck, self._dev, pallas_enabled())
       losses.append(ls[:real])
       correct = c if correct is None else correct + c
       valid = v if valid is None else valid + v
     metrics.inc('loader.batches', seeds.shape[0])
     return state, EpochStats(jnp.concatenate(losses), correct, valid)
+
+  def _next_dispatch(self) -> int:
+    """Monotone per-loader dispatch counter — the xprof step number of
+    each fused program dispatch (one per chunk)."""
+    self._dispatch_idx = getattr(self, '_dispatch_idx', 0) + 1
+    return self._dispatch_idx
 
   def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
                dev: dict, use_pallas: bool):
@@ -409,10 +451,14 @@ class FusedEpoch(_SupervisedScanEpoch):
         self._extract_with(step_apply), tx, self.batch_size)
     self._eval_step = make_extracted_eval_step(
         self._extract_with(apply_fn), self.batch_size)
+    # only chunk-bounded programs may opt into the persistent
+    # compilation cache (see `_uncached_jit`)
+    cacheable = self._chunk is not None
     self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
-                             static_argnums=(4,))
+                             static_argnums=(4,), cacheable=cacheable)
     self._compiled_eval = _uncached_jit(self._eval_fn,
-                                        static_argnums=(4,))
+                                        static_argnums=(4,),
+                                        cacheable=cacheable)
 
   @staticmethod
   def _extract_with(apply):
@@ -541,10 +587,12 @@ class FusedHeteroEpoch(_SupervisedScanEpoch):
         self._extract_with(step_apply), tx, self.batch_size)
     self._eval_step = make_extracted_eval_step(
         self._extract_with(apply_fn), self.batch_size)
+    cacheable = self._chunk is not None
     self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
-                             static_argnums=(4,))
+                             static_argnums=(4,), cacheable=cacheable)
     self._compiled_eval = _uncached_jit(self._eval_fn,
-                                        static_argnums=(4,))
+                                        static_argnums=(4,),
+                                        cacheable=cacheable)
 
   def _extract_with(self, apply):
     it = self.input_type
@@ -680,10 +728,12 @@ class FusedLinkEpoch:
     step_apply = jax.checkpoint(apply_fn) if remat else apply_fn
     self._apply = apply_fn            # un-remat'd: evaluate() is fwd-only
     self._step = make_unsupervised_step(step_apply, tx)
+    cacheable = self._chunk is not None
     self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
-                             static_argnums=(6,))
+                             static_argnums=(6,), cacheable=cacheable)
     self._compiled_eval = _uncached_jit(self._auc_fn,
-                                        static_argnums=(5,))
+                                        static_argnums=(5,),
+                                        cacheable=cacheable)
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -858,11 +908,11 @@ class FusedLinkEpoch:
     chunk = self._chunk or s
     losses, valid = [], None
 
-    def piece(a, c0):
+    def piece(a, c0, fill=-1):
       part = a[c0:c0 + chunk]
       if part.shape[0] < chunk:
         part = np.concatenate([
-            part, np.full((chunk - part.shape[0], a.shape[1]), -1,
+            part, np.full((chunk - part.shape[0], a.shape[1]), fill,
                           a.dtype)])
       return jnp.asarray(part)
 
@@ -870,10 +920,16 @@ class FusedLinkEpoch:
     for c0 in range(0, s, chunk):
       real = min(chunk, s - c0)
       ck = key if n_chunks == 1 else jax.random.fold_in(key, c0)
-      state, ls, v = self._compiled(
-          state, piece(srcs, c0), piece(dsts, c0),
-          None if labels is None else piece(labels, c0),
-          ck, self._dev, pallas_enabled())
+      self._dispatch_idx = getattr(self, '_dispatch_idx', 0) + 1
+      with step_annotation('fused_link_epoch', self._dispatch_idx):
+        state, ls, v = self._compiled(
+            state, piece(srcs, c0), piece(dsts, c0),
+            # chunk-tail label padding uses the established invalid
+            # sentinel 0 ("sampled negative"/masked), NOT -1: a -1
+            # label reaching a metadata consumer that skips
+            # edge_label_mask would index class tables out of range
+            None if labels is None else piece(labels, c0, fill=0),
+            ck, self._dev, pallas_enabled())
       losses.append(ls[:real])
       valid = v if valid is None else valid + v
     metrics.inc('loader.batches', s)

@@ -133,10 +133,15 @@ class FusedTreeEpoch(_SupervisedScanEpoch):
     apply = model.apply
     self._apply = jax.checkpoint(apply) if remat else apply
     self._eval_apply = apply
+    # chunk-bounded programs may opt into the persistent compilation
+    # cache via GLT_FUSED_COMPILE_CACHE=1 (see loader.fused._uncached_jit)
+    cacheable = self._chunk is not None
     self._compiled = _uncached_jit(self._epoch_fn, donate_argnums=(0,),
-                                   static_argnums=(4,))
+                                   static_argnums=(4,),
+                                   cacheable=cacheable)
     self._compiled_eval = _uncached_jit(self._eval_fn,
-                                        static_argnums=(4,))
+                                        static_argnums=(4,),
+                                        cacheable=cacheable)
 
   def __len__(self) -> int:
     return len(self._batcher)

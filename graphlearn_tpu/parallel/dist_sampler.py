@@ -405,12 +405,20 @@ class AdaptiveSlack:
   def slack(self):
     return SLACK_LADDER[self._idx]
 
-  def _set(self, idx: int) -> None:
+  def _set(self, idx: int, reason: str = '',
+           drop_rate: float = 0.0) -> None:
     if idx == self._idx:
       return
+    from ..telemetry.recorder import recorder
+    from ..utils.profiling import metrics
+    frm = SLACK_LADDER[self._idx]
     self._idx = idx
     self.sampler.exchange_slack = SLACK_LADDER[idx]
     self.sampler._steps.clear()       # new capacity = new program
+    metrics.inc('dist.slack.transitions')
+    recorder.emit('slack.transition', from_slack=frm,
+                  to_slack=SLACK_LADDER[idx], reason=reason,
+                  drop_rate=round(float(drop_rate), 6))
 
   #: ALL loss channels the shared slack caps gate — a clean frontier
   #: with skewed feature buckets must still read as "dropping"
@@ -432,13 +440,16 @@ class AdaptiveSlack:
     if rate > ADAPTIVE_DROP_TOLERANCE:
       # widen; if this reverses our own tighten, pin there
       wider = min(self._idx + 1, len(SLACK_LADDER) - 1)
-      self._set(wider)
+      self._set(wider, reason='drops', drop_rate=rate)
       if self._tightened_from is not None and \
           wider >= self._tightened_from:
         self._pinned = True
+        from ..telemetry.recorder import recorder
+        recorder.emit('slack.pinned', slack=SLACK_LADDER[self._idx],
+                      drop_rate=round(float(rate), 6))
     elif self._idx > 0:
       self._tightened_from = self._idx
-      self._set(self._idx - 1)
+      self._set(self._idx - 1, reason='drop_free', drop_rate=rate)
 
 
 #: per-destination capacity floor: exchanges this small gain nothing
@@ -913,6 +924,7 @@ class ExchangeTelemetry:
     out['dist.feature.cold_hit_rate'] = (
         1.0 - cold_misses / cold_lookups if cold_lookups else 1.0)
     if tick_metrics:
+      from ..telemetry.recorder import recorder
       from ..utils.profiling import metrics
       for n, d in zip(EXCHANGE_STAT_NAMES, delta):
         if d:
@@ -921,7 +933,48 @@ class ExchangeTelemetry:
         metrics.inc('dist.feature.cold_lookups', float(cold_delta[0]))
       if cold_delta[1] > 0:
         metrics.inc('dist.feature.cold_misses', float(cold_delta[1]))
+      if delta.any():
+        # one flight-recorder event per drain window: the since-last
+        # deltas, so a JSONL reader sees the exchange trajectory
+        # without diffing cumulative totals
+        recorder.emit(
+            'dist.exchange',
+            **{n.replace('.', '_'): int(d)
+               for n, d in zip(EXCHANGE_STAT_NAMES, delta)})
+      if cold_delta[0] > 0:
+        recorder.emit('dist.cold_tier', lookups=int(cold_delta[0]),
+                      misses=int(cold_delta[1]),
+                      hit_rate=round(
+                          1.0 - cold_delta[1] / cold_delta[0], 6))
     return out
+
+  def cluster_exchange_stats(self) -> dict:
+    """CLUSTER-wide exchange health: raw totals plus the derived
+    padding-waste / drop-rate numbers the bench rounds track.
+
+    The device-side counters are already global — each step's
+    ``[P, 7]`` stats vector is summed over the sharded mesh axis
+    before the host drains it, so every process reads the same
+    cluster totals.  The HOST-side cold-tier counters are
+    per-process; under multiple controllers they are summed over
+    hosts via `telemetry.aggregate.allgather_sum_int`.  On a single
+    controller (including the virtual CPU mesh) this is exactly
+    `exchange_stats` plus the derived keys.
+    """
+    from ..telemetry.aggregate import allgather_sum_int, exchange_summary
+    st = dict(self.exchange_stats())
+    num_hosts = jax.process_count()
+    if num_hosts > 1:
+      lookups, misses = allgather_sum_int(
+          [st['dist.feature.cold_lookups'],
+           st['dist.feature.cold_misses']])
+      st['dist.feature.cold_lookups'] = lookups
+      st['dist.feature.cold_misses'] = misses
+      st['dist.feature.cold_hit_rate'] = (
+          1.0 - misses / lookups if lookups else 1.0)
+    st['num_hosts'] = num_hosts
+    st.update(exchange_summary(st))
+    return st
 
 
 def put_stacked_host_local(mesh: Mesh, axis: str, num_parts: int,
@@ -1634,11 +1687,28 @@ class DistNeighborLoader(PrefetchingLoader):
   def __len__(self):
     return len(self._batcher)
 
+  def _maybe_emit_hop_events(self, nsn) -> None:
+    """Per-hop frontier-size / padding-fill flight-recorder events for
+    one batch.  Only when the recorder is on: reading the stacked
+    ``num_sampled_nodes`` is a device sync, which the hot path must
+    never pay by default."""
+    from ..telemetry.recorder import recorder
+    if not recorder.enabled:
+      return
+    from ..telemetry.aggregate import per_hop_padding
+    self._batch_idx = getattr(self, '_batch_idx', 0) + 1
+    rows = per_hop_padding(np.asarray(nsn), self.batch_size,
+                           self.sampler.fanouts)
+    for row in rows:
+      recorder.emit('hop.padding', scope='dist_loader',
+                    batch=self._batch_idx, **row)
+
   def _produce(self, seed_iter):
     from ..loader.transform import Batch
     flat = next(seed_iter)                         # [P * B]
     seeds = flat.reshape(self.num_parts, self.batch_size)
     out = self.sampler.sample_from_nodes(seeds)
+    self._maybe_emit_hop_events(out['num_sampled_nodes'])
     edge_index = jnp.stack([out['row'], out['col']], axis=1)  # [P, 2, E]
     return Batch(
         x=out['x'], y=out['y'], edge_index=edge_index,

@@ -87,13 +87,41 @@ class _MeshEpochDriver:
     DONATED — thread the returned state forward.  ``stats`` is LAZY
     (`loader.fused.EpochStats`)."""
     from ..loader.fused import EpochStats
+    from ..utils.profiling import step_annotation
     flat = np.stack(list(self._batcher))           # [S, P*B]
     seeds = flat.reshape(-1, self.num_parts, self.batch_size)
-    state, losses, correct, valid, stats = self._compiled(
-        state, self._put_batches(seeds), self._next_epoch_key(),
-        self.sampler._arrays())
+    key = self._next_epoch_key()
+    with step_annotation('fused_dist_epoch', self._epoch_idx):
+      state, losses, correct, valid, stats, hops = self._compiled(
+          state, self._put_batches(seeds), key, self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
+    self._emit_hop_events(hops, seeds.shape[0])
     return state, EpochStats(losses, correct, valid)
+
+  def _emit_hop_events(self, hop_counts, steps: int) -> None:
+    """Per-hop padding-fill flight-recorder events for one fused
+    epoch.  ``hop_counts`` is the epoch's ``[H+1]`` per-hop node
+    totals (summed over steps and devices inside the program — free
+    in the scan); reading it is a device sync, so this only runs when
+    the recorder is on (`EpochStats` laziness stays intact
+    otherwise)."""
+    from ..telemetry.recorder import recorder
+    if not recorder.enabled:
+      return
+    from ..telemetry.aggregate import per_hop_padding
+    fanouts = getattr(self, 'fanouts', None) or self.sampler.fanouts
+    rows = per_hop_padding(
+        np.asarray(hop_counts),
+        self.batch_size * self.num_parts * max(int(steps), 1), fanouts)
+    for row in rows:
+      recorder.emit('hop.padding', scope=type(self).__name__,
+                    epoch=self._epoch_idx, steps=int(steps), **row)
+
+  def cluster_exchange_stats(self) -> dict:
+    """Cluster-wide padding-waste / drop-rate / cold-tier report for
+    this epoch driver (delegates to the sampler's telemetry — see
+    `ExchangeTelemetry.cluster_exchange_stats`)."""
+    return self.sampler.cluster_exchange_stats()
 
   def evaluate(self, params, input_nodes,
                input_space: str = 'old') -> float:
@@ -225,20 +253,24 @@ class FusedDistEpoch(_MeshEpochDriver):
   def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
                 key: jax.Array, arrs: dict):
     """``[S, P, B]`` seed batches → S fused exchange+collect+train
-    steps; outputs per-step losses and the summed telemetry."""
+    steps; outputs per-step losses, the summed telemetry and the
+    per-hop new-node totals (for the padding-fill gauges)."""
 
     def body(state, xs):
       i, seeds = xs
       batch, stats = self._collate(seeds, jax.random.fold_in(key, i),
                                    arrs)
       state, loss, correct = self._dp_step(state, batch)
-      return state, (loss, correct, jnp.sum(seeds >= 0), stats)
+      # [P, H+1] new-node counts -> [H+1]: per-hop padding fill rides
+      # the scan for free instead of a per-batch host sync
+      hop = jnp.sum(batch.num_sampled_nodes, axis=0)
+      return state, (loss, correct, jnp.sum(seeds >= 0), stats, hop)
 
     steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
-    state, (losses, corrects, valids, stats) = jax.lax.scan(
+    state, (losses, corrects, valids, stats, hops) = jax.lax.scan(
         body, state, (steps, seeds_all))
     return (state, losses, jnp.sum(corrects), jnp.sum(valids),
-            jnp.sum(stats, axis=0))
+            jnp.sum(stats, axis=0), jnp.sum(hops, axis=0))
 
   def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
                arrs: dict):
@@ -370,7 +402,10 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
   def _expand_collect(self, seeds, key, indptr_s, indices_s, bounds,
                       fshards_s, lshards_s):
     """Tree expansion + one fused feature/label exchange for one
-    device's ``[B]`` seed slice.  Returns (xs, masks, y, stats7)."""
+    device's ``[B]`` seed slice.  Returns
+    ``(xs, masks, y, stats7, hop_counts)`` — ``hop_counts[h]`` is the
+    number of VALID ids in level ``h`` (the tree analog of the
+    dedup path's per-hop new-node count, for the padding gauges)."""
     from .dist_sampler import (_dist_one_hop, _slack_cap,
                                dist_gather_multi)
     slack = self.sampler.exchange_slack
@@ -402,7 +437,9 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     y = labels[:self.batch_size]
     stats7 = jnp.concatenate(
         [fstats, jnp.stack(gst), jnp.zeros((1,), jnp.int32)])
-    return xs, masks, y, stats7
+    hop_counts = jnp.stack(
+        [jnp.sum((lvl >= 0).astype(jnp.int32)) for lvl in levels])
+    return xs, masks, y, stats7, hop_counts
 
   def _make_sharded(self, train: bool):
     from .shard_map_compat import shard_map
@@ -412,7 +449,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     def per_device(state_or_params, seeds_s, key, indptr_s, indices_s,
                    bounds, fshards_s, lshards_s):
       seeds = seeds_s[0]
-      xs, masks, y, stats7 = self._expand_collect(
+      xs, masks, y, stats7, hop_counts = self._expand_collect(
           seeds, key, indptr_s[0], indices_s[0], bounds, fshards_s[0],
           lshards_s[0])
       valid = seeds >= 0
@@ -422,6 +459,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
             jnp.sum((jnp.argmax(logits, -1) == y) & valid), axis)
         total = jax.lax.psum(jnp.sum(valid), axis)
         return correct, total, stats7[None]
+      hop_g = jax.lax.psum(hop_counts, axis)       # global [H+1]
       state = state_or_params
 
       def loss_fn(params):
@@ -445,12 +483,12 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
           new_state, state)
       correct = jax.lax.psum(
           jnp.sum((jnp.argmax(logits[:b], -1) == y) & valid), axis)
-      return state, loss, correct, jax.lax.psum(jnp.sum(valid), axis), \
-          stats7[None]
+      return (state, loss, correct, jax.lax.psum(jnp.sum(valid), axis),
+              stats7[None], hop_g)
 
     ax = self.axis
     if train:
-      out_specs = (P(), P(), P(), P(), P(ax))
+      out_specs = (P(), P(), P(), P(), P(ax), P())
     else:
       out_specs = (P(), P(), P(ax))
     return shard_map(
@@ -464,17 +502,17 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
                 key: jax.Array, arrs: dict):
     def body(state, xs_in):
       i, seeds = xs_in
-      state, loss, correct, valid, stats = self._sharded_step(
+      state, loss, correct, valid, stats, hop = self._sharded_step(
           state, seeds, jax.random.fold_in(key, i), arrs['indptr'],
           arrs['indices'], arrs['bounds'], arrs['fshards'],
           arrs['lshards'])
-      return state, (loss, correct, valid, stats)
+      return state, (loss, correct, valid, stats, hop)
 
     steps = jnp.arange(seeds_all.shape[0], dtype=jnp.int32)
-    state, (losses, corrects, valids, stats) = jax.lax.scan(
+    state, (losses, corrects, valids, stats, hops) = jax.lax.scan(
         body, state, (steps, seeds_all))
     return (state, losses, jnp.sum(corrects), jnp.sum(valids),
-            jnp.sum(stats, axis=0))
+            jnp.sum(stats, axis=0), jnp.sum(hops, axis=0))
 
   def _eval_fn(self, params, seeds_all: jax.Array, key: jax.Array,
                arrs: dict):
@@ -697,11 +735,13 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     ``stats.seeds`` counts valid seed EDGES; accuracy reads 0 (the
     unsupervised objective has no accuracy)."""
     from ..loader.fused import EpochStats
+    from ..utils.profiling import step_annotation
     flat = np.stack(list(self._batcher))           # [S, P*B, 2|3]
     pairs = flat.reshape(-1, self.num_parts, self.batch_size,
                          flat.shape[-1])
-    state, losses, valid, stats = self._compiled(
-        state, self._put_batches(pairs), self._next_epoch_key(),
-        self.sampler._arrays())
+    key = self._next_epoch_key()
+    with step_annotation('fused_dist_link_epoch', self._epoch_idx):
+      state, losses, valid, stats = self._compiled(
+          state, self._put_batches(pairs), key, self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
     return state, EpochStats(losses, jnp.zeros((), jnp.int32), valid)

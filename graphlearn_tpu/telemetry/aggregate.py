@@ -1,0 +1,145 @@
+"""Mesh/cluster aggregation of host-local telemetry.
+
+The device-side exchange counters of the mesh engines are already
+cluster-global (each step's ``[P, 7]`` stats are summed over the sharded
+axis before the host drains them), but everything the HOST ticks —
+cold-tier lookups, loader batches, channel stalls, compile seconds — is
+per-process.  :func:`gather_metrics` allgathers each host's `Metrics`
+snapshot over the existing collective plane
+(`jax.experimental.multihost_utils`, the same transport the cold-tier
+capacity handshake rides) and sums them, so a multi-host job can report
+cluster-wide numbers instead of host-0-only ones.
+
+Single-controller processes (including the virtual CPU mesh the tests
+and CI run) take the degenerate path: one host, aggregate == local.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.profiling import Metrics, metrics
+
+
+def _allgather_snapshots(snap: Dict[str, float]) -> List[Dict[str, float]]:
+  """One snapshot per process, via two `process_allgather` rounds
+  (length agreement, then uint8-padded JSON payloads) — key sets may
+  differ across hosts, so the payload is a string, not a vector."""
+  import jax
+  if jax.process_count() == 1:
+    return [dict(snap)]
+  from jax.experimental import multihost_utils
+  payload = np.frombuffer(json.dumps(snap).encode('utf-8'), np.uint8)
+  sizes = multihost_utils.process_allgather(
+      np.asarray([payload.size], np.int64)).reshape(-1)
+  cap = int(sizes.max())
+  buf = np.zeros((max(cap, 1),), np.uint8)
+  buf[:payload.size] = payload
+  gathered = multihost_utils.process_allgather(buf)
+  out = []
+  for i in range(gathered.shape[0]):
+    raw = bytes(bytearray(gathered[i, :int(sizes[i])]))
+    out.append(json.loads(raw.decode('utf-8')) if raw else {})
+  return out
+
+
+def allgather_sum_int(vals) -> List[int]:
+  """Element-wise SUM of an int vector across processes — the
+  host-counter aggregation primitive (`cluster_exchange_stats` sums
+  its cold-tier counters through this).  Single process: identity."""
+  import jax
+  if jax.process_count() == 1:
+    return [int(v) for v in vals]
+  from jax.experimental import multihost_utils
+  return [int(x) for x in multihost_utils.process_allgather(
+      np.asarray(vals, np.int64)).sum(axis=0)]
+
+
+def gather_metrics(registry: Optional[Metrics] = None,
+                   prefix: Optional[str] = None) -> Dict:
+  """Cluster-wide view of a `Metrics` registry.
+
+  Allgathers every process's ``registry.snapshot()`` and sums per key.
+  ``prefix`` filters the snapshot before the exchange (smaller payload
+  and a focused report, e.g. ``prefix='dist.'``).
+
+  Returns ``{'num_hosts': H, 'aggregate': {key: summed}, 'per_host':
+  [snapshot, ...]}`` — `per_host` preserves the raw inputs so callers
+  can check the aggregate against the host-local numbers.
+  """
+  snap = (registry if registry is not None else metrics).snapshot()
+  if prefix:
+    snap = {k: v for k, v in snap.items() if k.startswith(prefix)}
+  snaps = _allgather_snapshots(snap)
+  agg: Dict[str, float] = {}
+  for s in snaps:
+    for k, v in s.items():
+      agg[k] = agg.get(k, 0) + v
+  return {'num_hosts': len(snaps), 'aggregate': agg, 'per_host': snaps}
+
+
+def exchange_summary(stats: Dict[str, float]) -> Dict[str, float]:
+  """Derived exchange health from a ``dist.*`` counter dict (the
+  `exchange_stats` / `gather_metrics` key vocabulary): padding waste
+  and drop rate per loss channel, the numbers the bench rounds track.
+  """
+  def g(k):
+    return float(stats.get(k, 0))
+
+  fr_off, fr_drop = g('dist.frontier.offered'), g('dist.frontier.dropped')
+  fr_slots = g('dist.frontier.slots')
+  ft_off, ft_drop = g('dist.feature.offered'), g('dist.feature.dropped')
+  ft_slots = g('dist.feature.slots')
+  sent_fr = fr_off - fr_drop
+  sent_ft = ft_off - ft_drop
+  out = {
+      'frontier_padding_waste_pct': round(
+          100.0 * (1 - sent_fr / fr_slots), 4) if fr_slots else None,
+      'frontier_drop_rate_pct': round(
+          100.0 * fr_drop / fr_off, 4) if fr_off else None,
+      'feature_padding_waste_pct': round(
+          100.0 * (1 - sent_ft / ft_slots), 4) if ft_slots else None,
+      'feature_drop_rate_pct': round(
+          100.0 * ft_drop / ft_off, 4) if ft_off else None,
+      'negative_lost': g('dist.negative.lost'),
+  }
+  lookups = g('dist.feature.cold_lookups')
+  if lookups:
+    out['cold_hit_rate'] = round(
+        1.0 - g('dist.feature.cold_misses') / lookups, 4)
+  return out
+
+
+def per_hop_padding(nsn, batch_size: int,
+                    fanouts: Sequence[int]) -> List[Dict]:
+  """Per-hop frontier sizes and padding-fill ratios from the sampler's
+  ``num_sampled_nodes`` output.
+
+  ``nsn`` is the per-hop NEW-node counts ``[H+1]`` (hop 0 = seeds), or
+  any stacked/batched form of it — leading axes are summed and the
+  capacities scaled by the collapsed multiplicity, so a ``[P, H+1]``
+  mesh output or an epoch's ``[S, H+1]`` stack aggregates correctly.
+
+  Hop ``h >= 1`` expands a frontier of capacity
+  ``batch * prod(fanouts[:h-1])`` into a window of
+  ``batch * prod(fanouts[:h])`` candidate slots; ``fill`` is the
+  fraction of those slots that produced (new, for deduping samplers)
+  nodes — ``1 - fill`` is that hop's padding waste.
+  """
+  arr = np.asarray(nsn, np.int64)
+  mult = int(np.prod(arr.shape[:-1])) if arr.ndim > 1 else 1
+  flat = arr.reshape(-1, arr.shape[-1]).sum(axis=0)
+  caps = [batch_size]
+  for k in fanouts:
+    caps.append(caps[-1] * int(k))
+  out = []
+  for h in range(len(flat)):
+    cap = caps[h] * mult if h < len(caps) else None
+    row = {'hop': h, 'nodes': int(flat[h])}
+    if cap:
+      row['capacity'] = int(cap)
+      row['fill'] = round(float(flat[h]) / cap, 6)
+    out.append(row)
+  return out

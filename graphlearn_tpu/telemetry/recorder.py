@@ -1,0 +1,189 @@
+"""Bounded, thread-safe JSON-lines flight recorder.
+
+One `EventRecorder` holds a fixed-size in-memory ring of structured
+events and (optionally) appends each event as one JSON line to a file.
+Both sides are BOUNDED: the ring by ``max_events`` and the file by
+``max_file_events`` — a runaway emitter can never eat the host's RAM or
+disk (the "flight recorder" contract: keep the most recent window, drop
+the oldest).
+
+Producers call ``recorder.emit('hop.padding', hop=1, fill=0.42, ...)``
+from any thread; when recording is off (the default) ``emit`` is a
+single attribute check, so instrumentation can stay in hot host paths.
+
+Event wire form (one JSON object per line)::
+
+    {"ts": 1722700000.123, "kind": "hop.padding", "hop": 1, ...}
+
+``ts`` is ``time.time()`` at emit; every other field comes from the
+emitter.  Values must be JSON-serializable scalars/lists (numpy scalars
+are coerced).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: env var: a path here enables the global recorder at import time.
+TELEMETRY_ENV = 'GLT_TELEMETRY_JSONL'
+#: env var: override the in-memory ring size of the global recorder.
+TELEMETRY_EVENTS_ENV = 'GLT_TELEMETRY_EVENTS'
+
+DEFAULT_MAX_EVENTS = 4096
+DEFAULT_MAX_FILE_EVENTS = 200_000
+
+
+def _jsonable(v: Any) -> Any:
+  """Coerce numpy / jax scalars to plain python for json.dumps."""
+  item = getattr(v, 'item', None)
+  if item is not None and getattr(v, 'ndim', 0) == 0:
+    try:
+      return item()
+    except Exception:             # noqa: BLE001 — best-effort coercion
+      pass
+  tolist = getattr(v, 'tolist', None)
+  if tolist is not None:
+    try:
+      return tolist()
+    except Exception:             # noqa: BLE001
+      pass
+  return v
+
+
+class EventRecorder:
+  """Bounded thread-safe event ring with an optional JSONL file sink.
+
+  Args:
+    path: JSONL file to append events to (None = ring only).
+    max_events: in-memory ring capacity (oldest events drop first).
+    max_file_events: hard cap on lines written to ``path`` per enable;
+      past it the file stops growing (the ring keeps recording).
+  """
+
+  def __init__(self, path: Optional[str] = None,
+               max_events: int = DEFAULT_MAX_EVENTS,
+               max_file_events: int = DEFAULT_MAX_FILE_EVENTS):
+    self._lock = threading.Lock()
+    self._ring: collections.deque = collections.deque(
+        maxlen=max(int(max_events), 1))
+    self._path: Optional[str] = None
+    self._file = None
+    self._file_events = 0
+    self._max_file_events = int(max_file_events)
+    self._dropped_file_events = 0
+    self.enabled = False
+    if path:
+      self.enable(path)
+
+  # -- lifecycle ----------------------------------------------------------
+  def enable(self, path: Optional[str] = None,
+             max_events: Optional[int] = None,
+             max_file_events: Optional[int] = None) -> 'EventRecorder':
+    """Turn recording on (optionally into a JSONL file).  Idempotent;
+    re-enabling with a different path closes the previous file."""
+    with self._lock:
+      if max_events is not None:
+        self._ring = collections.deque(self._ring,
+                                       maxlen=max(int(max_events), 1))
+      if max_file_events is not None:
+        self._max_file_events = int(max_file_events)
+      if path is not None and path != self._path:
+        self._close_file_locked()
+        self._path = path
+        # line-buffered append: each event is one write, so concurrent
+        # processes sharing a path interleave at line granularity
+        self._file = open(path, 'a', buffering=1)
+        self._file_events = 0
+      self.enabled = True
+    return self
+
+  def disable(self) -> None:
+    with self._lock:
+      self.enabled = False
+      self._close_file_locked()
+      self._path = None
+
+  def _close_file_locked(self) -> None:
+    if self._file is not None:
+      try:
+        self._file.close()
+      except OSError:
+        pass
+      self._file = None
+
+  @property
+  def path(self) -> Optional[str]:
+    return self._path
+
+  # -- emit / read --------------------------------------------------------
+  def emit(self, kind: str, **fields) -> None:
+    """Record one event.  No-op (one attribute check) when disabled."""
+    if not self.enabled:
+      return
+    ev = {'ts': round(time.time(), 6), 'kind': kind}
+    for k, v in fields.items():
+      ev[k] = _jsonable(v)
+    with self._lock:
+      if not self.enabled:        # raced a disable()
+        return
+      self._ring.append(ev)
+      if self._file is not None:
+        if self._file_events < self._max_file_events:
+          try:
+            self._file.write(json.dumps(ev) + '\n')
+            self._file_events += 1
+          except (OSError, ValueError):
+            self._close_file_locked()
+        else:
+          self._dropped_file_events += 1
+
+  def events(self, kind: Optional[str] = None) -> List[Dict]:
+    """Snapshot of the in-memory ring (newest last), optionally
+    filtered by ``kind``."""
+    with self._lock:
+      evs = list(self._ring)
+    if kind is None:
+      return evs
+    return [e for e in evs if e['kind'] == kind]
+
+  def clear(self) -> None:
+    with self._lock:
+      self._ring.clear()
+
+  def dump(self, path: str) -> int:
+    """Write the current ring snapshot as JSONL; returns event count."""
+    evs = self.events()
+    with open(path, 'w') as f:
+      for e in evs:
+        f.write(json.dumps(e) + '\n')
+    return len(evs)
+
+  def stats(self) -> Dict[str, int]:
+    with self._lock:
+      return {'ring_events': len(self._ring),
+              'ring_capacity': self._ring.maxlen,
+              'file_events': self._file_events,
+              'dropped_file_events': self._dropped_file_events}
+
+
+def _from_env() -> EventRecorder:
+  path = os.environ.get(TELEMETRY_ENV) or None
+  try:
+    cap = int(os.environ.get(TELEMETRY_EVENTS_ENV, DEFAULT_MAX_EVENTS))
+  except ValueError:
+    # this runs at package import: a malformed env var must degrade to
+    # the default, not take down every `import graphlearn_tpu`
+    cap = DEFAULT_MAX_EVENTS
+  try:
+    return EventRecorder(path=path, max_events=cap)
+  except OSError:
+    # unwritable JSONL path: record to the ring only
+    return EventRecorder(path=None, max_events=cap)
+
+
+#: process-global flight recorder all library instrumentation emits to.
+recorder = _from_env()

@@ -95,3 +95,13 @@ def capture(log_dir: str) -> Iterator[None]:
     yield
   finally:
     stop_trace()
+
+
+@contextlib.contextmanager
+def step_annotation(name: str, step_num: int) -> Iterator[None]:
+  """xprof STEP marker (`jax.profiler.StepTraceAnnotation`): dispatches
+  wrapped in this show up as numbered steps on the TensorBoard profile
+  timeline.  The fused epoch drivers wrap each program dispatch so a
+  `--trace-dir` capture segments by epoch/chunk."""
+  with jax.profiler.StepTraceAnnotation(name, step_num=int(step_num)):
+    yield

@@ -118,3 +118,51 @@ def test_aggregate_elision_suspect_fused_not_headline(bench):
   assert out['metric'].startswith('graphsage_epoch_secs')
   assert out['value'] == 0.25
   assert out['fused_suspect_elision'] is True
+
+
+def test_artifact_file_written_and_parseable(bench, tmp_path,
+                                             monkeypatch):
+  """r6 sink contract: the FULL aggregate lands in BENCH_ARTIFACT.json
+  (env-overridable), parseable, while stdout carries only the bounded
+  summary naming the file."""
+  dest = tmp_path / 'BENCH_ARTIFACT.json'
+  monkeypatch.setenv('GLT_BENCH_ARTIFACT', str(dest))
+  # a dist payload far beyond any stdout tail: the file must carry it
+  # all, the summary must still fit
+  dist = {'label': 'virtual CPU mesh - relative only',
+          'padding_waste_pct': 71.2, 'drop_rate_pct': 0.0,
+          'num_parts': 8,
+          'scale_envelope': [{'row': i, 'blob': 'x' * 500}
+                             for i in range(16)]}
+  fused = {'mode': 'fused-session', 'platform': 'tpu',
+           'fused_compile_secs': 60.0, 'epoch_secs_fused': 7.1,
+           'fused_layout': 'tree'}
+  art = bench._aggregate([_primary(**FULL)], fused, dist)
+  line = bench._emit_artifact(art)
+  assert dest.exists()
+  full = json.loads(dest.read_text())
+  assert full['value'] == 7.1
+  assert len(full['dist']['scale_envelope']) == 16   # nothing truncated
+  # the stdout line: bounded, parseable, names the artifact, carries
+  # the headline
+  assert len(line) <= 2000
+  summary = json.loads(line)
+  assert summary['artifact'] == str(dest)
+  assert summary['value'] == 7.1
+  assert summary['metric'].startswith('graphsage_fused_epoch_secs')
+  assert summary['dist']['padding_waste_pct'] == 71.2
+
+
+def test_summary_line_bounded_on_pathological_artifact(bench, tmp_path,
+                                                       monkeypatch):
+  """Even an artifact whose every headline field is huge must yield a
+  parseable summary under the 2000-char tail budget."""
+  from graphlearn_tpu.telemetry import sink
+  art = {'metric': 'm' * 500, 'value': 1.0, 'unit': 's',
+         'protocol': 'p' * 900,
+         'epoch_secs_min_med_max': [0.1] * 200,
+         'dist': {'padding_waste_pct': 1.0, 'error': 'e' * 900}}
+  line = sink.summary_line(art, artifact=str(tmp_path / 'a.json'))
+  assert len(line) <= 2000
+  parsed = json.loads(line)
+  assert parsed['value'] == 1.0

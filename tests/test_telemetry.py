@@ -1,0 +1,443 @@
+"""Telemetry plane (ISSUE r6): flight-recorder bounds + thread safety,
+mesh-aggregated metrics on the 8-device virtual mesh, per-hop padding
+gauges against the loader's own numbers, slack-ladder transition
+events, and the compile-cache dispatch telemetry."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from graphlearn_tpu.telemetry import (EventRecorder, exchange_summary,
+                                      gather_metrics, metrics,
+                                      per_hop_padding, recorder)
+from graphlearn_tpu.utils.profiling import Metrics
+
+P = 8
+N = 256
+FANOUT = [2, 2]
+BATCH = 8
+
+
+# -- recorder mechanics -----------------------------------------------------
+
+def test_recorder_ring_bounded():
+  r = EventRecorder(max_events=16)
+  r.enable()
+  for i in range(100):
+    r.emit('tick', i=i)
+  evs = r.events('tick')
+  assert len(evs) == 16                 # bounded: oldest dropped
+  assert [e['i'] for e in evs] == list(range(84, 100))
+  assert r.stats()['ring_capacity'] == 16
+
+
+def test_recorder_disabled_is_noop():
+  r = EventRecorder(max_events=8)
+  r.emit('tick')                        # default OFF
+  assert r.events() == []
+  r.enable()
+  r.emit('tick')
+  r.disable()
+  r.emit('tick')
+  assert len(r.events()) == 1
+
+
+def test_recorder_file_sink_bounded(tmp_path):
+  p = str(tmp_path / 'flight.jsonl')
+  r = EventRecorder(path=p, max_events=64, max_file_events=10)
+  for i in range(25):
+    r.emit('tick', i=i)
+  lines = open(p).read().strip().splitlines()
+  assert len(lines) == 10               # file cap holds
+  assert all(json.loads(ln)['kind'] == 'tick' for ln in lines)
+  st = r.stats()
+  assert st['dropped_file_events'] == 15
+  assert st['ring_events'] == 25        # ring kept recording
+
+
+def test_recorder_thread_safety(tmp_path):
+  p = str(tmp_path / 'flight.jsonl')
+  r = EventRecorder(path=p, max_events=4096, max_file_events=100000)
+  threads, per = 8, 200
+
+  def work(tid):
+    for i in range(per):
+      r.emit('t', tid=tid, i=i)
+
+  ts = [threading.Thread(target=work, args=(t,)) for t in range(threads)]
+  for t in ts:
+    t.start()
+  for t in ts:
+    t.join()
+  lines = open(p).read().strip().splitlines()
+  assert len(lines) == threads * per
+  # every line is intact JSON (no interleaved writes)
+  parsed = [json.loads(ln) for ln in lines]
+  assert all(pv['kind'] == 't' for pv in parsed)
+  assert len(r.events()) == threads * per
+
+
+def test_recorder_coerces_numpy_scalars(tmp_path):
+  p = str(tmp_path / 'f.jsonl')
+  r = EventRecorder(path=p)
+  r.emit('x', a=np.int64(3), b=np.float32(0.5), c=np.arange(2))
+  ev = json.loads(open(p).read())
+  assert ev['a'] == 3 and abs(ev['b'] - 0.5) < 1e-6 and ev['c'] == [0, 1]
+
+
+# -- aggregation helpers ----------------------------------------------------
+
+def test_gather_metrics_single_host_matches_local():
+  reg = Metrics()
+  reg.inc('dist.frontier.offered', 100)
+  reg.inc('dist.frontier.dropped', 3)
+  reg.inc('other.counter', 7)
+  out = gather_metrics(reg)
+  assert out['num_hosts'] == 1
+  assert out['aggregate'] == reg.snapshot()
+  assert out['per_host'] == [reg.snapshot()]
+  only = gather_metrics(reg, prefix='dist.')
+  assert set(only['aggregate']) == {'dist.frontier.offered',
+                                    'dist.frontier.dropped'}
+
+
+def test_exchange_summary_derivations():
+  st = {'dist.frontier.offered': 100, 'dist.frontier.dropped': 10,
+        'dist.frontier.slots': 300, 'dist.feature.offered': 0,
+        'dist.feature.dropped': 0, 'dist.feature.slots': 0,
+        'dist.feature.cold_lookups': 50, 'dist.feature.cold_misses': 5}
+  s = exchange_summary(st)
+  assert s['frontier_padding_waste_pct'] == pytest.approx(70.0)
+  assert s['frontier_drop_rate_pct'] == pytest.approx(10.0)
+  assert s['feature_padding_waste_pct'] is None
+  assert s['cold_hit_rate'] == pytest.approx(0.9)
+
+
+def test_per_hop_padding_stacked_axes():
+  # [P, H+1] mesh form: capacities scale by the collapsed axis
+  nsn = np.array([[4, 6, 10]] * 2)
+  rows = per_hop_padding(nsn, 4, [2, 3])
+  assert rows[0] == {'hop': 0, 'nodes': 8, 'capacity': 8, 'fill': 1.0}
+  assert rows[1]['capacity'] == 16 and rows[1]['nodes'] == 12
+  assert rows[2]['capacity'] == 48 and rows[2]['fill'] == pytest.approx(
+      20 / 48)
+
+
+# -- mesh-integrated paths (8-device virtual mesh) --------------------------
+
+def _dist_dataset():
+  from graphlearn_tpu.parallel import DistDataset
+  rows = np.concatenate([np.arange(N), np.arange(N)])
+  cols = np.concatenate([(np.arange(N) + 1) % N,
+                         (np.arange(N) + 2) % N])
+  feats = np.random.default_rng(0).random((N, 8), np.float32)
+  labels = np.random.default_rng(1).integers(0, 4, N).astype(np.int32)
+  return DistDataset.from_full_graph(P, rows, cols, node_feat=feats,
+                                     node_label=labels, num_nodes=N)
+
+
+@pytest.fixture(scope='module')
+def dist_run(tmp_path_factory):
+  """One adaptive dist-loader run (2 epochs) plus one fused dist
+  epoch, flight recorder ON — several tests read its outputs.  Model
+  init happens BEFORE the recorder turns on so the loader events in
+  the JSONL all belong to the adaptive loader."""
+  from graphlearn_tpu.models import GraphSAGE, create_train_state
+  from graphlearn_tpu.parallel import (DistNeighborLoader,
+                                       FusedDistEpoch, local_batch_piece,
+                                       make_mesh, replicate)
+  import optax
+  path = str(tmp_path_factory.mktemp('telemetry') / 'flight.jsonl')
+  ds = _dist_dataset()
+  mesh = make_mesh(P)
+  # recorder OFF: init batch + params
+  b0 = next(iter(DistNeighborLoader(ds, FANOUT, np.arange(N),
+                                    batch_size=BATCH, mesh=mesh,
+                                    shuffle=True, seed=0)))
+  model = GraphSAGE(hidden_features=8, out_features=4, num_layers=2)
+  tx = optax.adam(1e-2)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), local_batch_piece(b0, P), tx)
+  base = metrics.snapshot()
+  recorder.enable(path, max_events=8192)
+  try:
+    loader = DistNeighborLoader(ds, FANOUT, np.arange(N),
+                                batch_size=BATCH, shuffle=True,
+                                mesh=mesh, seed=0,
+                                exchange_slack='adaptive')
+    nsn_per_batch = []
+    for _ in range(2):
+      for b in loader:
+        nsn_per_batch.append(np.asarray(b.num_sampled_nodes))
+    loader_stats = loader.sampler.exchange_stats()
+
+    fused = FusedDistEpoch(ds, FANOUT, np.arange(N), apply_fn, tx,
+                           batch_size=BATCH, mesh=mesh, shuffle=True,
+                           seed=0)
+    state = replicate(state, mesh)
+    state, stats = fused.run(state)
+    loss = stats.loss
+    cluster = fused.cluster_exchange_stats()
+  finally:
+    recorder.disable()
+  yield dict(path=path, loader=loader, fused=fused,
+             loader_stats=loader_stats, cluster=cluster,
+             nsn_per_batch=nsn_per_batch, base=base, loss=loss)
+
+
+def test_flight_recorder_jsonl_complete(dist_run):
+  lines = open(dist_run['path']).read().strip().splitlines()
+  assert lines, 'flight recorder wrote nothing'
+  kinds = {json.loads(ln)['kind'] for ln in lines}
+  # the acceptance trio: per-hop padding fill, a slack-ladder
+  # transition, and exchange drains all land in ONE JSONL
+  assert 'hop.padding' in kinds
+  assert 'slack.transition' in kinds
+  assert 'dist.exchange' in kinds
+
+
+def test_per_hop_gauges_match_loader(dist_run):
+  """The recorder's hop.padding events must equal the gauges computed
+  from the loader's own num_sampled_nodes output."""
+  evs = [e for e in recorderless_events(dist_run['path'], 'hop.padding')
+         if e.get('scope') == 'dist_loader']
+  per_batch = {}
+  for e in evs:
+    per_batch.setdefault(e['batch'], []).append(e)
+  assert len(per_batch) == len(dist_run['nsn_per_batch'])
+  for bidx, nsn in enumerate(dist_run['nsn_per_batch'], start=1):
+    want = per_hop_padding(nsn, BATCH, FANOUT)
+    got = sorted(per_batch[bidx], key=lambda e: e['hop'])
+    assert len(got) == len(FANOUT) + 1
+    for w, g in zip(want, got):
+      assert g['nodes'] == w['nodes']
+      assert g['capacity'] == w['capacity']
+      assert g['fill'] == pytest.approx(w['fill'])
+      assert 0.0 < g['fill'] <= 1.0
+
+
+def test_exchange_events_sum_to_loader_waste(dist_run):
+  """Summing the dist.exchange drain deltas reproduces the loader's
+  padding_waste_pct exactly — the events are the same counters the
+  bench derives its number from.  The loader drained fully before the
+  fused phase, so its totals are a PREFIX of the event stream."""
+  evs = recorderless_events(dist_run['path'], 'dist.exchange')
+  st = dist_run['loader_stats']
+  waste_loader = 100.0 * (
+      1 - (st['dist.frontier.offered'] - st['dist.frontier.dropped'])
+      / max(st['dist.frontier.slots'], 1))
+  run_off = run_drop = run_slots = 0
+  matched = False
+  for e in evs:
+    run_off += e['frontier_offered']
+    run_drop += e['frontier_dropped']
+    run_slots += e['frontier_slots']
+    if run_off == st['dist.frontier.offered']:
+      matched = True
+      waste_prefix = 100.0 * (1 - (run_off - run_drop)
+                              / max(run_slots, 1))
+      assert waste_prefix == pytest.approx(waste_loader)
+      break
+  assert matched, 'loader totals never appeared in the event stream'
+
+
+def test_gather_metrics_mesh_delta_consistent(dist_run):
+  """`gather_metrics` over the global registry: the delta ticked
+  during the run equals the two samplers' host-local totals summed —
+  the cluster aggregate is consistent with the per-host numbers."""
+  agg = gather_metrics(prefix='dist.')
+  assert agg['num_hosts'] == 1
+  base = dist_run['base']
+  delta = (agg['aggregate'].get('dist.frontier.offered', 0)
+           - base.get('dist.frontier.offered', 0))
+  fused_st = dist_run['fused'].sampler.exchange_stats(
+      tick_metrics=False)
+  want = (dist_run['loader_stats']['dist.frontier.offered']
+          + fused_st['dist.frontier.offered'])
+  assert delta == want
+
+
+def test_fused_epoch_hop_events_and_cluster(dist_run):
+  evs = [e for e in recorderless_events(dist_run['path'], 'hop.padding')
+         if e.get('scope') == 'FusedDistEpoch']
+  assert len(evs) == len(FANOUT) + 1
+  by_hop = {e['hop']: e for e in evs}
+  steps = evs[0]['steps']
+  assert by_hop[0]['capacity'] == BATCH * P * steps
+  for h in range(len(FANOUT) + 1):
+    assert 0.0 < by_hop[h]['fill'] <= 1.0
+  # hop 0 = seeds: every seed slot was a real seed in this run
+  assert by_hop[0]['fill'] == pytest.approx(1.0)
+  assert np.isfinite(dist_run['loss'])
+
+  # cluster-wide report must be CONSISTENT with the sampler's own
+  # host-local totals (single controller: identical) and with the
+  # derivation helper
+  cluster = dist_run['cluster']
+  assert cluster['num_hosts'] == 1
+  st = dist_run['fused'].sampler.exchange_stats(tick_metrics=False)
+  assert cluster['dist.frontier.offered'] == \
+      st['dist.frontier.offered']
+  assert cluster['dist.feature.slots'] == st['dist.feature.slots']
+  want = exchange_summary(st)
+  assert cluster['frontier_padding_waste_pct'] == \
+      want['frontier_padding_waste_pct']
+  assert cluster['frontier_drop_rate_pct'] == 0.0
+
+
+def test_slack_transition_event_fields(dist_run):
+  evs = recorderless_events(dist_run['path'], 'slack.transition')
+  assert evs, 'adaptive controller never transitioned'
+  e = evs[0]
+  assert e['reason'] in ('drops', 'drop_free')
+  assert e['from_slack'] != e['to_slack']
+  assert metrics.snapshot().get('dist.slack.transitions', 0) >= len(evs)
+
+
+def recorderless_events(path, kind):
+  return [json.loads(ln) for ln in open(path).read().splitlines()
+          if json.loads(ln)['kind'] == kind]
+
+
+# -- compile-cache dispatch telemetry (satellite) ---------------------------
+
+def test_uncached_jit_dispatch_time_env(monkeypatch):
+  from graphlearn_tpu.loader.fused import _uncached_jit
+  calls = {'n': 0}
+
+  def f(x):
+    calls['n'] += 1
+    return x + 1
+
+  base = metrics.snapshot()
+  wrapped = _uncached_jit(f, cacheable=True)
+  monkeypatch.delenv('GLT_FUSED_COMPILE_CACHE', raising=False)
+  out = wrapped(jnp.zeros((4,)))
+  assert float(out.sum()) == 4.0
+  # env flipped AFTER construction must take effect (dispatch-time
+  # read): the cached path still executes correctly
+  monkeypatch.setenv('GLT_FUSED_COMPILE_CACHE', '1')
+  out = wrapped(jnp.ones((4,)))
+  assert float(out.sum()) == 8.0
+  snap = metrics.snapshot()
+  assert snap.get('fused.compile.misses', 0) > base.get(
+      'fused.compile.misses', 0)
+  # second call with identical shapes is an in-memory hit
+  wrapped(jnp.ones((4,)))
+  assert metrics.snapshot().get('fused.compile.hits', 0) > base.get(
+      'fused.compile.hits', 0)
+  assert wrapped.jitted is not None
+
+
+def test_uncached_jit_not_cacheable_ignores_env(monkeypatch):
+  """Full-length programs must NEVER take the persistent-cache path,
+  even with the env var set (the r3 watchdog crash class)."""
+  from graphlearn_tpu.loader import fused as fused_mod
+  seen = []
+  orig = fused_mod._fresh_compile
+  monkeypatch.setattr(fused_mod, '_fresh_compile',
+                      lambda: (seen.append(1), orig())[1])
+  monkeypatch.setenv('GLT_FUSED_COMPILE_CACHE', '1')
+  wrapped = fused_mod._uncached_jit(lambda x: x * 2, cacheable=False)
+  wrapped(jnp.ones((2,)))
+  assert seen, 'cacheable=False must still route through _fresh_compile'
+  seen.clear()
+  cached = fused_mod._uncached_jit(lambda x: x * 3, cacheable=True)
+  cached(jnp.ones((2,)))
+  assert not seen, 'cacheable=True + env=1 must skip _fresh_compile'
+
+
+def test_fused_compile_event_emitted(tmp_path):
+  from graphlearn_tpu.loader.fused import _uncached_jit
+  p = str(tmp_path / 'f.jsonl')
+  recorder.enable(p)
+  try:
+    wrapped = _uncached_jit(lambda x: x - 1)
+    wrapped(jnp.ones((3,)))
+  finally:
+    recorder.disable()
+  evs = [json.loads(ln) for ln in open(p).read().splitlines()]
+  comp = [e for e in evs if e['kind'] == 'fused.compile']
+  assert comp and comp[0]['secs'] >= 0
+  assert comp[0]['persistent_cache'] is False
+
+
+# -- channel stall telemetry ------------------------------------------------
+
+def test_channel_stall_recorded(tmp_path):
+  from graphlearn_tpu.channel import MpChannel
+  p = str(tmp_path / 'f.jsonl')
+  recorder.enable(p)
+  ch = MpChannel()
+  try:
+    def produce():
+      time.sleep(0.15)
+      ch.send({'a': np.arange(3)})
+
+    t = threading.Thread(target=produce)
+    t.start()
+    msg = ch.recv()                     # blocks ~0.15s -> stall
+    t.join()
+  finally:
+    recorder.disable()
+    ch.close()
+  assert msg['a'].tolist() == [0, 1, 2]
+  snap = metrics.snapshot()
+  assert snap.get('channel.recv.calls', 0) >= 1
+  assert snap.get('channel.recv.stalls', 0) >= 1
+  evs = [json.loads(ln) for ln in open(p).read().splitlines()
+         if json.loads(ln)['kind'] == 'channel.stall']
+  assert evs and evs[0]['op'] == 'recv'
+  assert evs[0]['secs'] >= 0.1
+
+
+# -- data satellites --------------------------------------------------------
+
+def test_device_csr_num_nodes_mismatch_raises():
+  from graphlearn_tpu.data import Dataset
+  indptr = jnp.asarray(np.array([0, 1, 2, 2], np.int32))   # 3 nodes
+  indices = jnp.asarray(np.array([1, 2], np.int32))
+  with pytest.raises(ValueError, match='num_nodes'):
+    Dataset().init_graph((indptr, indices), layout='CSR', num_nodes=5)
+  ds = Dataset().init_graph((indptr, indices), layout='CSR',
+                            num_nodes=3)
+  assert ds.get_graph().num_nodes == 3
+
+
+def test_device_csr_requires_both_device_arrays():
+  """A mixed (jax.Array, numpy) pair must NOT take the device-native
+  fast path; it flows through the host CSR builder and still works."""
+  from graphlearn_tpu.data import Dataset
+  indptr = jnp.asarray(np.array([0, 1, 2, 2], np.int32))
+  indices = np.array([1, 2], np.int32)                      # host!
+  ds = Dataset().init_graph((indptr, indices), layout='CSR',
+                            num_nodes=3)
+  g = ds.get_graph()
+  assert g.num_nodes == 3
+  assert isinstance(g.indices, jax.Array)
+
+
+def test_feature_sort_func_with_device_table_raises():
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.data.reorder import sort_by_in_degree
+  feats = jnp.ones((4, 2))
+  with pytest.raises(ValueError, match='sort_func'):
+    Dataset().init_node_features(feats, sort_func=sort_by_in_degree)
+
+
+def test_feature_device_native_honors_device():
+  from graphlearn_tpu.data.feature import Feature
+  devs = jax.devices()
+  if len(devs) < 2:
+    pytest.skip('needs >= 2 devices')
+  arr = jax.device_put(jnp.ones((4, 2)), devs[0])
+  f = Feature(arr, device=devs[1])
+  assert devs[1] in f.hot_tier.devices()
+  # same-device placement is a no-op (no copy)
+  f0 = Feature(arr, device=devs[0])
+  assert f0.hot_tier is arr
