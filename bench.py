@@ -64,6 +64,16 @@ on, writing per-hop padding / slack-transition / exchange events to
 `--trace-dir DIR` captures an xprof trace (TensorBoard profile plugin
 format) around the fused session's epoch dispatches, which carry
 `StepTraceAnnotation` step markers.
+
+`--check-regression` runs the bench regression gate after the final
+artifact lands (`telemetry/regress.py`, loaded by path like the sink):
+the artifact's headline metrics are compared against
+`BENCH_BASELINE.json` (`GLT_BENCH_BASELINE` / `--baseline` override;
+created FROM this artifact on the first run) and the driver exits
+nonzero with a per-metric report when any metric slows more than the
+threshold (default 20%; `--regress-threshold 0.1` /
+`GLT_REGRESS_THRESHOLD`).  The compact verdict is stamped into the
+artifact summary line under `regression`.
 """
 import json
 import os
@@ -1040,6 +1050,20 @@ def _aggregate(results, fused_res, dist, hetero=None):
 
 
 _SINK = None
+_REGRESS = None
+
+
+def _light_module(name: str, cache: str):
+  """Load a json-only telemetry module directly by file path, keeping
+  the driver process free of the full package (and jax) import
+  chain."""
+  import importlib.util
+  p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   'graphlearn_tpu', 'telemetry', f'{name}.py')
+  spec = importlib.util.spec_from_file_location(cache, p)
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  return mod
 
 
 def _sink_module():
@@ -1048,14 +1072,69 @@ def _sink_module():
   process free of the full package (and jax) import chain."""
   global _SINK
   if _SINK is None:
-    import importlib.util
-    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     'graphlearn_tpu', 'telemetry', 'sink.py')
-    spec = importlib.util.spec_from_file_location('_bench_sink', p)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    _SINK = mod
+    _SINK = _light_module('sink', '_bench_sink')
   return _SINK
+
+
+def _regress_module():
+  """Load `telemetry/regress.py` by file path (json/os-only, like the
+  sink)."""
+  global _REGRESS
+  if _REGRESS is None:
+    _REGRESS = _light_module('regress', '_bench_regress')
+  return _REGRESS
+
+
+def _run_regression_gate(art) -> int:
+  """The `--check-regression` gate (telemetry.regress): compare the
+  just-written artifact against BENCH_BASELINE.json (created from this
+  artifact on the first run, since the trajectory starts empty), print
+  the per-metric report, stamp the compact verdict into the artifact's
+  summary, and return the exit code: 0 = PASS/baseline created, 1 = a
+  headline metric slowed past the threshold, 2 = the gate itself could
+  not run (which must NOT fail a completed bench — main() exits
+  nonzero only on rc 1)."""
+  try:
+    regress = _regress_module()
+    sink = _sink_module()
+    thr = _arg_after('--regress-threshold')
+    try:
+      thr = float(thr) if thr else None
+    except ValueError:
+      # a typo'd flag must not crash the gate AFTER the whole bench
+      # ran: degrade to the env/default threshold like regress does
+      print(f'--regress-threshold {thr!r} is not a number; using the '
+            'default', file=sys.stderr)
+      thr = None
+    # gate the IN-MEMORY aggregate when we have it: if the artifact
+    # sink degraded to stdout this run, the file on disk may be a
+    # STALE previous run's — it must never be what gets gated
+    verdict, rc = regress.check(
+        art if art is not None else sink.artifact_path(),
+        baseline=_arg_after('--baseline'),
+        threshold=thr)
+    print(regress.format_report(verdict), flush=True)
+    if art is not None:
+      # re-emit with the verdict so the artifact file + the bounded
+      # summary line both carry it ('regression' sits near the front
+      # of sink._SUMMARY_KEYS — a FAIL survives line degradation).
+      # Best-effort: a re-emit failure must not downgrade an rc-1
+      # verdict to the non-fatal rc 2 (CI would miss the regression).
+      try:
+        art = dict(art)
+        art['regression'] = regress.summary(verdict)
+        art['regression_report'] = verdict
+        print(_emit_artifact(art), flush=True)
+      except Exception as e:      # noqa: BLE001
+        print(f'could not stamp the regression verdict into the '
+              f'artifact ({type(e).__name__}: {e})', file=sys.stderr)
+    return rc
+  except Exception as e:          # noqa: BLE001 — the gate must
+    # report, never traceback-crash a driver whose bench phases all
+    # completed (missing artifact, unreadable baseline, ...)
+    print(f'regression gate could not run '
+          f'({type(e).__name__}: {e})', file=sys.stderr)
+    return 2
 
 
 def _emit_artifact(art):
@@ -1095,14 +1174,15 @@ def main():
     return total_budget - (time.time() - t_start)
 
   results, fused_res, dist, hetero = [], None, None, None
+  last_art = [None]
 
   def emit():
     """The indestructible-artifact contract: full cumulative
     aggregate to the artifact FILE after every completed phase;
     stdout gets only the bounded summary line."""
     if results or fused_res or dist or hetero:
-      print(_emit_artifact(_aggregate(results, fused_res, dist,
-                                      hetero)), flush=True)
+      last_art[0] = _aggregate(results, fused_res, dist, hetero)
+      print(_emit_artifact(last_art[0]), flush=True)
 
   # phase 1 — one primary session (epochs + sampling + roofline).
   attempts = 0
@@ -1185,6 +1265,16 @@ def main():
   if not (results or fused_res or dist):
     raise SystemExit('all bench phases failed')
   emit()                            # final (possibly repeated) line
+
+  # phase 5 — the bench regression gate (--check-regression): fail
+  # the run ONLY on a genuine regression (rc 1).  A gate that could
+  # not run at all (rc 2: missing artifact, unwritable baseline dir)
+  # is reported but must not fail a bench whose measurement phases
+  # all completed.
+  if '--check-regression' in sys.argv:
+    rc = _run_regression_gate(last_art[0])
+    if rc == 1:
+      raise SystemExit(1)
 
 
 if __name__ == '__main__':

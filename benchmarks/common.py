@@ -8,6 +8,7 @@ line per config so the results are machine-comparable across rounds.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -197,16 +198,30 @@ def emit(metric: str, value: float, unit: str, baseline: float = None,
   tee_record(rec)
 
 
+def run_id() -> str:
+  """Stable identifier for THIS sweep run, minted once by the first
+  process to ask and inherited by its fresh per-config subprocesses
+  through the environment — the sidecar appends across runs, so every
+  record needs a key consumers can group/dedupe by."""
+  rid = os.environ.get('GLT_BENCH_RUN_ID')
+  if not rid:
+    rid = time.strftime('%Y%m%dT%H%M%S') + f'-{os.getpid()}'
+    os.environ['GLT_BENCH_RUN_ID'] = rid
+  return rid
+
+
 def tee_record(rec: dict) -> None:
   """File-artifact tee for sweep records: every emitted config line
   also appends to the JSONL sidecar (`telemetry.sink.append_record`,
   `GLT_BENCH_RECORDS` overrides the path, default
   ``BENCH_ARTIFACT.jsonl``) — line-atomic across the sweeps' fresh
   subprocesses, so a truncated stdout capture no longer loses
-  measurements.  Best-effort: a sink failure never kills a bench."""
+  measurements.  Records carry a ``run`` id (`run_id`) so re-runs in
+  one directory stay distinguishable.  Best-effort: a sink failure
+  never kills a bench."""
   try:
     from graphlearn_tpu.telemetry import sink
-    sink.append_record(rec)
+    sink.append_record(dict(rec, run=run_id()))
   except Exception:               # noqa: BLE001 — telemetry is optional
     pass
 
@@ -229,7 +244,8 @@ def cpu_mesh_env(num_devices: int) -> dict:
   pre-imports jax and latches the platform before user code runs, so
   in-process env changes are too late (see tests/conftest.py).
   """
-  import os
+  run_id()      # mint the sweep's run id HERE, in the parent, so the
+                # env snapshot below hands every worker the same one
   env = dict(os.environ)
   env.pop('PALLAS_AXON_POOL_IPS', None)     # don't register the TPU plugin
   env['JAX_PLATFORMS'] = 'cpu'
@@ -254,6 +270,13 @@ def run_in_fresh_process(script: str, args, env=None) -> bool:
   """
   import subprocess
   import sys
+  # every config must record the SAME run id: mint it in the parent
+  # and plant it into the child env even when the caller snapshotted
+  # that env before the id existed (env=None inherits os.environ,
+  # which run_id() just stamped)
+  rid = run_id()
+  if env is not None and 'GLT_BENCH_RUN_ID' not in env:
+    env = dict(env, GLT_BENCH_RUN_ID=rid)
   cmd = [sys.executable, script] + [str(a) for a in args]
   rc = subprocess.run(cmd, env=env).returncode
   if rc != 0:

@@ -35,7 +35,19 @@ class ChannelTelemetry:
   carrying the ring occupancy when the transport exposes one
   (`_occupancy`; -1 = unknown).  Cheap when the recorder is off: two
   perf_counter reads and two counter ticks per message.
+
+  Span propagation (`telemetry.spans`): :meth:`_send_traced` injects
+  the sender's ambient span context into the message (a uint8 tensor
+  under ``'#SPAN'`` — every transport ships it like any other array);
+  :meth:`_recv_traced` strips it and parks it at
+  :attr:`last_span_context`, so a consumer can causally link its
+  recv/collate spans to the producer's trace.  Both are single
+  attribute checks when the recorder is off.
   """
+
+  #: span context of the most recently received message (None when the
+  #: producer ran recorder-off or predates span propagation).
+  last_span_context = None
 
   def _occupancy(self) -> int:
     """Messages currently queued; -1 when the transport can't say."""
@@ -55,6 +67,33 @@ class ChannelTelemetry:
                     occupancy=self._occupancy(),
                     channel=type(self).__name__)
     return out
+
+  def _send_traced(self, op: str, fn, msg):
+    from ..telemetry import spans
+    spans.inject(msg)
+    try:
+      return self._timed(op, fn, msg)
+    except ValueError:
+      if spans.SPAN_KEY not in msg:
+        raise
+      # the context tensor pushed a message that fit before past a
+      # fixed transport budget (shm slot size): drop the LINK, never
+      # the message — enabling telemetry must not fail sends that
+      # succeed with it off
+      msg.pop(spans.SPAN_KEY, None)
+      return self._timed(op, fn, msg)
+
+  def _park_span(self, msg):
+    """THE strip-and-park contract (one definition for every receive
+    path: blocking recv, timed recv, remote prefetch): pop the
+    message's '#SPAN' context and expose it at `last_span_context`."""
+    if msg is not None:
+      from ..telemetry import spans
+      self.last_span_context = spans.extract(msg)
+    return msg
+
+  def _recv_traced(self, op: str, fn, *args):
+    return self._park_span(self._timed(op, fn, *args))
 
 
 class ChannelBase(ChannelTelemetry, abc.ABC):

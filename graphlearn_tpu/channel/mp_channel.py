@@ -16,10 +16,11 @@ class MpChannel(ChannelBase):
     self._q = mp.get_context('spawn').Queue(maxsize)
 
   def send(self, msg: SampleMessage) -> None:
-    self._timed('send', self._q.put, msg)
+    # carries the sender's ambient span context (telemetry.spans)
+    self._send_traced('send', self._q.put, msg)
 
   def recv(self) -> SampleMessage:
-    return self._timed('recv', self._q.get)
+    return self._recv_traced('recv', self._q.get)
 
   def _occupancy(self) -> int:
     try:

@@ -75,7 +75,9 @@ class RemoteReceivingChannel(ChannelBase):
       if stamp is not None and int(np.asarray(stamp)) != self._epoch:
         continue     # stale message from an abandoned epoch; refetch
       self._received += 1
-      return msg
+      # strip + park the producer's span context (telemetry.spans) —
+      # it crossed the server RPC as an ordinary '#SPAN' tensor
+      return self._park_span(msg)
 
   def empty(self) -> bool:
     return not self._pending

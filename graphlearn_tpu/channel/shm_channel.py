@@ -30,10 +30,12 @@ class ShmChannel(ChannelBase):
     self._q = ShmQueue(num_slots=capacity, slot_bytes=slot)
 
   def send(self, msg: SampleMessage) -> None:
-    self._timed('send', self._q.put, msg)
+    # carries the sender's ambient span context (telemetry.spans) —
+    # the '#SPAN' uint8 tensor rides the C tensor-map like any array
+    self._send_traced('send', self._q.put, msg)
 
   def recv(self) -> SampleMessage:
-    return self._timed('recv', self._q.get)
+    return self._recv_traced('recv', self._q.get)
 
   def _occupancy(self) -> int:
     try:
@@ -43,8 +45,9 @@ class ShmChannel(ChannelBase):
 
   def recv_timeout(self, timeout: float):
     """Dequeue with a timeout; ``None`` when nothing arrived — the
-    hook liveness watchdogs need (blocking fast path preserved)."""
-    return self._q.get_timed(timeout)
+    hook liveness watchdogs need (blocking fast path preserved).
+    Strips the producer's span context like :meth:`recv` does."""
+    return self._park_span(self._q.get_timed(timeout))
 
   def recv_bytes(self) -> bytes:
     """Dequeue one message still in tensor-map wire form — lets the

@@ -31,8 +31,10 @@ class RemoteProducerHandle:
         drop_last=drop_last)
 
   def fetch(self):
-    return self._client.request_server(
-        self._server_idx, 'fetch_one_sampled_message', self._pid)
+    from ..telemetry.spans import span
+    with span('client.fetch', server=self._server_idx):
+      return self._client.request_server(
+          self._server_idx, 'fetch_one_sampled_message', self._pid)
 
   def destroy(self) -> None:
     try:

@@ -218,16 +218,39 @@ class DistLoader:
     return self
 
   def __next__(self) -> Batch:
+    from ..telemetry import spans
+    # epoch exhaustion surfaces BEFORE the per-batch 'batch' root
+    # span opens — an epoch end is not a batch and must not emit a
+    # phantom near-zero span pair into the histogram/trace.  In
+    # collocated mode that means the in-process sampling (inside
+    # next()) runs outside the span; the channel-fed modes (the
+    # production deployments) keep full recv+collate coverage.
     if self._epoch_iter is not None:
       msg = next(self._epoch_iter)
-    else:
-      if self._received >= self._expected:
-        raise StopIteration
-      with trace('dist_loader.recv'):
-        msg = self._recv_current_epoch()
+      with spans.span('batch', scope=type(self).__name__):
+        return self._collate_batch(msg)
+    if self._received >= self._expected:
+      raise StopIteration
+    with spans.span('batch', scope=type(self).__name__):
+      with spans.span('recv'):
+        with trace('dist_loader.recv'):
+          msg = self._recv_current_epoch()
       self._received += 1
-    with trace('dist_loader.collate'):
-      batch = self._collate_fn(msg)
+      return self._collate_batch(msg)
+
+  def _collate_batch(self, msg: SampleMessage) -> Batch:
+    """Collate under a 'collate' span carrying the producer's
+    cross-process span context (injected into the message by the
+    channel) as producer_trace/producer_span link fields."""
+    from ..telemetry import spans
+    # every channel receive path already stripped-and-parked the
+    # message's '#SPAN' (ChannelTelemetry._park_span) — the parked
+    # context is the one source of the producer link
+    link = spans.link_fields(getattr(self.channel,
+                                     'last_span_context', None))
+    with spans.span('collate', **link):
+      with trace('dist_loader.collate'):
+        batch = self._collate_fn(msg)
     metrics.inc('dist_loader.batches')
     return batch
 

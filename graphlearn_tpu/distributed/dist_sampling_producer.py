@@ -119,14 +119,22 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
     if cmd == MpCommand.STOP:
       break
     seeds, batch_size, epoch = payload
+    from ..telemetry.spans import span
     for lo in range(0, len(seeds), batch_size):
-      msg = _dispatch_sample(
-          sampler, sampling_config, seeds[lo:lo + batch_size],
-          batch_seed=(epoch * 1000003 + rank) * 131071 + lo)
-      # Epoch stamp lets consumers discard stale messages after an
-      # early-terminated epoch (see `DistLoader._recv_current_epoch`).
-      msg['#EPOCH'] = np.int64(epoch)
-      channel.send(msg)
+      # the producer-side span covers sample + send; the channel
+      # injects its context into the message at send time, so the
+      # consumer's collate span can link back to THIS trace (the
+      # worker's recorder comes up via GLT_TELEMETRY_JSONL, which
+      # spawn/forkserver children inherit)
+      with span('producer.sample', worker=rank, epoch=epoch,
+                offset=lo):
+        msg = _dispatch_sample(
+            sampler, sampling_config, seeds[lo:lo + batch_size],
+            batch_seed=(epoch * 1000003 + rank) * 131071 + lo)
+        # Epoch stamp lets consumers discard stale messages after an
+        # early-terminated epoch (`DistLoader._recv_current_epoch`).
+        msg['#EPOCH'] = np.int64(epoch)
+        channel.send(msg)
 
 
 class MpSamplingProducer:

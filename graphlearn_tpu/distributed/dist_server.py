@@ -92,9 +92,12 @@ class DistServer:
     """Blocking pull of one message (reference
     `fetch_one_sampled_message`, `dist_server.py:121-131`).  Returns
     the wire bytes untouched — they cross the socket as a tensor-map
-    frame without a parse/re-serialize round trip."""
+    frame without a parse/re-serialize round trip (a producer's
+    '#SPAN' context tensor rides through to the client intact)."""
+    from ..telemetry.spans import span
     from .rpc import RawTensorMap
-    return RawTensorMap(self._channels[producer_id].recv_bytes())
+    with span('server.fetch', producer=producer_id):
+      return RawTensorMap(self._channels[producer_id].recv_bytes())
 
   def destroy_sampling_producer(self, producer_id: int) -> None:
     with self._lock:

@@ -293,20 +293,25 @@ class _SupervisedScanEpoch:
     never blocks.  With ``max_steps_per_program`` set, per-chunk keys
     derive from (epoch, chunk offset): same draw distribution as the
     single-program epoch, different stream."""
+    from ..telemetry.spans import span
     seeds = np.stack(list(self._batcher))          # [S, B], host shuffle
     self._epoch_idx += 1
     key = jax.random.fold_in(self._base_key, self._epoch_idx)
     parts = list(self._chunks(seeds))
     losses, correct, valid = [], None, None
-    for c0, real, part in parts:
-      # single-program epochs keep the r4 key schedule exactly
-      ck = key if len(parts) == 1 else jax.random.fold_in(key, c0)
-      with step_annotation('fused_epoch', self._next_dispatch()):
-        state, ls, c, v = self._compiled(
-            state, jnp.asarray(part), ck, self._dev, pallas_enabled())
-      losses.append(ls[:real])
-      correct = c if correct is None else correct + c
-      valid = v if valid is None else valid + v
+    with span('fused.epoch', scope=type(self).__name__,
+              epoch=self._epoch_idx, steps=seeds.shape[0]):
+      for c0, real, part in parts:
+        # single-program epochs keep the r4 key schedule exactly
+        ck = key if len(parts) == 1 else jax.random.fold_in(key, c0)
+        with span('fused.dispatch', chunk=c0):
+          with step_annotation('fused_epoch', self._next_dispatch()):
+            state, ls, c, v = self._compiled(
+                state, jnp.asarray(part), ck, self._dev,
+                pallas_enabled())
+        losses.append(ls[:real])
+        correct = c if correct is None else correct + c
+        valid = v if valid is None else valid + v
     metrics.inc('loader.batches', seeds.shape[0])
     return state, EpochStats(jnp.concatenate(losses), correct, valid)
 

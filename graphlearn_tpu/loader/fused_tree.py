@@ -149,16 +149,18 @@ class FusedTreeEpoch(_SupervisedScanEpoch):
   def init_state(self, rng) -> TrainState:
     """Init params from one dummy tree batch (host-cheap: shapes
     only)."""
-    d = self.data.node_features.feature_dim
-    sizes = [self.batch_size]
-    for k in self.fanouts:
-      sizes.append(sizes[-1] * k)
-    xs = [jnp.zeros((s, d), self.data.node_features.dtype)
-          for s in sizes]
-    masks = [jnp.ones((s,), jnp.bool_) for s in sizes]
-    params = self.model.init(rng, xs, masks)
-    return TrainState(params, self.tx.init(params),
-                      jnp.zeros((), jnp.int32))
+    from ..telemetry.spans import span
+    with span('fused.init_state', scope=type(self).__name__):
+      d = self.data.node_features.feature_dim
+      sizes = [self.batch_size]
+      for k in self.fanouts:
+        sizes.append(sizes[-1] * k)
+      xs = [jnp.zeros((s, d), self.data.node_features.dtype)
+            for s in sizes]
+      masks = [jnp.ones((s,), jnp.bool_) for s in sizes]
+      params = self.model.init(rng, xs, masks)
+      return TrainState(params, self.tx.init(params),
+                        jnp.zeros((), jnp.int32))
 
   # -- tree expansion + collation (the scan-body front half) --------------
 

@@ -1136,20 +1136,29 @@ class DistNeighborSampler(ExchangeTelemetry):
   def sample_from_nodes(self, seeds_stacked: np.ndarray):
     """``seeds_stacked``: ``[P, B]`` per-device seed batches (relabeled
     id space, -1 padded).  Returns stacked pytree pieces."""
+    from ..telemetry.spans import span
     b = seeds_stacked.shape[1]
     step = self.step_for_batch(b)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
-    seeds_dev = jax.device_put(
-        np.asarray(seeds_stacked, dtype=np.int32),
-        NamedSharding(self.mesh, P(self.axis)))
-    (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats) = \
-        step(arrs['indptr'], arrs['indices'], arrs['eids'],
-             arrs['bounds'], seeds_dev, arrs['fshards'],
-             arrs['lshards'], arrs['cids'], arrs['crows'],
-             arrs['efshards'], arrs['ebounds'],
-             arrs['hcounts'], key)
+    # 'sample.exchange': the fused sample+exchange SPMD dispatch —
+    # async, so its duration is dispatch latency; sync time (the
+    # stage-attribution signal) lands in the feature.lookup child
+    # whenever a cold overlay forces the host to wait
+    with span('sample.exchange', step=self._step_cnt, batch=b):
+      seeds_dev = jax.device_put(
+          np.asarray(seeds_stacked, dtype=np.int32),
+          NamedSharding(self.mesh, P(self.axis)))
+      (nodes, count, row, col, edge, seed_local, x, y, ef, nsn,
+       stats) = \
+          step(arrs['indptr'], arrs['indices'], arrs['eids'],
+               arrs['bounds'], seeds_dev, arrs['fshards'],
+               arrs['lshards'], arrs['cids'], arrs['crows'],
+               arrs['efshards'], arrs['ebounds'],
+               arrs['hcounts'], key)
+    # outside the span: the every-64th-call drain blocks on the
+    # device, and that sync must not masquerade as dispatch latency
     self._accumulate_stats(stats)
     x = self._maybe_overlay_cold(x, nodes)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
@@ -1163,6 +1172,14 @@ class DistNeighborSampler(ExchangeTelemetry):
     host-local ``cold_local`` stacks) and tick the cold telemetry."""
     if not self.tiered or x is None:
       return x
+    from ..telemetry.spans import span
+    with span('feature.lookup', step=self._step_cnt):
+      return self._overlay_cold_traced(x, nodes)
+
+  def _overlay_cold_traced(self, x, nodes):
+    """The overlay body, under `_maybe_overlay_cold`'s span — the
+    span exists only for tiered stores, where this is the per-batch
+    host sync worth attributing."""
     nf = self.ds.node_features
     if nf.cold_host is not None:
       x, lookups, misses = overlay_cold_host(
@@ -1507,17 +1524,21 @@ class DistSubGraphSampler(DistNeighborSampler):
           exchange_slack=self.exchange_slack, tiered=self.tiered,
           hop_chunk=resolve_hop_chunk(self.hop_chunk, node_cap,
                                       self.max_degree))
+    from ..telemetry.spans import span
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
-    seeds_dev = jax.device_put(
-        np.asarray(seeds_stacked, dtype=np.int32),
-        NamedSharding(self.mesh, P(self.axis)))
-    (nodes, count, row, col, edge, seed_local, x, y, nsn, stats) = \
-        self._steps[cfg](arrs['indptr'], arrs['indices'], arrs['eids'],
-                         arrs['bounds'], seeds_dev, arrs['fshards'],
-                         arrs['lshards'], arrs['cids'], arrs['crows'],
-                         arrs['hcounts'], key)
+    with span('sample.exchange', step=self._step_cnt,
+              mode='subgraph'):
+      seeds_dev = jax.device_put(
+          np.asarray(seeds_stacked, dtype=np.int32),
+          NamedSharding(self.mesh, P(self.axis)))
+      (nodes, count, row, col, edge, seed_local, x, y, nsn, stats) = \
+          self._steps[cfg](arrs['indptr'], arrs['indices'],
+                           arrs['eids'], arrs['bounds'], seeds_dev,
+                           arrs['fshards'], arrs['lshards'],
+                           arrs['cids'], arrs['crows'],
+                           arrs['hcounts'], key)
     self._accumulate_stats(stats)
     x = self._maybe_overlay_cold(x, nodes)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
@@ -1631,18 +1652,21 @@ class DistSubGraphLoader(PrefetchingLoader):
 
   def _produce(self, seed_iter):
     from ..loader.transform import Batch
+    from ..telemetry.spans import span
     flat = next(seed_iter)
-    seeds = flat.reshape(self.num_parts, self.batch_size)
-    out = self.sampler.sample_subgraph(seeds)
-    edge_index = jnp.stack([out['row'], out['col']], axis=1)
-    return Batch(
-        x=out['x'], y=out['y'], edge_index=edge_index,
-        node=out['node'], node_mask=out['node'] >= 0,
-        edge_mask=out['row'] >= 0, edge=out['edge'],
-        batch=out['batch'], batch_size=self.batch_size,
-        num_sampled_nodes=out['num_sampled_nodes'],
-        metadata={'seed_local': out['seed_local'],
-                  'mapping': out['seed_local']})
+    with span('batch', scope='DistSubGraphLoader'):
+      seeds = flat.reshape(self.num_parts, self.batch_size)
+      out = self.sampler.sample_subgraph(seeds)
+      with span('stitch'):
+        edge_index = jnp.stack([out['row'], out['col']], axis=1)
+        return Batch(
+            x=out['x'], y=out['y'], edge_index=edge_index,
+            node=out['node'], node_mask=out['node'] >= 0,
+            edge_mask=out['row'] >= 0, edge=out['edge'],
+            batch=out['batch'], batch_size=self.batch_size,
+            num_sampled_nodes=out['num_sampled_nodes'],
+            metadata={'seed_local': out['seed_local'],
+                      'mapping': out['seed_local']})
 
 
 class DistNeighborLoader(PrefetchingLoader):
@@ -1697,27 +1721,44 @@ class DistNeighborLoader(PrefetchingLoader):
       return
     from ..telemetry.aggregate import per_hop_padding
     self._batch_idx = getattr(self, '_batch_idx', 0) + 1
-    rows = per_hop_padding(np.asarray(nsn), self.batch_size,
-                           self.sampler.fanouts)
+    if getattr(nsn, 'is_fully_addressable', True):
+      arr = np.asarray(nsn)
+    else:
+      # multi-controller mesh: only this host's shards are readable —
+      # emit the HOST-LOCAL per-hop fill (capacities scale by the
+      # local shard count inside per_hop_padding), instead of
+      # crashing the job the recorder is meant to diagnose
+      arr = np.concatenate(
+          [np.asarray(s.data) for s in nsn.addressable_shards])
+    rows = per_hop_padding(arr, self.batch_size, self.sampler.fanouts)
     for row in rows:
       recorder.emit('hop.padding', scope='dist_loader',
                     batch=self._batch_idx, **row)
 
   def _produce(self, seed_iter):
     from ..loader.transform import Batch
+    from ..telemetry.spans import span
     flat = next(seed_iter)                         # [P * B]
-    seeds = flat.reshape(self.num_parts, self.batch_size)
-    out = self.sampler.sample_from_nodes(seeds)
-    self._maybe_emit_hop_events(out['num_sampled_nodes'])
-    edge_index = jnp.stack([out['row'], out['col']], axis=1)  # [P, 2, E]
-    return Batch(
-        x=out['x'], y=out['y'], edge_index=edge_index,
-        edge_attr=out['ef'],
-        node=out['node'], node_mask=out['node'] >= 0,
-        edge_mask=out['row'] >= 0, edge=out['edge'],
-        batch=out['batch'], batch_size=self.batch_size,
-        num_sampled_nodes=out['num_sampled_nodes'],
-        metadata={'seed_local': out['seed_local']})
+    # 'batch' is the per-batch ROOT span; the sampler's
+    # sample.exchange / feature.lookup spans nest under it, and
+    # 'stitch' covers the Batch assembly — the causal tree stage
+    # attribution reads
+    with span('batch', scope='DistNeighborLoader',
+              batch=getattr(self, '_batch_idx', 0) + 1):
+      seeds = flat.reshape(self.num_parts, self.batch_size)
+      out = self.sampler.sample_from_nodes(seeds)
+      self._maybe_emit_hop_events(out['num_sampled_nodes'])
+      with span('stitch'):
+        edge_index = jnp.stack([out['row'], out['col']],
+                               axis=1)             # [P, 2, E]
+        return Batch(
+            x=out['x'], y=out['y'], edge_index=edge_index,
+            edge_attr=out['ef'],
+            node=out['node'], node_mask=out['node'] >= 0,
+            edge_mask=out['row'] >= 0, edge=out['edge'],
+            batch=out['batch'], batch_size=self.batch_size,
+            num_sampled_nodes=out['num_sampled_nodes'],
+            metadata={'seed_local': out['seed_local']})
 
 
 def pack_link_seeds(edge_label_index, edge_label,
@@ -1834,21 +1875,24 @@ class DistLinkNeighborSampler(DistNeighborSampler):
   def sample_from_edges(self, pairs_stacked: np.ndarray):
     """``pairs_stacked``: ``[P, B, 2|3]`` per-device (src, dst[, label])
     seed edges in the relabeled id space, -1 padded."""
+    from ..telemetry.spans import span
     p, b = pairs_stacked.shape[:2]
     step = self.step_for_pairs(b, pairs_stacked.shape[2])
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
-    pairs_dev = jax.device_put(
-        np.asarray(pairs_stacked, dtype=np.int32),
-        NamedSharding(self.mesh, P(self.axis)))
-    (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats,
-     eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
-        step(arrs['indptr'], arrs['indices'], arrs['eids'],
-             arrs['bounds'], pairs_dev, arrs['fshards'],
-             arrs['lshards'], arrs['cids'], arrs['crows'],
-             arrs['efshards'], arrs['ebounds'],
-             arrs['hcounts'], key)
+    with span('sample.exchange', step=self._step_cnt, batch=b,
+              mode='link'):
+      pairs_dev = jax.device_put(
+          np.asarray(pairs_stacked, dtype=np.int32),
+          NamedSharding(self.mesh, P(self.axis)))
+      (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats,
+       eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
+          step(arrs['indptr'], arrs['indices'], arrs['eids'],
+               arrs['bounds'], pairs_dev, arrs['fshards'],
+               arrs['lshards'], arrs['cids'], arrs['crows'],
+               arrs['efshards'], arrs['ebounds'],
+               arrs['hcounts'], key)
     self._accumulate_stats(stats)
     x = self._maybe_overlay_cold(x, nodes)
     md = link_step_metadata(self.neg_mode, seed_local, eli, elab,
@@ -1906,15 +1950,18 @@ class DistLinkNeighborLoader(PrefetchingLoader):
 
   def _produce(self, seed_iter):
     from ..loader.transform import Batch
+    from ..telemetry.spans import span
     flat = next(seed_iter)                         # [P * B, 2|3]
-    pairs = flat.reshape(self.num_parts, self.batch_size, -1)
-    out = self.sampler.sample_from_edges(pairs)
-    edge_index = jnp.stack([out['row'], out['col']], axis=1)
-    return Batch(
-        x=out['x'], y=out['y'], edge_index=edge_index,
-        edge_attr=out['ef'],
-        node=out['node'], node_mask=out['node'] >= 0,
-        edge_mask=out['row'] >= 0, edge=out['edge'],
-        batch=out['batch'], batch_size=self.batch_size,
-        num_sampled_nodes=out['num_sampled_nodes'],
-        metadata=out['metadata'])
+    with span('batch', scope='DistLinkNeighborLoader'):
+      pairs = flat.reshape(self.num_parts, self.batch_size, -1)
+      out = self.sampler.sample_from_edges(pairs)
+      with span('stitch'):
+        edge_index = jnp.stack([out['row'], out['col']], axis=1)
+        return Batch(
+            x=out['x'], y=out['y'], edge_index=edge_index,
+            edge_attr=out['ef'],
+            node=out['node'], node_mask=out['node'] >= 0,
+            edge_mask=out['row'] >= 0, edge=out['edge'],
+            batch=out['batch'], batch_size=self.batch_size,
+            num_sampled_nodes=out['num_sampled_nodes'],
+            metadata=out['metadata'])

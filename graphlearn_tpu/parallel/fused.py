@@ -87,15 +87,20 @@ class _MeshEpochDriver:
     DONATED — thread the returned state forward.  ``stats`` is LAZY
     (`loader.fused.EpochStats`)."""
     from ..loader.fused import EpochStats
+    from ..telemetry.spans import span
     from ..utils.profiling import step_annotation
     flat = np.stack(list(self._batcher))           # [S, P*B]
     seeds = flat.reshape(-1, self.num_parts, self.batch_size)
     key = self._next_epoch_key()
-    with step_annotation('fused_dist_epoch', self._epoch_idx):
-      state, losses, correct, valid, stats, hops = self._compiled(
-          state, self._put_batches(seeds), key, self.sampler._arrays())
-    self.sampler._accumulate_stats(stats)
-    self._emit_hop_events(hops, seeds.shape[0])
+    with span('fused.epoch', scope=type(self).__name__,
+              epoch=self._epoch_idx, steps=seeds.shape[0]):
+      with step_annotation('fused_dist_epoch', self._epoch_idx):
+        with span('fused.dispatch'):
+          state, losses, correct, valid, stats, hops = self._compiled(
+              state, self._put_batches(seeds), key,
+              self.sampler._arrays())
+      self.sampler._accumulate_stats(stats)
+      self._emit_hop_events(hops, seeds.shape[0])
     return state, EpochStats(losses, correct, valid)
 
   def _emit_hop_events(self, hop_counts, steps: int) -> None:

@@ -28,6 +28,30 @@ three pieces:
     never truncate the artifact again (the `BENCH_r05.json`
     ``"parsed": null`` failure mode).
 
+On top of the recorder sits the CAUSAL layer (this PR's tentpole):
+
+  * :mod:`~graphlearn_tpu.telemetry.spans` — ``span()`` context
+    manager emitting paired ``span.begin``/``span.end`` events with
+    ``trace_id``/``span_id``/``parent_id`` and monotonic-clock
+    durations; the pipeline (channels, mesh samplers, loaders, the
+    server/client runtime, fused epochs) opens sample → exchange →
+    feature-lookup → stitch → dispatch child spans, and the context
+    crosses process boundaries inside each `SampleMessage`.
+  * :mod:`~graphlearn_tpu.telemetry.histogram` — fixed-bucket log2
+    latency histograms per span kind, encoded as flat metric keys so
+    :func:`gather_metrics` merges them across hosts for free.
+  * :mod:`~graphlearn_tpu.telemetry.export` /
+    :mod:`~graphlearn_tpu.telemetry.report` — recorder dump → Chrome
+    trace-event JSON (Perfetto-loadable), and the
+    ``python -m graphlearn_tpu.telemetry.report`` per-stage latency
+    table / trace-diff CLI.
+  * :mod:`~graphlearn_tpu.telemetry.regress` — the bench regression
+    gate (`bench.py --check-regression`): artifact vs committed
+    ``BENCH_BASELINE.json``, nonzero exit + per-metric report on a
+    threshold breach.
+  * :mod:`~graphlearn_tpu.telemetry.schema` — the registry of event
+    kinds and span names the static schema test enforces.
+
 xprof integration: :func:`step_annotation` wraps
 `jax.profiler.StepTraceAnnotation` so fused-epoch dispatches show up as
 steps on the TensorBoard timeline; ``bench.py --trace-dir DIR`` captures
@@ -42,13 +66,16 @@ from __future__ import annotations
 from ..utils.profiling import (Metrics, capture, metrics, start_trace,
                                step_annotation, stop_trace, trace)
 from .aggregate import exchange_summary, gather_metrics, per_hop_padding
+from .histogram import Histogram, from_snapshot
 from .recorder import EventRecorder, recorder
 from .sink import (artifact_path, append_record, summary_line,
                    write_artifact)
+from .spans import SpanContext, span
 
 __all__ = [
-    'EventRecorder', 'Metrics', 'append_record', 'artifact_path',
-    'capture', 'exchange_summary', 'gather_metrics', 'metrics',
-    'per_hop_padding', 'recorder', 'start_trace', 'step_annotation',
-    'stop_trace', 'summary_line', 'trace', 'write_artifact',
+    'EventRecorder', 'Histogram', 'Metrics', 'SpanContext',
+    'append_record', 'artifact_path', 'capture', 'exchange_summary',
+    'from_snapshot', 'gather_metrics', 'metrics', 'per_hop_padding',
+    'recorder', 'span', 'start_trace', 'step_annotation', 'stop_trace',
+    'summary_line', 'trace', 'write_artifact',
 ]

@@ -12,6 +12,15 @@ cluster-wide numbers instead of host-0-only ones.
 
 Single-controller processes (including the virtual CPU mesh the tests
 and CI run) take the degenerate path: one host, aggregate == local.
+Multi-process CPU meshes (the 2-process jax.distributed tests) have no
+cross-process XLA collectives, so the transport falls back to the
+jax.distributed COORDINATION service's key-value store
+(`_kv_allgather_strings`) — same lockstep-call contract, same result.
+
+The per-span-kind latency histograms (`telemetry.histogram`) ride this
+exact machinery: they are flat ``span.<kind>.hist.*`` counter keys in
+the registry, so ``gather_metrics(prefix='span.')`` IS the mesh-wide
+histogram merge.
 """
 from __future__ import annotations
 
@@ -23,35 +32,81 @@ import numpy as np
 from ..utils.profiling import Metrics, metrics
 
 
-def _allgather_snapshots(snap: Dict[str, float]) -> List[Dict[str, float]]:
-  """One snapshot per process, via two `process_allgather` rounds
-  (length agreement, then uint8-padded JSON payloads) — key sets may
-  differ across hosts, so the payload is a string, not a vector."""
+#: generation counter namespacing the KV-store allgather rounds —
+#: correct as long as every process calls the collective helpers in
+#: lockstep, which is already their contract (process_allgather is no
+#: different).
+_KV_GEN = [0]
+
+
+def _kv_allgather_strings(payload: str) -> List[str]:
+  """Allgather string payloads over the jax.distributed COORDINATION
+  service (key-value store + barrier) instead of XLA collectives — the
+  CPU backend has no cross-process collectives, but the coordinator is
+  up whenever `jax.distributed.initialize` ran, so the virtual-mesh
+  multi-process tests (and any CPU-mesh deployment) still aggregate.
+  Each process publishes under a per-round key, reads every peer's,
+  then deletes its own key after a barrier (no coordinator leak)."""
+  import jax
+  from jax._src import distributed
+  client = distributed.global_state.client
+  _KV_GEN[0] += 1
+  gen = _KV_GEN[0]
+  pid, n = jax.process_index(), jax.process_count()
+  timeout_ms = 60_000
+  client.key_value_set(f'glt/agg/{gen}/{pid}', payload)
+  out = [client.blocking_key_value_get(f'glt/agg/{gen}/{i}',
+                                       timeout_ms) for i in range(n)]
+  client.wait_at_barrier(f'glt_agg_{gen}', timeout_ms)
+  try:
+    client.key_value_delete(f'glt/agg/{gen}/{pid}')
+  except Exception:             # noqa: BLE001 — cleanup best-effort
+    pass
+  return out
+
+
+def _allgather_strings(payload: str) -> List[str]:
+  """One string payload per process.  XLA-collective transport
+  (`process_allgather`, two rounds: length agreement then uint8-padded
+  payloads) where the backend supports cross-process collectives; the
+  coordination-service KV store on the CPU backend, which does not."""
   import jax
   if jax.process_count() == 1:
-    return [dict(snap)]
+    return [payload]
+  if jax.default_backend() == 'cpu':
+    return _kv_allgather_strings(payload)
   from jax.experimental import multihost_utils
-  payload = np.frombuffer(json.dumps(snap).encode('utf-8'), np.uint8)
+  raw = np.frombuffer(payload.encode('utf-8'), np.uint8)
   sizes = multihost_utils.process_allgather(
-      np.asarray([payload.size], np.int64)).reshape(-1)
+      np.asarray([raw.size], np.int64)).reshape(-1)
   cap = int(sizes.max())
   buf = np.zeros((max(cap, 1),), np.uint8)
-  buf[:payload.size] = payload
+  buf[:raw.size] = raw
   gathered = multihost_utils.process_allgather(buf)
-  out = []
-  for i in range(gathered.shape[0]):
-    raw = bytes(bytearray(gathered[i, :int(sizes[i])]))
-    out.append(json.loads(raw.decode('utf-8')) if raw else {})
-  return out
+  return [bytes(bytearray(gathered[i, :int(sizes[i])])).decode('utf-8')
+          for i in range(gathered.shape[0])]
+
+
+def _allgather_snapshots(snap: Dict[str, float]) -> List[Dict[str, float]]:
+  """One snapshot per process — key sets may differ across hosts, so
+  the payload is a JSON string, not a fixed vector."""
+  return [json.loads(s) if s else {}
+          for s in _allgather_strings(json.dumps(snap))]
 
 
 def allgather_sum_int(vals) -> List[int]:
   """Element-wise SUM of an int vector across processes — the
   host-counter aggregation primitive (`cluster_exchange_stats` sums
-  its cold-tier counters through this).  Single process: identity."""
+  its cold-tier counters through this).  Single process: identity;
+  CPU backend: the KV-store transport (same as `gather_metrics`)."""
   import jax
   if jax.process_count() == 1:
     return [int(v) for v in vals]
+  if jax.default_backend() == 'cpu':
+    rows = [json.loads(s)
+            for s in _allgather_strings(json.dumps(
+                [int(v) for v in vals]))]
+    return [int(sum(col)) for col in zip(*rows)]
   from jax.experimental import multihost_utils
   return [int(x) for x in multihost_utils.process_allgather(
       np.asarray(vals, np.int64)).sum(axis=0)]

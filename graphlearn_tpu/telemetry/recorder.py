@@ -13,11 +13,17 @@ single attribute check, so instrumentation can stay in hot host paths.
 
 Event wire form (one JSON object per line)::
 
-    {"ts": 1722700000.123, "kind": "hop.padding", "hop": 1, ...}
+    {"ts": 1722700000.123, "mono": 12345.678901, "pid": 71,
+     "tid": 1393..., "kind": "hop.padding", "hop": 1, ...}
 
-``ts`` is ``time.time()`` at emit; every other field comes from the
-emitter.  Values must be JSON-serializable scalars/lists (numpy scalars
-are coerced).
+``ts`` is ``time.time()`` at emit — human-readable, but steppable by
+NTP; ``mono`` is ``time.monotonic()`` on the same event, the timebase
+span durations and trace timelines are computed from (machine-wide on
+Linux, so events from cooperating processes on one host order
+correctly).  Every other field comes from the emitter.  Values are
+coerced to JSON-serializable form; anything that still can't serialize
+(bytes, enums, device arrays) degrades to ``repr`` — an event is never
+lost to one bad field.
 """
 from __future__ import annotations
 
@@ -38,7 +44,12 @@ DEFAULT_MAX_FILE_EVENTS = 200_000
 
 
 def _jsonable(v: Any) -> Any:
-  """Coerce numpy / jax scalars to plain python for json.dumps."""
+  """Coerce numpy / jax scalars to plain python for json.dumps; values
+  still unserializable after coercion (bytes, enums, device handles)
+  degrade to ``repr`` — ``emit`` runs inside hot paths and must never
+  raise out of them, and a degraded field beats a lost event."""
+  if v is None or isinstance(v, (bool, int, float, str)):
+    return v
   item = getattr(v, 'item', None)
   if item is not None and getattr(v, 'ndim', 0) == 0:
     try:
@@ -46,12 +57,30 @@ def _jsonable(v: Any) -> Any:
     except Exception:             # noqa: BLE001 — best-effort coercion
       pass
   tolist = getattr(v, 'tolist', None)
-  if tolist is not None:
+  if tolist is not None and not isinstance(v, bytes):
     try:
       return tolist()
     except Exception:             # noqa: BLE001
       pass
-  return v
+  if isinstance(v, (list, tuple, dict)):
+    # containers pass through; any unserializable LEAF degrades at
+    # dump time (`_safe_dumps`)
+    return v
+  return repr(v)
+
+
+def _safe_dumps(ev: Dict) -> str:
+  """Serialize an event, degrading rather than raising: default=repr
+  covers unserializable leaf VALUES, and the fallback re-dump covers
+  what default can't (non-string dict KEYS, circular references) by
+  repr-ing whole offending fields — the never-lose-the-event
+  contract."""
+  try:
+    return json.dumps(ev, default=repr)
+  except (TypeError, ValueError):
+    return json.dumps(
+        {k: (v if isinstance(v, (str, int, float, bool, type(None)))
+             else repr(v)) for k, v in ev.items()})
 
 
 class EventRecorder:
@@ -91,7 +120,12 @@ class EventRecorder:
                                        maxlen=max(int(max_events), 1))
       if max_file_events is not None:
         self._max_file_events = int(max_file_events)
-      if path is not None and path != self._path:
+      # reopen on a NEW path, and also on the SAME path when the sink
+      # was closed by an emit-time I/O failure (ENOSPC): a re-enable
+      # after the operator frees space must resume the file, not
+      # silently stay ring-only behind a stale `path` property
+      if path is not None and (path != self._path
+                               or self._file is None):
         self._close_file_locked()
         self._path = path
         # line-buffered append: each event is one write, so concurrent
@@ -124,7 +158,14 @@ class EventRecorder:
     """Record one event.  No-op (one attribute check) when disabled."""
     if not self.enabled:
       return
-    ev = {'ts': round(time.time(), 6), 'kind': kind}
+    # `mono` (time.monotonic) rides next to wall-clock `ts` so span
+    # durations and cross-event timelines survive NTP steps/slews;
+    # pid/tid put every event on its real process/thread row when
+    # several processes append to one JSONL (the Chrome-trace rows)
+    ev = {'ts': round(time.time(), 6),
+          'mono': round(time.monotonic(), 6),
+          'pid': os.getpid(), 'tid': threading.get_ident(),
+          'kind': kind}
     for k, v in fields.items():
       ev[k] = _jsonable(v)
     with self._lock:
@@ -133,10 +174,12 @@ class EventRecorder:
       self._ring.append(ev)
       if self._file is not None:
         if self._file_events < self._max_file_events:
+          # serialization can't raise (`_safe_dumps` degrades bad
+          # fields in place); only a real I/O failure closes the sink
           try:
-            self._file.write(json.dumps(ev) + '\n')
+            self._file.write(_safe_dumps(ev) + '\n')
             self._file_events += 1
-          except (OSError, ValueError):
+          except OSError:
             self._close_file_locked()
         else:
           self._dropped_file_events += 1
@@ -159,7 +202,7 @@ class EventRecorder:
     evs = self.events()
     with open(path, 'w') as f:
       for e in evs:
-        f.write(json.dumps(e) + '\n')
+        f.write(_safe_dumps(e) + '\n')
     return len(evs)
 
   def stats(self) -> Dict[str, int]:
@@ -181,8 +224,10 @@ def _from_env() -> EventRecorder:
   try:
     return EventRecorder(path=path, max_events=cap)
   except OSError:
-    # unwritable JSONL path: record to the ring only
-    return EventRecorder(path=None, max_events=cap)
+    # unwritable JSONL path: degrade to RING-ONLY recording — the
+    # user asked for telemetry, so the recorder must still be ON
+    rec = EventRecorder(path=None, max_events=cap)
+    return rec.enable() if path else rec
 
 
 #: process-global flight recorder all library instrumentation emits to.

@@ -1,0 +1,255 @@
+"""Bench regression gate: artifact vs committed baseline.
+
+The ROADMAP's "as fast as the hardware allows" has no automated guard:
+nothing fails when a PR slows the hot path.  This module compares the
+freshly written ``BENCH_ARTIFACT.json`` against a committed
+``BENCH_BASELINE.json`` and FAILS (nonzero) with a per-metric report
+when any headline metric regresses past a threshold (default 20%).
+On the FIRST run — the bench trajectory starts empty — the artifact
+itself becomes the baseline (verdict ``BASELINE_CREATED``), so the
+gate bootstraps without manual setup; commit the baseline file to pin
+it.
+
+Deliberately import-light (json/os only), like `sink.py`: `bench.py`
+loads it directly by file path so the driver process never pays the
+package/jax import chain.  Also a CLI::
+
+    python graphlearn_tpu/telemetry/regress.py ARTIFACT [BASELINE]
+        [--threshold 0.2] [--update-baseline]
+
+Env overrides: ``GLT_BENCH_BASELINE`` (baseline path),
+``GLT_REGRESS_THRESHOLD`` (fractional slowdown tolerance).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+BASELINE_ENV = 'GLT_BENCH_BASELINE'
+THRESHOLD_ENV = 'GLT_REGRESS_THRESHOLD'
+DEFAULT_BASELINE = 'BENCH_BASELINE.json'
+DEFAULT_THRESHOLD = 0.2
+
+#: headline metrics the gate tracks: (dotted key, direction).
+#: 'lower' = smaller is better (times), 'higher' = bigger is better
+#: (rates).  Keys absent from either side are SKIPPED, not failed —
+#: phases degrade day to day and a missing phase is not a regression.
+METRICS: Tuple[Tuple[str, str], ...] = (
+    ('value', 'lower'),                       # the headline epoch time
+    ('fused_epoch_secs', 'lower'),
+    ('fused_epoch_secs_bf16', 'lower'),
+    ('fused_hetero_epoch_secs', 'lower'),
+    ('fused_subgraph_ms_per_step', 'lower'),
+    ('train_step_mfu', 'higher'),
+    ('dist.seeds_per_sec', 'higher'),
+    ('dist.edges_per_sec_per_chip', 'higher'),
+)
+
+
+def baseline_path(path: Optional[str] = None) -> str:
+  return path or os.environ.get(BASELINE_ENV) or DEFAULT_BASELINE
+
+
+def threshold_from_env(default: float = DEFAULT_THRESHOLD) -> float:
+  try:
+    return float(os.environ.get(THRESHOLD_ENV, default))
+  except ValueError:
+    return default
+
+
+def _get(obj: Dict, dotted: str):
+  cur = obj
+  for part in dotted.split('.'):
+    if not isinstance(cur, dict):
+      return None
+    cur = cur.get(part)
+  return cur if isinstance(cur, (int, float)) else None
+
+
+def compare(artifact: Dict, baseline: Dict,
+            threshold: float = DEFAULT_THRESHOLD) -> Dict:
+  """Per-metric comparison.  Returns a verdict dict::
+
+      {'status': 'PASS'|'FAIL', 'threshold': 0.2,
+       'metrics': [{'key', 'direction', 'current', 'baseline',
+                    'change_pct', 'status'}, ...],
+       'regressed': ['fused_epoch_secs', ...]}
+
+  ``change_pct`` is signed so that POSITIVE always means SLOWER
+  (time up, or rate down), regardless of direction.
+  """
+  rows: List[Dict] = []
+  regressed: List[str] = []
+  for key, direction in METRICS:
+    cur, base = _get(artifact, key), _get(baseline, key)
+    if cur is None or base is None or base == 0:
+      rows.append({'key': key, 'direction': direction, 'current': cur,
+                   'baseline': base, 'change_pct': None,
+                   'status': 'skipped'})
+      continue
+    if direction == 'lower':
+      slowdown = cur / base - 1.0
+    else:
+      # a rate collapsing to 0 is a total regression; the slowdown is
+      # CLAMPED finite so the verdict stays strict-JSON (an Infinity
+      # token in the artifact would make the whole file unparseable —
+      # the exact failure mode the sink exists to prevent)
+      slowdown = min(base / cur - 1.0 if cur else 1e4, 1e4)
+    status = 'regressed' if slowdown > threshold else 'ok'
+    if status == 'regressed':
+      regressed.append(key)
+    rows.append({'key': key, 'direction': direction, 'current': cur,
+                 'baseline': base,
+                 'change_pct': round(100.0 * slowdown, 2),
+                 'status': status})
+  return {'status': 'FAIL' if regressed else 'PASS',
+          'threshold': threshold, 'metrics': rows,
+          'regressed': regressed}
+
+
+def format_report(verdict: Dict) -> str:
+  """Human-readable per-metric report (every line names its key, so a
+  FAIL is actionable from the log alone)."""
+  lines = [f"bench regression gate: {verdict['status']} "
+           f"(threshold {verdict['threshold'] * 100:.0f}%)"]
+  if verdict.get('baseline_created'):
+    lines[0] = ('bench regression gate: BASELINE_CREATED '
+                f"-> {verdict.get('baseline_path')} (first run; commit "
+                'it to pin the trajectory)')
+    if verdict.get('unguarded'):
+      lines.append(
+          '  WARNING: baseline lacks tracked metrics '
+          f"{verdict['unguarded']} — these stay UNGUARDED until a "
+          'complete run re-bootstraps (delete the baseline or pass '
+          '--update-baseline after a full run)')
+    return '\n'.join(lines)
+  if verdict['status'] == 'ERROR':
+    lines.append(f"  {verdict.get('error')}")
+    return '\n'.join(lines)
+  for m in verdict['metrics']:
+    if m['status'] == 'skipped':
+      lines.append(f"  [skip] {m['key']}: missing on one side "
+                   f"(current={m['current']}, baseline={m['baseline']})")
+      continue
+    tag = 'FAIL' if m['status'] == 'regressed' else ' ok '
+    lines.append(
+        f"  [{tag}] {m['key']}: {m['current']} vs baseline "
+        f"{m['baseline']} ({m['change_pct']:+.1f}% "
+        f"{'slower' if m['change_pct'] >= 0 else 'faster'})")
+  return '\n'.join(lines)
+
+
+def summary(verdict: Dict) -> str:
+  """Compact verdict for the artifact's bounded stdout summary line
+  (`sink._SUMMARY_KEYS` carries it near the front)."""
+  if verdict.get('baseline_created'):
+    return 'BASELINE_CREATED'
+  if verdict['status'] != 'FAIL':
+    return verdict['status']
+  worst = max((m for m in verdict['metrics']
+               if m['status'] == 'regressed'),
+              key=lambda m: m['change_pct'])
+  return (f"FAIL {worst['key']} {worst['change_pct']:+.1f}%"
+          + (f" (+{len(verdict['regressed']) - 1} more)"
+             if len(verdict['regressed']) > 1 else ''))
+
+
+def _write_json_atomic(path: str, obj: Dict) -> None:
+  """tmp + rename, like the sink's artifact write: a kill mid-write
+  must never leave a truncated baseline to poison every later gate."""
+  import tempfile
+  d = os.path.dirname(os.path.abspath(path))
+  fd, tmp = tempfile.mkstemp(prefix='.bench_baseline.', dir=d)
+  try:
+    with os.fdopen(fd, 'w') as f:
+      json.dump(obj, f, indent=1, sort_keys=True)
+      f.write('\n')
+    os.replace(tmp, path)
+  except BaseException:
+    try:
+      os.unlink(tmp)
+    except OSError:
+      pass
+    raise
+
+
+def check(artifact, baseline: Optional[str] = None,
+          threshold: Optional[float] = None,
+          update_baseline: bool = False) -> Tuple[Dict, int]:
+  """The gate: compare artifact vs baseline, return ``(verdict,
+  exit_code)`` — 0 PASS / baseline bootstrapped, 1 regression, 2 the
+  gate could not run.  ``artifact`` is the aggregate dict itself or a
+  path to it (callers holding the fresh in-memory aggregate pass the
+  dict, so a stale file on disk can never be gated by accident).
+
+  A MISSING baseline is created from the artifact (first run — the
+  intended bootstrap).  A CORRUPT baseline is rc 2, NOT recreated: a
+  regressed run must never get to re-base the trajectory onto its own
+  slow numbers through a conveniently broken file; fix or delete the
+  baseline explicitly.  ``update_baseline`` rewrites it after a PASS
+  (explicit re-basing)."""
+  bp = baseline_path(baseline)
+  thr = threshold_from_env() if threshold is None else float(threshold)
+  if isinstance(artifact, dict):
+    art = artifact
+  else:
+    with open(artifact) as f:
+      art = json.load(f)
+  if not os.path.exists(bp):
+    _write_json_atomic(bp, art)
+    # a partial first run (a crashed phase) pins a baseline with
+    # holes, and compare() SKIPS keys missing from either side — name
+    # the uncovered metrics loudly so the hole is a choice, not a
+    # surprise (re-bootstrap from a complete run to close it)
+    missing = [k for k, _ in METRICS if _get(art, k) is None]
+    return ({'status': 'PASS', 'baseline_created': True,
+             'baseline_path': bp, 'threshold': thr, 'metrics': [],
+             'regressed': [], 'unguarded': missing}, 0)
+  try:
+    with open(bp) as f:
+      base = json.load(f)
+  except (json.JSONDecodeError, ValueError) as e:
+    return ({'status': 'ERROR', 'baseline_path': bp, 'threshold': thr,
+             'metrics': [], 'regressed': [],
+             'error': f'baseline is corrupt ({e}); fix or delete it '
+                      'to re-bootstrap'}, 2)
+  verdict = compare(art, base, thr)
+  verdict['baseline_path'] = bp
+  if update_baseline and verdict['status'] == 'PASS':
+    _write_json_atomic(bp, art)
+    verdict['baseline_updated'] = True
+  return verdict, (1 if verdict['status'] == 'FAIL' else 0)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  import argparse
+  ap = argparse.ArgumentParser(
+      description='Compare a bench artifact against the committed '
+                  'baseline; exit 1 on regression.')
+  ap.add_argument('artifact')
+  ap.add_argument('baseline', nargs='?', default=None)
+  ap.add_argument('--threshold', type=float, default=None,
+                  help='fractional slowdown tolerance (default 0.2)')
+  ap.add_argument('--update-baseline', action='store_true',
+                  help='rewrite the baseline from this artifact after '
+                       'a PASS')
+  args = ap.parse_args(argv)
+  try:
+    verdict, rc = check(args.artifact, args.baseline,
+                        threshold=args.threshold,
+                        update_baseline=args.update_baseline)
+  except (OSError, ValueError) as e:
+    # infra failure (missing/corrupt artifact, unwritable baseline
+    # dir) is rc 2, never rc 1 — a CI keying on the exit code must
+    # not misread it as a perf regression
+    print(f'bench regression gate: ERROR — could not run '
+          f'({type(e).__name__}: {e})')
+    return 2
+  print(format_report(verdict))
+  return rc
+
+
+if __name__ == '__main__':
+  import sys
+  sys.exit(main())

@@ -1,0 +1,145 @@
+"""Per-stage latency report CLI over flight-recorder traces.
+
+``python -m graphlearn_tpu.telemetry.report TRACE.jsonl`` prints a
+per-stage (span-kind) latency table — count, total, mean, p50/p90/p99
+from the log2 histograms, max — answering "where did the step time
+go" without leaving the terminal:
+
+    stage              count   total_s    mean_ms      p50      p90 ...
+    batch                 16     0.842     52.6ms   64.0ms  128.0ms
+    sample.exchange       16     0.512     32.0ms   32.0ms   65.5ms
+
+Modes:
+  * ``--diff OTHER.jsonl``: second trace as baseline; the table gains
+    a ``Δmean%`` column per stage (positive = this trace is slower) —
+    the two-trace regression hunt.
+  * ``--chrome OUT.json``: also write the Perfetto-loadable Chrome
+    trace (`telemetry.export`).
+  * ``--metrics-json FILE``: instead of a JSONL trace, read a
+    `gather_metrics` aggregate dump (``{'aggregate': {...}}`` or the
+    flat dict itself) and print the MERGED cross-host histograms —
+    the ≥2-process mesh view.
+
+Quantiles from ``--metrics-json`` are log2-bucket upper edges (a 2x
+envelope); from a JSONL trace the same bucketing is applied to the raw
+durations so the two views stay comparable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from .export import load_events, span_durations, write_chrome_trace
+from .histogram import Histogram, from_snapshot
+
+
+def histograms_from_trace(path: str) -> Dict[str, Histogram]:
+  """Per-kind histograms rebuilt from a JSONL trace's span.end
+  durations."""
+  out: Dict[str, Histogram] = {}
+  for kind, durs in span_durations(load_events(path)).items():
+    h = out.setdefault(kind, Histogram(kind))
+    for d in durs:
+      h.add(d)
+  return out
+
+
+def _fmt_secs(s: float) -> str:
+  if s >= 1.0:
+    return f'{s:.3f}s'
+  if s >= 1e-3:
+    return f'{s * 1e3:.1f}ms'
+  return f'{s * 1e6:.0f}us'
+
+
+def format_table(hists: Dict[str, Histogram],
+                 baseline: Optional[Dict[str, Histogram]] = None
+                 ) -> str:
+  """Render the per-stage latency table (largest total time first).
+  With ``baseline``, adds the Δmean% column (positive = slower)."""
+  header = ['stage', 'count', 'total_s', 'mean', 'p50', 'p90', 'p99']
+  if baseline is not None:
+    header.append('Δmean%')
+  rows: List[List[str]] = []
+  for kind in sorted(hists, key=lambda k: -hists[k].secs):
+    h = hists[kind]
+    row = [kind, f'{int(h.count)}', f'{h.secs:.3f}',
+           _fmt_secs(h.mean), _fmt_secs(h.quantile(0.5)),
+           _fmt_secs(h.quantile(0.9)), _fmt_secs(h.quantile(0.99))]
+    if baseline is not None:
+      b = baseline.get(kind)
+      if b is not None and b.count and b.mean > 0:
+        row.append(f'{100.0 * (h.mean / b.mean - 1.0):+.1f}')
+      else:
+        row.append('new')
+    rows.append(row)
+  if baseline is not None:
+    for kind in sorted(set(baseline) - set(hists)):
+      rows.append([kind, '0', '0.000', '-', '-', '-', '-', 'gone'])
+  widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+            if rows else len(header[i]) for i in range(len(header))]
+  lines = ['  '.join(h.ljust(w) if i == 0 else h.rjust(w)
+                     for i, (h, w) in enumerate(zip(header, widths)))]
+  for r in rows:
+    lines.append('  '.join(c.ljust(w) if i == 0 else c.rjust(w)
+                           for i, (c, w) in enumerate(zip(r, widths))))
+  return '\n'.join(lines)
+
+
+def histograms_from_metrics_json(path: str) -> Dict[str, Histogram]:
+  """Decode a `gather_metrics` dump (the ``aggregate`` dict, or the
+  whole result object) into merged histograms."""
+  with open(path) as f:
+    obj = json.load(f)
+  if isinstance(obj, dict) and isinstance(obj.get('aggregate'), dict):
+    obj = obj['aggregate']
+  return from_snapshot(obj)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  ap = argparse.ArgumentParser(
+      prog='python -m graphlearn_tpu.telemetry.report',
+      description='Per-stage latency report over a flight-recorder '
+                  'trace (and optional trace diff / Chrome export).')
+  ap.add_argument('trace', nargs='?',
+                  help='recorder JSONL (GLT_TELEMETRY_JSONL output)')
+  ap.add_argument('--diff', metavar='BASELINE_JSONL',
+                  help='second trace to diff against (Δmean%% column)')
+  ap.add_argument('--chrome', metavar='OUT_JSON',
+                  help='also write a Perfetto-loadable Chrome trace')
+  ap.add_argument('--metrics-json', metavar='FILE',
+                  help='print merged histograms from a gather_metrics '
+                       'aggregate dump instead of a JSONL trace')
+  args = ap.parse_args(argv)
+  if not args.trace and not args.metrics_json:
+    ap.error('need a TRACE.jsonl or --metrics-json FILE')
+  if args.metrics_json:
+    hists = histograms_from_metrics_json(args.metrics_json)
+    print(f'# merged cross-host histograms ({args.metrics_json})')
+    print(format_table(hists))
+    if not args.trace:
+      if args.chrome or args.diff:
+        ap.error('--chrome/--diff need a TRACE.jsonl positional '
+                 'argument (a metrics aggregate has no events to '
+                 'export or diff)')
+      return 0
+  hists = histograms_from_trace(args.trace)
+  base = histograms_from_trace(args.diff) if args.diff else None
+  print(f'# per-stage span latencies ({args.trace})'
+        + (f' vs {args.diff}' if args.diff else ''))
+  if not hists:
+    print('(no span.end events in trace — was the recorder on and '
+          'the pipeline span-instrumented?)')
+  else:
+    print(format_table(hists, baseline=base))
+  if args.chrome:
+    n = write_chrome_trace(args.trace, args.chrome)
+    print(f'# wrote {n} trace events -> {args.chrome} '
+          '(open in https://ui.perfetto.dev)')
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,0 +1,81 @@
+"""Registry of flight-recorder event kinds.
+
+Exporters (`telemetry.export`), the report CLI, and external
+dashboards key off event ``kind`` strings; an unregistered kind is a
+consumer that silently sees nothing.  Every ``recorder.emit('<kind>',
+...)`` call site must register its kind here — enforced statically by
+``tests/test_event_schema.py``, which greps the package for emit call
+sites and fails on any kind missing from :data:`EVENT_KINDS` (and on
+stale registry entries with no remaining call site, so the table can't
+rot in the other direction).
+
+The value documents the emitter and the fields consumers may rely on.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+#: kind -> 'emitter: field summary' (the consumer contract)
+EVENT_KINDS: Dict[str, str] = {
+    'hop.padding':
+        'DistNeighborLoader / fused epoch drivers: hop, nodes, '
+        'capacity, fill (1 - fill = padding waste)',
+    'channel.stall':
+        'ChannelTelemetry._timed: op, secs, occupancy, channel',
+    'slack.transition':
+        'AdaptiveSlack: from_slack, to_slack, reason, drop_rate',
+    'slack.pinned':
+        'AdaptiveSlack: slack, drop_rate (ladder pinned, no more '
+        'retuning)',
+    'dist.exchange':
+        'ExchangeTelemetry drains: since-last-drain deltas of '
+        'offered/dropped/slots per loss channel',
+    'dist.cold_tier':
+        'tiered DistFeature drains: lookups, misses, hit_rate',
+    'fused.compile':
+        'loader.fused._uncached_jit: fn, secs, persistent_cache',
+    'span.begin':
+        'telemetry.spans: name, trace_id, span_id, parent_id, pid, '
+        'tid (+caller fields)',
+    'span.end':
+        'telemetry.spans: same ids as span.begin plus dur '
+        '(monotonic-clock seconds) and error',
+}
+
+
+#: span NAME vocabulary (the `name` field of span.begin/span.end —
+#: the per-stage rows of the report CLI and the Perfetto slices).
+#: Same contract as EVENT_KINDS: every ``span('<name>', ...)`` call
+#: site registers here, enforced by the same static test.
+SPAN_NAMES: Dict[str, str] = {
+    'batch':
+        'per-batch root span (mesh + host-runtime loaders)',
+    'sample.exchange':
+        'mesh samplers: the fused sample+exchange SPMD dispatch',
+    'feature.lookup':
+        'mesh samplers, TIERED stores only: the cold-tier overlay '
+        '(the per-batch host sync worth attributing)',
+    'stitch':
+        'mesh loaders: Batch pytree assembly',
+    'recv':
+        'host-runtime DistLoader: channel dequeue',
+    'collate':
+        'host-runtime DistLoader: message -> static-shape Batch '
+        '(carries producer_trace/producer_span link fields)',
+    'producer.sample':
+        'sampling worker subprocess: one sample+send',
+    'server.fetch':
+        'DistServer: one blocking buffer pull for a client',
+    'client.fetch':
+        'DistClient: one RPC fetch round trip',
+    'fused.epoch':
+        'fused epoch drivers: one whole run() call',
+    'fused.dispatch':
+        'fused epoch drivers: one chunk/program dispatch',
+    'fused.init_state':
+        'FusedTreeEpoch.init_state: param init from the dummy batch',
+}
+
+
+def registered(kind: str) -> bool:
+  return kind in EVENT_KINDS

@@ -37,8 +37,11 @@ DEFAULT_RECORDS = 'BENCH_ARTIFACT.jsonl'
 SUMMARY_LIMIT = 1900
 
 #: summary key order: earlier keys survive when the line must shrink.
+#: 'regression' (the bench gate's compact verdict, telemetry.regress)
+#: sits right behind the headline so a FAIL stays visible even when
+#: the line degrades to its minimum.
 _SUMMARY_KEYS = (
-    'metric', 'value', 'unit', 'vs_baseline', 'protocol',
+    'metric', 'value', 'unit', 'regression', 'vs_baseline', 'protocol',
     'fused_epoch_secs', 'fused_vs_baseline', 'fused_layout',
     'epoch_secs_min_med_max', 'epoch_floor_secs',
     'sampled_edges_per_sec_M_min_med_max', 'train_step_mfu',
@@ -112,9 +115,10 @@ def summary_line(art: Dict, artifact: Optional[str] = None,
   line = json.dumps(picked)
   while len(line) > limit and picked:
     # drop the lowest-priority droppable key ('metric'/'value'/
-    # 'artifact' go last: they are the whole point of the line)
+    # 'regression'/'artifact' go last: they are the whole point of
+    # the line — a regression FAIL must survive any degradation)
     order = [k for k in picked
-             if k not in ('metric', 'value', 'artifact')]
+             if k not in ('metric', 'value', 'regression', 'artifact')]
     victim = order[-1] if order else next(iter(picked))
     del picked[victim]
     line = json.dumps(picked)

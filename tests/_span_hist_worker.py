@@ -1,0 +1,38 @@
+"""Worker for the 2-process span-histogram merge test
+(tests/test_spans.py::test_histograms_merge_across_two_process_mesh):
+each process records span latencies into its LOCAL metrics registry,
+then `gather_metrics(prefix='span.')` allgathers + sums the flat
+histogram encodings over the real cross-process collective plane.
+"""
+import json
+import sys
+import time
+
+coordinator, num_procs, proc_id, out_file = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+
+from graphlearn_tpu.parallel import multihost
+
+multihost.initialize(coordinator_address=coordinator,
+                     num_processes=num_procs, process_id=proc_id)
+
+import jax
+
+assert jax.process_count() == num_procs, jax.process_count()
+
+from graphlearn_tpu.telemetry import gather_metrics, recorder, span
+
+recorder.enable()                       # ring-only: spans need it on
+try:
+  # proc 0 records 1 span, proc 1 records 2 — the merged histogram
+  # must show count 3 on BOTH processes
+  for i in range(proc_id + 1):
+    with span('mesh.stage', proc=proc_id, i=i):
+      time.sleep(0.005 * (proc_id + 1))
+finally:
+  recorder.disable()
+
+agg = gather_metrics(prefix='span.')
+with open(out_file, 'w') as f:
+  json.dump(agg, f)
+print('WORKER OK', proc_id)

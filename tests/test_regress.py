@@ -1,0 +1,218 @@
+"""Bench regression gate (ISSUE 2): baseline bootstrap on first run,
+per-metric FAIL report on an artificially slowed metric, direction
+handling for rate metrics, and the bench.py wiring (artifact verdict
+stamped into the bounded summary line).
+"""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+_ROOT = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope='module')
+def regress():
+  spec = importlib.util.spec_from_file_location(
+      'regress_under_test',
+      _ROOT / 'graphlearn_tpu' / 'telemetry' / 'regress.py')
+  mod = importlib.util.module_from_spec(spec)
+  spec.loader.exec_module(mod)
+  return mod
+
+
+@pytest.fixture(scope='module')
+def bench():
+  spec = importlib.util.spec_from_file_location('bench_for_regress',
+                                                _ROOT / 'bench.py')
+  mod = importlib.util.module_from_spec(spec)
+  argv = sys.argv
+  sys.argv = ['bench.py']
+  try:
+    spec.loader.exec_module(mod)
+  finally:
+    sys.argv = argv
+  return mod
+
+
+ART = {'metric': 'graphsage_fused_epoch_secs', 'value': 7.1,
+       'unit': 's', 'fused_epoch_secs': 7.1, 'train_step_mfu': 0.02,
+       'dist': {'seeds_per_sec': 1000.0,
+                'edges_per_sec_per_chip': 2e4}}
+
+
+def _write(path, obj):
+  path.write_text(json.dumps(obj))
+  return str(path)
+
+
+def test_first_run_creates_baseline(regress, tmp_path):
+  art = _write(tmp_path / 'A.json', ART)
+  bl = tmp_path / 'BL.json'
+  verdict, rc = regress.check(art, str(bl))
+  assert rc == 0 and verdict['baseline_created']
+  assert json.loads(bl.read_text())['value'] == 7.1
+  assert 'BASELINE_CREATED' in regress.format_report(verdict)
+  assert regress.summary(verdict) == 'BASELINE_CREATED'
+
+
+def test_partial_bootstrap_names_unguarded_metrics(regress, tmp_path):
+  """Pinning a baseline from a partial run (a crashed phase) must
+  loudly name the tracked metrics it leaves unguarded."""
+  partial = {'value': 7.1}                      # no fused/dist keys
+  art = _write(tmp_path / 'A.json', partial)
+  verdict, rc = regress.check(art, str(tmp_path / 'BL.json'))
+  assert rc == 0 and verdict['baseline_created']
+  assert 'fused_epoch_secs' in verdict['unguarded']
+  assert 'dist.seeds_per_sec' in verdict['unguarded']
+  assert 'UNGUARDED' in regress.format_report(verdict)
+
+
+def test_corrupt_baseline_errors_without_rebasing(regress, tmp_path):
+  """A corrupt baseline is rc 2 (reported, non-fatal to the bench) and
+  NOT rewritten — a regressed run must never re-base the trajectory
+  onto its own numbers through a conveniently broken file."""
+  art = _write(tmp_path / 'A.json', dict(ART, value=99.0))
+  bl = tmp_path / 'BL.json'
+  bl.write_text('{"value": 7.')                 # truncated JSON
+  verdict, rc = regress.check(art, str(bl))
+  assert rc == 2 and verdict['status'] == 'ERROR'
+  assert 'corrupt' in verdict['error']
+  assert bl.read_text() == '{"value": 7.'       # untouched
+  assert regress.summary(verdict) == 'ERROR'
+  assert 'corrupt' in regress.format_report(verdict)
+
+
+def test_check_accepts_in_memory_artifact(regress, tmp_path):
+  """bench passes the fresh aggregate dict directly, so a stale
+  artifact file can never be what gets gated."""
+  bl = _write(tmp_path / 'BL.json', ART)
+  verdict, rc = regress.check(dict(ART, value=9.0,
+                                   fused_epoch_secs=9.0), bl)
+  assert rc == 1 and 'value' in verdict['regressed']
+
+
+def test_slowed_metric_fails_and_names_key(regress, tmp_path):
+  """Acceptance: an artificially >= 20% slowed metric exits nonzero
+  with a per-metric report naming the regressed key."""
+  bl = _write(tmp_path / 'BL.json', ART)
+  slow = dict(ART, value=9.0, fused_epoch_secs=9.0)   # +26.8%
+  art = _write(tmp_path / 'A.json', slow)
+  verdict, rc = regress.check(art, bl)
+  assert rc == 1 and verdict['status'] == 'FAIL'
+  assert set(verdict['regressed']) == {'value', 'fused_epoch_secs'}
+  report = regress.format_report(verdict)
+  assert '[FAIL] fused_epoch_secs' in report
+  assert '+26.8%' in report
+  assert regress.summary(verdict).startswith('FAIL ')
+  # CLI form: same verdict, nonzero exit
+  assert regress.main([art, bl]) == 1
+
+
+def test_within_threshold_passes(regress, tmp_path):
+  bl = _write(tmp_path / 'BL.json', ART)
+  ok = dict(ART, value=7.8)                           # +9.9%
+  verdict, rc = regress.check(_write(tmp_path / 'A.json', ok), bl)
+  assert rc == 0 and verdict['status'] == 'PASS'
+  assert regress.summary(verdict) == 'PASS'
+
+
+def test_rate_metric_direction(regress, tmp_path):
+  """higher-is-better metrics regress when they DROP: a fallen
+  seeds_per_sec must fail, a risen one must not."""
+  bl = _write(tmp_path / 'BL.json', ART)
+  dropped = dict(ART, dist={'seeds_per_sec': 700.0,   # -30% rate
+                            'edges_per_sec_per_chip': 3e4})
+  verdict, rc = regress.check(_write(tmp_path / 'A.json', dropped), bl)
+  assert rc == 1
+  assert verdict['regressed'] == ['dist.seeds_per_sec']
+  row = {m['key']: m for m in verdict['metrics']}
+  assert row['dist.seeds_per_sec']['change_pct'] > 20
+  assert row['dist.edges_per_sec_per_chip']['status'] == 'ok'
+
+
+def test_rate_collapse_stays_strict_json(regress, tmp_path):
+  """A rate falling to 0 regresses with a CLAMPED finite change_pct —
+  json.dumps of the verdict must stay strict (no Infinity token)."""
+  bl = _write(tmp_path / 'BL.json', ART)
+  dead = dict(ART, dist={'seeds_per_sec': 0.0})
+  verdict, rc = regress.check(_write(tmp_path / 'A.json', dead), bl)
+  assert rc == 1 and 'dist.seeds_per_sec' in verdict['regressed']
+  row = {m['key']: m for m in verdict['metrics']}['dist.seeds_per_sec']
+  assert row['change_pct'] == 1e6          # clamped, finite
+  text = json.dumps(verdict, allow_nan=False)   # raises on inf/nan
+  assert 'Infinity' not in text
+  assert regress.format_report(verdict)    # renders without error
+
+
+def test_missing_metrics_skip_not_fail(regress, tmp_path):
+  """A phase that degraded away (key missing on one side) is skipped —
+  a bad bench day is not a regression."""
+  bl = _write(tmp_path / 'BL.json', ART)
+  partial = {'value': 7.2}
+  verdict, rc = regress.check(_write(tmp_path / 'A.json', partial), bl)
+  assert rc == 0
+  rows = {m['key']: m['status'] for m in verdict['metrics']}
+  assert rows['fused_epoch_secs'] == 'skipped'
+  assert rows['value'] == 'ok'
+
+
+def test_threshold_override(regress, tmp_path):
+  bl = _write(tmp_path / 'BL.json', ART)
+  mild = dict(ART, value=7.9)                         # +11.3%
+  _, rc = regress.check(_write(tmp_path / 'A.json', mild), bl,
+                        threshold=0.1)
+  assert rc == 1
+  _, rc = regress.check(str(tmp_path / 'A.json'), bl, threshold=0.15)
+  assert rc == 0
+
+
+def test_update_baseline_after_pass(regress, tmp_path):
+  bl = _write(tmp_path / 'BL.json', ART)
+  faster = dict(ART, value=5.0, fused_epoch_secs=5.0)
+  verdict, rc = regress.check(_write(tmp_path / 'A.json', faster), bl,
+                              update_baseline=True)
+  assert rc == 0 and verdict.get('baseline_updated')
+  assert json.loads(Path(bl).read_text())['value'] == 5.0
+
+
+def test_bench_gate_wiring(bench, regress, tmp_path, monkeypatch):
+  """bench.py --check-regression: first run creates the baseline;
+  a slowed artifact exits nonzero and the re-emitted summary line
+  carries the compact verdict near the front."""
+  art_path = tmp_path / 'BENCH_ARTIFACT.json'
+  bl_path = tmp_path / 'BENCH_BASELINE.json'
+  monkeypatch.setenv('GLT_BENCH_ARTIFACT', str(art_path))
+  monkeypatch.setenv('GLT_BENCH_BASELINE', str(bl_path))
+  art = dict(ART)
+  _write(art_path, art)
+  rc = bench._run_regression_gate(art)
+  assert rc == 0 and bl_path.exists()      # baseline bootstrapped
+  slow = dict(ART, value=9.0, fused_epoch_secs=9.0)
+  _write(art_path, slow)
+  rc = bench._run_regression_gate(slow)
+  assert rc == 1
+  # the verdict was stamped into the re-emitted artifact + summary
+  full = json.loads(art_path.read_text())
+  assert full['regression'].startswith('FAIL ')
+  assert full['regression_report']['status'] == 'FAIL'
+  from graphlearn_tpu.telemetry import sink
+  line = sink.summary_line(full, artifact=str(art_path))
+  assert json.loads(line)['regression'].startswith('FAIL ')
+
+
+def test_summary_line_keeps_regression_under_degradation():
+  """The satellite contract: a FAIL verdict survives even when the
+  summary line degrades to its minimum."""
+  from graphlearn_tpu.telemetry import sink
+  art = {'metric': 'm' * 500, 'value': 1.0, 'unit': 's',
+         'regression': 'FAIL fused_epoch_secs +34.0%',
+         'protocol': 'p' * 900,
+         'epoch_secs_min_med_max': [0.1] * 400,
+         'dist': {'padding_waste_pct': 1.0, 'error': 'e' * 1200}}
+  line = sink.summary_line(art, artifact='/tmp/a.json', limit=700)
+  parsed = json.loads(line)
+  assert parsed['regression'].startswith('FAIL')
+  assert parsed['value'] == 1.0

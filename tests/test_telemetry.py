@@ -90,6 +90,126 @@ def test_recorder_coerces_numpy_scalars(tmp_path):
   assert ev['a'] == 3 and abs(ev['b'] - 0.5) < 1e-6 and ev['c'] == [0, 1]
 
 
+def test_recorder_unserializable_degrades_to_repr(tmp_path):
+  """ISSUE-2 satellite: bytes/enums/arbitrary objects degrade the
+  FIELD (repr), never the event — emit must not raise from hot
+  paths."""
+  import enum
+
+  class Kind(enum.Enum):
+    A = 1
+
+  class Opaque:
+    def __repr__(self):
+      return '<opaque>'
+
+  p = str(tmp_path / 'f.jsonl')
+  r = EventRecorder(path=p)
+  r.emit('x', raw=b'\x00\xff', kind_=Kind.A, obj=Opaque(), ok=1,
+         nested={'deep': b'zz'})
+  r.emit('y', after=2)                   # the stream keeps flowing
+  lines = open(p).read().strip().splitlines()
+  assert len(lines) == 2
+  ev = json.loads(lines[0])
+  assert ev['ok'] == 1
+  assert ev['obj'] == '<opaque>'
+  assert 'Kind.A' in ev['kind_']
+  assert isinstance(ev['raw'], str)      # repr of the bytes
+  assert isinstance(ev['nested']['deep'], str)   # container leaf too
+  assert json.loads(lines[1])['after'] == 2
+  # the ring snapshot dumps the same events without raising
+  dump = str(tmp_path / 'dump.jsonl')
+  assert r.dump(dump) == 2
+  assert len(open(dump).read().strip().splitlines()) == 2
+
+
+def test_recorder_nonstring_dict_keys_degrade(tmp_path):
+  """default=repr can't fix non-string dict KEYS (json raises
+  TypeError before consulting it); the whole field degrades to repr
+  instead of emit raising from the hot path."""
+  p = str(tmp_path / 'f.jsonl')
+  r = EventRecorder(path=p)
+  r.emit('x', per_etype={('paper', 'cites', 'paper'): 5}, ok=1)
+  r.emit('y', after=2)
+  lines = open(p).read().strip().splitlines()
+  assert len(lines) == 2
+  ev = json.loads(lines[0])
+  assert ev['ok'] == 1
+  assert 'cites' in ev['per_etype']       # repr of the whole dict
+  assert r.dump(str(tmp_path / 'd.jsonl')) == 2
+
+
+def test_reenable_same_path_reopens_after_io_failure(tmp_path):
+  """An emit-time I/O failure closes the sink; a later enable() with
+  the SAME path must reopen the file, not silently stay ring-only."""
+  p = str(tmp_path / 'f.jsonl')
+  r = EventRecorder(path=p)
+  r.emit('a')
+  with r._lock:
+    r._close_file_locked()          # what an ENOSPC emit does
+  r.emit('b')                       # ring-only while closed
+  r.enable(p)                       # operator freed space: resume
+  r.emit('c')
+  kinds = [json.loads(ln)['kind']
+           for ln in open(p).read().strip().splitlines()]
+  assert kinds == ['a', 'c']
+  assert [e['kind'] for e in r.events()] == ['a', 'b', 'c']
+
+
+def test_recorder_mono_field_monotonic(tmp_path):
+  """ISSUE-2 satellite: every event carries a monotonic-clock `mono`
+  next to wall `ts`, and mono never goes backwards (span durations
+  derive from it)."""
+  r = EventRecorder(path=str(tmp_path / 'f.jsonl'))
+  for i in range(5):
+    r.emit('tick', i=i)
+  evs = r.events('tick')
+  assert all('mono' in e and 'ts' in e for e in evs)
+  monos = [e['mono'] for e in evs]
+  assert monos == sorted(monos)
+  assert monos[-1] > 0
+
+
+def test_recorder_concurrent_emit_with_both_bounds(tmp_path):
+  """ISSUE-2 satellite: many threads emitting with BOTH the ring and
+  file bounds active — no torn/interleaved JSONL lines, the file cap
+  holds exactly, and the ring keeps the NEWEST window (oldest-drop)."""
+  p = str(tmp_path / 'flight.jsonl')
+  ring_cap, file_cap, threads, per = 64, 300, 8, 100
+  r = EventRecorder(path=p, max_events=ring_cap,
+                    max_file_events=file_cap)
+  start = threading.Barrier(threads)
+
+  def work(tid):
+    start.wait()
+    for i in range(per):
+      r.emit('t', tid=tid, i=i)
+
+  ts = [threading.Thread(target=work, args=(t,))
+        for t in range(threads)]
+  for t in ts:
+    t.start()
+  for t in ts:
+    t.join()
+  lines = open(p).read().strip().splitlines()
+  assert len(lines) == file_cap            # file bound holds exactly
+  parsed = [json.loads(ln) for ln in lines]       # every line intact
+  assert all(pv['kind'] == 't' and 'mono' in pv for pv in parsed)
+  st = r.stats()
+  assert st['dropped_file_events'] == threads * per - file_cap
+  # ring: full at capacity, holding each thread's NEWEST emissions —
+  # the oldest-drop contract (per-thread order is preserved by the
+  # single append lock, so kept i's are each thread's tail)
+  ring = r.events('t')
+  assert len(ring) == ring_cap == st['ring_events']
+  by_tid = {}
+  for e in ring:
+    by_tid.setdefault(e['tid'], []).append(e['i'])
+  for tid, seen in by_tid.items():
+    assert seen == sorted(seen)
+    assert seen == list(range(per - len(seen), per)), tid
+
+
 # -- aggregation helpers ----------------------------------------------------
 
 def test_gather_metrics_single_host_matches_local():
