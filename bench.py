@@ -924,7 +924,7 @@ def _run_envelope_row(num_parts: int, batch: int, timeout: int):
                         'benchmarks', 'bench_dist_loader.py')
   cmd = [sys.executable, script, '--envelope-worker', '--num-parts',
          str(num_parts), '--mode', 'homo', '--batch', str(batch),
-         '--nodes', '20000']
+         '--nodes', '20000', '--epochs', '5']
   try:
     out = subprocess.run(cmd, capture_output=True, text=True,
                          env=cpu_mesh_env(num_parts), timeout=timeout)
@@ -1243,10 +1243,15 @@ def main():
   else:
     env_rows = []
     for p_, bsz in ((16, 64), (64, 32)):
-      if budget_left() < 130:
+      # rows now include the per-layout comparison epochs (3 extra
+      # compiles) and the 5-epoch adaptive walk: up to ~7 min worst
+      # case, typically 2-3 — don't launch with less than ~3 min left
+      # (a timed-out row burns the budget AND leaves the guarded
+      # dist.scale_envelope.pNN metrics unwatched)
+      if budget_left() < 200:
         break
       r = _run_envelope_row(p_, bsz,
-                            int(min(280, max(budget_left() - 30, 60))))
+                            int(min(420, max(budget_left() - 30, 170))))
       if r is not None:
         env_rows.append(r)
     if env_rows:

@@ -173,8 +173,34 @@ def memory_envelope(num_parts: int = 128, hbm_gb: float = 95.0,
   }
 
 
+def _epoch_exchange_rows(loader, epochs: int, batch: int,
+                         num_parts: int):
+  """Run ``epochs`` epochs, returning (n_seeds, per-epoch
+  (waste_pct, drop_pct) rows) from the frontier exchange deltas."""
+  rows = []
+  n_seeds = 0
+  b = None
+  for _ in range(epochs):
+    prev = loader.sampler.exchange_stats(tick_metrics=False)
+    for b in loader:
+      n_seeds += batch * num_parts
+    st = loader.sampler.exchange_stats(tick_metrics=False)
+    offered = (st['dist.frontier.offered']
+               - prev['dist.frontier.offered'])
+    dropped = (st['dist.frontier.dropped']
+               - prev['dist.frontier.dropped'])
+    slots = st['dist.frontier.slots'] - prev['dist.frontier.slots']
+    rows.append((round(100.0 * (1 - (offered - dropped)
+                                / max(slots, 1)), 2),
+                 round(100.0 * dropped / max(offered, 1), 3)))
+  if b is not None:
+    import jax
+    jax.block_until_ready(b)
+  return n_seeds, rows
+
+
 def envelope_worker(num_parts: int, mode: str, batch: int,
-                    num_nodes: int, epochs: int = 3):
+                    num_nodes: int, epochs: int = 5):
   """Scale-envelope probe at ``num_parts`` VIRTUAL devices (VERDICT r3
   #6: past P=32): a deliberately tiny workload — the point is the
   PER-P exchange behavior (padding waste, drops, adaptive-slack
@@ -182,17 +208,39 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
   oversubscribe this box's cores ~10x.  ``mode``: 'homo' (adaptive
   slack, several epochs so the controller can walk), 'hetero'
   (per-type exchanges, adaptive), 'seal' (chunked full-window
-  subgraph hop).  Prints ONE JSON line."""
+  subgraph hop).  Prints ONE JSON line.
+
+  The headline ``padding_waste_pct`` / ``drop_rate_pct`` are the
+  FINAL epoch's (the adaptive ladder's converged state — the steady
+  state an IGBH-scale run lives in, and the same convention as the
+  main dist row's ``waste_by_epoch[-1]``); the full trajectory and
+  the run-cumulative figures ride alongside.  ``mode='homo'`` also
+  re-runs one epoch per exchange layout (dense / compact / hier, all
+  at the same static slack) so the artifact captures the layout
+  comparison at this P.
+  """
   import json
   import time
   import jax
-  from graphlearn_tpu.parallel import make_mesh
+  from graphlearn_tpu.parallel import make_mesh, resolve_layout
   assert len(jax.devices()) == num_parts, len(jax.devices())
   rows, cols = build_graph(num_nodes)
   rng = np.random.default_rng(1)
   mesh = make_mesh(num_parts)
   out = {'metric': 'dist_scale_envelope', 'num_parts': num_parts,
          'mode': mode, 'batch': batch, 'num_nodes': num_nodes}
+
+  def make_homo_loader(layout=None, slack='adaptive'):
+    from graphlearn_tpu.parallel import DistDataset, DistNeighborLoader
+    ds = DistDataset.from_full_graph(num_parts, rows, cols,
+                                     num_nodes=num_nodes)
+    seeds = rng.integers(0, num_nodes, batch * num_parts * 2)
+    return DistNeighborLoader(ds, [5, 5], seeds, batch_size=batch,
+                              shuffle=True, mesh=mesh,
+                              collect_features=False, seed=0,
+                              exchange_slack=slack,
+                              exchange_layout=layout)
+
   if mode == 'seal':
     from graphlearn_tpu.parallel import DistDataset, DistSubGraphLoader
     ds = DistDataset.from_full_graph(num_parts, rows, cols,
@@ -220,34 +268,48 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
                                       collect_features=False, seed=0,
                                       exchange_slack='adaptive')
   else:
-    from graphlearn_tpu.parallel import DistDataset, DistNeighborLoader
-    ds = DistDataset.from_full_graph(num_parts, rows, cols,
-                                     num_nodes=num_nodes)
-    seeds = rng.integers(0, num_nodes, batch * num_parts * 2)
-    loader = DistNeighborLoader(ds, [5, 5], seeds, batch_size=batch,
-                                shuffle=True, mesh=mesh,
-                                collect_features=False, seed=0,
-                                exchange_slack='adaptive')
+    loader = make_homo_loader()
   t0 = time.perf_counter()
   b = next(iter(loader))
   jax.block_until_ready(b)
   out['compile_secs'] = round(time.perf_counter() - t0, 1)
-  n_seeds = 0
   t0 = time.perf_counter()
-  for _ in range(epochs):
-    for b in loader:
-      n_seeds += batch * num_parts
-  jax.block_until_ready(b)
+  n_seeds, ep_rows = _epoch_exchange_rows(loader, epochs, batch,
+                                          num_parts)
   dt = time.perf_counter() - t0
   st = loader.sampler.exchange_stats(tick_metrics=False)
   sent = st['dist.frontier.offered'] - st['dist.frontier.dropped']
   out.update(
       seeds_per_sec=round(n_seeds / dt, 1),
-      padding_waste_pct=round(
+      # headline = converged (final-epoch) exchange state; the
+      # trajectory + run-cumulative figures follow
+      padding_waste_pct=ep_rows[-1][0],
+      drop_rate_pct=ep_rows[-1][1],
+      padding_waste_pct_by_epoch=[r[0] for r in ep_rows],
+      drop_rate_pct_by_epoch=[r[1] for r in ep_rows],
+      padding_waste_pct_cum=round(
           100.0 * (1 - sent / max(st['dist.frontier.slots'], 1)), 2),
-      drop_rate_pct=round(100.0 * st['dist.frontier.dropped']
-                          / max(st['dist.frontier.offered'], 1), 3),
-      slack_final=getattr(loader.sampler, 'exchange_slack', None))
+      drop_rate_pct_cum=round(100.0 * st['dist.frontier.dropped']
+                              / max(st['dist.frontier.offered'], 1),
+                              3),
+      slack_final=getattr(loader.sampler, 'exchange_slack', None),
+      exchange_layout=resolve_layout(
+          getattr(loader.sampler, 'exchange_layout', None), num_parts))
+  if mode == 'homo':
+    # dense-vs-compacted-vs-hierarchical at the same static slack:
+    # one epoch each, fresh loader (fresh compile) per layout
+    comparison = {}
+    for layout in ('dense', 'compact', 'hier'):
+      ll = make_homo_loader(layout=layout, slack=1.25)
+      _, lrows = _epoch_exchange_rows(ll, 1, batch, num_parts)
+      lst = ll.sampler.exchange_stats(tick_metrics=False)
+      comparison[layout] = {
+          'padding_waste_pct': lrows[-1][0],
+          'drop_rate_pct': lrows[-1][1],
+          'frontier_slots': lst['dist.frontier.slots'],
+          'frontier_offered': lst['dist.frontier.offered'],
+      }
+    out['layouts'] = comparison
   # the BASELINE north-star memory check rides along on every
   # envelope row (VERDICT r4 #9)
   out['memory_envelope_v5p128'] = memory_envelope(128)
@@ -319,6 +381,9 @@ def main():
                   help='print the IGBH-large-on-v5p-128 per-chip '
                        'memory table (VERDICT r4 #9)')
   ap.add_argument('--mode', default='homo')
+  ap.add_argument('--epochs', type=int, default=5,
+                  help='envelope-worker epochs (the adaptive ladder '
+                       'walks one rung per drop-free epoch)')
   ap.add_argument('--slack', default='exact')
   ap.add_argument('--hop-chunk', default='none')
   ap.add_argument('--batch', type=int, default=1024)
@@ -352,7 +417,8 @@ def main():
           flush=True)
     return
   if args.envelope_worker:
-    envelope_worker(args.num_parts, args.mode, args.batch, args.nodes)
+    envelope_worker(args.num_parts, args.mode, args.batch, args.nodes,
+                    epochs=args.epochs)
     return
 
   import jax

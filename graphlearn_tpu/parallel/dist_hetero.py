@@ -383,7 +383,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
   def __init__(self, dataset: DistHeteroDataset, num_neighbors,
                mesh: Optional[Mesh] = None, axis: str = 'data',
                with_edge: bool = False, collect_features: bool = True,
-               seed: int = 0, exchange_slack: Optional[float] = None):
+               seed: int = 0, exchange_slack: Optional[float] = None,
+               exchange_layout: Optional[str] = None):
     from .dp import make_mesh
     self.ds = dataset
     self.etypes, self.fanouts, self.num_hops = normalize_fanouts(
@@ -394,6 +395,9 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
     self.with_edge = with_edge
     self.collect_features = collect_features
     self.exchange_slack = exchange_slack
+    # see DistNeighborSampler: dense/compact/hier/ragged per-etype
+    # exchange layout; every per-type hop and gather below shares it
+    self.exchange_layout = exchange_layout or 'auto'
     self._base_key = jax.random.key(seed)
     self._step_cnt = 0
     self._steps = {}
@@ -464,6 +468,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
                      else 'range') for et in efeat_ets}
     num_hops = self.num_hops
     exchange_slack = self.exchange_slack
+    exchange_layout = self.exchange_layout
 
     def per_device(graphs_t, bounds_t, feats_t, labels_t, efeats_t,
                    ebounds_t, hcounts_t, seeds_s, key):
@@ -492,7 +497,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
         my_idx = jax.lax.axis_index(axis)
         neg_key = jax.random.fold_in(jax.random.fold_in(key, my_idx), 977)
         neg_cap = _slack_cap(link['num_neg'] * NEG_TRIALS, num_parts,
-                             exchange_slack)
+                             exchange_slack, exchange_layout)
         if link['mode'] == 'binary':
           nrows, ncols, neg_ok = dist_sample_negative(
               li, lx, bounds[s_t], num_nodes[s_t], num_nodes[d_t],
@@ -562,7 +567,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
               indptr, indices, eids if with_edge else None, bounds[s],
               fr_nodes, int(k), hop_key, axis, num_parts, with_edge,
               exchange_capacity=_slack_cap(fr_nodes.shape[0], num_parts,
-                                           exchange_slack))
+                                           exchange_slack,
+                                           exchange_layout))
           fr_stats = fr_stats + jnp.stack(hstats)
           states[d], rows, cols, _ = induce_next(
               states[d], fr_local, nbrs, mask)
@@ -581,7 +587,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
             (fshards[nt],), bounds[nt], states[nt].nodes, axis,
             num_parts,
             exchange_capacity=_slack_cap(table_cap[nt], num_parts,
-                                         exchange_slack),
+                                         exchange_slack,
+                                         exchange_layout),
             hot_counts=hcounts[nt] if tiered_nts[nt] else None)
         ft_stats = ft_stats + jnp.stack(gstats)
       y = {}
@@ -590,7 +597,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
             (lshards[nt],), bounds[nt], states[nt].nodes, axis,
             num_parts,
             exchange_capacity=_slack_cap(table_cap[nt], num_parts,
-                                         exchange_slack))
+                                         exchange_slack,
+                                         exchange_layout))
         ft_stats = ft_stats + jnp.stack(gstats)
 
       ef = {}
@@ -601,7 +609,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
         (ef[et],), gstats = dist_gather_multi(
             (efshards[et],), ebounds[et], all_eids, axis, num_parts,
             exchange_capacity=_slack_cap(all_eids.shape[0], num_parts,
-                                         exchange_slack),
+                                         exchange_slack,
+                                         exchange_layout),
             shard_mode=ef_modes[et])
         ft_stats = ft_stats + jnp.stack(gstats)
 
@@ -907,7 +916,9 @@ class DistHeteroNeighborLoader(PrefetchingLoader):
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto', prefetch: int = 0):
+               exchange_slack='auto',
+               exchange_layout: Optional[str] = None,
+               prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     from .dist_sampler import DEFAULT_EXCHANGE_SLACK, AdaptiveSlack
     self.prefetch = int(prefetch)
@@ -918,7 +929,8 @@ class DistHeteroNeighborLoader(PrefetchingLoader):
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
-                        else slack))
+                        else slack),
+        exchange_layout=exchange_layout)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0
@@ -978,7 +990,9 @@ class DistHeteroLinkNeighborLoader(PrefetchingLoader):
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto', prefetch: int = 0):
+               exchange_slack='auto',
+               exchange_layout: Optional[str] = None,
+               prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     from ..sampler.base import NegativeSampling
     self.prefetch = int(prefetch)
@@ -996,7 +1010,8 @@ class DistHeteroLinkNeighborLoader(PrefetchingLoader):
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
-                        else slack))
+                        else slack),
+        exchange_layout=exchange_layout)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     rows, cols, colsarr = pack_link_seeds(

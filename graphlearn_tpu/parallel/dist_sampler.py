@@ -40,6 +40,8 @@ from ..ops.neighbor import sample_one_hop
 from ..ops.unique import init_node, induce_next
 from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
 from .dist_data import DistDataset
+from .exchange import (MIN_EXCHANGE_CAP, capacity_spec, plan_exchange,
+                       resolve_layout)
 
 #: default per-destination exchange capacity, as a multiple of the
 #: balanced share (frontier / P).  2.0 tolerates 2x ownership skew
@@ -61,7 +63,9 @@ EXCHANGE_STAT_NAMES = (
 
 
 def _exchange_stats(ids, slot_j, num_parts: int, cap: int):
-  """(offered, dropped, slots) triple for one bucketed exchange."""
+  """(offered, dropped, slots) triple for one bucketed exchange —
+  kept for direct `bucket_by_owner` users (the plan layouts in
+  `parallel.exchange` carry their own triple)."""
   valid = ids >= 0
   offered = jnp.sum(valid.astype(jnp.int32))
   dropped = jnp.sum((valid & (slot_j < 0)).astype(jnp.int32))
@@ -148,26 +152,17 @@ def dist_edge_exists(indptr_loc, indices_loc, bounds, rows, cols,
   """
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
-  owner = (jnp.searchsorted(bounds, rows, side='right') - 1).astype(
-      jnp.int32)
-  send_r, send_c, slot_p, slot_j = bucket_with_payload(
-      rows, cols, owner, num_parts, my_idx, exchange_capacity)
-  # one fused [P, 2C] exchange for both halves of the pair (these
-  # buffers are small and latency-bound on ICI)
-  recv = jax.lax.all_to_all(
-      jnp.concatenate([send_r, send_c], axis=1), axis, 0, 0, tiled=True)
-  c = send_r.shape[1]
-  recv_r, recv_c = recv[:, :c], recv[:, c:]
-  flat_r = recv_r.reshape(-1)
+  owner_fn = lambda v: (jnp.searchsorted(bounds, v, side='right')
+                        - 1).astype(jnp.int32)
+  plan = plan_exchange(rows, owner_fn, num_parts, axis,
+                       exchange_capacity, payload=cols)
+  flat_r = plan.recv
   local_r = jnp.where(flat_r >= 0, flat_r - my_start,
                       INVALID_ID).astype(jnp.int32)
   ex = edge_in_csr(indptr_loc, indices_loc, local_r,
-                   recv_c.reshape(-1).astype(jnp.int32))
-  reply = jax.lax.all_to_all(ex.reshape(num_parts, -1), axis, 0, 0,
-                             tiled=True)
-  kept = slot_j >= 0
-  out = reply[slot_p, jnp.where(kept, slot_j, 0)]
-  return jnp.where(kept, out, True)
+                   plan.recv_payload.astype(jnp.int32))
+  # undelivered pairs fill True ("exists", so never a strict negative)
+  return plan.reply(ex, fill=True)
 
 
 NEG_TRIALS = 5     # redraw attempts per strict-negative slot
@@ -220,33 +215,20 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   """
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
-  owner = (jnp.searchsorted(bounds, frontier, side='right') - 1).astype(
-      jnp.int32)
-  send, slot_p, slot_j = bucket_by_owner(frontier, owner, num_parts,
-                                         my_idx, exchange_capacity)
-  c = send.shape[1]
-  stats = _exchange_stats(frontier, slot_j, num_parts, c)
-  recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)     # [P, C]
-  flat = recv.reshape(-1)
+  owner_fn = lambda v: (jnp.searchsorted(bounds, v, side='right')
+                        - 1).astype(jnp.int32)
+  plan = plan_exchange(frontier, owner_fn, num_parts, axis,
+                       exchange_capacity)
+  flat = plan.recv
   local = jnp.where(flat >= 0, flat - my_start, INVALID_ID).astype(jnp.int32)
   res = sample_one_hop(indptr_loc, indices_loc, local, k,
                        jax.random.fold_in(key, my_idx), eids_loc,
                        with_edge_ids=with_edge,
                        sort_locality=sort_locality)
-  kept = slot_j >= 0
-  sj = jnp.where(kept, slot_j, 0)
-  nbrs = jax.lax.all_to_all(res.nbrs.reshape(num_parts, c, k),
-                            axis, 0, 0, tiled=True)
-  mask = jax.lax.all_to_all(res.mask.reshape(num_parts, c, k),
-                            axis, 0, 0, tiled=True)
-  out_nbrs = jnp.where(kept[:, None], nbrs[slot_p, sj], INVALID_ID)
-  out_mask = mask[slot_p, sj] & kept[:, None]
-  out_eids = None
-  if with_edge:
-    eids = jax.lax.all_to_all(res.eids.reshape(num_parts, c, k),
-                              axis, 0, 0, tiled=True)
-    out_eids = jnp.where(kept[:, None], eids[slot_p, sj], INVALID_ID)
-  return out_nbrs, out_mask, out_eids, stats
+  out_nbrs = plan.reply(res.nbrs, fill=INVALID_ID)
+  out_mask = plan.reply(res.mask, fill=False)
+  out_eids = plan.reply(res.eids, fill=INVALID_ID) if with_edge else None
+  return out_nbrs, out_mask, out_eids, plan.stats
 
 
 def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
@@ -274,25 +256,20 @@ def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
   """
   my_idx = jax.lax.axis_index(axis)
   if shard_mode == 'mod':
-    owner = (ids % num_parts).astype(jnp.int32)
+    owner_fn = lambda v: (v % num_parts).astype(jnp.int32)
   else:
     my_start = bounds[my_idx]
-    owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(
-        jnp.int32)
-  send, slot_p, slot_j = bucket_by_owner(ids, owner, num_parts, my_idx,
-                                         exchange_capacity)
-  cw = send.shape[1]
-  stats = _exchange_stats(ids, slot_j, num_parts, cw)
-  recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)
-  flat = recv.reshape(-1)
+    owner_fn = lambda v: (jnp.searchsorted(bounds, v, side='right')
+                          - 1).astype(jnp.int32)
+  plan = plan_exchange(ids, owner_fn, num_parts, axis,
+                       exchange_capacity)
+  flat = plan.recv
   valid = flat >= 0
   if shard_mode == 'mod':
     local = jnp.where(valid, flat // num_parts, 0)
   else:
     local = jnp.where(valid, flat - my_start, 0)
-  kept = slot_j >= 0
-  sj = jnp.where(kept, slot_j, 0)
-  ok = (ids >= 0) & kept
+  ok = (ids >= 0) & plan.delivered
   outs = []
   for t, shard_loc in enumerate(shard_locs):
     row_valid = valid
@@ -304,15 +281,12 @@ def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
       rows = jnp.where(row_valid, rows, 0)
     else:
       rows = jnp.where(row_valid[:, None], rows, 0)
-    reply = jax.lax.all_to_all(
-        rows.reshape((num_parts, cw) + rows.shape[1:]), axis, 0, 0,
-        tiled=True)
-    out = reply[slot_p, sj]
+    out = plan.reply(rows, fill=0)
     if out.ndim == 1:
       outs.append(jnp.where(ok, out, 0))
     else:
       outs.append(jnp.where(ok[:, None], out, 0))
-  return tuple(outs), stats
+  return tuple(outs), plan.stats
 
 
 def dist_gather(shard_loc, bounds, ids, axis: str, num_parts: int):
@@ -370,8 +344,17 @@ def resolve_exchange_slack(exchange_slack, shuffle: bool):
 #: `AdaptiveSlack` ladder, tightest first.  2.0 is the static default;
 #: the controller walks DOWN when an epoch ends drop-free (less
 #: padding = smaller exchanges) and UP on drops, pinning after the
-#: first reversal so it never oscillates.
-SLACK_LADDER = (1.25, 1.5, 2.0, 3.0, None)
+#: first reversal so it never oscillates.  The sub-1.25 rungs only
+#: bite under the compact/hier layouts (the dense layout's
+#: `MIN_EXCHANGE_CAP` floor dominates their caps) — they are what
+#: lets the ladder keep reclaiming padding on drop-free workloads
+#: instead of pinning at 1.25 with 80%+ waste (the r5 envelope).
+SLACK_LADDER = (0.75, 1.0, 1.25, 1.5, 2.0, 3.0, None)
+
+#: tightest rung the ladder may reach by default (override per
+#: controller or via ``GLT_SLACK_FLOOR``): the last step to 0.75
+#: undercuts the BALANCED share and is opt-in.
+DEFAULT_SLACK_FLOOR = 1.0
 
 #: per-epoch frontier drop-rate above which the controller widens.
 ADAPTIVE_DROP_TOLERANCE = 1e-3
@@ -390,13 +373,41 @@ class AdaptiveSlack:
   widen reversal PINS the setting (no oscillation).  Each change
   clears the sampler's step cache (one recompile, amortized over the
   remaining epochs).
+
+  One slack value drives EVERY capacity knob of the selected exchange
+  layout (`parallel.exchange.capacity_spec`): the dense per-
+  destination cap, the compacted base width (its global overflow
+  budget scales with the request width), and both hierarchical stage
+  capacities — so the ladder tunes the new layouts with the same
+  telemetry loop that tuned the dense cap.
+
+  Args:
+    floor: tightest slack the ladder may reach (default
+      `DEFAULT_SLACK_FLOOR`, env ``GLT_SLACK_FLOOR``).  A drop-free
+      epoch at the floor PINS there (``pin_reason='floor'``) — the
+      controller is done, not stuck.
   """
 
   def __init__(self, sampler: 'DistNeighborSampler',
-               start: float = DEFAULT_EXCHANGE_SLACK):
+               start: float = DEFAULT_EXCHANGE_SLACK,
+               floor: Optional[float] = None):
+    import os
     self.sampler = sampler
+    if floor is None:
+      try:
+        floor = float(os.environ.get('GLT_SLACK_FLOOR',
+                                     DEFAULT_SLACK_FLOOR))
+      except ValueError:
+        floor = DEFAULT_SLACK_FLOOR
+    finite = [s for s in SLACK_LADDER if s is not None]
+    self._min_idx = min(
+        (i for i, s in enumerate(SLACK_LADDER)
+         if s is not None and s >= floor - 1e-9),
+        default=len(finite) - 1)
+    self.floor = SLACK_LADDER[self._min_idx]
     self._idx = SLACK_LADDER.index(start)
     self._pinned = False
+    self._pin_reason = ''
     self._tightened_from = None
     self._last = {}
     sampler.exchange_slack = SLACK_LADDER[self._idx]
@@ -406,7 +417,7 @@ class AdaptiveSlack:
     return SLACK_LADDER[self._idx]
 
   def _set(self, idx: int, reason: str = '',
-           drop_rate: float = 0.0) -> None:
+           drop_rate: float = 0.0, pin_reason: str = '') -> None:
     if idx == self._idx:
       return
     from ..telemetry.recorder import recorder
@@ -418,7 +429,15 @@ class AdaptiveSlack:
     metrics.inc('dist.slack.transitions')
     recorder.emit('slack.transition', from_slack=frm,
                   to_slack=SLACK_LADDER[idx], reason=reason,
-                  drop_rate=round(float(drop_rate), 6))
+                  drop_rate=round(float(drop_rate), 6),
+                  pin_reason=pin_reason)
+
+  def _pin(self, reason: str, rate: float) -> None:
+    self._pinned = True
+    self._pin_reason = reason
+    from ..telemetry.recorder import recorder
+    recorder.emit('slack.pinned', slack=SLACK_LADDER[self._idx],
+                  drop_rate=round(float(rate), 6), pin_reason=reason)
 
   #: ALL loss channels the shared slack caps gate — a clean frontier
   #: with skewed feature buckets must still read as "dropping"
@@ -434,43 +453,59 @@ class AdaptiveSlack:
     offered = sum(st[k] - self._last.get(k, 0) for k in self.OFFER_KEYS)
     dropped = sum(st[k] - self._last.get(k, 0) for k in self.DROP_KEYS)
     self._last = {k: st[k] for k in self.OFFER_KEYS + self.DROP_KEYS}
-    if self._pinned or offered <= 0:
+    if offered <= 0:
       return
     rate = dropped / offered
-    if rate > ADAPTIVE_DROP_TOLERANCE:
+    # the hierarchical layout counts each id ONCE PER WIRE STAGE in
+    # 'offered' (the per-wire fill contract), so its drop ratio reads
+    # up to 2x low — compensate so the widen trigger fires at the
+    # same per-id loss as the single-stage layouts
+    tol = ADAPTIVE_DROP_TOLERANCE
+    if resolve_layout(getattr(self.sampler, 'exchange_layout', None),
+                      getattr(self.sampler, 'num_parts', 1)) == 'hier':
+      tol = ADAPTIVE_DROP_TOLERANCE / 2
+    if self._pinned and (self._pin_reason != 'floor'
+                         or rate <= tol):
+      # a reversal pin is final; a FLOOR pin only stops tightening —
+      # drops at the floor must still get their capacity back
+      return
+    if rate > tol:
       # widen; if this reverses our own tighten, pin there
       wider = min(self._idx + 1, len(SLACK_LADDER) - 1)
-      self._set(wider, reason='drops', drop_rate=rate)
-      if self._tightened_from is not None and \
-          wider >= self._tightened_from:
-        self._pinned = True
-        from ..telemetry.recorder import recorder
-        recorder.emit('slack.pinned', slack=SLACK_LADDER[self._idx],
-                      drop_rate=round(float(rate), 6))
-    elif self._idx > 0:
+      pin = (self._tightened_from is not None
+             and wider >= self._tightened_from)
+      self._set(wider, reason='drops', drop_rate=rate,
+                pin_reason='reversal' if pin else '')
+      if pin:
+        self._pin('reversal', rate)
+      else:
+        self._pinned = False        # left the floor; resume tuning
+    elif self._idx > self._min_idx:
       self._tightened_from = self._idx
       self._set(self._idx - 1, reason='drop_free', drop_rate=rate)
-
-
-#: per-destination capacity floor: exchanges this small gain nothing
-#: from capping (the buffer is a few KB) but would drop ids on ANY
-#: ownership skew, so they stay exact.
-MIN_EXCHANGE_CAP = 64
+    elif not self._pinned:
+      # drop-free AT the floor: the ladder is done tightening — pin
+      # and say why, so 'slack_final == floor' is readable as
+      # converged rather than stuck (the r5 envelope ambiguity)
+      self._pin('floor', rate)
 
 
 def _slack_cap(n: int, num_parts: int,
-               exchange_slack: Optional[float]) -> Optional[int]:
-  if exchange_slack is None:
-    return None
-  cap = max(int(np.ceil(n / num_parts * exchange_slack)),
-            MIN_EXCHANGE_CAP)
-  return int(round_up(min(n, cap), 8))
+               exchange_slack: Optional[float],
+               exchange_layout: Optional[str] = None):
+  """Capacity plan for one ``n``-id exchange: None = exact, else an
+  `exchange.ExchangeSpec` under the sampler's layout (the dense spec
+  reproduces the original ``max(ceil(n/P * slack), MIN_EXCHANGE_CAP)``
+  rounded cap bit-for-bit)."""
+  return capacity_spec(n, num_parts, exchange_slack,
+                       layout=exchange_layout)
 
 
 def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
                         fanouts, node_cap, with_edge, collect_features,
                         collect_labels, with_cache, fshard, lshard,
                         cids, crows, axis, num_parts, exchange_slack,
+                        exchange_layout=None,
                         collect_edge_features=False, efshard=None,
                         ebounds=None, ef_shard_mode='mod',
                         hot_counts=None):
@@ -499,7 +534,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
         indptr, indices, eids, bounds, frontier, int(k), hop_key,
         axis, num_parts, with_edge,
         exchange_capacity=_slack_cap(frontier.shape[0], num_parts,
-                                     exchange_slack))
+                                     exchange_slack, exchange_layout))
     fr_stats = fr_stats + jnp.stack(hstats)
     state, rows, cols, prev_cnt = induce_next(
         state, frontier_local, nbrs, mask)
@@ -524,7 +559,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
     (ef,), estats = dist_gather_multi(
         (efshard,), ebounds, edge, axis, num_parts,
         exchange_capacity=_slack_cap(edge.shape[0], num_parts,
-                                     exchange_slack),
+                                     exchange_slack, exchange_layout),
         shard_mode=ef_shard_mode)
     ft_stats = ft_stats + jnp.stack(estats)
   tables = (((fshard,) if collect_features else ())
@@ -533,7 +568,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
     got, gstats = dist_gather_multi(
         tables, bounds, state.nodes, axis, num_parts,
         exchange_capacity=_slack_cap(node_cap, num_parts,
-                                     exchange_slack),
+                                     exchange_slack, exchange_layout),
         hot_counts=hot_counts if collect_features else None)
     got = list(got)
     ft_stats = ft_stats + jnp.stack(gstats)
@@ -557,6 +592,7 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     collect_labels: bool, axis: str = 'data',
                     with_cache: bool = False,
                     exchange_slack: Optional[float] = None,
+                    exchange_layout: Optional[str] = None,
                     collect_edge_features: bool = False,
                     ef_shard_mode: str = 'mod', tiered: bool = False):
   """Build the jitted SPMD sample(+collect) step.
@@ -584,6 +620,7 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
         cids=cids_s[0] if with_cache else None,
         crows=crows_s[0] if with_cache else None,
         axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
+        exchange_layout=exchange_layout,
         collect_edge_features=collect_edge_features,
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
@@ -620,6 +657,7 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                          collect_labels: bool, axis: str = 'data',
                          with_cache: bool = False,
                          exchange_slack: Optional[float] = None,
+                         exchange_layout: Optional[str] = None,
                          collect_edge_features: bool = False,
                          ef_shard_mode: str = 'mod',
                          tiered: bool = False):
@@ -644,7 +682,7 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
     my_idx = jax.lax.axis_index(axis)
     neg_key = jax.random.fold_in(jax.random.fold_in(key, my_idx), 977)
     cap = _slack_cap(num_neg * NEG_TRIALS, num_parts,
-                     exchange_slack)
+                     exchange_slack, exchange_layout)
     neg_ok = None
     if neg_mode == 'binary':
       nrows, ncols, neg_ok = dist_sample_negative(
@@ -675,6 +713,7 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
         cids=cids_s[0] if with_cache else None,
         crows=crows_s[0] if with_cache else None,
         axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
+        exchange_layout=exchange_layout,
         collect_edge_features=collect_edge_features,
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
@@ -745,6 +784,7 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
                              axis: str = 'data',
                              with_cache: bool = False,
                              exchange_slack: Optional[float] = None,
+                             exchange_layout: Optional[str] = None,
                              tiered: bool = False,
                              hop_chunk: Optional[int] = None):
   """Build the jitted SPMD INDUCED-SUBGRAPH step — the device-mesh
@@ -790,6 +830,7 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
         cids=cids_s[0] if with_cache else None,
         crows=crows_s[0] if with_cache else None,
         axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
+        exchange_layout=exchange_layout,
         hot_counts=hcounts if tiered else None)
 
     nodes = state.nodes                              # [node_cap]
@@ -808,7 +849,8 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
           jax.random.fold_in(key, ci), axis, num_parts,
           with_edge,
           exchange_capacity=_slack_cap(chunk, num_parts,
-                                       exchange_slack))
+                                       exchange_slack,
+                                       exchange_layout))
       stats = stats.at[:3].add(jnp.stack(hstats))
       nbrs_parts.append(nb)
       mask_parts.append(mk)
@@ -1015,7 +1057,8 @@ class DistNeighborSampler(ExchangeTelemetry):
   def __init__(self, dataset: DistDataset, num_neighbors,
                mesh: Optional[Mesh] = None, axis: str = 'data',
                with_edge: bool = False, collect_features: bool = True,
-               seed: int = 0, exchange_slack: Optional[float] = None):
+               seed: int = 0, exchange_slack: Optional[float] = None,
+               exchange_layout: Optional[str] = None):
     from .dp import make_mesh
     self.ds = dataset
     self.fanouts = tuple(int(k) for k in num_neighbors)
@@ -1048,6 +1091,11 @@ class DistNeighborSampler(ExchangeTelemetry):
     # None = exact; the loaders resolve 'auto' to
     # DEFAULT_EXCHANGE_SLACK when shuffling, exact otherwise.
     self.exchange_slack = exchange_slack
+    # exchange LAYOUT (parallel.exchange): None/'auto' keeps dense on
+    # small meshes and compacts at P >= 16; 'dense'/'compact'/'hier'/
+    # 'ragged' select explicitly (env GLT_EXCHANGE_LAYOUT overrides
+    # 'auto' only).  Exact exchanges (slack None) always run dense.
+    self.exchange_layout = exchange_layout or 'auto'
     self._base_key = jax.random.key(seed)
     self._step_cnt = 0
     self._steps = {}
@@ -1123,15 +1171,28 @@ class DistNeighborSampler(ExchangeTelemetry):
     hcounts, key)`` — also the scan body of `FusedDistEpoch`."""
     cfg = (int(batch_size),)
     if cfg not in self._steps:
-      self._steps[cfg] = _make_dist_step(
-          self.mesh, self.num_parts, self.fanouts,
-          self.node_capacity(int(batch_size)),
-          self.with_edge, self.collect_features, self.collect_labels,
-          self.axis, with_cache=self.with_cache,
-          exchange_slack=self.exchange_slack,
-          collect_edge_features=self.collect_edge_features,
-          ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
+      with self._layout_span(batch=int(batch_size)):
+        self._steps[cfg] = _make_dist_step(
+            self.mesh, self.num_parts, self.fanouts,
+            self.node_capacity(int(batch_size)),
+            self.with_edge, self.collect_features, self.collect_labels,
+            self.axis, with_cache=self.with_cache,
+            exchange_slack=self.exchange_slack,
+            exchange_layout=self.exchange_layout,
+            collect_edge_features=self.collect_edge_features,
+            ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
     return self._steps[cfg]
+
+  def _layout_span(self, **fields):
+    """Build-time `exchange.layout` span around step construction: the
+    resolved layout + slack land in the flight recorder once per
+    compiled program (the runtime path stays span-free)."""
+    from ..telemetry.spans import span
+    return span('exchange.layout',
+                layout=resolve_layout(self.exchange_layout,
+                                      self.num_parts),
+                num_parts=self.num_parts,
+                slack=self.exchange_slack, **fields)
 
   def sample_from_nodes(self, seeds_stacked: np.ndarray):
     """``seeds_stacked``: ``[P, B]`` per-device seed batches (relabeled
@@ -1424,7 +1485,8 @@ def overlay_cold_owner(x, nodes, bounds, hot_counts, cold_local, mesh,
 
 def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
                          axis: str = 'data',
-                         exchange_slack: Optional[float] = None):
+                         exchange_slack: Optional[float] = None,
+                         exchange_layout: Optional[str] = None):
   """Jitted SPMD uniform random walk over the sharded CSR: each step
   is one `_dist_one_hop` with fanout 1 (a uniform neighbor draw
   through the owner exchange) — the distributed arm of
@@ -1441,7 +1503,8 @@ def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
           indptr_s[0], indices_s[0], None, bounds, cur, 1,
           jax.random.fold_in(key, h), axis, num_parts, False,
           exchange_capacity=_slack_cap(cur.shape[0], num_parts,
-                                       exchange_slack))
+                                       exchange_slack,
+                                       exchange_layout))
       stats = stats + jnp.stack(hstats)
       cur = jnp.where(mask[:, 0], nbrs[:, 0], INVALID_ID).astype(
           jnp.int32)
@@ -1517,13 +1580,15 @@ class DistSubGraphSampler(DistNeighborSampler):
     node_cap = self.node_capacity(b)
     cfg = ('subgraph', b)
     if cfg not in self._steps:
-      self._steps[cfg] = _make_dist_subgraph_step(
-          self.mesh, self.num_parts, self.fanouts, node_cap,
-          self.max_degree, self.with_edge, self.collect_features,
-          self.collect_labels, self.axis, with_cache=self.with_cache,
-          exchange_slack=self.exchange_slack, tiered=self.tiered,
-          hop_chunk=resolve_hop_chunk(self.hop_chunk, node_cap,
-                                      self.max_degree))
+      with self._layout_span(batch=b, mode='subgraph'):
+        self._steps[cfg] = _make_dist_subgraph_step(
+            self.mesh, self.num_parts, self.fanouts, node_cap,
+            self.max_degree, self.with_edge, self.collect_features,
+            self.collect_labels, self.axis, with_cache=self.with_cache,
+            exchange_slack=self.exchange_slack,
+            exchange_layout=self.exchange_layout, tiered=self.tiered,
+            hop_chunk=resolve_hop_chunk(self.hop_chunk, node_cap,
+                                        self.max_degree))
     from ..telemetry.spans import span
     arrs = self._arrays()
     self._step_cnt += 1
@@ -1584,9 +1649,10 @@ class DistRandomWalker(DistNeighborSampler):
     b = starts_stacked.shape[1]
     cfg = ('walk', b)
     if cfg not in self._steps:
-      self._steps[cfg] = _make_dist_walk_step(
-          self.mesh, self.num_parts, self.walk_length, self.axis,
-          self.exchange_slack)
+      with self._layout_span(batch=b, mode='walk'):
+        self._steps[cfg] = _make_dist_walk_step(
+            self.mesh, self.num_parts, self.walk_length, self.axis,
+            self.exchange_slack, self.exchange_layout)
     arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -1614,6 +1680,7 @@ class DistSubGraphLoader(PrefetchingLoader):
                with_edge: bool = False, collect_features: bool = True,
                max_degree: Optional[int] = None, seed: int = 0,
                input_space: str = 'old', exchange_slack='auto',
+               exchange_layout: Optional[str] = None,
                hop_chunk='auto', prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
@@ -1637,6 +1704,7 @@ class DistSubGraphLoader(PrefetchingLoader):
         with_edge=with_edge, collect_features=collect_features,
         seed=seed,
         exchange_slack=resolve_exchange_slack(exchange_slack, shuffle),
+        exchange_layout=exchange_layout,
         hop_chunk=hop_chunk)
     self.ds = dataset
     seeds = np.asarray(input_nodes).reshape(-1)
@@ -1686,7 +1754,9 @@ class DistNeighborLoader(PrefetchingLoader):
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto', prefetch: int = 0):
+               exchange_slack='auto',
+               exchange_layout: Optional[str] = None,
+               prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
     slack = resolve_exchange_slack(exchange_slack, shuffle)
@@ -1694,7 +1764,8 @@ class DistNeighborLoader(PrefetchingLoader):
         dataset, num_neighbors, mesh=mesh, with_edge=with_edge,
         collect_features=collect_features, seed=seed,
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
-                        else slack))
+                        else slack),
+        exchange_layout=exchange_layout)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0
@@ -1860,16 +1931,18 @@ class DistLinkNeighborSampler(DistNeighborSampler):
     exp_seeds, num_neg = self._expansion_seeds(b)
     cfg = ('link', b, int(width))
     if cfg not in self._steps:
-      self._steps[cfg] = _make_dist_link_step(
-          self.mesh, self.num_parts, self.fanouts,
-          self.node_capacity(exp_seeds), b,
-          self.ds.graph.num_nodes, self.neg_mode, num_neg,
-          self.neg_amount,
-          self.with_edge, self.collect_features, self.collect_labels,
-          self.axis, with_cache=self.with_cache,
-          exchange_slack=self.exchange_slack,
-          collect_edge_features=self.collect_edge_features,
-          ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
+      with self._layout_span(batch=b, mode='link'):
+        self._steps[cfg] = _make_dist_link_step(
+            self.mesh, self.num_parts, self.fanouts,
+            self.node_capacity(exp_seeds), b,
+            self.ds.graph.num_nodes, self.neg_mode, num_neg,
+            self.neg_amount,
+            self.with_edge, self.collect_features, self.collect_labels,
+            self.axis, with_cache=self.with_cache,
+            exchange_slack=self.exchange_slack,
+            exchange_layout=self.exchange_layout,
+            collect_edge_features=self.collect_edge_features,
+            ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
     return self._steps[cfg]
 
   def sample_from_edges(self, pairs_stacked: np.ndarray):
@@ -1923,7 +1996,9 @@ class DistLinkNeighborLoader(PrefetchingLoader):
                drop_last: bool = False, mesh: Optional[Mesh] = None,
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto', prefetch: int = 0):
+               exchange_slack='auto',
+               exchange_layout: Optional[str] = None,
+               prefetch: int = 0):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
     slack = resolve_exchange_slack(exchange_slack, shuffle)
@@ -1932,7 +2007,8 @@ class DistLinkNeighborLoader(PrefetchingLoader):
         with_edge=with_edge, collect_features=collect_features,
         seed=seed,
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
-                        else slack))
+                        else slack),
+        exchange_layout=exchange_layout)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0

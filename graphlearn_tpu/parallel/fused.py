@@ -181,7 +181,8 @@ class FusedDistEpoch(_MeshEpochDriver):
                axis: str = 'data', shuffle: bool = True,
                drop_last: bool = False, seed: int = 0,
                input_space: str = 'old',
-               exchange_slack='auto', remat: bool = False,
+               exchange_slack='auto', exchange_layout=None,
+               remat: bool = False,
                fast_compile: bool = False):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None or dataset.node_labels is None:
@@ -200,7 +201,8 @@ class FusedDistEpoch(_MeshEpochDriver):
     slack = resolve_exchange_slack(exchange_slack, shuffle)
     self.sampler = DistNeighborSampler(
         dataset, num_neighbors, mesh=mesh, axis=axis,
-        collect_features=True, seed=seed, exchange_slack=slack)
+        collect_features=True, seed=seed, exchange_slack=slack,
+        exchange_layout=exchange_layout)
     self.ds = dataset
     self.mesh = self.sampler.mesh
     self.axis = axis
@@ -338,6 +340,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
                axis: str = 'data', shuffle: bool = True,
                drop_last: bool = False, seed: int = 0,
                input_space: str = 'old', exchange_slack='auto',
+               exchange_layout=None,
                remat: bool = False, fast_compile: bool = False):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None or dataset.node_labels is None:
@@ -362,7 +365,8 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     self.sampler = DistNeighborSampler(
         dataset, [], mesh=mesh, axis=axis, collect_features=True,
         seed=seed,
-        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle))
+        exchange_slack=resolve_exchange_slack(exchange_slack, shuffle),
+        exchange_layout=exchange_layout)
     self.ds = dataset
     self.model = model
     self.tx = tx
@@ -414,6 +418,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     from .dist_sampler import (_dist_one_hop, _slack_cap,
                                dist_gather_multi)
     slack = self.sampler.exchange_slack
+    layout = self.sampler.exchange_layout
     levels, frontier = [seeds], seeds
     fstats = jnp.zeros((3,), jnp.int32)
     for h, k in enumerate(self.fanouts):
@@ -422,7 +427,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
           jax.random.fold_in(key, h), self.axis, self.num_parts,
           False, sort_locality=False,
           exchange_capacity=_slack_cap(frontier.shape[0],
-                                       self.num_parts, slack))
+                                       self.num_parts, slack, layout))
       fstats = fstats + jnp.stack(st)
       nxt = jnp.where(mask, nbrs, -1).reshape(-1)
       levels.append(nxt)
@@ -432,7 +437,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         (fshards_s, lshards_s), bounds, all_ids, self.axis,
         self.num_parts,
         exchange_capacity=_slack_cap(all_ids.shape[0], self.num_parts,
-                                     slack))
+                                     slack, layout))
     sizes = [lvl.shape[0] for lvl in levels]
     xs, off = [], 0
     for s in sizes:
@@ -570,7 +575,8 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
                mesh: Optional[Mesh] = None, axis: str = 'data',
                shuffle: bool = True, drop_last: bool = False,
                seed: int = 0, input_space: str = 'old',
-               exchange_slack='auto', remat: bool = False,
+               exchange_slack='auto', exchange_layout=None,
+               remat: bool = False,
                fast_compile: bool = False):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None:
@@ -588,7 +594,7 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     self.sampler = DistLinkNeighborSampler(
         dataset, num_neighbors, neg_sampling=neg_sampling, mesh=mesh,
         axis=axis, collect_features=True, seed=seed,
-        exchange_slack=slack)
+        exchange_slack=slack, exchange_layout=exchange_layout)
     self.ds = dataset
     self.mesh = self.sampler.mesh
     self.axis = axis

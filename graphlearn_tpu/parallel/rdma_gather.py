@@ -37,6 +37,35 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .dist_sampler import bucket_by_owner
+from .exchange import ExchangeSpec, MIN_EXCHANGE_CAP
+
+
+def _dense_request_cap(exchange_capacity, num_parts: int):
+  """Normalize an ``exchange_capacity`` (legacy int, None, or an
+  `exchange.ExchangeSpec`) to the per-destination width of the DENSE
+  request grid this kernel requires: every (owner, slot) pair maps to
+  exactly one remote-DMA descriptor, so the request layout cannot be
+  compacted or staged.
+
+  A COMPACT spec flattens to ``base + pool`` per destination: the
+  plan admits at most that many ids of any one owner (per-owner base
+  prefix plus whatever the shared pool took, which is itself a
+  prefix of the owner's overflow), so the dense grid keeps a strict
+  superset of the ids the XLA compact path delivers.  A HIER spec
+  has no cheap per-destination superset (its caps bound COLUMNS and
+  ROWS, not owners) — it maps to a slots-equivalent dense cap, which
+  can drop under skew the staged path absorbed; acceptable for this
+  prototype, noted here so a real-slice integration revisits it."""
+  if exchange_capacity is None or isinstance(exchange_capacity, int):
+    return exchange_capacity
+  if isinstance(exchange_capacity, ExchangeSpec):
+    if exchange_capacity.layout == 'dense':
+      return exchange_capacity.capacity
+    if exchange_capacity.layout in ('compact', 'ragged'):
+      return exchange_capacity.capacity + exchange_capacity.pool
+    return max(MIN_EXCHANGE_CAP,
+               -(-exchange_capacity.slots // num_parts))
+  return int(exchange_capacity)
 
 
 def _push_rows_kernel(num_parts: int, axis: str):
@@ -94,8 +123,9 @@ def rdma_gather(shard_loc, bounds, ids, axis: str, num_parts: int,
   my_start = bounds[my_idx]
   owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(
       jnp.int32)
-  send, slot_p, slot_j = bucket_by_owner(ids, owner, num_parts, my_idx,
-                                         exchange_capacity)
+  send, slot_p, slot_j = bucket_by_owner(
+      ids, owner, num_parts, my_idx,
+      _dense_request_cap(exchange_capacity, num_parts))
   c = send.shape[1]
   recv_ids = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)  # [P, C]
   d = shard_loc.shape[1]

@@ -44,6 +44,14 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     ('train_step_mfu', 'higher'),
     ('dist.seeds_per_sec', 'higher'),
     ('dist.edges_per_sec_per_chip', 'higher'),
+    # exchange-efficiency guard (ISSUE 3): the P=16 / P=64 rows of the
+    # dist scale envelope — a PR that regresses padding waste or
+    # throughput at scale fails the gate.  A 'pNN' path segment
+    # selects the envelope row with num_parts == NN.
+    ('dist.scale_envelope.p16.padding_waste_pct', 'lower'),
+    ('dist.scale_envelope.p16.seeds_per_sec', 'higher'),
+    ('dist.scale_envelope.p64.padding_waste_pct', 'lower'),
+    ('dist.scale_envelope.p64.seeds_per_sec', 'higher'),
 )
 
 
@@ -61,6 +69,15 @@ def threshold_from_env(default: float = DEFAULT_THRESHOLD) -> float:
 def _get(obj: Dict, dotted: str):
   cur = obj
   for part in dotted.split('.'):
+    if isinstance(cur, list):
+      # 'pNN' selects the list element whose num_parts == NN (the
+      # scale-envelope row addressing used by the exchange guard)
+      if not (part.startswith('p') and part[1:].isdigit()):
+        return None
+      want = int(part[1:])
+      cur = next((r for r in cur if isinstance(r, dict)
+                  and r.get('num_parts') == want), None)
+      continue
     if not isinstance(cur, dict):
       return None
     cur = cur.get(part)

@@ -23,10 +23,17 @@ EVENT_KINDS: Dict[str, str] = {
     'channel.stall':
         'ChannelTelemetry._timed: op, secs, occupancy, channel',
     'slack.transition':
-        'AdaptiveSlack: from_slack, to_slack, reason, drop_rate',
+        'AdaptiveSlack: from_slack, to_slack, reason, drop_rate, '
+        "pin_reason ('reversal' when this widen pins the ladder, "
+        "else '')",
     'slack.pinned':
-        'AdaptiveSlack: slack, drop_rate (ladder pinned, no more '
-        'retuning)',
+        'AdaptiveSlack: slack, drop_rate, pin_reason (why retuning '
+        "stopped: 'reversal' = tighten->widen oscillation guard, "
+        "'floor' = drop-free at the configured ladder floor)",
+    'padding.truncate':
+        'utils.padding.pad_1d: requested, size, dropped — a host-side '
+        'pad silently cut non-fill entries (capacity bug surfacing; '
+        'GLT_STRICT_PADDING=1 raises instead)',
     'dist.exchange':
         'ExchangeTelemetry drains: since-last-drain deltas of '
         'offered/dropped/slots per loss channel',
@@ -74,6 +81,14 @@ SPAN_NAMES: Dict[str, str] = {
         'fused epoch drivers: one chunk/program dispatch',
     'fused.init_state':
         'FusedTreeEpoch.init_state: param init from the dummy batch',
+    'exchange.layout':
+        'mesh samplers, build time: one span per compiled SPMD step '
+        'with the resolved exchange layout (dense/compact/hier/'
+        'ragged), num_parts and slack',
+    'exchange.stage':
+        'parallel.exchange.capacity_spec, build time: hierarchical '
+        'stage capacities (rows, cols, stage1_cap, stage2_cap) for '
+        'one planned exchange',
 }
 
 

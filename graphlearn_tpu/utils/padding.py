@@ -25,9 +25,31 @@ def next_power_of_two(x: int) -> int:
   return 1 << (int(x) - 1).bit_length()
 
 
-def pad_1d(arr: np.ndarray, size: int, fill=INVALID_ID) -> np.ndarray:
-  """Pad (or truncate) a host 1-D array to a static size."""
+def pad_1d(arr: np.ndarray, size: int, fill=INVALID_ID,
+           strict: Optional[bool] = None) -> np.ndarray:
+  """Pad (or truncate) a host 1-D array to a static size.
+
+  Truncation that cuts NON-fill entries is a capacity bug in the
+  caller, not routine padding — it emits a ``padding.truncate``
+  flight-recorder event so the loss surfaces instead of vanishing,
+  and raises when ``strict`` is True (default: env
+  ``GLT_STRICT_PADDING=1``).
+  """
+  import os
   arr = np.asarray(arr)
+  if len(arr) > size:
+    tail = arr[size:]
+    dropped = int((tail != fill).sum()) if tail.size else 0
+    if dropped:
+      from ..telemetry.recorder import recorder
+      recorder.emit('padding.truncate', requested=int(len(arr)),
+                    size=int(size), dropped=dropped)
+      if strict or (strict is None
+                    and os.environ.get('GLT_STRICT_PADDING') == '1'):
+        raise ValueError(
+            f'pad_1d would truncate {dropped} valid entries '
+            f'({len(arr)} -> {size}); the caller undersized a static '
+            'capacity')
   out = np.full((size,), fill, dtype=arr.dtype)
   n = min(len(arr), size)
   out[:n] = arr[:n]

@@ -128,6 +128,90 @@ def test_adaptive_controller_unit():
   assert ctl.slack == 1.5          # pinned: no further movement
 
 
+def _fake_sampler():
+  class FakeSampler:
+    exchange_slack = None
+    _steps = {}
+
+    def __init__(self):
+      self.offered = 0
+      self.dropped = 0
+
+    def exchange_stats(self, tick_metrics=True):
+      return {'dist.frontier.offered': self.offered,
+              'dist.frontier.dropped': self.dropped,
+              'dist.feature.offered': 0, 'dist.feature.dropped': 0,
+              'dist.negative.lost': 0}
+  return FakeSampler()
+
+
+def test_ladder_floor_configurable_and_pins_there():
+  """ISSUE 3 satellite: the ladder keeps tightening while epochs stay
+  drop-free, down to a CONFIGURABLE floor, and a drop-free epoch at
+  the floor pins with pin_reason='floor' instead of silently idling
+  (the r5 envelope's 'stuck at 1.25' ambiguity)."""
+  import sys
+  from graphlearn_tpu.telemetry.recorder import EventRecorder
+  rec_mod = sys.modules['graphlearn_tpu.telemetry.recorder']
+  rec = EventRecorder()
+  rec.enable()
+  orig = rec_mod.recorder
+  rec_mod.recorder = rec
+  try:
+    s = _fake_sampler()
+    ctl = AdaptiveSlack(s, floor=1.0)
+    assert ctl.floor == 1.0
+    for _ in range(4):               # 2.0 -> 1.5 -> 1.25 -> 1.0
+      s.offered += 1000              # cumulative counters grow
+      ctl.on_epoch_end()
+    assert ctl.slack == 1.0          # below the old 1.25 terminus
+    assert ctl._pinned               # 4th drop-free epoch: floor pin
+    pins = rec.events('slack.pinned')
+    assert pins and pins[-1]['pin_reason'] == 'floor'
+    # a FLOOR pin only stops tightening: drops arriving later must
+    # still widen (then hard-pin as a reversal) — the safety response
+    # survives the pin
+    s.offered, s.dropped = s.offered + 1000, 50
+    ctl.on_epoch_end()
+    assert ctl.slack == 1.25
+    assert ctl._pinned and ctl._pin_reason == 'reversal'
+    s.offered, s.dropped = s.offered + 1000, 100
+    ctl.on_epoch_end()
+    assert ctl.slack == 1.25         # reversal pin is final
+    # transitions carry the pin_reason field ('' while walking)
+    trans = rec.events('slack.transition')
+    assert trans and all('pin_reason' in t for t in trans)
+    # a reversal pin reports its own reason
+    s2 = _fake_sampler()
+    ctl2 = AdaptiveSlack(s2, floor=1.0)
+    s2.offered = 1000
+    ctl2.on_epoch_end()              # tighten 2.0 -> 1.5
+    s2.offered, s2.dropped = 2000, 100
+    ctl2.on_epoch_end()              # widen back: reversal pin
+    assert ctl2._pinned
+    assert rec.events('slack.pinned')[-1]['pin_reason'] == 'reversal'
+    assert rec.events('slack.transition')[-1]['pin_reason'] == \
+        'reversal'
+  finally:
+    rec_mod.recorder = orig
+    rec.disable()
+
+
+def test_ladder_floor_from_env(monkeypatch):
+  monkeypatch.setenv('GLT_SLACK_FLOOR', '0.75')
+  ctl = AdaptiveSlack(_fake_sampler())
+  assert ctl.floor == 0.75
+  monkeypatch.setenv('GLT_SLACK_FLOOR', '1.5')
+  ctl2 = AdaptiveSlack(_fake_sampler())
+  assert ctl2.floor == 1.5
+  s = ctl2.sampler
+  s.offered = 500
+  ctl2.on_epoch_end()
+  s.offered = 1000
+  ctl2.on_epoch_end()
+  assert ctl2.slack == 1.5           # floored above the old terminus
+
+
 @pytest.mark.slow
 def test_adaptive_with_tiered_store_and_prefetch():
   """The three r3 levers compose: adaptive capacity retunes across

@@ -133,6 +133,51 @@ def test_rate_metric_direction(regress, tmp_path):
   assert row['dist.edges_per_sec_per_chip']['status'] == 'ok'
 
 
+def test_scale_envelope_rows_guarded(regress, tmp_path):
+  """ISSUE 3 satellite: the P=16 / P=64 scale-envelope rows'
+  padding_waste_pct and seeds_per_sec are guarded metrics — a waste
+  regression at P=64 fails the gate, and the 'pNN' path segment
+  addresses the right row of the list."""
+  def env_art(w16, w64, s16=900.0, s64=900.0):
+    return dict(ART, dist={
+        'seeds_per_sec': 1000.0, 'edges_per_sec_per_chip': 2e4,
+        'scale_envelope': [
+            {'num_parts': 16, 'padding_waste_pct': w16,
+             'seeds_per_sec': s16},
+            {'num_parts': 64, 'padding_waste_pct': w64,
+             'seeds_per_sec': s64},
+        ]})
+  bl = _write(tmp_path / 'BL.json', env_art(24.0, 28.0))
+  # same numbers: pass, and all four envelope keys were compared
+  verdict, rc = regress.check(
+      _write(tmp_path / 'A.json', env_art(24.0, 28.0)), bl)
+  assert rc == 0
+  rows = {m['key']: m for m in verdict['metrics']}
+  for key in ('dist.scale_envelope.p16.padding_waste_pct',
+              'dist.scale_envelope.p64.padding_waste_pct',
+              'dist.scale_envelope.p16.seeds_per_sec',
+              'dist.scale_envelope.p64.seeds_per_sec'):
+    assert rows[key]['status'] == 'ok', key
+  # waste blowing back up at P=64 (lower-is-better) fails the gate
+  verdict, rc = regress.check(
+      _write(tmp_path / 'B.json', env_art(24.0, 90.0)), bl)
+  assert rc == 1
+  assert 'dist.scale_envelope.p64.padding_waste_pct' in \
+      verdict['regressed']
+  # rows are matched by num_parts, not list position
+  flipped = env_art(24.0, 28.0)
+  flipped['dist']['scale_envelope'].reverse()
+  verdict, rc = regress.check(
+      _write(tmp_path / 'C.json', flipped), bl)
+  assert rc == 0
+  # a missing envelope (crashed phase) skips, never fails
+  verdict, rc = regress.check(_write(tmp_path / 'D.json', ART), bl)
+  assert rc == 0
+  rows = {m['key']: m for m in verdict['metrics']}
+  assert rows['dist.scale_envelope.p16.padding_waste_pct'][
+      'status'] == 'skipped'
+
+
 def test_rate_collapse_stays_strict_json(regress, tmp_path):
   """A rate falling to 0 regresses with a CLAMPED finite change_pct —
   json.dumps of the verdict must stay strict (no Infinity token)."""
