@@ -939,6 +939,26 @@ def _run_envelope_row(num_parts: int, batch: int, timeout: int):
   return None
 
 
+def _run_chaos_row(timeout: int):
+  """The `bench_dist_loader.py --chaos` resilience smoke in a
+  subprocess; returns its JSON row (None on failure/timeout)."""
+  script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'benchmarks', 'bench_dist_loader.py')
+  cmd = [sys.executable, script, '--chaos']
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout)
+  except subprocess.TimeoutExpired:
+    return None
+  for ln in reversed((out.stdout or '').strip().splitlines()):
+    if ln.startswith('{'):
+      try:
+        return json.loads(ln)
+      except json.JSONDecodeError:
+        continue
+  return None
+
+
 def _aggregate(results, fused_res, dist, hetero=None):
   """The full artifact schema from whatever phases have completed so
   far.  The HEADLINE `value` is the fused whole-epoch time when the
@@ -1256,6 +1276,22 @@ def main():
         env_rows.append(r)
     if env_rows:
       dist['scale_envelope'] = env_rows
+      emit()
+
+  # phase 3d — resilience smoke (ISSUE 4): the host server->client
+  # path with the retry/idempotency layer on — fault-free throughput
+  # feeds the dist.chaos.fault_free_seeds_per_sec regression guard,
+  # and one chaos epoch proves exact accounting under faults
+  if not (isinstance(dist, dict) and 'error' not in dist):
+    print('skipping chaos smoke: no dist section to attach to',
+          file=sys.stderr)
+  elif budget_left() <= 150:
+    print(f'budget: skipping chaos smoke ({budget_left():.0f}s left)',
+          file=sys.stderr)
+  else:
+    r = _run_chaos_row(int(min(300, max(budget_left() - 30, 120))))
+    if r is not None:
+      dist['chaos'] = r
       emit()
 
   # phase 4 — extra primary sessions stabilize the per-batch median

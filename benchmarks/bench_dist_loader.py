@@ -318,6 +318,143 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
   tee_record(out)
 
 
+def _chaos_server_proc(port_q, num_nodes, dim, jsonl, worker_plan):
+  """Sampling-server process for the chaos smoke (spawn-started so it
+  inherits THIS env assignment — its producer workers read the kill
+  plan from GLT_FAULT_PLAN)."""
+  import os
+  if worker_plan:
+    os.environ['GLT_FAULT_PLAN'] = worker_plan
+  os.environ['GLT_TELEMETRY_JSONL'] = jsonl
+  import numpy as np
+  from graphlearn_tpu.distributed import (HostDataset, init_server,
+                                          wait_and_shutdown_server)
+  from graphlearn_tpu.telemetry import recorder
+  recorder.enable(jsonl)
+  rows, cols = build_graph(num_nodes)
+  feats = np.random.default_rng(0).standard_normal(
+      (num_nodes, dim)).astype(np.float32)
+  ds = HostDataset.from_coo(rows, cols, num_nodes, node_features=feats)
+  srv = init_server(num_servers=1, num_clients=1, rank=0, dataset=ds,
+                    host='127.0.0.1', port=0)
+  port_q.put(srv.port)
+  wait_and_shutdown_server(timeout=600)
+
+
+def chaos_smoke(batch: int = 64, num_nodes: int = 5000, dim: int = 32,
+                epochs: int = 3):
+  """Resilience smoke on the HOST server->client path (ISSUE 4): time
+  fault-free epochs WITH the retry/idempotency layer on (the
+  ``dist.chaos.fault_free_seeds_per_sec`` regression guard — the
+  resilience layer must not tax the hot path), then run one chaos
+  epoch (worker kill + connection drop + delayed fetch) and assert
+  exact batch accounting.  Prints ONE JSON row."""
+  import json
+  import multiprocessing as mp
+  import os
+  import tempfile
+  import time
+  import numpy as np
+  from graphlearn_tpu import native
+  if not native.available():
+    row = {'metric': 'dist_chaos_smoke', 'skipped': True,
+           'reason': 'native lib unavailable'}
+    print(json.dumps(row), flush=True)
+    return
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  from graphlearn_tpu.distributed.dist_loader import DistLoader
+  from graphlearn_tpu.telemetry import recorder
+  from graphlearn_tpu.testing import chaos
+
+  n_seeds = batch * 32
+  n_batches = n_seeds // batch
+  chaos_epoch = epochs             # epochs 0..epochs-1 fault-free
+  jsonl = os.path.join(tempfile.mkdtemp(prefix='glt_chaos_'),
+                       'server.jsonl')
+  # the kill fires only in the chaos epoch (epoch filter) and only in
+  # the ORIGINAL worker incarnation (generation filter), so the timed
+  # fault-free epochs run untouched and the supervisor's replacement
+  # worker survives to finish the replay
+  worker_plan = (f'producer.worker:kill:2:worker=0:'
+                 f'epoch={chaos_epoch}:generation=0')
+  ctx = mp.get_context('spawn')
+  port_q = ctx.Queue()
+  proc = ctx.Process(target=_chaos_server_proc,
+                     args=(port_q, num_nodes, dim, jsonl, worker_plan),
+                     daemon=False)
+  proc.start()
+  port = port_q.get(timeout=300)
+  init_client([('127.0.0.1', port)], rank=0, num_clients=1)
+  recorder.enable(None)            # ring: rpc.retry/peer.lost capture
+  DistLoader.RECV_POLL_SECS = 2.0
+  seeds = np.arange(n_seeds) % num_nodes
+  loader = DistNeighborLoader(
+      None, [10, 5], seeds, batch_size=batch, shuffle=True,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=0, num_workers=2, prefetch_size=2),
+      to_device=False, seed=0)
+
+  # -- fault-free phase (epoch 0 warms the pipeline, rest are timed) --
+  for b in loader:
+    pass
+  t0 = time.perf_counter()
+  timed_batches = 0
+  for _ in range(epochs - 1):
+    for b in loader:
+      timed_batches += 1
+  dt = time.perf_counter() - t0
+  fault_free_rate = timed_batches * batch / max(dt, 1e-9)
+  base_retries = len(recorder.events('rpc.retry'))
+
+  # -- chaos epoch ----------------------------------------------------
+  chaos.install('rpc.request:drop:2:op=fetch_one_sampled_message;'
+                'rpc.request:delay:4:op=fetch_one_sampled_message:'
+                'secs=0.5')
+  got = 0
+  seen = set()
+  for b in loader:
+    got += 1
+  ch = loader.channel
+  seen = set(getattr(ch, '_seen_seqs', ()))
+  dup = getattr(ch, 'duplicates_discarded', 0)
+  retries = len(recorder.events('rpc.retry')) - base_retries
+  chaos.uninstall()
+  loader.shutdown()
+  shutdown_client()
+  proc.join(timeout=60)
+  server_events = ''
+  try:
+    with open(jsonl) as f:
+      server_events = f.read()
+  except OSError:
+    pass
+  row = {
+      'metric': 'dist_chaos_smoke',
+      'batch': batch, 'num_nodes': num_nodes,
+      'epochs_fault_free': epochs,
+      'fault_free_seeds_per_sec': round(fault_free_rate, 1),
+      'chaos_epoch': {
+          'expected_batches': n_batches,
+          'received_batches': got,
+          'unique_seqs': len(seen),
+          'duplicates_discarded': int(dup),
+          'rpc_retries': retries,
+          'producer_restart_logged':
+              '"kind": "producer.restart"' in server_events,
+          'fault_injected_logged':
+              '"kind": "fault.injected"' in server_events,
+      },
+      'ok': bool(got == n_batches and len(seen) == n_batches
+                 and retries >= 1),
+  }
+  print(json.dumps(row), flush=True)
+  from benchmarks.common import tee_record
+  tee_record(row)
+  return row
+
+
 def capacity_sweep(quick: bool):
   import json
   fanout = [15, 10, 5]
@@ -380,6 +517,12 @@ def main():
   ap.add_argument('--memory-envelope', action='store_true',
                   help='print the IGBH-large-on-v5p-128 per-chip '
                        'memory table (VERDICT r4 #9)')
+  ap.add_argument('--chaos', action='store_true',
+                  help='resilience smoke: fault-free host '
+                       'server->client throughput with the retry '
+                       'layer on, then one chaos epoch (worker kill '
+                       '+ connection drop + delayed fetch) with '
+                       'exact-accounting checks')
   ap.add_argument('--mode', default='homo')
   ap.add_argument('--epochs', type=int, default=5,
                   help='envelope-worker epochs (the adaptive ladder '
@@ -399,6 +542,10 @@ def main():
                        'see benchmarks/bench_compile.py')
   args = ap.parse_args()
 
+  if args.chaos:
+    chaos_smoke(batch=args.batch if args.batch != 1024 else 64,
+                num_nodes=min(args.nodes, 5000))
+    return
   if args.capacity_sweep:
     capacity_sweep(args.quick)
     return

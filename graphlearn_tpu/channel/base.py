@@ -107,6 +107,16 @@ class ChannelBase(ChannelTelemetry, abc.ABC):
   def recv(self) -> SampleMessage:
     """Dequeue one message (blocks when empty)."""
 
+  def recv_timeout(self, timeout: float):
+    """Dequeue with a deadline; ``None`` when nothing arrived in time.
+    The liveness-watchdog primitive: every consumer poll loop
+    (`DistLoader._recv_current_epoch`) interleaves timed waits with
+    peer/worker supervision, so a dead producer surfaces as an error
+    instead of a hang.  Implementations must strip-and-park the span
+    context exactly like :meth:`recv`."""
+    raise NotImplementedError(
+        f'{type(self).__name__} has no timed receive')
+
   def empty(self) -> bool:
     raise NotImplementedError
 

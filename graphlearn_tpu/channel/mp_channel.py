@@ -6,6 +6,7 @@ dependency-free; the debugging/fallback transport.
 from __future__ import annotations
 
 import multiprocessing as mp
+import queue as queue_mod
 
 from .base import ChannelBase, SampleMessage
 
@@ -21,6 +22,14 @@ class MpChannel(ChannelBase):
 
   def recv(self) -> SampleMessage:
     return self._recv_traced('recv', self._q.get)
+
+  def recv_timeout(self, timeout: float):
+    """Timed dequeue (``None`` on timeout) — same watchdog contract as
+    `ShmChannel.recv_timeout`."""
+    try:
+      return self._park_span(self._q.get(timeout=timeout))
+    except queue_mod.Empty:
+      return None
 
   def _occupancy(self) -> int:
     try:

@@ -54,6 +54,12 @@ class ShmChannel(ChannelBase):
     server forward it over RPC without a parse/re-serialize round trip."""
     return self._q.get_bytes()
 
+  def recv_bytes_timeout(self, timeout: float):
+    """Timed `recv_bytes` (``None`` on timeout) — the server's fetch
+    handler polls with this so a dead producer pool surfaces as an
+    RPC error to the client instead of a forever-blocked request."""
+    return self._q.get_bytes_timed(timeout)
+
   def empty(self) -> bool:
     return self._q.empty()
 

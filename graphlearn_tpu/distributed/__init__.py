@@ -26,6 +26,9 @@ from .dist_sampling_producer import (CollocatedSamplingProducer,
                                      MpSamplingProducer)
 from .dist_server import (DistServer, get_server, init_server,
                           wait_and_shutdown_server)
+from .resilience import (PeerLostError, RetryExhausted, RetryPolicy,
+                         degraded_ok)
+from .rpc import RpcError
 from .host_dataset import HostDataset, HostHeteroDataset
 from .host_dist_sampler import (HostDistNeighborSampler,
                                 PartitionService, connect_peers)
@@ -45,4 +48,6 @@ __all__ = [
     'PartitionService', 'connect_peers',
     'DistPartitionManager', 'DistRandomPartitioner', 'node_range',
     'DistTableRandomPartitioner',
+    'RetryPolicy', 'RetryExhausted', 'PeerLostError', 'RpcError',
+    'degraded_ok',
 ]

@@ -13,7 +13,7 @@ import numpy as np
 
 from .dist_context import DistContext, DistRole, _set_context, get_context
 from .dist_options import RemoteDistSamplingWorkerOptions
-from .rpc import RpcClient
+from .rpc import RpcClient, RpcError
 
 
 class RemoteProducerHandle:
@@ -30,16 +30,22 @@ class RemoteProducerHandle:
         self._server_idx, 'start_new_epoch_sampling', self._pid,
         drop_last=drop_last)
 
-  def fetch(self):
+  def fetch(self, src=None):
+    # ``src`` is the replacement-fetch routing hint (see
+    # `MultiProducerHandle.fetch`); with one server there is only one
+    # place to fetch from, so it is accepted and ignored
     from ..telemetry.spans import span
     with span('client.fetch', server=self._server_idx):
       return self._client.request_server(
           self._server_idx, 'fetch_one_sampled_message', self._pid)
 
   def destroy(self) -> None:
+    # best-effort cleanup: ONE short attempt, no retry ladder — a
+    # teardown against an already-dead server must not block for the
+    # full retry deadline (the server reaps producers on exit anyway)
     try:
-      self._client.request_server(
-          self._server_idx, 'destroy_sampling_producer', self._pid)
+      self._client._rpcs[self._server_idx].request_once(
+          'destroy_sampling_producer', self._pid, timeout=5.0)
     except Exception:
       pass
 
@@ -56,6 +62,10 @@ class MultiProducerHandle:
     self._plan: List[int] = []      # handle idx per outstanding message
     self._pos = 0
 
+  @property
+  def server_indices(self) -> List[int]:
+    return [h._server_idx for h in self._handles]
+
   def start_new_epoch(self, drop_last: bool = False) -> int:
     counts = [h.start_new_epoch(drop_last) for h in self._handles]
     with self._lock:
@@ -71,11 +81,46 @@ class MultiProducerHandle:
       self._pos = 0
     return sum(counts)
 
-  def fetch(self):
+  def fetch(self, src=None):
+    """One planned fetch, or — ``src`` given — a replacement fetch
+    routed to that handle.  A replacement replaces a message the
+    consumer discarded as a worker-restart replay duplicate: the real
+    undelivered message sits in THAT server's buffer, so round-robin
+    would send the extra fetch to a server that owes nothing (blocking
+    there until its fetch deadline and failing a healthy epoch)."""
+    if src is not None:
+      msg = self._handles[src].fetch()
+      if isinstance(msg, dict):
+        msg['#SRC'] = np.int64(src)
+      return msg
     with self._lock:
-      idx = self._plan[self._pos % max(len(self._plan), 1)]
+      if self._pos >= len(self._plan):
+        raise RpcError('no planned fetches remain (accounting bug, or '
+                       'every server owing messages is gone)')
+      idx = self._plan[self._pos]
       self._pos += 1
-    return self._handles[idx].fetch()
+    msg = self._handles[idx].fetch()
+    if isinstance(msg, dict):
+      # source tag: each server's producer numbers its '#SEQ' stamps
+      # from 0, so the consumer's replay dedup must key on
+      # (source, seq) — without this, server B's batch 0 reads as a
+      # replay of server A's batch 0 and gets discarded
+      msg['#SRC'] = np.int64(idx)
+    return msg
+
+  def drop_server(self, server_idx: int) -> int:
+    """Degraded mode: a server is lost for good — remove its remaining
+    planned fetches so survivors finish the epoch.  Returns how many
+    planned (not-yet-started) fetches it still owed; in-flight fetches
+    that fail surface separately, one `PeerLostError` each."""
+    with self._lock:
+      dead = [i for i, h in enumerate(self._handles)
+              if h._server_idx == server_idx]
+      remaining = self._plan[self._pos:]
+      kept = [i for i in remaining if i not in dead]
+      self._plan = kept
+      self._pos = 0
+      return len(remaining) - len(kept)
 
   def destroy(self) -> None:
     for h in self._handles:
@@ -93,7 +138,46 @@ class DistClient:
     self.num_clients = num_clients
 
   def request_server(self, server_idx: int, name: str, *args, **kwargs):
-    return self._rpcs[server_idx].request(name, *args, **kwargs)
+    """RPC to one server, classified on failure: a retry-exhausted
+    request probes the peer — still answering its ping means SLOW
+    (`RetryExhausted` propagates, caller may widen its deadline), not
+    answering means DEAD (`PeerLostError`, emitted as a ``peer.lost``
+    event).  A server-side `PeerLostError` (its producer pool died)
+    re-raises typed on this side too."""
+    from ..telemetry.recorder import recorder
+    from .resilience import PeerLostError, RetryExhausted
+    try:
+      return self._rpcs[server_idx].request(name, *args, **kwargs)
+    except PeerLostError:
+      raise
+    except RetryExhausted as e:
+      if self._rpcs[server_idx].probe():
+        raise                      # slow peer: alive but over budget
+      addr = self._rpcs[server_idx].addr
+      recorder.emit('peer.lost', peer=server_idx, peer_kind='server',
+                    addr=f'{addr[0]}:{addr[1]}', op=name,
+                    degraded=False, error=str(e))
+      raise PeerLostError(
+          f'server {server_idx} at {addr} is gone: {name!r} '
+          f'exhausted retries and the liveness probe failed',
+          peer=server_idx) from e
+    except RpcError as e:
+      if getattr(e, 'remote_kind', None) == 'PeerLostError':
+        # the server executed but ITS producer pool is irrecoverable
+        # (typed via the wire's structured error-kind field — never
+        # sniffed out of the message text)
+        raise PeerLostError(f'server {server_idx}: {e}',
+                            peer=server_idx) from e
+      raise
+
+  def heartbeat(self, server_idx: int, timeout: float = 2.0):
+    """One-shot health snapshot from a server (fresh connection, no
+    retries); ``None`` when the peer is unreachable."""
+    try:
+      return self._rpcs[server_idx].request_once('heartbeat',
+                                                 timeout=timeout)
+    except Exception:              # noqa: BLE001 — unreachable = None
+      return None
 
   def get_dataset_meta(self, server_idx: int = 0):
     return self.request_server(server_idx, 'get_dataset_meta')
@@ -139,14 +223,25 @@ class DistClient:
                             with_edge, shuffle, seed, sampling_config)
 
   def shutdown(self, notify_servers: bool = True) -> None:
-    """Client-0 asks every server to exit
-    (reference `shutdown_client`, `dist_client.py:54-76`)."""
-    if notify_servers and self.rank == 0:
+    """Every client says goodbye (`notify_leave` — the server's
+    shutdown-timeout diagnostics name whoever didn't); client-0 then
+    asks every server to exit (reference `shutdown_client`,
+    `dist_client.py:54-76`)."""
+    if notify_servers:
       for i in range(self.num_servers):
         try:
-          self.request_server(i, 'exit')
+          self._rpcs[i].request_once('notify_leave', self.rank,
+                                     timeout=2.0)
         except Exception:
           pass
+        if self.rank == 0:
+          # one short attempt: telling an already-dead server to exit
+          # must not ride the retry ladder
+          try:
+            self._rpcs[i].request_once('exit', client_rank=self.rank,
+                                       timeout=5.0)
+          except Exception:
+            pass
     for c in self._rpcs:
       c.close()
 

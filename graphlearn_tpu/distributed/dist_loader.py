@@ -203,6 +203,8 @@ class DistLoader:
   # -- epoch protocol (reference `__iter__`/`__next__`,
   # `dist_loader.py:246-272`) ---------------------------------------------
   def __iter__(self):
+    self._seen_seqs = set()       # '#SEQ' stamps delivered this epoch
+    self._degraded_lost = set()   # seqs written off in degraded mode
     if isinstance(self.opts, MpDistSamplingWorkerOptions):
       self._expected = self._producer.produce_all(self.seeds,
                                                   drop_last=self.drop_last)
@@ -254,34 +256,127 @@ class DistLoader:
     metrics.inc('dist_loader.batches')
     return batch
 
+  #: timed-wait granularity of the supervision poll loops.
+  RECV_POLL_SECS = 5.0
+
   def _recv_current_epoch(self) -> SampleMessage:
     """Receive, discarding stale-epoch messages left in the channel by
     an early-terminated previous epoch (`RemoteReceivingChannel` does
-    its own stamp filtering).  Blocking waits are liveness-guarded:
-    the shm dequeue blocks in a semaphore, so a crashed producer pool
-    must surface as an error here, not as a hang (the reference's
-    MP_STATUS_CHECK_INTERVAL watchdog)."""
+    its own stamp + '#SEQ' filtering).  Blocking waits are liveness-
+    guarded: every wait is timed, and each timeout runs supervision —
+    mp mode restarts dead workers and replays their unacked batches;
+    remote mode heartbeats the servers.  Irrecoverable loss raises
+    `PeerLostError` with diagnostics, or — ``GLT_DEGRADED_OK=1`` —
+    finishes the epoch on survivors with the loss flagged in telemetry
+    (a ``peer.lost`` event with ``degraded=True``)."""
+    from ..telemetry.recorder import recorder
+    from .resilience import PeerLostError, degraded_ok
     if isinstance(self.opts, RemoteDistSamplingWorkerOptions):
-      return self.channel.recv()
+      while True:
+        try:
+          msg = self.channel.recv_timeout(self.RECV_POLL_SECS)
+        except StopIteration:
+          raise
+        except PeerLostError as e:
+          if not degraded_ok() or not hasattr(self._remote,
+                                              'drop_server'):
+            # single-server loaders have no survivors to finish on —
+            # degraded mode needs a multi-server plan to fall back to
+            e.peer_health = dict(getattr(self, '_peer_health', {}))
+            raise
+          # finish on survivors: write off what the dead peer still
+          # owed (its planned fetches + this failed one) and keep
+          # draining the rest of the plan
+          owed = 1
+          if e.peer is not None:
+            owed += self._remote.drop_server(e.peer)
+          self.channel.reduce_expected(owed)
+          self._expected -= owed
+          recorder.emit('peer.lost', peer=e.peer, peer_kind='server',
+                        degraded=True, lost_batches=owed,
+                        received=self._received,
+                        expected=self._expected)
+          if self._received >= self._expected:
+            raise StopIteration from e
+          continue
+        if msg is not None:
+          return msg
+        # clean poll timeout: distinguish slow from dead via the
+        # heartbeat (a dead server's in-flight fetch will also raise,
+        # but the probe surfaces sooner and feeds diagnostics)
+        self._probe_servers()
+      # not reached
     cur = self._producer.current_epoch
     while True:
       # timed semaphore wait: blocking fast path, and ANY crashed
-      # worker surfaces as an error on the next timeout (a dead worker
-      # may hold an outstanding seed slice that will never arrive).
-      # The timed recv itself closes the message-arrived-then-died
-      # race: a message present at raise-decision time was drained.
-      msg = self.channel.recv_timeout(5.0)
+      # worker surfaces on the next timeout (a dead worker may hold an
+      # outstanding seed slice that will never arrive).  The timed
+      # recv itself closes the message-arrived-then-died race: a
+      # message present at raise-decision time was drained.
+      msg = self.channel.recv_timeout(self.RECV_POLL_SECS)
       if msg is None:
-        dead = self._producer.dead_worker_exitcodes()
-        if dead:
-          raise RuntimeError(
-              f'{len(dead)} sampling worker(s) exited (exit codes '
-              f'{dead}) with {self._expected - self._received} '
-              'batches outstanding')
+        _, lost = self._producer.supervise(self._seen_seqs)
+        fresh_lost = set(lost) - self._degraded_lost
+        if fresh_lost:
+          if not degraded_ok():
+            dead = self._producer.dead_worker_exitcodes()
+            raise PeerLostError(
+                f'{len(dead)} sampling worker(s) unrecoverable (exit '
+                f'codes {dead}, restart budget spent) with '
+                f'{self._expected - self._received} batch(es) '
+                f'outstanding, {len(fresh_lost)} of them lost for '
+                f'good; received {self._received}/{self._expected}',
+                received=self._received, expected=self._expected,
+                outstanding=len(fresh_lost))
+          self._degraded_lost |= fresh_lost
+          self._expected -= len(fresh_lost)
+          recorder.emit('peer.lost', peer_kind='worker', degraded=True,
+                        lost_batches=len(fresh_lost),
+                        received=self._received,
+                        expected=self._expected)
+          if self._received >= self._expected:
+            raise StopIteration
         continue
       stamp = msg.get('#EPOCH')
-      if stamp is None or int(np.asarray(stamp)) == cur:
-        return msg
+      if stamp is not None and int(np.asarray(stamp)) != cur:
+        continue
+      seq = msg.get('#SEQ')
+      if seq is not None:
+        seq = int(np.asarray(seq))
+        if seq in self._seen_seqs:
+          continue    # replayed batch whose original got through
+        if seq in self._degraded_lost:
+          # written off as lost, then arrived after all (the worker's
+          # send raced its own death): the epoch accounting already
+          # subtracted it — delivering now would end the epoch one
+          # batch early and silently drop a different healthy batch
+          continue
+        self._seen_seqs.add(seq)
+      return msg
+
+  def _probe_servers(self) -> None:
+    """Heartbeat every server this loader draws from (remote mode).
+    Fetch-path errors carry the authoritative failure; the probe's job
+    is the diagnostics trail — the last observed health of every peer
+    is kept at ``self._peer_health`` and attached to the
+    `PeerLostError` (``.peer_health``) when the epoch finally fails,
+    so the log tells slow-peer from dead-peer without reconstruction."""
+    import time as _time
+    from .dist_client import get_client
+    client = get_client()
+    if client is None:
+      return
+    idxs = (self._remote.server_indices
+            if hasattr(self._remote, 'server_indices')
+            else [self._remote._server_idx])
+    health = getattr(self, '_peer_health', None)
+    if health is None:
+      health = self._peer_health = {}
+    for idx in idxs:
+      hb = client.heartbeat(idx)
+      health[idx] = {'at': round(_time.time(), 3),
+                     'alive': hb is not None,
+                     'producers': (hb or {}).get('producers')}
 
   # -- message -> static-shape Batch (reference `dist_loader.py:286-383`) --
   def _collate_fn(self, msg: SampleMessage):
@@ -461,6 +556,12 @@ class DistLoader:
     return md
 
   def shutdown(self) -> None:
+    # idempotent: __del__ re-enters after an explicit shutdown, and a
+    # second remote destroy against a since-departed server would
+    # waste its one-shot teardown attempt on a dead socket
+    if getattr(self, '_shutdown_done', False):
+      return
+    self._shutdown_done = True
     if self._producer is not None and hasattr(self._producer, 'shutdown'):
       self._producer.shutdown()
     if isinstance(self.opts, RemoteDistSamplingWorkerOptions):

@@ -22,6 +22,7 @@ from __future__ import annotations
 import enum
 import multiprocessing as mp
 import queue as queue_mod
+import threading
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -98,7 +99,8 @@ def _dispatch_sample(sampler, cfg, seeds_slice, batch_seed: int):
 
 def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
                           collect_features, channel, task_queue, seed,
-                          sampling_config=None):
+                          sampling_config=None, progress_queue=None,
+                          generation=0):
   """Body of one sampling subprocess (reference `_sampling_worker_loop`,
   `dist_sampling_producer.py:52-144`)."""
   from .shm_arrays import SharedDatasetHandle
@@ -118,23 +120,44 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
       continue
     if cmd == MpCommand.STOP:
       break
-    seeds, batch_size, epoch = payload
+    seeds, batch_size, epoch, seqs = payload
     from ..telemetry.spans import span
-    for lo in range(0, len(seeds), batch_size):
+    from ..testing import chaos
+    for i, lo in enumerate(range(0, len(seeds), batch_size)):
+      # fault-plan seam: a planned 'kill' hard-exits here, between
+      # batches — the supervisor must restart us and replay what we
+      # never delivered (the chaos suite's central scenario)
+      chaos.worker_kill_check(rank, epoch, generation)
       # the producer-side span covers sample + send; the channel
       # injects its context into the message at send time, so the
       # consumer's collate span can link back to THIS trace (the
       # worker's recorder comes up via GLT_TELEMETRY_JSONL, which
       # spawn/forkserver children inherit)
+      seq = int(seqs[i])
       with span('producer.sample', worker=rank, epoch=epoch,
                 offset=lo):
+        # batch content is a function of (epoch, seq) ONLY — a batch
+        # replayed after a worker restart (possibly from a different
+        # offset) is byte-identical to the original, so consumer-side
+        # '#SEQ' dedup keeps epoch content exact under faults
         msg = _dispatch_sample(
             sampler, sampling_config, seeds[lo:lo + batch_size],
-            batch_seed=(epoch * 1000003 + rank) * 131071 + lo)
+            batch_seed=(epoch * 1000003 + seq) * 131071)
         # Epoch stamp lets consumers discard stale messages after an
-        # early-terminated epoch (`DistLoader._recv_current_epoch`).
+        # early-terminated epoch (`DistLoader._recv_current_epoch`);
+        # the seq stamp is the per-batch identity replay dedup keys on.
         msg['#EPOCH'] = np.int64(epoch)
+        msg['#SEQ'] = np.int64(seq)
         channel.send(msg)
+      if progress_queue is not None:
+        # progress ack AFTER the durable channel send: the channel
+        # outlives us, so a sent batch never needs replay — the
+        # supervisor replays only what sits between the last ack and
+        # the crash (consumer-side '#SEQ' dedup absorbs the overlap)
+        try:
+          progress_queue.put((epoch, rank, seq))
+        except Exception:           # noqa: BLE001 — teardown race
+          pass
 
 
 class MpSamplingProducer:
@@ -167,6 +190,32 @@ class MpSamplingProducer:
     self._task_queues: List = []
     self._workers: List = []
     self.current_epoch = -1      # stamp of the last dispatched epoch
+    # supervision state: per-worker assignment ledger for the CURRENT
+    # epoch ({rank: (seed_slice, seq_stamps)}), workers declared
+    # irrecoverable, and the restart budget consumed so far
+    self._assignments: dict = {}
+    self._lost: set = set()
+    self._restarts = 0
+    self._sent_seqs: set = set()   # worker progress acks, this epoch
+    self._progress = None
+    self._generations: dict = {}   # rank -> restart count
+    # one supervisor at a time: the server runtime calls supervise()
+    # from one RPC handler thread per in-flight fetch — without the
+    # lock two threads can both restart the same dead worker (orphaned
+    # duplicate process, double-billed restart budget)
+    self._sup_lock = threading.Lock()
+
+  def _spawn_worker(self, rank: int):
+    tq = self._ctx.Queue()
+    w = self._ctx.Process(
+        target=_sampling_worker_loop,
+        args=(rank, self._ds_arg, self.fanouts, self.with_edge,
+              self.opts.collect_features, self.channel, tq, self._seed,
+              self.sampling_config, self._progress,
+              self._generations.get(rank, 0)),
+        daemon=True)
+    w.start()
+    return tq, w
 
   def init(self) -> None:
     ds_arg = self.ds
@@ -176,15 +225,10 @@ class MpSamplingProducer:
       # zero-copy instead of unpickling a full copy each
       from .shm_arrays import share_dataset
       ds_arg, self._shm_segs = share_dataset(self.ds)
+    self._ds_arg = ds_arg          # kept: restarts respawn from it
+    self._progress = self._ctx.Queue()
     for r in range(self.opts.num_workers):
-      tq = self._ctx.Queue()
-      w = self._ctx.Process(
-          target=_sampling_worker_loop,
-          args=(r, ds_arg, self.fanouts, self.with_edge,
-                self.opts.collect_features, self.channel, tq, self._seed,
-                self.sampling_config),
-          daemon=True)
-      w.start()
+      tq, w = self._spawn_worker(r)
       self._task_queues.append(tq)
       self._workers.append(w)
 
@@ -204,17 +248,70 @@ class MpSamplingProducer:
       seeds = self._rng.permutation(seeds)
     if drop_last:
       seeds = seeds[:(len(seeds) // self.batch_size) * self.batch_size]
+    with self._sup_lock:
+      return self._produce_all_locked(seeds)
+
+  def _produce_all_locked(self, seeds: np.ndarray) -> int:
+    # under _sup_lock: the server runtime can run supervise() from a
+    # fetch handler thread concurrently with a start-epoch RPC — an
+    # unlocked respawn here would race it (duplicate replacement
+    # workers, a replayed task enqueued on a queue this method is
+    # about to replace)
+    # an epoch boundary is a recovery point: workers that died late in
+    # the previous epoch respawn BEFORE this epoch's assignments go
+    # out (their queues would otherwise hold work no one ever does),
+    # and the restart budget + lost set reset — the budget bounds
+    # crash-looping within one epoch, not uptime across a long run
+    self._restarts = 0
+    self._lost.clear()
+    for r, w in enumerate(self._workers):
+      if not w.is_alive():
+        from ..telemetry.recorder import recorder
+        self._generations[r] = self._generations.get(r, 0) + 1
+        tq, proc = self._spawn_worker(r)
+        self._task_queues[r] = tq
+        self._workers[r] = proc
+        recorder.emit('producer.restart', worker=r, exitcode=w.exitcode,
+                      replayed=0, restarts=self._restarts,
+                      budget=None, at='epoch_boundary')
     nw = max(len(self._workers), 1)
     # batch-aligned contiguous slices (reference `:249-260`)
     n_batches = self.num_batches(len(seeds))
     per_worker = ((n_batches + nw - 1) // nw) * self.batch_size
+    batches_per_worker = per_worker // self.batch_size
+    self._assignments = {}
     for r, tq in enumerate(self._task_queues):
       sl = seeds[r * per_worker:(r + 1) * per_worker]
       if len(sl):
-        tq.put((MpCommand.SAMPLE_ALL, (sl, self.batch_size, self._epoch)))
+        # '#SEQ' stamps: the global batch index of each batch in this
+        # slice — the identity supervision replays and consumers
+        # dedup on (unique within the epoch by construction)
+        seqs = [r * batches_per_worker + i
+                for i in range(self.num_batches(len(sl)))]
+        self._assignments[r] = (sl, seqs)
+        tq.put((MpCommand.SAMPLE_ALL,
+                (sl, self.batch_size, self._epoch, seqs)))
     self.current_epoch = self._epoch
     self._epoch += 1
+    self._sent_seqs = set()
+    self._drain_progress()          # discard stale prior-epoch acks
     return n_batches
+
+  def _drain_progress(self) -> None:
+    """Fold worker progress acks for the CURRENT epoch into
+    ``_sent_seqs`` (acks are ``(epoch, rank, seq)`` put after each
+    durable channel send)."""
+    if self._progress is None:
+      return
+    while True:
+      try:
+        ep, _, s = self._progress.get_nowait()
+      except queue_mod.Empty:
+        return
+      except (OSError, ValueError):
+        return                      # queue tearing down
+      if ep == self.current_epoch:
+        self._sent_seqs.add(s)
 
   def alive_workers(self) -> int:
     """Liveness probe (the reference's 5s MP_STATUS_CHECK_INTERVAL
@@ -225,6 +322,76 @@ class MpSamplingProducer:
 
   def dead_worker_exitcodes(self):
     return [w.exitcode for w in self._workers if not w.is_alive()]
+
+  def _unacked(self, rank: int, acked_seqs=None):
+    """The (seed_slice, seqs) of ``rank``'s current-epoch batches with
+    no delivery evidence: neither in the worker's own progress acks
+    (``_sent_seqs`` — sent to the channel, which outlives the worker)
+    nor in the consumer's optional ``acked_seqs``.  Replay of an
+    already-sent batch would be harmless (consumer '#SEQ' dedup) but
+    wasteful — and under a deterministic kill fault it would re-fire
+    the fault forever."""
+    sl, seqs = self._assignments.get(rank, (None, []))
+    if sl is None:
+      return None, []
+    done = set(self._sent_seqs)
+    if acked_seqs is not None:
+      done |= set(acked_seqs)
+    bs = self.batch_size
+    keep = [i for i, s in enumerate(seqs) if s not in done]
+    if not keep:
+      return None, []
+    parts = [sl[i * bs:(i + 1) * bs] for i in keep]
+    return np.concatenate(parts, axis=0), [seqs[i] for i in keep]
+
+  def supervise(self, acked_seqs=None):
+    """Detect dead workers, restart them, and replay their unacked
+    current-epoch batches (same '#SEQ' stamps + (epoch, seq)-derived
+    batch seeds, so replays are byte-identical to what was lost).
+
+    Returns ``(restarted, lost_seqs)``: workers restarted this call,
+    and the outstanding seq stamps owned by workers past the restart
+    budget (``GLT_MAX_WORKER_RESTARTS``) — permanently lost batches
+    the caller must either subtract from the epoch (degraded mode) or
+    raise `PeerLostError` over."""
+    from ..telemetry.recorder import recorder
+    from .resilience import max_worker_restarts
+    with self._sup_lock:
+      return self._supervise_locked(acked_seqs, recorder,
+                                    max_worker_restarts())
+
+  def _supervise_locked(self, acked_seqs, recorder, budget):
+    self._drain_progress()
+    restarted = 0
+    lost_seqs: list = []
+    for r, w in enumerate(self._workers):
+      if w.is_alive():
+        continue
+      sl, seqs = self._unacked(r, acked_seqs)
+      if r in self._lost or self._restarts >= budget:
+        if r not in self._lost:
+          self._lost.add(r)
+          recorder.emit('peer.lost', peer=f'worker-{r}', peer_kind='worker',
+                        exitcode=w.exitcode,
+                        outstanding=len(seqs),
+                        restarts=self._restarts, budget=budget)
+        lost_seqs.extend(seqs)
+        continue
+      exitcode = w.exitcode
+      self._restarts += 1
+      self._generations[r] = self._generations.get(r, 0) + 1
+      tq, proc = self._spawn_worker(r)
+      self._task_queues[r] = tq
+      self._workers[r] = proc
+      if sl is not None and self.current_epoch >= 0:
+        tq.put((MpCommand.SAMPLE_ALL,
+                (sl, self.batch_size, self.current_epoch, seqs)))
+        self._assignments[r] = (sl, seqs)
+      recorder.emit('producer.restart', worker=r, exitcode=exitcode,
+                    replayed=len(seqs), restarts=self._restarts,
+                    budget=budget)
+      restarted += 1
+    return restarted, lost_seqs
 
   def shutdown(self) -> None:
     for tq in self._task_queues:

@@ -35,6 +35,9 @@ class DistServer:
     self._next_id = 0
     self._exit = threading.Event()
     self._lock = threading.Lock()
+    self.rank = 0                   # set by init_server
+    self.num_clients = 1            # set by init_server
+    self._left_clients: set = set()
 
   # -- handlers ------------------------------------------------------------
   def get_dataset_meta(self):
@@ -89,15 +92,55 @@ class DistServer:
         self._seeds[producer_id], drop_last=drop_last)
 
   def fetch_one_sampled_message(self, producer_id: int):
-    """Blocking pull of one message (reference
-    `fetch_one_sampled_message`, `dist_server.py:121-131`).  Returns
-    the wire bytes untouched — they cross the socket as a tensor-map
-    frame without a parse/re-serialize round trip (a producer's
-    '#SPAN' context tensor rides through to the client intact)."""
+    """Pull of one message (reference `fetch_one_sampled_message`,
+    `dist_server.py:121-131`).  Returns the wire bytes untouched —
+    they cross the socket as a tensor-map frame without a
+    parse/re-serialize round trip (a producer's '#SPAN' context tensor
+    rides through to the client intact).
+
+    Liveness-guarded: the buffer pull is a timed poll interleaved with
+    producer supervision, so a crashed sampling worker is restarted
+    (its unacked batches replayed; the client's '#SEQ' dedup absorbs
+    any double delivery) and an irrecoverable pool surfaces to the
+    client as a `PeerLostError`-tagged RPC error instead of a request
+    that never returns."""
     from ..telemetry.spans import span
-    from .rpc import RawTensorMap
+    from .resilience import PeerLostError, fetch_deadline
+    from .rpc import RawTensorMap, RpcError
     with span('server.fetch', producer=producer_id):
-      return RawTensorMap(self._channels[producer_id].recv_bytes())
+      channel = self._channels[producer_id]
+      producer = self._producers[producer_id]
+      timed = getattr(channel, 'recv_bytes_timeout', None)
+      if timed is None:
+        return RawTensorMap(channel.recv_bytes())
+      patience = fetch_deadline()
+      deadline = time.monotonic() + patience
+      while True:
+        data = timed(2.0)
+        if data is not None:
+          return RawTensorMap(data)
+        # acks live client-side; supervise with unknown acks replays
+        # the dead worker's FULL assignment (consumer dedup keeps the
+        # epoch exact)
+        _, lost = producer.supervise(None)
+        if lost:
+          raise PeerLostError(
+              f'producer {producer_id}: worker restart budget '
+              f'exhausted with {len(lost)} batch(es) unrecoverable '
+              f'(exit codes {producer.dead_worker_exitcodes()})',
+              peer=f'server-{self.rank}/producer-{producer_id}',
+              outstanding=len(lost))
+        if time.monotonic() > deadline:
+          # alive-but-silent past the (generous) fetch deadline: an
+          # ambiguous stall, NOT a proven peer loss — raise the plain
+          # RPC error so degraded-mode clients don't amputate a
+          # server whose pool may merely be stuck (PeerLostError is
+          # reserved for the exhausted-budget arm above)
+          raise RpcError(
+              f'producer {producer_id}: no message within '
+              f'{patience:.0f}s fetch deadline '
+              f'({producer.alive_workers()} worker(s) alive — '
+              'stalled or extremely slow pool)')
 
   def destroy_sampling_producer(self, producer_id: int) -> None:
     with self._lock:
@@ -109,7 +152,27 @@ class DistServer:
     if channel is not None:
       channel.close()
 
-  def exit(self) -> bool:
+  def heartbeat(self) -> dict:
+    """Liveness + health snapshot (the slow-peer / dead-peer
+    discriminator `DistClient.heartbeat` keys off): which producers
+    exist and how many of their workers are alive."""
+    with self._lock:
+      producers = {pid: {'alive_workers': p.alive_workers(),
+                         'dead_exitcodes': p.dead_worker_exitcodes(),
+                         'restarts': p._restarts}
+                   for pid, p in self._producers.items()}
+    return {'rank': self.rank, 'time': time.time(),
+            'producers': producers}
+
+  def notify_leave(self, client_rank: int) -> bool:
+    """Record an orderly client departure — `wait_for_exit`'s timeout
+    diagnostics name the clients that never called this."""
+    self._left_clients.add(int(client_rank))
+    return True
+
+  def exit(self, client_rank: Optional[int] = None) -> bool:
+    if client_rank is not None:
+      self._left_clients.add(int(client_rank))
     self._exit.set()
     return True
 
@@ -119,8 +182,20 @@ class DistServer:
     `wait_and_shutdown_server` poll loop, `dist_server.py:64-74`).
     Producers are destroyed either way — a timeout means the clients
     died, and leaking sampling subprocesses + SysV segments is worse
-    than cutting them off."""
+    than cutting them off.  A timeout is LOGGED through the flight
+    recorder with the clients that never said goodbye, instead of
+    returning silently (the operator's first question is "which
+    trainer hung?")."""
     done = self._exit.wait(timeout)
+    if not done:
+      from ..telemetry.recorder import recorder
+      missing = sorted(set(range(self.num_clients))
+                       - self._left_clients)
+      recorder.emit('server.shutdown_timeout', rank=self.rank,
+                    timeout_secs=timeout,
+                    clients_never_exited=missing,
+                    clients_left=sorted(self._left_clients),
+                    live_producers=len(self._producers))
     for pid in list(self._producers):
       self.destroy_sampling_producer(pid)
     return done
@@ -143,10 +218,13 @@ def init_server(num_servers: int, num_clients: int, rank: int,
                            num_servers=num_servers,
                            num_clients=num_clients))
   srv = DistServer(dataset)
+  srv.rank = rank
+  srv.num_clients = num_clients
   rpc = RpcServer(host, port)
   for name in ('get_dataset_meta', 'create_sampling_producer',
                'start_new_epoch_sampling', 'fetch_one_sampled_message',
-               'destroy_sampling_producer', 'exit'):
+               'destroy_sampling_producer', 'exit', 'heartbeat',
+               'notify_leave'):
     rpc.register(name, getattr(srv, name))
   if getattr(dataset, 'node_pb', None) is not None and \
       not isinstance(getattr(dataset, 'node_pb'), dict):

@@ -44,26 +44,38 @@ except ImportError:
 _CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
 
 
-def _build():
+def _build(force: bool = False):
   srcs = [os.path.join(_CSRC, f) for f in
           ('shm_queue.cc', 'tensor_map.cc', 'cpu_ops.cc', 'inducer.cc',
            'common.h')]
-  if os.path.exists(_SO):
+  if not force and os.path.exists(_SO):
     so_mtime = os.path.getmtime(_SO)
     if all(os.path.getmtime(s) <= so_mtime for s in srcs if
            os.path.exists(s)):
       return
+  if force and os.path.exists(_SO):
+    # make's mtime check would skip the rebuild; the stale artifact
+    # must go first
+    os.unlink(_SO)
   subprocess.run(['make', '-s', f'OUT={_SO}'], cwd=_CSRC, check=True)
 
 
 def lib() -> ctypes.CDLL:
-  """The loaded native library (built on first use)."""
+  """The loaded native library (built on first use).  A binary that
+  fails to *load* — typically an artifact carried over from a host
+  with a different libstdc++/glibc — is rebuilt in place from source
+  and retried once, instead of poisoning every native-dependent path
+  on this machine."""
   global _lib
   if _lib is None:
     with _lock:
       if _lib is None:
         _build()
-        l = ctypes.CDLL(_SO)
+        try:
+          l = ctypes.CDLL(_SO)
+        except OSError:
+          _build(force=True)
+          l = ctypes.CDLL(_SO)
         _declare(l)
         _lib = l
   return _lib

@@ -52,6 +52,10 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     ('dist.scale_envelope.p16.seeds_per_sec', 'higher'),
     ('dist.scale_envelope.p64.padding_waste_pct', 'lower'),
     ('dist.scale_envelope.p64.seeds_per_sec', 'higher'),
+    # resilience guard (ISSUE 4): the host server->client loader path
+    # WITH the retry/idempotency layer on, no faults injected — the
+    # retry layer must not silently slow the fault-free hot path
+    ('dist.chaos.fault_free_seeds_per_sec', 'higher'),
 )
 
 

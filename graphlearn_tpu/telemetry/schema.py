@@ -47,6 +47,26 @@ EVENT_KINDS: Dict[str, str] = {
     'span.end':
         'telemetry.spans: same ids as span.begin plus dur '
         '(monotonic-clock seconds) and error',
+    'fault.injected':
+        'testing.chaos: site, action, nth, arrival (+op/worker/epoch '
+        'filters, secs for delays) — one event per fired fault, so a '
+        'chaos run reads out of the same stream as the retries and '
+        'restarts it caused',
+    'rpc.retry':
+        'RpcClient.request: op, attempt, addr, error, backoff_secs — '
+        'one transport fault absorbed by the resilience layer',
+    'producer.restart':
+        'MpSamplingProducer.supervise: worker, exitcode, replayed '
+        '(unacked batches re-dispatched), restarts, budget',
+    'peer.lost':
+        'resilience layer (DistClient / DistLoader / supervise): '
+        'peer, peer_kind (server|worker), degraded (True = epoch '
+        'finished on survivors under GLT_DEGRADED_OK), lost_batches/'
+        'outstanding, received, expected',
+    'server.shutdown_timeout':
+        'DistServer.wait_for_exit: rank, timeout_secs, '
+        'clients_never_exited, clients_left, live_producers — a '
+        'shutdown wait that expired instead of returning silently',
 }
 
 

@@ -1,0 +1,14 @@
+"""Deterministic failure tooling for the distributed runtime.
+
+`graphlearn_tpu.testing.chaos` is the fault-injection harness the
+resilience layer is proved against; it ships in the package (not under
+tests/) because producer subprocesses and sampling servers must be
+able to import it wherever they run.
+"""
+from .chaos import (ChaosPlan, Fault, FAULT_PLAN_ENV, WORKER_KILL_EXIT,
+                    active, install, parse_plan, uninstall)
+
+__all__ = [
+    'ChaosPlan', 'Fault', 'FAULT_PLAN_ENV', 'WORKER_KILL_EXIT',
+    'active', 'install', 'parse_plan', 'uninstall',
+]

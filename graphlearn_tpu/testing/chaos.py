@@ -1,0 +1,267 @@
+"""Seeded, declarative fault injection for the distributed runtime.
+
+The resilience layer (`distributed/resilience.py`) claims a flaky
+peer degrades into a retry, not a hung TPU step; this harness makes
+that claim testable.  A *fault plan* is a list of :class:`Fault`
+records naming a **site** (an injection seam the runtime calls into),
+an **action**, and *when* to fire (the ``nth`` matching arrival at
+that seam, counted per fault — deterministic under a fixed plan, no
+wall clocks involved).  Sites and actions:
+
+  ``rpc.request``
+      Seam inside `RpcClient.request`, once per attempt.  Actions:
+      ``drop`` (sever the connection after the request is sent — the
+      server may have executed it, exercising the replay cache),
+      ``delay`` (sleep ``secs`` before sending), ``corrupt`` (scramble
+      the reply payload so the client misparses — exercising the
+      reset-on-partial-read path).  ``op`` filters by handler name.
+  ``producer.worker``
+      Seam at the top of a sampling worker's per-batch loop.  Action
+      ``kill`` ( ``os._exit(WORKER_KILL_EXIT)`` — a hard crash, no
+      cleanup, like the OOM killer).  ``worker`` / ``epoch`` filter by
+      worker rank and epoch.
+
+Plans install three ways: programmatically (:func:`install`), from the
+``GLT_FAULT_PLAN`` env var (inherited by producer subprocesses and
+sampling servers — how cross-process chaos reaches them), or not at
+all — every seam is a single module-attribute check when no plan is
+active, so the harness costs nothing in production.
+
+Plan syntax — JSON::
+
+    {"seed": 7, "faults": [
+      {"site": "rpc.request", "action": "drop", "nth": 3,
+       "op": "fetch_one_sampled_message"},
+      {"site": "producer.worker", "action": "kill", "nth": 2,
+       "worker": 0}]}
+
+or the compact form (``;``-separated, ``site:action:nth[:key=val...]``)::
+
+    rpc.request:drop:3:op=fetch_one_sampled_message;producer.worker:kill:2:worker=0
+
+Every fired fault emits a ``fault.injected`` flight-recorder event, so
+a chaos run's injected faults and the retries/restarts they caused
+read out of ONE event stream.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+FAULT_PLAN_ENV = 'GLT_FAULT_PLAN'
+
+#: exit code of a chaos-killed sampling worker (distinctive in
+#: ``dead_worker_exitcodes`` so tests can tell injected kills from
+#: real crashes).
+WORKER_KILL_EXIT = 173
+
+_SITES = ('rpc.request', 'producer.worker')
+_ACTIONS = ('drop', 'delay', 'corrupt', 'kill')
+
+
+@dataclass
+class Fault:
+  """One planned fault: fire ``count`` times starting at the ``nth``
+  matching arrival (1-based) at ``site``."""
+  site: str
+  action: str
+  nth: int = 1
+  count: int = 1
+  op: Optional[str] = None        # rpc.request: handler-name filter
+  worker: Optional[int] = None    # producer.worker: rank filter
+  epoch: Optional[int] = None     # producer.worker: epoch filter
+  #: producer.worker: restart-generation filter — ``0`` targets only
+  #: the ORIGINAL worker incarnation, so a deterministic kill cannot
+  #: re-fire inside the supervisor's replacement (whose fresh process
+  #: restarts the arrival counters)
+  generation: Optional[int] = None
+  secs: float = 0.1               # delay duration
+  _seen: int = field(default=0, repr=False, compare=False)
+
+  def __post_init__(self):
+    if self.site not in _SITES:
+      raise ValueError(f'unknown fault site {self.site!r} '
+                       f'(expected one of {_SITES})')
+    if self.action not in _ACTIONS:
+      raise ValueError(f'unknown fault action {self.action!r} '
+                       f'(expected one of {_ACTIONS})')
+
+  def _matches(self, ctx: Dict[str, Any]) -> bool:
+    if self.op is not None and ctx.get('op') != self.op:
+      return False
+    if self.worker is not None and ctx.get('worker') != self.worker:
+      return False
+    if self.epoch is not None and ctx.get('epoch') != self.epoch:
+      return False
+    if self.generation is not None and \
+        ctx.get('generation') != self.generation:
+      return False
+    return True
+
+
+class ChaosPlan:
+  """A set of faults plus the seeded RNG probabilistic faults draw
+  from.  Arrival counting is per fault, under a lock — deterministic
+  for single-threaded seams (the chaos tests run prefetch depth 1 so
+  RPC arrivals are totally ordered)."""
+
+  def __init__(self, faults: List[Fault], seed: int = 0):
+    self.faults = list(faults)
+    self.seed = int(seed)
+    self.rng = random.Random(self.seed)
+    self._lock = threading.Lock()
+    self.fired: List[Dict[str, Any]] = []
+
+  def on(self, site: str, **ctx) -> List[Fault]:
+    """Record one arrival at ``site``; return the faults that fire."""
+    fired = []
+    with self._lock:
+      for f in self.faults:
+        if f.site != site or not f._matches(ctx):
+          continue
+        f._seen += 1
+        if f.nth <= f._seen < f.nth + f.count:
+          fired.append(f)
+          rec = {'site': site, 'action': f.action, 'arrival': f._seen}
+          rec.update({k: v for k, v in ctx.items()
+                      if isinstance(v, (str, int, float))})
+          self.fired.append(rec)
+    for f in fired:
+      _emit_injected(f, site, ctx)
+    return fired
+
+  def exhausted(self) -> bool:
+    """Every planned fault has fired its full count."""
+    with self._lock:
+      return all(f._seen >= f.nth + f.count - 1 for f in self.faults)
+
+
+def _emit_injected(f: Fault, site: str, ctx: Dict[str, Any]) -> None:
+  from ..telemetry.recorder import recorder
+  recorder.emit('fault.injected', site=site, action=f.action,
+                nth=f.nth, arrival=f._seen,
+                op=ctx.get('op'), worker=ctx.get('worker'),
+                epoch=ctx.get('epoch'),
+                secs=(f.secs if f.action == 'delay' else None))
+
+
+def parse_plan(spec) -> ChaosPlan:
+  """Parse a plan from a dict / list / JSON string / compact string."""
+  if isinstance(spec, ChaosPlan):
+    return spec
+  seed = 0
+  if isinstance(spec, str):
+    s = spec.strip()
+    if s.startswith('{') or s.startswith('['):
+      spec = json.loads(s)
+    else:
+      return ChaosPlan([_parse_compact(part)
+                        for part in s.split(';') if part.strip()])
+  if isinstance(spec, dict):
+    seed = int(spec.get('seed', 0))
+    spec = spec.get('faults', [])
+  faults = [f if isinstance(f, Fault) else Fault(**f) for f in spec]
+  return ChaosPlan(faults, seed=seed)
+
+
+def _parse_compact(part: str) -> Fault:
+  toks = part.strip().split(':')
+  if len(toks) < 2:
+    raise ValueError(f'bad compact fault {part!r}: need site:action')
+  kw: Dict[str, Any] = {'site': toks[0], 'action': toks[1]}
+  if len(toks) > 2 and toks[2]:
+    kw['nth'] = int(toks[2])
+  for tok in toks[3:]:
+    if '=' not in tok:
+      raise ValueError(f'bad compact fault field {tok!r} in {part!r}')
+    k, v = tok.split('=', 1)
+    if k in ('nth', 'count', 'worker', 'epoch', 'generation'):
+      kw[k] = int(v)
+    elif k == 'secs':
+      kw[k] = float(v)
+    else:
+      kw[k] = v
+  return Fault(**kw)
+
+
+# -- process-global plan ----------------------------------------------------
+_plan: Optional[ChaosPlan] = None
+_env_checked = False
+_install_lock = threading.Lock()
+
+
+def install(spec) -> ChaosPlan:
+  """Install ``spec`` as the process's active plan (replacing any)."""
+  global _plan, _env_checked
+  with _install_lock:
+    _plan = parse_plan(spec)
+    _env_checked = True
+  return _plan
+
+
+def uninstall() -> None:
+  """Deactivate chaos for this process (the env var stays untouched —
+  subprocesses spawned later still inherit it)."""
+  global _plan, _env_checked
+  with _install_lock:
+    _plan = None
+    _env_checked = True
+
+
+def active() -> Optional[ChaosPlan]:
+  """The process's plan, lazily initialized from ``GLT_FAULT_PLAN``
+  (how producer subprocesses and server processes pick chaos up)."""
+  global _plan, _env_checked
+  if _plan is None and not _env_checked:
+    with _install_lock:
+      if _plan is None and not _env_checked:
+        _env_checked = True
+        spec = os.environ.get(FAULT_PLAN_ENV)
+        if spec:
+          _plan = parse_plan(spec)
+  return _plan
+
+
+# -- seams ------------------------------------------------------------------
+def on(site: str, **ctx) -> List[Fault]:
+  """The generic seam: no-op (one global read) without a plan."""
+  p = active()
+  return p.on(site, **ctx) if p is not None else []
+
+
+def rpc_faults(op: str) -> List[Fault]:
+  """`RpcClient.request` seam, called once per attempt.  The caller
+  applies the returned actions (sleep for ``delay``, sever for
+  ``drop``, scramble the reply for ``corrupt``)."""
+  return on('rpc.request', op=op)
+
+
+def maybe_delay(faults: List[Fault]) -> None:
+  for f in faults:
+    if f.action == 'delay':
+      time.sleep(f.secs)
+
+
+def corrupt_payload(payload: bytes) -> bytes:
+  """Deterministically scramble a reply payload (bit-flip every 7th
+  byte) — enough to break both pickle and tensor-map parsing."""
+  buf = bytearray(payload)
+  if not buf:
+    return b'\xff\xff\xff\xff'
+  buf[::7] = bytes((b ^ 0xFF) for b in buf[::7])
+  return bytes(buf)
+
+
+def worker_kill_check(rank: int, epoch: int, generation: int = 0) -> None:
+  """Sampling-worker seam, called before each batch; a fired ``kill``
+  hard-exits the process (no cleanup — a real crash).  ``generation``
+  is the supervisor's restart count for this rank (0 = original)."""
+  for f in on('producer.worker', worker=rank, epoch=epoch,
+              generation=generation):
+    if f.action == 'kill':
+      os._exit(WORKER_KILL_EXIT)

@@ -163,13 +163,18 @@ class TestDistLoaderModes:
       loader.shutdown()
 
 
-def test_dead_workers_raise_not_hang():
-  """Crashed sampling pool surfaces as a RuntimeError (the reference's
+def test_dead_workers_raise_not_hang(monkeypatch):
+  """Crashed sampling pool surfaces as a typed error (the reference's
   MP_STATUS_CHECK_INTERVAL watchdog), never an infinite semaphore
-  wait.  The epoch is far larger than the channel capacity, so
-  terminating the workers mid-epoch is guaranteed to leave
-  outstanding batches — the test can only pass through the watchdog."""
-  from graphlearn_tpu.distributed import DistNeighborLoader
+  wait.  The restart budget is pinned to zero — with budget available
+  the supervisor would RESTART the pool and finish the epoch exactly
+  (tests/test_chaos.py pins that healing path); this test pins the
+  irrecoverable arm.  The epoch is far larger than the channel
+  capacity, so terminating the workers mid-epoch is guaranteed to
+  leave outstanding batches — the test can only pass through the
+  watchdog."""
+  from graphlearn_tpu.distributed import DistNeighborLoader, PeerLostError
+  monkeypatch.setenv('GLT_MAX_WORKER_RESTARTS', '0')
   ds = ring_dataset(n=40)
   seeds = np.tile(np.arange(40), 100)          # 500 batches expected
   loader = DistNeighborLoader(
@@ -183,7 +188,7 @@ def test_dead_workers_raise_not_hang():
     for w in loader._producer._workers:
       w.terminate()
       w.join(timeout=10)
-    with pytest.raises(RuntimeError, match='worker'):
+    with pytest.raises(PeerLostError, match='worker'):
       for _ in range(600):
         next(it)
   finally:

@@ -1,0 +1,254 @@
+"""Chaos suite (ISSUE 4): deterministic fault plans driven through the
+REAL runtime — producer worker kills mid-epoch, RPC connection drops
+and delays on the server-fed path — asserting exact batch accounting
+(expected count, zero duplicate '#SEQ', full seed coverage) and that
+the fault-free path is byte-identical with the resilience layer on.
+"""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu import native
+from graphlearn_tpu.telemetry import recorder
+from graphlearn_tpu.testing import chaos
+from graphlearn_tpu.testing.chaos import ChaosPlan, Fault, parse_plan
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason='native lib unavailable')
+
+N = 48
+BATCH = 8
+N_BATCHES = N // BATCH
+
+
+# -- plan grammar (no native needed, but grouped with the suite) ------------
+def test_parse_plan_json_and_compact():
+  p = parse_plan('{"seed": 7, "faults": [{"site": "rpc.request", '
+                 '"action": "drop", "nth": 3, "op": "fetch"}]}')
+  assert p.seed == 7 and p.faults[0].nth == 3
+  c = parse_plan('rpc.request:drop:3:op=fetch;'
+                 'producer.worker:kill:2:worker=0:epoch=1')
+  assert len(c.faults) == 2
+  assert c.faults[1] == Fault('producer.worker', 'kill', nth=2,
+                              worker=0, epoch=1)
+  with pytest.raises(ValueError):
+    parse_plan('nowhere:drop:1')
+  with pytest.raises(ValueError):
+    parse_plan('rpc.request:explode:1')
+
+
+def test_plan_counting_is_deterministic():
+  plan = ChaosPlan([Fault('rpc.request', 'drop', nth=2, count=2,
+                          op='fetch')])
+  fired = [bool(plan.on('rpc.request', op='fetch')) for _ in range(5)]
+  assert fired == [False, True, True, False, False]
+  # non-matching ops don't advance the counter
+  plan2 = ChaosPlan([Fault('rpc.request', 'drop', nth=2, op='fetch')])
+  plan2.on('rpc.request', op='other')
+  assert not plan2.on('rpc.request', op='fetch')
+  assert plan2.on('rpc.request', op='fetch')
+  assert plan2.exhausted()
+
+
+# -- shared fixtures --------------------------------------------------------
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+  from graphlearn_tpu.distributed.dist_loader import DistLoader
+  from graphlearn_tpu.distributed.resilience import reset_default_policy
+  monkeypatch.setenv('GLT_RPC_TIMEOUT', '10')
+  monkeypatch.setenv('GLT_RPC_DEADLINE', '30')
+  monkeypatch.setenv('GLT_RPC_BACKOFF_BASE', '0.02')
+  monkeypatch.setattr(DistLoader, 'RECV_POLL_SECS', 0.5)
+  reset_default_policy()
+  chaos.uninstall()
+  recorder.enable(None)
+  recorder.clear()
+  yield
+  chaos.uninstall()
+  recorder.clear()
+  recorder.disable()
+  reset_default_policy()
+
+
+def _ring(n=N, d=4):
+  from graphlearn_tpu.distributed import HostDataset
+  rows = np.repeat(np.arange(n), 2)
+  cols = np.stack([(np.arange(n) + 1) % n,
+                   (np.arange(n) + 2) % n], 1).reshape(-1)
+  feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, d))
+  return HostDataset.from_coo(rows, cols, n, node_features=feats,
+                              node_labels=np.arange(n) % 4)
+
+
+def _mp_loader(seed=3):
+  from graphlearn_tpu.distributed import (DistNeighborLoader,
+                                          MpDistSamplingWorkerOptions)
+  # spawn (not forkserver): workers inherit the CURRENT os.environ, so
+  # monkeypatched fault plans reach them deterministically
+  return DistNeighborLoader(
+      _ring(), [2], np.arange(N), batch_size=BATCH, shuffle=False,
+      worker_options=MpDistSamplingWorkerOptions(
+          num_workers=2, mp_start_method='spawn'),
+      to_device=False, seed=seed)
+
+
+def _drain(loader):
+  """One epoch -> [(sorted-seed-tuple, node-bytes, edge-bytes)]."""
+  out = []
+  for b in loader:
+    s = np.asarray(b.batch)
+    key = tuple(np.sort(s[s >= 0]).tolist())
+    out.append((key, np.asarray(b.node).tobytes(),
+                np.asarray(b.edge_index).tobytes()))
+  return out
+
+
+def _assert_exact(batches, loader=None):
+  assert len(batches) == N_BATCHES
+  seeds = sorted(x for key, _, _ in batches for x in key)
+  assert seeds == list(range(N)), 'lost or duplicated seeds'
+  if loader is not None:
+    assert len(loader._seen_seqs) == N_BATCHES, \
+        'duplicate or missing #SEQ stamps'
+
+
+# -- mp mode: worker kill mid-epoch -----------------------------------------
+def test_mp_worker_kill_restart_exact_and_byte_identical(monkeypatch,
+                                                         tmp_path):
+  jsonl = str(tmp_path / 'workers.jsonl')
+  # worker 0 dies before its 3rd batch of epoch 0 (it owns seqs 0-2):
+  # seqs 0,1 delivered, seq 2 replayed by the restarted worker
+  monkeypatch.setenv('GLT_FAULT_PLAN',
+                     'producer.worker:kill:3:worker=0:epoch=0')
+  monkeypatch.setenv('GLT_TELEMETRY_JSONL', jsonl)
+  loader = _mp_loader()
+  chaotic = _drain(loader)
+  _assert_exact(chaotic, loader)
+  restarts = recorder.events('producer.restart')
+  assert restarts, 'supervisor must have restarted the killed worker'
+  assert restarts[0]['worker'] == 0
+  assert restarts[0]['exitcode'] == chaos.WORKER_KILL_EXIT
+  assert restarts[0]['replayed'] >= 1
+  loader.shutdown()
+  # the killed worker recorded its own injected fault before dying
+  with open(jsonl) as f:
+    assert any('"kind": "fault.injected"' in ln and '"kill"' in ln
+               for ln in f), 'worker-side fault.injected missing'
+
+  # fault-free epoch, same config+seed, resilience layer still on:
+  # every batch byte-identical to the chaos run (replayed batches
+  # included — batch content is a function of (epoch, seq) only)
+  monkeypatch.delenv('GLT_FAULT_PLAN')
+  monkeypatch.delenv('GLT_TELEMETRY_JSONL')
+  chaos.uninstall()
+  clean_loader = _mp_loader()
+  clean = _drain(clean_loader)
+  clean_loader.shutdown()
+  _assert_exact(clean)
+  assert sorted(chaotic) == sorted(clean), \
+      'faulted epoch must be byte-identical to the fault-free epoch'
+
+
+def test_mp_worker_lost_raises_with_diagnostics(monkeypatch):
+  from graphlearn_tpu.distributed import PeerLostError
+  monkeypatch.setenv('GLT_FAULT_PLAN',
+                     'producer.worker:kill:1:worker=0:epoch=0')
+  monkeypatch.setenv('GLT_MAX_WORKER_RESTARTS', '0')
+  loader = _mp_loader()
+  with pytest.raises(PeerLostError, match='unrecoverable'):
+    _drain(loader)
+  loader.shutdown()
+  assert recorder.events('peer.lost'), 'loss must hit the recorder'
+
+
+def test_mp_worker_lost_degraded_finishes_on_survivors(monkeypatch):
+  # worker 0 dies before its FIRST batch and may not be restarted:
+  # its 3 batches are written off; the epoch finishes with worker 1's
+  monkeypatch.setenv('GLT_FAULT_PLAN',
+                     'producer.worker:kill:1:worker=0:epoch=0')
+  monkeypatch.setenv('GLT_MAX_WORKER_RESTARTS', '0')
+  monkeypatch.setenv('GLT_DEGRADED_OK', '1')
+  loader = _mp_loader()
+  batches = _drain(loader)
+  lost_evs = [e for e in recorder.events('peer.lost')
+              if e.get('degraded')]
+  assert lost_evs, 'degraded completion must be flagged in telemetry'
+  lost = sum(e['lost_batches'] for e in lost_evs)
+  assert lost >= 1
+  assert len(batches) == N_BATCHES - lost
+  # the surviving batches are still exact — no duplicates among them
+  seeds = sorted(x for key, _, _ in batches for x in key)
+  assert len(seeds) == len(set(seeds))
+  loader.shutdown()
+
+
+# -- remote mode: connection drop + delayed fetch ---------------------------
+def _server_chaos_proc(port_q, jsonl, worker_plan):
+  # env set BEFORE the producer pool exists: sampling workers inherit
+  # the kill plan and the telemetry sink from this process
+  if worker_plan:
+    os.environ['GLT_FAULT_PLAN'] = worker_plan
+  os.environ['GLT_TELEMETRY_JSONL'] = jsonl
+  from graphlearn_tpu.distributed import (init_server,
+                                          wait_and_shutdown_server)
+  recorder.enable(jsonl)
+  srv = init_server(num_servers=1, num_clients=1, rank=0,
+                    dataset=_ring(), host='127.0.0.1', port=0)
+  port_q.put(srv.port)
+  wait_and_shutdown_server(timeout=180)
+
+
+def test_remote_chaos_epoch_exact(monkeypatch, tmp_path):
+  """The acceptance scenario: one worker kill (server side) + one
+  connection drop + one delayed fetch in a single epoch -> exact batch
+  count, zero duplicate '#SEQ', producer.restart + rpc.retry events
+  present; the next (fault-free) epoch is exact too."""
+  jsonl = str(tmp_path / 'server.jsonl')
+  ctx = mp.get_context('spawn')
+  port_q = ctx.Queue()
+  p = ctx.Process(
+      target=_server_chaos_proc,
+      args=(port_q, jsonl, 'producer.worker:kill:3:worker=0:epoch=0'),
+      daemon=False)
+  p.start()
+  port = port_q.get(timeout=120)
+
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  init_client([('127.0.0.1', port)], rank=0, num_clients=1)
+  loader = DistNeighborLoader(
+      None, [2], np.arange(N), batch_size=BATCH, shuffle=False,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=0, num_workers=2, prefetch_size=1),
+      to_device=False, seed=3)
+  # prefetch_size=1 keeps fetch arrivals totally ordered, so 'nth'
+  # counting is deterministic; the 1.5s delay overshoots the 0.5s
+  # recv poll, driving the heartbeat slow-vs-dead probe
+  chaos.install(
+      'rpc.request:drop:3:op=fetch_one_sampled_message;'
+      'rpc.request:delay:5:op=fetch_one_sampled_message:secs=1.5')
+  epoch1 = _drain(loader)
+  _assert_exact(epoch1)
+  ch = loader.channel
+  assert len(ch._seen_seqs) == N_BATCHES, 'duplicate/missing #SEQ'
+  retries = recorder.events('rpc.retry')
+  assert retries, 'the dropped connection must surface as rpc.retry'
+  assert all(e['op'] == 'fetch_one_sampled_message' for e in retries)
+  assert chaos.active().exhausted(), 'every planned fault must fire'
+
+  chaos.uninstall()
+  epoch2 = _drain(loader)         # fault-free epoch after the storm
+  _assert_exact(epoch2)
+
+  loader.shutdown()
+  shutdown_client()
+  p.join(timeout=60)
+  assert not p.is_alive()
+  with open(jsonl) as f:
+    lines = f.read()
+  assert '"kind": "producer.restart"' in lines, \
+      'server-side supervisor must log the worker restart'
+  assert '"kind": "fault.injected"' in lines

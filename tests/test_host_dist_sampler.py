@@ -153,17 +153,28 @@ def test_missing_peer_raises(deployment):
     HostDistNeighborSampler(shards[0], [2], {})
 
 
-def test_dead_peer_raises_not_hangs(deployment):
-  """A peer that dies mid-epoch must surface a prompt error (socket
-  reset), never a silent under-sample or an indefinite hang — the
-  host-runtime arm of the failure-handling story."""
-  shards, services, addrs = deployment
-  sampler = HostDistNeighborSampler(shards[0], [2],
-                                    connect_peers(addrs, 0), seed=0)
-  # first batch works
-  sampler.sample_from_nodes(np.arange(4, dtype=np.int64))
-  services[1].shutdown()
-  with pytest.raises((ConnectionError, OSError)):
-    # remote-owned seeds force RPC to the dead peer
-    for _ in range(4):
-      sampler.sample_from_nodes(np.arange(N, dtype=np.int64))
+def test_dead_peer_raises_not_hangs(deployment, monkeypatch):
+  """A peer that dies mid-epoch must surface a typed error once the
+  retry deadline expires (a peer that came BACK inside the deadline
+  would heal the hop transparently — distributed/resilience.py),
+  never a silent under-sample or an indefinite hang — the
+  host-runtime arm of the failure-handling story.  The deadline is
+  shortened so 'prompt' stays prompt on the test clock."""
+  from graphlearn_tpu.distributed.resilience import (
+      RetryExhausted, reset_default_policy)
+  monkeypatch.setenv('GLT_RPC_DEADLINE', '2.0')
+  monkeypatch.setenv('GLT_RPC_BACKOFF_CAP', '0.2')
+  reset_default_policy()
+  try:
+    shards, services, addrs = deployment
+    sampler = HostDistNeighborSampler(shards[0], [2],
+                                      connect_peers(addrs, 0), seed=0)
+    # first batch works
+    sampler.sample_from_nodes(np.arange(4, dtype=np.int64))
+    services[1].shutdown()
+    with pytest.raises((RetryExhausted, ConnectionError, OSError)):
+      # remote-owned seeds force RPC to the dead peer
+      for _ in range(4):
+        sampler.sample_from_nodes(np.arange(N, dtype=np.int64))
+  finally:
+    reset_default_policy()         # don't leak the short deadline
