@@ -604,6 +604,53 @@ def main():
          batch=batch_size, num_parts=num_parts,
          platform=jax.devices()[0].platform)
 
+  # -- tiered rows (r10): static split vs cache + cold pipeline ----------
+  # The same workload against a split_ratio=0.3 store, twice: the r5
+  # static-split configuration (no cache, synchronous overlay) and the
+  # r10 default (HBM victim cache + double-buffered cold overlay).
+  # Both rows land in BENCH_ARTIFACT.jsonl; the bench.py twin of this
+  # measurement feeds the guarded `dist.tiered.seeds_per_sec` /
+  # `dist.feature.cache_hit_rate` regression keys.
+  import os
+  ds_t = DistDataset.from_full_graph(num_parts, rows, cols,
+                                     node_feat=feats, node_label=labels,
+                                     num_nodes=n, split_ratio=0.3)
+  for mode, env in (('static_split', {'GLT_COLD_CACHE_ROWS': '0',
+                                      'GLT_COLD_PREFETCH': '0'}),
+                    ('cached_pipelined', {})):
+    saved = {k: os.environ.pop(k, None)
+             for k in ('GLT_COLD_CACHE_ROWS', 'GLT_COLD_PREFETCH')}
+    os.environ.update(env)
+    try:
+      lt = DistNeighborLoader(ds_t, [10, 5], seeds, batch_size=512,
+                              shuffle=True, mesh=mesh, seed=0,
+                              prefetch=2)
+      it = iter(lt)
+      b = next(it)
+      b.x.block_until_ready()
+      nt = 0
+      with Timer() as t:
+        for b in it:
+          b.x.block_until_ready()
+          nt += 1
+      st = lt.sampler.exchange_stats(tick_metrics=False)
+      emit('dist_tiered_seeds_per_sec',
+           nt * 512 * num_parts / t.dt / 1e3, 'K seeds/s',
+           mode=mode, split_ratio=0.3, batch=512, num_parts=num_parts,
+           cold_cache_rows=(lt.sampler._cold_cache.capacity
+                            if lt.sampler._cold_cache else 0),
+           cold_lookups=st['dist.feature.cold_lookups'],
+           cold_misses=st['dist.feature.cold_misses'],
+           hot_hit_rate=round(st['dist.feature.hot_hit_rate'], 4),
+           cache_hit_rate=round(st['dist.feature.cache_hit_rate'], 4),
+           platform=jax.devices()[0].platform)
+    finally:
+      for k, v in saved.items():
+        if v is None:
+          os.environ.pop(k, None)
+        else:
+          os.environ[k] = v
+
   if args.fused:
     # fused whole-epoch vs per-batch loader + DP step, same workload
     # (the dispatch-overhead measurement, mesh edition)

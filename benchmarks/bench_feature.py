@@ -62,6 +62,9 @@ def main():
       out = sampler.sample_from_nodes(NodeSamplerInput(node=seeds))
       node_sets.append(np.asarray(out.node))
 
+  # legacy lookup sweep runs cache-OFF so its rows stay comparable
+  # across bench rounds (the r10 cache sweep below measures budgets)
+  os.environ['GLT_COLD_CACHE_ROWS'] = '0'
   for split_ratio in (() if args.overlap_only else (1.0, 0.5, 0.2)):
     for pallas in ((True, False) if split_ratio == 1.0 else (False,)):
       os.environ['GLT_PALLAS'] = '1' if pallas else '0'
@@ -88,6 +91,51 @@ def main():
            impl=('pallas' if pallas else 'xla'),
            platform=jax.devices()[0].platform)
   os.environ.pop('GLT_PALLAS', None)
+
+  # -- cold-cache budget sweep (r10): hit rate vs HBM spend --------------
+  # The same sampled node sets against the split_ratio=0.2 store, with
+  # the HBM victim cache (`data.cold_cache`) at 0 / 5% / 15% of the
+  # cold rows — the BENCH_ARTIFACT row behind the "how much cache buys
+  # how many hits" tradeoff (benchmarks/README "Cold-tier cache").
+  # Timed pass runs WARM (cache populated by the warmup pass), so the
+  # hit rate is the steady-state epoch>=2 number; stats reset between.
+  if not args.overlap_only:
+    split = 0.2
+    cold_rows = n - int(round(n * split))
+    for frac in (0.0, 0.05, 0.15):
+      budget = int(cold_rows * frac)
+      os.environ['GLT_COLD_CACHE_ROWS'] = str(budget)
+      ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
+      ds.init_node_features(feats, sort_func=sort_by_in_degree,
+                            split_ratio=split)
+      feat = ds.get_node_feature()
+      for ns in node_sets:
+        feat[ns].block_until_ready()
+      cache = feat._cold_cache
+      if cache is not None:
+        cache.stats.__init__()                    # steady-state window
+      feat.cold_stats['lookups'] = 0
+      feat.cold_stats['cold_lookups'] = 0
+      nbytes = 0
+      with Timer() as t:
+        res = None
+        for ns in node_sets:
+          res = feat[ns]
+          nbytes += res.size * res.dtype.itemsize
+        res.block_until_ready()
+      cold = max(feat.cold_stats['cold_lookups'], 1)
+      hits = cache.stats.hits if cache is not None else 0
+      emit('feature_cold_cache_gbps', nbytes / t.dt / 1e9, 'GB/s',
+           split_ratio=split, cache_rows=budget,
+           budget_frac=frac,
+           cache_hit_rate=round(hits / cold, 4),
+           cold_lookups=feat.cold_stats['cold_lookups'],
+           admits=cache.stats.admits if cache is not None else 0,
+           evicts=cache.stats.evicts if cache is not None else 0,
+           platform=jax.devices()[0].platform)
+    os.environ.pop('GLT_COLD_CACHE_ROWS', None)
+  else:
+    os.environ.pop('GLT_COLD_CACHE_ROWS', None)
 
   # -- cold-path overlap: prefetch=2 vs synchronous loader ---------------
   # The batch loop alternates a device compute step with the loader's

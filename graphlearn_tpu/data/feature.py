@@ -92,12 +92,18 @@ class Feature:
     device: optional explicit device for the hot tier.
     dtype: optional storage dtype for the hot tier (e.g. ``bfloat16`` —
       halves HBM footprint and feeds the MXU natively).
+    cold_cache_rows: HBM victim-cache budget over the cold tier
+      (`data.cold_cache`): ``'auto'`` (default) sizes it to
+      ``GLT_COLD_CACHE_ROWS`` or 15% of the cold rows, an int pins it,
+      0 disables.  Cache hits are served by a device gather (the cold
+      bytes stay in HBM across batches); only misses pay the host
+      gather + transfer.  Values are byte-identical either way.
   """
 
   def __init__(self, feature_array, id2index: Optional[np.ndarray] = None,
                split_ratio: float = 1.0,
                device: Optional[jax.Device] = None,
-               dtype=None):
+               dtype=None, cold_cache_rows='auto'):
     if isinstance(feature_array, jax.Array):
       # device-native construction (tables produced on device — e.g.
       # `benchmarks/common.build_products_device`): the array IS the
@@ -127,6 +133,9 @@ class Feature:
       self._id2index_dev = (None if id2index is None
                             else jnp.asarray(id2index, jnp.int32))
       self.hot_rows = feats.shape[0]
+      self._cache_rows = 0
+      self._cold_cache = None
+      self.cold_stats = {'lookups': 0, 'cold_lookups': 0}
       return
     feats = convert_to_array(feature_array)
     if feats.ndim == 1:
@@ -142,6 +151,17 @@ class Feature:
     n = feats.shape[0]
     self.hot_rows = int(round(n * self.split_ratio))
     self.hot_rows = max(0, min(self.hot_rows, n))
+    from .cold_cache import resolve_cache_rows
+    # the cache only bites on the MIXED path (0 < hot_rows < n): the
+    # fully-host path ships whole batches and the fully-HBM path has
+    # no cold tier to cache
+    self._cache_rows = (
+        resolve_cache_rows(cold_cache_rows, n - self.hot_rows)
+        if 0 < self.hot_rows < n else 0)
+    self._cold_cache = None     # DeviceColdCache (lazy, see lazy_init)
+    #: host-side cold accounting: lookups = valid ids per __getitem__,
+    #: cold_lookups = ids past the hot tier (the cache denominator)
+    self.cold_stats = {'lookups': 0, 'cold_lookups': 0}
 
   # -- lazy device residency (reference `Feature.lazy_init*`,
   # `data/feature.py:208-258`) -------------------------------------------
@@ -155,6 +175,10 @@ class Feature:
     self._hot = jax.device_put(hot, dev)
     if self._id2index_host is not None:
       self._id2index_dev = jax.device_put(self._id2index_host, dev)
+    if self._cache_rows and self._cold_cache is None:
+      from .cold_cache import DeviceColdCache
+      self._cold_cache = DeviceColdCache(
+          self._cache_rows, self.feature_dim, self.dtype, dev)
 
   @property
   def shape(self):
@@ -218,6 +242,8 @@ class Feature:
       return jnp.where(jnp.asarray(valid)[:, None], out, 0)
 
     cold_sel = valid & (idx >= self.hot_rows)
+    self.cold_stats['lookups'] += int(valid.sum())
+    self.cold_stats['cold_lookups'] += int(cold_sel.sum())
     if self.hot_rows == 0:
       # Fully host-resident: gather on host, one transfer.
       out = np.zeros((len(ids_host), d), dtype=self._host_feats.dtype)
@@ -228,27 +254,44 @@ class Feature:
       out = gather_rows(self._hot, jnp.asarray(idx.astype(np.int32)))
       return jnp.where(jnp.asarray(valid)[:, None], out, 0)
 
-    # Mixed: device gather for hot rows; cold rows host-gathered into a
-    # COMPACT [n_cold_pad, D] buffer (power-of-two padded so the number
-    # of compiled variants stays logarithmic) and expanded on device by
-    # a per-row rank map.  Ships only the cold bytes — a full-[B, D]
-    # staging buffer or a dynamic scatter is 10-200x slower (the former
-    # in transfer, the latter recompiling on every batch's cold count).
+    # Mixed: device gather for hot rows; cold rows first checked
+    # against the HBM victim cache (`data.cold_cache` — hits are a
+    # device gather, the bytes never leave HBM); residual misses are
+    # host-gathered into a COMPACT [n_miss_pad, D] buffer
+    # (power-of-two padded so the number of compiled variants stays
+    # logarithmic) and expanded on device by a per-row rank map.
+    # Ships only the miss bytes — a full-[B, D] staging buffer or a
+    # dynamic scatter is 10-200x slower (the former in transfer, the
+    # latter recompiling on every batch's cold count).
     hot_idx = np.where(cold_sel, 0, idx)
     out = gather_rows(self._hot, jnp.asarray(hot_idx.astype(np.int32)))
-    n_cold = int(cold_sel.sum())
-    cold_pad = next_power_of_two(n_cold)
+    cache = self._cold_cache
+    if cache is not None:
+      hit, slot = cache.lookup(idx, cold_sel)
+      miss_sel = cold_sel & ~hit
+    else:
+      hit = slot = None
+      miss_sel = cold_sel
+    n_miss = int(miss_sel.sum())
+    cold_pad = next_power_of_two(n_miss)
     compact = np.zeros((cold_pad, d), dtype=self._host_feats.dtype)
-    compact[:n_cold] = self._host_feats[idx[cold_sel]]
+    compact[:n_miss] = self._host_feats[idx[miss_sel]]
     if self._dtype is not None:
       compact = compact.astype(self._dtype)
     # rank[i] = position of row i's value in the compact buffer
-    rank = np.cumsum(cold_sel) - 1
-    rank = np.where(cold_sel, rank, 0).astype(np.int32)
+    rank = np.cumsum(miss_sel) - 1
+    rank = np.where(miss_sel, rank, 0).astype(np.int32)
     cold_rows = jnp.take(jnp.asarray(compact), jnp.asarray(rank), axis=0)
     hot_ok = jnp.asarray(valid & ~cold_sel)[:, None]
-    cold_ok = jnp.asarray(cold_sel)[:, None]
-    return jnp.where(hot_ok, out, jnp.where(cold_ok, cold_rows, 0))
+    cold_ok = jnp.asarray(miss_sel)[:, None]
+    x = jnp.where(hot_ok, out, jnp.where(cold_ok, cold_rows, 0))
+    if cache is not None:
+      x = cache.serve_hits(x, hit, slot)
+      admits, evicts = cache.admit(x, idx, miss_sel)
+      from .cold_cache import emit_cache_events
+      emit_cache_events('feature', int(hit.sum()), n_miss, admits,
+                        evicts)
+    return x
 
   def _device_get(self, ids: jax.Array) -> jax.Array:
     """All-device gather (fully-hot tables, device ids): no host sync."""

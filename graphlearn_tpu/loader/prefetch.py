@@ -92,6 +92,39 @@ class PrefetchingLoader:
   def _produce(self, seed_iter):
     raise NotImplementedError
 
+  def _pipeline_acquire(self, seed_iter):
+    """First half of the one-deep dispatch/finish pipeline: hand back
+    batch k's in-flight handle (dispatched during batch k-1), or —
+    pipeline cold, at epoch start — batch k's raw seeds for the caller
+    to dispatch.  Raises StopIteration at epoch end, and MUST be
+    called before the per-batch root span opens so an exhausted epoch
+    cannot emit an empty ``batch`` span.  Pipeline state is keyed on
+    the seed-iterator identity, so a new epoch (or an abandoned one)
+    can never consume a stale in-flight batch."""
+    if getattr(self, '_pending_src', None) is not seed_iter:
+      self._pending, self._pending_src = None, seed_iter
+    cur, self._pending = self._pending, None
+    if cur is None:
+      return None, next(seed_iter)     # StopIteration ends the epoch
+    return cur, None
+
+  def _pipelined(self, acquired, seed_iter, dispatch_flat, finish):
+    """Second half, inside the batch span: issue the device work for
+    batch k+1 before running batch k's host finish (``finish``) — the
+    asynchronous double-buffered cold overlay of the tiered mesh
+    loaders.  The host gather + transfer of batch k's cold rows then
+    overlaps with batch k+1's sampling compute, instead of serializing
+    after it.  Batches are byte-identical to the unpipelined path —
+    only the host/device interleaving changes."""
+    cur, flat = acquired
+    if cur is None:
+      cur = dispatch_flat(flat)
+    try:
+      self._pending = dispatch_flat(next(seed_iter))
+    except StopIteration:
+      pass
+    return finish(cur)
+
 
 class _SyncEpochIterator:
   """One synchronous epoch: ``iter()`` returns itself, so a warm-up

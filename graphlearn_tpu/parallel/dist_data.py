@@ -152,6 +152,19 @@ def scatter_partition_rows(root, host_parts, subpath: str, fname: str,
   return out
 
 
+def hot_count(counts, split_ratio: float) -> np.ndarray:
+  """THE hot-row arithmetic of the tiered store: how many of each
+  partition's ``counts`` rows are HBM-served at ``split_ratio``.
+  ONE definition shared by every site that tiers or addresses a
+  tiered layout (`build_dist_feature`, `tiered_local_feature`, and
+  any loader-side HBM-served predicate): the ceil-vs-round rounding
+  must agree everywhere, or the builder and the lookup path silently
+  disagree on which rows are hot and mis-tier the boundary row of
+  every partition."""
+  return np.ceil(np.asarray(counts) * float(split_ratio)).astype(
+      np.int64)
+
+
 _SCAN_CHUNK = 1 << 22
 
 
@@ -288,7 +301,7 @@ def tiered_local_feature(fs: np.ndarray, counts: np.ndarray,
   shared by the homo and hetero host-local loaders — the rounding and
   clamp must stay bit-identical to `build_dist_feature` or the
   host-local/single-controller relabel parity breaks."""
-  hot_counts = np.ceil(counts * float(split_ratio)).astype(np.int64)
+  hot_counts = hot_count(counts, split_ratio)
   hot_max = max(int(hot_counts.max()), 1)
   shards = np.zeros((len(host_parts), hot_max, fs.shape[-1]), fs.dtype)
   for j, p in enumerate(host_parts):
@@ -495,7 +508,7 @@ def build_dist_feature(feats: np.ndarray, old2new: np.ndarray,
   if not 0.0 <= split_ratio <= 1.0:
     raise ValueError(f'split_ratio must be in [0, 1], got {split_ratio}')
   tiered = split_ratio < 1.0
-  hot_counts = (np.ceil(counts * split_ratio).astype(np.int64)
+  hot_counts = (hot_count(counts, split_ratio)
                 if tiered else counts.astype(np.int64))
   hot_max = int(hot_counts.max()) if num_parts else 0
   if tiered:

@@ -700,7 +700,10 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
           nf.hot_counts, nf.cold_host, self.mesh, self.axis,
           self.num_parts, nodes_host=nodes_h)
       with self._stats_lock:
-        self._cold_lookups += lookups
+        # hetero engine: no dynamic cache yet — every cold request is
+        # host-served (cold_lookups == cold_misses)
+        self._feat_lookups += lookups
+        self._cold_lookups += misses
         self._cold_misses += misses
     hp = (self.ds.host_parts if self.ds.host_parts is not None
           else np.arange(self.num_parts))
@@ -727,7 +730,10 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
           self.num_parts, hp, cache_ids=nf.cache_ids, plan_=plan,
           agreed_capacity=cap)
       with self._stats_lock:
-        self._cold_lookups += lookups
+        # hetero engine: no dynamic cache yet — every cold request is
+        # host-served (cold_lookups == cold_misses)
+        self._feat_lookups += lookups
+        self._cold_lookups += misses
         self._cold_misses += misses
     return tuple(out)
 

@@ -919,12 +919,22 @@ class ExchangeTelemetry:
     self._stats_acc = jnp.zeros((len(EXCHANGE_STAT_NAMES),), jnp.int32)
     self._stats_total = np.zeros(len(EXCHANGE_STAT_NAMES), np.int64)
     self._stats_pending = 0
-    # host-side cold-tier counters (tiered feature stores only):
-    # lookups = valid node-table entries per step, misses = entries
-    # served from the host-DRAM cold tier.
+    # host-side cold-tier counters (tiered feature stores only).
+    # Definitions (benchmarks/README "Cold-tier metrics"):
+    #   lookups      = valid node-table feature lookups;
+    #   cold_lookups = lookups past the owner's hot count (the cold
+    #                  tier's demand — the cache denominator);
+    #   cold_misses  = cold lookups the HOST tier served (cache
+    #                  misses; each one is host-gather work);
+    #   cache_*      = dynamic HBM victim-cache traffic
+    #                  (`data.cold_cache`).
+    self._feat_lookups = 0
     self._cold_lookups = 0
     self._cold_misses = 0
-    self._cold_reported = (0, 0)
+    self._cache_hits = 0
+    self._cache_admits = 0
+    self._cache_evicts = 0
+    self._cold_reported = (0,) * 6
 
   def _accumulate_stats(self, stats_stacked) -> None:
     with self._stats_lock:
@@ -953,28 +963,45 @@ class ExchangeTelemetry:
       delta = np.asarray(jax.device_get(acc), np.int64)
       self._stats_total += delta
       totals = self._stats_total.copy()
-      cold_lookups, cold_misses = self._cold_lookups, self._cold_misses
-      cold_delta = (0, 0)
+      cold_now = (self._feat_lookups, self._cold_lookups,
+                  self._cold_misses, self._cache_hits,
+                  self._cache_admits, self._cache_evicts)
+      cold_delta = (0,) * 6
       if tick_metrics:
-        lk, ms = self._cold_reported
-        cold_delta = (cold_lookups - lk, cold_misses - ms)
-        self._cold_reported = (cold_lookups, cold_misses)
+        cold_delta = tuple(n - p for n, p
+                           in zip(cold_now, self._cold_reported))
+        self._cold_reported = cold_now
     out = {f'dist.{n}': int(v)
            for n, v in zip(EXCHANGE_STAT_NAMES, totals)}
+    lookups, cold_lookups, cold_misses, hits, admits, evicts = cold_now
+    out['dist.feature.lookups'] = lookups
     out['dist.feature.cold_lookups'] = cold_lookups
     out['dist.feature.cold_misses'] = cold_misses
-    out['dist.feature.cold_hit_rate'] = (
-        1.0 - cold_misses / cold_lookups if cold_lookups else 1.0)
+    out['dist.feature.cache_hits'] = hits
+    out['dist.feature.cache_admits'] = admits
+    out['dist.feature.cache_evicts'] = evicts
+    # hot_hit_rate: fraction of feature lookups the HBM hot tier
+    # served (what r5's "cold_hit_rate" actually measured);
+    # cache/cold_hit_rate: fraction of COLD lookups served on-device
+    # by the victim cache — each miss is host-gather work.  See
+    # benchmarks/README "Cold-tier metrics".
+    out['dist.feature.hot_hit_rate'] = (
+        1.0 - cold_lookups / lookups if lookups else 1.0)
+    out['dist.feature.cache_hit_rate'] = (
+        1.0 - cold_misses / cold_lookups if cold_lookups else 0.0)
+    out['dist.feature.cold_hit_rate'] = out[
+        'dist.feature.cache_hit_rate']
     if tick_metrics:
       from ..telemetry.recorder import recorder
       from ..utils.profiling import metrics
       for n, d in zip(EXCHANGE_STAT_NAMES, delta):
         if d:
           metrics.inc(f'dist.{n}', float(d))
-      if cold_delta[0] > 0:
-        metrics.inc('dist.feature.cold_lookups', float(cold_delta[0]))
-      if cold_delta[1] > 0:
-        metrics.inc('dist.feature.cold_misses', float(cold_delta[1]))
+      for n, d in zip(('lookups', 'cold_lookups', 'cold_misses',
+                       'cache_hits', 'cache_admits', 'cache_evicts'),
+                      cold_delta):
+        if d > 0:
+          metrics.inc(f'dist.feature.{n}', float(d))
       if delta.any():
         # one flight-recorder event per drain window: the since-last
         # deltas, so a JSONL reader sees the exchange trajectory
@@ -983,11 +1010,14 @@ class ExchangeTelemetry:
             'dist.exchange',
             **{n.replace('.', '_'): int(d)
                for n, d in zip(EXCHANGE_STAT_NAMES, delta)})
-      if cold_delta[0] > 0:
-        recorder.emit('dist.cold_tier', lookups=int(cold_delta[0]),
-                      misses=int(cold_delta[1]),
+      if cold_delta[1] > 0:
+        recorder.emit('dist.cold_tier',
+                      lookups=int(cold_delta[0]),
+                      cold_lookups=int(cold_delta[1]),
+                      misses=int(cold_delta[2]),
+                      cache_hits=int(cold_delta[3]),
                       hit_rate=round(
-                          1.0 - cold_delta[1] / cold_delta[0], 6))
+                          1.0 - cold_delta[2] / cold_delta[1], 6))
     return out
 
   def cluster_exchange_stats(self) -> dict:
@@ -1007,13 +1037,19 @@ class ExchangeTelemetry:
     st = dict(self.exchange_stats())
     num_hosts = jax.process_count()
     if num_hosts > 1:
-      lookups, misses = allgather_sum_int(
-          [st['dist.feature.cold_lookups'],
-           st['dist.feature.cold_misses']])
-      st['dist.feature.cold_lookups'] = lookups
-      st['dist.feature.cold_misses'] = misses
-      st['dist.feature.cold_hit_rate'] = (
-          1.0 - misses / lookups if lookups else 1.0)
+      keys = ('lookups', 'cold_lookups', 'cold_misses', 'cache_hits',
+              'cache_admits', 'cache_evicts')
+      summed = allgather_sum_int(
+          [st[f'dist.feature.{k}'] for k in keys])
+      for k, v in zip(keys, summed):
+        st[f'dist.feature.{k}'] = v
+      lookups, cold_lookups, cold_misses = summed[:3]
+      st['dist.feature.hot_hit_rate'] = (
+          1.0 - cold_lookups / lookups if lookups else 1.0)
+      st['dist.feature.cache_hit_rate'] = (
+          1.0 - cold_misses / cold_lookups if cold_lookups else 0.0)
+      st['dist.feature.cold_hit_rate'] = st[
+          'dist.feature.cache_hit_rate']
     st['num_hosts'] = num_hosts
     st.update(exchange_summary(st))
     return st
@@ -1058,7 +1094,8 @@ class DistNeighborSampler(ExchangeTelemetry):
                mesh: Optional[Mesh] = None, axis: str = 'data',
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, exchange_slack: Optional[float] = None,
-               exchange_layout: Optional[str] = None):
+               exchange_layout: Optional[str] = None,
+               cold_cache_rows='auto'):
     from .dp import make_mesh
     self.ds = dataset
     self.fanouts = tuple(int(k) for k in num_neighbors)
@@ -1084,6 +1121,12 @@ class DistNeighborSampler(ExchangeTelemetry):
     # `data/feature.py:174-206` + `csrc/cuda/unified_tensor.cu:202+`.
     self.tiered = (self.collect_features
                    and dataset.node_features.is_tiered)
+    # dynamic HBM victim cache over cold rows (`data.cold_cache`):
+    # built lazily on the first cold overlay; 'auto' sizes it to
+    # GLT_COLD_CACHE_ROWS or 15% of the largest partition's cold rows
+    self._cold_cache_spec = cold_cache_rows
+    self._cold_cache = None
+    self._cold_cache_built = False
     # SURVEY §7 "partition-aware capacity tuning": e.g. 2.0 sends
     # 2x the balanced share per destination instead of the full
     # frontier (P/2 x fewer exchanged bytes); overflowed ids lose
@@ -1194,15 +1237,27 @@ class DistNeighborSampler(ExchangeTelemetry):
                 num_parts=self.num_parts,
                 slack=self.exchange_slack, **fields)
 
-  def sample_from_nodes(self, seeds_stacked: np.ndarray):
+  def sample_from_nodes(self, seeds_stacked: np.ndarray, key=None):
     """``seeds_stacked``: ``[P, B]`` per-device seed batches (relabeled
-    id space, -1 padded).  Returns stacked pytree pieces."""
+    id space, -1 padded).  Returns stacked pytree pieces.  ``key``
+    overrides the internal key stream (the fused-vs-per-batch parity
+    tests drive both engines with identical keys)."""
+    return self._finish_nodes(self._dispatch_nodes(seeds_stacked, key))
+
+  def _dispatch_nodes(self, seeds_stacked: np.ndarray, key=None):
+    """Dispatch the SPMD sample+collect step WITHOUT the cold-tier
+    finish: the returned dict's arrays are in flight on device.  With
+    `_finish_nodes` this is the loaders' double-buffered cold
+    pipeline — batch k+1's sampling runs on device while batch k's
+    cold overlay does its host work (`PrefetchingLoader._pipelined`).
+    """
     from ..telemetry.spans import span
     b = seeds_stacked.shape[1]
     step = self.step_for_batch(b)
     arrs = self._arrays()
     self._step_cnt += 1
-    key = jax.random.fold_in(self._base_key, self._step_cnt)
+    if key is None:
+      key = jax.random.fold_in(self._base_key, self._step_cnt)
     # 'sample.exchange': the fused sample+exchange SPMD dispatch —
     # async, so its duration is dispatch latency; sync time (the
     # stage-attribution signal) lands in the feature.lookup child
@@ -1221,12 +1276,23 @@ class DistNeighborSampler(ExchangeTelemetry):
     # outside the span: the every-64th-call drain blocks on the
     # device, and that sync must not masquerade as dispatch latency
     self._accumulate_stats(stats)
-    x = self._maybe_overlay_cold(x, nodes)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, seed_local=seed_local, x=x, y=y, ef=ef,
-                num_sampled_nodes=nsn, batch=seeds_dev)
+                num_sampled_nodes=nsn, batch=seeds_dev,
+                overlay_step=self._step_cnt)
 
-  def _maybe_overlay_cold(self, x, nodes):
+  def _finish_nodes(self, out: dict) -> dict:
+    """The host half of a dispatched step: the cold-tier overlay
+    (no-op for untiered stores).  ``overlay_step`` pins the span to
+    the step that DISPATCHED this batch — under the cold pipeline
+    batch k+1's dispatch has already advanced ``_step_cnt`` by the
+    time batch k's overlay runs."""
+    out['x'] = self._maybe_overlay_cold(out['x'], out['node'],
+                                        step=out.pop('overlay_step',
+                                                     None))
+    return out
+
+  def _maybe_overlay_cold(self, x, nodes, step=None):
     """Overlay host-DRAM cold-tier rows onto the exchanged features
     (requester-side `overlay_cold_host` for single-controller
     ``cold_host`` tables; owner-served `overlay_cold_owner` for
@@ -1234,28 +1300,125 @@ class DistNeighborSampler(ExchangeTelemetry):
     if not self.tiered or x is None:
       return x
     from ..telemetry.spans import span
-    with span('feature.lookup', step=self._step_cnt):
+    with span('feature.lookup',
+              step=self._step_cnt if step is None else step):
       return self._overlay_cold_traced(x, nodes)
+
+  def _ensure_cold_cache(self):
+    """Build the `MeshColdCache` on first use (the budget needs the
+    feature dim and the partitions' cold-row counts, both known only
+    for tiered stores)."""
+    if self._cold_cache_built:
+      return self._cold_cache
+    self._cold_cache_built = True
+    if not self.tiered:
+      return None
+    from ..data.cold_cache import MeshColdCache, resolve_cache_rows
+    nf = self.ds.node_features
+    counts = np.diff(self.ds.graph.bounds)
+    cold_rows = int(np.maximum(counts - nf.hot_counts, 0).max(
+        initial=0))
+    cap = resolve_cache_rows(self._cold_cache_spec, cold_rows)
+    if cap > 0:
+      num_local = (len(self.ds.host_parts)
+                   if self.ds.host_parts is not None
+                   else self.num_parts)
+      shard = NamedSharding(self.mesh, P(self.axis))
+      putS = (self._put_stacked
+              if self.ds.host_parts is not None
+              else (lambda a: jax.device_put(a, shard)))
+      self._cold_cache = MeshColdCache(
+          cap, nf.shards.shape[-1], nf.shards.dtype, num_local,
+          self.mesh, self.axis, putS)
+    return self._cold_cache
 
   def _overlay_cold_traced(self, x, nodes):
     """The overlay body, under `_maybe_overlay_cold`'s span — the
     span exists only for tiered stores, where this is the per-batch
-    host sync worth attributing."""
+    host sync worth attributing.
+
+    Order of service per batch: (1) hits in the dynamic HBM victim
+    cache are overlaid by a purely local device gather (no host
+    bytes); (2) residual misses ride the host cold tier
+    (requester-side `overlay_cold_host` or owner-served
+    `overlay_cold_owner`); (3) the now-corrected miss rows are
+    admitted into the cache (device→device `at[].set`), so the next
+    batch's repeats hit — the cross-batch cold-id dedup.
+    """
+    from ..data.cold_cache import emit_cache_events
     nf = self.ds.node_features
+    g = self.ds.graph
+    cache = self._ensure_cold_cache()
+    hits = admits = evicts = 0
     if nf.cold_host is not None:
-      x, lookups, misses = overlay_cold_host(
-          x, nodes, self.ds.graph.bounds, nf.hot_counts, nf.cold_host,
-          self.mesh, self.axis, self.num_parts)
+      # single-controller table: every shard addressable
+      nodes_l = np.asarray(jax.device_get(nodes)).astype(np.int64)
+      valid = nodes_l >= 0
+      owner = np.clip(
+          np.searchsorted(g.bounds, nodes_l, side='right') - 1, 0,
+          self.num_parts - 1)
+      local = np.where(valid, nodes_l - g.bounds[owner], 0)
+      cold = valid & (local >= nf.hot_counts[owner])
+      lookups, cold_n = int(valid.sum()), int(cold.sum())
+      miss = cold
+      if cache is not None:
+        hit, slot = cache.lookup(nodes_l, cold)
+        hits = int(hit.sum())
+        x = cache.serve(x, hit, slot)
+        miss = cold & ~hit
+      x, _, served = overlay_cold_host(
+          x, nodes, g.bounds, nf.hot_counts, nf.cold_host, self.mesh,
+          self.axis, self.num_parts, nodes_host=nodes_l,
+          cold_mask=miss)
+      if cache is not None and miss.any():
+        plans = cache.plan_admissions(nodes_l, miss)
+        admits, evicts = cache.commit_admissions(
+            x, plans, cache.admit_width(plans))
     else:
       hp = (self.ds.host_parts if self.ds.host_parts is not None
             else np.arange(self.num_parts))
-      x, lookups, misses = overlay_cold_owner(
-          x, nodes, self.ds.graph.bounds, nf.hot_counts, nf.cold_local,
-          self.mesh, self.axis, self.num_parts, hp,
-          cache_ids=nf.cache_ids)
+      plan = plan_cold_requests(nodes, g.bounds, nf.hot_counts, hp,
+                                cache_ids=nf.cache_ids)
+      hp_, nodes_l, valid, owner, cold, counts, lookups = plan
+      cold_n = int(cold.sum())
+      if cache is not None:
+        hit, slot = cache.lookup(nodes_l, cold)
+        hits = int(hit.sum())
+        # serve runs UNCONDITIONALLY under multiple controllers: every
+        # process must dispatch the same programs on the global arrays
+        x = cache.serve(x, hit, slot)
+        miss = cold & ~hit
+        counts = np.zeros_like(counts)
+        sel_j, sel_pos = np.nonzero(miss)
+        if len(sel_j):
+          np.add.at(counts, (sel_j, owner[sel_j, sel_pos]), 1)
+        plan = (hp_, nodes_l, valid, owner, miss, counts, lookups)
+        adm_plans = cache.plan_admissions(nodes_l, miss)
+        # ONE handshake agrees on both per-batch program widths
+        caps = _global_max_vec([int(counts.max(initial=0)),
+                                cache.admit_width(adm_plans)])
+        x, _, served = overlay_cold_owner(
+            x, nodes, g.bounds, nf.hot_counts, nf.cold_local,
+            self.mesh, self.axis, self.num_parts, hp, plan_=plan,
+            agreed_capacity=caps[0])
+        admits, evicts = cache.commit_admissions(x, adm_plans,
+                                                 caps[1])
+      else:
+        x, _, served = overlay_cold_owner(
+            x, nodes, g.bounds, nf.hot_counts, nf.cold_local,
+            self.mesh, self.axis, self.num_parts, hp, plan_=plan)
     with self._stats_lock:
-      self._cold_lookups += lookups
-      self._cold_misses += misses
+      self._feat_lookups += lookups
+      self._cold_lookups += cold_n
+      self._cold_misses += served
+      self._cache_hits += hits
+      self._cache_admits += admits
+      self._cache_evicts += evicts
+    if cache is not None:
+      # cache-off runs (GLT_COLD_CACHE_ROWS=0, the static-split bench
+      # baseline) must not record phantom cache.miss traffic — cold
+      # service without a cache is already visible as cold_misses
+      emit_cache_events('dist', hits, served, admits, evicts)
     return x
 
 
@@ -1267,7 +1430,8 @@ def _overlay_cold_rows(x, mask, rank, compact):
 
 
 def overlay_cold_host(x, nodes, bounds, hot_counts, cold_host, mesh,
-                      axis: str, num_parts: int, nodes_host=None):
+                      axis: str, num_parts: int, nodes_host=None,
+                      cold_mask=None):
   """Serve cold-tier rows (host DRAM) for node-table entries the HBM
   exchange zeroed — shared by the homo and hetero mesh engines.
 
@@ -1283,15 +1447,21 @@ def overlay_cold_host(x, nodes, bounds, hot_counts, cold_host, mesh,
   Returns ``(x', lookups, misses)`` for the caller's telemetry.
   ``nodes_host`` skips the device_get when the caller already fetched
   the table (the hetero engine batches ONE sync over all node types).
+  ``cold_mask`` overrides the cold-row predicate with a precomputed
+  mask (the cache-aware caller passes ``cold & ~cache_hit`` so served
+  rows skip the host gather).
   """
   from ..utils.padding import next_power_of_two
   nodes_h = np.asarray(nodes_host if nodes_host is not None
                        else jax.device_get(nodes)).astype(np.int64)
-  owner = np.clip(np.searchsorted(bounds, nodes_h, side='right') - 1,
-                  0, num_parts - 1)
   valid = nodes_h >= 0
-  local = np.where(valid, nodes_h - bounds[owner], 0)
-  cold = valid & (local >= hot_counts[owner])
+  if cold_mask is not None:
+    cold = cold_mask
+  else:
+    owner = np.clip(np.searchsorted(bounds, nodes_h, side='right') - 1,
+                    0, num_parts - 1)
+    local = np.where(valid, nodes_h - bounds[owner], 0)
+    cold = valid & (local >= hot_counts[owner])
   lookups = int(valid.sum())
   n_cold = int(cold.sum())
   if n_cold == 0:
@@ -1756,7 +1926,7 @@ class DistNeighborLoader(PrefetchingLoader):
                seed: int = 0, input_space: str = 'old',
                exchange_slack='auto',
                exchange_layout: Optional[str] = None,
-               prefetch: int = 0):
+               prefetch: int = 0, cold_cache_rows='auto'):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
     slack = resolve_exchange_slack(exchange_slack, shuffle)
@@ -1765,10 +1935,17 @@ class DistNeighborLoader(PrefetchingLoader):
         collect_features=collect_features, seed=seed,
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
                         else slack),
-        exchange_layout=exchange_layout)
+        exchange_layout=exchange_layout,
+        cold_cache_rows=cold_cache_rows)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0
+    import os
+    # tiered stores default to the double-buffered cold overlay
+    # (GLT_COLD_PREFETCH=0 opts out; batches are byte-identical)
+    self._cold_pipeline = (self.sampler.tiered
+                           and os.environ.get('GLT_COLD_PREFETCH',
+                                              '1') != '0')
     self.ds = dataset
     seeds = np.asarray(input_nodes).reshape(-1)
     if input_space == 'old' and dataset.old2new is not None:
@@ -1806,18 +1983,35 @@ class DistNeighborLoader(PrefetchingLoader):
       recorder.emit('hop.padding', scope='dist_loader',
                     batch=self._batch_idx, **row)
 
+  def _dispatch_flat(self, flat):
+    seeds = flat.reshape(self.num_parts, self.batch_size)  # [P * B]
+    return self.sampler._dispatch_nodes(seeds)
+
   def _produce(self, seed_iter):
     from ..loader.transform import Batch
     from ..telemetry.spans import span
-    flat = next(seed_iter)                         # [P * B]
+    # acquire BEFORE the span: epoch end (StopIteration) must not
+    # emit an empty `batch` root span
+    if self._cold_pipeline:
+      acquired = self._pipeline_acquire(seed_iter)
+    else:
+      flat = next(seed_iter)                       # [P * B]
     # 'batch' is the per-batch ROOT span; the sampler's
     # sample.exchange / feature.lookup spans nest under it, and
     # 'stitch' covers the Batch assembly — the causal tree stage
     # attribution reads
     with span('batch', scope='DistNeighborLoader',
               batch=getattr(self, '_batch_idx', 0) + 1):
-      seeds = flat.reshape(self.num_parts, self.batch_size)
-      out = self.sampler.sample_from_nodes(seeds)
+      if self._cold_pipeline:
+        # tiered stores: double-buffered cold overlay — batch k+1's
+        # sampling is dispatched before batch k's overlay syncs
+        # (`PrefetchingLoader._pipelined`; GLT_COLD_PREFETCH=0 off)
+        out = self._pipelined(acquired, seed_iter,
+                              self._dispatch_flat,
+                              self.sampler._finish_nodes)
+      else:
+        seeds = flat.reshape(self.num_parts, self.batch_size)
+        out = self.sampler.sample_from_nodes(seeds)
       self._maybe_emit_hop_events(out['num_sampled_nodes'])
       with span('stitch'):
         edge_index = jnp.stack([out['row'], out['col']],
@@ -1945,15 +2139,21 @@ class DistLinkNeighborSampler(DistNeighborSampler):
             ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
     return self._steps[cfg]
 
-  def sample_from_edges(self, pairs_stacked: np.ndarray):
+  def sample_from_edges(self, pairs_stacked: np.ndarray, key=None):
     """``pairs_stacked``: ``[P, B, 2|3]`` per-device (src, dst[, label])
     seed edges in the relabeled id space, -1 padded."""
+    return self._finish_edges(self._dispatch_edges(pairs_stacked, key))
+
+  def _dispatch_edges(self, pairs_stacked: np.ndarray, key=None):
+    """Link twin of `_dispatch_nodes` (the cold pipeline's dispatch
+    half)."""
     from ..telemetry.spans import span
     p, b = pairs_stacked.shape[:2]
     step = self.step_for_pairs(b, pairs_stacked.shape[2])
     arrs = self._arrays()
     self._step_cnt += 1
-    key = jax.random.fold_in(self._base_key, self._step_cnt)
+    if key is None:
+      key = jax.random.fold_in(self._base_key, self._step_cnt)
     with span('sample.exchange', step=self._step_cnt, batch=b,
               mode='link'):
       pairs_dev = jax.device_put(
@@ -1967,12 +2167,18 @@ class DistLinkNeighborSampler(DistNeighborSampler):
                arrs['efshards'], arrs['ebounds'],
                arrs['hcounts'], key)
     self._accumulate_stats(stats)
-    x = self._maybe_overlay_cold(x, nodes)
     md = link_step_metadata(self.neg_mode, seed_local, eli, elab,
                             elab_mask, src_idx, dst_pos, dst_neg)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, x=x, y=y, ef=ef, num_sampled_nodes=nsn,
-                batch=pairs_dev[:, :, 0], metadata=md)
+                batch=pairs_dev[:, :, 0], metadata=md,
+                overlay_step=self._step_cnt)
+
+  def _finish_edges(self, out: dict) -> dict:
+    out['x'] = self._maybe_overlay_cold(out['x'], out['node'],
+                                        step=out.pop('overlay_step',
+                                                     None))
+    return out
 
 
 class DistLinkNeighborLoader(PrefetchingLoader):
@@ -1998,7 +2204,7 @@ class DistLinkNeighborLoader(PrefetchingLoader):
                seed: int = 0, input_space: str = 'old',
                exchange_slack='auto',
                exchange_layout: Optional[str] = None,
-               prefetch: int = 0):
+               prefetch: int = 0, cold_cache_rows='auto'):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
     slack = resolve_exchange_slack(exchange_slack, shuffle)
@@ -2008,10 +2214,15 @@ class DistLinkNeighborLoader(PrefetchingLoader):
         seed=seed,
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
                         else slack),
-        exchange_layout=exchange_layout)
+        exchange_layout=exchange_layout,
+        cold_cache_rows=cold_cache_rows)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0
+    import os
+    self._cold_pipeline = (self.sampler.tiered
+                           and os.environ.get('GLT_COLD_PREFETCH',
+                                              '1') != '0')
     self.pairs = pack_link_seeds_relabeled(
         edge_label_index, edge_label, self.sampler.neg_mode, dataset,
         input_space)
@@ -2024,13 +2235,26 @@ class DistLinkNeighborLoader(PrefetchingLoader):
   def __len__(self):
     return len(self._batcher)
 
+  def _dispatch_flat(self, flat):
+    pairs = flat.reshape(self.num_parts, self.batch_size, -1)
+    return self.sampler._dispatch_edges(pairs)
+
   def _produce(self, seed_iter):
     from ..loader.transform import Batch
     from ..telemetry.spans import span
-    flat = next(seed_iter)                         # [P * B, 2|3]
+    # acquire BEFORE the span (see DistNeighborLoader._produce)
+    if self._cold_pipeline:
+      acquired = self._pipeline_acquire(seed_iter)
+    else:
+      flat = next(seed_iter)                       # [P * B, 2|3]
     with span('batch', scope='DistLinkNeighborLoader'):
-      pairs = flat.reshape(self.num_parts, self.batch_size, -1)
-      out = self.sampler.sample_from_edges(pairs)
+      if self._cold_pipeline:
+        out = self._pipelined(acquired, seed_iter,
+                              self._dispatch_flat,
+                              self.sampler._finish_edges)
+      else:
+        pairs = flat.reshape(self.num_parts, self.batch_size, -1)
+        out = self.sampler.sample_from_edges(pairs)
       with span('stitch'):
         edge_index = jnp.stack([out['row'], out['col']], axis=1)
         return Batch(

@@ -18,12 +18,27 @@ accumulator, so `exchange_stats()` reads the same numbers the per-batch
 path would produce.
 
 Constraints (checked at construction):
-  * non-tiered feature store — the cold-tier overlay is a host-side
-    gather per batch, which is exactly the per-batch loader's
-    ``prefetch=2`` territory;
   * static exchange slack — ``'adaptive'`` retunes between batches on
     the host, which a single fused program precludes by design
     (``'auto'`` resolves to the capacity default, as in the loaders).
+
+TIERED stores (``split_ratio < 1``) run as **tiered fused epochs**
+(ISSUE 5): the epoch splits into chunks of ``GLT_FUSED_COLD_CHUNK``
+steps and each chunk runs THREE dispatches instead of one —
+
+  1. a compiled sample+collect scan (the same SPMD step the per-batch
+     sampler dispatches; cold rows come back zeroed past the owner's
+     hot count);
+  2. the host cold service BETWEEN dispatches: hits in the dynamic
+     HBM victim cache (`data.cold_cache`) are overlaid by a local
+     device gather, residual misses ride the bounded per-chunk host
+     overlay (`overlay_cold_host` / `overlay_cold_owner`), and the
+     corrected rows are admitted back into the cache;
+  3. a compiled train scan over the chunk's corrected batches.
+
+Batches are byte-identical to the per-batch tiered loader driven with
+the same keys; the fused dispatch structure (O(S/chunk) programs, not
+O(S) sampler+train dispatches) survives tiering.
 """
 from __future__ import annotations
 
@@ -35,7 +50,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..loader.fused import _uncached_jit
+from ..loader.fused import _uncached_jit, resolve_cold_chunk
 from ..models.train import TrainState
 from .dist_data import DistDataset
 from .dist_sampler import (DistLinkNeighborSampler, DistNeighborSampler,
@@ -48,6 +63,10 @@ from .dp import (make_dp_eval_step, make_dp_supervised_step,
 class _MeshEpochDriver:
   """Host-driver pieces shared by the three fused mesh classes, so
   the seed/key/device-put contracts cannot drift between them."""
+
+  #: True = tiered store: run()/evaluate() take the chunked
+  #: collect → cold-service → consume path (module docstring)
+  _tiered = False
 
   def _next_epoch_key(self):
     self._epoch_idx += 1
@@ -93,15 +112,85 @@ class _MeshEpochDriver:
     seeds = flat.reshape(-1, self.num_parts, self.batch_size)
     key = self._next_epoch_key()
     with span('fused.epoch', scope=type(self).__name__,
-              epoch=self._epoch_idx, steps=seeds.shape[0]):
+              epoch=self._epoch_idx, steps=seeds.shape[0],
+              tiered=self._tiered):
       with step_annotation('fused_dist_epoch', self._epoch_idx):
-        with span('fused.dispatch'):
-          state, losses, correct, valid, stats, hops = self._compiled(
-              state, self._put_batches(seeds), key,
-              self.sampler._arrays())
-      self.sampler._accumulate_stats(stats)
+        if self._tiered:
+          state, losses, correct, valid, hops = self._run_tiered(
+              state, seeds, key)
+        else:
+          with span('fused.dispatch'):
+            (state, losses, correct, valid, stats,
+             hops) = self._compiled(state, self._put_batches(seeds),
+                                    key, self.sampler._arrays())
+          self.sampler._accumulate_stats(stats)
       self._emit_hop_events(hops, seeds.shape[0])
     return state, EpochStats(losses, correct, valid)
+
+  # -- tiered fused epochs (module docstring) -------------------------------
+
+  def _chunk_key_stack(self, key, c0: int, n: int):
+    """Per-step keys for one chunk, in the GLOBAL step index domain —
+    the same ``fold_in(epoch_key, i)`` schedule the single-program
+    scan uses, so tiered and untiered epochs draw identically."""
+    return jnp.stack([jax.random.fold_in(key, i)
+                      for i in range(c0, c0 + n)])
+
+  def _cold_chunk_steps(self, total_steps: int) -> int:
+    return resolve_cold_chunk(self._collect_step_bytes(), total_steps)
+
+  def _tiered_chunks(self, stacked: np.ndarray, key, chunk: int):
+    """Yield ``(c0, real_steps, [chunk, ...] piece, [chunk] keys)``:
+    tail chunks pad with INVALID_ID seed rows (the loader twin's
+    `_chunks` convention — every epoch length reuses ONE compile per
+    collect/train/eval program; padded steps sample nothing and
+    contribute no valid seeds).  Consumers must slice per-step
+    outputs (losses, stats) to ``real_steps``."""
+    s = stacked.shape[0]
+    for c0 in range(0, s, chunk):
+      part = stacked[c0:c0 + chunk]
+      real = part.shape[0]
+      if real < chunk:
+        pad = np.full((chunk - real,) + stacked.shape[1:], -1,
+                      stacked.dtype)
+        part = np.concatenate([part, pad])
+      yield c0, real, part, self._chunk_key_stack(key, c0, chunk)
+
+  def _overlay_stacked(self, x_all, nodes_all):
+    """Between-dispatch cold service for one chunk's stacked
+    ``[c, ...]`` features/ids: per step, the sampler's cache-aware
+    overlay (cache hits device-served, misses host-overlaid, corrected
+    rows admitted)."""
+    from ..telemetry.spans import span
+    c = x_all.shape[0]
+    with span('feature.cold_overlay', scope=type(self).__name__,
+              steps=c):
+      fixed = [self.sampler._overlay_cold_traced(x_all[i], nodes_all[i])
+               for i in range(c)]
+    return jnp.stack(fixed)
+
+  def _run_tiered(self, state, seeds: np.ndarray, key):
+    """Chunked collect → cold-service → train epoch (tiered stores).
+    Returns ``(state, losses, correct, valid, hops)``."""
+    from ..telemetry.spans import span
+    s = seeds.shape[0]
+    chunk = self._cold_chunk_steps(s)
+    losses, correct, valid, hops = [], None, None, None
+    for c0, real, part, keys in self._tiered_chunks(seeds, key, chunk):
+      with span('fused.dispatch', chunk=c0, phase='collect'):
+        data, stats = self._compiled_collect(
+            self._put_batches(part), keys, self.sampler._arrays())
+      # stats sliced to the real steps: padded tail steps still carry
+      # static exchange SLOTS, which would inflate padding waste
+      self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
+      data = self._overlay_chunk(data)
+      with span('fused.dispatch', chunk=c0, phase='train'):
+        state, ls, cor, val, hop = self._compiled_train(state, data)
+      losses.append(ls[:real])
+      correct = cor if correct is None else correct + cor
+      valid = val if valid is None else valid + val
+      hops = hop if hops is None else hops + hop
+    return state, jnp.concatenate(losses), correct, valid, hops
 
   def _emit_hop_events(self, hop_counts, steps: int) -> None:
     """Per-hop padding-fill flight-recorder events for one fused
@@ -131,13 +220,31 @@ class _MeshEpochDriver:
   def evaluate(self, params, input_nodes,
                input_space: str = 'old') -> float:
     """Accuracy over ``input_nodes`` (e.g. the test split) as ONE
-    SPMD scan program (VERDICT r4 #5)."""
+    SPMD scan program (VERDICT r4 #5) — or, for tiered stores, the
+    chunked collect → cold-service → eval path."""
     seeds = self._stack_eval_seeds(input_nodes, input_space)
+    if self._tiered:
+      return self._evaluate_tiered(params, seeds)
     correct, total, stats = self._compiled_eval(
         params, self._put_batches(seeds), self._eval_key(),
         self.sampler._arrays())
     self.sampler._accumulate_stats(stats)
     return float(int(correct) / max(int(total), 1))
+
+  def _evaluate_tiered(self, params, seeds: np.ndarray) -> float:
+    key = self._eval_key()
+    s = seeds.shape[0]
+    chunk = self._cold_chunk_steps(s)
+    correct = total = 0
+    for c0, real, part, keys in self._tiered_chunks(seeds, key, chunk):
+      data, stats = self._compiled_collect(
+          self._put_batches(part), keys, self.sampler._arrays())
+      self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
+      data = self._overlay_chunk(data)
+      c, t = self._compiled_eval_consume(params, data)
+      correct += int(c)
+      total += int(t)
+    return correct / max(total, 1)
 
 
 class FusedDistEpoch(_MeshEpochDriver):
@@ -152,7 +259,9 @@ class FusedDistEpoch(_MeshEpochDriver):
         state, stats = fused.run(state)
 
   Args:
-    dataset: `DistDataset` (sharded layout, non-tiered features).
+    dataset: `DistDataset` (sharded layout).  Tiered stores
+      (``split_ratio < 1``) run as chunked tiered fused epochs with
+      the cold-cache service between dispatches (module docstring).
     num_neighbors: per-hop fanouts.
     input_nodes: global seed ids (``input_space`` semantics as in
       `DistNeighborLoader`).
@@ -187,11 +296,6 @@ class FusedDistEpoch(_MeshEpochDriver):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None or dataset.node_labels is None:
       raise ValueError('FusedDistEpoch needs node features and labels')
-    if dataset.node_features.is_tiered:
-      raise ValueError(
-          'FusedDistEpoch needs a non-tiered feature store (the cold '
-          'overlay is per-batch host work); use '
-          'DistNeighborLoader(prefetch=2) for tiered tables')
     if exchange_slack == 'adaptive':
       raise ValueError(
           "exchange_slack='adaptive' retunes between batches on the "
@@ -232,6 +336,17 @@ class FusedDistEpoch(_MeshEpochDriver):
                                    fast_compile=fast_compile)
     self._compiled_eval = _uncached_jit(self._eval_fn,
                                         fast_compile=fast_compile)
+    # tiered store: chunked collect → cold-service → train programs
+    # (module docstring, "tiered fused epochs")
+    self._tiered = dataset.node_features.is_tiered
+    if self._tiered:
+      self._compiled_collect = _uncached_jit(self._collect_fn,
+                                             fast_compile=fast_compile)
+      self._compiled_train = _uncached_jit(self._train_fn,
+                                           donate_argnums=(0,),
+                                           fast_compile=fast_compile)
+      self._compiled_eval_consume = _uncached_jit(
+          self._eval_consume_fn, fast_compile=fast_compile)
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -296,6 +411,54 @@ class FusedDistEpoch(_MeshEpochDriver):
         body, 0, (steps, seeds_all))
     return jnp.sum(correct), jnp.sum(total), jnp.sum(stats, axis=0)
 
+  # -- tiered fused epochs (chunked collect/train twins) --------------------
+
+  def _collect_step_bytes(self) -> int:
+    cap = self.sampler.node_capacity(self.batch_size)
+    nf = self.ds.node_features
+    return (self.num_parts * cap * nf.feature_dim
+            * np.dtype(nf.shards.dtype).itemsize)
+
+  def _collect_fn(self, seeds_all: jax.Array, keys: jax.Array,
+                  arrs: dict):
+    """``[c, P, B]`` seeds → the chunk's stacked sample+collect
+    batches (cold rows zeroed, corrected between dispatches) + the
+    stacked telemetry."""
+
+    def body(_, xs):
+      key_i, seeds = xs
+      batch, stats = self._collate(seeds, key_i, arrs)
+      return 0, (batch, stats)
+
+    _, (batches, stats) = jax.lax.scan(body, 0, (keys, seeds_all))
+    return batches, stats
+
+  def _overlay_chunk(self, batches):
+    batches.x = self._overlay_stacked(batches.x, batches.node)
+    return batches
+
+  def _train_fn(self, state: TrainState, batches):
+    """Train scan over one chunk's corrected batches — the back half
+    of the untiered `_epoch_fn` body."""
+
+    def body(state, batch):
+      state, loss, correct = self._dp_step(state, batch)
+      hop = jnp.sum(batch.num_sampled_nodes, axis=0)
+      return state, (loss, correct, jnp.sum(batch.batch >= 0), hop)
+
+    state, (losses, corrects, valids, hops) = jax.lax.scan(
+        body, state, batches)
+    return (state, losses, jnp.sum(corrects), jnp.sum(valids),
+            jnp.sum(hops, axis=0))
+
+  def _eval_consume_fn(self, params, batches):
+    def body(carry, batch):
+      correct, total = self._dp_eval(params, batch)
+      return carry, (correct, total)
+
+    _, (c, t) = jax.lax.scan(body, 0, batches)
+    return jnp.sum(c), jnp.sum(t)
+
   # run()/evaluate() come from `_MeshEpochDriver` — one host driver
   # for the supervised mesh twins (VERDICT r4 #5 wired there)
 
@@ -324,7 +487,8 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
   (`dist_gather_multi`); ``exchange_slack`` tunes it.
 
   Args:
-    dataset: `DistDataset` (sharded, NON-tiered features + labels).
+    dataset: `DistDataset` (sharded; features + labels).  Tiered
+      stores run as chunked tiered fused epochs (module docstring).
     num_neighbors: per-hop fanouts; ``len == model.num_layers``.
     input_nodes: global seed ids (``input_space`` as in the loaders).
     model: a `TreeSAGE`-shaped flax module.
@@ -346,10 +510,6 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     if dataset.node_features is None or dataset.node_labels is None:
       raise ValueError('FusedDistTreeEpoch needs node features and '
                        'labels')
-    if dataset.node_features.is_tiered:
-      raise ValueError(
-          'FusedDistTreeEpoch needs a non-tiered feature store; use '
-          'DistNeighborLoader(prefetch=2) for tiered tables')
     if exchange_slack == 'adaptive':
       raise ValueError(
           "exchange_slack='adaptive' retunes on the host between "
@@ -390,6 +550,19 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
                                    fast_compile=fast_compile)
     self._compiled_eval = _uncached_jit(self._eval_fn,
                                         fast_compile=fast_compile)
+    self._tiered = dataset.node_features.is_tiered
+    if self._tiered:
+      self._sharded_collect = self._make_collect_sharded()
+      self._sharded_consume = self._make_consume_sharded(train=True)
+      self._sharded_consume_eval = self._make_consume_sharded(
+          train=False)
+      self._compiled_collect = _uncached_jit(self._collect_fn,
+                                             fast_compile=fast_compile)
+      self._compiled_train = _uncached_jit(self._train_fn,
+                                           donate_argnums=(0,),
+                                           fast_compile=fast_compile)
+      self._compiled_eval_consume = _uncached_jit(
+          self._eval_consume_fn, fast_compile=fast_compile)
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -408,13 +581,27 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
 
   # -- per-device body ------------------------------------------------------
 
+  def _level_sizes(self):
+    sizes = [self.batch_size]
+    for k in self.fanouts:
+      sizes.append(sizes[-1] * int(k))
+    return sizes
+
   def _expand_collect(self, seeds, key, indptr_s, indices_s, bounds,
-                      fshards_s, lshards_s):
+                      fshards_s, lshards_s, hcounts=None,
+                      concat: bool = False):
     """Tree expansion + one fused feature/label exchange for one
     device's ``[B]`` seed slice.  Returns
     ``(xs, masks, y, stats7, hop_counts)`` — ``hop_counts[h]`` is the
     number of VALID ids in level ``h`` (the tree analog of the
-    dedup path's per-hop new-node count, for the padding gauges)."""
+    dedup path's per-hop new-node count, for the padding gauges).
+
+    ``hcounts`` (tiered stores) zeroes feature rows past each owner's
+    hot count — the caller overlays the cold tier; ``concat=True``
+    returns ``(all_ids, feats, y, stats7, hop_counts)`` in the
+    concatenated level layout instead of the split lists (the tiered
+    collect phase's shape — the overlay machinery addresses one
+    ``[L]`` id table, the consume phase re-splits)."""
     from .dist_sampler import (_dist_one_hop, _slack_cap,
                                dist_gather_multi)
     slack = self.sampler.exchange_slack
@@ -437,24 +624,64 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         (fshards_s, lshards_s), bounds, all_ids, self.axis,
         self.num_parts,
         exchange_capacity=_slack_cap(all_ids.shape[0], self.num_parts,
-                                     slack, layout))
+                                     slack, layout),
+        hot_counts=hcounts)
+    stats7 = jnp.concatenate(
+        [fstats, jnp.stack(gst), jnp.zeros((1,), jnp.int32)])
+    hop_counts = jnp.stack(
+        [jnp.sum((lvl >= 0).astype(jnp.int32)) for lvl in levels])
+    y = labels[:self.batch_size]
+    if concat:
+      return all_ids, feats, y, stats7, hop_counts
     sizes = [lvl.shape[0] for lvl in levels]
     xs, off = [], 0
     for s in sizes:
       xs.append(feats[off:off + s])
       off += s
     masks = [lvl >= 0 for lvl in levels]
-    y = labels[:self.batch_size]
-    stats7 = jnp.concatenate(
-        [fstats, jnp.stack(gst), jnp.zeros((1,), jnp.int32)])
-    hop_counts = jnp.stack(
-        [jnp.sum((lvl >= 0).astype(jnp.int32)) for lvl in levels])
     return xs, masks, y, stats7, hop_counts
+
+  def _eval_tail(self, params, xs, masks, y, valid):
+    axis = self.axis
+    logits = self._eval_apply(params, xs, masks)
+    correct = jax.lax.psum(
+        jnp.sum((jnp.argmax(logits, -1) == y) & valid), axis)
+    total = jax.lax.psum(jnp.sum(valid), axis)
+    return correct, total
+
+  def _train_tail(self, state, xs, masks, y, valid, hop_counts):
+    """The DP update half of the tree step — shared by the fused
+    single-program path and the tiered consume scan."""
+    axis, b = self.axis, self.batch_size
+    hop_g = jax.lax.psum(hop_counts, axis)         # global [H+1]
+
+    def loss_fn(params):
+      logits = self._apply(params, xs, masks)
+      vf = valid.astype(logits.dtype)
+      ce = optax.softmax_cross_entropy_with_integer_labels(
+          logits, y.astype(jnp.int32))
+      return (ce * vf).sum() / jnp.maximum(vf.sum(), 1.0), logits
+
+    (loss, logits), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(state.params)
+    grads = jax.lax.pmean(grads, axis)
+    loss = jax.lax.pmean(loss, axis)
+    updates, opt_state = self.tx.update(grads, state.opt_state,
+                                        state.params)
+    params = optax.apply_updates(state.params, updates)
+    new_state = TrainState(params, opt_state, state.step + 1)
+    any_valid = jax.lax.psum(jnp.sum(valid), axis) > 0
+    state = jax.tree_util.tree_map(
+        lambda new, old: jnp.where(any_valid, new, old),
+        new_state, state)
+    correct = jax.lax.psum(
+        jnp.sum((jnp.argmax(logits[:b], -1) == y) & valid), axis)
+    return (state, loss, correct, jax.lax.psum(jnp.sum(valid), axis),
+            hop_g)
 
   def _make_sharded(self, train: bool):
     from .shard_map_compat import shard_map
     axis = self.axis
-    b = self.batch_size
 
     def per_device(state_or_params, seeds_s, key, indptr_s, indices_s,
                    bounds, fshards_s, lshards_s):
@@ -464,37 +691,12 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
           lshards_s[0])
       valid = seeds >= 0
       if not train:
-        logits = self._eval_apply(state_or_params, xs, masks)
-        correct = jax.lax.psum(
-            jnp.sum((jnp.argmax(logits, -1) == y) & valid), axis)
-        total = jax.lax.psum(jnp.sum(valid), axis)
+        correct, total = self._eval_tail(state_or_params, xs, masks, y,
+                                         valid)
         return correct, total, stats7[None]
-      hop_g = jax.lax.psum(hop_counts, axis)       # global [H+1]
-      state = state_or_params
-
-      def loss_fn(params):
-        logits = self._apply(params, xs, masks)
-        vf = valid.astype(logits.dtype)
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            logits, y.astype(jnp.int32))
-        return (ce * vf).sum() / jnp.maximum(vf.sum(), 1.0), logits
-
-      (loss, logits), grads = jax.value_and_grad(
-          loss_fn, has_aux=True)(state.params)
-      grads = jax.lax.pmean(grads, axis)
-      loss = jax.lax.pmean(loss, axis)
-      updates, opt_state = self.tx.update(grads, state.opt_state,
-                                          state.params)
-      params = optax.apply_updates(state.params, updates)
-      new_state = TrainState(params, opt_state, state.step + 1)
-      any_valid = jax.lax.psum(jnp.sum(valid), axis) > 0
-      state = jax.tree_util.tree_map(
-          lambda new, old: jnp.where(any_valid, new, old),
-          new_state, state)
-      correct = jax.lax.psum(
-          jnp.sum((jnp.argmax(logits[:b], -1) == y) & valid), axis)
-      return (state, loss, correct, jax.lax.psum(jnp.sum(valid), axis),
-              stats7[None], hop_g)
+      state, loss, correct, n_valid, hop_g = self._train_tail(
+          state_or_params, xs, masks, y, valid, hop_counts)
+      return state, loss, correct, n_valid, stats7[None], hop_g
 
     ax = self.axis
     if train:
@@ -505,6 +707,105 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         per_device, mesh=self.mesh,
         in_specs=(P(), P(ax), P(), P(ax), P(ax), P(), P(ax), P(ax)),
         out_specs=out_specs)
+
+  # -- tiered fused epochs: collect / consume twins -------------------------
+
+  def _collect_step_bytes(self) -> int:
+    nf = self.ds.node_features
+    return (self.num_parts * sum(self._level_sizes()) * nf.feature_dim
+            * np.dtype(nf.shards.dtype).itemsize)
+
+  def _make_collect_sharded(self):
+    """Per-device tree expansion + hot-masked feature/label exchange,
+    returning the CONCATENATED level ids + features (the overlay
+    machinery's addressing) instead of the split lists."""
+    from .shard_map_compat import shard_map
+    ax = self.axis
+
+    def per_device(seeds_s, key, indptr_s, indices_s, bounds,
+                   fshards_s, lshards_s, hcounts):
+      seeds = seeds_s[0]
+      all_ids, feats, y, stats7, hop_counts = self._expand_collect(
+          seeds, key, indptr_s[0], indices_s[0], bounds, fshards_s[0],
+          lshards_s[0], hcounts=hcounts, concat=True)
+      return (all_ids[None], feats[None], y[None], stats7[None],
+              hop_counts[None])
+
+    return shard_map(
+        per_device, mesh=self.mesh,
+        in_specs=(P(ax), P(), P(ax), P(ax), P(), P(ax), P(ax), P()),
+        out_specs=tuple(P(ax) for _ in range(5)))
+
+  def _make_consume_sharded(self, train: bool):
+    """Per-device split of the corrected level features + the train or
+    eval tail (the back half of `_make_sharded`'s per_device)."""
+    from .shard_map_compat import shard_map
+    ax = self.axis
+    sizes = self._level_sizes()
+
+    def per_device(state_or_params, seeds_s, ids_s, feats_s, y_s,
+                   hop_s):
+      seeds = seeds_s[0]
+      ids, feats, y = ids_s[0], feats_s[0], y_s[0]
+      xs, masks, off = [], [], 0
+      for s in sizes:
+        xs.append(feats[off:off + s])
+        masks.append(ids[off:off + s] >= 0)
+        off += s
+      valid = seeds >= 0
+      if not train:
+        correct, total = self._eval_tail(state_or_params, xs, masks, y,
+                                         valid)
+        return correct, total
+      return self._train_tail(state_or_params, xs, masks, y, valid,
+                              hop_s[0])
+
+    if train:
+      out_specs = (P(), P(), P(), P(), P())
+    else:
+      out_specs = (P(), P())
+    return shard_map(
+        per_device, mesh=self.mesh,
+        in_specs=(P(), P(ax), P(ax), P(ax), P(ax), P(ax)),
+        out_specs=out_specs)
+
+  def _collect_fn(self, seeds_all: jax.Array, keys: jax.Array,
+                  arrs: dict):
+    def body(_, xs):
+      key_i, seeds = xs
+      ids, feats, y, stats, hops = self._sharded_collect(
+          seeds, key_i, arrs['indptr'], arrs['indices'],
+          arrs['bounds'], arrs['fshards'], arrs['lshards'],
+          arrs['hcounts'])
+      return 0, (dict(seeds=seeds, ids=ids, feats=feats, y=y,
+                      hops=hops), stats)
+
+    _, (data, stats) = jax.lax.scan(body, 0, (keys, seeds_all))
+    return data, stats
+
+  def _overlay_chunk(self, data):
+    data['feats'] = self._overlay_stacked(data['feats'], data['ids'])
+    return data
+
+  def _train_fn(self, state: TrainState, data):
+    def body(state, d):
+      state, loss, correct, n_valid, hop_g = self._sharded_consume(
+          state, d['seeds'], d['ids'], d['feats'], d['y'], d['hops'])
+      return state, (loss, correct, n_valid, hop_g)
+
+    state, (losses, corrects, valids, hops) = jax.lax.scan(
+        body, state, data)
+    return (state, losses, jnp.sum(corrects), jnp.sum(valids),
+            jnp.sum(hops, axis=0))
+
+  def _eval_consume_fn(self, params, data):
+    def body(carry, d):
+      correct, total = self._sharded_consume_eval(
+          params, d['seeds'], d['ids'], d['feats'], d['y'], d['hops'])
+      return carry, (correct, total)
+
+    _, (c, t) = jax.lax.scan(body, 0, data)
+    return jnp.sum(c), jnp.sum(t)
 
   # -- the one program ------------------------------------------------------
 
@@ -553,11 +854,12 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
   unsupervised update (`make_dp_unsupervised_step`: binary sigmoid or
   max-margin triplet link loss by the metadata keys, pmean gradients).
 
-  Same constraints as `FusedDistEpoch`: non-tiered feature store and
-  a static exchange slack.
+  Same constraints as `FusedDistEpoch`: a static exchange slack;
+  tiered stores run as chunked tiered fused epochs (module
+  docstring).
 
   Args:
-    dataset: `DistDataset` (sharded, non-tiered features).
+    dataset: `DistDataset` (sharded layout).
     num_neighbors: per-hop fanouts for the endpoint expansion.
     edge_label_index: ``[2, E]`` (or ``(rows, cols)``) seed edges.
     apply_fn / tx: embedding model apply + optax transform.
@@ -581,10 +883,6 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None:
       raise ValueError('FusedDistLinkEpoch needs node features')
-    if dataset.node_features.is_tiered:
-      raise ValueError(
-          'FusedDistLinkEpoch needs a non-tiered feature store; use '
-          'DistLinkNeighborLoader(prefetch=2) for tiered tables')
     if exchange_slack == 'adaptive':
       raise ValueError(
           "exchange_slack='adaptive' retunes between batches on the "
@@ -619,6 +917,15 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
         self._epoch_fn, donate_argnums=(0,), fast_compile=fast_compile)
     self._compiled_eval = _uncached_jit(self._auc_fn,
                                         fast_compile=fast_compile)
+    self._tiered = dataset.node_features.is_tiered
+    if self._tiered:
+      self._compiled_collect = _uncached_jit(self._collect_fn,
+                                             fast_compile=fast_compile)
+      self._compiled_train = _uncached_jit(self._train_fn,
+                                           donate_argnums=(0,),
+                                           fast_compile=fast_compile)
+      self._compiled_auc_consume = _uncached_jit(
+          self._auc_consume_fn, fast_compile=fast_compile)
 
   def __len__(self) -> int:
     return len(self._batcher)
@@ -664,13 +971,52 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
         batch_size=self.batch_size, num_sampled_nodes=nsn, metadata=md)
     return batch, stats
 
-  def _auc_fn(self, params, pairs_all: jax.Array, key: jax.Array,
-              arrs: dict):
-    """Scan body of `evaluate`: per batch, the full distributed link
-    step (fresh strict negatives), per-device embedding + pairwise
-    (pos > neg) win counts, psum'd over the mesh — the SPMD twin of
-    `loader.fused.FusedLinkEpoch._auc_fn` (batched rank-sum AUC,
-    per-device positive/negative blocks)."""
+  # -- tiered fused epochs (chunked collect/train twins) --------------------
+
+  def _collect_step_bytes(self) -> int:
+    exp_seeds, _ = self.sampler._expansion_seeds(self.batch_size)
+    cap = self.sampler.node_capacity(exp_seeds)
+    nf = self.ds.node_features
+    return (self.num_parts * cap * nf.feature_dim
+            * np.dtype(nf.shards.dtype).itemsize)
+
+  def _collect_fn(self, pairs_all: jax.Array, keys: jax.Array,
+                  arrs: dict):
+    def body(_, xs):
+      key_i, pairs = xs
+      batch, stats = self._link_batch(pairs, key_i, arrs)
+      return 0, (batch, stats)
+
+    _, (batches, stats) = jax.lax.scan(body, 0, (keys, pairs_all))
+    return batches, stats
+
+  def _overlay_chunk(self, batches):
+    batches.x = self._overlay_stacked(batches.x, batches.node)
+    return batches
+
+  def _train_fn(self, state: TrainState, batches):
+    def body(state, batch):
+      state, loss = self._dp_step(state, batch)
+      # SeedBatcher pads whole rows, so a valid src implies the pair
+      return state, (loss, jnp.sum(batch.batch >= 0))
+
+    state, (losses, valids) = jax.lax.scan(body, state, batches)
+    return state, losses, jnp.sum(valids)
+
+  def _auc_consume_fn(self, params, batches):
+    auc_step = self._make_auc_step()
+
+    def body(carry, batch):
+      wins, total = auc_step(params, batch)
+      return carry, (wins, total)
+
+    _, (wins, totals) = jax.lax.scan(body, 0, batches)
+    return jnp.sum(wins), jnp.sum(totals)
+
+  def _make_auc_step(self):
+    """Per-device embedding + pairwise (pos > neg) win counts, psum'd
+    over the mesh — shared by the single-program `_auc_fn` and the
+    tiered `_auc_consume_fn`."""
     from .shard_map_compat import shard_map
     b, axis = self.batch_size, self.axis
 
@@ -694,9 +1040,18 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
       total = jax.lax.psum(jnp.sum(pair_ok, dtype=jnp.float32), axis)
       return wins, total
 
-    auc_step = shard_map(per_device, mesh=self.mesh,
-                         in_specs=(P(), P(self.axis)),
-                         out_specs=(P(), P()))
+    return shard_map(per_device, mesh=self.mesh,
+                     in_specs=(P(), P(self.axis)),
+                     out_specs=(P(), P()))
+
+  def _auc_fn(self, params, pairs_all: jax.Array, key: jax.Array,
+              arrs: dict):
+    """Scan body of `evaluate`: per batch, the full distributed link
+    step (fresh strict negatives), per-device embedding + pairwise
+    (pos > neg) win counts, psum'd over the mesh — the SPMD twin of
+    `loader.fused.FusedLinkEpoch._auc_fn` (batched rank-sum AUC,
+    per-device positive/negative blocks)."""
+    auc_step = self._make_auc_step()
 
     def body(carry, xs):
       i, pairs = xs
@@ -733,6 +1088,21 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     stacked = np.stack(list(ev)).reshape(-1, self.num_parts,
                                          self.batch_size,
                                          pairs.shape[1])
+    if self._tiered:
+      key = self._eval_key()
+      s = stacked.shape[0]
+      chunk = self._cold_chunk_steps(s)
+      wins = total = 0.0
+      for c0, real, part, keys in self._tiered_chunks(stacked, key,
+                                                      chunk):
+        batches, stats = self._compiled_collect(
+            self._put_batches(part), keys, self.sampler._arrays())
+        self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
+        batches = self._overlay_chunk(batches)
+        w, t = self._compiled_auc_consume(params, batches)
+        wins += float(w)
+        total += float(t)
+      return wins / max(total, 1.0)
     wins, total, stats = self._compiled_eval(
         params, self._put_batches(stacked), self._eval_key(),
         self.sampler._arrays())
@@ -752,6 +1122,21 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
                          flat.shape[-1])
     key = self._next_epoch_key()
     with step_annotation('fused_dist_link_epoch', self._epoch_idx):
+      if self._tiered:
+        s = pairs.shape[0]
+        chunk = self._cold_chunk_steps(s)
+        losses, valid = [], None
+        for c0, real, part, keys in self._tiered_chunks(pairs, key,
+                                                        chunk):
+          batches, stats = self._compiled_collect(
+              self._put_batches(part), keys, self.sampler._arrays())
+          self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
+          batches = self._overlay_chunk(batches)
+          state, ls, val = self._compiled_train(state, batches)
+          losses.append(ls[:real])
+          valid = val if valid is None else valid + val
+        return state, EpochStats(jnp.concatenate(losses),
+                                 jnp.zeros((), jnp.int32), valid)
       state, losses, valid, stats = self._compiled(
           state, self._put_batches(pairs), key, self.sampler._arrays())
     self.sampler._accumulate_stats(stats)

@@ -56,6 +56,12 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # WITH the retry/idempotency layer on, no faults injected — the
     # retry layer must not silently slow the fault-free hot path
     ('dist.chaos.fault_free_seeds_per_sec', 'higher'),
+    # cold-cache guard (ISSUE 5): the tiered mesh-loader row — the
+    # HBM victim cache + double-buffered cold overlay must keep the
+    # tiered store's throughput and its on-device cache hit rate from
+    # silently regressing back to the r5 static-split numbers
+    ('dist.tiered.seeds_per_sec', 'higher'),
+    ('dist.feature.cache_hit_rate', 'higher'),
 )
 
 

@@ -38,7 +38,21 @@ EVENT_KINDS: Dict[str, str] = {
         'ExchangeTelemetry drains: since-last-drain deltas of '
         'offered/dropped/slots per loss channel',
     'dist.cold_tier':
-        'tiered DistFeature drains: lookups, misses, hit_rate',
+        'tiered DistFeature drains: lookups (all feature lookups), '
+        'cold_lookups (past the hot tier — the cache denominator), '
+        'misses (host-served), cache_hits, hit_rate',
+    'cache.hit':
+        'data.cold_cache consumers (scope=feature|dist): count of '
+        'cold lookups served from the HBM victim cache this overlay',
+    'cache.miss':
+        'data.cold_cache consumers: count of cold lookups that paid '
+        'the host gather this overlay (admission candidates)',
+    'cache.admit':
+        'data.cold_cache consumers: rows written into the HBM ring '
+        'this overlay (frequency-ranked winners)',
+    'cache.evict':
+        'data.cold_cache consumers: residents displaced by this '
+        "overlay's admissions (CLOCK second-chance victims)",
     'fused.compile':
         'loader.fused._uncached_jit: fn, secs, persistent_cache',
     'span.begin':
@@ -98,7 +112,12 @@ SPAN_NAMES: Dict[str, str] = {
     'fused.epoch':
         'fused epoch drivers: one whole run() call',
     'fused.dispatch':
-        'fused epoch drivers: one chunk/program dispatch',
+        'fused epoch drivers: one chunk/program dispatch (tiered '
+        "epochs tag phase='collect'|'train')",
+    'feature.cold_overlay':
+        'tiered fused epochs: the between-dispatch host cold service '
+        'for one chunk (cache serve + host overlay + admissions; '
+        'steps = batches corrected)',
     'fused.init_state':
         'FusedTreeEpoch.init_state: param init from the dummy batch',
     'exchange.layout':

@@ -71,15 +71,25 @@ def test_tiered_feature_provenance(split_ratio):
   out = sampler.sample_from_nodes(seeds)
   _assert_provenance(ds, out)
   stats = sampler.exchange_stats()
+  # new r10 vocabulary: lookups = all valid feature lookups,
+  # cold_lookups = lookups past the hot tier (the cache denominator)
   assert stats['dist.feature.cold_lookups'] > 0
+  assert (stats['dist.feature.cold_lookups']
+          <= stats['dist.feature.lookups'])
+  assert (0 < stats['dist.feature.cold_misses']
+          <= stats['dist.feature.cold_lookups'])
   if split_ratio == 0.0:
-    # everything is cold: miss rate is 100%.
-    assert (stats['dist.feature.cold_misses']
-            == stats['dist.feature.cold_lookups'])
+    # everything is cold: no lookup is hot-served
+    assert (stats['dist.feature.cold_lookups']
+            == stats['dist.feature.lookups'])
+    assert stats['dist.feature.hot_hit_rate'] == 0.0
   else:
-    assert 0 < stats['dist.feature.cold_misses'] < \
-        stats['dist.feature.cold_lookups']
-  assert 0.0 <= stats['dist.feature.cold_hit_rate'] <= 1.0
+    assert (stats['dist.feature.cold_lookups']
+            < stats['dist.feature.lookups'])
+    assert 0.0 < stats['dist.feature.hot_hit_rate'] < 1.0
+  assert 0.0 <= stats['dist.feature.cache_hit_rate'] <= 1.0
+  assert (stats['dist.feature.cold_hit_rate']
+          == stats['dist.feature.cache_hit_rate'])
 
 
 def test_tiered_matches_untiered():

@@ -184,7 +184,13 @@ def test_hetero_tiered_feature_provenance():
       np.testing.assert_allclose(x[p][m][:, 0], old.astype(np.float32))
   stats = sampler.exchange_stats()
   assert stats['dist.feature.cold_misses'] > 0
-  assert 0.0 < stats['dist.feature.cold_hit_rate'] < 1.0
+  # hetero engine has no dynamic cold cache yet: every cold lookup is
+  # host-served, so the cache hit rate reads 0 while the hot tier
+  # still serves its share
+  assert (stats['dist.feature.cold_misses']
+          == stats['dist.feature.cold_lookups'])
+  assert stats['dist.feature.cache_hit_rate'] == 0.0
+  assert 0.0 < stats['dist.feature.hot_hit_rate'] < 1.0
 
 
 def test_hetero_tiered_link_mode():

@@ -186,6 +186,34 @@ def _init_embed_state(tx, bs=16):
   return state, model.apply
 
 
+def test_fused_dist_link_tiered_trains():
+  """The mesh link driver's tiered path: chunked collect → cold
+  service → train/AUC-consume scans run end-to-end."""
+  from graphlearn_tpu.parallel import FusedDistLinkEpoch
+  ds = _dist_dataset(split_ratio=0.5)
+  mesh = make_mesh(P_PARTS)
+  tx = optax.adam(1e-2)
+  state, apply_fn = _init_embed_state(tx)
+  rows = np.repeat(np.arange(N), 5)[:256]
+  cols = np.asarray(
+      [int(c) for r in range(N) for c in _neighbors_of(ds, r)])[:256]
+  fused = FusedDistLinkEpoch(ds, [3, 2], (rows, cols), apply_fn, tx,
+                             batch_size=16, mesh=mesh,
+                             neg_sampling='binary', shuffle=True,
+                             seed=0)
+  assert fused._tiered
+  state = replicate(state, mesh)
+  state, first = fused.run(state)
+  for _ in range(4):
+    state, stats = fused.run(state)
+  assert stats['seeds'] == 256
+  assert np.isfinite(float(stats['loss']))
+  st = fused.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.feature.cold_lookups'] > 0
+  auc = fused.evaluate(state.params, (rows[:64], cols[:64]))
+  assert 0.0 <= auc <= 1.0
+
+
 def test_fused_dist_link_refuses_adaptive():
   from graphlearn_tpu.parallel import FusedDistLinkEpoch
   ds = _dist_dataset()
@@ -198,13 +226,82 @@ def test_fused_dist_link_refuses_adaptive():
                        exchange_slack='adaptive')
 
 
-def test_fused_dist_refuses_tiered_store():
+def test_fused_dist_tiered_epoch_matches_per_batch():
+  """ISSUE 5 acceptance: FusedDistEpoch runs end-to-end with a
+  ``split_ratio < 1`` store, and its chunked collect + cold-service
+  batches are IDENTICAL to the per-batch tiered sampler driven with
+  the same keys."""
+  from graphlearn_tpu.parallel import DistNeighborSampler
   ds = _dist_dataset(split_ratio=0.4)
+  mesh = make_mesh(P_PARTS)
   tx = optax.adam(1e-2)
-  _, apply_fn = _init_state(tx)
-  with pytest.raises(ValueError, match='non-tiered'):
-    FusedDistEpoch(ds, [3, 2], np.arange(N), apply_fn, tx,
-                   batch_size=16, mesh=make_mesh(P_PARTS))
+  state, apply_fn = _init_state(tx)
+  fused = FusedDistEpoch(ds, [3, 2], np.arange(N), apply_fn, tx,
+                         batch_size=16, mesh=mesh, shuffle=False,
+                         seed=0)
+  assert fused._tiered
+  # -- batch identity vs the per-batch engine, same keys ------------
+  seeds = np.stack(list(fused._batcher)).reshape(-1, P_PARTS, 16)
+  key = jax.random.fold_in(fused._base_key, 1)    # epoch 1's key
+  keys = fused._chunk_key_stack(key, 0, seeds.shape[0])
+  batches, _stats = fused._compiled_collect(
+      fused._put_batches(seeds), keys, fused.sampler._arrays())
+  batches = fused._overlay_chunk(batches)
+  ref = DistNeighborSampler(ds, [3, 2], mesh=mesh, seed=0)
+  for i in range(seeds.shape[0]):
+    out = ref.sample_from_nodes(seeds[i], key=keys[i])
+    np.testing.assert_array_equal(np.asarray(batches.node[i]),
+                                  np.asarray(out['node']))
+    np.testing.assert_array_equal(np.asarray(batches.x[i]),
+                                  np.asarray(out['x']))
+    np.testing.assert_array_equal(np.asarray(batches.y[i]),
+                                  np.asarray(out['y']))
+  # the store really is tiered and the cold tier was exercised
+  st = fused.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.feature.cold_lookups'] > 0
+  # -- end-to-end: run() + evaluate() through the tiered path -------
+  state = replicate(state, mesh)
+  state, first = fused.run(state)
+  for _ in range(8):
+    state, stats = fused.run(state)
+  assert stats['seeds'] == N
+  assert stats['loss'] < first['loss']
+  acc = fused.evaluate(state.params, np.arange(N))
+  assert 0.0 <= acc <= 1.0
+
+
+def test_fused_dist_tiered_tail_chunk_padded():
+  """S % chunk != 0: the tail chunk pads with INVALID_ID steps so
+  every chunk reuses ONE compiled shape, and losses/valid counts are
+  identical to the unchunked epoch (padded steps contribute nothing)."""
+  import os
+  ds = _dist_dataset(split_ratio=0.4)
+  mesh = make_mesh(P_PARTS)
+  tx = optax.adam(1e-2)
+  state, apply_fn = _init_state(tx)
+  state = replicate(state, mesh)
+
+  def epoch_losses(chunk_env):
+    os.environ['GLT_FUSED_COLD_CHUNK'] = chunk_env
+    try:
+      fused = FusedDistEpoch(ds, [3, 2], np.arange(N), apply_fn, tx,
+                             batch_size=16, mesh=mesh, shuffle=False,
+                             seed=0)
+      assert fused._tiered
+      _, stats = fused.run(jax.tree_util.tree_map(jnp.copy, state))
+      acc = fused.evaluate(state.params, np.arange(N))
+      return np.asarray(stats.losses), int(stats['seeds']), acc
+    finally:
+      del os.environ['GLT_FUSED_COLD_CHUNK']
+
+  # 4 steps per epoch: chunk=3 → chunks of 3 + a 1-step tail padded
+  # to 3; chunk=4 → one exact chunk (the reference)
+  ls_tail, seeds_tail, acc_tail = epoch_losses('3')
+  ls_ref, seeds_ref, acc_ref = epoch_losses('4')
+  assert ls_tail.shape == ls_ref.shape          # padded steps sliced
+  np.testing.assert_allclose(ls_tail, ls_ref, rtol=1e-6)
+  assert seeds_tail == seeds_ref == N
+  assert acc_tail == acc_ref
 
 
 def test_fused_dist_refuses_adaptive_slack():
@@ -246,16 +343,39 @@ def test_fused_dist_tree_epoch_trains():
   assert st['dist.feature.offered'] > 0
 
 
-def test_fused_dist_tree_refuses_tiered_and_adaptive():
+def test_fused_dist_tree_tiered_trains():
+  """The tree driver's tiered path: chunked collect (concatenated
+  level layout) → cold service → consume scans train end-to-end."""
+  from graphlearn_tpu.models import TreeSAGE
+  from graphlearn_tpu.parallel import FusedDistTreeEpoch
+  ds = _dist_dataset(split_ratio=0.5)
+  mesh = make_mesh(P_PARTS)
+  tx = optax.adam(1e-2)
+  model = TreeSAGE(hidden_features=16, out_features=CLASSES,
+                   num_layers=2)
+  fused = FusedDistTreeEpoch(ds, [4, 3], np.arange(N), model, tx,
+                             batch_size=16, mesh=mesh, shuffle=True,
+                             seed=0)
+  assert fused._tiered
+  state = fused.init_state(jax.random.key(0))
+  state, first = fused.run(state)
+  for _ in range(6):
+    state, stats = fused.run(state)
+  assert stats['seeds'] == N
+  assert np.isfinite(float(stats['loss']))
+  assert stats['loss'] < first['loss']
+  st = fused.sampler.exchange_stats(tick_metrics=False)
+  assert st['dist.feature.cold_lookups'] > 0
+  acc = fused.evaluate(state.params, np.arange(N))
+  assert 0.0 <= acc <= 1.0
+
+
+def test_fused_dist_tree_refuses_adaptive():
   from graphlearn_tpu.models import TreeSAGE
   from graphlearn_tpu.parallel import FusedDistTreeEpoch
   model = TreeSAGE(hidden_features=8, out_features=CLASSES,
                    num_layers=2)
   tx = optax.adam(1e-2)
-  with pytest.raises(ValueError, match='non-tiered'):
-    FusedDistTreeEpoch(_dist_dataset(split_ratio=0.5), [3, 2],
-                       np.arange(N), model, tx, batch_size=16,
-                       mesh=make_mesh(P_PARTS))
   with pytest.raises(ValueError, match='adaptive'):
     FusedDistTreeEpoch(_dist_dataset(), [3, 2], np.arange(N), model,
                        tx, batch_size=16, mesh=make_mesh(P_PARTS),

@@ -162,7 +162,8 @@ def test_host_local_tiered_loader_epoch(tmp_path):
   assert nb == len(loader)
   st = loader.sampler.exchange_stats(tick_metrics=False)
   assert st['dist.feature.cold_misses'] > 0
-  assert 0.0 < st['dist.feature.cold_hit_rate'] <= 1.0
+  assert 0.0 <= st['dist.feature.cache_hit_rate'] <= 1.0
+  assert 0.0 < st['dist.feature.hot_hit_rate'] < 1.0
 
 
 def test_host_local_by_dst_layout(tmp_path):

@@ -452,16 +452,23 @@ def test_span_children_tree(traced_run):
            if e['kind'] == 'span.begin'}
   roots = tree[None]
   assert len(roots) == traced_run['batches']
-  # each batch root has exactly its 3 runtime stage children; the
-  # FIRST batch additionally parents build-time spans (the
-  # exchange.layout step-construction marker lands inside the batch
-  # that triggered the compile — honest attribution of build cost)
+  # each batch root parents runtime stage children; the FIRST batch
+  # additionally parents build-time spans (the exchange.layout
+  # step-construction marker lands inside the batch that triggered
+  # the compile — honest attribution of build cost).  The tiered
+  # loader's cold pipeline dispatches batch k+1 inside batch k's span
+  # (honest attribution of the overlap), so one root may parent two
+  # sample.exchange children and the last none — but the EPOCH total
+  # is exactly 3 stage spans per batch.
   stage_names = {'sample.exchange', 'feature.lookup', 'stitch'}
+  per_root = []
   for r in roots:
     stages = [c for c in tree[r] if names.get(c) in stage_names]
-    assert len(stages) == 3
+    per_root.append(len(stages))
     assert all(names.get(c) in stage_names | {'exchange.layout'}
                for c in tree[r])
+  assert sum(per_root) == 3 * traced_run['batches']
+  assert all(2 <= n <= 4 for n in per_root)
   # malformed begin (no span_id) is skipped, not a KeyError
   assert span_children([{'kind': 'span.begin', 'parent_id': None}]) \
       == {}
