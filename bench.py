@@ -1015,6 +1015,26 @@ def _run_chaos_row(timeout: int):
   return None
 
 
+def _run_resume_row(timeout: int):
+  """The `bench_dist_loader.py --resume` preemption-resume smoke in a
+  subprocess; returns its JSON row (None on failure/timeout)."""
+  script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'benchmarks', 'bench_dist_loader.py')
+  cmd = [sys.executable, script, '--resume']
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout)
+  except subprocess.TimeoutExpired:
+    return None
+  for ln in reversed((out.stdout or '').strip().splitlines()):
+    if ln.startswith('{'):
+      try:
+        return json.loads(ln)
+      except json.JSONDecodeError:
+        continue
+  return None
+
+
 def _aggregate(results, fused_res, dist, hetero=None):
   """The full artifact schema from whatever phases have completed so
   far.  The HEADLINE `value` is the fused whole-epoch time when the
@@ -1348,6 +1368,17 @@ def main():
     r = _run_chaos_row(int(min(300, max(budget_left() - 30, 120))))
     if r is not None:
       dist['chaos'] = r
+      emit()
+
+  # phase 3e — preemption-resume smoke (ISSUE 6): snapshot-overhead
+  # epoch timing vs the no-snapshot line + kill -> durable restore ->
+  # finish; feeds the dist.resume.restore_secs / replayed_batches
+  # regression guards
+  if isinstance(dist, dict) and 'error' not in dist and \
+      budget_left() > 150:
+    r = _run_resume_row(int(min(300, max(budget_left() - 30, 120))))
+    if r is not None:
+      dist['resume'] = r
       emit()
 
   # phase 4 — extra primary sessions stabilize the per-batch median

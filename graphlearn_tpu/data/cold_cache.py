@@ -204,6 +204,23 @@ class ClockShardCache:
       self.ref[slots] = 0
     self._rebuild()
 
+  # -- DataPlaneState (utils.checkpoint): the policy rings ----------------
+  def state_dict(self) -> dict:
+    return {'ids': self.ids.copy(), 'ref': self.ref.copy(),
+            'hand': self.hand}
+
+  def load_state_dict(self, state: dict) -> None:
+    ids = np.asarray(state['ids'], np.int64)
+    if ids.shape != self.ids.shape:
+      raise ValueError(
+          f'cold-cache snapshot capacity {ids.shape[0]} does not match '
+          f'this cache ({self.capacity}); resume with the same '
+          f'GLT_COLD_CACHE_ROWS the snapshot was taken under')
+    self.ids = ids
+    self.ref = np.asarray(state['ref'], np.uint8).copy()
+    self.hand = int(np.asarray(state['hand']))
+    self._rebuild()
+
 
 class CacheStats:
   """Flat counters shared by every cache flavor; consumers fold them
@@ -322,6 +339,17 @@ class DeviceColdCache:
     self.stats.admits += len(adm_ids)
     self.stats.evicts += evicted
     return len(adm_ids), evicted
+
+  # -- DataPlaneState: tag ring + clock hand + the HBM row ring -----------
+  def state_dict(self) -> dict:
+    return {'policy': self.policy.state_dict(),
+            'rows': np.asarray(self.rows)}
+
+  def load_state_dict(self, state: dict) -> None:
+    self.policy.load_state_dict(state['policy'])
+    self.rows = jax.device_put(
+        np.asarray(state['rows'], self.rows.dtype),
+        next(iter(self.rows.devices())))
 
 
 # -- mesh flavor (dist samplers + tiered fused epochs) ---------------------
@@ -455,3 +483,18 @@ class MeshColdCache:
     self.stats.admits += admits
     self.stats.evicts += evicts
     return admits, evicts
+
+  # -- DataPlaneState: per-shard tag rings + the sharded HBM row stack ----
+  def state_dict(self) -> dict:
+    return {'shards': [sh.state_dict() for sh in self.shards],
+            'rows': np.asarray(jax.device_get(self.rows))}
+
+  def load_state_dict(self, state: dict) -> None:
+    shard_states = state['shards']
+    if len(shard_states) != len(self.shards):
+      raise ValueError(
+          f'cold-cache snapshot has {len(shard_states)} shards, this '
+          f'mesh cache holds {len(self.shards)}')
+    for sh, st in zip(self.shards, shard_states):
+      sh.load_state_dict(st)
+    self.rows = self._put(np.asarray(state['rows']))

@@ -254,6 +254,12 @@ class Feature:
       out = gather_rows(self._hot, jnp.asarray(idx.astype(np.int32)))
       return jnp.where(jnp.asarray(valid)[:, None], out, 0)
 
+    # chaos seam: the host cold tier is a service that can die
+    # mid-epoch; a planned 'fail' raises here, on the batch that
+    # needed it (the snapshot/resume layer turns it into a finished
+    # epoch instead of a lost one)
+    from ..testing import chaos
+    chaos.cold_service_check('feature')
     # Mixed: device gather for hot rows; cold rows first checked
     # against the HBM victim cache (`data.cold_cache` — hits are a
     # device gather, the bytes never leave HBM); residual misses are
@@ -297,6 +303,23 @@ class Feature:
     """All-device gather (fully-hot tables, device ids): no host sync."""
     return _device_gather(self._hot, ids, self._id2index_dev,
                           use_pallas=pallas_enabled())
+
+  # -- DataPlaneState (utils.checkpoint): the dynamic cache only ----------
+  # (the hot tier and host table are reconstructed from the dataset —
+  # snapshotting gigabytes of static rows would be pure dead weight)
+  def state_dict(self) -> dict:
+    self.lazy_init()
+    if self._cold_cache is None:
+      return {'has_cache': 0}
+    return {'has_cache': 1, 'cache': self._cold_cache.state_dict()}
+
+  def load_state_dict(self, state: dict) -> None:
+    self.lazy_init()
+    if not int(np.asarray(state.get('has_cache', 0))):
+      return
+    if self._cold_cache is None:
+      return                       # cache disabled this run: warmth lost
+    self._cold_cache.load_state_dict(state['cache'])
 
   def host_get(self, ids=None) -> np.ndarray:
     """Host-side gather (reference ``Feature.cpu_get``,

@@ -344,7 +344,11 @@ class DistLoader:
       if seq is not None:
         seq = int(np.asarray(seq))
         if seq in self._seen_seqs:
-          continue    # replayed batch whose original got through
+          # replayed batch whose original got through (worker-restart
+          # replay, or a resumed epoch's re-produced prefix)
+          self.replayed_discarded = getattr(self, 'replayed_discarded',
+                                            0) + 1
+          continue
         if seq in self._degraded_lost:
           # written off as lost, then arrived after all (the worker's
           # send raced its own death): the epoch accounting already
@@ -555,6 +559,53 @@ class DistLoader:
       md['edge_label_mask'] = padded if base is None else padded & base
     return md
 
+  # -- DataPlaneState (utils.checkpoint): mid-epoch snapshot/resume --------
+  def state_dict(self) -> dict:
+    """Epoch cursor for the mp (subprocess-producer) mode: producer
+    positions + the '#SEQ' stamps already delivered this epoch.  A
+    resumed epoch re-produces from the same (epoch, shuffle) and the
+    consumer discards the already-seen prefix — remaining batches are
+    byte-identical (batch content is a function of (epoch, seq))."""
+    if not isinstance(self.opts, MpDistSamplingWorkerOptions):
+      raise ValueError(
+          'DistLoader snapshots cover the mp producer mode; remote '
+          "mode's producers live in the server process (snapshot "
+          'there), and collocated mode has no durable position')
+    seen = np.asarray(sorted(getattr(self, '_seen_seqs', ())), np.int64)
+    return {'producer': self._producer.state_dict(), 'seen': seen,
+            'expected': int(self._expected)}
+
+  def load_state_dict(self, state: dict) -> None:
+    if not isinstance(self.opts, MpDistSamplingWorkerOptions):
+      raise ValueError('DistLoader snapshots cover the mp mode')
+    self._producer.load_state_dict(state['producer'], mid_epoch=True)
+    self._resume_state = {
+        'seen': set(int(s) for s in np.asarray(state['seen'])),
+        'expected': int(np.asarray(state['expected']))}
+
+  def resume_epoch(self):
+    """Finish the interrupted epoch (call after `load_state_dict`):
+    the producer re-dispatches the same epoch, already-delivered seqs
+    are discarded on arrival (counted in ``replayed_discarded``), and
+    the returned iterator yields exactly the remaining batches —
+    byte-identical to what an uninterrupted epoch would have
+    produced.  (``iter(loader)`` afterwards starts the NEXT epoch;
+    this iterator does not re-trigger the epoch protocol.)"""
+    r = getattr(self, '_resume_state', None)
+    if r is None:
+      raise ValueError('resume_epoch() needs load_state_dict() first')
+    self._resume_state = None
+    self._seen_seqs = set(r['seen'])
+    self._degraded_lost = set()
+    self.replayed_discarded = 0
+    expected = self._producer.produce_all(self.seeds,
+                                          drop_last=self.drop_last)
+    # the snapshot's expected wins when degraded mode had already
+    # written batches off before the snapshot
+    self._expected = min(expected, r['expected'])
+    self._received = len(self._seen_seqs)
+    return _ResumedEpochIterator(self)
+
   def shutdown(self) -> None:
     # idempotent: __del__ re-enters after an explicit shutdown, and a
     # second remote destroy against a since-departed server would
@@ -574,6 +625,22 @@ class DistLoader:
       self.shutdown()
     except Exception:
       pass
+
+
+class _ResumedEpochIterator:
+  """Continues an interrupted epoch WITHOUT re-entering the loader's
+  epoch protocol: ``for batch in loader.resume_epoch()`` must not hit
+  `DistLoader.__iter__` (which would dispatch a fresh epoch over the
+  one just resumed)."""
+
+  def __init__(self, loader: 'DistLoader'):
+    self._loader = loader
+
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    return DistLoader.__next__(self._loader)
 
 
 class DistNeighborLoader(DistLoader):

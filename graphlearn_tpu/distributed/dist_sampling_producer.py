@@ -241,9 +241,15 @@ class MpSamplingProducer:
     remainder differs per epoch (torch DataLoader semantics).
     ``seeds`` is ``[E]`` node ids, or ``[E, 2|3]`` edge pairs
     (+labels) in link mode — shuffling/slicing is along axis 0."""
+    from ..utils.checkpoint import pack_rng_state
     seeds = np.asarray(seeds)
     if seeds.ndim == 1:
       seeds = seeds.reshape(-1)
+    # pre-shuffle RNG capture: a mid-epoch snapshot restores THIS
+    # state so the resumed produce_all re-draws the same permutation
+    # (batch content is a function of (epoch, seq) — identical shuffle
+    # + identical stamps = byte-identical replays)
+    self._pre_epoch_rng = pack_rng_state(self._rng)
     if self.shuffle:
       seeds = self._rng.permutation(seeds)
     if drop_last:
@@ -392,6 +398,36 @@ class MpSamplingProducer:
                     budget=budget)
       restarted += 1
     return restarted, lost_seqs
+
+  # -- DataPlaneState (utils.checkpoint) ------------------------------------
+  def state_dict(self) -> dict:
+    """Producer positions: epoch counter, shuffle RNG (current AND the
+    pre-shuffle state of the in-flight epoch), per-worker restart
+    generations.  Worker processes are NOT captured — they are
+    respawned fresh and replay deterministically from (epoch, seq)."""
+    from ..utils.checkpoint import pack_bytes, pack_rng_state
+    return {
+        'epoch': self._epoch,
+        'current_epoch': self.current_epoch,
+        'rng': pack_rng_state(self._rng),
+        'pre_epoch_rng': getattr(self, '_pre_epoch_rng',
+                                 pack_rng_state(self._rng)),
+        'generations': pack_bytes(dict(self._generations)),
+    }
+
+  def load_state_dict(self, state: dict, mid_epoch: bool = True) -> None:
+    """``mid_epoch=True`` rewinds so the NEXT `produce_all` re-
+    dispatches the interrupted epoch (same epoch number, same
+    shuffle); False resumes at the epoch boundary."""
+    from ..utils.checkpoint import restore_rng_state, unpack_bytes
+    cur = int(np.asarray(state['current_epoch']))
+    if mid_epoch:
+      self._epoch = cur if cur >= 0 else 0
+      restore_rng_state(self._rng, state['pre_epoch_rng'])
+    else:
+      self._epoch = int(np.asarray(state['epoch']))
+      restore_rng_state(self._rng, state['rng'])
+    self._generations = dict(unpack_bytes(state['generations']))
 
   def shutdown(self) -> None:
     for tq in self._task_queues:

@@ -45,6 +45,7 @@ RETRY_SEED_ENV = 'GLT_RPC_RETRY_SEED'
 DEGRADED_ENV = 'GLT_DEGRADED_OK'
 RESTARTS_ENV = 'GLT_MAX_WORKER_RESTARTS'
 FETCH_DEADLINE_ENV = 'GLT_FETCH_DEADLINE'
+DISPATCH_DEADLINE_ENV = 'GLT_DISPATCH_DEADLINE'
 
 
 class RetryExhausted(RpcError):
@@ -65,6 +66,30 @@ class PeerLostError(RpcError):
     self.received = received
     self.expected = expected
     self.outstanding = outstanding
+
+
+class ReplayEvictedError(RpcError):
+  """A retried request's replay-cache entry was pruned before the
+  retry arrived: re-executing would break exactly-once (the fetch
+  handler pops a message), so the server answers this typed error
+  instead.  Under normal budgets a retry lands well inside the
+  replay horizon — seeing this means the cache was under pressure
+  (raise `REPLAY_ENTRIES_PER_CLIENT` or lower the prefetch fan-out)."""
+
+
+class MeshStallError(RuntimeError):
+  """A fused/mesh dispatch exceeded the configured dispatch deadline
+  (``GLT_DISPATCH_DEADLINE``) — the signature of a collective whose
+  participant died mid-``all_to_all`` (the program would otherwise
+  hang forever).  Carries the last-known-healthy participant set so
+  the operator (or the degraded-resume path) knows who survived."""
+
+  def __init__(self, msg: str, *, healthy=None, deadline=None,
+               scope: str = ''):
+    super().__init__(msg)
+    self.healthy = list(healthy) if healthy is not None else None
+    self.deadline = deadline
+    self.scope = scope
 
 
 def _env_float(name: str, default: float) -> float:
@@ -98,6 +123,73 @@ def fetch_deadline() -> float:
   — and much larger than — the RPC retry deadline: producing one batch
   slowly is normal; a pool silent for ten minutes is stuck."""
   return _env_float(FETCH_DEADLINE_ENV, 600.0)
+
+
+def dispatch_deadline() -> float:
+  """``GLT_DISPATCH_DEADLINE`` — seconds a fused/mesh chunk dispatch
+  may block before the watchdog converts the hang into a typed
+  `MeshStallError`.  Default 0 = disabled: the right deadline is a
+  multiple of the measured chunk wall (compiles included), which only
+  the deployment knows."""
+  return _env_float(DISPATCH_DEADLINE_ENV, 0.0)
+
+
+def healthy_participants() -> list:
+  """Best-effort last-known-healthy participant (process) set for
+  `MeshStallError` diagnostics: every process index that answered the
+  runtime's liveness view.  Single-controller meshes report
+  ``[0, .., n-1]`` of live local processes (trivially healthy — the
+  stall is then inside the collective itself); a multi-host runtime
+  without a reachable KV store degrades to the local process index."""
+  import jax
+  try:
+    return list(range(jax.process_count()))
+  except Exception:               # noqa: BLE001 — uninitialized runtime
+    return [0]
+
+
+def run_with_deadline(fn, *args, deadline: Optional[float] = None,
+                      scope: str = '', **kwargs):
+  """Run ``fn(*args, **kwargs)`` under the dispatch watchdog.
+
+  ``deadline`` None reads `dispatch_deadline()`; 0 disables (direct
+  call, zero overhead).  With a deadline, the call runs on a helper
+  thread and a timeout emits a ``mesh.stall`` event + raises
+  `MeshStallError` with the last-known-healthy participant set.  The
+  hung dispatch thread itself cannot be killed (XLA holds it) — the
+  caller decides whether to roll back to a snapshot (degraded mode)
+  or let the error end the job; either way the epoch is no longer
+  silently wedged."""
+  if deadline is None:
+    deadline = dispatch_deadline()
+  if not deadline or deadline <= 0:
+    return fn(*args, **kwargs)
+  import threading
+  out: dict = {}
+
+  def _run():
+    try:
+      out['value'] = fn(*args, **kwargs)
+    except BaseException as e:      # noqa: BLE001 — forwarded below
+      out['error'] = e
+
+  t = threading.Thread(target=_run, daemon=True,
+                       name=f'glt-dispatch-{scope or "chunk"}')
+  t.start()
+  t.join(deadline)
+  if t.is_alive():
+    healthy = healthy_participants()
+    from ..telemetry.recorder import recorder
+    recorder.emit('mesh.stall', scope=scope, deadline_secs=deadline,
+                  healthy=healthy)
+    raise MeshStallError(
+        f'{scope or "dispatch"} still blocked after {deadline:.1f}s '
+        f'(GLT_DISPATCH_DEADLINE) — a mesh participant likely died '
+        f'mid-collective; last-known-healthy processes: {healthy}',
+        healthy=healthy, deadline=deadline, scope=scope)
+  if 'error' in out:
+    raise out['error']
+  return out['value']
 
 
 @dataclass

@@ -188,7 +188,15 @@ class _ReplayEntry:
 class _ReplayCache:
   """Bounded per-client-token reply cache (the server side of request
   idempotency).  ``begin`` either claims a fresh entry (caller must
-  execute and `finish`) or returns the existing one (caller replays)."""
+  execute and `finish`), returns the existing one (caller replays), or
+  reports the entry EVICTED — a retry whose cached reply was pruned
+  must NOT silently re-execute (the fetch handler pops a message;
+  re-running it would hand one client two different batches under one
+  request id).  Eviction tracking is a per-client high-water mark over
+  pruned seqs: client seqs are monotone, so ``seq <= watermark`` with
+  no live entry means the reply existed once and is gone."""
+
+  EVICTED = 'evicted'
 
   def __init__(self, max_entries: int = REPLAY_ENTRIES_PER_CLIENT,
                max_bytes: int = REPLAY_BYTES_PER_CLIENT,
@@ -196,13 +204,23 @@ class _ReplayCache:
     self._lock = threading.Lock()
     self._clients: 'OrderedDict[str, OrderedDict[int, _ReplayEntry]]' = \
         OrderedDict()
+    # bounded LRU: a mark only matters while a zombie client might
+    # still retry; without a cap the server leaks one int per client
+    # token EVER seen (the ISSUE's serving fleet recycles clients
+    # continuously).  4x max_clients keeps marks well past the
+    # per-client eviction horizon.
+    self._evicted_marks: 'OrderedDict[str, int]' = OrderedDict()
+    self._max_marks = 4 * max_clients
     self._max_entries = max_entries
     self._max_bytes = max_bytes
     self._max_clients = max_clients
 
-  def begin(self, token: str, seq: int) -> Tuple[_ReplayEntry, bool]:
+  def begin(self, token: str, seq: int):
     """Returns ``(entry, fresh)`` — ``fresh`` means the caller owns
-    execution; otherwise replay (wait on ``entry.done`` if needed)."""
+    execution; otherwise replay (wait on ``entry.done`` if needed).
+    Returns ``(None, EVICTED)`` when this seq's entry was pruned —
+    the caller must answer with the typed eviction error instead of
+    executing."""
     with self._lock:
       per = self._clients.get(token)
       if per is None:
@@ -212,9 +230,20 @@ class _ReplayCache:
       if ent is not None:
         per.move_to_end(seq)
         return ent, False
+      if seq <= self._evicted_marks.get(token, -1):
+        self._evicted_marks.move_to_end(token)
+        return None, self.EVICTED
       ent = per[seq] = _ReplayEntry()
       self._prune_locked(token)
       return ent, True
+
+  def _mark_evicted_locked(self, token: str, seq: int) -> None:
+    cur = self._evicted_marks.get(token, -1)
+    if seq > cur:
+      self._evicted_marks[token] = seq
+    self._evicted_marks.move_to_end(token)
+    while len(self._evicted_marks) > self._max_marks:
+      self._evicted_marks.popitem(last=False)
 
   def _prune_locked(self, token: str) -> None:
     per = self._clients[token]
@@ -225,6 +254,7 @@ class _ReplayCache:
     for s in [s for s, e in per.items()
               if e.done_at is not None and e.done_at < horizon]:
       del per[s]
+      self._mark_evicted_locked(token, s)
     total = sum(len(e.frame[1]) for e in per.values()
                 if e.frame is not None)
     while len(per) > self._max_entries or total > self._max_bytes:
@@ -233,6 +263,7 @@ class _ReplayCache:
       if victim is None:            # everything in flight: never evict
         break
       total -= len(per.pop(victim).frame[1])
+      self._mark_evicted_locked(token, victim)
     while len(self._clients) > self._max_clients:
       stale = next((t for t, p in self._clients.items()
                     if t != token
@@ -240,6 +271,10 @@ class _ReplayCache:
                    None)
       if stale is None:
         break
+      # a whole-client eviction forgets its seqs too: keep the mark so
+      # a zombie client's late retry cannot re-execute either
+      if self._clients[stale]:
+        self._mark_evicted_locked(stale, max(self._clients[stale]))
       del self._clients[stale]
 
 
@@ -268,6 +303,17 @@ class RpcServer:
       ent = fresh = None
       if rid is not None:
         ent, fresh = replay.begin(str(rid[0]), int(rid[1]))
+        if fresh == _ReplayCache.EVICTED:
+          # the reply existed once and was pruned: answering the retry
+          # by re-executing would break exactly-once — a typed error
+          # (resilience.ReplayEvictedError client-side) is the honest
+          # outcome
+          _send_frame(sock, *_encode_obj(_RemoteError(
+              f'replay entry for request {rid} was evicted before the '
+              'retry arrived (cache pressure: raise '
+              'REPLAY_ENTRIES_PER_CLIENT or lower prefetch fan-out)',
+              kind='ReplayEvictedError')))
+          return
         if not fresh:
           # retried request: the first execution owns the side effect;
           # park until its reply frame lands, then replay it verbatim.
@@ -496,6 +542,13 @@ class RpcClient:
         attempt += 1
         continue
       if isinstance(out, _RemoteError):
+        if getattr(out, 'kind', None) == 'ReplayEvictedError':
+          # typed: the server pruned this request's reply before the
+          # retry arrived — re-execution was refused to keep
+          # exactly-once, so the caller must treat the request as of
+          # unknown outcome (not silently get a second execution)
+          from .resilience import ReplayEvictedError
+          raise ReplayEvictedError(out.msg)
         raise _remote_to_error(out)
       return out
 

@@ -225,6 +225,153 @@ def resolve_cold_chunk(per_step_bytes: int, total_steps: int) -> int:
   return max(min(DEFAULT_COLD_CHUNK, by_mem, total_steps), 1)
 
 
+class _SnapshotHooks:
+  """Chunk-boundary snapshot/resume for the fused epoch drivers (the
+  `utils.checkpoint` DataPlaneState protocol, driver-shaped) — shared
+  by the single-chip classes here and the mesh drivers in
+  `parallel.fused`, so the save/restore contracts cannot drift.
+
+  Lifecycle::
+
+      snap = fused.attach_snapshots()        # GLT_SNAPSHOT_DIR, or
+      fused.attach_snapshots(SnapshotManager(dir, every=2))
+      state, stats = fused.run(state)        # saves at chunk seams
+      # ... preemption; in a fresh process, same constructor args:
+      fused.attach_snapshots(snap_dir_manager)
+      state = fused.restore_from_snapshot(state)   # mid-epoch rewind
+      state, stats = fused.run(state)              # finishes the epoch
+
+  The snapshot payload holds (a) the DATA-PLANE state — epoch
+  counter, batcher RNG (epoch-start capture: resume RE-DRAWS the
+  interrupted epoch's permutation), cold-cache rings — (b) the epoch
+  PROGRESS (next chunk offset + per-step losses/correct/valid
+  accumulated so far), and (c) the TrainState as host copies.  Resume
+  is byte-identical: same permutation, same ``fold_in(epoch_key,
+  chunk_offset)`` key schedule, partial stats stitched back in front
+  of the freshly computed remainder.
+  """
+
+  _snap = None
+  _resume_progress = None
+
+  def attach_snapshots(self, manager=None):
+    """Attach a `SnapshotManager` (``None`` builds one from
+    ``GLT_SNAPSHOT_DIR`` when set; returns the manager or None)."""
+    if manager is None:
+      from ..utils.checkpoint import (SnapshotManager,
+                                      snapshot_dir_from_env)
+      if snapshot_dir_from_env() is None:
+        return None
+      manager = SnapshotManager()
+    self._snap = manager
+    return manager
+
+  # -- per-driver state hooks (overridden by the mesh drivers) ------------
+  def data_plane_state(self) -> dict:
+    st = {'epoch_idx': self._epoch_idx,
+          'dispatch_idx': getattr(self, '_dispatch_idx', 0),
+          'batcher': self._batcher.state_dict()}
+    feat = getattr(self, '_feat', None)
+    if feat is not None and getattr(self, '_tiered', False):
+      st['feat'] = feat.state_dict()
+    return st
+
+  def load_data_plane_state(self, plane: dict) -> None:
+    # run() pre-increments the epoch counter, so the rewound value is
+    # "one before the interrupted epoch"; the batcher rewinds its RNG
+    # to that epoch's start so run() re-draws the same permutation
+    self._epoch_idx = int(np.asarray(plane['epoch_idx'])) - 1
+    self._dispatch_idx = int(np.asarray(plane.get('dispatch_idx', 0)))
+    self._batcher.load_state_dict(plane['batcher'], mid_epoch=True)
+    feat = getattr(self, '_feat', None)
+    if feat is not None and 'feat' in plane:
+      feat.load_state_dict(plane['feat'])
+
+  def _state_to_device(self, train_host):
+    """Host TrainState pytree → device, driver-appropriately (the
+    mesh drivers replicate over their mesh instead)."""
+    return jax.tree_util.tree_map(jnp.asarray, train_host)
+
+  def restore_from_snapshot(self, state_template):
+    """Load the newest snapshot: rewind the data plane and return the
+    TrainState to continue from (validated against
+    ``state_template``'s structure/dtypes/shapes —
+    `CheckpointMismatchError` on a stale snapshot).  ``None`` when the
+    directory holds no snapshot; the caller keeps its fresh state."""
+    if self._snap is None:
+      raise ValueError('restore_from_snapshot() needs '
+                       'attach_snapshots() first')
+    payload = self._snap.restore_latest()
+    if payload is None:
+      return None
+    from ..utils.checkpoint import validate_tree
+    self.load_data_plane_state(payload['plane'])
+    self._resume_progress = payload['progress']
+    train = payload.get('train')
+    if train is None:
+      return None
+    validate_tree(train,
+                  jax.tree_util.tree_map(np.asarray, state_template))
+    return self._state_to_device(train)
+
+  # -- run()-side helpers -------------------------------------------------
+  def _take_resume(self, chunk_steps: int):
+    """Pop the pending resume progress (one epoch continuation per
+    restore).  Returns ``(skip_before, losses_list, correct, valid,
+    extra)`` — ``extra`` carries driver-specific partials (the mesh
+    tree driver's hop counts)."""
+    prog = self._resume_progress
+    if prog is None:
+      return 0, [], None, None, {}
+    self._resume_progress = None
+    saved_chunk = int(np.asarray(prog.get('chunk_steps', chunk_steps)))
+    if saved_chunk != chunk_steps:
+      from ..utils.checkpoint import CheckpointMismatchError
+      raise CheckpointMismatchError(
+          f'snapshot was taken with chunk size {saved_chunk}, this '
+          f'process resolves {chunk_steps} — resume with the same '
+          f'GLT_FUSED_COLD_CHUNK / max_steps_per_program',
+          path='progress.chunk_steps')
+    losses = np.asarray(prog['losses'])
+    losses_list = [losses] if losses.size else []
+    correct = prog.get('correct')
+    valid = prog.get('valid')
+    extra = {k: v for k, v in prog.items()
+             if k not in ('losses', 'correct', 'valid', 'epoch',
+                          'next_chunk', 'chunk_steps')}
+    return (int(np.asarray(prog['next_chunk'])), losses_list, correct,
+            valid, extra)
+
+  def _save_chunk_snapshot(self, state, next_chunk: int,
+                           chunk_steps: int, losses, correct, valid,
+                           force: bool = False, extra_fn=None,
+                           **extra) -> None:
+    """One chunk-boundary save when due (``force`` bypasses the
+    cadence — epoch-entry rollback targets and epoch-end saves).
+    ``extra_fn`` defers expensive extras (a device sync) to the saves
+    that actually happen."""
+    if self._snap is None:
+      return
+    if not force and not self._snap.due():
+      return
+    if extra_fn is not None:
+      extra = {**extra, **extra_fn()}
+    progress = {
+        'epoch': self._epoch_idx, 'next_chunk': int(next_chunk),
+        'chunk_steps': int(chunk_steps),
+        'losses': (np.concatenate([np.asarray(l) for l in losses])
+                   if losses else np.zeros((0,), np.float32)),
+    }
+    if correct is not None:
+      progress['correct'] = np.asarray(correct)
+    if valid is not None:
+      progress['valid'] = np.asarray(valid)
+    for k, v in extra.items():
+      if v is not None:
+        progress[k] = np.asarray(v)
+    self._snap.save(self.data_plane_state(), progress, train=state)
+
+
 class EpochStats:
   """Lazy epoch statistics: holds DEVICE arrays; any numeric access
   syncs.  Epoch loops that don't read stats dispatch epochs back to
@@ -262,7 +409,7 @@ class EpochStats:
     return f'EpochStats(steps={self.losses.shape[0]}, <lazy>)'
 
 
-class _SupervisedScanEpoch:
+class _SupervisedScanEpoch(_SnapshotHooks):
   """Shared epoch driver for the supervised fused twins: subclasses
   supply ``_sample_collate(seeds, key, dev, use_pallas) -> batch`` and
   ``_step(state, batch) -> (state, loss, correct)`` plus the
@@ -329,17 +476,28 @@ class _SupervisedScanEpoch:
     derive from (epoch, chunk offset): same draw distribution as the
     single-program epoch, different stream."""
     from ..telemetry.spans import span
+    from ..testing import chaos
     seeds = np.stack(list(self._batcher))          # [S, B], host shuffle
     self._epoch_idx += 1
     key = jax.random.fold_in(self._base_key, self._epoch_idx)
     parts = list(self._chunks(seeds))
-    losses, correct, valid = [], None, None
+    chunk_steps = parts[0][2].shape[0] if parts else 0
+    # mid-epoch resume (attach_snapshots/restore_from_snapshot):
+    # chunks before `skip` already ran pre-preemption — their stats
+    # come from the snapshot, the permutation and key schedule are
+    # re-derived identically, and only the remainder dispatches
+    skip, losses, correct, valid, _ = self._take_resume(chunk_steps)
     with span('fused.epoch', scope=type(self).__name__,
               epoch=self._epoch_idx, steps=seeds.shape[0],
               tiered=getattr(self, '_tiered', False)):
       for c0, real, part in parts:
+        if c0 < skip:
+          continue
         # single-program epochs keep the r4 key schedule exactly
         ck = key if len(parts) == 1 else jax.random.fold_in(key, c0)
+        # chaos seam: a planned kill dies here, between chunk
+        # dispatches — exactly what a preemption hits
+        chaos.fused_dispatch_check(chunk=c0, epoch=self._epoch_idx)
         with span('fused.dispatch', chunk=c0):
           with step_annotation('fused_epoch', self._next_dispatch()):
             if getattr(self, '_tiered', False):
@@ -351,6 +509,8 @@ class _SupervisedScanEpoch:
         losses.append(ls[:real])
         correct = c if correct is None else correct + c
         valid = v if valid is None else valid + v
+        self._save_chunk_snapshot(state, c0 + part.shape[0],
+                                  chunk_steps, losses, correct, valid)
     metrics.inc('loader.batches', seeds.shape[0])
     return state, EpochStats(jnp.concatenate(losses), correct, valid)
 
@@ -783,7 +943,7 @@ def _as_edge_pairs(edge_label_index):
   return ei[0], ei[1]
 
 
-class FusedLinkEpoch:
+class FusedLinkEpoch(_SnapshotHooks):
   """One-program link-prediction (unsupervised) training epochs.
 
   The link twin of `FusedEpoch`, fusing the `LinkNeighborLoader` +
@@ -1138,9 +1298,16 @@ class FusedLinkEpoch:
     if self._tiered and self._chunk is None:
       chunk = resolve_cold_chunk(self._collect_step_bytes(), s)
     n_chunks = (s + chunk - 1) // chunk
+    from ..testing import chaos
+    # mid-epoch resume: see _SupervisedScanEpoch.run (same contract,
+    # link stats carry valid-pair counts instead of correct)
+    skip, losses, _corr, valid, _ = self._take_resume(chunk)
     for c0 in range(0, s, chunk):
+      if c0 < skip:
+        continue
       real = min(chunk, s - c0)
       ck = key if n_chunks == 1 else jax.random.fold_in(key, c0)
+      chaos.fused_dispatch_check(chunk=c0, epoch=self._epoch_idx)
       self._dispatch_idx = getattr(self, '_dispatch_idx', 0) + 1
       with step_annotation('fused_link_epoch', self._dispatch_idx):
         # chunk-tail label padding uses the established invalid
@@ -1162,6 +1329,8 @@ class FusedLinkEpoch:
               ck, self._dev, pallas_enabled())
       losses.append(ls[:real])
       valid = v if valid is None else valid + v
+      self._save_chunk_snapshot(state, c0 + chunk, chunk, losses,
+                                None, valid)
     metrics.inc('loader.batches', s)
     return state, EpochStats(jnp.concatenate(losses),
                              jnp.zeros((), jnp.int32), valid)

@@ -37,6 +37,8 @@ class SeedBatcher:
     self.shuffle = shuffle
     self.drop_last = drop_last
     self._rng = np.random.default_rng(seed)
+    self.epochs_started = 0
+    self._epoch_start_rng = None   # packed rng state at last __iter__
 
   def __len__(self) -> int:
     n = len(self.seeds)
@@ -48,10 +50,42 @@ class SeedBatcher:
     """Each epoch is a PRIVATE iterator (own order, own position):
     an abandoned consumer — e.g. an orphaned prefetch worker — can
     never steal batches from a later epoch."""
+    from ..utils.checkpoint import pack_rng_state
+    # epoch-START rng snapshot: a mid-epoch resume must re-draw THIS
+    # epoch's permutation, which requires the state BEFORE the draw
+    self._epoch_start_rng = pack_rng_state(self._rng)
+    self.epochs_started += 1
     n = len(self.seeds)
     order = (self._rng.permutation(n) if self.shuffle
              else np.arange(n))
     return self._epoch(order)
+
+  # -- DataPlaneState (utils.checkpoint) ----------------------------------
+  def state_dict(self) -> dict:
+    """Cursor + RNG capture: ``rng`` is the CURRENT stream (epoch-
+    boundary resume point) and ``epoch_rng`` the state at the last
+    epoch's start (mid-epoch resume re-draws that epoch's permutation
+    byte-identically)."""
+    from ..utils.checkpoint import pack_rng_state
+    return {'rng': pack_rng_state(self._rng),
+            'epoch_rng': (self._epoch_start_rng
+                          if self._epoch_start_rng is not None
+                          else pack_rng_state(self._rng)),
+            'epochs_started': self.epochs_started}
+
+  def load_state_dict(self, state: dict, mid_epoch: bool = False
+                      ) -> None:
+    """``mid_epoch=True`` rewinds the RNG to the interrupted epoch's
+    START (the next ``__iter__`` re-draws the same permutation) and
+    rolls the epoch counter back so that re-draw is not double-
+    counted; False resumes at the epoch boundary."""
+    from ..utils.checkpoint import restore_rng_state
+    self.epochs_started = int(np.asarray(state['epochs_started']))
+    if mid_epoch:
+      restore_rng_state(self._rng, state['epoch_rng'])
+      self.epochs_started = max(self.epochs_started - 1, 0)
+    else:
+      restore_rng_state(self._rng, state['rng'])
 
   def _epoch(self, order: np.ndarray):
     n = len(self.seeds)

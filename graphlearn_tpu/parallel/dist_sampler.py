@@ -489,6 +489,29 @@ class AdaptiveSlack:
       # converged rather than stuck (the r5 envelope ambiguity)
       self._pin('floor', rate)
 
+  # -- DataPlaneState (utils.checkpoint): the ladder's position -----------
+  def state_dict(self) -> dict:
+    """Rung index + pin state + the tighten-origin marker.  The
+    telemetry baselines (``_last``) are NOT captured — they reference
+    process-local cumulative counters that restart at zero in the
+    resuming process; `load_state_dict` re-baselines against the live
+    registry instead."""
+    return {'idx': self._idx, 'pinned': int(self._pinned),
+            'pin_reason': self._pin_reason,
+            'tightened_from': (-1 if self._tightened_from is None
+                               else int(self._tightened_from))}
+
+  def load_state_dict(self, state: dict) -> None:
+    idx = int(np.asarray(state['idx']))
+    if idx != self._idx:
+      self._set(idx, reason='restore')
+    self._pinned = bool(int(np.asarray(state['pinned'])))
+    self._pin_reason = str(np.asarray(state['pin_reason']))
+    tf = int(np.asarray(state['tightened_from']))
+    self._tightened_from = None if tf < 0 else tf
+    st = self.sampler.exchange_stats()
+    self._last = {k: st[k] for k in self.OFFER_KEYS + self.DROP_KEYS}
+
 
 def _slack_cap(n: int, num_parts: int,
                exchange_slack: Optional[float],
@@ -944,6 +967,36 @@ class ExchangeTelemetry:
     if drain:
       self.exchange_stats()
 
+  def _stats_state(self) -> np.ndarray:
+    """Cumulative counter snapshot (exchange totals + cold-tier host
+    counters) as ONE int64 leaf — saved with each chunk snapshot so a
+    degraded-mode rollback (`parallel.fused._rollback_to_snapshot`)
+    can rewind the counters a re-dispatched chunk would otherwise
+    double-count."""
+    self.exchange_stats(tick_metrics=False)     # drain the device acc
+    with self._stats_lock:
+      cold = (self._feat_lookups, self._cold_lookups,
+              self._cold_misses, self._cache_hits, self._cache_admits,
+              self._cache_evicts)
+      return np.concatenate([self._stats_total,
+                             np.asarray(cold, np.int64)])
+
+  def _load_stats_state(self, packed) -> None:
+    arr = np.asarray(packed, np.int64)
+    n = len(EXCHANGE_STAT_NAMES)
+    with self._stats_lock:
+      self._stats_acc = jnp.zeros_like(self._stats_acc)
+      self._stats_pending = 0
+      self._stats_total = arr[:n].copy()
+      (self._feat_lookups, self._cold_lookups, self._cold_misses,
+       self._cache_hits, self._cache_admits,
+       self._cache_evicts) = (int(v) for v in arr[n:n + 6])
+      # the registry watermark must never exceed the rewound counters
+      # (a negative delta would tick the global metrics backwards)
+      self._cold_reported = tuple(
+          min(r, int(v)) for r, v in zip(self._cold_reported,
+                                         arr[n:n + 6]))
+
   def exchange_stats(self, tick_metrics: bool = True):
     """Materialize cumulative exchange telemetry (one device sync).
 
@@ -1346,6 +1399,10 @@ class DistNeighborSampler(ExchangeTelemetry):
     batch's repeats hit — the cross-batch cold-id dedup.
     """
     from ..data.cold_cache import emit_cache_events
+    from ..testing import chaos
+    # chaos seam: the host cold tier can die mid-epoch; a planned
+    # 'fail' surfaces here, before any host gather
+    chaos.cold_service_check('dist')
     nf = self.ds.node_features
     g = self.ds.graph
     cache = self._ensure_cold_cache()
@@ -1420,6 +1477,24 @@ class DistNeighborSampler(ExchangeTelemetry):
       # service without a cache is already visible as cold_misses
       emit_cache_events('dist', hits, served, admits, evicts)
     return x
+
+  # -- DataPlaneState (utils.checkpoint) ----------------------------------
+  def data_plane_state(self) -> dict:
+    """Key-stream cursor + cold-cache rings.  ``step_cnt`` positions
+    the per-batch sampling keys (``fold_in(base_key, step_cnt)``) —
+    restoring it is what makes resumed batches byte-identical."""
+    state = {'step_cnt': self._step_cnt}
+    cache = self._ensure_cold_cache()
+    if cache is not None:
+      state['cache'] = cache.state_dict()
+    return state
+
+  def load_data_plane_state(self, state: dict) -> None:
+    self._step_cnt = int(np.asarray(state['step_cnt']))
+    if 'cache' in state:
+      cache = self._ensure_cold_cache()
+      if cache is not None:
+        cache.load_state_dict(state['cache'])
 
 
 @jax.jit
@@ -1907,7 +1982,80 @@ class DistSubGraphLoader(PrefetchingLoader):
                       'mapping': out['seed_local']})
 
 
-class DistNeighborLoader(PrefetchingLoader):
+class _ResumableEpochMixin:
+  """Mid-epoch snapshot/resume for the mesh loaders (the
+  `utils.checkpoint` DataPlaneState protocol, loader-shaped).
+
+  ``state_dict()`` captures the epoch cursor: the batcher's RNG (the
+  interrupted epoch's permutation is RE-DRAWN on resume, not stored),
+  the number of batches already handed out, the sampler key-stream
+  position those batches consumed, and the cold-cache rings.
+  ``load_state_dict()`` + ``resume_epoch()`` then continue the epoch
+  in a fresh loader with byte-identical remaining batches: same
+  permutation, same per-batch sampling keys (``step_cnt`` excludes
+  any lost dispatch-ahead overshoot — the in-flight batch k+1 a kill
+  destroys is re-dispatched with the same key).
+  """
+
+  def _start_epoch(self, seed_iter):
+    self._epoch_start_steps = self.sampler._step_cnt
+    self._consumed = 0
+    return super()._start_epoch(seed_iter)
+
+  def state_dict(self) -> dict:
+    if getattr(self, '_active_prefetch', None) is not None:
+      # the worker thread runs _produce ahead of the consumer, so
+      # `_consumed` counts batches the trainer may never have seen —
+      # a snapshot here would skip them on resume (silent batch loss)
+      raise ValueError(
+          'mid-epoch snapshots need a synchronous epoch (prefetch=0): '
+          'a prefetch worker produces ahead of the trainer, so the '
+          'durable cursor would overcount delivered batches')
+    c = int(getattr(self, '_consumed', 0))
+    start = getattr(self, '_epoch_start_steps',
+                    self.sampler._step_cnt)
+    sampler_state = self.sampler.data_plane_state()
+    # the CONSUMED-batch key position, not the live counter: under the
+    # dispatch-ahead overlay batch k+1's dispatch has already advanced
+    # the counter while batch k is the newest durable batch
+    sampler_state['step_cnt'] = start + c
+    out = {'batcher': self._batcher.state_dict(), 'consumed': c,
+           'epoch_count': int(getattr(self, '_epoch_count', 0)),
+           'sampler': sampler_state}
+    ctl = getattr(self, '_adaptive', None)
+    if ctl is not None:
+      out['slack'] = ctl.state_dict()
+    return out
+
+  def load_state_dict(self, state: dict) -> None:
+    self._batcher.load_state_dict(state['batcher'], mid_epoch=True)
+    self.sampler.load_data_plane_state(state['sampler'])
+    # the ladder's rung/pin survive the restart (ISSUE 6: AdaptiveSlack
+    # is one of the stateful components a restart would silently reset)
+    ctl = getattr(self, '_adaptive', None)
+    if ctl is not None and 'slack' in state:
+      ctl.load_state_dict(state['slack'])
+    self._epoch_count = int(np.asarray(state.get('epoch_count', 0)))
+    self._resume_consumed = int(np.asarray(state['consumed']))
+
+  def resume_epoch(self):
+    """Iterator over the interrupted epoch's REMAINING batches (call
+    after `load_state_dict`); `iter(loader)` afterwards starts the
+    next epoch exactly where an uninterrupted run would."""
+    consumed = getattr(self, '_resume_consumed', None)
+    if consumed is None:
+      raise ValueError('resume_epoch() needs load_state_dict() first')
+    self._resume_consumed = None
+    it = iter(self._batcher)       # re-draws the interrupted epoch's perm
+    for _ in range(consumed):
+      next(it)                     # skip what the trainer already has
+    ep = PrefetchingLoader._start_epoch(self, it)
+    self._consumed = consumed
+    self._epoch_start_steps = self.sampler._step_cnt - consumed
+    return ep
+
+
+class DistNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
   """Distributed loader facade (reference ``DistNeighborLoader``,
   `distributed/dist_neighbor_loader.py:27-94`).
 
@@ -2016,7 +2164,7 @@ class DistNeighborLoader(PrefetchingLoader):
       with span('stitch'):
         edge_index = jnp.stack([out['row'], out['col']],
                                axis=1)             # [P, 2, E]
-        return Batch(
+        batch = Batch(
             x=out['x'], y=out['y'], edge_index=edge_index,
             edge_attr=out['ef'],
             node=out['node'], node_mask=out['node'] >= 0,
@@ -2024,6 +2172,8 @@ class DistNeighborLoader(PrefetchingLoader):
             batch=out['batch'], batch_size=self.batch_size,
             num_sampled_nodes=out['num_sampled_nodes'],
             metadata={'seed_local': out['seed_local']})
+      self._consumed = getattr(self, '_consumed', 0) + 1
+      return batch
 
 
 def pack_link_seeds(edge_label_index, edge_label,
@@ -2181,7 +2331,7 @@ class DistLinkNeighborSampler(DistNeighborSampler):
     return out
 
 
-class DistLinkNeighborLoader(PrefetchingLoader):
+class DistLinkNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
   """Distributed link-prediction loader over the device mesh
   (reference ``DistLinkNeighborLoader``,
   `distributed/dist_link_neighbor_loader.py:30-153`): seed edges split
@@ -2257,7 +2407,7 @@ class DistLinkNeighborLoader(PrefetchingLoader):
         out = self.sampler.sample_from_edges(pairs)
       with span('stitch'):
         edge_index = jnp.stack([out['row'], out['col']], axis=1)
-        return Batch(
+        batch = Batch(
             x=out['x'], y=out['y'], edge_index=edge_index,
             edge_attr=out['ef'],
             node=out['node'], node_mask=out['node'] >= 0,
@@ -2265,3 +2415,5 @@ class DistLinkNeighborLoader(PrefetchingLoader):
             batch=out['batch'], batch_size=self.batch_size,
             num_sampled_nodes=out['num_sampled_nodes'],
             metadata=out['metadata'])
+      self._consumed = getattr(self, '_consumed', 0) + 1
+      return batch

@@ -50,7 +50,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..loader.fused import _uncached_jit, resolve_cold_chunk
+from ..loader.fused import _SnapshotHooks, _uncached_jit, resolve_cold_chunk
 from ..models.train import TrainState
 from .dist_data import DistDataset
 from .dist_sampler import (DistLinkNeighborSampler, DistNeighborSampler,
@@ -60,13 +60,41 @@ from .dp import (make_dp_eval_step, make_dp_supervised_step,
                  make_dp_unsupervised_step)
 
 
-class _MeshEpochDriver:
+class _MeshEpochDriver(_SnapshotHooks):
   """Host-driver pieces shared by the three fused mesh classes, so
-  the seed/key/device-put contracts cannot drift between them."""
+  the seed/key/device-put contracts cannot drift between them.
+
+  Preemption tolerance (`_SnapshotHooks`): with a `SnapshotManager`
+  attached, tiered epochs snapshot at every chunk boundary (the
+  `GLT_FUSED_COLD_CHUNK` seams — the natural recovery points) and
+  untiered epochs at epoch boundaries; `restore_from_snapshot` +
+  `run()` then finish an interrupted epoch byte-identically.  Every
+  dispatch additionally runs under the `GLT_DISPATCH_DEADLINE`
+  watchdog: a collective hung by a dead mesh participant surfaces as
+  a typed `MeshStallError` instead of wedging the epoch forever, and
+  — with ``GLT_DEGRADED_OK=1`` and snapshots attached — the tiered
+  driver rolls back to the last snapshot and finishes the epoch on
+  the surviving hosts."""
 
   #: True = tiered store: run()/evaluate() take the chunked
   #: collect → cold-service → consume path (module docstring)
   _tiered = False
+
+  # -- snapshot hooks (mesh-shaped overrides of _SnapshotHooks) -----------
+  def data_plane_state(self) -> dict:
+    return {'epoch_idx': self._epoch_idx,
+            'batcher': self._batcher.state_dict(),
+            'sampler': self.sampler.data_plane_state()}
+
+  def load_data_plane_state(self, plane: dict) -> None:
+    self._epoch_idx = int(np.asarray(plane['epoch_idx'])) - 1
+    self._batcher.load_state_dict(plane['batcher'], mid_epoch=True)
+    self.sampler.load_data_plane_state(plane['sampler'])
+
+  def _state_to_device(self, train_host):
+    from .dp import replicate
+    return replicate(jax.tree_util.tree_map(np.asarray, train_host),
+                     self.mesh)
 
   def _next_epoch_key(self):
     self._epoch_idx += 1
@@ -105,11 +133,14 @@ class _MeshEpochDriver:
     """Run one epoch; ``state`` must be mesh-replicated and is
     DONATED — thread the returned state forward.  ``stats`` is LAZY
     (`loader.fused.EpochStats`)."""
+    from ..distributed.resilience import run_with_deadline
     from ..loader.fused import EpochStats
     from ..telemetry.spans import span
+    from ..testing import chaos
     from ..utils.profiling import step_annotation
     flat = np.stack(list(self._batcher))           # [S, P*B]
     seeds = flat.reshape(-1, self.num_parts, self.batch_size)
+    s = seeds.shape[0]
     key = self._next_epoch_key()
     with span('fused.epoch', scope=type(self).__name__,
               epoch=self._epoch_idx, steps=seeds.shape[0],
@@ -119,11 +150,25 @@ class _MeshEpochDriver:
           state, losses, correct, valid, hops = self._run_tiered(
               state, seeds, key)
         else:
-          with span('fused.dispatch'):
-            (state, losses, correct, valid, stats,
-             hops) = self._compiled(state, self._put_batches(seeds),
-                                    key, self.sampler._arrays())
-          self.sampler._accumulate_stats(stats)
+          # untiered = ONE program: snapshots land at epoch
+          # boundaries only (there is no mid-epoch seam to save at)
+          skip, l_saved, c_saved, v_saved, extra = self._take_resume(s)
+          if skip >= s and l_saved:
+            losses, correct, valid = l_saved[0], c_saved, v_saved
+            hops = extra.get('hops')
+          else:
+            with span('fused.dispatch'):
+              def _epoch_dispatch():
+                chaos.fused_dispatch_check(chunk=0,
+                                           epoch=self._epoch_idx)
+                return self._compiled(state, self._put_batches(seeds),
+                                      key, self.sampler._arrays())
+              (state, losses, correct, valid, stats,
+               hops) = run_with_deadline(_epoch_dispatch,
+                                         scope='fused.dispatch')
+            self.sampler._accumulate_stats(stats)
+            self._save_chunk_snapshot(state, s, s, [losses], correct,
+                                      valid, force=True, hops=hops)
       self._emit_hop_events(hops, seeds.shape[0])
     return state, EpochStats(losses, correct, valid)
 
@@ -171,26 +216,115 @@ class _MeshEpochDriver:
 
   def _run_tiered(self, state, seeds: np.ndarray, key):
     """Chunked collect → cold-service → train epoch (tiered stores).
-    Returns ``(state, losses, correct, valid, hops)``."""
-    from ..telemetry.spans import span
+    Returns ``(state, losses, correct, valid, hops)``.
+
+    With snapshots attached, every chunk boundary is a durable
+    recovery point, and a `MeshStallError` (hung collective under
+    `GLT_DISPATCH_DEADLINE`) rolls back to the last snapshot and
+    retries on the surviving hosts when ``GLT_DEGRADED_OK=1`` —
+    instead of wedging or losing the epoch."""
+    from ..distributed.resilience import MeshStallError, degraded_ok
     s = seeds.shape[0]
     chunk = self._cold_chunk_steps(s)
-    losses, correct, valid, hops = [], None, None, None
-    for c0, real, part, keys in self._tiered_chunks(seeds, key, chunk):
-      with span('fused.dispatch', chunk=c0, phase='collect'):
-        data, stats = self._compiled_collect(
-            self._put_batches(part), keys, self.sampler._arrays())
-      # stats sliced to the real steps: padded tail steps still carry
-      # static exchange SLOTS, which would inflate padding waste
-      self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
-      data = self._overlay_chunk(data)
-      with span('fused.dispatch', chunk=c0, phase='train'):
-        state, ls, cor, val, hop = self._compiled_train(state, data)
+    skip, losses, correct, valid, extra = self._take_resume(chunk)
+    hops = extra.get('hops')
+    if 'sampler_stats' in extra:
+      # a fresh-process resume continues the interrupted epoch's
+      # cumulative exchange/cold telemetry, not a zeroed ledger
+      self.sampler._load_stats_state(extra['sampler_stats'])
+    stats_fn = lambda: {'sampler_stats': self.sampler._stats_state()}
+    if self._snap is not None and skip == 0 and not losses:
+      # epoch-entry save: the rollback target a chunk-0 stall needs
+      self._save_chunk_snapshot(state, 0, chunk, losses, correct,
+                                valid, force=True, extra_fn=stats_fn)
+    parts = list(self._tiered_chunks(seeds, key, chunk))
+    i = rollbacks = 0
+    while i < len(parts):
+      c0, real, part, keys = parts[i]
+      if c0 < skip:
+        i += 1
+        continue
+      try:
+        state, ls, cor, val, hop = self._dispatch_tiered_chunk(
+            state, part, keys, real, c0)
+      except MeshStallError:
+        if (not degraded_ok() or self._snap is None
+            or rollbacks >= 3):
+          raise
+        rollback = self._rollback_to_snapshot(state)
+        if rollback is None:
+          raise     # nothing durable to roll back to: stay typed
+        rollbacks += 1
+        (state, skip, losses, correct, valid, hops) = rollback
+        i = 0
+        continue
       losses.append(ls[:real])
       correct = cor if correct is None else correct + cor
       valid = val if valid is None else valid + val
       hops = hop if hops is None else hops + hop
+      self._save_chunk_snapshot(state, c0 + chunk, chunk, losses,
+                                correct, valid, hops=hops,
+                                extra_fn=stats_fn)
+      i += 1
     return state, jnp.concatenate(losses), correct, valid, hops
+
+  def _dispatch_tiered_chunk(self, state, part, keys, real: int,
+                             c0: int):
+    """One chunk's collect → overlay → train, every dispatch under
+    the stall watchdog and the ``fused.dispatch`` chaos seam."""
+    from ..distributed.resilience import run_with_deadline
+    from ..telemetry.spans import span
+    from ..testing import chaos
+    with span('fused.dispatch', chunk=c0, phase='collect'):
+      def _collect():
+        chaos.fused_dispatch_check(chunk=int(c0),
+                                   epoch=self._epoch_idx,
+                                   phase='collect')
+        return self._compiled_collect(self._put_batches(part), keys,
+                                      self.sampler._arrays())
+      data, stats = run_with_deadline(_collect, scope='fused.dispatch')
+    # stats sliced to the real steps: padded tail steps still carry
+    # static exchange SLOTS, which would inflate padding waste
+    chunk_stats = jnp.sum(stats[:real], axis=0)
+    data = self._overlay_chunk(data)
+    with span('fused.dispatch', chunk=c0, phase='train'):
+      out = run_with_deadline(self._train_chunk, state, data,
+                              scope='fused.dispatch')
+    # banked only after BOTH dispatches land: a train-phase stall
+    # rolls back and re-runs the chunk, and stats accumulated at
+    # collect time would then double-count
+    self.sampler._accumulate_stats(chunk_stats)
+    return out
+
+  def _train_chunk(self, state, data):
+    """Train dispatch for one tiered chunk -> ``(state, losses,
+    correct, valid, hops)`` (the link driver overrides: no accuracy,
+    no hop gauges)."""
+    return self._compiled_train(state, data)
+
+  def _rollback_to_snapshot(self, cur_state):
+    """Degraded stall recovery: reload the last snapshot's train
+    state + progress (NOT the full data plane — the epoch counters
+    and batcher are live and correct mid-run) and hand back the
+    accumulators to continue from.  ``None`` when no snapshot was
+    ever published (every save failed): the caller re-raises the
+    stall."""
+    payload = self._snap.restore_latest()
+    if payload is None:
+      return None
+    prog = payload['progress']
+    train = payload.get('train')
+    state = (self._state_to_device(train) if train is not None
+             else cur_state)
+    saved = np.asarray(prog['losses'])
+    losses = [saved] if saved.size else []
+    if 'sampler_stats' in prog:
+      # re-dispatched chunks re-accumulate exchange/cold counters;
+      # rewinding them to the snapshot keeps AdaptiveSlack and the
+      # padding-waste metrics honest through a degraded recovery
+      self.sampler._load_stats_state(prog['sampler_stats'])
+    return (state, int(np.asarray(prog['next_chunk'])), losses,
+            prog.get('correct'), prog.get('valid'), prog.get('hops'))
 
   def _emit_hop_events(self, hop_counts, steps: int) -> None:
     """Per-hop padding-fill flight-recorder events for one fused
@@ -1111,6 +1245,12 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
 
   # -- host driver ----------------------------------------------------------
 
+  def _train_chunk(self, state, data):
+    # link train has no accuracy and no hop gauges — adapt to the
+    # shared _run_tiered 5-tuple (None accumulators stay None)
+    state, ls, val = self._compiled_train(state, data)
+    return state, ls, None, val, None
+
   def run(self, state: TrainState) -> Tuple[TrainState, 'EpochStats']:
     """One epoch; ``state`` must be mesh-replicated and is DONATED.
     ``stats.seeds`` counts valid seed EDGES; accuracy reads 0 (the
@@ -1123,20 +1263,14 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     key = self._next_epoch_key()
     with step_annotation('fused_dist_link_epoch', self._epoch_idx):
       if self._tiered:
-        s = pairs.shape[0]
-        chunk = self._cold_chunk_steps(s)
-        losses, valid = [], None
-        for c0, real, part, keys in self._tiered_chunks(pairs, key,
-                                                        chunk):
-          batches, stats = self._compiled_collect(
-              self._put_batches(part), keys, self.sampler._arrays())
-          self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
-          batches = self._overlay_chunk(batches)
-          state, ls, val = self._compiled_train(state, batches)
-          losses.append(ls[:real])
-          valid = val if valid is None else valid + val
-        return state, EpochStats(jnp.concatenate(losses),
-                                 jnp.zeros((), jnp.int32), valid)
+        # the shared chunked driver: snapshot seams, stall watchdog
+        # AND degraded rollback — the link driver must honor the same
+        # preemption contract as the node twins (link stats carry
+        # valid-pair counts; no accuracy, no hop gauges)
+        state, losses, _corr, valid, _hops = self._run_tiered(
+            state, pairs, key)
+        return state, EpochStats(losses, jnp.zeros((), jnp.int32),
+                                 valid)
       state, losses, valid, stats = self._compiled(
           state, self._put_batches(pairs), key, self.sampler._arrays())
     self.sampler._accumulate_stats(stats)

@@ -62,6 +62,19 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # silently regressing back to the r5 static-split numbers
     ('dist.tiered.seeds_per_sec', 'higher'),
     ('dist.feature.cache_hit_rate', 'higher'),
+    # preemption-resume guard (ISSUE 6): restoring a mid-epoch
+    # snapshot and re-entering the epoch must stay cheap — a resume
+    # that re-executes half the epoch (replayed_batches creeping up)
+    # or a restore path that grew a slow sync would erode exactly the
+    # recovery-time story the snapshot layer exists for
+    ('dist.resume.restore_secs', 'lower'),
+    ('dist.resume.replayed_batches', 'lower'),
+    # the snapshot-overhead acceptance line: snapshotting throughput
+    # over the same run's no-snapshot line (~1.0 when saves are in
+    # the noise).  Guarded as a positive RATIO, not the signed
+    # overhead pct, whose healthy baseline straddles zero (the
+    # cur/base slowdown math inverts on a negative baseline).
+    ('dist.resume.snap_over_nosnap_ratio', 'higher'),
 )
 
 

@@ -35,15 +35,21 @@ from .export import load_events, span_durations, write_chrome_trace
 from .histogram import Histogram, from_snapshot
 
 
-def histograms_from_trace(path: str) -> Dict[str, Histogram]:
-  """Per-kind histograms rebuilt from a JSONL trace's span.end
-  durations."""
+def histograms_from_events(events: List[Dict]) -> Dict[str, Histogram]:
+  """Per-kind histograms rebuilt from already-loaded trace events'
+  span.end durations."""
   out: Dict[str, Histogram] = {}
-  for kind, durs in span_durations(load_events(path)).items():
+  for kind, durs in span_durations(events).items():
     h = out.setdefault(kind, Histogram(kind))
     for d in durs:
       h.add(d)
   return out
+
+
+def histograms_from_trace(path: str) -> Dict[str, Histogram]:
+  """Per-kind histograms rebuilt from a JSONL trace's span.end
+  durations."""
+  return histograms_from_events(load_events(path))
 
 
 def _fmt_secs(s: float) -> str:
@@ -88,6 +94,56 @@ def format_table(hists: Dict[str, Histogram],
   return '\n'.join(lines)
 
 
+#: resilience/durability event kinds the report CLI counts next to the
+#: latency table (ISSUE 6 satellite: until now these were only visible
+#: by grepping the raw JSONL).  kind -> the field used for the
+#: per-bucket breakdown column ('' = none).
+RESILIENCE_KINDS = (
+    ('rpc.retry', 'op'),
+    ('peer.lost', 'peer_kind'),
+    ('fault.injected', 'site'),
+    ('producer.restart', 'worker'),
+    ('snapshot.save', 'ok'),
+    ('snapshot.restore', 'dir'),
+    ('mesh.stall', 'scope'),
+)
+
+
+def resilience_counts(events) -> List[List[str]]:
+  """``[kind, count, breakdown]`` rows for every resilience kind
+  present in the trace (absent kinds are omitted — a clean run prints
+  no table at all)."""
+  rows: List[List[str]] = []
+  for kind, field in RESILIENCE_KINDS:
+    evs = [e for e in events if e.get('kind') == kind]
+    if not evs:
+      continue
+    breakdown = ''
+    if field:
+      by: Dict[str, int] = {}
+      for e in evs:
+        key = str(e.get(field))
+        by[key] = by.get(key, 0) + 1
+      breakdown = ', '.join(f'{k}={v}' for k, v in sorted(by.items()))
+    rows.append([kind, str(len(evs)), breakdown])
+  return rows
+
+
+def format_resilience_table(events) -> str:
+  """Render the resilience-event count table ('' when the trace holds
+  none)."""
+  rows = resilience_counts(events)
+  if not rows:
+    return ''
+  header = ['event', 'count', 'breakdown']
+  widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+            for i in range(3)]
+  lines = ['  '.join(h.ljust(w) for h, w in zip(header, widths))]
+  for r in rows:
+    lines.append('  '.join(c.ljust(w) for c, w in zip(r, widths)))
+  return '\n'.join(lines)
+
+
 def histograms_from_metrics_json(path: str) -> Dict[str, Histogram]:
   """Decode a `gather_metrics` dump (the ``aggregate`` dict, or the
   whole result object) into merged histograms."""
@@ -125,7 +181,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                  'argument (a metrics aggregate has no events to '
                  'export or diff)')
       return 0
-  hists = histograms_from_trace(args.trace)
+  events = load_events(args.trace)
+  hists = histograms_from_events(events)
   base = histograms_from_trace(args.diff) if args.diff else None
   print(f'# per-stage span latencies ({args.trace})'
         + (f' vs {args.diff}' if args.diff else ''))
@@ -134,6 +191,10 @@ def main(argv: Optional[List[str]] = None) -> int:
           'the pipeline span-instrumented?)')
   else:
     print(format_table(hists, baseline=base))
+  res = format_resilience_table(events)
+  if res:
+    print('# resilience events (retries, faults, snapshots, stalls)')
+    print(res)
   if args.chrome:
     n = write_chrome_trace(args.trace, args.chrome)
     print(f'# wrote {n} trace events -> {args.chrome} '

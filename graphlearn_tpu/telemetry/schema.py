@@ -81,6 +81,19 @@ EVENT_KINDS: Dict[str, str] = {
         'DistServer.wait_for_exit: rank, timeout_secs, '
         'clients_never_exited, clients_left, live_producers — a '
         'shutdown wait that expired instead of returning silently',
+    'snapshot.save':
+        'utils.checkpoint.SnapshotManager.save: index, ok, secs, dir, '
+        'epoch, next_chunk (ok=False carries error — a failed '
+        'snapshot write is absorbed, not fatal)',
+    'snapshot.restore':
+        'utils.checkpoint.SnapshotManager.restore_latest: index, '
+        'secs, dir, epoch, next_chunk — one event per data-plane '
+        'restore (resume and degraded rollback both land here)',
+    'mesh.stall':
+        'resilience.run_with_deadline: scope, deadline_secs, healthy '
+        '(last-known-healthy process set) — a fused/mesh dispatch '
+        'exceeded GLT_DISPATCH_DEADLINE and was converted into a '
+        'typed MeshStallError instead of hanging the epoch',
 }
 
 

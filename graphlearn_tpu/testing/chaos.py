@@ -20,6 +20,27 @@ wall clocks involved).  Sites and actions:
       ``kill`` ( ``os._exit(WORKER_KILL_EXIT)`` — a hard crash, no
       cleanup, like the OOM killer).  ``worker`` / ``epoch`` filter by
       worker rank and epoch.
+  ``checkpoint.io``
+      Seam inside `utils.checkpoint.Checkpointer.save`.  Actions:
+      ``fail`` (the write dies before any byte lands), ``truncate``
+      (a PARTIAL tmp write then death before the atomic publish — the
+      kill-mid-write scenario; the previous snapshot must stay the
+      durable latest).
+  ``fused.dispatch``
+      Seam around each fused-epoch chunk dispatch (`loader.fused`,
+      `parallel.fused`).  Actions: ``delay`` (sleep ``secs`` INSIDE
+      the watchdog-timed region, so a configured
+      ``GLT_DISPATCH_DEADLINE`` converts it into `MeshStallError` —
+      the hung-collective simulation), ``kill`` (raise
+      :class:`ChaosKilledError` — the in-process stand-in for a
+      preemption; the producer-worker site keeps the real
+      ``os._exit`` arm).  ``epoch`` filters by epoch.
+  ``feature.cold_service``
+      Seam at the top of the host cold-tier gather (single-chip
+      `data.feature.Feature` mixed path and the mesh cold overlay).
+      Action ``fail`` raises :class:`InjectedFault` — a host feature
+      tier that died mid-epoch; the snapshot/resume layer is what
+      turns it into a finished epoch.
 
 Plans install three ways: programmatically (:func:`install`), from the
 ``GLT_FAULT_PLAN`` env var (inherited by producer subprocesses and
@@ -60,8 +81,22 @@ FAULT_PLAN_ENV = 'GLT_FAULT_PLAN'
 #: real crashes).
 WORKER_KILL_EXIT = 173
 
-_SITES = ('rpc.request', 'producer.worker')
-_ACTIONS = ('drop', 'delay', 'corrupt', 'kill')
+_SITES = ('rpc.request', 'producer.worker', 'checkpoint.io',
+          'fused.dispatch', 'feature.cold_service')
+_ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate')
+
+
+class InjectedFault(RuntimeError):
+  """A chaos 'fail' action fired: the real-world analog (disk error,
+  host OOM, cold-tier service death) raised mid-operation."""
+
+
+class ChaosKilledError(RuntimeError):
+  """A planned ``fused.dispatch:kill`` fired — the in-process stand-in
+  for a preemption (SIGKILL would also kill the test runner; the
+  producer-worker site keeps the real ``os._exit`` arm).  Everything a
+  real kill loses is lost here too: the test must resume from the
+  DURABLE snapshot in a fresh driver, not from live state."""
 
 
 @dataclass
@@ -265,3 +300,27 @@ def worker_kill_check(rank: int, epoch: int, generation: int = 0) -> None:
               generation=generation):
     if f.action == 'kill':
       os._exit(WORKER_KILL_EXIT)
+
+
+def fused_dispatch_check(chunk: int = 0, epoch: int = 0,
+                         phase: str = '') -> None:
+  """Fused-chunk-dispatch seam (called INSIDE the watchdog-timed
+  region): ``delay`` sleeps there so a configured dispatch deadline
+  sees a hung collective; ``kill`` raises `ChaosKilledError` (the
+  preemption stand-in)."""
+  for f in on('fused.dispatch', chunk=chunk, epoch=epoch, op=phase or
+              None):
+    if f.action == 'delay':
+      time.sleep(f.secs)
+    elif f.action == 'kill':
+      raise ChaosKilledError(
+          f'injected fused.dispatch kill (epoch {epoch}, chunk '
+          f'{chunk})')
+
+
+def cold_service_check(scope: str = '') -> None:
+  """Host cold-tier gather seam; ``fail`` raises `InjectedFault`."""
+  for f in on('feature.cold_service', op=scope or None):
+    if f.action == 'fail':
+      raise InjectedFault(
+          f'injected cold-tier service failure (scope {scope!r})')

@@ -11,12 +11,13 @@ from .tensor import convert_to_array, id2idx, to_device, to_host
 
 
 def __getattr__(name):
-  # Checkpointer is lazy: importing it pulls orbax (~4s), which every
-  # process importing the library would otherwise pay — including each
-  # mp sampling producer subprocess.
-  if name == 'Checkpointer':
-    from .checkpoint import Checkpointer
-    return Checkpointer
+  # checkpoint symbols are lazy: importing the module can pull orbax
+  # (~4s), which every process importing the library would otherwise
+  # pay — including each mp sampling producer subprocess.
+  if name in ('Checkpointer', 'CheckpointMismatchError',
+              'SnapshotManager'):
+    from . import checkpoint
+    return getattr(checkpoint, name)
   raise AttributeError(f'module {__name__!r} has no attribute {name!r}')
 from .topo import (coo_to_csc, coo_to_csr, csr_to_coo, degrees_from_indptr,
                    ptr2ind)

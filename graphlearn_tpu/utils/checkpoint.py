@@ -1,11 +1,32 @@
-"""Checkpoint/resume for training state.
+"""Checkpoint/resume for training state AND the data plane.
 
 The reference has NO checkpointing (SURVEY §5: examples rely on
-user-level ``torch.save``) — this module is beyond parity: an
-orbax-backed store for arbitrary pytrees (train state, optimizer,
-step counters) with a synchronous save/restore API shaped like the
-examples need it.  Falls back to a numpy+pickle layout when orbax is
-unavailable, so checkpoints work in any environment.
+user-level ``torch.save``) — this module is beyond parity twice over:
+
+  * :class:`Checkpointer` — an orbax-backed store for arbitrary
+    pytrees (train state, optimizer, step counters) with a synchronous
+    save/restore API shaped like the examples need it.  Falls back to
+    a numpy+pickle layout when orbax is unavailable, so checkpoints
+    work in any environment.  ``restore(template=)`` VALIDATES the
+    loaded tree against the template (structure, dtypes, shapes) and
+    raises :class:`CheckpointMismatchError` naming the first diverging
+    path — a stale checkpoint must fail loudly, not restore garbage.
+  * the **DataPlaneState protocol** + :class:`SnapshotManager` —
+    durable mid-epoch snapshots of every stateful data-plane component
+    (loader cursors + permutation RNGs, producer positions, cold-cache
+    rings, fused-epoch chunk progress), so a preempted process resumes
+    with byte-identical remaining batches.  ``torch.save`` captures
+    model weights but not loader position, sampler RNG, or cache
+    state; this captures all of them at the fused drivers' chunk
+    boundaries (the natural recovery points).
+
+DataPlaneState protocol (duck-typed — no base class to inherit):
+
+  * ``state_dict() -> dict`` — a pytree of numpy-compatible leaves
+    (arrays / ints / packed bytes via :func:`pack_rng_state` /
+    :func:`pack_bytes`) capturing everything needed to resume;
+  * ``load_state_dict(state) -> None`` — restore from such a tree
+    (leaves may come back as 0-d numpy arrays; coerce with ``int()``).
 
 Usage::
 
@@ -13,16 +34,44 @@ Usage::
     ckpt.save(step, state)                  # keeps the newest K
     state = ckpt.restore(template=state)    # None if empty
     step = ckpt.latest_step()
+
+    snap = SnapshotManager('/ckpts/run1/plane', every=2)
+    fused.attach_snapshots(snap)            # saves at chunk boundaries
+    # after a preemption, in a fresh process:
+    fused.attach_snapshots(snap)
+    state = fused.restore_from_snapshot(state)   # mid-epoch rewind
+    state, stats = fused.run(state)              # finishes the epoch
+
+Env knobs: ``GLT_SNAPSHOT_DIR`` (default snapshot root — enables
+snapshotting in drivers that were not handed a manager explicitly),
+``GLT_SNAPSHOT_EVERY`` (chunk boundaries between saves, default 1).
 """
 from __future__ import annotations
 
+import os
 import pickle
 import shutil
+import time
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+SNAPSHOT_DIR_ENV = 'GLT_SNAPSHOT_DIR'
+SNAPSHOT_EVERY_ENV = 'GLT_SNAPSHOT_EVERY'
+
+
+class CheckpointMismatchError(ValueError):
+  """A restored checkpoint does not match the caller's template: the
+  tree structure differs, or a leaf's dtype/shape diverges.  ``path``
+  names the first diverging tree path — the actionable datum (a stale
+  checkpoint restoring silently is how a resumed job trains on
+  garbage)."""
+
+  def __init__(self, msg: str, path: str = ''):
+    super().__init__(msg)
+    self.path = path
 
 
 def _try_orbax():
@@ -31,6 +80,65 @@ def _try_orbax():
     return ocp
   except Exception:  # pragma: no cover - baked into this env, gate anyway
     return None
+
+
+def _leaf_paths(tree) -> Dict[str, Any]:
+  """Flatten a pytree to ``{'/a/b[0]': leaf}`` using key paths — the
+  mismatch diagnostics' vocabulary."""
+  flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+  return {jax.tree_util.keystr(kp): v for kp, v in flat}
+
+
+def validate_tree(restored: Any, template: Any) -> None:
+  """Raise `CheckpointMismatchError` (first diverging path) unless
+  ``restored`` matches ``template`` in structure and per-leaf
+  dtype/shape.  Scalar-vs-0-d-array differences are tolerated (the
+  numpy backend round-trips python ints through 0-d arrays)."""
+  r_def = jax.tree_util.tree_structure(restored)
+  t_def = jax.tree_util.tree_structure(template)
+  if r_def != t_def:
+    r_paths = set(_leaf_paths(restored))
+    t_paths = set(_leaf_paths(template))
+    diverging = sorted((r_paths - t_paths) | (t_paths - r_paths))
+    path = diverging[0] if diverging else '<root>'
+    raise CheckpointMismatchError(
+        f'checkpoint tree structure does not match the template '
+        f'(first diverging path: {path}; checkpoint has '
+        f'{r_def.num_leaves} leaves, template {t_def.num_leaves})',
+        path=path)
+  r_leaves = _leaf_paths(restored)
+  for path, t_leaf in _leaf_paths(template).items():
+    r_leaf = r_leaves[path]
+    r_arr, t_arr = np.asarray(r_leaf), np.asarray(t_leaf)
+    if r_arr.shape != t_arr.shape:
+      raise CheckpointMismatchError(
+          f'checkpoint leaf {path} has shape {r_arr.shape}, template '
+          f'expects {t_arr.shape}', path=path)
+    if r_arr.dtype != t_arr.dtype:
+      raise CheckpointMismatchError(
+          f'checkpoint leaf {path} has dtype {r_arr.dtype}, template '
+          f'expects {t_arr.dtype}', path=path)
+
+
+def pack_bytes(obj: Any) -> np.ndarray:
+  """Pickle an arbitrary host object into a uint8 array so it rides a
+  numpy-leaf pytree (RNG states hold 128-bit ints numpy cannot
+  represent directly)."""
+  return np.frombuffer(pickle.dumps(obj, protocol=5), np.uint8).copy()
+
+
+def unpack_bytes(arr) -> Any:
+  return pickle.loads(np.asarray(arr, np.uint8).tobytes())
+
+
+def pack_rng_state(rng: np.random.Generator) -> np.ndarray:
+  """Capture a numpy Generator's full bit-generator state as a
+  checkpointable leaf."""
+  return pack_bytes(rng.bit_generator.state)
+
+
+def restore_rng_state(rng: np.random.Generator, packed) -> None:
+  rng.bit_generator.state = unpack_bytes(packed)
 
 
 class Checkpointer:
@@ -74,13 +182,23 @@ class Checkpointer:
 
   # -- save/restore -------------------------------------------------------
   def save(self, step: int, tree: Any) -> Path:
+    from ..testing import chaos
     self.directory.mkdir(parents=True, exist_ok=True)
     d = self._step_dir(step)
     tmp = d.with_suffix('.tmp')
     if tmp.exists():
       shutil.rmtree(tmp)
+    # chaos seam: a planned 'fail' dies before any byte is written; a
+    # 'truncate' writes a PARTIAL tmp dir and dies before the atomic
+    # rename — either way the previous published snapshot stays the
+    # durable latest (what the kill-mid-write acceptance pins)
+    faults = chaos.on('checkpoint.io', step=int(step),
+                      path=str(self.directory))
+    if any(f.action == 'fail' for f in faults):
+      raise OSError(f'injected checkpoint write failure (step {step})')
     host_tree = jax.tree_util.tree_map(np.asarray, tree)
-    if self._orbax:
+    truncate = any(f.action == 'truncate' for f in faults)
+    if self._orbax and not truncate:
       self._ckptr.save(tmp, host_tree)
     else:
       tmp.mkdir(parents=True)
@@ -89,6 +207,14 @@ class Checkpointer:
                **{f'l{i}': v for i, v in enumerate(leaves)})
       with open(tmp / 'treedef.pkl', 'wb') as f:
         pickle.dump(treedef, f, protocol=5)
+      if truncate:
+        # cut the leaves file mid-stream, like a kill during the
+        # write, then die WITHOUT publishing: the .tmp carcass must
+        # never shadow the last good step
+        with open(tmp / 'leaves.npz', 'r+b') as f:
+          f.truncate(max(f.seek(0, 2) // 2, 1))
+        raise OSError(
+            f'injected truncated checkpoint write (step {step})')
     if d.exists():
       shutil.rmtree(d)
     tmp.rename(d)                      # atomic publish
@@ -99,9 +225,10 @@ class Checkpointer:
               ) -> Optional[Any]:
     """Load the given (default: latest) step; ``None`` when empty.
 
-    ``template`` (a pytree of the expected structure) is required for
-    the fallback backend and recommended for orbax (restores with
-    matching dtypes/shapes).
+    ``template`` (a pytree of the expected structure) is optional but
+    recommended: when given, the restored tree is VALIDATED against it
+    (structure + per-leaf dtype/shape, both backends) and a divergence
+    raises `CheckpointMismatchError` naming the first diverging path.
     """
     step = step if step is not None else self.latest_step()
     if step is None:
@@ -110,16 +237,166 @@ class Checkpointer:
     if self._orbax:
       host_template = (None if template is None else
                        jax.tree_util.tree_map(np.asarray, template))
-      return self._ckptr.restore(d, item=host_template)
-    if template is None:
-      raise ValueError('fallback backend needs a template pytree')
-    with open(d / 'treedef.pkl', 'rb') as f:
-      treedef = pickle.load(f)
-    data = np.load(d / 'leaves.npz')
-    leaves = [data[f'l{i}'] for i in range(len(data.files))]
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+      try:
+        out = self._ckptr.restore(d, item=host_template)
+      except CheckpointMismatchError:
+        raise
+      except Exception as e:        # noqa: BLE001 — typed below
+        if template is None:
+          raise
+        # orbax raises its own (untyped) structure errors before our
+        # validation can run — re-restore in the SAVED structure and
+        # diff that against the template for the diverging-path
+        # diagnostic, falling back to the raw orbax message
+        try:
+          raw = self._ckptr.restore(d)
+        except Exception:           # noqa: BLE001 — carcass unreadable
+          raise CheckpointMismatchError(
+              f'checkpoint at {d} does not match the template and '
+              f'could not be read structurally: {e}') from e
+        validate_tree(raw, host_template)
+        raise CheckpointMismatchError(
+            f'checkpoint at {d} does not match the template: {e}'
+        ) from e
+    else:
+      with open(d / 'treedef.pkl', 'rb') as f:
+        treedef = pickle.load(f)
+      data = np.load(d / 'leaves.npz')
+      leaves = [data[f'l{i}'] for i in range(len(data.files))]
+      out = jax.tree_util.tree_unflatten(treedef, leaves)
+    if template is not None:
+      validate_tree(out, template)
+    return out
 
   def _gc(self):
     steps = self.all_steps()
     for s in steps[:-self.max_to_keep]:
       shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+
+# -- data-plane snapshots ----------------------------------------------------
+
+def snapshot_dir_from_env() -> Optional[str]:
+  """``GLT_SNAPSHOT_DIR`` — the opt-in that lets drivers build their
+  own `SnapshotManager` when none was attached explicitly."""
+  return os.environ.get(SNAPSHOT_DIR_ENV) or None
+
+
+def snapshot_every_from_env(default: int = 1) -> int:
+  try:
+    return max(int(os.environ.get(SNAPSHOT_EVERY_ENV, default)), 1)
+  except ValueError:
+    return default
+
+
+class SnapshotManager:
+  """Durable epoch-state snapshots for one training job.
+
+  One manager owns one snapshot directory and a save cadence
+  (``every`` chunk boundaries between saves — `GLT_SNAPSHOT_EVERY`).
+  The payload is a single pytree ``{'plane': <component states>,
+  'progress': <epoch/chunk cursor + partial stats>, 'train':
+  <TrainState, host copies>}`` written through `Checkpointer` (atomic
+  tmp+rename publish; a kill mid-write leaves the previous snapshot as
+  the durable latest).  Monotone snapshot indices double as the
+  Checkpointer step, so ``restore_latest`` is always the newest
+  published state.
+
+  A FAILED save (disk full, injected `checkpoint.io` fault) is
+  absorbed: the epoch continues, the failure lands in telemetry
+  (``snapshot.save`` with ``ok=False``) — losing one snapshot's
+  durability must not kill the training it exists to protect.
+  """
+
+  def __init__(self, directory: Optional[str] = None,
+               every: Optional[int] = None, max_to_keep: int = 2,
+               use_orbax: Optional[bool] = False):
+    directory = directory or snapshot_dir_from_env()
+    if directory is None:
+      raise ValueError('SnapshotManager needs a directory (argument '
+                       'or GLT_SNAPSHOT_DIR)')
+    # numpy backend by default: snapshot payloads carry packed-bytes
+    # leaves and nested progress dicts that orbax's strict typed
+    # restore refuses without a full template (which a fresh process
+    # restoring mid-epoch does not have yet)
+    self._ckpt = Checkpointer(directory, max_to_keep=max_to_keep,
+                              use_orbax=use_orbax)
+    self.every = max(int(every), 1) if every is not None \
+        else snapshot_every_from_env()
+    self._save_idx = 0
+    self._boundaries = 0
+
+  @property
+  def directory(self) -> Path:
+    return self._ckpt.directory
+
+  def due(self) -> bool:
+    """Tick one chunk boundary; True when this boundary should save
+    (every Nth, counting from the first)."""
+    due = self._boundaries % self.every == 0
+    self._boundaries += 1
+    return due
+
+  def save(self, plane: dict, progress: dict,
+           train: Any = None) -> bool:
+    """Write one snapshot; returns False (and records the failure)
+    instead of raising when the write fails."""
+    from ..telemetry.recorder import recorder
+    payload = {'plane': plane, 'progress': progress}
+    if train is not None:
+      payload['train'] = jax.tree_util.tree_map(np.asarray, train)
+    self._save_idx += 1
+    t0 = time.perf_counter()
+    try:
+      self._ckpt.save(self._save_idx, payload)
+    except OSError as e:
+      recorder.emit('snapshot.save', index=self._save_idx, ok=False,
+                    error=str(e), dir=str(self.directory))
+      return False
+    recorder.emit('snapshot.save', index=self._save_idx, ok=True,
+                  secs=round(time.perf_counter() - t0, 4),
+                  dir=str(self.directory),
+                  epoch=progress.get('epoch'),
+                  next_chunk=progress.get('next_chunk'))
+    return True
+
+  def restore_latest(self) -> Optional[dict]:
+    """Load the newest READABLE published snapshot payload (``None``
+    when the directory holds none) and emit ``snapshot.restore``.
+
+    An unreadable newest snapshot (torn disk, a crash on a
+    filesystem whose dir rename is not atomic) is SKIPPED to the next
+    older step — ``max_to_keep > 1`` retains older snapshots exactly
+    for this — with the failure recorded (``snapshot.restore`` with
+    ``ok=False``); only when every retained snapshot is unreadable
+    does the newest error propagate."""
+    from ..telemetry.recorder import recorder
+    t0 = time.perf_counter()
+    steps = self._ckpt.all_steps()
+    if not steps:
+      return None
+    first_err = None
+    for step in reversed(steps):
+      try:
+        out = self._ckpt.restore(step=step)
+      except Exception as e:          # noqa: BLE001 — skip-to-older
+        first_err = first_err if first_err is not None else e
+        recorder.emit('snapshot.restore', index=step, ok=False,
+                      dir=str(self.directory), error=repr(e))
+        continue
+      self._save_idx = step          # later saves continue the index
+      recorder.emit('snapshot.restore', index=step,
+                    secs=round(time.perf_counter() - t0, 4),
+                    dir=str(self.directory),
+                    epoch=_scalar(out.get('progress', {}).get('epoch')),
+                    next_chunk=_scalar(
+                        out.get('progress', {}).get('next_chunk')))
+      return out
+    raise first_err
+
+
+def _scalar(v):
+  """0-d-array-tolerant int coercion for restored progress fields."""
+  if v is None:
+    return None
+  return int(np.asarray(v))

@@ -281,3 +281,86 @@ def test_hetero_with_edge_static_pytree():
       em = np.asarray(batch.edge_mask_dict[et])
       assert np.all(ev[em] >= 0)
   assert len(structs) == 1
+
+
+# -- degraded completion (ISSUE 6 satellite) --------------------------------
+
+def _degraded_server_proc(port_q, rank, fault_plan):
+  """One of two hetero sampling servers; ``fault_plan`` (rank 1) kills
+  its only producer worker with a zero restart budget, so its pool
+  dies mid-epoch and fetches surface as typed peer-lost errors."""
+  import os
+  if fault_plan:
+    os.environ['GLT_FAULT_PLAN'] = fault_plan
+    os.environ['GLT_MAX_WORKER_RESTARTS'] = '0'
+  from graphlearn_tpu.distributed import (init_server,
+                                          wait_and_shutdown_server)
+  ds, _, _, _ = _bipartite()
+  srv = init_server(num_servers=2, num_clients=1, rank=rank,
+                    dataset=ds, host='127.0.0.1', port=0)
+  port_q.put(srv.port)
+  wait_and_shutdown_server(timeout=120)
+
+
+def test_remote_hetero_degraded_drops_dead_server(monkeypatch):
+  """The PR 4 homogeneous degraded contract, heterogeneous: one of two
+  sampling servers dies mid-epoch (its producer worker is killed with
+  no restart budget); with ``GLT_DEGRADED_OK=1`` the epoch finishes on
+  the survivor with a REDUCED-BUT-EXACT batch set — every delivered
+  batch provenance-checked, no duplicate seeds, the loss flagged as a
+  ``peer.lost`` event with ``degraded=True``."""
+  from graphlearn_tpu.distributed.dist_loader import DistLoader
+  from graphlearn_tpu.telemetry import recorder
+  monkeypatch.setenv('GLT_DEGRADED_OK', '1')
+  monkeypatch.setattr(DistLoader, 'RECV_POLL_SECS', 1.0)
+  recorder.enable(None)
+  recorder.clear()
+  ctx = mp.get_context('spawn')
+  procs, ports = [], []
+  for rank in range(2):
+    q = ctx.Queue()
+    plan = ('producer.worker:kill:2:worker=0:epoch=0'
+            if rank == 1 else '')
+    p = ctx.Process(target=_degraded_server_proc,
+                    args=(q, rank, plan), daemon=False)
+    p.start()
+    procs.append(p)
+    ports.append(q.get(timeout=120))
+
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  init_client([('127.0.0.1', pt) for pt in ports], rank=0,
+              num_clients=1)
+  _, edge_set, _, _ = _bipartite()
+  loader = DistNeighborLoader(
+      None, {ET: [2, 2], REV: [2, 2]}, ('u', np.arange(NU)),
+      batch_size=8, shuffle=False,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=[0, 1], num_workers=1, prefetch_size=1),
+      to_device=False)
+  try:
+    batches = []
+    for batch in loader:
+      _check_batch(batch, edge_set)
+      batches.append(batch)
+    lost_evs = [e for e in recorder.events('peer.lost')
+                if e.get('degraded')]
+    assert lost_evs, 'degraded completion must be flagged'
+    lost = sum(e['lost_batches'] for e in lost_evs)
+    assert lost >= 1
+    # reduced-but-EXACT: every delivered seed exactly once
+    seeds = np.concatenate(
+        [np.asarray(b.batch_dict['u']) for b in batches])
+    seeds = seeds[seeds >= 0]
+    assert len(seeds) == len(set(seeds.tolist()))
+    assert 0 < len(seeds) < NU, 'reduced: the dead server\'s share lost'
+    assert len(batches) == loader._expected
+  finally:
+    loader.shutdown()
+    shutdown_client()
+    recorder.clear()
+    recorder.disable()
+    for p in procs:
+      p.join(timeout=60)
+      assert not p.is_alive()

@@ -224,3 +224,50 @@ def test_heartbeat_reports_producers():
   srv = DistServer(dataset=None)
   hb = srv.heartbeat()
   assert hb['producers'] == {} and 'time' in hb
+
+
+# -- replay-cache horizon (ISSUE 6 satellite) -------------------------------
+def test_replay_cache_eviction_watermark_unit():
+  """`_ReplayCache.begin` for a seq pruned under entry pressure must
+  report EVICTED (never hand out a fresh entry that would re-execute):
+  client seqs are monotone, so a pruned seq below the per-client
+  watermark can only be a retry whose reply is gone."""
+  from graphlearn_tpu.distributed.rpc import _ReplayCache
+  cache = _ReplayCache(max_entries=2)
+  for seq in range(4):
+    ent, fresh = cache.begin('tok', seq)
+    assert fresh is True
+    ent.frame = (b'h', b'x' * 8)
+    ent.done_at = time.monotonic()
+    ent.done.set()
+  # seqs 0/1 were pruned by the entry bound as 2/3 landed
+  got = cache.begin('tok', 0)
+  assert got == (None, _ReplayCache.EVICTED)
+  # live entries still replay
+  ent, fresh = cache.begin('tok', 3)
+  assert fresh is False and ent.frame is not None
+  # an UNSEEN higher seq is still fresh
+  _, fresh = cache.begin('tok', 9)
+  assert fresh is True
+
+
+def test_replay_evicted_retry_gets_typed_error_not_reexecution(server):
+  """End-to-end horizon contract: a retry whose replay entry was
+  pruned under cache pressure gets `ReplayEvictedError` — the handler
+  must NOT run a second time under the same request id (exactly-once
+  beats availability here)."""
+  from graphlearn_tpu.distributed.resilience import ReplayEvictedError
+  cli = RpcClient('127.0.0.1', server.port, policy=_fast_policy())
+  assert cli.request('bump') == 1                # seq 0, cached
+  server._replay._max_entries = 1               # cache pressure
+  assert cli.request('bump') == 2                # seq 1 evicts seq 0
+  executed = len(server.calls)
+  # a zombie retry of seq 0 (the client re-presents the same request
+  # id after its reply was pruned)
+  import itertools
+  cli._seq = itertools.count(0)
+  with pytest.raises(ReplayEvictedError, match='evicted'):
+    cli.request('bump')
+  assert len(server.calls) == executed, \
+      'the evicted request id must never re-execute'
+  cli.close()

@@ -1,0 +1,709 @@
+"""Preemption-tolerant data plane (ISSUE 6): durable mid-epoch
+snapshots, byte-identical resume, and the mesh stall watchdog.
+
+The acceptance contract under test: a chaos-killed epoch, restored
+from the latest published snapshot in a FRESH driver/loader (the
+stand-in for a new process), finishes with exact unique batch counts
+and batches/losses byte-identical to an uninterrupted seeded run; a
+hung dispatch under a ``fused.dispatch`` delay fault surfaces as a
+typed `MeshStallError` within the configured deadline instead of
+wedging the epoch; and a failed/truncated snapshot write never
+shadows the previous durable snapshot.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from graphlearn_tpu.data import Dataset
+from graphlearn_tpu.distributed.resilience import (MeshStallError,
+                                                   run_with_deadline)
+from graphlearn_tpu.loader import FusedEpoch, NeighborLoader
+from graphlearn_tpu.models import GraphSAGE, create_train_state
+from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                     make_mesh)
+from graphlearn_tpu.telemetry import recorder
+from graphlearn_tpu.testing import chaos
+from graphlearn_tpu.utils.checkpoint import (CheckpointMismatchError,
+                                             Checkpointer,
+                                             SnapshotManager,
+                                             pack_rng_state,
+                                             restore_rng_state,
+                                             validate_tree)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  chaos.uninstall()
+  recorder.enable(None)
+  recorder.clear()
+  yield
+  chaos.uninstall()
+  recorder.clear()
+  recorder.disable()
+
+
+def _tree(v=0.0):
+  return {'w': np.full((3, 2), v, np.float32),
+          'opt': {'step': np.int32(4), 'mu': np.arange(3, dtype=np.float64)}}
+
+
+# -- Checkpointer template validation (satellite 1) -------------------------
+
+def _mismatch_cases():
+  bad_struct = {'w': np.zeros((3, 2), np.float32),
+                'opt': {'step': np.int32(0)}}              # 'mu' missing
+  bad_shape = _tree()
+  bad_shape['w'] = np.zeros((2, 2), np.float32)
+  bad_dtype = _tree()
+  bad_dtype['opt']['mu'] = np.arange(3, dtype=np.float32)
+  return (('structure', bad_struct, 'structure'),
+          ('shape', bad_shape, 'shape'),
+          ('dtype', bad_dtype, 'dtype'))
+
+
+@pytest.mark.parametrize('use_orbax', [False, True],
+                         ids=['numpy', 'orbax'])
+def test_checkpointer_restore_validates_template(tmp_path, use_orbax):
+  """A stale checkpoint must raise `CheckpointMismatchError` naming
+  the first diverging path — not restore garbage silently — on BOTH
+  backends."""
+  if use_orbax:
+    pytest.importorskip('orbax.checkpoint')
+  ckpt = Checkpointer(tmp_path / 'ck', use_orbax=use_orbax)
+  ckpt.save(1, _tree(1.5))
+  out = ckpt.restore(template=_tree())           # matching: round trips
+  np.testing.assert_array_equal(out['w'], np.full((3, 2), 1.5, np.float32))
+  for name, template, msg in _mismatch_cases():
+    with pytest.raises(CheckpointMismatchError, match=msg) as ei:
+      ckpt.restore(template=template)
+    assert ei.value.path, f'{name}: the diverging path is the point'
+
+
+def test_validate_tree_names_first_diverging_path():
+  good = _tree()
+  bad = _tree()
+  bad['opt']['mu'] = np.arange(4, dtype=np.float64)
+  with pytest.raises(CheckpointMismatchError) as ei:
+    validate_tree(bad, good)
+  assert 'mu' in ei.value.path
+
+
+def test_rng_state_pack_roundtrip():
+  rng = np.random.default_rng(11)
+  packed = pack_rng_state(rng)
+  a = rng.permutation(32)
+  fresh = np.random.default_rng(0)
+  restore_rng_state(fresh, packed)
+  np.testing.assert_array_equal(fresh.permutation(32), a)
+
+
+# -- SnapshotManager + checkpoint.io chaos ----------------------------------
+
+def test_snapshot_manager_roundtrip_and_cadence(tmp_path, monkeypatch):
+  monkeypatch.setenv('GLT_SNAPSHOT_EVERY', '2')
+  snap = SnapshotManager(str(tmp_path / 's'))
+  assert snap.every == 2
+  assert [snap.due() for _ in range(5)] == [True, False, True, False,
+                                            True]
+  ok = snap.save({'cursor': np.int64(3)},
+                 {'epoch': 1, 'next_chunk': 2,
+                  'losses': np.arange(2, dtype=np.float32)},
+                 train=_tree(2.0))
+  assert ok
+  fresh = SnapshotManager(str(tmp_path / 's'))   # a new process
+  payload = fresh.restore_latest()
+  assert int(np.asarray(payload['plane']['cursor'])) == 3
+  assert int(np.asarray(payload['progress']['next_chunk'])) == 2
+  np.testing.assert_array_equal(payload['train']['w'],
+                                np.full((3, 2), 2.0, np.float32))
+  saves = recorder.events('snapshot.save')
+  restores = recorder.events('snapshot.restore')
+  assert saves and saves[0]['ok'] and saves[0]['secs'] >= 0
+  assert restores and restores[0]['epoch'] == 1
+  assert restores[0]['next_chunk'] == 2
+  assert SnapshotManager(str(tmp_path / 'empty')).restore_latest() is None
+
+
+def test_snapshot_write_faults_keep_previous_durable(tmp_path):
+  """`checkpoint.io` ``fail`` (dies before any byte) and ``truncate``
+  (partial tmp write, death before the atomic rename) are both
+  absorbed — save() returns False, the failure lands in telemetry,
+  and the PREVIOUS published snapshot stays the durable latest."""
+  snap = SnapshotManager(str(tmp_path / 's'), every=1,
+                         max_to_keep=1)
+  assert snap.save({'k': np.int64(1)}, {'epoch': 0, 'next_chunk': 1})
+  chaos.install('checkpoint.io:fail:1; checkpoint.io:truncate:2')
+  assert not snap.save({'k': np.int64(2)}, {'epoch': 0, 'next_chunk': 2})
+  assert not snap.save({'k': np.int64(3)}, {'epoch': 0, 'next_chunk': 3})
+  assert chaos.active().exhausted()
+  chaos.uninstall()
+  payload = SnapshotManager(str(tmp_path / 's')).restore_latest()
+  assert int(np.asarray(payload['plane']['k'])) == 1, \
+      'a failed write must never shadow the last good snapshot'
+  evs = recorder.events('snapshot.save')
+  assert [e['ok'] for e in evs] == [True, False, False]
+  assert all('error' in e for e in evs[1:])
+
+
+def test_restore_latest_skips_corrupt_newest(tmp_path):
+  """A newest snapshot that PUBLISHED but is unreadable (torn disk,
+  non-atomic dir rename) is skipped to the older retained step —
+  that's what ``max_to_keep > 1`` is for; only when every retained
+  snapshot is unreadable does the error propagate."""
+  snap = SnapshotManager(str(tmp_path / 's'), every=1)
+  assert snap.save({'k': np.int64(1)}, {'epoch': 0, 'next_chunk': 1})
+  assert snap.save({'k': np.int64(2)}, {'epoch': 0, 'next_chunk': 2})
+  steps = sorted((tmp_path / 's').glob('step_*'))
+  assert len(steps) == 2
+  (steps[-1] / 'leaves.npz').write_bytes(b'not a zipfile')
+  payload = SnapshotManager(str(tmp_path / 's')).restore_latest()
+  assert int(np.asarray(payload['plane']['k'])) == 1, \
+      'corrupt newest must fall back to the older good snapshot'
+  evs = recorder.events('snapshot.restore')
+  assert any(e.get('ok') is False and 'error' in e for e in evs)
+  (steps[0] / 'leaves.npz').write_bytes(b'also broken')
+  with pytest.raises(Exception):
+    SnapshotManager(str(tmp_path / 's')).restore_latest()
+
+
+# -- single-chip fused kill-resume acceptance -------------------------------
+
+def _cluster_dataset(n=90, d=8, classes=3, seed=0, split_ratio=1.0):
+  rng = np.random.default_rng(seed)
+  labels = (np.arange(n) % classes).astype(np.int32)
+  rows, cols = [], []
+  for v in range(n):
+    for _ in range(6):
+      u = (rng.choice(np.nonzero(labels == labels[v])[0])
+           if rng.random() < 0.85 else rng.integers(0, n))
+      rows.append(v)
+      cols.append(int(u))
+  feats = np.eye(classes, d, dtype=np.float32)[labels]
+  feats += rng.normal(0, 0.3, feats.shape).astype(np.float32)
+  return (Dataset()
+          .init_graph((np.array(rows), np.array(cols)), layout='COO',
+                      num_nodes=n)
+          .init_node_features(feats, split_ratio=split_ratio)
+          .init_node_labels(labels))
+
+
+def _setup(ds, batch_size=32, seed=0):
+  model = GraphSAGE(hidden_features=16, out_features=3, num_layers=2)
+  tx = optax.adam(1e-2)
+  loader = NeighborLoader(ds, [4, 3], np.arange(90),
+                          batch_size=batch_size)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(seed), next(iter(loader)), tx)
+  return state, apply_fn, tx
+
+
+def _copy(state):
+  return jax.tree_util.tree_map(jnp.copy, state)
+
+
+def _params_equal(a, b):
+  for la, lb in zip(jax.tree_util.tree_leaves(a.params),
+                    jax.tree_util.tree_leaves(b.params)):
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _fused(ds, apply_fn, tx, **kw):
+  kw.setdefault('batch_size', 32)
+  kw.setdefault('shuffle', True)
+  kw.setdefault('seed', 5)
+  kw.setdefault('max_steps_per_program', 1)
+  return FusedEpoch(ds, [4, 3], np.arange(90), apply_fn, tx, **kw)
+
+
+@pytest.mark.parametrize('split_ratio', [1.0, 0.5],
+                         ids=['resident', 'tiered'])
+def test_fused_epoch_kill_resume_byte_identical(tmp_path, monkeypatch,
+                                                split_ratio):
+  """THE acceptance loop, single-chip: chunked epoch, planned
+  preemption at the third chunk, restore in a fresh driver, finish —
+  losses, stats and final params byte-identical to an uninterrupted
+  seeded twin.  The tiered variant carries the cold-cache rings
+  through the snapshot as well."""
+  if split_ratio < 1.0:
+    monkeypatch.setenv('GLT_FUSED_COLD_CHUNK', '1')
+  ds = _cluster_dataset(split_ratio=split_ratio)
+  state, apply_fn, tx = _setup(ds)
+
+  ref = _fused(ds, apply_fn, tx)
+  ref_state, ref_stats = ref.run(_copy(state))
+  # host copies BEFORE epoch 2 donates the state buffers
+  ref_params1 = jax.tree_util.tree_map(np.asarray, ref_state.params)
+  ref_state2, ref_stats2 = ref.run(ref_state)    # epoch 2 reference
+
+  snap_dir = str(tmp_path / 'plane')
+  fused = _fused(ds, apply_fn, tx)
+  assert fused.attach_snapshots(SnapshotManager(snap_dir, every=1))
+  chaos.install('fused.dispatch:kill:3')         # 3rd chunk arrival
+  with pytest.raises(chaos.ChaosKilledError):
+    fused.run(_copy(state))
+  chaos.uninstall()
+  assert recorder.events('snapshot.save'), 'chunk boundaries must save'
+
+  # fresh process stand-in: same constructor args, restore, finish
+  resumed = _fused(ds, apply_fn, tx)
+  resumed.attach_snapshots(SnapshotManager(snap_dir))
+  got = resumed.restore_from_snapshot(state)
+  assert got is not None
+  assert recorder.events('snapshot.restore')
+  state_r, stats_r = resumed.run(got)
+
+  assert stats_r['seeds'] == 90                  # exact unique count
+  np.testing.assert_array_equal(np.asarray(stats_r['losses']),
+                                np.asarray(ref_stats['losses']))
+  assert stats_r['correct'] == ref_stats['correct']
+  for la, lb in zip(jax.tree_util.tree_leaves(ref_params1),
+                    jax.tree_util.tree_leaves(state_r.params)):
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+  # the NEXT epoch continues exactly where an uninterrupted run would
+  state_r2, stats_r2 = resumed.run(state_r)
+  np.testing.assert_array_equal(np.asarray(stats_r2['losses']),
+                                np.asarray(ref_stats2['losses']))
+  _params_equal(ref_state2, state_r2)
+
+
+def test_fused_epoch_restore_rejects_stale_train_state(tmp_path):
+  """A snapshot whose TrainState no longer matches the caller's
+  template (a changed model) must raise `CheckpointMismatchError`,
+  not restore garbage."""
+  ds = _cluster_dataset()
+  state, apply_fn, tx = _setup(ds)
+  fused = _fused(ds, apply_fn, tx)
+  fused.attach_snapshots(SnapshotManager(str(tmp_path / 'p'), every=1))
+  fused.run(_copy(state))
+  other = GraphSAGE(hidden_features=24, out_features=3, num_layers=2)
+  loader = NeighborLoader(ds, [4, 3], np.arange(90), batch_size=32)
+  other_state, other_apply = create_train_state(
+      other, jax.random.key(0), next(iter(loader)), optax.adam(1e-2))
+  fresh = _fused(ds, other_apply, optax.adam(1e-2))
+  fresh.attach_snapshots(SnapshotManager(str(tmp_path / 'p')))
+  with pytest.raises(CheckpointMismatchError):
+    fresh.restore_from_snapshot(other_state)
+
+
+def test_fused_epoch_resume_rejects_changed_chunk_size(tmp_path,
+                                                       monkeypatch):
+  """Resuming under a different chunk size would mis-stitch the key
+  schedule — the typed mismatch error must name the knob."""
+  ds = _cluster_dataset()
+  state, apply_fn, tx = _setup(ds)
+  fused = _fused(ds, apply_fn, tx)
+  fused.attach_snapshots(SnapshotManager(str(tmp_path / 'p'), every=1))
+  chaos.install('fused.dispatch:kill:3')
+  with pytest.raises(chaos.ChaosKilledError):
+    fused.run(_copy(state))
+  chaos.uninstall()
+  resumed = _fused(ds, apply_fn, tx, max_steps_per_program=2)
+  resumed.attach_snapshots(SnapshotManager(str(tmp_path / 'p')))
+  resumed.restore_from_snapshot(state)
+  with pytest.raises(CheckpointMismatchError, match='chunk'):
+    resumed.run(_copy(state))
+
+
+# -- mesh loader kill-resume acceptance -------------------------------------
+
+MESH_N = 64
+MESH_P = 4
+
+
+def _mesh_dataset(split_ratio=0.3):
+  rows = np.concatenate([np.arange(MESH_N), np.arange(MESH_N)])
+  cols = np.concatenate([(np.arange(MESH_N) + 1) % MESH_N,
+                         (np.arange(MESH_N) + 2) % MESH_N])
+  feats = (np.arange(MESH_N, dtype=np.float32)[:, None]
+           * np.ones((1, 4), np.float32))        # feat[v] == v
+  labels = (np.arange(MESH_N) % 5).astype(np.int32)
+  node_pb = (np.arange(MESH_N) % MESH_P).astype(np.int32)
+  return DistDataset.from_full_graph(
+      MESH_P, rows, cols, node_feat=feats, node_label=labels,
+      num_nodes=MESH_N, node_pb=node_pb, split_ratio=split_ratio)
+
+
+def _mesh_loader(ds, mesh, seed=9, **kw):
+  return DistNeighborLoader(ds, [2, 2], np.arange(MESH_N),
+                            batch_size=4, shuffle=True, mesh=mesh,
+                            seed=seed, **kw)
+
+
+def _batch_bytes(b):
+  return (np.asarray(b.node).tobytes(), np.asarray(b.x).tobytes(),
+          np.asarray(b.y).tobytes(),
+          np.asarray(b.edge_index).tobytes())
+
+
+def test_mesh_loader_kill_resume_byte_identical(tmp_path):
+  """THE acceptance loop, mesh loader variant: consume part of a
+  tiered epoch (cold cache + dispatch-ahead overlay live), snapshot
+  through the DURABLE store, lose the process (a fresh loader), and
+  finish — the union of pre-kill and resumed batches is byte-identical
+  to an uninterrupted seeded twin, and the following epoch continues
+  exactly where the uninterrupted run would."""
+  ds = _mesh_dataset()
+  mesh = make_mesh(MESH_P)
+  ref = _mesh_loader(ds, mesh)
+  epoch1 = [_batch_bytes(b) for b in ref]
+  epoch2 = [_batch_bytes(b) for b in ref]
+  assert len(epoch1) >= 3
+
+  loader = _mesh_loader(ds, mesh)
+  it = iter(loader)
+  got = [_batch_bytes(next(it)) for _ in range(2)]
+  snap = SnapshotManager(str(tmp_path / 'plane'), every=1)
+  assert snap.save(loader.state_dict(),
+                   {'epoch': 0, 'next_chunk': loader._consumed})
+  # the kill: this loader is never touched again
+  payload = SnapshotManager(str(tmp_path / 'plane')).restore_latest()
+
+  resumed = _mesh_loader(ds, mesh)
+  resumed.load_state_dict(payload['plane'])
+  rest = [_batch_bytes(b) for b in resumed.resume_epoch()]
+  assert len(got) + len(rest) == len(epoch1), 'exact batch count'
+  assert got + rest == epoch1, 'batches must be byte-identical'
+  # next epoch: same stream as the uninterrupted twin's epoch 2
+  assert [_batch_bytes(b) for b in resumed] == epoch2
+
+
+def test_mesh_loader_cold_service_fault_then_resume(tmp_path):
+  """`feature.cold_service` fail mid-epoch: the host cold tier dies,
+  the epoch surfaces `InjectedFault` — and the snapshot taken at the
+  last delivered batch turns it into a finished, byte-identical epoch
+  in a fresh loader."""
+  ds = _mesh_dataset()
+  mesh = make_mesh(MESH_P)
+  ref = _mesh_loader(ds, mesh)
+  epoch1 = [_batch_bytes(b) for b in ref]
+
+  loader = _mesh_loader(ds, mesh)
+  snap = SnapshotManager(str(tmp_path / 'plane'), every=1)
+  it = iter(loader)
+  got = []
+  # the cold service dies on the arrival after the second batch
+  chaos.install('feature.cold_service:fail:3:op=dist')
+  with pytest.raises(chaos.InjectedFault):
+    while True:
+      b = next(it)
+      got.append(_batch_bytes(b))
+      snap.save(loader.state_dict(), {'epoch': 0,
+                                      'next_chunk': loader._consumed})
+  chaos.uninstall()
+  assert got, 'some batches must land before the fault'
+  assert recorder.events('fault.injected')
+
+  payload = SnapshotManager(str(tmp_path / 'plane')).restore_latest()
+  resumed = _mesh_loader(ds, mesh)
+  resumed.load_state_dict(payload['plane'])
+  rest = [_batch_bytes(b) for b in resumed.resume_epoch()]
+  assert got + rest == epoch1
+
+
+def test_mesh_loader_snapshot_refuses_prefetch(tmp_path):
+  ds = _mesh_dataset()
+  loader = _mesh_loader(ds, make_mesh(MESH_P), prefetch=2)
+  it = iter(loader)
+  next(it)
+  with pytest.raises(ValueError, match='prefetch'):
+    loader.state_dict()
+  loader.close()
+
+
+def test_adaptive_slack_ladder_state_roundtrip():
+  """The AdaptiveSlack rung/pin survive a snapshot: a fresh loader
+  restored from state resumes at the tuned rung instead of silently
+  resetting to the 2.0 default (ISSUE 6 tentpole: resumable is a
+  property of EVERY stateful component)."""
+  ds = _mesh_dataset(split_ratio=1.0)
+  mesh = make_mesh(MESH_P)
+  loader = _mesh_loader(ds, mesh, exchange_slack='adaptive')
+  ctl = loader._adaptive
+  assert ctl is not None
+  for b in loader:                 # epoch 1 telemetry
+    pass
+  for b in loader:                 # iter() retunes: drop-free tightens
+    break
+  loader.close()
+  assert not ctl._pinned
+  tuned = ctl._idx
+  assert ctl.sampler.exchange_slack == ctl.slack
+
+  state = loader.state_dict()
+  resumed = _mesh_loader(ds, mesh, exchange_slack='adaptive')
+  assert resumed._adaptive._idx != tuned or tuned == 4
+  resumed.load_state_dict(state)
+  assert resumed._adaptive._idx == tuned
+  assert resumed.sampler.exchange_slack == ctl.slack
+  assert resumed._adaptive._pinned == ctl._pinned
+
+
+# -- the mesh stall watchdog ------------------------------------------------
+
+def test_run_with_deadline_passthrough_and_errors():
+  assert run_with_deadline(lambda x: x + 1, 41, deadline=0) == 42
+  assert run_with_deadline(lambda: 'ok', deadline=5.0) == 'ok'
+  with pytest.raises(ZeroDivisionError):
+    run_with_deadline(lambda: 1 / 0, deadline=5.0)
+
+
+def test_run_with_deadline_converts_hang_to_mesh_stall():
+  t0 = time.monotonic()
+  with pytest.raises(MeshStallError) as ei:
+    run_with_deadline(time.sleep, 30.0, deadline=0.3,
+                      scope='fused.dispatch')
+  assert time.monotonic() - t0 < 5.0, 'must not wait out the hang'
+  assert ei.value.deadline == 0.3
+  assert ei.value.scope == 'fused.dispatch'
+  assert ei.value.healthy == [0], 'single-process: trivially healthy'
+  evs = recorder.events('mesh.stall')
+  assert evs and evs[0]['deadline_secs'] == 0.3
+
+
+def test_dispatch_delay_fault_raises_stall_within_deadline(monkeypatch):
+  """The acceptance wording verbatim: a hung dispatch under a
+  ``fused.dispatch`` delay fault raises `MeshStallError` within the
+  configured ``GLT_DISPATCH_DEADLINE`` instead of hanging the epoch."""
+  monkeypatch.setenv('GLT_DISPATCH_DEADLINE', '0.3')
+  chaos.install('fused.dispatch:delay:1:secs=30')
+
+  def dispatch():
+    chaos.fused_dispatch_check(chunk=0, epoch=1)
+    return 'finished'
+
+  t0 = time.monotonic()
+  with pytest.raises(MeshStallError):
+    run_with_deadline(dispatch, scope='fused.dispatch')
+  assert time.monotonic() - t0 < 5.0
+  chaos.uninstall()
+  monkeypatch.delenv('GLT_DISPATCH_DEADLINE')
+  assert run_with_deadline(dispatch, scope='fused.dispatch') == \
+      'finished', 'no deadline: direct call, zero overhead'
+
+
+def test_cold_service_fault_single_chip():
+  ds = _cluster_dataset(split_ratio=0.5)
+  feat = ds.node_features
+  chaos.install('feature.cold_service:fail:1:op=feature')
+  with pytest.raises(chaos.InjectedFault):
+    feat[np.arange(60)]
+  chaos.uninstall()
+  out = np.asarray(feat[np.arange(60)])          # service healthy again
+  assert out.shape[0] == 60
+
+
+# -- report CLI resilience counters (satellite 4) ---------------------------
+
+def test_report_resilience_table():
+  from graphlearn_tpu.telemetry.report import (format_resilience_table,
+                                               resilience_counts)
+  events = [
+      {'kind': 'rpc.retry', 'op': 'fetch'},
+      {'kind': 'rpc.retry', 'op': 'fetch'},
+      {'kind': 'fault.injected', 'site': 'fused.dispatch'},
+      {'kind': 'snapshot.save', 'ok': True},
+      {'kind': 'snapshot.save', 'ok': False},
+      {'kind': 'snapshot.restore', 'dir': '/tmp/s'},
+      {'kind': 'mesh.stall', 'scope': 'fused.dispatch'},
+      {'kind': 'span.begin', 'name': 'batch'},   # not a resilience kind
+  ]
+  rows = {r[0]: (r[1], r[2]) for r in resilience_counts(events)}
+  assert rows['rpc.retry'] == ('2', 'fetch=2')
+  assert rows['snapshot.save'][0] == '2'
+  assert 'False=1' in rows['snapshot.save'][1]
+  assert rows['mesh.stall'] == ('1', 'fused.dispatch=1')
+  assert 'span.begin' not in rows
+  table = format_resilience_table(events)
+  assert 'snapshot.restore' in table and 'count' in table
+  assert format_resilience_table([]) == ''
+
+
+# -- host runtime (mp producers): DistLoader snapshot/resume ----------------
+
+HOST_N = 48
+HOST_BATCH = 8
+
+
+def _host_ring(n=HOST_N, d=4):
+  from graphlearn_tpu.distributed import HostDataset
+  rows = np.repeat(np.arange(n), 2)
+  cols = np.stack([(np.arange(n) + 1) % n,
+                   (np.arange(n) + 2) % n], 1).reshape(-1)
+  feats = np.tile(np.arange(n, dtype=np.float32)[:, None], (1, d))
+  return HostDataset.from_coo(rows, cols, n, node_features=feats,
+                              node_labels=np.arange(n) % 4)
+
+
+def _host_mp_loader(seed=3):
+  from graphlearn_tpu.distributed import (DistNeighborLoader,
+                                          MpDistSamplingWorkerOptions)
+  return DistNeighborLoader(
+      _host_ring(), [2], np.arange(HOST_N), batch_size=HOST_BATCH,
+      shuffle=True, worker_options=MpDistSamplingWorkerOptions(
+          num_workers=2, mp_start_method='spawn'),
+      to_device=False, seed=seed)
+
+
+def _host_key(b):
+  s = np.asarray(b.batch)
+  return (tuple(np.sort(s[s >= 0]).tolist()),
+          np.asarray(b.node).tobytes(), np.asarray(b.x).tobytes())
+
+
+@pytest.mark.skipif(
+    not __import__('graphlearn_tpu').native.available(),
+    reason='native lib unavailable')
+def test_host_mp_loader_snapshot_resume_exact(tmp_path):
+  """Host mp mode: producer (epoch, seq) positions + delivered-seq set
+  snapshot and resume — the resumed epoch re-produces the SAME epoch,
+  discards the already-delivered prefix, and yields exactly the
+  remaining batches, byte-identical (batch content is a function of
+  (epoch, seq))."""
+  n_batches = HOST_N // HOST_BATCH
+  ref = _host_mp_loader()
+  try:
+    clean = sorted(_host_key(b) for b in ref)
+    clean2 = sorted(_host_key(b) for b in ref)   # epoch 2 reference
+  finally:
+    ref.shutdown()
+
+  loader = _host_mp_loader()
+  try:
+    it = iter(loader)
+    got = [_host_key(next(it)) for _ in range(2)]
+    snap = SnapshotManager(str(tmp_path / 'plane'), every=1)
+    assert snap.save(loader.state_dict(), {'epoch': 0, 'next_chunk': 2})
+  finally:
+    loader.shutdown()              # the preemption
+
+  payload = SnapshotManager(str(tmp_path / 'plane')).restore_latest()
+  resumed = _host_mp_loader()
+  try:
+    resumed.load_state_dict(payload['plane'])
+    rest = [_host_key(b) for b in resumed.resume_epoch()]
+    assert len(got) + len(rest) == n_batches
+    assert sorted(got + rest) == clean, \
+        'resumed epoch must be byte-identical to the clean epoch'
+    assert resumed.replayed_discarded >= len(got), \
+        're-produced prefix must be discarded, not re-delivered'
+    # the NEXT epoch advances the shuffle stream exactly as the
+    # uninterrupted twin's second epoch
+    nxt = sorted(_host_key(b) for b in resumed)
+    assert nxt == clean2
+  finally:
+    resumed.shutdown()
+
+
+# -- mesh fused drivers: stall watchdog + degraded rollback (slow) ----------
+
+FN = 256
+FCLASSES = 4
+
+
+def _fused_mesh_dataset(split_ratio=0.3):
+  rng = np.random.default_rng(0)
+  labels = (np.arange(FN) % FCLASSES).astype(np.int32)
+  rows, cols = [], []
+  for v in range(FN):
+    for _ in range(5):
+      u = (int(rng.choice(np.nonzero(labels == labels[v])[0]))
+           if rng.random() < 0.8 else int(rng.integers(0, FN)))
+      rows.append(v)
+      cols.append(u)
+  feats = np.eye(FCLASSES, 8, dtype=np.float32)[labels]
+  feats += rng.normal(0, 0.3, feats.shape).astype(np.float32)
+  return DistDataset.from_full_graph(
+      MESH_P, np.asarray(rows), np.asarray(cols), node_feat=feats,
+      node_label=labels, num_nodes=FN, split_ratio=split_ratio)
+
+
+def _copy2(host_tree):
+  return jax.tree_util.tree_map(np.copy, host_tree)
+
+
+def _fused_mesh_state(tx, bs=16):
+  rng = np.random.default_rng(0)
+  ds = (Dataset()
+        .init_graph((np.arange(32), (np.arange(32) + 1) % 32),
+                    layout='COO', num_nodes=32)
+        .init_node_features(rng.random((32, 8)).astype(np.float32))
+        .init_node_labels((np.arange(32) % FCLASSES).astype(np.int32)))
+  loader = NeighborLoader(ds, [3, 2], np.arange(32), batch_size=bs)
+  model = GraphSAGE(hidden_features=16, out_features=FCLASSES,
+                    num_layers=2)
+  return create_train_state(model, jax.random.key(0),
+                            next(iter(loader)), tx)
+
+
+@pytest.mark.slow
+def test_mesh_tiered_stall_watchdog_and_degraded_resume(tmp_path,
+                                                        monkeypatch):
+  """The mesh acceptance loop: a tiered fused epoch whose chunk
+  dispatch hangs under a ``fused.dispatch`` delay fault (1) raises
+  `MeshStallError` within ``GLT_DISPATCH_DEADLINE`` instead of
+  wedging, and (2) with ``GLT_DEGRADED_OK=1``, rolls back to the last
+  chunk-boundary snapshot and finishes the epoch byte-identically to
+  an unfaulted seeded twin."""
+  from graphlearn_tpu.parallel import FusedDistEpoch, replicate
+  monkeypatch.setenv('GLT_FUSED_COLD_CHUNK', '1')
+  ds = _fused_mesh_dataset()
+  mesh = make_mesh(MESH_P)
+  tx = optax.adam(1e-2)
+  state, apply_fn = _fused_mesh_state(tx)
+  # replicate() may ALIAS the source buffer for the same-device shard,
+  # and the epoch donates it — replicate each run from host copies so
+  # one run's donation cannot delete another's input
+  host_state = jax.tree_util.tree_map(np.asarray, state)
+
+  def make():
+    return FusedDistEpoch(ds, [3, 2], np.arange(FN), apply_fn, tx,
+                          batch_size=16, mesh=mesh, shuffle=True,
+                          seed=0)
+
+  ref = make()
+  sref, ref1 = ref.run(replicate(_copy2(host_state), mesh))
+  sref, ref2 = ref.run(sref)
+  ref1_losses = np.asarray(ref1.losses)
+  ref2_losses = np.asarray(ref2.losses)
+  ref2_params = jax.tree_util.tree_map(np.asarray, sref.params)
+
+  # arm 1: epoch 1 fault-free (warms this driver's compiles), then a
+  # hung chunk-0 collect in epoch 2 -> typed MeshStallError, fast
+  snap_dir = str(tmp_path / 'plane')
+  fused = make()
+  fused.attach_snapshots(SnapshotManager(snap_dir, every=1))
+  s, st1 = fused.run(replicate(_copy2(host_state), mesh))
+  np.testing.assert_array_equal(np.asarray(st1.losses), ref1_losses)
+  monkeypatch.setenv('GLT_DISPATCH_DEADLINE', '10')
+  monkeypatch.delenv('GLT_DEGRADED_OK', raising=False)
+  chaos.install('fused.dispatch:delay:1:secs=90:op=collect')
+  t0 = time.monotonic()
+  with pytest.raises(MeshStallError) as ei:
+    fused.run(s)
+  assert time.monotonic() - t0 < 60, 'must not wait out the hang'
+  assert ei.value.healthy == [0]
+  assert recorder.events('mesh.stall')
+  chaos.uninstall()
+
+  # arm 2: fresh driver (fresh process stand-in), restore the epoch-2
+  # snapshot, degraded mode on; the chunk-1 collect hangs once -> the
+  # driver rolls back to its own chunk-boundary snapshot and finishes
+  monkeypatch.setenv('GLT_DEGRADED_OK', '1')
+  fused2 = make()
+  fused2.run(replicate(_copy2(host_state), mesh))   # warm compiles only
+  fused2.attach_snapshots(SnapshotManager(snap_dir))
+  restored = fused2.restore_from_snapshot(host_state)
+  assert restored is not None
+  chaos.install('fused.dispatch:delay:2:secs=90:op=collect')
+  s2, st2 = fused2.run(restored)
+  assert chaos.active().exhausted(), 'the planned stall must fire'
+  chaos.uninstall()
+  np.testing.assert_array_equal(np.asarray(st2.losses), ref2_losses)
+  for la, lb in zip(jax.tree_util.tree_leaves(ref2_params),
+                    jax.tree_util.tree_leaves(s2.params)):
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

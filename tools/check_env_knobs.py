@@ -1,0 +1,82 @@
+"""Env-knob documentation drift check (ISSUE 6 satellite).
+
+PR 4 and PR 5 each added ``GLT_*`` knobs that drifted from the
+``benchmarks/README.md`` knob tables — an undocumented knob is a
+feature only its author can use.  This tool AST-scans the package (and
+the bench drivers) for every ``GLT_*`` string constant — the knob
+vocabulary: env reads go through ``os.environ.get('GLT_X')``,
+``os.environ['GLT_X']`` or a ``FOO_ENV = 'GLT_X'`` constant, and all
+of them surface as a string literal — and fails if any knob is
+missing from the README.
+
+Wired into the test suite like ``tests/test_event_schema.py``
+(``tests/test_env_knobs.py``), and runnable standalone::
+
+    python tools/check_env_knobs.py          # exit 1 on drift
+"""
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+#: scanned roots: the package plus the bench drivers (their knobs are
+#: user-facing too)
+SCAN_ROOTS = ('graphlearn_tpu', 'benchmarks', 'bench.py')
+README = REPO / 'benchmarks' / 'README.md'
+
+_KNOB_RE = re.compile(r'^GLT_[A-Z0-9_]+$')
+
+
+def knob_references() -> dict:
+  """``{knob: [relative file, ...]}`` for every GLT_* string constant
+  in the scanned roots."""
+  out: dict = {}
+  files = []
+  for root in SCAN_ROOTS:
+    p = REPO / root
+    if p.is_file():
+      files.append(p)
+    elif p.is_dir():
+      files.extend(sorted(p.rglob('*.py')))
+  for py in files:
+    try:
+      tree = ast.parse(py.read_text())
+    except SyntaxError:             # pragma: no cover — broken file
+      continue
+    for node in ast.walk(tree):
+      if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+          and _KNOB_RE.match(node.value)):
+        out.setdefault(node.value, []).append(
+            str(py.relative_to(REPO)))
+  return out
+
+
+def documented_knobs(readme_path: Path = README) -> set:
+  return set(re.findall(r'GLT_[A-Z0-9_]+', readme_path.read_text()))
+
+
+def undocumented(readme_path: Path = README) -> dict:
+  """Knobs referenced in code but absent from the README's tables."""
+  doc = documented_knobs(readme_path)
+  return {k: sorted(set(files)) for k, files in knob_references().items()
+          if k not in doc}
+
+
+def main() -> int:
+  missing = undocumented()
+  if not missing:
+    print(f'env knobs: OK ({len(knob_references())} GLT_* knobs, all '
+          f'documented in {README.relative_to(REPO)})')
+    return 0
+  print('env knobs: DRIFT — knobs read in code but missing from '
+        f'{README.relative_to(REPO)}:')
+  for k, files in sorted(missing.items()):
+    print(f'  {k}  ({", ".join(files)})')
+  return 1
+
+
+if __name__ == '__main__':
+  sys.exit(main())
