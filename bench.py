@@ -1035,6 +1035,42 @@ def _run_resume_row(timeout: int):
   return None
 
 
+def _run_serving_row(timeout: int):
+  """The `bench_serving.py` online-serving phase (ISSUE 9) in a
+  subprocess: Zipf open-loop traffic against the coalescing tier on a
+  single CPU device — p50/p95/p99 + sustained QPS + shed rate feed
+  the dist.serving.p99_ms / dist.serving.qps regression guards, and
+  the worker exits nonzero if any shape recompiled after warmup.
+  Returns its last JSON row (None on failure/timeout)."""
+  script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'benchmarks', 'bench_serving.py')
+  cmd = [sys.executable, script, '--cpu']
+  env = dict(os.environ)
+  env.setdefault('JAX_PLATFORMS', 'cpu')
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                         timeout=timeout)
+  except subprocess.TimeoutExpired:
+    return None
+  for ln in reversed((out.stdout or '').strip().splitlines()):
+    if ln.startswith('{'):
+      try:
+        r = json.loads(ln)
+      except json.JSONDecodeError:
+        continue
+      # the worker exits nonzero when ANY phase recompiled after
+      # warmup — stamp the verdict into the artifact row so the pin
+      # is visible there, not only in a discarded exit code
+      r['recompile_pin'] = ('ok' if out.returncode == 0
+                            else 'FAILED')
+      if out.returncode != 0:
+        print('serving phase: recompiles after warmup — a shape '
+              'escaped the bucket ladder (see dist.serving rows)',
+              file=sys.stderr)
+      return r
+  return None
+
+
 def _aggregate(results, fused_res, dist, hetero=None):
   """The full artifact schema from whatever phases have completed so
   far.  The HEADLINE `value` is the fused whole-epoch time when the
@@ -1380,6 +1416,20 @@ def main():
     if r is not None:
       dist['resume'] = r
       emit()
+
+  # phase 3f — online serving (ISSUE 9): Zipf open-loop traffic
+  # against the coalescing tier; feeds dist.serving.p99_ms /
+  # dist.serving.qps (+ shed_rate reported) and pins zero recompiles
+  # after warmup
+  if isinstance(dist, dict) and 'error' not in dist and \
+      budget_left() > 120:
+    r = _run_serving_row(int(min(300, max(budget_left() - 30, 90))))
+    if r is not None:
+      dist['serving'] = r
+      emit()
+  elif isinstance(dist, dict) and 'error' not in dist:
+    print(f'budget: skipping serving phase ({budget_left():.0f}s left)',
+          file=sys.stderr)
 
   # phase 4 — extra primary sessions stabilize the per-batch median
   while (len(results) < sessions and attempts < sessions + 3

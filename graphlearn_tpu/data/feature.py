@@ -204,6 +204,10 @@ class Feature:
 
   # -- lookup -------------------------------------------------------------
   def __getitem__(self, ids) -> jax.Array:
+    """Gather rows by global id onto the device (see :meth:`get`)."""
+    return self.get(ids)
+
+  def get(self, ids, scope: str = 'feature') -> jax.Array:
     """Gather rows by global id onto the device.
 
     Counterpart of reference `Feature.__getitem__`
@@ -214,6 +218,13 @@ class Feature:
     Device-resident ids with a fully-HBM table take an all-device
     path: the reference's ids are already on-GPU likewise; a host
     round-trip here would serialize every batch on transfer latency.
+
+    ``scope`` tags this lookup's cold-cache telemetry
+    (``cache.hit``/``cache.miss``/... events): the epoch loaders use
+    the default ``'feature'``; the online serving plane's per-request
+    tiered path passes ``'serving'`` so a dashboard can split
+    training-epoch from inference-traffic cache behavior out of one
+    event stream.  Values are scope-independent (byte-identical).
     """
     self.lazy_init()
     if (isinstance(ids, jax.Array)
@@ -295,7 +306,7 @@ class Feature:
       x = cache.serve_hits(x, hit, slot)
       admits, evicts = cache.admit(x, idx, miss_sel)
       from .cold_cache import emit_cache_events
-      emit_cache_events('feature', int(hit.sum()), n_miss, admits,
+      emit_cache_events(scope, int(hit.sum()), n_miss, admits,
                         evicts)
     return x
 

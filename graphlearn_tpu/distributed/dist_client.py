@@ -170,6 +170,34 @@ class DistClient:
                             peer=server_idx) from e
       raise
 
+  def serve(self, seeds, server_idx: Optional[int] = None,
+            deadline_ms: Optional[float] = None) -> dict:
+    """One online inference request against a server's serving tier
+    (ISSUE 9): ``seeds`` (a few node ids) -> ``{'nodes': [k, W], 'x':
+    [k, W, D] | 'logits': [k, C]}`` numpy arrays, byte-identical to
+    the per-seed offline reference whatever the request was coalesced
+    with.  Rides the full PR 4 resilience ladder via
+    `request_server`: transport faults retry under the same request
+    id (the server's replay cache keeps the retry exactly-once), a
+    dead peer surfaces as `PeerLostError` — and a server-side
+    admission refusal resurfaces TYPED as
+    `serving.admission.AdmissionRejected` (wire error-kind field,
+    never message-text sniffing), so callers can tell overload (back
+    off / reroute) from failure.  Default server = ``rank %
+    num_servers``, the producer round-robin convention."""
+    from ..serving.admission import AdmissionRejected
+    if server_idx is None:
+      server_idx = self.rank % self.num_servers
+    seeds = np.asarray(seeds, np.int64).reshape(-1)
+    try:
+      return self.request_server(server_idx, 'serve_infer', seeds,
+                                 deadline_ms=deadline_ms)
+    except RpcError as e:
+      if getattr(e, 'remote_kind', None) == 'AdmissionRejected':
+        raise AdmissionRejected(
+            f'server {server_idx} shed the request: {e}') from e
+      raise
+
   def heartbeat(self, server_idx: int, timeout: float = 2.0):
     """One-shot health snapshot from a server (fresh connection, no
     retries); ``None`` when the peer is unreachable."""

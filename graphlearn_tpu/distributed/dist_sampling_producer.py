@@ -126,8 +126,13 @@ def _sampling_worker_loop(rank, dataset, fanouts, with_edge,
     for i, lo in enumerate(range(0, len(seeds), batch_size)):
       # fault-plan seam: a planned 'kill' hard-exits here, between
       # batches — the supervisor must restart us and replay what we
-      # never delivered (the chaos suite's central scenario)
-      chaos.worker_kill_check(rank, epoch, generation)
+      # never delivered (the chaos suite's central scenario).  The
+      # progress queue rides `flush` so acks for batches the channel
+      # already holds survive the exit (see `worker_kill_check`).
+      chaos.worker_kill_check(
+          rank, epoch, generation,
+          flush=(progress_queue,) if progress_queue is not None
+          else ())
       # the producer-side span covers sample + send; the channel
       # injects its context into the message at send time, so the
       # consumer's collate span can link back to THIS trace (the

@@ -38,6 +38,7 @@ class DistServer:
     self.rank = 0                   # set by init_server
     self.num_clients = 1            # set by init_server
     self._left_clients: set = set()
+    self._serving = None            # ServingFrontend (attach_serving)
 
   # -- handlers ------------------------------------------------------------
   def get_dataset_meta(self):
@@ -152,17 +153,62 @@ class DistServer:
     if channel is not None:
       channel.close()
 
+  # -- serving plane (ISSUE 9) ---------------------------------------------
+  def attach_serving(self, frontend) -> None:
+    """Attach a `serving.ServingFrontend`: `serve_infer` starts
+    answering, and `heartbeat` grows the serving block (queue depth,
+    in-flight batch, per-bucket compile status) — the overloaded-vs-
+    dead discriminator for serving clients."""
+    self._serving = frontend
+
+  def serve_infer(self, seeds, deadline_ms=None):
+    """One online inference request (RPC handler).  Exactly-once:
+    this handler runs under the replay cache like every RPC, so a
+    retried request replays the cached reply instead of re-executing
+    (and the engine's per-seed determinism makes even a hypothetical
+    re-execution byte-identical).  `AdmissionRejected` travels back
+    typed via the wire's structured error-kind field —
+    `DistClient.serve` resurfaces it as the same class."""
+    from ..testing import chaos
+    chaos.serving_request_check('serve_infer')
+    serving = self._serving
+    if serving is None:
+      from .rpc import RpcError
+      raise RpcError(f'server {self.rank} has no serving tier '
+                     'attached (attach_serving was never called)')
+    fut = serving.submit(np.asarray(seeds), deadline_ms)
+    # wait on the REQUEST's deadline (+ execution grace), not the
+    # tier default: a caller that paid for a long deadline must not be
+    # timed out at the default by its own server (the in-process
+    # `ServingFrontend.infer` uses the same arithmetic)
+    dl = (float(deadline_ms) if deadline_ms is not None
+          else serving.admission.default_deadline_ms)
+    res = fut.result(dl / 1e3 + 30.0)
+    out = {'nodes': np.asarray(res.nodes)}
+    if res.x is not None:
+      out['x'] = np.asarray(res.x)
+    if res.logits is not None:
+      out['logits'] = np.asarray(res.logits)
+    return out
+
   def heartbeat(self) -> dict:
     """Liveness + health snapshot (the slow-peer / dead-peer
     discriminator `DistClient.heartbeat` keys off): which producers
-    exist and how many of their workers are alive."""
+    exist and how many of their workers are alive; with a serving
+    tier attached, also its queue depth / in-flight batch count /
+    per-bucket compile status, so a serving client can tell an
+    OVERLOADED peer (deep queue, warm buckets) from a dead or
+    still-compiling one."""
     with self._lock:
       producers = {pid: {'alive_workers': p.alive_workers(),
                          'dead_exitcodes': p.dead_worker_exitcodes(),
                          'restarts': p._restarts}
                    for pid, p in self._producers.items()}
-    return {'rank': self.rank, 'time': time.time(),
-            'producers': producers}
+    out = {'rank': self.rank, 'time': time.time(),
+           'producers': producers}
+    if self._serving is not None:
+      out['serving'] = self._serving.stats()
+    return out
 
   def notify_leave(self, client_rank: int) -> bool:
     """Record an orderly client departure — `wait_for_exit`'s timeout
@@ -198,6 +244,11 @@ class DistServer:
                     live_producers=len(self._producers))
     for pid in list(self._producers):
       self.destroy_sampling_producer(pid)
+    if self._serving is not None:
+      # queued serving requests resolve with typed shutdown
+      # rejections (never silently lost), then the executor stops
+      self._serving.shutdown()
+      self._serving = None
     return done
 
 
@@ -224,7 +275,7 @@ def init_server(num_servers: int, num_clients: int, rank: int,
   for name in ('get_dataset_meta', 'create_sampling_producer',
                'start_new_epoch_sampling', 'fetch_one_sampled_message',
                'destroy_sampling_producer', 'exit', 'heartbeat',
-               'notify_leave'):
+               'notify_leave', 'serve_infer'):
     rpc.register(name, getattr(srv, name))
   if getattr(dataset, 'node_pb', None) is not None and \
       not isinstance(getattr(dataset, 'node_pb'), dict):

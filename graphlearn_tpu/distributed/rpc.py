@@ -483,14 +483,22 @@ class RpcClient:
       send_obj(sock, (rid, name, args, kwargs))
     except Exception as e:
       raise _TransportError(f'send failed: {e}') from e
+    dropped = False
     for f in faults:
       if f.action == 'drop':
         # sever AFTER the send: the server may already be executing —
         # the replay cache, not a re-execution, must answer the retry
+        dropped = True
         try:
           sock.shutdown(socket.SHUT_RDWR)
         except OSError:
           pass
+    if dropped:
+      # the attempt FAILS deterministically: on a fast loopback the
+      # reply can already sit in the receive buffer when the shutdown
+      # lands, and reading it would silently un-inject the fault (the
+      # retry-and-replay path under test would never run)
+      raise _TransportError('injected connection drop')
     try:
       kind, payload = _recv_frame(sock)
     except Exception as e:

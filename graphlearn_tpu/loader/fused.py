@@ -159,7 +159,13 @@ def _uncached_jit(fn, fast_compile: bool = False,
   ``fused.compile.misses`` + ``fused.compile.secs`` and emits a
   ``fused.compile`` flight-recorder event whose ``secs`` is the wall
   of that dispatch (compile + first execution — the same definition
-  bench.py's compile numbers use)."""
+  bench.py's compile numbers use).
+
+  The returned callable also keeps PER-CALLABLE counters —
+  ``call.calls`` and ``call.compiles`` — so a caller can pin "this
+  program never recompiled" without diffing the process-global
+  metrics registry (the serving plane's zero-recompile-after-warmup
+  acceptance assertion, `serving.engine`)."""
   import os as _os
   import time as _time
   from ..telemetry.recorder import recorder
@@ -181,6 +187,7 @@ def _uncached_jit(fn, fast_compile: bool = False,
                  _os.environ.get('GLT_FUSED_COMPILE_CACHE') == '1')
     before = _cache_size()
     t0 = _time.perf_counter()
+    call.calls += 1
     if use_cache:
       out = compiled(*args, **kwargs)
     else:
@@ -189,6 +196,7 @@ def _uncached_jit(fn, fast_compile: bool = False,
     after = _cache_size()
     if after >= 0 and after > before:
       dt = _time.perf_counter() - t0
+      call.compiles += 1
       metrics.inc('fused.compile.misses')
       metrics.inc('fused.compile.secs', dt)
       recorder.emit('fused.compile', fn=name, secs=round(dt, 3),
@@ -198,7 +206,30 @@ def _uncached_jit(fn, fast_compile: bool = False,
     return out
 
   call.jitted = compiled         # escape hatch for lower()/inspection
+  call.calls = 0
+  call.compiles = 0
   return call
+
+
+#: every `_uncached_jit` program attribute a fused epoch driver (this
+#: module, `loader.fused_tree`, `parallel.fused`) may hold — the scan
+#: set of `driver_compile_count`
+_COMPILED_ATTRS = ('_compiled', '_compiled_eval', '_compiled_collect',
+                   '_compiled_train', '_compiled_eval_consume',
+                   '_compiled_auc_consume')
+
+
+def driver_compile_count(driver) -> int:
+  """Total XLA compiles across a fused driver's `_uncached_jit`
+  programs (the per-callable counters) — the epoch-driver twin of
+  `serving.engine.ServingEngine.compile_count`.  Snapshot it before a
+  steady-state window and compare after: a nonzero delta means an
+  epoch shape escaped chunking/bucketing and silently paid a compile
+  (the exact failure `max_steps_per_program` and the serving bucket
+  ladder exist to prevent)."""
+  return sum(getattr(driver, a).compiles for a in _COMPILED_ATTRS
+             if getattr(driver, a, None) is not None
+             and hasattr(getattr(driver, a), 'compiles'))
 
 
 #: default steps per tiered-fused chunk when the auto budget does not
@@ -519,6 +550,11 @@ class _SupervisedScanEpoch(_SnapshotHooks):
     each fused program dispatch (one per chunk)."""
     self._dispatch_idx = getattr(self, '_dispatch_idx', 0) + 1
     return self._dispatch_idx
+
+  def compile_count(self) -> int:
+    """Total compiles across this driver's programs (see
+    `driver_compile_count`)."""
+    return driver_compile_count(self)
 
   # -- tiered fused epochs (cold-cache service between dispatches) ----------
 
@@ -1052,6 +1088,7 @@ class FusedLinkEpoch(_SnapshotHooks):
   # `_SupervisedScanEpoch` — one body, so a fix cannot miss a twin
   _collect_step_bytes = FusedEpoch._collect_step_bytes
   _fill_cold_x = _SupervisedScanEpoch._fill_cold_x
+  compile_count = _SupervisedScanEpoch.compile_count
 
   def _link_collect_fn(self, srcs: jax.Array, dsts: jax.Array,
                        labs: jax.Array, key: jax.Array, dev: dict):

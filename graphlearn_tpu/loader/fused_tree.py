@@ -61,6 +61,29 @@ from .node_loader import SeedBatcher
 from .transform import _gather_labels
 
 
+def expand_tree_levels(indptr, indices, seeds, key, fanouts, *,
+                       sort_locality: bool = False):
+  """The bucketed single-shot tree expansion: ``[B]`` seeds → per-level
+  ``(levels, masks)`` lists (``levels[t]`` is ``[B * k_1 ... k_t]``
+  node ids, INVALID_ID where masked).  ONE definition shared by the
+  epoch drivers here and the online serving plane
+  (`serving.engine.ServingEngine` — which vmaps it per seed so a
+  seed's tree depends only on (key, seed), never on batch
+  composition), so the level layout the model consumes cannot drift
+  between training and serving."""
+  levels, masks = [seeds], [seeds >= 0]
+  frontier = seeds
+  for i, k in enumerate(fanouts):
+    res = sample_one_hop(indptr, indices, frontier, k,
+                         jax.random.fold_in(key, i),
+                         sort_locality=sort_locality)
+    nxt = jnp.where(res.mask, res.nbrs, -1).reshape(-1)
+    levels.append(nxt)
+    masks.append(nxt >= 0)
+    frontier = nxt
+  return levels, masks
+
+
 class FusedTreeEpoch(_SupervisedScanEpoch):
   """One-program tree-layout supervised epochs (see module docstring).
 
@@ -166,20 +189,12 @@ class FusedTreeEpoch(_SupervisedScanEpoch):
 
   def _expand(self, seeds: jax.Array, key: jax.Array, dev: dict,
               use_pallas: bool):
-    levels, masks = [seeds], [seeds >= 0]
-    frontier = seeds
-    for i, k in enumerate(self.fanouts):
-      res = sample_one_hop(dev['indptr'], dev['indices'], frontier,
-                           k, jax.random.fold_in(key, i),
-                           # no sort: the tree gather is rate-bound by
-                           # rows/s either way (r5 roofline), and the
-                           # locality sort is the subgraph sampler's
-                           # dominant device cost
-                           sort_locality=False)
-      nxt = jnp.where(res.mask, res.nbrs, -1).reshape(-1)
-      levels.append(nxt)
-      masks.append(nxt >= 0)
-      frontier = nxt
+    # no sort: the tree gather is rate-bound by rows/s either way (r5
+    # roofline), and the locality sort is the subgraph sampler's
+    # dominant device cost
+    levels, masks = expand_tree_levels(dev['indptr'], dev['indices'],
+                                       seeds, key, self.fanouts,
+                                       sort_locality=False)
     xs = [_device_gather(dev['hot'], lvl, dev['id2index'],
                          use_pallas=use_pallas) for lvl in levels]
     y = _gather_labels(dev['labels'], seeds)

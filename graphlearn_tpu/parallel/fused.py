@@ -50,7 +50,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..loader.fused import _SnapshotHooks, _uncached_jit, resolve_cold_chunk
+from ..loader.fused import (_SnapshotHooks, _uncached_jit,
+                            driver_compile_count, resolve_cold_chunk)
 from ..models.train import TrainState
 from .dist_data import DistDataset
 from .dist_sampler import (DistLinkNeighborSampler, DistNeighborSampler,
@@ -344,6 +345,14 @@ class _MeshEpochDriver(_SnapshotHooks):
     for row in rows:
       recorder.emit('hop.padding', scope=type(self).__name__,
                     epoch=self._epoch_idx, steps=int(steps), **row)
+
+  def compile_count(self) -> int:
+    """Total XLA compiles across this driver's `_uncached_jit`
+    programs (`loader.fused.driver_compile_count`) — the mesh twin of
+    the serving engine's zero-recompile pin.  A serving fleet that
+    co-hosts training warms its epoch programs once and watches this
+    stay flat, exactly like the bucket ladder."""
+    return driver_compile_count(self)
 
   def cluster_exchange_stats(self) -> dict:
     """Cluster-wide padding-waste / drop-rate / cold-tier report for

@@ -1,0 +1,25 @@
+"""Online inference serving plane (ISSUE 9).
+
+Turns the `DistServer`/`DistClient` runtime into an SLO-gated
+inference tier: shape-bucketed warm fused sample+gather(+forward)
+executables (`engine`), a bounded-queue admission controller with
+typed load-shedding (`admission`), and a request coalescer + executor
+loop (`frontend`).  Wire-up: build a `ServingEngine` over the served
+`Dataset`, wrap it in a `ServingFrontend`, and
+`DistServer.attach_serving(frontend)` — clients call
+`DistClient.serve`.
+
+Knobs: ``GLT_SERVING_BUCKETS``, ``GLT_SERVING_MAX_WAIT_MS``,
+``GLT_SERVING_QUEUE_DEPTH``, ``GLT_SERVING_DEADLINE_MS``
+(benchmarks/README "Online serving (r9)").
+"""
+from .admission import (AdmissionController, AdmissionRejected,
+                        ServingFuture)
+from .engine import ServingEngine, ServingResult, resolve_buckets
+from .frontend import ServingFrontend
+
+__all__ = [
+    'AdmissionController', 'AdmissionRejected', 'ServingFuture',
+    'ServingEngine', 'ServingResult', 'resolve_buckets',
+    'ServingFrontend',
+]

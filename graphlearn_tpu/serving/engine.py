@@ -1,0 +1,360 @@
+"""Bucketed warm-executable inference engine (ISSUE 9 tentpole).
+
+The training data plane compiles ONE program per epoch shape and
+amortizes it over thousands of steps; online traffic arrives as
+single-seed (or few-seed) queries whose natural shapes are all
+different — compiled naively, every request is a 60 s compile.  The
+engine applies the PR 5 INVALID_ID idiom to the traffic envelope
+instead: a small ladder of **shape buckets** (``GLT_SERVING_BUCKETS``,
+seed capacities), each served by ONE warm fused sample+gather(+model-
+forward) executable; a coalesced batch pads its tail with INVALID_ID
+up to the smallest bucket that fits.  `warmup` AOT-compiles every
+bucket at server start, and after it NOTHING recompiles across the
+whole envelope (pinned by the `_uncached_jit` per-callable compile
+counters — the zero-recompile acceptance assertion).
+
+**Per-seed determinism (the coalescing contract).**  A batch-keyed
+sampler draws per *slot*, so a seed's neighborhood would change with
+whoever it shares a bucket with — coalescing would alter answers.
+The serving program instead vmaps the single-shot tree expansion
+(`loader.fused_tree.expand_tree_levels`) per seed under a key folded
+from ``(serve_key, seed_id)``: a seed's sampled tree is a pure
+function of the engine seed and the node id — independent of bucket
+capacity, slot position, and co-batched traffic.  That is what makes
+the de-multiplexed per-request results byte-identical to the per-seed
+offline reference (`offline_reference`) across bucket boundaries, and
+what makes an RPC retry's re-execution indistinguishable from the
+first run.
+
+Identity fine print (pinned by tests/test_serving.py): ``nodes`` and
+gathered ``x`` are byte-identical across EVERY bucket shape and any
+co-batched traffic.  Fused-forward ``logits`` are byte-identical
+within a bucket shape whatever the request rode with (each row's
+matmul reads only its own row), and agree across DIFFERENT bucket
+shapes only to float tolerance (~1e-6 — XLA retiles the matmul
+reduction per shape; no compiler grants cross-shape bitwise
+equality).  Per-request answers are therefore bitwise-reproducible
+given (engine seed, bucket shape) — retries and replicas agree —
+while cross-bucket logit identity is numerical, not bitwise.
+
+**Tiered tables.**  With ``split_ratio < 1`` the device program emits
+the sampled node ids only; features fill through the per-request
+tiered `Feature.get` path — hot split gather + HBM cold-cache hits +
+host-served misses with admission (`data.cold_cache`) — under the
+``'serving'`` telemetry scope.  Zipf-skewed inference traffic is
+exactly the workload that cache was built for (ROADMAP item 2
+grounding: GNS, arXiv 2106.06150).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Dataset
+from ..data.feature import _device_gather
+from ..loader.fused import _uncached_jit
+from ..loader.fused_tree import expand_tree_levels
+from ..ops.pallas_gather import pallas_enabled
+from ..utils.padding import INVALID_ID
+
+BUCKETS_ENV = 'GLT_SERVING_BUCKETS'
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def resolve_buckets(spec=None) -> Tuple[int, ...]:
+  """The seed-capacity ladder: an explicit sequence wins, else
+  ``GLT_SERVING_BUCKETS`` (comma-separated ints), else the default.
+  Returned sorted ascending, deduplicated, all positive."""
+  if spec is None:
+    env = os.environ.get(BUCKETS_ENV)
+    if env:
+      try:
+        spec = [int(tok) for tok in env.split(',') if tok.strip()]
+      except ValueError:
+        spec = None
+  if not spec:
+    spec = DEFAULT_BUCKETS
+  caps = sorted({int(c) for c in spec if int(c) > 0})
+  if not caps:
+    raise ValueError(f'no positive bucket capacities in {spec!r}')
+  return tuple(caps)
+
+
+@dataclass
+class ServingResult:
+  """De-multiplexed per-request inference output.
+
+  ``nodes`` is ``[k, W]`` — each seed's sampled tree, all levels
+  concatenated (widths ``1, k1, k1*k2, ...``; INVALID_ID where
+  masked).  Exactly one of ``x`` (``[k, W, D]`` gathered features,
+  model-less engines) and ``logits`` (``[k, C]``, engines with a
+  model) is set."""
+  nodes: np.ndarray
+  x: Optional[np.ndarray] = None
+  logits: Optional[np.ndarray] = None
+
+  def slice(self, lo: int, hi: int) -> 'ServingResult':
+    return ServingResult(
+        nodes=self.nodes[lo:hi],
+        x=None if self.x is None else self.x[lo:hi],
+        logits=None if self.logits is None else self.logits[lo:hi])
+
+
+class ServingEngine:
+  """Warm bucketed single-shot inference over a `Dataset`.
+
+  Args:
+    data: homogeneous `Dataset`; the `Feature` may be tiered
+      (``split_ratio < 1`` routes cold rows through the cache-aware
+      host path) — the serving twin of the fused epoch drivers'
+      tiered contract.
+    num_neighbors: per-hop fanouts of the sampling tree.
+    model: optional tree-layout model (`models.tree.TreeSAGE`
+      signature: ``(xs, masks) -> [B, C]``); fused into the bucket
+      program when the table is fully HBM-resident, run as a warm
+      consume program after the host feature fill when tiered.
+    params: model params (required with ``model``; see
+      `init_params`).
+    seed: the serve key — per-seed sampling derives from
+      ``fold_in(key(seed), node_id)``, so two engines with one seed
+      answer identically (replica consistency for free).
+    buckets: seed-capacity ladder override (else
+      ``GLT_SERVING_BUCKETS``).
+  """
+
+  def __init__(self, data: Dataset, num_neighbors: Sequence[int],
+               model=None, params=None, seed: int = 0, buckets=None):
+    if data.is_hetero:
+      raise ValueError('ServingEngine is homogeneous-only (hetero '
+                       'serving parity is ROADMAP item 4)')
+    feat = data.node_features
+    if feat is None:
+      raise ValueError('ServingEngine needs node features')
+    self.data = data
+    self.fanouts = tuple(int(k) for k in num_neighbors)
+    self.model = model
+    self.params = params
+    self.buckets = resolve_buckets(buckets)
+    self._tiered = feat.hot_rows < feat.size(0)
+    self._feat = feat
+    graph = data.get_graph()
+    self.num_nodes = int(graph.num_nodes)
+    # big tables as jit ARGUMENTS, never closures (`loader.fused`)
+    self._dev = dict(indptr=graph.indptr, indices=graph.indices,
+                     hot=None if self._tiered else feat.hot_tier,
+                     id2index=(None if self._tiered
+                               else feat._id2index_dev))
+    self._key = jax.random.key(int(seed))
+    self.level_widths = self._level_widths()
+    self.tree_width = sum(self.level_widths)
+    #: bucket capacity -> True once `warmup` compiled it
+    self.warm = {cap: False for cap in self.buckets}
+    # every program is chunk-bounded by construction (one bucket =
+    # one static shape), so all opt into the persistent compile
+    # cache under GLT_FUSED_COMPILE_CACHE=1 — ROADMAP item 6's
+    # cold-start story rides the same seam as the fused epochs
+    self._compiled_collect = _uncached_jit(self._collect_fn,
+                                           cacheable=True)
+    self._compiled_gather = _uncached_jit(self._gather_fn,
+                                          static_argnums=(2,),
+                                          cacheable=True)
+    self._compiled_forward = _uncached_jit(self._forward_fn,
+                                           static_argnums=(3,),
+                                           cacheable=True)
+    self._compiled_consume = _uncached_jit(self._consume_fn,
+                                           cacheable=True)
+
+  # -- static layout --------------------------------------------------------
+  def _level_widths(self) -> Tuple[int, ...]:
+    widths = [1]
+    for k in self.fanouts:
+      widths.append(widths[-1] * k)
+    return tuple(widths)
+
+  def max_request_seeds(self) -> int:
+    return self.buckets[-1]
+
+  def bucket_for(self, n_seeds: int) -> int:
+    """Smallest capacity holding ``n_seeds`` (ValueError past the
+    ladder — admission refuses those with a typed error instead)."""
+    for cap in self.buckets:
+      if n_seeds <= cap:
+        return cap
+    raise ValueError(f'{n_seeds} seeds exceed the largest bucket '
+                     f'{self.buckets[-1]}')
+
+  # -- traced programs ------------------------------------------------------
+  def _seed_tree(self, indptr, indices, seed):
+    """One seed's sampled tree: ``[W]`` concatenated level node ids,
+    keyed by (serve_key, seed id) ONLY — the per-seed determinism the
+    whole coalescing contract rests on."""
+    valid = seed >= 0
+    skey = jax.random.fold_in(self._key, jnp.where(valid, seed, 0))
+    s1 = jnp.where(valid, seed, INVALID_ID).astype(jnp.int32)[None]
+    levels, _masks = expand_tree_levels(indptr, indices, s1, skey,
+                                        self.fanouts)
+    return jnp.concatenate(levels)
+
+  def _collect_fn(self, seeds: jax.Array, dev: dict) -> jax.Array:
+    """``[cap]`` seeds -> ``[cap, W]`` sampled trees (no features) —
+    the tiered path's device half."""
+    return jax.vmap(
+        lambda s: self._seed_tree(dev['indptr'], dev['indices'], s)
+    )(seeds)
+
+  def _split_levels(self, flat: jax.Array) -> List[jax.Array]:
+    """``[cap, W, ...]`` -> per-level ``[cap * w_t, ...]`` tensors in
+    the tree-layout order `models.tree.TreeSAGE` consumes (parent-
+    major within each seed block — the same layout
+    `expand_tree_levels` emits)."""
+    out, off = [], 0
+    cap = flat.shape[0]
+    for w in self.level_widths:
+      lvl = flat[:, off:off + w]
+      out.append(lvl.reshape((cap * w,) + flat.shape[2:]))
+      off += w
+    return out
+
+  def _gather_fn(self, seeds: jax.Array, dev: dict,
+                 use_pallas: bool):
+    """Fully-hot, model-less bucket program: sample + feature gather
+    in ONE executable.  Returns ``(nodes [cap, W], x [cap, W, D])``."""
+    nodes = self._collect_fn(seeds, dev)
+    x = _device_gather(dev['hot'], nodes.reshape(-1), dev['id2index'],
+                       use_pallas=use_pallas)
+    return nodes, x.reshape(nodes.shape + (x.shape[-1],))
+
+  def _forward_fn(self, seeds: jax.Array, params, dev: dict,
+                  use_pallas: bool):
+    """Fully-hot bucket program WITH the model forward fused in:
+    sample + gather + tree-layout apply.  ``(nodes, logits)``."""
+    nodes = self._collect_fn(seeds, dev)
+    xs = [_device_gather(dev['hot'], lvl, dev['id2index'],
+                         use_pallas=use_pallas)
+          for lvl in self._split_levels(nodes)]
+    masks = [lvl >= 0 for lvl in self._split_levels(nodes)]
+    return nodes, self.model.apply(params, xs, masks)
+
+  def _consume_fn(self, nodes: jax.Array, x: jax.Array, params):
+    """Tiered consume program: host-filled ``[cap, W, D]`` features ->
+    logits (the warm second half of a tiered bucket)."""
+    xs = self._split_levels(x)
+    masks = [lvl >= 0 for lvl in self._split_levels(nodes)]
+    return self.model.apply(params, xs, masks)
+
+  # -- host driver ----------------------------------------------------------
+  def init_params(self, rng):
+    """Init model params from the level shapes (host-cheap, shapes
+    only) — the serving twin of `FusedTreeEpoch.init_state`."""
+    if self.model is None:
+      raise ValueError('init_params() needs a model')
+    d = self._feat.feature_dim
+    xs = [jnp.zeros((w, d), self._feat.dtype)
+          for w in self.level_widths]
+    masks = [jnp.ones((w,), jnp.bool_) for w in self.level_widths]
+    self.params = self.model.init(rng, xs, masks)
+    return self.params
+
+  def _pad(self, seeds: np.ndarray, cap: int) -> jax.Array:
+    out = np.full((cap,), INVALID_ID, np.int32)
+    out[:len(seeds)] = np.asarray(seeds, np.int32)
+    return jnp.asarray(out)
+
+  def _dispatch(self, padded: jax.Array) -> ServingResult:
+    """One bucket dispatch (``padded`` already at a bucket capacity).
+    Warm after `warmup`: every call is an in-memory executable hit."""
+    if self.model is not None and self.params is None:
+      raise ValueError(
+          'ServingEngine has a model but no params — call '
+          'init_params(rng) (or set .params) before serving/warmup')
+    if self._tiered:
+      nodes = self._compiled_collect(padded, self._dev)
+      nodes_h = np.asarray(nodes)
+      # the per-request tiered lookup: hot split + HBM cold-cache +
+      # host-served misses, 'serving' telemetry scope
+      x = self._feat.get(nodes_h.reshape(-1), scope='serving')
+      x = x.reshape(nodes_h.shape + (x.shape[-1],))
+      if self.model is None:
+        return ServingResult(nodes=nodes_h, x=np.asarray(x))
+      logits = self._compiled_consume(nodes, jnp.asarray(x),
+                                      self.params)
+      return ServingResult(nodes=nodes_h, logits=np.asarray(logits))
+    if self.model is None:
+      nodes, x = self._compiled_gather(padded, self._dev,
+                                       pallas_enabled())
+      return ServingResult(nodes=np.asarray(nodes), x=np.asarray(x))
+    nodes, logits = self._compiled_forward(padded, self.params,
+                                           self._dev,
+                                           pallas_enabled())
+    return ServingResult(nodes=np.asarray(nodes),
+                         logits=np.asarray(logits))
+
+  def infer(self, seeds, cap: Optional[int] = None) -> ServingResult:
+    """Serve one (possibly coalesced) seed batch; results sliced back
+    to ``len(seeds)``.  ``cap`` pins the bucket (the frontend picks it
+    once per coalesced dispatch); default = smallest fitting."""
+    seeds = np.asarray(seeds).reshape(-1)
+    cap = self.bucket_for(len(seeds)) if cap is None else cap
+    return self._dispatch(self._pad(seeds, cap)).slice(0, len(seeds))
+
+  def offline_reference(self, seeds,
+                        cap: Optional[int] = None) -> ServingResult:
+    """The per-seed offline loader twin: every seed served ALONE —
+    through the smallest bucket by default, or a pinned ``cap`` —
+    the byte-identity reference the coalesced path is tested against
+    (and what a non-coalescing baseline deployment would compute).
+    See the class docstring's identity fine print for which outputs
+    are bitwise vs float-tolerance equal across bucket shapes."""
+    parts = [self.infer(np.asarray([s]), cap=cap) for s in
+             np.asarray(seeds).reshape(-1)]
+    return ServingResult(
+        nodes=np.concatenate([p.nodes for p in parts]),
+        x=(None if parts[0].x is None
+           else np.concatenate([p.x for p in parts])),
+        logits=(None if parts[0].logits is None
+                else np.concatenate([p.logits for p in parts])))
+
+  def warmup(self) -> dict:
+    """AOT-compile every bucket program at server start (the tiered
+    host fill + consume included), so the first real request — and
+    every one after — hits a warm executable.  Returns
+    ``{'buckets': {...}, 'compiles': n, 'secs': wall}``."""
+    import time
+    from ..utils.profiling import metrics
+    t0 = time.perf_counter()
+    n = min(self.num_nodes, 8)
+    before = self.compile_count()
+    for cap in self.buckets:
+      # valid ids (0..n-1 cycled) + one INVALID tail slot when the
+      # bucket has room: both the masked and unmasked arms warm up
+      seeds = np.arange(cap, dtype=np.int32) % n
+      if cap > 1:
+        seeds[-1] = INVALID_ID
+      self._dispatch(jnp.asarray(seeds))
+      self.warm[cap] = True
+    secs = time.perf_counter() - t0
+    compiles = self.compile_count() - before
+    metrics.inc('serving.warmup.secs', secs)
+    return {'buckets': dict(self.warm), 'compiles': compiles,
+            'secs': round(secs, 3)}
+
+  def compile_count(self) -> int:
+    """Total compiles across the engine's programs (the
+    `_uncached_jit` per-callable counters) — snapshot before traffic,
+    compare after: a nonzero delta after `warmup` means a shape
+    escaped the bucket ladder."""
+    return sum(fn.compiles for fn in (
+        self._compiled_collect, self._compiled_gather,
+        self._compiled_forward, self._compiled_consume))
+
+  def compile_status(self) -> dict:
+    """Per-bucket warm status + compile counters (the heartbeat's
+    serving block)."""
+    return {'buckets': {str(c): bool(w) for c, w in self.warm.items()},
+            'compiles': self.compile_count(),
+            'tiered': self._tiered}

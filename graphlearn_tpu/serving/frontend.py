@@ -1,0 +1,230 @@
+"""Request coalescing + executor loop over warm bucket executables.
+
+The `ServingFrontend` is the glue of the online tier: producers
+(`DistServer.serve_infer` handler threads, or in-process callers)
+``submit`` single-seed / few-seed requests through the
+`AdmissionController`; ONE executor thread drains the bounded queue
+in coalesced runs — FIFO requests packed until the largest bucket
+fills or ``GLT_SERVING_MAX_WAIT_MS`` has passed since the run's first
+arrival — dispatches each run through the engine's warm bucket
+program, and de-multiplexes per-request slices back onto the waiting
+futures.  Per-seed sampling determinism (`serving.engine`) is what
+makes the slices byte-identical to serving each request alone.
+
+Latency anatomy of one request (all spans/events in the flight
+recorder): queue wait (bounded by max-wait + the in-flight dispatch),
+``serving.infer`` span (the device dispatch + tiered host fill),
+demux.  ``serving.request`` events carry the end-to-end
+``latency_ms`` the bench's percentile table is built from.
+
+Coalescing is a LATENCY/THROUGHPUT dial, not a correctness one:
+``GLT_SERVING_MAX_WAIT_MS=0`` degrades to serve-every-request-alone
+(lowest added latency, one dispatch per request); large values
+amortize dispatch overhead across deeper buckets under load.  Under
+an arrival burst the wait never binds — the queue fills a bucket
+immediately and the tier runs back-to-back dispatches.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..telemetry.recorder import recorder
+from ..telemetry.spans import span
+from .admission import AdmissionController, Request
+from .engine import ServingEngine, ServingResult
+
+MAX_WAIT_ENV = 'GLT_SERVING_MAX_WAIT_MS'
+DEFAULT_MAX_WAIT_MS = 2.0
+
+
+def max_wait_ms_from_env() -> float:
+  raw = os.environ.get(MAX_WAIT_ENV)
+  if raw is None:
+    return DEFAULT_MAX_WAIT_MS
+  try:
+    return max(float(raw), 0.0)
+  except ValueError:
+    return DEFAULT_MAX_WAIT_MS
+
+
+class ServingFrontend:
+  """Admission + coalescing + warm-executable execution.
+
+  Args:
+    engine: a `ServingEngine` (warmed by `start`, see below).
+    max_wait_ms: coalescing window (else ``GLT_SERVING_MAX_WAIT_MS``).
+    max_queue / default_deadline_ms: admission bounds (else the
+      ``GLT_SERVING_QUEUE_DEPTH`` / ``GLT_SERVING_DEADLINE_MS``
+      defaults).
+    auto_start: start the executor thread (and run `engine.warmup`
+      when not yet warm) immediately.  Tests pass ``False`` and pump
+      deterministically with `pump_once`.
+  """
+
+  def __init__(self, engine: ServingEngine,
+               max_wait_ms: Optional[float] = None,
+               max_queue: Optional[int] = None,
+               default_deadline_ms: Optional[float] = None,
+               auto_start: bool = True, warmup: bool = True):
+    self.engine = engine
+    self.max_wait_s = (max_wait_ms if max_wait_ms is not None
+                       else max_wait_ms_from_env()) / 1e3
+    self.admission = AdmissionController(
+        max_queue=max_queue, default_deadline_ms=default_deadline_ms,
+        max_request_seeds=engine.max_request_seeds())
+    self._closed = False
+    self._thread: Optional[threading.Thread] = None
+    self._lock = threading.Lock()
+    #: executor-side counters (heartbeat/stats; executor thread only
+    #: writes, readers take the lock for a consistent snapshot)
+    self.in_flight = 0
+    self.served_requests = 0
+    self.served_seeds = 0
+    self.dispatches = 0
+    self.failed = 0
+    if auto_start:
+      self.start(warmup=warmup)
+
+  # -- lifecycle ------------------------------------------------------------
+  def start(self, warmup: bool = True) -> None:
+    if self._thread is not None:
+      return
+    if warmup and not all(self.engine.warm.values()):
+      self.engine.warmup()
+    self._thread = threading.Thread(target=self._loop, daemon=True,
+                                    name='glt-serving-executor')
+    self._thread.start()
+
+  def shutdown(self, timeout: float = 10.0) -> None:
+    """Stop the executor; every queued request resolves with a typed
+    shutdown rejection (never silently lost)."""
+    self._closed = True
+    self.admission.close()
+    t = self._thread
+    if t is not None:
+      t.join(timeout)
+    self._thread = None
+
+  # -- producer side --------------------------------------------------------
+  def submit(self, seeds, deadline_ms: Optional[float] = None):
+    """Admit one request; returns its `ServingFuture` (raises
+    `AdmissionRejected` at the door when the queue is at bound, and
+    `ValueError` for a MALFORMED request — empty, or seed ids outside
+    ``[0, num_nodes)``; the engine's gathers CLAMP out-of-range ids,
+    so without this check a bogus id would come back as a plausible
+    answer for the wrong node instead of an error)."""
+    seeds = np.asarray(seeds, np.int64).reshape(-1)
+    if seeds.size == 0:
+      raise ValueError('a serving request needs at least one seed')
+    if seeds.min() < 0 or seeds.max() >= self.engine.num_nodes:
+      bad = seeds[(seeds < 0) | (seeds >= self.engine.num_nodes)]
+      raise ValueError(
+          f'seed id(s) {bad[:8].tolist()} outside [0, '
+          f'{self.engine.num_nodes}) — refused (a clamped gather '
+          'would silently answer for a different node)')
+    return self.admission.submit(seeds, deadline_ms).future
+
+  def infer(self, seeds, deadline_ms: Optional[float] = None,
+            timeout: Optional[float] = None) -> ServingResult:
+    """Blocking submit+wait convenience (the in-process client)."""
+    dl = (deadline_ms if deadline_ms is not None
+          else self.admission.default_deadline_ms)
+    fut = self.submit(seeds, deadline_ms)
+    # the wait outlives the deadline by a grace window: a request
+    # PICKED before its deadline still completes (classic SLO
+    # semantics — shed applies to queued requests only)
+    return fut.result(timeout if timeout is not None
+                      else dl / 1e3 + 30.0)
+
+  # -- executor side --------------------------------------------------------
+  def _loop(self) -> None:
+    while not self._closed:
+      try:
+        self.pump_once()
+      except Exception:             # noqa: BLE001 — pump_once resolves
+        # per-request errors onto futures; anything escaping here is a
+        # harness bug, and dying silently would hang every later
+        # caller — keep the loop alive
+        if self._closed:
+          return
+
+  def pump_once(self, block: bool = True) -> int:
+    """Drain ONE coalesced run end to end; returns requests served
+    (0 = nothing to do / everything shed).  The executor loop calls
+    this forever (``block=True``: wait for work); tests call it
+    directly — ``block=False`` returns 0 immediately on an empty
+    queue instead of waiting."""
+    run = self.admission.take(self.engine.max_request_seeds(),
+                              self.max_wait_s, block=block)
+    if not run:
+      return 0
+    with self._lock:
+      self.in_flight = len(run)
+    try:
+      return self._execute(run)
+    finally:
+      with self._lock:
+        self.in_flight = 0
+
+  def _execute(self, run: List[Request]) -> int:
+    from ..testing import chaos
+    sizes = [len(r.seeds) for r in run]
+    total = sum(sizes)
+    cap = self.engine.bucket_for(total)
+    now = time.monotonic()
+    recorder.emit('serving.coalesce', requests=len(run), seeds=total,
+                  bucket=cap,
+                  waited_ms=round(1e3 * (now - run[0].arrived), 3))
+    try:
+      # chaos seam (executor flavor): a 'delay' here simulates a slow/
+      # stuck dispatch — queued requests behind it expire and shed; a
+      # 'drop' kills this dispatch with a typed error on every rider
+      chaos.serving_request_check('dispatch')
+      with span('serving.infer', bucket=cap, requests=len(run),
+                seeds=total):
+        batch = self.engine.infer(
+            np.concatenate([r.seeds for r in run]), cap=cap)
+    except Exception as e:          # noqa: BLE001 — typed resolve,
+      # never a silent drop: every rider of the failed dispatch gets
+      # the error (an RPC handler re-raises it to its client)
+      with self._lock:
+        self.failed += len(run)
+      for req in run:
+        req.future.set_error(e)
+        recorder.emit('serving.request', seeds=len(req.seeds),
+                      bucket=cap, coalesced=len(run), ok=False,
+                      latency_ms=round(req.waited_ms(), 3),
+                      error=f'{type(e).__name__}: {e}'[:160])
+      return 0
+    off = 0
+    for req, k in zip(run, sizes):
+      req.future.set_result(batch.slice(off, off + k))
+      off += k
+      recorder.emit('serving.request', seeds=k, bucket=cap,
+                    coalesced=len(run), ok=True,
+                    latency_ms=round(req.waited_ms(), 3))
+    with self._lock:
+      self.served_requests += len(run)
+      self.served_seeds += total
+      self.dispatches += 1
+    return len(run)
+
+  # -- observability --------------------------------------------------------
+  def stats(self) -> dict:
+    """The heartbeat serving block: queue depth, in-flight batch
+    size, served/shed counters, per-bucket compile status."""
+    with self._lock:
+      out = {'in_flight': self.in_flight,
+             'served_requests': self.served_requests,
+             'served_seeds': self.served_seeds,
+             'dispatches': self.dispatches,
+             'failed': self.failed}
+    out.update(self.admission.stats())
+    out['compile_status'] = self.engine.compile_status()
+    out['max_wait_ms'] = round(self.max_wait_s * 1e3, 3)
+    return out

@@ -75,6 +75,14 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # overhead pct, whose healthy baseline straddles zero (the
     # cur/base slowdown math inverts on a negative baseline).
     ('dist.resume.snap_over_nosnap_ratio', 'higher'),
+    # online-serving guard (ISSUE 9): the Zipf open-loop traffic row
+    # (bench_serving.py) — tail latency and sustained completion rate
+    # of the coalescing tier must not silently erode (shed_rate is
+    # reported in the artifact; a healthy baseline of 0 makes it
+    # ungateable by ratio, so the latency/throughput pair carries the
+    # guard)
+    ('dist.serving.p99_ms', 'lower'),
+    ('dist.serving.qps', 'higher'),
 )
 
 

@@ -144,6 +144,83 @@ def format_resilience_table(events) -> str:
   return '\n'.join(lines)
 
 
+def nearest_rank(sorted_vals, p: float):
+  """Nearest-rank quantile over PRE-SORTED values (``None`` on
+  empty).  ONE definition shared by this report CLI and
+  `benchmarks/bench_serving.py`, so the bench's regression-guarded
+  p99 and the trace report's p99 can never silently diverge."""
+  if not sorted_vals:
+    return None
+  i = min(int(p * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+  return sorted_vals[i]
+
+
+def serving_percentiles(events) -> Dict[str, Dict]:
+  """Per-bucket serving latency percentiles from ``serving.request``
+  events (EXACT quantiles over the raw ``latency_ms`` values — the
+  serving SLO numbers deserve better than the 2x log2 envelope), plus
+  an ``all`` row and the shed counts by reason.  ``{}`` when the
+  trace holds no serving traffic."""
+  lat: Dict[str, List[float]] = {}
+  for e in events:
+    if e.get('kind') != 'serving.request' or not e.get('ok', True):
+      continue
+    v = e.get('latency_ms')
+    if v is None:
+      continue
+    lat.setdefault(str(e.get('bucket', '?')), []).append(float(v))
+    lat.setdefault('all', []).append(float(v))
+  if not lat:
+    return {}
+  out: Dict[str, Dict] = {}
+  for bucket, vals in lat.items():
+    vals = sorted(vals)
+    out[bucket] = {'count': len(vals),
+                   'p50_ms': nearest_rank(vals, 0.5),
+                   'p95_ms': nearest_rank(vals, 0.95),
+                   'p99_ms': nearest_rank(vals, 0.99),
+                   'max_ms': vals[-1]}
+  shed: Dict[str, int] = {}
+  for e in events:
+    if e.get('kind') == 'serving.shed':
+      r = str(e.get('reason'))
+      shed[r] = shed.get(r, 0) + 1
+  if shed:
+    out['shed'] = shed
+  return out
+
+
+def format_serving_table(events) -> str:
+  """Render the serving percentile table ('' when the trace holds no
+  serving.request events)."""
+  pct = serving_percentiles(events)
+  if not pct:
+    return ''
+  shed = pct.pop('shed', {})
+  header = ['bucket', 'count', 'p50_ms', 'p95_ms', 'p99_ms', 'max_ms']
+  rows = []
+  # 'all' first, then buckets in NUMERIC ladder order (keys are
+  # stringified capacities — a lexicographic sort puts 16 before 2)
+  for bucket in sorted(pct, key=lambda b: (b != 'all',
+                                           int(b) if b.isdigit() else 0,
+                                           b)):
+    r = pct[bucket]
+    rows.append([bucket, str(r['count']),
+                 f"{r['p50_ms']:.2f}", f"{r['p95_ms']:.2f}",
+                 f"{r['p99_ms']:.2f}", f"{r['max_ms']:.2f}"])
+  widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+            for i in range(len(header))]
+  lines = ['  '.join(h.ljust(w) if i == 0 else h.rjust(w)
+                     for i, (h, w) in enumerate(zip(header, widths)))]
+  for r in rows:
+    lines.append('  '.join(c.ljust(w) if i == 0 else c.rjust(w)
+                           for i, (c, w) in enumerate(zip(r, widths))))
+  if shed:
+    lines.append('shed: ' + ', '.join(f'{k}={v}'
+                                      for k, v in sorted(shed.items())))
+  return '\n'.join(lines)
+
+
 def histograms_from_metrics_json(path: str) -> Dict[str, Histogram]:
   """Decode a `gather_metrics` dump (the ``aggregate`` dict, or the
   whole result object) into merged histograms."""
@@ -195,6 +272,11 @@ def main(argv: Optional[List[str]] = None) -> int:
   if res:
     print('# resilience events (retries, faults, snapshots, stalls)')
     print(res)
+  srv = format_serving_table(events)
+  if srv:
+    print('# serving request latency percentiles (serving.request '
+          'events; exact quantiles, not log2 buckets)')
+    print(srv)
   if args.chrome:
     n = write_chrome_trace(args.trace, args.chrome)
     print(f'# wrote {n} trace events -> {args.chrome} '

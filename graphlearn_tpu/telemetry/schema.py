@@ -42,8 +42,9 @@ EVENT_KINDS: Dict[str, str] = {
         'cold_lookups (past the hot tier — the cache denominator), '
         'misses (host-served), cache_hits, hit_rate',
     'cache.hit':
-        'data.cold_cache consumers (scope=feature|dist): count of '
-        'cold lookups served from the HBM victim cache this overlay',
+        'data.cold_cache consumers (scope=feature|dist|serving): '
+        'count of cold lookups served from the HBM victim cache this '
+        'overlay',
     'cache.miss':
         'data.cold_cache consumers: count of cold lookups that paid '
         'the host gather this overlay (admission candidates)',
@@ -94,6 +95,25 @@ EVENT_KINDS: Dict[str, str] = {
         '(last-known-healthy process set) — a fused/mesh dispatch '
         'exceeded GLT_DISPATCH_DEADLINE and was converted into a '
         'typed MeshStallError instead of hanging the epoch',
+    'serving.request':
+        'serving.frontend executor, one per de-multiplexed request: '
+        'seeds, bucket, coalesced (requests in the dispatch), ok, '
+        'latency_ms (arrival -> resolve; the percentile-table and '
+        'bench p50/p95/p99 source), error when ok=False',
+    'serving.coalesce':
+        'serving.frontend executor, one per coalesced dispatch: '
+        'requests, seeds, bucket (chosen capacity), waited_ms since '
+        "the run's first arrival (how much of GLT_SERVING_MAX_WAIT_MS "
+        'actually bound)',
+    'serving.admit':
+        'serving.admission.AdmissionController.submit: seeds, '
+        'queue_depth after admit, deadline_ms — one per admitted '
+        'request',
+    'serving.shed':
+        'serving.admission: reason (queue_full|deadline|too_large), '
+        'seeds, queue_depth, limit / waited_ms — one per typed '
+        'load-shed (the request future resolves with '
+        'AdmissionRejected; nothing is silently dropped)',
 }
 
 
@@ -141,6 +161,11 @@ SPAN_NAMES: Dict[str, str] = {
         'parallel.exchange.capacity_spec, build time: hierarchical '
         'stage capacities (rows, cols, stage1_cap, stage2_cap) for '
         'one planned exchange',
+    'serving.infer':
+        'serving.frontend executor: one warm bucketed dispatch '
+        '(device program + tiered host fill) — bucket, requests, '
+        'seeds; queue wait is OUTSIDE this span (serving.request '
+        'latency_ms minus this span = admission/coalescing wait)',
 }
 
 

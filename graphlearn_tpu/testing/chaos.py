@@ -41,6 +41,17 @@ wall clocks involved).  Sites and actions:
       Action ``fail`` raises :class:`InjectedFault` — a host feature
       tier that died mid-epoch; the snapshot/resume layer is what
       turns it into a finished epoch.
+  ``serving.request``
+      Two seams in the online serving plane, distinguished by ``op``:
+      ``op='serve_infer'`` fires inside the `DistServer.serve_infer`
+      RPC handler (before admission), ``op='dispatch'`` inside the
+      serving executor just before a coalesced dispatch.  Actions:
+      ``delay`` (sleep ``secs`` — a slow executor; queued requests
+      behind it expire and SHED typed, the SLO-gating under test),
+      ``drop`` (raise :class:`InjectedFault` — the request/dispatch
+      dies server-side; the client sees a typed error, and a
+      transport-level retry of the same RPC is answered by the replay
+      cache, never re-executed).
 
 Plans install three ways: programmatically (:func:`install`), from the
 ``GLT_FAULT_PLAN`` env var (inherited by producer subprocesses and
@@ -82,7 +93,7 @@ FAULT_PLAN_ENV = 'GLT_FAULT_PLAN'
 WORKER_KILL_EXIT = 173
 
 _SITES = ('rpc.request', 'producer.worker', 'checkpoint.io',
-          'fused.dispatch', 'feature.cold_service')
+          'fused.dispatch', 'feature.cold_service', 'serving.request')
 _ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate')
 
 
@@ -292,13 +303,34 @@ def corrupt_payload(payload: bytes) -> bytes:
   return bytes(buf)
 
 
-def worker_kill_check(rank: int, epoch: int, generation: int = 0) -> None:
+def worker_kill_check(rank: int, epoch: int, generation: int = 0,
+                      flush=()) -> None:
   """Sampling-worker seam, called before each batch; a fired ``kill``
   hard-exits the process (no cleanup — a real crash).  ``generation``
-  is the supervisor's restart count for this rank (0 = original)."""
+  is the supervisor's restart count for this rank (0 = original).
+
+  ``flush`` holds mp queues (the producer's progress-ack queue) whose
+  feeder threads are joined BEFORE the exit.  The seam models a crash
+  BETWEEN batches: every prior batch was already durably sent to the
+  channel, and its ack merely sits in the mp.Queue feeder buffer — a
+  plain ``os._exit`` raced that feeder, sometimes losing acks for
+  batches the channel already holds, so the supervisor replayed the
+  FULL assignment and the replacement re-fired the same deterministic
+  ``nth`` kill until the restart budget died (the exact hazard
+  `MpSamplingProducer._unacked` documents).  Joining the feeder keeps
+  the simulation honest (a real crash that loses acks only replays
+  already-delivered batches — harmless dedup — nondeterministically,
+  not deterministically forever) and makes kill-fault replays exactly
+  the unsent batches."""
   for f in on('producer.worker', worker=rank, epoch=epoch,
               generation=generation):
     if f.action == 'kill':
+      for q in flush:
+        try:
+          q.close()
+          q.join_thread()
+        except Exception:           # noqa: BLE001 — best-effort flush
+          pass
       os._exit(WORKER_KILL_EXIT)
 
 
@@ -324,3 +356,17 @@ def cold_service_check(scope: str = '') -> None:
     if f.action == 'fail':
       raise InjectedFault(
           f'injected cold-tier service failure (scope {scope!r})')
+
+
+def serving_request_check(op: str = '') -> None:
+  """Serving-plane seam (RPC handler: ``op='serve_infer'``; executor
+  dispatch: ``op='dispatch'``): ``delay`` sleeps in place (driving
+  deadline sheds behind it), ``drop`` raises `InjectedFault` (a typed
+  server-side request loss — the replay cache still answers any
+  transport retry of the same request id verbatim)."""
+  for f in on('serving.request', op=op or None):
+    if f.action == 'delay':
+      time.sleep(f.secs)
+    elif f.action == 'drop':
+      raise InjectedFault(
+          f'injected serving request drop (op {op!r})')

@@ -1,0 +1,178 @@
+"""Serving over the real RPC runtime (ISSUE 9 satellites): the
+`serve_infer` handler + `DistClient.serve` round trip, the heartbeat
+serving block, typed admission propagation over the wire, and the
+replay-cache exactly-once contract extended to serving RPCs under
+injected connection drops.  Server runs IN-PROCESS (the `RpcServer`
+is threaded — the test_resilience idiom), so no native dependency and
+no subprocess jax imports.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu.data import Dataset
+from graphlearn_tpu.distributed import (init_client, init_server,
+                                        shutdown_client,
+                                        wait_and_shutdown_server)
+from graphlearn_tpu.distributed.resilience import reset_default_policy
+from graphlearn_tpu.serving import (AdmissionRejected, ServingEngine,
+                                    ServingFrontend)
+from graphlearn_tpu.telemetry import recorder
+from graphlearn_tpu.testing import chaos
+
+N, D = 48, 4
+FANOUTS = [2, 2]
+BUCKETS = (1, 2, 4)
+
+
+def _dataset():
+  rng = np.random.default_rng(1)
+  rows = np.repeat(np.arange(N), 3)
+  cols = rng.integers(0, N, rows.shape[0])
+  feats = (np.arange(N, dtype=np.float32)[:, None]
+           * np.ones((1, D), np.float32))
+  return (Dataset().init_graph((rows, cols), layout='COO', num_nodes=N)
+          .init_node_features(feats))
+
+
+class _StubHostDataset:
+  """`DistServer` wants a dataset for the PRODUCER path; the serving
+  tests never touch producers, so a shape-only stub keeps the fixture
+  free of the host sampling stack."""
+  num_nodes = N
+  num_edges = N * 3
+  node_features = None
+  node_labels = None
+
+
+@pytest.fixture(scope='module')
+def serving_cluster():
+  """One in-process server with a warmed serving tier + one client."""
+  engine = ServingEngine(_dataset(), FANOUTS, seed=7, buckets=BUCKETS)
+  frontend = ServingFrontend(engine, auto_start=True, warmup=True,
+                             max_wait_ms=1.0,
+                             default_deadline_ms=2000.0)
+  srv = init_server(num_servers=1, num_clients=1, rank=0,
+                    dataset=_StubHostDataset(), host='127.0.0.1',
+                    port=0)
+  srv.attach_serving(frontend)
+  client = init_client([('127.0.0.1', srv.port)], rank=0,
+                       num_clients=1)
+  yield srv, client, engine, frontend
+  client.shutdown()                  # notify_leave + exit + close
+  wait_and_shutdown_server(timeout=10)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  reset_default_policy()
+  chaos.uninstall()
+  recorder.enable(None)
+  recorder.clear()
+  yield
+  chaos.uninstall()
+  recorder.clear()
+  recorder.disable()
+  reset_default_policy()
+
+
+def test_serve_roundtrip_matches_offline(serving_cluster):
+  _, client, engine, _ = serving_cluster
+  out = client.serve([5, 9])
+  ref = engine.offline_reference([5, 9])
+  np.testing.assert_array_equal(out['nodes'], ref.nodes)
+  np.testing.assert_array_equal(out['x'], ref.x)
+  assert 'logits' not in out         # model-less engine serves x
+
+
+def test_heartbeat_serving_block(serving_cluster):
+  _, client, _, frontend = serving_cluster
+  client.serve([3])
+  hb = client.heartbeat(0)
+  assert hb is not None and 'serving' in hb
+  s = hb['serving']
+  assert s['queue_depth'] == 0 and s['in_flight'] == 0
+  assert s['served_requests'] >= 1
+  assert s['compile_status']['buckets'] == \
+      {'1': True, '2': True, '4': True}
+  assert s['compile_status']['compiles'] == frontend.engine.compile_count()
+  assert 'shed' in s and s['max_queue'] == frontend.admission.max_queue
+
+
+def test_admission_rejection_travels_typed(serving_cluster):
+  """A server-side shed resurfaces client-side as AdmissionRejected
+  via the wire's structured error-kind field — callers can tell
+  overload from failure without message sniffing."""
+  _, client, _, _ = serving_cluster
+  with pytest.raises(AdmissionRejected):
+    client.serve(list(range(BUCKETS[-1] + 1)))   # past the top bucket
+
+
+def test_replay_cache_exactly_once_under_drop(serving_cluster):
+  """The PR 4 contract extended to serving RPCs: a connection dropped
+  after the send (server already executing) is retried under the SAME
+  request id and answered from the replay cache — the tier admits the
+  request ONCE, and the client still gets the full (byte-identical)
+  answer."""
+  _, client, engine, frontend = serving_cluster
+  admitted_before = frontend.admission.stats()['admitted']
+  chaos.install({'seed': 3, 'faults': [
+      {'site': 'rpc.request', 'action': 'drop', 'nth': 1,
+       'op': 'serve_infer'}]})
+  out = client.serve([7, 11])
+  assert chaos.active().exhausted(), 'the planned drop must fire'
+  retries = recorder.events('rpc.retry')
+  assert retries and retries[0]['op'] == 'serve_infer'
+  ref = engine.offline_reference([7, 11])
+  np.testing.assert_array_equal(out['nodes'], ref.nodes)
+  np.testing.assert_array_equal(out['x'], ref.x)
+  admitted_after = frontend.admission.stats()['admitted']
+  assert admitted_after - admitted_before == 1, \
+      'the retried request must NOT be admitted/executed twice'
+
+
+def test_server_side_drop_surfaces_typed_not_lost(serving_cluster):
+  """A serving.request 'drop' fault inside the handler: the client
+  gets a typed RPC error naming the injected fault — the request is
+  answered (with its failure), never lost or double-executed."""
+  from graphlearn_tpu.distributed.rpc import RpcError
+  _, client, _, frontend = serving_cluster
+  admitted_before = frontend.admission.stats()['admitted']
+  chaos.install('serving.request:drop:1:op=serve_infer')
+  with pytest.raises(RpcError) as ei:
+    client.serve([3])
+  assert 'InjectedFault' in str(ei.value) or \
+      getattr(ei.value, 'remote_kind', '') == 'InjectedFault'
+  assert frontend.admission.stats()['admitted'] == admitted_before
+  chaos.uninstall()
+  out = client.serve([3])            # the tier recovers
+  assert out['nodes'].shape[0] == 1
+
+
+def test_slow_dispatch_sheds_queued_request_typed(serving_cluster):
+  """SLO gating under a stuck executor: request A's dispatch stalls
+  (injected delay at the executor seam); request B, queued behind it
+  with a short deadline, expires in queue and comes back as a TYPED
+  AdmissionRejected — p99 is shed, not silently stretched."""
+  _, client, _, _ = serving_cluster
+  chaos.install('serving.request:delay:1:op=dispatch:secs=0.8')
+  errs = {}
+
+  def slow_rider():
+    try:
+      errs['a'] = client.serve([5], deadline_ms=5000)
+    except Exception as e:           # noqa: BLE001
+      errs['a'] = e
+
+  t = threading.Thread(target=slow_rider)
+  t.start()
+  time.sleep(0.3)                    # A is mid-dispatch (sleeping)
+  with pytest.raises(AdmissionRejected):
+    client.serve([9], deadline_ms=100)
+  t.join(10)
+  assert isinstance(errs['a'], dict), \
+      'the slow rider itself still completes (picked before deadline)'
+  assert any(e['reason'] == 'deadline'
+             for e in recorder.events('serving.shed'))
