@@ -751,11 +751,16 @@ def main():
   ds_t = DistDataset.from_full_graph(num_parts, rows, cols,
                                      node_feat=feats, node_label=labels,
                                      num_nodes=n, split_ratio=0.3)
+  # third row (r11): GNS-on vs GNS-off tiered comparison — the same
+  # cache + pipeline with the sampler-side bias added (GLT_GNS=1
+  # exercises the env-knob path the way a deployment would set it)
   for mode, env in (('static_split', {'GLT_COLD_CACHE_ROWS': '0',
                                       'GLT_COLD_PREFETCH': '0'}),
-                    ('cached_pipelined', {})):
+                    ('cached_pipelined', {}),
+                    ('gns_cached_pipelined', {'GLT_GNS': '1'})):
     saved = {k: os.environ.pop(k, None)
-             for k in ('GLT_COLD_CACHE_ROWS', 'GLT_COLD_PREFETCH')}
+             for k in ('GLT_COLD_CACHE_ROWS', 'GLT_COLD_PREFETCH',
+                       'GLT_GNS')}
     os.environ.update(env)
     try:
       lt = DistNeighborLoader(ds_t, [10, 5], seeds, batch_size=512,
@@ -773,6 +778,7 @@ def main():
       emit('dist_tiered_seeds_per_sec',
            nt * 512 * num_parts / t.dt / 1e3, 'K seeds/s',
            mode=mode, split_ratio=0.3, batch=512, num_parts=num_parts,
+           gns=bool(lt.sampler.gns),
            cold_cache_rows=(lt.sampler._cold_cache.capacity
                             if lt.sampler._cold_cache else 0),
            cold_lookups=st['dist.feature.cold_lookups'],

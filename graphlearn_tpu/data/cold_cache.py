@@ -80,17 +80,33 @@ def resolve_cache_rows(spec, cold_rows: int) -> int:
 class ClockShardCache:
   """CLOCK second-chance id→slot policy for ONE device shard.
 
-  Holds only host-side metadata (tags, reference bits, the hand); the
-  cached ROWS live in the owning cache's device array, addressed by
-  the slot indices this class assigns.  All operations are vectorized
-  over the batch's id arrays — no per-id python on the hot path.
+  Holds only host-side metadata (tags, reference bits, the hand, the
+  decayed visit-frequency sketch); the cached ROWS live in the owning
+  cache's device array, addressed by the slot indices this class
+  assigns.  All operations are vectorized over the batch's id arrays
+  — no per-id python on the hot path.
+
+  Admission ranking (r11): candidates are scored by the shard's
+  `ops.gns.DecayedSketch` — the batch's cold-id multiset folded into
+  an exponentially-decayed cross-batch visit count — instead of the
+  per-batch multiset alone.  An id the stream revisits every few
+  batches now outranks a one-batch burst, and the SAME sketch-selected
+  residents feed the GNS sampling bias (`ops.gns.cached_set_bits`),
+  so admission and sampling share one notion of "hot".  Cache
+  contents never change batch bytes (PR 5's byte-identity contract),
+  so the ranking change is invisible outside hit rates.
   """
 
   def __init__(self, capacity: int):
+    from ..ops.gns import DecayedSketch
     self.capacity = int(capacity)
     self.ids = np.full(self.capacity, -1, np.int64)
     self.ref = np.zeros(self.capacity, np.uint8)
     self.hand = 0
+    self.sketch = DecayedSketch()
+    #: bumped on every committed admission wave — consumers (the GNS
+    #: bitmask refresh) rebuild derived state only when this moved
+    self.version = 0
     self._sorted_ids = np.empty(0, np.int64)
     self._sorted_slots = np.empty(0, np.int32)
 
@@ -142,9 +158,12 @@ class ClockShardCache:
                       ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Assign ring slots to (unique, not-resident) candidate ids.
 
-    Candidates are ranked by multiset count (descending), so the ids
-    the batch touched most win slots first.  Free slots fill first;
-    the remainder comes from one batched CLOCK sweep: residents with a
+    The batch's cold-id multiset (``cand_counts``) is folded into the
+    shard's decayed visit-frequency sketch, and candidates are ranked
+    by SKETCH score (descending) — cross-batch reuse outranks a
+    one-batch burst; on a fresh sketch the ranking reduces exactly to
+    the old per-batch multiset order.  Free slots fill first; the
+    remainder comes from one batched CLOCK sweep: residents with a
     clear reference bit are victims in hand order, residents touched
     since the last sweep survive it (their bit is cleared — the
     second chance).  Returns ``(admitted_ids, slots, evicted)``; call
@@ -155,7 +174,8 @@ class ClockShardCache:
       return (np.empty(0, np.int64), np.empty(0, np.int32), 0)
     if cand_counts is None:
       cand_counts = np.ones(len(cand_ids), np.int64)
-    order = np.lexsort((cand_ids, -np.asarray(cand_counts)))
+    self.sketch.update(cand_ids, cand_counts)
+    order = np.lexsort((cand_ids, -self.sketch.score(cand_ids)))
     # bounded wave: empty slots may always fill, but EVICTING
     # admissions are capped at `ADMIT_WAVE_FRACTION` of the ring (see
     # the constant's rationale — full-ring churn earns no hits)
@@ -202,12 +222,18 @@ class ClockShardCache:
     if len(ids):
       self.ids[slots] = ids
       self.ref[slots] = 0
+      self.version += 1
     self._rebuild()
 
-  # -- DataPlaneState (utils.checkpoint): the policy rings ----------------
+  def resident_ids(self) -> np.ndarray:
+    """The current residents (sorted) — the dynamic half of the GNS
+    cached set (`ops.gns.cached_set_bits`)."""
+    return self._sorted_ids
+
+  # -- DataPlaneState (utils.checkpoint): rings + the visit sketch --------
   def state_dict(self) -> dict:
     return {'ids': self.ids.copy(), 'ref': self.ref.copy(),
-            'hand': self.hand}
+            'hand': self.hand, 'sketch': self.sketch.state_dict()}
 
   def load_state_dict(self, state: dict) -> None:
     ids = np.asarray(state['ids'], np.int64)
@@ -219,6 +245,11 @@ class ClockShardCache:
     self.ids = ids
     self.ref = np.asarray(state['ref'], np.uint8).copy()
     self.hand = int(np.asarray(state['hand']))
+    if 'sketch' in state:
+      # pre-r11 snapshots carry no sketch: residency restores, the
+      # learned visit frequencies restart cold (documented fallback)
+      self.sketch.load_state_dict(state['sketch'])
+    self.version += 1
     self._rebuild()
 
 
@@ -405,6 +436,20 @@ class MeshColdCache:
   @property
   def enabled(self) -> bool:
     return self.capacity > 0
+
+  @property
+  def version(self) -> int:
+    """Sum of the shard ring versions — moved iff any shard's
+    residency changed (the GNS bitmask refresh trigger)."""
+    return sum(sh.version for sh in self.shards)
+
+  def resident_ids(self) -> np.ndarray:
+    """Union of every local shard's residents (global ids) — the
+    dynamic half of the GNS cached set."""
+    if not self.shards:
+      return np.empty(0, np.int64)
+    return np.unique(np.concatenate(
+        [sh.resident_ids() for sh in self.shards]))
 
   def lookup(self, ids_l: np.ndarray, active: np.ndarray
              ) -> Tuple[np.ndarray, np.ndarray]:

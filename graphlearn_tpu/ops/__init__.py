@@ -2,6 +2,8 @@
 reference's C++/CUDA kernel layer (`graphlearn_torch/csrc/`)."""
 from .neighbor import (OneHopResult, cal_nbr_prob, default_window,
                        lookup_degree, sample_one_hop)
+from .gns import (DecayedSketch, bitmask_lookup, cached_set_bits,
+                  gns_enabled, sample_one_hop_gns)
 from .negative import NegativeSampleResult, edge_in_csr, sample_negative
 from .pallas_gather import gather_rows, pallas_enabled
 from .random_walk import node2vec_walk, random_walk, walk_edges

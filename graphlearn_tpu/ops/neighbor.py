@@ -41,10 +41,16 @@ class OneHopResult(NamedTuple):
     nbrs: ``[B, k]`` global neighbor ids (INVALID_ID where masked).
     mask: ``[B, k]`` slot validity (slot < min(deg, k)).
     eids: ``[B, k]`` global edge ids (INVALID_ID where masked) or None.
+    weights: ``[B, k]`` per-edge importance weights (``p/q``
+      inclusion-probability correction), or None.  Only the biased
+      GNS kernel (`ops.gns.sample_one_hop_gns`) sets this; the
+      uniform kernel's draws are already unbiased for the neighbor
+      mean, so it leaves the field None and no consumer pays for it.
   """
   nbrs: jax.Array
   mask: jax.Array
   eids: Optional[jax.Array]
+  weights: Optional[jax.Array] = None
 
 
 def default_window(k: int) -> int:

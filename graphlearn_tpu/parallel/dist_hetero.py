@@ -563,7 +563,7 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
           fr_nodes, fr_local = frontiers[s]
           indptr, indices, eids = graphs[et]
           hop_key = jax.random.fold_in(jax.random.fold_in(key, h), ei_i)
-          nbrs, mask, e, hstats = _dist_one_hop(
+          nbrs, mask, e, _w, hstats = _dist_one_hop(
               indptr, indices, eids if with_edge else None, bounds[s],
               fr_nodes, int(k), hop_key, axis, num_parts, with_edge,
               exchange_capacity=_slack_cap(fr_nodes.shape[0], num_parts,

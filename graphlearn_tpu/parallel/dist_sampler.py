@@ -204,14 +204,20 @@ def dist_sample_negative(indptr_loc, indices_loc, bounds,
 def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
                   k: int, key, axis: str, num_parts: int,
                   with_edge: bool, sort_locality: bool = True,
-                  exchange_capacity: Optional[int] = None):
+                  exchange_capacity: Optional[int] = None,
+                  gns_bits=None, gns_boost: Optional[float] = None):
   """One distributed hop for this device's ``frontier`` ids.
 
   ``exchange_capacity`` caps the per-destination exchange width
   (default: the full frontier — ~P x padding with balanced buckets);
   overflowed frontier entries sample nothing this hop (masked).
-  Returns ``(nbrs, mask, eids, stats)`` — ``stats`` is the
-  (offered, dropped, slots) telemetry triple.
+  ``gns_bits`` (+ static ``gns_boost``) switches the owner-side
+  kernel to cache-aware GNS sampling (`ops.gns.sample_one_hop_gns`):
+  cached neighbors draw with boosted probability and per-edge
+  importance weights ride the reply collective next to the ids.
+  Returns ``(nbrs, mask, eids, weights, stats)`` — ``weights`` is
+  None without GNS; ``stats`` is the (offered, dropped, slots)
+  telemetry triple.
   """
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
@@ -221,14 +227,24 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
                        exchange_capacity)
   flat = plan.recv
   local = jnp.where(flat >= 0, flat - my_start, INVALID_ID).astype(jnp.int32)
-  res = sample_one_hop(indptr_loc, indices_loc, local, k,
-                       jax.random.fold_in(key, my_idx), eids_loc,
-                       with_edge_ids=with_edge,
-                       sort_locality=sort_locality)
+  if gns_bits is not None:
+    from ..ops.gns import sample_one_hop_gns
+    res = sample_one_hop_gns(indptr_loc, indices_loc, local, k,
+                             jax.random.fold_in(key, my_idx), gns_bits,
+                             float(gns_boost), eids_loc,
+                             with_edge_ids=with_edge,
+                             sort_locality=sort_locality)
+  else:
+    res = sample_one_hop(indptr_loc, indices_loc, local, k,
+                         jax.random.fold_in(key, my_idx), eids_loc,
+                         with_edge_ids=with_edge,
+                         sort_locality=sort_locality)
   out_nbrs = plan.reply(res.nbrs, fill=INVALID_ID)
   out_mask = plan.reply(res.mask, fill=False)
   out_eids = plan.reply(res.eids, fill=INVALID_ID) if with_edge else None
-  return out_nbrs, out_mask, out_eids, plan.stats
+  out_w = (plan.reply(res.weights, fill=0.0)
+           if res.weights is not None else None)
+  return out_nbrs, out_mask, out_eids, out_w, plan.stats
 
 
 def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
@@ -531,13 +547,16 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
                         exchange_layout=None,
                         collect_edge_features=False, efshard=None,
                         ebounds=None, ef_shard_mode='mod',
-                        hot_counts=None):
+                        hot_counts=None, gns_bits=None,
+                        gns_boost=None):
   """Per-device multihop expansion + feature/label collection — the
   shared body of the node and link SPMD steps.  When
   ``collect_edge_features`` is set, every sampled edge's feature row is
   gathered by GLOBAL edge id through the same exchange machinery (the
   collective analog of the reference's efeats collation,
-  `distributed/dist_neighbor_sampler.py:600-673`)."""
+  `distributed/dist_neighbor_sampler.py:600-673`).  With ``gns_bits``
+  set the hops sample cache-aware (GNS) and the per-edge importance
+  weights come back aligned with the ``row``/``col`` edge list."""
   b = seeds.shape[0]
   state, seed_local = init_node(seeds, node_cap)
   f_cap = b
@@ -547,17 +566,18 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
       fr_valid, state.nodes[jnp.clip(slots, 0, node_cap - 1)], INVALID_ID)
   frontier_local = jnp.where(fr_valid, slots, -1)
 
-  rows_acc, cols_acc, eids_acc = [], [], []
+  rows_acc, cols_acc, eids_acc, ew_acc = [], [], [], []
   hop_counts = [state.count]
   fr_stats = jnp.zeros((3,), jnp.int32)
   ft_stats = jnp.zeros((3,), jnp.int32)
   for h, k in enumerate(fanouts):
     hop_key = jax.random.fold_in(key, h)
-    nbrs, mask, e, hstats = _dist_one_hop(
+    nbrs, mask, e, hw, hstats = _dist_one_hop(
         indptr, indices, eids, bounds, frontier, int(k), hop_key,
         axis, num_parts, with_edge,
         exchange_capacity=_slack_cap(frontier.shape[0], num_parts,
-                                     exchange_slack, exchange_layout))
+                                     exchange_slack, exchange_layout),
+        gns_bits=gns_bits, gns_boost=gns_boost)
     fr_stats = fr_stats + jnp.stack(hstats)
     state, rows, cols, prev_cnt = induce_next(
         state, frontier_local, nbrs, mask)
@@ -565,6 +585,10 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
     cols_acc.append(cols)
     if with_edge:
       eids_acc.append(jnp.where(rows >= 0, e.reshape(-1), INVALID_ID))
+    if gns_bits is not None:
+      # induce_next flattens [F, k] row-major, so the weight layout
+      # matches the edge list's; masked/dropped edges carry 0
+      ew_acc.append(jnp.where(rows >= 0, hw.reshape(-1), 0.0))
     hop_counts.append(state.count)
     f_cap = f_cap * int(k)
     slots = prev_cnt + jnp.arange(f_cap, dtype=jnp.int32)
@@ -577,6 +601,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
   row = jnp.concatenate(rows_acc)
   col = jnp.concatenate(cols_acc)
   edge = jnp.concatenate(eids_acc) if with_edge else None
+  ew = jnp.concatenate(ew_acc) if gns_bits is not None else None
   x = y = ef = None
   if collect_edge_features and edge is not None:
     (ef,), estats = dist_gather_multi(
@@ -607,7 +632,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
   cum = jnp.stack(hop_counts)
   nsn = jnp.concatenate([cum[:1], cum[1:] - cum[:-1]]).astype(jnp.int32)
   stats = jnp.concatenate([fr_stats, ft_stats, jnp.zeros((1,), jnp.int32)])
-  return state, row, col, edge, seed_local, x, y, ef, nsn, stats
+  return state, row, col, edge, seed_local, x, y, ef, nsn, stats, ew
 
 
 def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
@@ -617,7 +642,8 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     exchange_slack: Optional[float] = None,
                     exchange_layout: Optional[str] = None,
                     collect_edge_features: bool = False,
-                    ef_shard_mode: str = 'mod', tiered: bool = False):
+                    ef_shard_mode: str = 'mod', tiered: bool = False,
+                    gns_boost: Optional[float] = None):
   """Build the jitted SPMD sample(+collect) step.
 
   ``exchange_slack``: per-destination exchange capacity as a multiple
@@ -625,14 +651,22 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
   frontier width, ~P x padding).  See `bucket_by_owner`.
   ``tiered``: the feature table is HBM-partial — owners zero rows past
   their hot count (``hcounts``) and the caller overlays the cold tier.
+  ``gns_boost``: non-None builds the GNS variant — the step takes a
+  replicated cached-set bitmask (``gns_bits``) before ``key`` and
+  returns the per-edge importance weights as a 12th output; None
+  builds EXACTLY the unbiased step (same signature, same program —
+  the ``GLT_GNS=0`` byte-identity contract).
   """
   from .shard_map_compat import shard_map
+  gns = gns_boost is not None
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
                  lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts,
-                 key):
-    (state, row, col, edge, seed_local, x, y, ef, nsn,
-     stats) = _expand_and_collect(
+                 *rest):
+    gns_bits = rest[0] if gns else None
+    key = rest[-1]
+    (state, row, col, edge, seed_local, x, y, ef, nsn, stats,
+     ew) = _expand_and_collect(
         indptr_s[0], indices_s[0], eids_s[0] if with_edge else None,
         bounds, seeds_s[0], key,
         fanouts=fanouts, node_cap=node_cap, with_edge=with_edge,
@@ -647,26 +681,30 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
         collect_edge_features=collect_edge_features,
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
-        hot_counts=hcounts if tiered else None)
+        hot_counts=hcounts if tiered else None,
+        gns_bits=gns_bits, gns_boost=gns_boost)
 
     def lead(v):   # re-add the shard axis for stacked outputs
       return None if v is None else v[None]
-    return (lead(state.nodes), lead(state.count[None]), lead(row),
-            lead(col), lead(edge), lead(seed_local), lead(x), lead(y),
-            lead(ef), lead(nsn), lead(stats))
+    out = (lead(state.nodes), lead(state.count[None]), lead(row),
+           lead(col), lead(edge), lead(seed_local), lead(x), lead(y),
+           lead(ef), lead(nsn), lead(stats))
+    return out + (lead(ew),) if gns else out
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P(axis), P(axis), P(axis), P(), P(), P())
-  specs_out = tuple(P(axis) for _ in range(11))
+              P(axis), P(axis), P(axis), P(), P()) \
+      + ((P(),) if gns else ()) + (P(),)
+  specs_out = tuple(P(axis) for _ in range(12 if gns else 11))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
-           lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts, key):
+           lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts,
+           *rest):
     return sharded(indptr_s, indices_s, eids_s, bounds, seeds_s,
                    fshard_s, lshard_s, cids_s, crows_s, efshard_s,
-                   ebounds, hcounts, key)
+                   ebounds, hcounts, *rest)
 
   return step
 
@@ -683,7 +721,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                          exchange_layout: Optional[str] = None,
                          collect_edge_features: bool = False,
                          ef_shard_mode: str = 'mod',
-                         tiered: bool = False):
+                         tiered: bool = False,
+                         gns_boost: Optional[float] = None):
   """Build the jitted SPMD LINK sample step: per-device seed edges +
   collective strict negatives + the shared expansion body.
 
@@ -691,13 +730,18 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
   (`distributed/dist_neighbor_sampler.py:327-453`) — with the key
   difference that negatives are strict against the GLOBAL sharded
   graph (one `dist_edge_exists` exchange), where the reference settles
-  for local-partition rejection.
+  for local-partition rejection.  ``gns_boost``: as `_make_dist_step`
+  (non-None adds the bitmask input + the edge-weight output; the
+  negative draws stay uniform — only the endpoint EXPANSION biases).
   """
   from .shard_map_compat import shard_map
+  gns = gns_boost is not None
 
   def per_device(indptr_s, indices_s, eids_s, bounds, pairs_s, fshard_s,
                  lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts,
-                 key):
+                 *rest):
+    gns_bits = rest[0] if gns else None
+    key = rest[-1]
     indptr = indptr_s[0]
     indices = indices_s[0]
     pairs = pairs_s[0]                       # [B, 2|3]
@@ -724,8 +768,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
       seeds = jnp.concatenate([src, dst])
     seeds = jnp.where(seeds >= 0, seeds, INVALID_ID).astype(jnp.int32)
 
-    (state, row, col, edge, seed_local, x, y, ef, nsn,
-     stats) = _expand_and_collect(
+    (state, row, col, edge, seed_local, x, y, ef, nsn, stats,
+     ew) = _expand_and_collect(
         indptr, indices, eids_s[0] if with_edge else None, bounds,
         seeds, key,
         fanouts=fanouts, node_cap=node_cap, with_edge=with_edge,
@@ -740,7 +784,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
         collect_edge_features=collect_edge_features,
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
-        hot_counts=hcounts if tiered else None)
+        hot_counts=hcounts if tiered else None,
+        gns_bits=gns_bits, gns_boost=gns_boost)
 
     b = batch
     sl = seed_local
@@ -779,23 +824,27 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
 
     def lead(v):
       return None if v is None else v[None]
-    return ((lead(state.nodes), lead(state.count[None]), lead(row),
-             lead(col), lead(edge), lead(seed_local), lead(x), lead(y),
-             lead(ef), lead(nsn), lead(stats))
-            + tuple(lead(m) for m in md))
+    out = ((lead(state.nodes), lead(state.count[None]), lead(row),
+            lead(col), lead(edge), lead(seed_local), lead(x), lead(y),
+            lead(ef), lead(nsn), lead(stats))
+           + ((lead(ew),) if gns else ())
+           + tuple(lead(m) for m in md))
+    return out
 
   specs_in = (P(axis), P(axis), P(axis), P(), P(axis), P(axis), P(axis),
-              P(axis), P(axis), P(axis), P(), P(), P())
-  specs_out = tuple(P(axis) for _ in range(17))
+              P(axis), P(axis), P(axis), P(), P()) \
+      + ((P(),) if gns else ()) + (P(),)
+  specs_out = tuple(P(axis) for _ in range(18 if gns else 17))
   sharded = shard_map(per_device, mesh=mesh, in_specs=specs_in,
                       out_specs=specs_out)
 
   @jax.jit
   def step(indptr_s, indices_s, eids_s, bounds, pairs_s, fshard_s,
-           lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts, key):
+           lshard_s, cids_s, crows_s, efshard_s, ebounds, hcounts,
+           *rest):
     return sharded(indptr_s, indices_s, eids_s, bounds, pairs_s,
                    fshard_s, lshard_s, cids_s, crows_s, efshard_s,
-                   ebounds, hcounts, key)
+                   ebounds, hcounts, *rest)
 
   return step
 
@@ -842,8 +891,8 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
 
   def per_device(indptr_s, indices_s, eids_s, bounds, seeds_s, fshard_s,
                  lshard_s, cids_s, crows_s, hcounts, key):
-    (state, _row, _col, _edge, seed_local, x, y, _ef, nsn,
-     stats) = _expand_and_collect(
+    (state, _row, _col, _edge, seed_local, x, y, _ef, nsn, stats,
+     _ew) = _expand_and_collect(
         indptr_s[0], indices_s[0], None, bounds, seeds_s[0], key,
         fanouts=fanouts, node_cap=node_cap, with_edge=False,
         collect_features=collect_features, collect_labels=collect_labels,
@@ -864,7 +913,7 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
     for ci in range(n_chunks):
       frontier_c = jax.lax.dynamic_slice_in_dim(nodes_pad, ci * chunk,
                                                 chunk)
-      nb, mk, ei, hstats = _dist_one_hop(
+      nb, mk, ei, _w, hstats = _dist_one_hop(
           indptr_s[0], indices_s[0], eids_s[0] if with_edge else None,
           bounds, frontier_c, max_degree,
           # per-chunk fold: with a truncating max_degree the window
@@ -1148,7 +1197,7 @@ class DistNeighborSampler(ExchangeTelemetry):
                with_edge: bool = False, collect_features: bool = True,
                seed: int = 0, exchange_slack: Optional[float] = None,
                exchange_layout: Optional[str] = None,
-               cold_cache_rows='auto'):
+               cold_cache_rows='auto', gns=None):
     from .dp import make_mesh
     self.ds = dataset
     self.fanouts = tuple(int(k) for k in num_neighbors)
@@ -1180,6 +1229,19 @@ class DistNeighborSampler(ExchangeTelemetry):
     self._cold_cache_spec = cold_cache_rows
     self._cold_cache = None
     self._cold_cache_built = False
+    # cache-aware Global Neighbor Sampling (ops.gns, r11): bias
+    # neighbor selection toward the device-servable set (hot split ∪
+    # cold-cache residents) with a 1/q unbiasedness correction.  Only
+    # meaningful on tiered feature stores (a fully-HBM table has no
+    # cold tier to steer away from); `GLT_GNS=1` / gns=True enables,
+    # off is byte-identical to the unbiased sampler.
+    from ..ops.gns import gns_enabled, resolve_boost
+    self.gns = bool(gns_enabled(gns) and self.tiered
+                    and self.collect_features)
+    self.gns_boost = resolve_boost() if self.gns else None
+    self._gns_bits = None
+    self._gns_hot_bits = None
+    self._gns_ver = -1
     # SURVEY §7 "partition-aware capacity tuning": e.g. 2.0 sends
     # 2x the balanced share per destination instead of the full
     # frontier (P/2 x fewer exchanged bytes); overflowed ids lose
@@ -1276,7 +1338,13 @@ class DistNeighborSampler(ExchangeTelemetry):
             exchange_slack=self.exchange_slack,
             exchange_layout=self.exchange_layout,
             collect_edge_features=self.collect_edge_features,
-            ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
+            ef_shard_mode=self._ef_shard_mode, tiered=self.tiered,
+            gns_boost=self.gns_boost)
+      if self.gns:
+        from ..telemetry.recorder import recorder
+        recorder.emit('gns.bias', batch=int(batch_size),
+                      boost=float(self.gns_boost),
+                      num_parts=self.num_parts)
     return self._steps[cfg]
 
   def _layout_span(self, **fields):
@@ -1319,20 +1387,25 @@ class DistNeighborSampler(ExchangeTelemetry):
       seeds_dev = jax.device_put(
           np.asarray(seeds_stacked, dtype=np.int32),
           NamedSharding(self.mesh, P(self.axis)))
+      extra = (self._gns_arrays(),) if self.gns else ()
+      outs = step(arrs['indptr'], arrs['indices'], arrs['eids'],
+                  arrs['bounds'], seeds_dev, arrs['fshards'],
+                  arrs['lshards'], arrs['cids'], arrs['crows'],
+                  arrs['efshards'], arrs['ebounds'],
+                  arrs['hcounts'], *extra, key)
       (nodes, count, row, col, edge, seed_local, x, y, ef, nsn,
-       stats) = \
-          step(arrs['indptr'], arrs['indices'], arrs['eids'],
-               arrs['bounds'], seeds_dev, arrs['fshards'],
-               arrs['lshards'], arrs['cids'], arrs['crows'],
-               arrs['efshards'], arrs['ebounds'],
-               arrs['hcounts'], key)
+       stats) = outs[:11]
+      ew = outs[11] if self.gns else None
     # outside the span: the every-64th-call drain blocks on the
     # device, and that sync must not masquerade as dispatch latency
     self._accumulate_stats(stats)
-    return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
-                edge=edge, seed_local=seed_local, x=x, y=y, ef=ef,
-                num_sampled_nodes=nsn, batch=seeds_dev,
-                overlay_step=self._step_cnt)
+    out = dict(node=nodes, node_count=count[..., 0], row=row, col=col,
+               edge=edge, seed_local=seed_local, x=x, y=y, ef=ef,
+               num_sampled_nodes=nsn, batch=seeds_dev,
+               overlay_step=self._step_cnt)
+    if ew is not None:
+      out['edge_weight'] = ew
+    return out
 
   def _finish_nodes(self, out: dict) -> dict:
     """The host half of a dispatched step: the cold-tier overlay
@@ -1384,6 +1457,42 @@ class DistNeighborSampler(ExchangeTelemetry):
           cap, nf.shards.shape[-1], nf.shards.dtype, num_local,
           self.mesh, self.axis, putS)
     return self._cold_cache
+
+  def _gns_arrays(self) -> jax.Array:
+    """The replicated cached-set bitmask (`ops.gns.cached_set_bits`)
+    for the GNS step's ``gns_bits`` input, rebuilt ONLY when the cold
+    cache's residency actually changed (its version counter) — the
+    refresh is one N/8-byte host build + replicated transfer, paid
+    per admission wave, never per step.
+
+    Staleness is harmless by construction: the importance weights
+    correct ANY membership mask exactly, so a mask lagging one batch
+    behind the ring costs a little bias-placement efficiency, zero
+    estimator bias (`ops.gns` module docstring).
+    """
+    cache = self._ensure_cold_cache()
+    ver = cache.version if cache is not None else 0
+    if self._gns_bits is None or ver != self._gns_ver:
+      from ..ops.gns import cached_set_bits, set_resident_bits
+      if self._gns_hot_bits is None:
+        # the static half, packed once: refreshes pay O(bytes) copy
+        # + O(residents), not the O(num_nodes) bool rebuild
+        self._gns_hot_bits = cached_set_bits(
+            self.ds.graph.num_nodes, self.ds.graph.bounds,
+            self.ds.node_features.hot_counts, np.empty(0, np.int64))
+      residents = (cache.resident_ids() if cache is not None
+                   else np.empty(0, np.int64))
+      bits = set_resident_bits(self._gns_hot_bits, residents,
+                               self.ds.graph.num_nodes)
+      self._gns_bits = jax.device_put(
+          bits, NamedSharding(self.mesh, P()))
+      self._gns_ver = ver
+      from ..telemetry.recorder import recorder
+      if recorder.enabled:
+        recorder.emit('gns.sketch_update', scope='dist',
+                      residents=int(len(residents)), version=int(ver),
+                      mask_bytes=int(bits.nbytes))
+    return self._gns_bits
 
   def _overlay_cold_traced(self, x, nodes):
     """The overlay body, under `_maybe_overlay_cold`'s span — the
@@ -1744,7 +1853,7 @@ def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
     path = [cur]
     stats = jnp.zeros((3,), jnp.int32)
     for h in range(walk_length):
-      nbrs, mask, _, hstats = _dist_one_hop(
+      nbrs, mask, _, _w, hstats = _dist_one_hop(
           indptr_s[0], indices_s[0], None, bounds, cur, 1,
           jax.random.fold_in(key, h), axis, num_parts, False,
           exchange_capacity=_slack_cap(cur.shape[0], num_parts,
@@ -1810,6 +1919,12 @@ class DistSubGraphSampler(DistNeighborSampler):
                max_degree: Optional[int] = None,
                hop_chunk='auto', **kwargs):
     super().__init__(dataset, num_neighbors, **kwargs)
+    # induced subgraphs are EXACT by contract (a biased closure
+    # corrupts SEAL/DRNL labels the way a capacity drop would), so a
+    # global GLT_GNS=1 must not flip this sampler's flag: the step
+    # never biases, and the flag must not report otherwise
+    self.gns = False
+    self.gns_boost = None
     if max_degree is None:
       g = dataset.graph
       max_degree = int(np.diff(g.indptr, axis=1).max())
@@ -2074,7 +2189,7 @@ class DistNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
                seed: int = 0, input_space: str = 'old',
                exchange_slack='auto',
                exchange_layout: Optional[str] = None,
-               prefetch: int = 0, cold_cache_rows='auto'):
+               prefetch: int = 0, cold_cache_rows='auto', gns=None):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
     slack = resolve_exchange_slack(exchange_slack, shuffle)
@@ -2084,7 +2199,7 @@ class DistNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
                         else slack),
         exchange_layout=exchange_layout,
-        cold_cache_rows=cold_cache_rows)
+        cold_cache_rows=cold_cache_rows, gns=gns)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0
@@ -2164,6 +2279,11 @@ class DistNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
       with span('stitch'):
         edge_index = jnp.stack([out['row'], out['col']],
                                axis=1)             # [P, 2, E]
+        md = {'seed_local': out['seed_local']}
+        if 'edge_weight' in out:
+          # GNS importance weights, aligned with the [P, E] edge list
+          # — consumers weight aggregation by them to stay unbiased
+          md['edge_weight'] = out['edge_weight']
         batch = Batch(
             x=out['x'], y=out['y'], edge_index=edge_index,
             edge_attr=out['ef'],
@@ -2171,7 +2291,7 @@ class DistNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
             edge_mask=out['row'] >= 0, edge=out['edge'],
             batch=out['batch'], batch_size=self.batch_size,
             num_sampled_nodes=out['num_sampled_nodes'],
-            metadata={'seed_local': out['seed_local']})
+            metadata=md)
       self._consumed = getattr(self, '_consumed', 0) + 1
       return batch
 
@@ -2286,7 +2406,13 @@ class DistLinkNeighborSampler(DistNeighborSampler):
             exchange_slack=self.exchange_slack,
             exchange_layout=self.exchange_layout,
             collect_edge_features=self.collect_edge_features,
-            ef_shard_mode=self._ef_shard_mode, tiered=self.tiered)
+            ef_shard_mode=self._ef_shard_mode, tiered=self.tiered,
+            gns_boost=self.gns_boost)
+      if self.gns:
+        from ..telemetry.recorder import recorder
+        recorder.emit('gns.bias', batch=b, mode='link',
+                      boost=float(self.gns_boost),
+                      num_parts=self.num_parts)
     return self._steps[cfg]
 
   def sample_from_edges(self, pairs_stacked: np.ndarray, key=None):
@@ -2309,16 +2435,22 @@ class DistLinkNeighborSampler(DistNeighborSampler):
       pairs_dev = jax.device_put(
           np.asarray(pairs_stacked, dtype=np.int32),
           NamedSharding(self.mesh, P(self.axis)))
-      (nodes, count, row, col, edge, seed_local, x, y, ef, nsn, stats,
-       eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
-          step(arrs['indptr'], arrs['indices'], arrs['eids'],
-               arrs['bounds'], pairs_dev, arrs['fshards'],
-               arrs['lshards'], arrs['cids'], arrs['crows'],
-               arrs['efshards'], arrs['ebounds'],
-               arrs['hcounts'], key)
+      extra = (self._gns_arrays(),) if self.gns else ()
+      outs = step(arrs['indptr'], arrs['indices'], arrs['eids'],
+                  arrs['bounds'], pairs_dev, arrs['fshards'],
+                  arrs['lshards'], arrs['cids'], arrs['crows'],
+                  arrs['efshards'], arrs['ebounds'],
+                  arrs['hcounts'], *extra, key)
+      (nodes, count, row, col, edge, seed_local, x, y, ef, nsn,
+       stats) = outs[:11]
+      ew = outs[11] if self.gns else None
+      (eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
+          outs[12:] if self.gns else outs[11:]
     self._accumulate_stats(stats)
     md = link_step_metadata(self.neg_mode, seed_local, eli, elab,
                             elab_mask, src_idx, dst_pos, dst_neg)
+    if ew is not None:
+      md['edge_weight'] = ew
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                 edge=edge, x=x, y=y, ef=ef, num_sampled_nodes=nsn,
                 batch=pairs_dev[:, :, 0], metadata=md,
@@ -2354,7 +2486,7 @@ class DistLinkNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
                seed: int = 0, input_space: str = 'old',
                exchange_slack='auto',
                exchange_layout: Optional[str] = None,
-               prefetch: int = 0, cold_cache_rows='auto'):
+               prefetch: int = 0, cold_cache_rows='auto', gns=None):
     from ..loader.node_loader import SeedBatcher
     self.prefetch = int(prefetch)
     slack = resolve_exchange_slack(exchange_slack, shuffle)
@@ -2365,7 +2497,7 @@ class DistLinkNeighborLoader(_ResumableEpochMixin, PrefetchingLoader):
         exchange_slack=(DEFAULT_EXCHANGE_SLACK if slack == 'adaptive'
                         else slack),
         exchange_layout=exchange_layout,
-        cold_cache_rows=cold_cache_rows)
+        cold_cache_rows=cold_cache_rows, gns=gns)
     self._adaptive = (AdaptiveSlack(self.sampler)
                       if slack == 'adaptive' else None)
     self._epoch_count = 0

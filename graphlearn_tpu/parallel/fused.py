@@ -81,6 +81,17 @@ class _MeshEpochDriver(_SnapshotHooks):
   #: collect → cold-service → consume path (module docstring)
   _tiered = False
 
+  def _chunk_arrs(self) -> dict:
+    """The sampler's device arrays, plus — under cache-aware GNS —
+    the freshly refreshed cached-set bitmask.  Called per dispatch so
+    a chunk's sampling bias sees the admissions the previous chunk's
+    cold service made (`ops.gns`: staleness costs placement, never
+    estimator bias)."""
+    arrs = self.sampler._arrays()
+    if getattr(self.sampler, 'gns', False):
+      arrs = dict(arrs, gns=self.sampler._gns_arrays())
+    return arrs
+
   # -- snapshot hooks (mesh-shaped overrides of _SnapshotHooks) -----------
   def data_plane_state(self) -> dict:
     return {'epoch_idx': self._epoch_idx,
@@ -163,7 +174,7 @@ class _MeshEpochDriver(_SnapshotHooks):
                 chaos.fused_dispatch_check(chunk=0,
                                            epoch=self._epoch_idx)
                 return self._compiled(state, self._put_batches(seeds),
-                                      key, self.sampler._arrays())
+                                      key, self._chunk_arrs())
               (state, losses, correct, valid, stats,
                hops) = run_with_deadline(_epoch_dispatch,
                                          scope='fused.dispatch')
@@ -282,7 +293,7 @@ class _MeshEpochDriver(_SnapshotHooks):
                                    epoch=self._epoch_idx,
                                    phase='collect')
         return self._compiled_collect(self._put_batches(part), keys,
-                                      self.sampler._arrays())
+                                      self._chunk_arrs())
       data, stats = run_with_deadline(_collect, scope='fused.dispatch')
     # stats sliced to the real steps: padded tail steps still carry
     # static exchange SLOTS, which would inflate padding waste
@@ -370,7 +381,7 @@ class _MeshEpochDriver(_SnapshotHooks):
       return self._evaluate_tiered(params, seeds)
     correct, total, stats = self._compiled_eval(
         params, self._put_batches(seeds), self._eval_key(),
-        self.sampler._arrays())
+        self._chunk_arrs())
     self.sampler._accumulate_stats(stats)
     return float(int(correct) / max(int(total), 1))
 
@@ -381,7 +392,7 @@ class _MeshEpochDriver(_SnapshotHooks):
     correct = total = 0
     for c0, real, part, keys in self._tiered_chunks(seeds, key, chunk):
       data, stats = self._compiled_collect(
-          self._put_batches(part), keys, self.sampler._arrays())
+          self._put_batches(part), keys, self._chunk_arrs())
       self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
       data = self._overlay_chunk(data)
       c, t = self._compiled_eval_consume(params, data)
@@ -435,7 +446,7 @@ class FusedDistEpoch(_MeshEpochDriver):
                input_space: str = 'old',
                exchange_slack='auto', exchange_layout=None,
                remat: bool = False,
-               fast_compile: bool = False):
+               fast_compile: bool = False, gns=None):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None or dataset.node_labels is None:
       raise ValueError('FusedDistEpoch needs node features and labels')
@@ -449,7 +460,7 @@ class FusedDistEpoch(_MeshEpochDriver):
     self.sampler = DistNeighborSampler(
         dataset, num_neighbors, mesh=mesh, axis=axis,
         collect_features=True, seed=seed, exchange_slack=slack,
-        exchange_layout=exchange_layout)
+        exchange_layout=exchange_layout, gns=gns)
     self.ds = dataset
     self.mesh = self.sampler.mesh
     self.axis = axis
@@ -499,20 +510,27 @@ class FusedDistEpoch(_MeshEpochDriver):
   def _collate(self, seeds: jax.Array, key_i: jax.Array, arrs: dict):
     """One fused distributed sample+collect: shared front half of the
     train and eval scan bodies (the same program `DistNeighborSampler`
-    dispatches per batch)."""
+    dispatches per batch).  Under GNS (``'gns'`` in ``arrs``) the step
+    takes the cached-set bitmask and the per-edge importance weights
+    land in the batch metadata."""
     from ..loader.transform import Batch
+    extra = (arrs['gns'],) if 'gns' in arrs else ()
+    outs = self._dist_step(
+        arrs['indptr'], arrs['indices'], arrs['eids'], arrs['bounds'],
+        seeds, arrs['fshards'], arrs['lshards'], arrs['cids'],
+        arrs['crows'], arrs['efshards'], arrs['ebounds'],
+        arrs['hcounts'], *extra, key_i)
     (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn,
-     stats) = self._dist_step(
-         arrs['indptr'], arrs['indices'], arrs['eids'], arrs['bounds'],
-         seeds, arrs['fshards'], arrs['lshards'], arrs['cids'],
-         arrs['crows'], arrs['efshards'], arrs['ebounds'],
-         arrs['hcounts'], key_i)
+     stats) = outs[:11]
+    md = {'seed_local': seed_local}
+    if 'gns' in arrs:
+      md['edge_weight'] = outs[11]
     batch = Batch(
         x=x, y=y, edge_index=jnp.stack([row, col], axis=1),
         edge_attr=ef, node=nodes, node_mask=nodes >= 0,
         edge_mask=row >= 0, edge=edge, batch=seeds,
         batch_size=self.batch_size,
-        num_sampled_nodes=nsn, metadata={'seed_local': seed_local})
+        num_sampled_nodes=nsn, metadata=md)
     return batch, stats
 
   def _epoch_fn(self, state: TrainState, seeds_all: jax.Array,
@@ -648,7 +666,8 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
                drop_last: bool = False, seed: int = 0,
                input_space: str = 'old', exchange_slack='auto',
                exchange_layout=None,
-               remat: bool = False, fast_compile: bool = False):
+               remat: bool = False, fast_compile: bool = False,
+               gns=None):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None or dataset.node_labels is None:
       raise ValueError('FusedDistTreeEpoch needs node features and '
@@ -669,7 +688,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         dataset, [], mesh=mesh, axis=axis, collect_features=True,
         seed=seed,
         exchange_slack=resolve_exchange_slack(exchange_slack, shuffle),
-        exchange_layout=exchange_layout)
+        exchange_layout=exchange_layout, gns=gns)
     self.ds = dataset
     self.model = model
     self.tx = tx
@@ -732,7 +751,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
 
   def _expand_collect(self, seeds, key, indptr_s, indices_s, bounds,
                       fshards_s, lshards_s, hcounts=None,
-                      concat: bool = False):
+                      concat: bool = False, gns_bits=None):
     """Tree expansion + one fused feature/label exchange for one
     device's ``[B]`` seed slice.  Returns
     ``(xs, masks, y, stats7, hop_counts)`` — ``hop_counts[h]`` is the
@@ -744,23 +763,36 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     returns ``(all_ids, feats, y, stats7, hop_counts)`` in the
     concatenated level layout instead of the split lists (the tiered
     collect phase's shape — the overlay machinery addresses one
-    ``[L]`` id table, the consume phase re-splits)."""
+    ``[L]`` id table, the consume phase re-splits).
+
+    ``gns_bits`` (cache-aware GNS, tiered path only): hops sample
+    through `ops.gns.sample_one_hop_gns` and a CUMULATIVE per-slot
+    importance weight (the product of a slot's ancestor edge weights
+    — the tree estimator's 1/q correction, GNS §3) rides back with
+    the level layout; the consume phase multiplies each level's
+    features by it so TreeSAGE's masked means stay unbiased."""
     from .dist_sampler import (_dist_one_hop, _slack_cap,
                                dist_gather_multi)
     slack = self.sampler.exchange_slack
     layout = self.sampler.exchange_layout
+    gns = gns_bits is not None
+    boost = self.sampler.gns_boost if gns else None
     levels, frontier = [seeds], seeds
+    w_levels = [jnp.ones(seeds.shape, jnp.float32)]
     fstats = jnp.zeros((3,), jnp.int32)
     for h, k in enumerate(self.fanouts):
-      nbrs, mask, _, st = _dist_one_hop(
+      nbrs, mask, _, hw, st = _dist_one_hop(
           indptr_s, indices_s, None, bounds, frontier, int(k),
           jax.random.fold_in(key, h), self.axis, self.num_parts,
           False, sort_locality=False,
           exchange_capacity=_slack_cap(frontier.shape[0],
-                                       self.num_parts, slack, layout))
+                                       self.num_parts, slack, layout),
+          gns_bits=gns_bits, gns_boost=boost)
       fstats = fstats + jnp.stack(st)
       nxt = jnp.where(mask, nbrs, -1).reshape(-1)
       levels.append(nxt)
+      if gns:
+        w_levels.append((w_levels[-1][:, None] * hw).reshape(-1))
       frontier = nxt
     all_ids = jnp.concatenate(levels)
     (feats, labels), gst = dist_gather_multi(
@@ -775,7 +807,8 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         [jnp.sum((lvl >= 0).astype(jnp.int32)) for lvl in levels])
     y = labels[:self.batch_size]
     if concat:
-      return all_ids, feats, y, stats7, hop_counts
+      out = (all_ids, feats, y, stats7, hop_counts)
+      return out + (jnp.concatenate(w_levels),) if gns else out
     sizes = [lvl.shape[0] for lvl in levels]
     xs, off = [], 0
     for s in sizes:
@@ -861,38 +894,54 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
   def _make_collect_sharded(self):
     """Per-device tree expansion + hot-masked feature/label exchange,
     returning the CONCATENATED level ids + features (the overlay
-    machinery's addressing) instead of the split lists."""
+    machinery's addressing) instead of the split lists.  Under GNS the
+    program takes the replicated cached-set bitmask and also returns
+    the cumulative per-slot importance weights."""
     from .shard_map_compat import shard_map
     ax = self.axis
+    gns = self.sampler.gns
 
     def per_device(seeds_s, key, indptr_s, indices_s, bounds,
-                   fshards_s, lshards_s, hcounts):
+                   fshards_s, lshards_s, hcounts, *rest):
       seeds = seeds_s[0]
-      all_ids, feats, y, stats7, hop_counts = self._expand_collect(
+      out = self._expand_collect(
           seeds, key, indptr_s[0], indices_s[0], bounds, fshards_s[0],
-          lshards_s[0], hcounts=hcounts, concat=True)
-      return (all_ids[None], feats[None], y[None], stats7[None],
+          lshards_s[0], hcounts=hcounts, concat=True,
+          gns_bits=rest[0] if gns else None)
+      all_ids, feats, y, stats7, hop_counts = out[:5]
+      lead = (all_ids[None], feats[None], y[None], stats7[None],
               hop_counts[None])
+      return lead + (out[5][None],) if gns else lead
 
+    n_out = 6 if gns else 5
     return shard_map(
         per_device, mesh=self.mesh,
-        in_specs=(P(ax), P(), P(ax), P(ax), P(), P(ax), P(ax), P()),
-        out_specs=tuple(P(ax) for _ in range(5)))
+        in_specs=(P(ax), P(), P(ax), P(ax), P(), P(ax), P(ax), P())
+        + ((P(),) if gns else ()),
+        out_specs=tuple(P(ax) for _ in range(n_out)))
 
   def _make_consume_sharded(self, train: bool):
     """Per-device split of the corrected level features + the train or
-    eval tail (the back half of `_make_sharded`'s per_device)."""
+    eval tail (the back half of `_make_sharded`'s per_device).  Under
+    GNS each level's features are scaled by the cumulative importance
+    weights BEFORE the model's masked means — the tree form of the
+    1/q correction (weight 1 everywhere when the boost never bit)."""
     from .shard_map_compat import shard_map
     ax = self.axis
     sizes = self._level_sizes()
+    gns = self.sampler.gns
 
     def per_device(state_or_params, seeds_s, ids_s, feats_s, y_s,
-                   hop_s):
+                   hop_s, *rest):
       seeds = seeds_s[0]
       ids, feats, y = ids_s[0], feats_s[0], y_s[0]
+      w = rest[0][0] if gns else None
       xs, masks, off = [], [], 0
       for s in sizes:
-        xs.append(feats[off:off + s])
+        lvl = feats[off:off + s]
+        if gns:
+          lvl = lvl * w[off:off + s][:, None].astype(lvl.dtype)
+        xs.append(lvl)
         masks.append(ids[off:off + s] >= 0)
         off += s
       valid = seeds >= 0
@@ -909,19 +958,25 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
       out_specs = (P(), P())
     return shard_map(
         per_device, mesh=self.mesh,
-        in_specs=(P(), P(ax), P(ax), P(ax), P(ax), P(ax)),
+        in_specs=(P(), P(ax), P(ax), P(ax), P(ax), P(ax))
+        + ((P(ax),) if gns else ()),
         out_specs=out_specs)
 
   def _collect_fn(self, seeds_all: jax.Array, keys: jax.Array,
                   arrs: dict):
+    gns = 'gns' in arrs
+
     def body(_, xs):
       key_i, seeds = xs
-      ids, feats, y, stats, hops = self._sharded_collect(
+      outs = self._sharded_collect(
           seeds, key_i, arrs['indptr'], arrs['indices'],
           arrs['bounds'], arrs['fshards'], arrs['lshards'],
-          arrs['hcounts'])
-      return 0, (dict(seeds=seeds, ids=ids, feats=feats, y=y,
-                      hops=hops), stats)
+          arrs['hcounts'], *((arrs['gns'],) if gns else ()))
+      ids, feats, y, stats, hops = outs[:5]
+      d = dict(seeds=seeds, ids=ids, feats=feats, y=y, hops=hops)
+      if gns:
+        d['w'] = outs[5]
+      return 0, (d, stats)
 
     _, (data, stats) = jax.lax.scan(body, 0, (keys, seeds_all))
     return data, stats
@@ -930,10 +985,14 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     data['feats'] = self._overlay_stacked(data['feats'], data['ids'])
     return data
 
+  def _consume_args(self, d):
+    return ((d['w'],) if 'w' in d else ())
+
   def _train_fn(self, state: TrainState, data):
     def body(state, d):
       state, loss, correct, n_valid, hop_g = self._sharded_consume(
-          state, d['seeds'], d['ids'], d['feats'], d['y'], d['hops'])
+          state, d['seeds'], d['ids'], d['feats'], d['y'], d['hops'],
+          *self._consume_args(d))
       return state, (loss, correct, n_valid, hop_g)
 
     state, (losses, corrects, valids, hops) = jax.lax.scan(
@@ -944,7 +1003,8 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
   def _eval_consume_fn(self, params, data):
     def body(carry, d):
       correct, total = self._sharded_consume_eval(
-          params, d['seeds'], d['ids'], d['feats'], d['y'], d['hops'])
+          params, d['seeds'], d['ids'], d['feats'], d['y'], d['hops'],
+          *self._consume_args(d))
       return carry, (correct, total)
 
     _, (c, t) = jax.lax.scan(body, 0, data)
@@ -1022,7 +1082,7 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
                seed: int = 0, input_space: str = 'old',
                exchange_slack='auto', exchange_layout=None,
                remat: bool = False,
-               fast_compile: bool = False):
+               fast_compile: bool = False, gns=None):
     from ..loader.node_loader import SeedBatcher
     if dataset.node_features is None:
       raise ValueError('FusedDistLinkEpoch needs node features')
@@ -1035,7 +1095,7 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     self.sampler = DistLinkNeighborSampler(
         dataset, num_neighbors, neg_sampling=neg_sampling, mesh=mesh,
         axis=axis, collect_features=True, seed=seed,
-        exchange_slack=slack, exchange_layout=exchange_layout)
+        exchange_slack=slack, exchange_layout=exchange_layout, gns=gns)
     self.ds = dataset
     self.mesh = self.sampler.mesh
     self.axis = axis
@@ -1098,15 +1158,21 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     endpoint expansion + features): shared front half of the train
     and eval scan bodies."""
     from ..loader.transform import Batch
-    (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn, stats,
-     eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
-        self._dist_step(
-            arrs['indptr'], arrs['indices'], arrs['eids'],
-            arrs['bounds'], pairs, arrs['fshards'], arrs['lshards'],
-            arrs['cids'], arrs['crows'], arrs['efshards'],
-            arrs['ebounds'], arrs['hcounts'], key_i)
+    extra = (arrs['gns'],) if 'gns' in arrs else ()
+    outs = self._dist_step(
+        arrs['indptr'], arrs['indices'], arrs['eids'],
+        arrs['bounds'], pairs, arrs['fshards'], arrs['lshards'],
+        arrs['cids'], arrs['crows'], arrs['efshards'],
+        arrs['ebounds'], arrs['hcounts'], *extra, key_i)
+    (nodes, _count, row, col, edge, seed_local, x, y, ef, nsn,
+     stats) = outs[:11]
+    ew = outs[11] if 'gns' in arrs else None
+    (eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
+        outs[12:] if 'gns' in arrs else outs[11:]
     md = link_step_metadata(self.sampler.neg_mode, seed_local, eli,
                             elab, elab_mask, src_idx, dst_pos, dst_neg)
+    if ew is not None:
+      md['edge_weight'] = ew
     batch = Batch(
         x=x, y=y, edge_index=jnp.stack([row, col], axis=1),
         edge_attr=ef, node=nodes, node_mask=nodes >= 0,
@@ -1239,7 +1305,7 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
       for c0, real, part, keys in self._tiered_chunks(stacked, key,
                                                       chunk):
         batches, stats = self._compiled_collect(
-            self._put_batches(part), keys, self.sampler._arrays())
+            self._put_batches(part), keys, self._chunk_arrs())
         self.sampler._accumulate_stats(jnp.sum(stats[:real], axis=0))
         batches = self._overlay_chunk(batches)
         w, t = self._compiled_auc_consume(params, batches)
@@ -1248,7 +1314,7 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
       return wins / max(total, 1.0)
     wins, total, stats = self._compiled_eval(
         params, self._put_batches(stacked), self._eval_key(),
-        self.sampler._arrays())
+        self._chunk_arrs())
     self.sampler._accumulate_stats(stats)
     return float(wins) / max(float(total), 1.0)
 
@@ -1281,6 +1347,6 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
         return state, EpochStats(losses, jnp.zeros((), jnp.int32),
                                  valid)
       state, losses, valid, stats = self._compiled(
-          state, self._put_batches(pairs), key, self.sampler._arrays())
+          state, self._put_batches(pairs), key, self._chunk_arrs())
     self.sampler._accumulate_stats(stats)
     return state, EpochStats(losses, jnp.zeros((), jnp.int32), valid)

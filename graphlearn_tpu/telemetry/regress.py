@@ -62,6 +62,13 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # silently regressing back to the r5 static-split numbers
     ('dist.tiered.seeds_per_sec', 'higher'),
     ('dist.feature.cache_hit_rate', 'higher'),
+    # cache-aware sampling guard (ISSUE 10): the GNS-on tiered row —
+    # the sampler-side bias must keep beating the budget/universe
+    # hit-rate ceiling AND hold the tiered throughput line (a PR that
+    # silently un-biases the sampler or taxes the biased step fails
+    # here, not in a notebook)
+    ('dist.gns.cache_hit_rate', 'higher'),
+    ('dist.gns.seeds_per_sec', 'higher'),
     # preemption-resume guard (ISSUE 6): restoring a mid-epoch
     # snapshot and re-entering the epoch must stay cheap — a resume
     # that re-executes half the epoch (replayed_batches creeping up)

@@ -109,6 +109,15 @@ EVENT_KINDS: Dict[str, str] = {
         'serving.admission.AdmissionController.submit: seeds, '
         'queue_depth after admit, deadline_ms — one per admitted '
         'request',
+    'gns.bias':
+        'DistNeighborSampler.step_for_batch (GNS mode, build time): '
+        'batch, boost, num_parts — one event per compiled GNS step, '
+        'recording the cached-neighbor boost that step samples with',
+    'gns.sketch_update':
+        'DistNeighborSampler._gns_arrays: scope, residents, version, '
+        'mask_bytes — one event per cached-set bitmask refresh (the '
+        'sketch-selected cold-cache residents ∪ hot split became the '
+        'new sampling-bias membership table)',
     'serving.shed':
         'serving.admission: reason (queue_full|deadline|too_large), '
         'seeds, queue_depth, limit / waited_ms — one per typed '

@@ -1,0 +1,436 @@
+"""Cache-aware Global Neighbor Sampling (`ops/gns.py`, ISSUE 10).
+
+The contract under test, in three layers:
+
+  * **kernel** — `sample_one_hop_gns` is seeded/jit-stable, its boost
+    actually skews draws toward the cached set, and the importance-
+    weighted estimator over many keys matches the uniform-sampling
+    reference within tolerance (the 1/q unbiasedness correction);
+  * **engines** — ``GLT_GNS=0`` (and the default) is bit-identical to
+    the unbiased path across the single-chip, mesh and fused-tiered
+    engines; GNS-on batches carry per-edge weights, keep feature
+    values exact, and break the budget/universe cache-hit ceiling on
+    a uniform cold stream (the PR 5 honesty-note regime);
+  * **shared working set** — cold-cache admission ranks by the same
+    decayed sketch the bias mask derives from, and both persist
+    through `state_dict`/`load_state_dict` (PR 6 snapshot/resume
+    keeps the learned working set).
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from graphlearn_tpu.data.cold_cache import ClockShardCache
+from graphlearn_tpu.ops.gns import (DecayedSketch, bitmask_lookup,
+                                    cached_set_bits, gns_enabled,
+                                    sample_one_hop_gns)
+from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                     DistNeighborSampler, FusedDistEpoch,
+                                     make_mesh)
+
+P = 4
+
+
+def _uniform_dataset(n, split_ratio, num_parts=P, deg=8, dim=4, seed=0):
+  """Uniform random regular-ish graph: the cold stream the static
+  split can't help (no hubs to hot-tier) — the honesty-note regime."""
+  rng = np.random.default_rng(seed)
+  rows = np.repeat(np.arange(n), deg)
+  cols = rng.integers(0, n, n * deg)
+  feats = (np.arange(n, dtype=np.float32)[:, None]
+           * np.ones((1, dim), np.float32))
+  labels = (np.arange(n) % 5).astype(np.int32)
+  node_pb = (np.arange(n) % num_parts).astype(np.int32)
+  return DistDataset.from_full_graph(
+      num_parts, rows, cols, node_feat=feats, node_label=labels,
+      num_nodes=n, node_pb=node_pb, split_ratio=split_ratio)
+
+
+# -- sketch ----------------------------------------------------------------
+
+def test_sketch_cross_batch_ranking():
+  """A steadily revisited id outranks a one-batch burst once the
+  burst decays — the property the per-batch multiset ranking lacked."""
+  sk = DecayedSketch(slots=128, decay=0.5)
+  sk.update([7], counts=[100])            # one-batch burst
+  for _ in range(6):
+    sk.update([3], counts=[2])            # steady repeat visitor
+  assert sk.score([3])[0] > sk.score([7])[0]
+  assert sk.score([-1])[0] == 0.0
+
+
+def test_sketch_fresh_reduces_to_multiset():
+  """On a fresh sketch the admission ranking equals the old per-batch
+  multiset order (the drop-in-replacement contract)."""
+  c = ClockShardCache(2)
+  ids = np.array([5, 6, 7], np.int64)
+  counts = np.array([1, 9, 4], np.int64)
+  adm, slots, _ = c.plan_admissions(ids, counts)
+  c.commit(adm, slots)
+  hit, _ = c.lookup(ids)
+  assert hit.tolist() == [False, True, True]
+
+
+def test_sketch_persists_with_cache_state():
+  """ClockShardCache snapshots carry the sketch: a resumed cache
+  ranks admissions with the LEARNED visit frequencies, not a cold
+  restart (ISSUE 10 satellite)."""
+  a = ClockShardCache(2)
+  adm, slots, _ = a.plan_admissions(np.array([1, 2], np.int64),
+                                    np.array([9, 8], np.int64))
+  a.commit(adm, slots)
+  state = a.state_dict()
+  assert 'sketch' in state
+
+  b = ClockShardCache(2)
+  b.load_state_dict(state)
+  np.testing.assert_array_equal(b.sketch.scores, a.sketch.scores)
+  np.testing.assert_array_equal(b.ids, a.ids)
+  # pre-r11 snapshot (no sketch key): residency restores, no crash
+  legacy = {k: v for k, v in state.items() if k != 'sketch'}
+  c = ClockShardCache(2)
+  c.load_state_dict(legacy)
+  np.testing.assert_array_equal(c.ids, a.ids)
+
+
+def test_gns_enabled_resolution():
+  assert gns_enabled(True) and not gns_enabled(False)
+  assert not gns_enabled(None)
+  os.environ['GLT_GNS'] = '1'
+  try:
+    assert gns_enabled(None)
+    assert not gns_enabled(False)      # explicit kwarg beats env
+  finally:
+    del os.environ['GLT_GNS']
+
+
+# -- membership bitmask ----------------------------------------------------
+
+def test_cached_set_bits_lookup():
+  bounds = np.array([0, 10, 20])
+  hot_counts = np.array([3, 2])            # hot: 0,1,2 and 10,11
+  residents = np.array([5, 17, 999])       # out-of-range id ignored
+  bits = cached_set_bits(20, bounds, hot_counts, residents)
+  got = np.asarray(bitmask_lookup(jnp.asarray(bits),
+                                  jnp.arange(-1, 20)))
+  want = np.zeros(21, np.uint8)
+  for v in (0, 1, 2, 10, 11, 5, 17):
+    want[v + 1] = 1                        # +1: index 0 is id -1
+  np.testing.assert_array_equal(got, want)
+
+
+def test_set_resident_bits_matches_full_rebuild():
+  """The incremental refresh (static hot mask + resident scatter)
+  equals the one-shot builder bit for bit."""
+  from graphlearn_tpu.ops.gns import set_resident_bits
+  bounds = np.array([0, 10, 20])
+  hot = np.array([3, 2])
+  base = cached_set_bits(20, bounds, hot, np.empty(0, np.int64))
+  res = np.array([5, 17, -1, 99])
+  inc = set_resident_bits(base, res, 20)
+  full = cached_set_bits(20, bounds, hot, res)
+  np.testing.assert_array_equal(inc, full)
+  # the base mask is untouched (copy semantics)
+  np.testing.assert_array_equal(
+      base, cached_set_bits(20, bounds, hot, np.empty(0, np.int64)))
+
+
+def test_subgraph_sampler_never_biases():
+  """Induced subgraphs are exact by contract: a global GLT_GNS=1 must
+  not flip the subgraph sampler's flag (its step never biases)."""
+  from graphlearn_tpu.parallel import DistSubGraphSampler
+  os.environ['GLT_GNS'] = '1'
+  try:
+    ds = _uniform_dataset(96, 0.3)
+    s = DistSubGraphSampler(ds, [2], mesh=make_mesh(P))
+    assert not s.gns and s.gns_boost is None
+  finally:
+    del os.environ['GLT_GNS']
+
+
+# -- biased kernel ---------------------------------------------------------
+
+def _chain_csr(deg):
+  """One seed (node 0) with neighbors 1..deg; the other nodes are
+  isolated (indptr flat past row 0)."""
+  n = deg + 1
+  indptr = np.concatenate([[0], np.full(n, deg)]).astype(np.int64)
+  indices = np.arange(1, deg + 1, dtype=np.int32)
+  return jnp.asarray(indptr), jnp.asarray(indices), n
+
+
+def test_gns_kernel_bias_and_unbiasedness():
+  """The boost measurably skews draws toward the cached set, and the
+  importance-weighted estimator of the neighbor mean matches the
+  exact mean over many seeds (the 1/q correction)."""
+  deg, k = 16, 4
+  indptr, indices, n = _chain_csr(deg)
+  # cache neighbors 1..4
+  bits = jnp.asarray(cached_set_bits(
+      n, np.array([0, n]), np.array([0]), np.arange(1, 5)))
+  seeds = jnp.zeros((1,), jnp.int32)
+  true_mean = np.arange(1, deg + 1).mean()
+
+  trials = 2000
+  est = np.zeros(trials)
+  cached_frac = 0.0
+  for t in range(trials):
+    res = sample_one_hop_gns(indptr, indices, seeds, k,
+                             jax.random.fold_in(jax.random.key(0), t),
+                             bits, 8.0)
+    nbrs = np.asarray(res.nbrs[0])
+    w = np.asarray(res.weights[0])
+    m = np.asarray(res.mask[0])
+    assert m.all() and (nbrs >= 1).all()
+    # weighted estimator of the neighbor mean: sum(w f)/k
+    est[t] = (w * nbrs).sum() / k
+    cached_frac += (nbrs <= 4).mean() / trials
+  # the bias bites: cached neighbors are 4/16 = 25% of the adjacency
+  # but far more of the draws (q = 9/(12 + 9*4) = 0.1875 each -> 75%)
+  assert cached_frac > 0.5, cached_frac
+  # ...and the correction undoes it: the estimator mean is the
+  # uniform neighbor mean within monte-carlo tolerance
+  se = est.std() / np.sqrt(trials)
+  assert abs(est.mean() - true_mean) < 4 * se + 1e-6, (
+      est.mean(), true_mean, se)
+
+
+def test_gns_kernel_take_all_and_beyond_window_arms():
+  """deg <= k: take-all with weight 1; deg > window: uniform draws
+  with weight 1 (the boost only engages between the two)."""
+  deg, k = 3, 4
+  indptr, indices, n = _chain_csr(deg)
+  bits = jnp.asarray(cached_set_bits(n, np.array([0, n]),
+                                     np.array([0]), np.arange(1, 3)))
+  res = sample_one_hop_gns(indptr, indices, jnp.zeros((1,), jnp.int32),
+                           k, jax.random.key(1), bits, 8.0)
+  m = np.asarray(res.mask[0])
+  assert m.sum() == deg
+  np.testing.assert_array_equal(np.asarray(res.weights[0])[m], 1.0)
+  np.testing.assert_array_equal(np.asarray(res.weights[0])[~m], 0.0)
+
+  deg2 = 32
+  indptr2, indices2, n2 = _chain_csr(deg2)
+  res2 = sample_one_hop_gns(indptr2, indices2,
+                            jnp.zeros((1,), jnp.int32), 4,
+                            jax.random.key(2),
+                            jnp.asarray(cached_set_bits(
+                                n2, np.array([0, n2]), np.array([0]),
+                                np.arange(1, 5))),
+                            8.0, window=16)     # deg > window
+  np.testing.assert_array_equal(np.asarray(res2.weights[0]), 1.0)
+
+
+def test_gns_kernel_seeded_and_sorted_equivalence():
+  """Same key -> same draws; sort_locality returns input order."""
+  deg = 16
+  indptr, indices, n = _chain_csr(deg)
+  bits = jnp.asarray(cached_set_bits(n, np.array([0, n]),
+                                     np.array([0]), np.arange(1, 5)))
+  seeds = jnp.asarray([0, 0, -1], jnp.int32)
+  a = sample_one_hop_gns(indptr, indices, seeds, 4, jax.random.key(3),
+                         bits, 8.0)
+  b = sample_one_hop_gns(indptr, indices, seeds, 4, jax.random.key(3),
+                         bits, 8.0)
+  np.testing.assert_array_equal(np.asarray(a.nbrs), np.asarray(b.nbrs))
+  np.testing.assert_array_equal(np.asarray(a.weights),
+                                np.asarray(b.weights))
+  assert not np.asarray(a.mask[2]).any()        # invalid seed: empty
+
+
+# -- mesh engines ----------------------------------------------------------
+
+def _loader(ds, mesh, n, gns=None, **kw):
+  return DistNeighborLoader(ds, [3, 2], np.arange(n), batch_size=8,
+                            shuffle=True, mesh=mesh, seed=0, gns=gns,
+                            **kw)
+
+
+def test_gns_off_byte_identity_mesh():
+  """GLT_GNS=0, gns=False and the default all produce bit-identical
+  mesh batches (the off path IS the unbiased sampler, not a
+  zero-boost GNS program)."""
+  n = 96
+  ds = _uniform_dataset(n, 0.3)
+  mesh = make_mesh(P)
+  runs = {}
+  for tag, env, kwarg in (('default', None, None),
+                          ('env0', '0', None),
+                          ('kwfalse', None, False)):
+    if env is not None:
+      os.environ['GLT_GNS'] = env
+    try:
+      loader = _loader(ds, mesh, n, gns=kwarg)
+      assert not loader.sampler.gns
+      batches = list(loader)
+      assert all('edge_weight' not in b.metadata for b in batches)
+      runs[tag] = [(np.asarray(b.x), np.asarray(b.node),
+                    np.asarray(b.edge_index)) for b in batches]
+    finally:
+      os.environ.pop('GLT_GNS', None)
+  for tag in ('env0', 'kwfalse'):
+    for (x0, n0, e0), (x1, n1, e1) in zip(runs['default'], runs[tag]):
+      np.testing.assert_array_equal(x0, x1, err_msg=tag)
+      np.testing.assert_array_equal(n0, n1, err_msg=tag)
+      np.testing.assert_array_equal(e0, e1, err_msg=tag)
+
+
+def test_gns_on_values_exact_and_weighted():
+  """GNS batches keep feature values exact (the overlay serves the
+  biased sample correctly) and carry per-edge weights aligned with
+  the edge list."""
+  n = 96
+  ds = _uniform_dataset(n, 0.3)
+  mesh = make_mesh(P)
+  new2old = np.argsort(ds.old2new)
+  loader = _loader(ds, mesh, n, gns=True)
+  assert loader.sampler.gns
+  saw_weighted = False
+  for b in loader:
+    node = np.asarray(b.node)
+    x = np.asarray(b.x)
+    valid = node >= 0
+    np.testing.assert_allclose(x[valid][:, 0], new2old[node[valid]])
+    ew = np.asarray(b.metadata['edge_weight'])
+    emask = np.asarray(b.edge_mask)
+    assert ew.shape == emask.shape
+    assert (ew[emask] > 0).all()
+    assert (ew[~emask] == 0).all()
+    saw_weighted |= bool((np.abs(ew[emask] - 1.0) > 1e-6).any())
+  assert saw_weighted            # the boost engaged somewhere
+
+
+def test_gns_breaks_hit_rate_ceiling():
+  """On a uniform cold stream at split 0.3 — the PR 5 honesty-note
+  regime where cache_hit_rate pins at budget/universe — GNS-on
+  steering lifts the hit rate well past the ceiling at identical
+  budget, while GNS-off stays near it."""
+  n = 512
+  ds = _uniform_dataset(n, 0.3, deg=8)
+  mesh = make_mesh(P)
+  cache_rows = 16
+  counts = np.diff(ds.graph.bounds)
+  universe = int(np.maximum(
+      counts - ds.node_features.hot_counts, 0).sum())
+  ceiling = cache_rows / universe
+
+  os.environ['GLT_GNS_BOOST'] = '32'     # margin over the 3x bar
+  rates = {}
+  try:
+    for gns in (False, True):
+      s = DistNeighborSampler(ds, [3, 2], mesh=mesh, seed=0,
+                              cold_cache_rows=cache_rows, gns=gns)
+      rng = np.random.default_rng(1)
+      for step in range(24):
+        seeds = ds.old2new[rng.integers(0, n, (P, 16))]
+        s.sample_from_nodes(seeds,
+                            key=jax.random.fold_in(jax.random.key(5),
+                                                   step))
+      st = s.exchange_stats(tick_metrics=False)
+      rates[gns] = st['dist.feature.cache_hit_rate']
+  finally:
+    del os.environ['GLT_GNS_BOOST']
+  # acceptance shape (ISSUE 10): >= 3x budget/universe with the
+  # sampler biased, and decisively above the unbiased sampler
+  # (measured: off 0.050 ~ ceiling 0.045; on 0.188 ~ 4.2x)
+  assert rates[True] >= 3 * ceiling, (rates, ceiling)
+  assert rates[True] > 1.5 * rates[False], (rates, ceiling)
+
+
+def test_gns_fused_tiered_trains_and_off_is_identical():
+  """FusedDistEpoch on a tiered store: GLT_GNS=0 epochs are
+  bit-identical to the default driver, and a GNS-on epoch trains to
+  finite losses through the chunked collect -> cold-service -> train
+  path with the bitmask refreshed at chunk seams."""
+  import optax
+  from graphlearn_tpu.models import GraphSAGE, create_train_state
+  from graphlearn_tpu.parallel import local_batch_piece, replicate
+  n = 96
+  ds = _uniform_dataset(n, 0.3)
+  mesh = make_mesh(P)
+  model = GraphSAGE(hidden_features=8, out_features=5, num_layers=2)
+  tx = optax.adam(1e-2)
+  b0 = next(iter(_loader(ds, mesh, n)))
+  b0_local = local_batch_piece(b0, P)
+
+  def run_epoch(**kw):
+    fused = FusedDistEpoch(ds, [3, 2], np.arange(n), apply_fn, tx,
+                           batch_size=8, mesh=mesh, shuffle=True,
+                           seed=0, **kw)
+    state = replicate(
+        create_train_state(model, jax.random.key(0), b0_local, tx)[0],
+        mesh)
+    state, stats = fused.run(state)
+    return np.asarray(stats.losses)
+
+  state0, apply_fn = create_train_state(model, jax.random.key(0),
+                                        b0_local, tx)
+  l_default = run_epoch()
+  os.environ['GLT_GNS'] = '0'
+  try:
+    l_env0 = run_epoch()
+  finally:
+    del os.environ['GLT_GNS']
+  np.testing.assert_array_equal(l_default, l_env0)
+
+  l_gns = run_epoch(gns=True)
+  assert np.isfinite(l_gns).all()
+  assert l_gns.shape == l_default.shape
+
+
+def test_gns_fused_tree_tiered_smoke():
+  """FusedDistTreeEpoch with GNS on: the tiered collect phase carries
+  cumulative level weights, the consume phase scales features by
+  them, and the epoch trains to finite losses."""
+  import optax
+  from graphlearn_tpu.models import TreeSAGE
+  from graphlearn_tpu.parallel import FusedDistTreeEpoch
+  n = 96
+  ds = _uniform_dataset(n, 0.3)
+  mesh = make_mesh(P)
+  model = TreeSAGE(hidden_features=8, out_features=5, num_layers=2)
+  tx = optax.adam(1e-2)
+  fused = FusedDistTreeEpoch(ds, [3, 2], np.arange(n), model, tx,
+                             batch_size=8, mesh=mesh, shuffle=True,
+                             seed=0, gns=True)
+  assert fused.sampler.gns
+  state = fused.init_state(jax.random.key(0))
+  state, stats = fused.run(state)
+  losses = np.asarray(stats.losses)
+  assert np.isfinite(losses).all() and losses.size > 0
+
+
+# -- serving cold-path dedup (ISSUE 10 satellite) --------------------------
+
+def test_serving_cold_dedup_pays_unique_ids_only():
+  """A coalesced dispatch whose riders repeat the same seed fetches
+  each distinct id once: results stay byte-identical to the per-seed
+  reference while the tiered host path sees ~tree-width lookups, not
+  riders x tree-width."""
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.data.feature import Feature
+  from graphlearn_tpu.serving.engine import ServingEngine
+  n = 64
+  rng = np.random.default_rng(0)
+  rows = np.repeat(np.arange(n), 4)
+  cols = rng.integers(0, n, 4 * n)
+  feats = (np.arange(n, dtype=np.float32)[:, None]
+           * np.ones((1, 4), np.float32))
+  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=n)
+  ds.node_features = Feature(feats, split_ratio=0.5)
+  eng = ServingEngine(ds, [3, 2], seed=0, buckets=(8,))
+  eng.warmup()
+  feat = ds.node_features
+  before = feat.cold_stats['lookups']
+  seeds = np.array([5, 5, 5, 5, 9, 9, 9, 9])
+  out = eng.infer(seeds)
+  dedup_lookups = feat.cold_stats['lookups'] - before
+  ref = eng.offline_reference(seeds, cap=8)
+  np.testing.assert_array_equal(out.nodes, ref.nodes)
+  np.testing.assert_array_equal(out.x, ref.x)
+  # 8 riders x tree width would be 8 * (1 + 3 + 6) = 80 lookups; the
+  # deduped run pays the distinct ids of TWO trees (plus pow2 pad)
+  assert dedup_lookups < 8 * eng.tree_width / 2, dedup_lookups
