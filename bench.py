@@ -1357,10 +1357,10 @@ def main():
   total_budget = float(os.environ.get('GLT_BENCH_TOTAL_BUDGET', 1200))
   dist_timeout = int(os.environ.get('GLT_BENCH_DIST_TIMEOUT', 600))
   fused_timeout = int(os.environ.get('GLT_BENCH_FUSED_TIMEOUT', 600))
-  t_start = time.time()
+  t_start = time.monotonic()
 
   def budget_left():
-    return total_budget - (time.time() - t_start)
+    return total_budget - (time.monotonic() - t_start)
 
   results, fused_res, dist, hetero = [], None, None, None
   last_art = [None]

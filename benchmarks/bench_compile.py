@@ -84,6 +84,8 @@ def main():
     rec('dist_step', batch, time.perf_counter() - t0)
     # DP train step
     b0_local = local_batch_piece(b0, num_parts)
+    # same init key across loop variants BY DESIGN: compile timing
+    # must compare identical programs  # glint: disable=rng-discipline
     state, apply_fn = create_train_state(model, jax.random.key(0),
                                          b0_local, tx)
     step = make_dp_supervised_step(apply_fn, tx, batch, mesh)
@@ -98,6 +100,7 @@ def main():
       fused = FusedDistEpoch(ds, FANOUT, seeds, apply_fn, tx,
                              batch_size=batch, mesh=mesh, shuffle=True,
                              seed=0, remat=remat, fast_compile=fastc)
+      # glint: disable=rng-discipline — same rationale as above
       st, _ = create_train_state(model, jax.random.key(1), b0_local, tx)
       st = replicate(st, mesh)
       t0 = time.perf_counter()

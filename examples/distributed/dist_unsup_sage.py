@@ -74,14 +74,14 @@ def main():
   step = make_dp_unsupervised_step(model.apply, tx, mesh)
 
   for epoch in range(args.epochs):
-    t0 = time.time()
+    t0 = time.monotonic()
     tot = cnt = 0
     for batch in loader:
       state, loss = step(state, batch)
       tot += float(loss)
       cnt += 1
     print(f'epoch {epoch}: link loss {tot / max(cnt, 1):.4f} '
-          f'({time.time() - t0:.2f}s, {cnt} steps x {n_dev} devices)')
+          f'({time.monotonic() - t0:.2f}s, {cnt} steps x {n_dev} devices)')
 
   # embedding quality probe: intra-cluster pairs should score higher
   # than random pairs under the trained dot-product model

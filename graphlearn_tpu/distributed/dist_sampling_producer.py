@@ -198,10 +198,11 @@ class MpSamplingProducer:
     # supervision state: per-worker assignment ledger for the CURRENT
     # epoch ({rank: (seed_slice, seq_stamps)}), workers declared
     # irrecoverable, and the restart budget consumed so far
-    self._assignments: dict = {}
-    self._lost: set = set()
-    self._restarts = 0
-    self._sent_seqs: set = set()   # worker progress acks, this epoch
+    self._assignments: dict = {}   # guarded-by: self._sup_lock
+    self._lost: set = set()        # guarded-by: self._sup_lock
+    self._restarts = 0             # guarded-by: self._sup_lock
+    # worker progress acks, this epoch  # guarded-by: self._sup_lock
+    self._sent_seqs: set = set()
     self._progress = None
     self._generations: dict = {}   # rank -> restart count
     # one supervisor at a time: the server runtime calls supervise()
@@ -312,6 +313,8 @@ class MpSamplingProducer:
     """Fold worker progress acks for the CURRENT epoch into
     ``_sent_seqs`` (acks are ``(epoch, rank, seq)`` put after each
     durable channel send)."""
+    # called from _produce_all_locked/_supervise_locked only
+    # glint: holds=self._sup_lock
     if self._progress is None:
       return
     while True:
@@ -342,6 +345,7 @@ class MpSamplingProducer:
     already-sent batch would be harmless (consumer '#SEQ' dedup) but
     wasteful — and under a deterministic kill fault it would re-fire
     the fault forever."""
+    # called from _supervise_locked only  # glint: holds=self._sup_lock
     sl, seqs = self._assignments.get(rank, (None, []))
     if sl is None:
       return None, []

@@ -202,6 +202,7 @@ class _ReplayCache:
                max_bytes: int = REPLAY_BYTES_PER_CLIENT,
                max_clients: int = REPLAY_MAX_CLIENTS):
     self._lock = threading.Lock()
+    # guarded-by: self._lock
     self._clients: 'OrderedDict[str, OrderedDict[int, _ReplayEntry]]' = \
         OrderedDict()
     # bounded LRU: a mark only matters while a zombie client might
@@ -209,7 +210,7 @@ class _ReplayCache:
     # token EVER seen (the ISSUE's serving fleet recycles clients
     # continuously).  4x max_clients keeps marks well past the
     # per-client eviction horizon.
-    self._evicted_marks: 'OrderedDict[str, int]' = OrderedDict()
+    self._evicted_marks: 'OrderedDict[str, int]' = OrderedDict()  # guarded-by: self._lock
     self._max_marks = 4 * max_clients
     self._max_entries = max_entries
     self._max_bytes = max_bytes

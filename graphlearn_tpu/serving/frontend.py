@@ -81,12 +81,13 @@ class ServingFrontend:
     self._thread: Optional[threading.Thread] = None
     self._lock = threading.Lock()
     #: executor-side counters (heartbeat/stats; executor thread only
-    #: writes, readers take the lock for a consistent snapshot)
-    self.in_flight = 0
-    self.served_requests = 0
-    self.served_seeds = 0
-    self.dispatches = 0
-    self.failed = 0
+    #: writes, readers take the lock for a consistent snapshot —
+    #: enforced by glint's guarded-by pass)
+    self.in_flight = 0          # guarded-by: self._lock
+    self.served_requests = 0    # guarded-by: self._lock
+    self.served_seeds = 0       # guarded-by: self._lock
+    self.dispatches = 0         # guarded-by: self._lock
+    self.failed = 0             # guarded-by: self._lock
     if auto_start:
       self.start(warmup=warmup)
 

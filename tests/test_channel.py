@@ -217,9 +217,9 @@ def test_shm_queue_mpmc_stress():
   # deadline-based: forkserver children re-import the package (seconds
   # of startup before the first send), so a short single-recv timeout
   # would bail early; the count check still exits promptly when done
-  deadline = time.time() + 120
+  deadline = time.monotonic() + 120
   def consume():
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
       with lock:
         if len(got) >= n_producers * per:
           return

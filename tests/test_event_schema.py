@@ -1,84 +1,27 @@
 """Static event-kind / span-name schema enforcement (ISSUE 2
 satellite): every ``recorder.emit('<kind>', ...)`` and
 ``span('<name>', ...)`` call site in the package must be registered in
-`telemetry.schema`, and the registry must not hold stale entries —
-exporters and dashboards key off these strings, and an unregistered
-kind is a consumer that silently sees nothing.
+`telemetry.schema`, and the registry must not hold stale or
+undocumented entries.
+
+The AST scan that used to live here migrated to glint's
+``event-schema`` pass (ISSUE 11) — this test is now the tier-1 driver
+invocation, so any new subsystem gets the same enforcement for free
+(plus the other five passes via ``test_glint.py``'s whole-tree run).
 """
-import ast
+import sys
 from pathlib import Path
 
-from graphlearn_tpu.telemetry.schema import EVENT_KINDS, SPAN_NAMES
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-PKG = Path(__file__).resolve().parent.parent / 'graphlearn_tpu'
-
-
-def _callee_name(func) -> str:
-  if isinstance(func, ast.Attribute):
-    return func.attr
-  if isinstance(func, ast.Name):
-    return func.id
-  return ''
+from tools.glint.driver import DEFAULT_BASELINE, run_glint  # noqa: E402
 
 
-def _call_sites(callee: str):
-  """``{first_string_arg: [files...]}`` for every real AST call of
-  ``callee`` in the package (docstring examples don't count — the
-  registry tracks call SITES)."""
-  out = {}
-  for py in sorted(PKG.rglob('*.py')):
-    tree = ast.parse(py.read_text())
-    for node in ast.walk(tree):
-      if (isinstance(node, ast.Call)
-          and _callee_name(node.func) == callee and node.args
-          and isinstance(node.args[0], ast.Constant)
-          and isinstance(node.args[0].value, str)):
-        out.setdefault(node.args[0].value, []).append(
-            str(py.relative_to(PKG)))
-  return out
-
-
-def test_all_emitted_kinds_registered():
-  sites = _call_sites('emit')
-  # spans.py emits the span.begin/end pair; everything else emits
-  # point events — all must be registered
-  unregistered = {k: v for k, v in sites.items() if k not in EVENT_KINDS}
-  assert not unregistered, (
-      f'unregistered event kinds {unregistered} — add them to '
-      'telemetry/schema.py::EVENT_KINDS (with a field summary) so '
-      'exporters and dashboards do not go stale')
-
-
-def test_no_stale_registered_kinds():
-  sites = _call_sites('emit')
-  stale = set(EVENT_KINDS) - set(sites)
-  assert not stale, (
-      f'registered kinds with no emit call site: {stale} — remove '
-      'them from telemetry/schema.py::EVENT_KINDS')
-
-
-def test_all_span_names_registered():
-  sites = _call_sites('span')
-  unregistered = {k: v for k, v in sites.items() if k not in SPAN_NAMES}
-  assert not unregistered, (
-      f'unregistered span names {unregistered} — add them to '
-      'telemetry/schema.py::SPAN_NAMES')
-
-
-def test_no_stale_span_names():
-  sites = _call_sites('span')
-  stale = set(SPAN_NAMES) - set(sites)
-  assert not stale, (
-      f'registered span names with no call site: {stale} — remove '
-      'them from telemetry/schema.py::SPAN_NAMES')
-
-
-def test_tests_emit_only_registered_or_local_kinds():
-  """The recorder tests exercise ad-hoc kinds on PRIVATE EventRecorder
-  instances, which is fine; the GLOBAL recorder in package code is the
-  contract.  This test pins the boundary: schema entries must be
-  non-empty strings documenting emitter + fields."""
-  for table in (EVENT_KINDS, SPAN_NAMES):
-    for kind, doc in table.items():
-      assert isinstance(kind, str) and kind
-      assert isinstance(doc, str) and len(doc) > 10, kind
+def test_event_schema_clean():
+  # paths narrowed to the package: the pass ignores everything else
+  # anyway, and test_glint.py's whole-tree run covers the full roots
+  live = [f for f in run_glint(paths=['graphlearn_tpu'],
+                               rules=['event-schema'],
+                               baseline=DEFAULT_BASELINE) if f.live]
+  assert not live, 'event-schema drift:\n' + '\n'.join(
+      f.render() for f in live)

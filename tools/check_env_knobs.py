@@ -1,33 +1,35 @@
-"""Env-knob documentation drift check (ISSUE 6 satellite).
+"""Env-knob documentation drift check (ISSUE 6 satellite) — now a
+thin shim over glint's ``env-knob-drift`` pass (ISSUE 11).
 
-PR 4 and PR 5 each added ``GLT_*`` knobs that drifted from the
-``benchmarks/README.md`` knob tables — an undocumented knob is a
-feature only its author can use.  This tool AST-scans the package (and
-the bench drivers) for every ``GLT_*`` string constant — the knob
-vocabulary: env reads go through ``os.environ.get('GLT_X')``,
-``os.environ['GLT_X']`` or a ``FOO_ENV = 'GLT_X'`` constant, and all
-of them surface as a string literal — and fails if any knob is
-missing from the README.
-
-Wired into the test suite like ``tests/test_event_schema.py``
-(``tests/test_env_knobs.py``), and runnable standalone::
+The implementation lives in ``tools/glint/passes/env_knobs.py``; this
+module keeps the original standalone CLI and the helper API
+(`knob_references` / `documented_knobs` / `undocumented`) that
+``tests/test_env_knobs.py`` and the docs reference::
 
     python tools/check_env_knobs.py          # exit 1 on drift
+
+The full framework run (this pass plus five more) is::
+
+    python -m tools.glint --baseline tools/glint/baseline.json
 """
 from __future__ import annotations
 
 import ast
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:               # standalone-script import
+  sys.path.insert(0, str(REPO))
+
+from tools.glint.passes.env_knobs import (documented_knobs as  # noqa: E402
+                                          _documented, knob_constants)
+
 #: scanned roots: the package plus the bench drivers (their knobs are
-#: user-facing too)
+#: user-facing too).  The glint pass scans the driver's wider root set
+#: (``examples/`` included); this shim keeps its historical contract.
 SCAN_ROOTS = ('graphlearn_tpu', 'benchmarks', 'bench.py')
 README = REPO / 'benchmarks' / 'README.md'
-
-_KNOB_RE = re.compile(r'^GLT_[A-Z0-9_]+$')
 
 
 def knob_references() -> dict:
@@ -46,16 +48,13 @@ def knob_references() -> dict:
       tree = ast.parse(py.read_text())
     except SyntaxError:             # pragma: no cover — broken file
       continue
-    for node in ast.walk(tree):
-      if (isinstance(node, ast.Constant) and isinstance(node.value, str)
-          and _KNOB_RE.match(node.value)):
-        out.setdefault(node.value, []).append(
-            str(py.relative_to(REPO)))
+    for knob, _line in knob_constants(tree):
+      out.setdefault(knob, []).append(str(py.relative_to(REPO)))
   return out
 
 
 def documented_knobs(readme_path: Path = README) -> set:
-  return set(re.findall(r'GLT_[A-Z0-9_]+', readme_path.read_text()))
+  return _documented(readme_path)
 
 
 def undocumented(readme_path: Path = README) -> dict:

@@ -1,0 +1,27 @@
+"""glint — repo-native static analysis for data-plane invariants.
+
+The codebase encodes a set of unwritten contracts that tests only
+catch after the fact: no host synchronization inside the fused
+dispatch-ahead path, per-seed deterministic sampling, byte-identical
+resume, monotonic-clock durations, lock-guarded shared state,
+documented env knobs, registered telemetry schema.  Two of those
+already grew one-off AST checkers (``tools/check_env_knobs.py``, the
+event-schema scan that used to live in ``tests/test_event_schema.py``)
+because drift kept recurring — glint is the framework both migrated
+into, plus four new passes grounded in the same class of hazard.
+
+Usage::
+
+    python -m tools.glint                       # scan default roots
+    python -m tools.glint --list-passes
+    python -m tools.glint --rules monotonic-clock graphlearn_tpu
+    python -m tools.glint --write-baseline      # grandfather findings
+
+Nonzero exit on any finding that is neither inline-suppressed
+(``# glint: disable=<rule>``) nor recorded in the checked-in baseline
+(``tools/glint/baseline.json``).  The same run is wired into tier-1 as
+``tests/test_glint.py::test_whole_tree_clean``.
+"""
+from .driver import check_source, run_glint  # noqa: F401
+from .findings import Finding  # noqa: F401
+from .registry import GlintPass, all_passes, register  # noqa: F401

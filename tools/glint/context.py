@@ -1,0 +1,139 @@
+"""Per-file analysis context: one parse, shared by every pass.
+
+Provides the AST plus the cross-cutting machinery passes need:
+parent links, enclosing-scope lookup, import-alias resolution
+(``import numpy as np`` makes ``np.random.rand`` resolve to
+``numpy.random.rand``), source-line access, and the inline suppression
+table (``# glint: disable=<rule>[,<rule>...]`` — trailing on the
+flagged line, or a standalone comment line suppressing the next line).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(r'#\s*glint:\s*disable=([A-Za-z0-9_\-, ]+)')
+
+
+def terminal_name(node: ast.AST) -> str:
+  """Identifier a callable/attribute reference bottoms out in:
+  ``f`` for a Name, ``m`` for ``self.m`` / ``obj.a.m``."""
+  if isinstance(node, ast.Attribute):
+    return node.attr
+  if isinstance(node, ast.Name):
+    return node.id
+  return ''
+
+
+def comment_annotations(lines, pattern: 're.Pattern') -> Dict[int, list]:
+  """``{target_line: [matches]}`` for a comment-borne annotation:
+  a trailing comment annotates its own line, a standalone comment
+  line annotates the next line.  The single convention shared by
+  suppressions, ``# guarded-by:`` and ``# glint: holds=``."""
+  out: Dict[int, list] = {}
+  for i, raw in enumerate(lines, start=1):
+    m = pattern.search(raw)
+    if m:
+      target = i + 1 if raw.lstrip().startswith('#') else i
+      out.setdefault(target, []).append(m)
+  return out
+
+
+class FileContext:
+  """Parsed view of one source file."""
+
+  def __init__(self, source: str, rel: str, path: Optional[Path] = None):
+    self.path = path
+    self.rel = rel                      #: repo-relative posix path
+    self.source = source
+    self.lines: List[str] = source.splitlines()
+    self.tree: Optional[ast.AST] = None
+    self.parse_error: Optional[SyntaxError] = None
+    try:
+      self.tree = ast.parse(source)
+    except SyntaxError as e:            # surfaced by the driver
+      self.parse_error = e
+      return
+    self._parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(self.tree):
+      for child in ast.iter_child_nodes(node):
+        self._parents[child] = node
+    self.aliases = self._import_aliases()
+    self._suppress = self._suppressions()
+
+  @classmethod
+  def from_path(cls, path: Path, repo: Path) -> 'FileContext':
+    try:
+      rel = path.resolve().relative_to(repo.resolve()).as_posix()
+    except ValueError:                  # explicit path outside the repo
+      rel = path.as_posix()
+    return cls(path.read_text(), rel, path)
+
+  # -- source helpers --------------------------------------------------------
+  def line_text(self, lineno: int) -> str:
+    if 1 <= lineno <= len(self.lines):
+      return self.lines[lineno - 1].strip()
+    return ''
+
+  # -- tree helpers ----------------------------------------------------------
+  def parent(self, node: ast.AST) -> Optional[ast.AST]:
+    return self._parents.get(node)
+
+  def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+    cur = self._parents.get(node)
+    while cur is not None:
+      yield cur
+      cur = self._parents.get(cur)
+
+  def enclosing(self, node: ast.AST, kinds: Tuple[type, ...]):
+    for anc in self.ancestors(node):
+      if isinstance(anc, kinds):
+        return anc
+    return None
+
+  def enclosing_function(self, node: ast.AST):
+    return self.enclosing(
+        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+
+  def qualname(self, node: ast.AST) -> str:
+    """Dotted name of a Name/Attribute chain with the ROOT segment
+    expanded through the file's import aliases; '' when the chain
+    bottoms out in anything else (a call result, a subscript, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+      parts.append(node.attr)
+      node = node.value
+    if isinstance(node, ast.Name):
+      parts.append(self.aliases.get(node.id, node.id))
+    else:
+      return ''
+    return '.'.join(reversed(parts))
+
+  def _import_aliases(self) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(self.tree):
+      if isinstance(node, ast.Import):
+        for a in node.names:
+          out[a.asname or a.name.split('.')[0]] = (
+              a.name if a.asname else a.name.split('.')[0])
+      elif isinstance(node, ast.ImportFrom) and node.module \
+          and not node.level:
+        for a in node.names:
+          out[a.asname or a.name] = f'{node.module}.{a.name}'
+    return out
+
+  # -- suppressions ----------------------------------------------------------
+  def _suppressions(self) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for target, matches in comment_annotations(
+        self.lines, _SUPPRESS_RE).items():
+      for m in matches:
+        out.setdefault(target, set()).update(
+            r.strip() for r in m.group(1).split(',') if r.strip())
+    return out
+
+  def rule_disabled(self, rule: str, lineno: int) -> bool:
+    rules = self._suppress.get(lineno, ())
+    return rule in rules or 'all' in rules

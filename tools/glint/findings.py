@@ -1,0 +1,49 @@
+"""The finding model: one diagnostic, anchored to a file:line.
+
+Findings carry a line-content-based *fingerprint* so the baseline
+survives unrelated edits shifting line numbers — the classic reason
+line-keyed baselines rot within a week.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Finding:
+  """One diagnostic from one pass.
+
+  ``suppressed`` / ``baselined`` are stamped by the driver after the
+  pass yields the finding; passes never set them.
+  """
+
+  rule: str               #: registered pass name, e.g. 'monotonic-clock'
+  path: str               #: repo-relative posix path
+  line: int               #: 1-based line the finding anchors to
+  message: str
+  snippet: str = ''       #: source line text (stripped) at the anchor
+  suppressed: bool = False
+  baselined: bool = False
+
+  @property
+  def fingerprint(self) -> str:
+    """Stable identity for baseline matching: rule + file + the
+    *content* of the anchored line (not its number)."""
+    body = ' '.join((self.snippet or self.message).split())
+    return f'{self.rule}|{self.path}|{body}'
+
+  @property
+  def live(self) -> bool:
+    """True when this finding should fail the run."""
+    return not (self.suppressed or self.baselined)
+
+  def render(self) -> str:
+    tag = ''
+    if self.suppressed:
+      tag = '  [suppressed]'
+    elif self.baselined:
+      tag = '  [baselined]'
+    out = f'{self.path}:{self.line}: [{self.rule}] {self.message}{tag}'
+    if self.snippet:
+      out += f'\n    {self.snippet}'
+    return out

@@ -1,0 +1,130 @@
+"""event-schema: telemetry kinds/spans and the registry stay in sync.
+
+Migrated from the AST scan that lived in ``tests/test_event_schema.py``
+(the test is now a one-line driver invocation, so every new subsystem
+gets schema checking for free).  Exporters, the report CLI and
+external dashboards key off event ``kind`` / span ``name`` strings;
+an unregistered kind is a consumer that silently sees nothing, and a
+stale registry entry is a dashboard panel that can never fill.
+
+Four sub-checks, all against the dict literals in
+``telemetry/schema.py`` (parsed, not imported — the pass stays
+jax-free):
+
+  * every ``recorder.emit('<kind>', ...)`` call site in the package
+    is registered in ``EVENT_KINDS``;
+  * every registered kind still has a call site (no rot);
+  * the same pair for ``span('<name>', ...)`` vs ``SPAN_NAMES``;
+  * every registry value documents emitter + fields (>10 chars).
+
+Scope is the package (``pkg_prefix``): tests exercise ad-hoc kinds on
+private recorders by design, and bench drivers consume rather than
+emit.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from ..context import terminal_name as _callee_name
+from ..findings import Finding
+from ..registry import GlintPass, register
+
+
+def registry_tables(schema_path: Path) -> Dict[str, Dict[str, Tuple[int, object]]]:
+  """``{'EVENT_KINDS': {kind: (line, doc)}, 'SPAN_NAMES': ...}``
+  parsed from the schema module's dict literals."""
+  tree = ast.parse(Path(schema_path).read_text())
+  out: Dict[str, Dict[str, Tuple[int, object]]] = {}
+  for node in tree.body:
+    targets = []
+    if isinstance(node, ast.Assign):
+      targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+      value = node.value
+    elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+      targets = [node.target.id]
+      value = node.value
+    else:
+      continue
+    for name in targets:
+      if name in ('EVENT_KINDS', 'SPAN_NAMES') \
+          and isinstance(value, ast.Dict):
+        table: Dict[str, Tuple[int, object]] = {}
+        for k, v in zip(value.keys, value.values):
+          if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            doc = v.value if isinstance(v, ast.Constant) else None
+            table[k.value] = (k.lineno, doc)
+        out[name] = table
+  return out
+
+
+@register
+class EventSchemaPass(GlintPass):
+  name = 'event-schema'
+  description = ('every recorder.emit(kind)/span(name) call site in '
+                 'the package is registered in telemetry/schema.py, '
+                 'and the registry holds no stale entries')
+
+  def begin(self, run):
+    self._schema = run.schema_path
+    self._pkg = run.pkg_prefix.rstrip('/') + '/'
+    #: callee -> {first_string_arg: [(rel, line), ...]}
+    self._sites: Dict[str, Dict[str, List[Tuple[str, int]]]] = {
+        'emit': {}, 'span': {}}
+
+  def check_file(self, ctx):
+    if not ctx.rel.startswith(self._pkg):
+      return ()
+    for node in ast.walk(ctx.tree):
+      if (isinstance(node, ast.Call)
+          and _callee_name(node.func) in self._sites and node.args
+          and isinstance(node.args[0], ast.Constant)
+          and isinstance(node.args[0].value, str)):
+        self._sites[_callee_name(node.func)].setdefault(
+            node.args[0].value, []).append((ctx.rel, node.lineno))
+    return ()
+
+  def finish(self, run):
+    try:
+      tables = registry_tables(self._schema)
+    except (OSError, SyntaxError) as e:
+      yield Finding(
+          rule=self.name, path=str(self._schema), line=0,
+          message=f'schema registry unreadable ({e}) — nothing to '
+                  'enforce against')
+      return
+    schema_rel = self._schema_rel(run)
+    for callee, table_name in (('emit', 'EVENT_KINDS'),
+                               ('span', 'SPAN_NAMES')):
+      table = tables.get(table_name, {})
+      sites = self._sites[callee]
+      for kind, where in sorted(sites.items()):
+        if kind not in table:
+          rel, line = where[0]
+          yield Finding(
+              rule=self.name, path=rel, line=line,
+              message=f'{callee}({kind!r}) is not registered in '
+                      f'telemetry/schema.py::{table_name} — add it '
+                      'with a field summary so exporters and '
+                      'dashboards do not go stale')
+      for kind, (line, doc) in sorted(table.items()):
+        if kind not in sites:
+          yield Finding(
+              rule=self.name, path=schema_rel, line=line,
+              message=f'{table_name}[{kind!r}] has no remaining '
+                      f'{callee}() call site — remove the stale '
+                      'registry entry')
+        if not (isinstance(doc, str) and len(doc) > 10):
+          yield Finding(
+              rule=self.name, path=schema_rel, line=line,
+              message=f'{table_name}[{kind!r}] must document emitter '
+                      '+ fields (a >10 char string) — the value IS '
+                      'the consumer contract')
+
+  def _schema_rel(self, run) -> str:
+    try:
+      return self._schema.resolve().relative_to(
+          run.repo.resolve()).as_posix()
+    except ValueError:
+      return str(self._schema)

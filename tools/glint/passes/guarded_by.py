@@ -1,0 +1,132 @@
+"""guarded-by: annotated shared attributes are only touched under
+their lock.
+
+Thread-shared state in this codebase is documented by a trailing
+comment at the defining assignment::
+
+    self.in_flight = 0          # guarded-by: self._lock
+
+(or a standalone ``# guarded-by: self._lock`` comment on the line
+directly above).  This pass enforces the annotation: every other
+``self.<attr>`` load/store in the class must sit lexically inside
+``with <lock>:`` — the serving frontend's executor counters, the
+producer's supervision ledger and the RPC server's `_ReplayCache`
+all carry the contract (an unguarded touch is a data race that only
+fires under load, the worst kind of serving bug).
+
+Escape hatches, both conventions the code already uses:
+  * methods named ``*_locked`` are called with the lock held;
+  * a method containing ``# glint: holds=<lock>`` declares the same
+    for names the suffix convention doesn't fit.
+
+Scope: accesses through ``self`` within the annotating class —
+cross-object accesses (``other._attr``) are out of reach of a
+lexical checker and stay review territory.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..context import comment_annotations
+from ..findings import Finding
+from ..registry import GlintPass, register
+
+_GUARD_RE = re.compile(r'#\s*guarded-by:\s*([^\s#]+)')
+_HOLDS_RE = re.compile(r'#\s*glint:\s*holds=([^\s#]+)')
+_ATTR_RE = re.compile(r'self\.(\w+)\s*[:=]')
+
+
+def _norm(expr: str) -> str:
+  return expr.replace(' ', '')
+
+
+@register
+class GuardedByPass(GlintPass):
+  name = 'guarded-by'
+  description = ('attributes annotated "# guarded-by: <lock>" are '
+                 'only accessed under "with <lock>:" (or in *_locked '
+                 '/ "# glint: holds=<lock>" methods)')
+
+  def check_file(self, ctx):
+    # line -> lock for every guarded-by comment (trailing annotates
+    # its own line, standalone the next — the shared convention in
+    # context.comment_annotations)
+    guard_lines: Dict[int, str] = {
+        target: _norm(matches[-1].group(1))
+        for target, matches in comment_annotations(
+            ctx.lines, _GUARD_RE).items()}
+    if not guard_lines:
+      return
+
+    for cls in ast.walk(ctx.tree):
+      if isinstance(cls, ast.ClassDef):
+        yield from self._check_class(ctx, cls, guard_lines)
+
+  def _check_class(self, ctx, cls: ast.ClassDef,
+                   guard_lines: Dict[int, str]):
+    # guarded attrs declared in THIS class: the annotated line must
+    # contain a `self.<attr> =` / `self.<attr>:` assignment
+    guarded: Dict[str, str] = {}
+    decl_methods: Dict[str, ast.AST] = {}
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for meth in methods:
+      for node in ast.walk(meth):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) \
+            and node.lineno in guard_lines:
+          m = _ATTR_RE.search(ctx.lines[node.lineno - 1])
+          if m:
+            guarded[m.group(1)] = guard_lines[node.lineno]
+            decl_methods[m.group(1)] = meth
+    if not guarded:
+      return
+
+    for meth in methods:
+      span = (meth.lineno, meth.end_lineno or meth.lineno)
+      holds = self._holds(ctx, span)
+      exempt_all = meth.name.endswith('_locked') or meth.name == '__init__'
+      for node in ast.walk(meth):
+        if not (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == 'self'
+                and node.attr in guarded):
+          continue
+        lock = guarded[node.attr]
+        if exempt_all or meth is decl_methods.get(node.attr):
+          continue
+        if lock in holds:
+          continue
+        if self._under_lock(ctx, node, lock):
+          continue
+        yield Finding(
+            rule=self.name, path=ctx.rel, line=node.lineno,
+            message=f'self.{node.attr} is guarded-by {lock} but '
+                    f'accessed in {cls.name}.{meth.name} outside '
+                    f'"with {lock}:" — data race; take the lock, or '
+                    f'mark the method *_locked / "# glint: '
+                    f'holds={lock}" if callers hold it')
+
+  @staticmethod
+  def _holds(ctx, span: Tuple[int, int]) -> List[str]:
+    out = []
+    for i in range(span[0], span[1] + 1):
+      m = _HOLDS_RE.search(ctx.lines[i - 1] if i <= len(ctx.lines) else '')
+      if m:
+        out.append(_norm(m.group(1)))
+    return out
+
+  @staticmethod
+  def _under_lock(ctx, node: ast.AST, lock: str) -> bool:
+    for anc in ctx.ancestors(node):
+      if isinstance(anc, (ast.With, ast.AsyncWith)):
+        for item in anc.items:
+          if _norm(ast.unparse(item.context_expr)) == lock:
+            return True
+      if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+        # don't credit a `with` in an OUTER function to a nested def
+        # that may run later on another thread
+        return False
+    return False

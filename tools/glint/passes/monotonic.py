@@ -1,0 +1,79 @@
+"""monotonic-clock: durations and deadlines never read the wall clock.
+
+``time.time()`` steps under NTP adjustment and DST/clock-set events;
+a duration computed from it can be negative or hours long, and a
+deadline can fire immediately or never.  The span layer is monotonic
+by contract (`telemetry/spans.py` records ``mono``), and the
+resilience deadlines (PR 4/6) are built on ``time.monotonic()``.
+
+The pass flags a ``time.time()`` call only where its value flows into
+*arithmetic or comparison* — i.e. where a duration/deadline is being
+computed:
+
+* the call sits directly inside a ``BinOp`` / ``Compare`` /
+  ``AugAssign``; or
+* the call's result is bound to a plain name that is later used
+  inside a ``BinOp`` / ``Compare`` in the same function.
+
+Pure timestamps (``{'ts': time.time()}``, ``round(time.time(), 3)``)
+are wall-clock by design — heartbeats and flight-recorder events
+WANT human-correlatable time — and are not flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..findings import Finding
+from ..registry import GlintPass, register
+
+_WALL = {'time.time'}
+
+
+@register
+class MonotonicClockPass(GlintPass):
+  name = 'monotonic-clock'
+  description = ('time.time() must not feed duration/deadline '
+                 'arithmetic — use time.monotonic(); pure wall-clock '
+                 'timestamps are fine')
+
+  def check_file(self, ctx):
+    for node in ast.walk(ctx.tree):
+      if not (isinstance(node, ast.Call)
+              and ctx.qualname(node.func) in _WALL):
+        continue
+      hit = self._arithmetic_ancestor(ctx, node)
+      if hit is None:
+        hit = self._name_flows_to_arithmetic(ctx, node)
+      if hit is not None:
+        yield Finding(
+            rule=self.name, path=ctx.rel, line=node.lineno,
+            message='time.time() feeds a duration/deadline '
+                    f'computation ({hit}) — wall clock steps under '
+                    'NTP; use time.monotonic()')
+
+  @staticmethod
+  def _arithmetic_ancestor(ctx, node: ast.Call) -> Optional[str]:
+    for anc in ctx.ancestors(node):
+      if isinstance(anc, (ast.BinOp, ast.Compare, ast.AugAssign)):
+        return 'in-expression arithmetic'
+      if isinstance(anc, (ast.stmt, ast.Lambda)):
+        return None
+    return None
+
+  @staticmethod
+  def _name_flows_to_arithmetic(ctx, node: ast.Call) -> Optional[str]:
+    parent = ctx.parent(node)
+    if not (isinstance(parent, ast.Assign)
+            and len(parent.targets) == 1
+            and isinstance(parent.targets[0], ast.Name)):
+      return None
+    name = parent.targets[0].id
+    scope = ctx.enclosing_function(node) or ctx.tree
+    for n in ast.walk(scope):
+      if isinstance(n, (ast.BinOp, ast.Compare)):
+        for leaf in ast.walk(n):
+          if isinstance(leaf, ast.Name) and leaf.id == name \
+              and isinstance(leaf.ctx, ast.Load):
+            return f'via {name!r} at line {n.lineno}'
+    return None

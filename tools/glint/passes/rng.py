@@ -1,0 +1,62 @@
+"""rng-discipline: all randomness is seeded and scoped.
+
+Two hazards, both fatal to the byte-identity contracts (PR 6 resume,
+PR 9 per-seed serving determinism, PR 10 GNS exactness):
+
+* **Module-level numpy RNG** — ``np.random.randint(...)`` & friends
+  draw from the shared global ``RandomState``; any library call that
+  touches it perturbs every other consumer's stream, and a restart
+  replays nothing.  Library code constructs a ``Generator``
+  (``np.random.default_rng(seed)``) and threads it.
+
+* **Constant ``PRNGKey`` in a loop** — ``jax.random.PRNGKey(0)`` /
+  ``jax.random.key(0)`` inside a ``for``/``while`` body re-derives
+  the SAME key every iteration, so every "random" draw repeats.
+  Loops must ``fold_in`` / ``split`` from a key created outside.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..findings import Finding
+from ..registry import GlintPass, register
+
+#: the sanctioned ``np.random.*`` surface: constructors of seeded,
+#: threadable state (classes are CamelCase; ``default_rng`` is the
+#: one lowercase entry point)
+_ALLOWED_NP_RANDOM = {'default_rng'}
+
+_KEY_CTORS = {'jax.random.PRNGKey', 'jax.random.key'}
+
+
+@register
+class RngDisciplinePass(GlintPass):
+  name = 'rng-discipline'
+  description = ('no module-level np.random.* (Generator-less global '
+                 'state) and no constant PRNGKey/key construction '
+                 'inside loop bodies (fold_in/split instead)')
+
+  def check_file(self, ctx):
+    for node in ast.walk(ctx.tree):
+      if not isinstance(node, ast.Call):
+        continue
+      qn = ctx.qualname(node.func)
+      if qn.startswith('numpy.random.'):
+        attr = qn.rsplit('.', 1)[1]
+        if attr[:1].islower() and attr not in _ALLOWED_NP_RANDOM:
+          yield Finding(
+              rule=self.name, path=ctx.rel, line=node.lineno,
+              message=f'np.random.{attr}() draws from the shared '
+                      'module-level RandomState — unseeded, '
+                      'cross-contaminating, unresumable; construct '
+                      'np.random.default_rng(seed) and thread it')
+      elif qn in _KEY_CTORS and node.args \
+          and isinstance(node.args[0], ast.Constant):
+        loop = ctx.enclosing(node, (ast.For, ast.While, ast.AsyncFor))
+        if loop is not None:
+          yield Finding(
+              rule=self.name, path=ctx.rel, line=node.lineno,
+              message=f'{qn}({node.args[0].value!r}) inside a loop '
+                      'body re-derives the SAME key every iteration '
+                      '— create the key outside and fold_in/split '
+                      'the loop index')

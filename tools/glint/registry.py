@@ -1,0 +1,57 @@
+"""Pass registry: a pass is a class with a unique ``name``; the
+``@register`` decorator adds it to the table the driver instantiates
+per run.  Passes live in ``tools/glint/passes/`` — importing that
+package populates the registry (``all_passes`` does it lazily so the
+framework core stays import-light).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Type
+
+
+class GlintPass:
+  """Base pass.  Lifecycle per run::
+
+      p = PassCls()
+      p.begin(run)                  # run-level config (README paths, ...)
+      for ctx in files: p.check_file(ctx)   # yield per-file findings
+      p.finish(run)                 # yield repo-level findings
+
+  Per-file passes implement only ``check_file``; repo-level passes
+  (cross-file aggregation like knob drift) accumulate in
+  ``check_file`` and report from ``finish``.
+  """
+
+  #: unique rule name — the suppression / --rules / baseline key
+  name: str = ''
+  #: one-line description for --list-passes and the docs table
+  description: str = ''
+
+  def begin(self, run) -> None:
+    del run
+
+  def check_file(self, ctx) -> Iterable:
+    del ctx
+    return ()
+
+  def finish(self, run) -> Iterable:
+    del run
+    return ()
+
+
+_REGISTRY: Dict[str, Type[GlintPass]] = {}
+
+
+def register(cls: Type[GlintPass]) -> Type[GlintPass]:
+  if not cls.name:
+    raise ValueError(f'{cls.__name__} has no rule name')
+  if cls.name in _REGISTRY and _REGISTRY[cls.name] is not cls:
+    raise ValueError(f'duplicate glint pass name {cls.name!r}')
+  _REGISTRY[cls.name] = cls
+  return cls
+
+
+def all_passes() -> Dict[str, Type[GlintPass]]:
+  """Name -> pass class, loading the passes package on first use."""
+  from . import passes  # noqa: F401 — import side effect registers
+  return dict(_REGISTRY)
