@@ -1116,14 +1116,17 @@ def _run_serving_row(timeout: int):
       except json.JSONDecodeError:
         continue
       # the worker exits nonzero when ANY phase recompiled after
-      # warmup — stamp the verdict into the artifact row so the pin
-      # is visible there, not only in a discarded exit code
+      # warmup OR the mid-run live-ops scrape failed validation
+      # (r13: bench_serving runs with the ops endpoint on and
+      # strictly parses /metrics during traffic) — stamp the verdict
+      # into the artifact row so the pin is visible there, not only
+      # in a discarded exit code
       r['recompile_pin'] = ('ok' if out.returncode == 0
                             else 'FAILED')
       if out.returncode != 0:
-        print('serving phase: recompiles after warmup — a shape '
-              'escaped the bucket ladder (see dist.serving rows)',
-              file=sys.stderr)
+        print('serving phase: recompile after warmup or failed '
+              'live-ops scrape (see dist.serving rows / the ops '
+              'block)', file=sys.stderr)
       return r
   return None
 

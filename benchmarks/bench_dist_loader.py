@@ -674,6 +674,11 @@ def main():
                        'see benchmarks/bench_compile.py')
   args = ap.parse_args()
 
+  # live ops plane (r13): honor GLT_OPS_PORT so a long-running dist
+  # bench is scrapeable mid-run (no-op at the 0/unset default)
+  from graphlearn_tpu.telemetry import maybe_start_from_env
+  maybe_start_from_env()
+
   if args.chaos:
     chaos_smoke(batch=args.batch if args.batch != 1024 else 64,
                 num_nodes=min(args.nodes, 5000))

@@ -122,7 +122,45 @@ def drive_open_loop(frontend, plan):
   return out
 
 
-def run_phase(label: str, ds, model, params, args, result: dict):
+def scrape_ops(ops, at_s: float, out: dict, require_cache=False):
+  """Mid-run scrape thread body: after ``at_s`` seconds, pull
+  /metrics + /varz off the live ops server and STRICTLY validate the
+  Prometheus text (the acceptance check: live metrics are scrapeable
+  and well-formed DURING traffic, not after)."""
+  import json as _json
+  import urllib.request
+  from graphlearn_tpu.telemetry import parse_prometheus_text
+  time.sleep(at_s)
+  try:
+    txt = urllib.request.urlopen(f'{ops.url}/metrics',
+                                 timeout=10).read().decode()
+    samples = parse_prometheus_text(txt)
+    varz = _json.loads(urllib.request.urlopen(
+        f'{ops.url}/varz', timeout=10).read())
+    present = {
+        'queue_depth': 'glt_serving_queue_depth' in samples,
+        'shed_rate': 'glt_serving_shed_rate' in samples,
+        'latency_hist': any(k.startswith(
+            'glt_serving_request_latency_bucket') for k in samples),
+        'slo_burn_rate': any(k.startswith('glt_serving_slo_burn_rate')
+                             for k in samples),
+    }
+    if require_cache:
+      # only the tiered phase has cache traffic; the derived gauge
+      # stays absent (not a fake 0) while there is nothing to rate
+      present['cache_hit_rate'] = 'glt_cache_hit_rate' in samples
+    out.pop('error', None)          # clear the pre-filled sentinel
+    out.update(scrape_ok=True, samples=len(samples),
+               varz_keys=len(varz.get('metrics', {})),
+               present=present, all_present=all(present.values()))
+  except Exception as e:            # noqa: BLE001 — reported, scored
+    out.update(scrape_ok=False, error=f'{type(e).__name__}: {e}')
+
+
+def run_phase(label: str, ds, model, params, args, result: dict,
+              ops=None):
+  import threading
+
   import jax
   from graphlearn_tpu.serving import ServingEngine, ServingFrontend
   from graphlearn_tpu.telemetry import recorder
@@ -138,9 +176,27 @@ def run_phase(label: str, ds, model, params, args, result: dict):
   warm_compiles = eng.compile_count()
   plan = make_schedule(args.rate, args.duration, ds.get_graph().num_nodes,
                        args.zipf_a, seed=3)
+  # pre-filled FAILED so a scrape thread that outlives the join still
+  # shows up (and fails) in the acceptance check, instead of the row
+  # silently losing its 'ops' block
+  scrape: dict = {}
+  scraper = None
+  if ops is not None:
+    scrape = {'scrape_ok': False,
+              'error': 'scrape thread did not complete'}
+    # scrape mid-run (half the open-loop window in) — a stalled or
+    # slow scrape runs on the ops server's own thread and must not
+    # perturb the traffic it is observing
+    scraper = threading.Thread(
+        target=scrape_ops, args=(ops, args.duration / 2, scrape,
+                                 label == 'tiered'),
+        daemon=True)
+    scraper.start()
   t_run = time.perf_counter()
   outcomes = drive_open_loop(fe, plan)
   run_s = time.perf_counter() - t_run
+  if scraper is not None:
+    scraper.join(timeout=30.0)
   fe.shutdown()
   lats = sorted(l for l, o in outcomes if o == 'ok' and l is not None)
   shed = sum(1 for _, o in outcomes if o == 'shed')
@@ -171,6 +227,8 @@ def run_phase(label: str, ds, model, params, args, result: dict):
       'recompiles_after_warmup': eng.compile_count() - warm_compiles,
       'stats': fe.stats(),
   }
+  if scrape:
+    row['ops'] = scrape
   if cache_hits or cache_misses:
     row['cache_hit_rate'] = round(
         cache_hits / max(cache_hits + cache_misses, 1), 4)
@@ -196,6 +254,10 @@ def main(argv=None):
   ap.add_argument('--zipf-a', type=float, default=1.1)
   ap.add_argument('--split-ratio', type=float, default=0.5,
                   help='tiered phase hot fraction (0 skips the phase)')
+  ap.add_argument('--ops-port', type=int, default=-1,
+                  help='live ops endpoint: -1 (default) = ephemeral '
+                       'port + mid-run scrape validation, 0 = no ops '
+                       'plane, >0 = fixed port')
   ap.add_argument('--cpu', action='store_true')
   args = ap.parse_args(argv)
   import jax
@@ -204,18 +266,30 @@ def main(argv=None):
   from graphlearn_tpu.models.tree import TreeSAGE
   from graphlearn_tpu.telemetry import recorder
   recorder.enable(None)              # in-memory: serving cache events
+  # SLO targets for the burn-rate gauges the scrape check asserts on
+  # (operators set their own; the bench only needs the plumbing live)
+  os.environ.setdefault('GLT_SERVING_SLO_P99_MS', '100')
+  os.environ.setdefault('GLT_SERVING_SLO_QPS', str(args.rate / 2))
+  ops = None
+  if args.ops_port != 0:
+    from graphlearn_tpu.telemetry import OpsServer
+    ops = OpsServer(port=max(args.ops_port, 0))
   model = TreeSAGE(hidden_features=32, out_features=16,
                    num_layers=len(args.fanout))
   result = {'num_nodes': args.nodes, 'fanout': list(args.fanout),
-            'platform': jax.devices()[0].platform}
+            'platform': jax.devices()[0].platform,
+            'ops_enabled': ops is not None}
   ds = build_dataset(args.nodes, args.dim)
-  rows = [run_phase('hot', ds, model, None, args, result)]
+  rows = [run_phase('hot', ds, model, None, args, result, ops=ops)]
   if args.split_ratio and 0.0 < args.split_ratio < 1.0:
     ds_t = build_dataset(args.nodes, args.dim,
                          split_ratio=args.split_ratio)
     # params re-initialize under the same key -> same params; the
     # tiered phase measures the feature path, not the model
-    rows.append(run_phase('tiered', ds_t, model, None, args, result))
+    rows.append(run_phase('tiered', ds_t, model, None, args, result,
+                          ops=ops))
+  if ops is not None:
+    ops.close()
   # the zero-recompile pin covers EVERY phase (the tiered path holds
   # the extra collect/consume programs — the likelier escape route)
   bad = {r['label']: r['recompiles_after_warmup'] for r in rows
@@ -223,6 +297,15 @@ def main(argv=None):
   if bad:
     print(f'WARNING: recompile(s) after warmup {bad} — a shape '
           'escaped the bucket ladder', file=sys.stderr)
+    return 1
+  # acceptance: the mid-run scrape must have parsed as valid
+  # Prometheus text with the promised families present
+  bad_scrapes = {r['label']: r['ops'] for r in rows
+                 if 'ops' in r and not (r['ops'].get('scrape_ok')
+                                        and r['ops'].get('all_present'))}
+  if bad_scrapes:
+    print(f'WARNING: mid-run ops scrape failed validation '
+          f'{bad_scrapes}', file=sys.stderr)
     return 1
   return 0
 

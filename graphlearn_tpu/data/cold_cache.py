@@ -269,10 +269,44 @@ class CacheStats:
             'admits': self.admits, 'evicts': self.evicts}
 
 
+#: scope -> backing-store keys of the four labeled live counters,
+#: resolved (and registered for the /metrics rendering) once per scope
+_CACHE_METRIC_KEYS: dict = {}
+
+
+def _cache_metric_keys(scope: str):
+  keys = _CACHE_METRIC_KEYS.get(scope)
+  if keys is None:
+    from ..telemetry.live import live
+    labels = {'scope': scope}
+    keys = _CACHE_METRIC_KEYS[scope] = (
+        live.counter('cache.hits_total', labels=labels).key,
+        live.counter('cache.misses_total', labels=labels).key,
+        live.counter('cache.admits_total', labels=labels).key,
+        live.counter('cache.evicts_total', labels=labels).key)
+  return keys
+
+
 def emit_cache_events(scope: str, hits: int, misses: int, admits: int,
                       evicts: int) -> None:
   """Per-overlay-batch flight-recorder events (only when the recorder
-  is on; zero-count kinds are skipped so the JSONL stays signal)."""
+  is on; zero-count kinds are skipped so the JSONL stays signal).
+
+  Always mirrors the counts into the live metrics vocabulary
+  (``cache.*_total{scope=...}``, one lock acquisition) — the scrape
+  must see cache economics even when the flight recorder is off.
+  Registration goes through the live registry so the labeled
+  per-scope instances render on ``/metrics`` (an instance the
+  registry never saw would exist only in ``/varz``); the typed
+  handles are resolved ONCE per scope (`_cache_metric_keys`), so the
+  per-overlay-batch tick is a plain multi-key increment."""
+  from ..utils.profiling import metrics
+  hk, mk, ak, ek = _cache_metric_keys(scope)
+  pairs = [(k, float(v)) for k, v in
+           ((hk, hits), (mk, misses), (ak, admits), (ek, evicts))
+           if v]
+  if pairs:
+    metrics.inc_many(pairs)
   from ..telemetry.recorder import recorder
   if not recorder.enabled:
     return

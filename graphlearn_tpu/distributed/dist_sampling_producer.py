@@ -205,6 +205,8 @@ class MpSamplingProducer:
     self._sent_seqs: set = set()
     self._progress = None
     self._generations: dict = {}   # rank -> restart count
+    # staged peer-lost bundle context  # guarded-by: self._sup_lock
+    self._pending_postmortem: Optional[dict] = None
     # one supervisor at a time: the server runtime calls supervise()
     # from one RPC handler thread per in-flight fetch — without the
     # lock two threads can both restart the same dead worker (orphaned
@@ -286,6 +288,8 @@ class MpSamplingProducer:
         recorder.emit('producer.restart', worker=r, exitcode=w.exitcode,
                       replayed=0, restarts=self._restarts,
                       budget=None, at='epoch_boundary')
+        from ..utils.profiling import metrics
+        metrics.inc('producer.restarts_total')
     nw = max(len(self._workers), 1)
     # batch-aligned contiguous slices (reference `:249-260`)
     n_batches = self.num_batches(len(seeds))
@@ -337,6 +341,22 @@ class MpSamplingProducer:
   def dead_worker_exitcodes(self):
     return [w.exitcode for w in self._workers if not w.is_alive()]
 
+  def health(self) -> dict:
+    """Supervision state for `/healthz`: ``healthy`` means every
+    spawned worker is currently alive and none is declared
+    irrecoverable — a dead-but-restartable worker reads unhealthy
+    until `supervise` replaces it (exactly the during-the-incident
+    signal a liveness prober wants)."""
+    alive = self.alive_workers()
+    with self._sup_lock:
+      lost, restarts = sorted(self._lost), self._restarts
+    return {'healthy': alive == len(self._workers) and not lost,
+            'alive_workers': alive,
+            'num_workers': len(self._workers),
+            'dead_exitcodes': self.dead_worker_exitcodes(),
+            'lost_workers': lost,
+            'restarts': restarts}
+
   def _unacked(self, rank: int, acked_seqs=None):
     """The (seed_slice, seqs) of ``rank``'s current-epoch batches with
     no delivery evidence: neither in the worker's own progress acks
@@ -372,8 +392,17 @@ class MpSamplingProducer:
     from ..telemetry.recorder import recorder
     from .resilience import max_worker_restarts
     with self._sup_lock:
-      return self._supervise_locked(acked_seqs, recorder,
-                                    max_worker_restarts())
+      out = self._supervise_locked(acked_seqs, recorder,
+                                   max_worker_restarts())
+      pending = self._pending_postmortem
+      self._pending_postmortem = None
+    if pending is not None:
+      # OUTSIDE the supervision lock: the bundle's health snapshot
+      # calls back into `health()`, which takes `_sup_lock` (the
+      # lock is not reentrant — dumping under it deadlocks)
+      from ..telemetry import postmortem
+      postmortem.dump('peer.lost', extra=pending)
+    return out
 
   def _supervise_locked(self, acked_seqs, recorder, budget):
     self._drain_progress()
@@ -390,6 +419,14 @@ class MpSamplingProducer:
                         exitcode=w.exitcode,
                         outstanding=len(seqs),
                         restarts=self._restarts, budget=budget)
+          # black box: an irrecoverable worker pool is fatal — stage
+          # a post-mortem for `supervise` to write AFTER releasing
+          # `_sup_lock` (the bundle's health snapshot re-enters
+          # `health()`, which needs the lock)
+          self._pending_postmortem = {
+              'peer': f'worker-{r}', 'exitcode': w.exitcode,
+              'outstanding': len(seqs),
+              'restarts': self._restarts, 'budget': budget}
         lost_seqs.extend(seqs)
         continue
       exitcode = w.exitcode
@@ -405,6 +442,8 @@ class MpSamplingProducer:
       recorder.emit('producer.restart', worker=r, exitcode=exitcode,
                     replayed=len(seqs), restarts=self._restarts,
                     budget=budget)
+      from ..utils.profiling import metrics
+      metrics.inc('producer.restarts_total')
       restarted += 1
     return restarted, lost_seqs
 

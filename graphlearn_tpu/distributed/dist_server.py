@@ -123,6 +123,10 @@ class DistServer:
         # acks live client-side; supervise with unknown acks replays
         # the dead worker's FULL assignment (consumer dedup keeps the
         # epoch exact)
+        # an irrecoverable pool already wrote its 'peer.lost'
+        # post-mortem inside supervise() (with the worker/exitcode/
+        # outstanding context) — no second dump here, the one-shot
+        # per-reason dedup would discard it anyway
         _, lost = producer.supervise(None)
         if lost:
           raise PeerLostError(
@@ -210,6 +214,22 @@ class DistServer:
       out['serving'] = self._serving.stats()
     return out
 
+  def health(self) -> dict:
+    """The `/healthz` server component: a superset of `heartbeat` —
+    per-producer supervision state with a per-producer ``healthy``
+    verdict (any dead or irrecoverable worker flips the process
+    unhealthy until supervision replaces it).  The serving tier
+    reports through its OWN `/healthz` component, so this block
+    stays about the sampling plane."""
+    with self._lock:
+      producers = {pid: p.health()
+                   for pid, p in self._producers.items()}
+    return {'rank': self.rank,
+            'healthy': all(p['healthy'] for p in producers.values()),
+            'producers': producers,
+            'clients_left': sorted(self._left_clients),
+            'serving_attached': self._serving is not None}
+
   def notify_leave(self, client_rank: int) -> bool:
     """Record an orderly client departure — `wait_for_exit`'s timeout
     diagnostics name the clients that never called this."""
@@ -287,6 +307,14 @@ def init_server(num_servers: int, num_clients: int, rank: int,
     PartitionService(dataset, server=rpc)
   rpc.start()
   srv.port = rpc.port
+  # live ops plane: one scrapeable endpoint per server process
+  # (GLT_OPS_PORT, 0/unset = disabled) + this server's supervision
+  # state on /healthz; no-ops entirely at the default
+  from ..telemetry import opsserver
+  from ..telemetry.live import live
+  opsserver.maybe_start_from_env()
+  srv._health_fn = srv.health       # pinned: unregister is fn-guarded
+  live.register_health('server', srv._health_fn)
   _server, _rpc_server = srv, rpc
   return srv
 
@@ -299,6 +327,9 @@ def wait_and_shutdown_server(timeout: Optional[float] = None) -> None:
   global _server, _rpc_server
   if _server is not None:
     _server.wait_for_exit(timeout)
+    from ..telemetry.live import live
+    live.unregister_health('server',
+                           fn=getattr(_server, '_health_fn', None))
   if _rpc_server is not None:
     _rpc_server.shutdown()
   _server = _rpc_server = None

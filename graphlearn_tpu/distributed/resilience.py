@@ -182,11 +182,18 @@ def run_with_deadline(fn, *args, deadline: Optional[float] = None,
     from ..telemetry.recorder import recorder
     recorder.emit('mesh.stall', scope=scope, deadline_secs=deadline,
                   healthy=healthy)
-    raise MeshStallError(
+    err = MeshStallError(
         f'{scope or "dispatch"} still blocked after {deadline:.1f}s '
         f'(GLT_DISPATCH_DEADLINE) — a mesh participant likely died '
         f'mid-collective; last-known-healthy processes: {healthy}',
         healthy=healthy, deadline=deadline, scope=scope)
+    # black box (ISSUE 12): dump the recorder ring + metrics snapshot
+    # BEFORE raising — the degraded-rollback path may recover, but if
+    # the process dies instead, this bundle is the only artifact.
+    # One-shot per process; a no-op unless GLT_POSTMORTEM_DIR is set.
+    from ..telemetry import postmortem
+    postmortem.dump('mesh.stall', error=err)
+    raise err
   if 'error' in out:
     raise out['error']
   return out['value']

@@ -216,6 +216,14 @@ class _ReplayCache:
     self._max_bytes = max_bytes
     self._max_clients = max_clients
 
+  def occupancy(self) -> int:
+    """Live entries across every client — the exactly-once cache's
+    memory pressure (exported as the ``rpc.replay_cache_entries``
+    gauge; near the eviction caps = retries at risk of
+    `ReplayEvictedError`)."""
+    with self._lock:
+      return sum(len(per) for per in self._clients.values())
+
   def begin(self, token: str, seq: int):
     """Returns ``(entry, fresh)`` — ``fresh`` means the caller owns
     execution; otherwise replay (wait on ``entry.done`` if needed).
@@ -390,6 +398,13 @@ class RpcServer:
     self.host, self.port = self._server.server_address
     self._thread = threading.Thread(target=self._server.serve_forever,
                                     daemon=True)
+    # live ops plane: replay-cache occupancy at scrape time (latest
+    # RpcServer in the process wins the gauge — one server per
+    # process outside tests; shutdown() unregisters so a dead
+    # server's cache isn't pinned or reported as live)
+    from ..telemetry.live import live
+    self._occupancy_fn = replay.occupancy
+    live.gauge('rpc.replay_cache_entries', fn=self._occupancy_fn)
 
   def register(self, name: str, fn: Callable) -> None:
     """Reference `rpc_register` (`distributed/rpc.py:401-420`)."""
@@ -404,6 +419,9 @@ class RpcServer:
     server keeps answering pooled peers indefinitely — callers (and
     failure tests) must see a dead peer as ConnectionError, not as a
     healthy endpoint."""
+    from ..telemetry.live import live
+    live.unregister_gauge('rpc.replay_cache_entries',
+                          fn=self._occupancy_fn)
     self._server.shutdown()
     self._server.server_close()
     with self._alock:

@@ -1342,6 +1342,8 @@ class DistNeighborSampler(ExchangeTelemetry):
             gns_boost=self.gns_boost)
       if self.gns:
         from ..telemetry.recorder import recorder
+        from ..utils.profiling import metrics
+        metrics.inc('gns.bias_steps_total')
         recorder.emit('gns.bias', batch=int(batch_size),
                       boost=float(self.gns_boost),
                       num_parts=self.num_parts)
@@ -1487,6 +1489,8 @@ class DistNeighborSampler(ExchangeTelemetry):
       self._gns_bits = jax.device_put(
           bits, NamedSharding(self.mesh, P()))
       self._gns_ver = ver
+      from ..utils.profiling import metrics
+      metrics.inc('gns.sketch_updates_total')
       from ..telemetry.recorder import recorder
       if recorder.enabled:
         recorder.emit('gns.sketch_update', scope='dist',
@@ -2410,6 +2414,8 @@ class DistLinkNeighborSampler(DistNeighborSampler):
             gns_boost=self.gns_boost)
       if self.gns:
         from ..telemetry.recorder import recorder
+        from ..utils.profiling import metrics
+        metrics.inc('gns.bias_steps_total')
         recorder.emit('gns.bias', batch=b, mode='link',
                       boost=float(self.gns_boost),
                       num_parts=self.num_parts)

@@ -54,6 +54,31 @@ def queue_depth_from_env() -> int:
   return _env_pos(QUEUE_DEPTH_ENV, DEFAULT_QUEUE_DEPTH, int)
 
 
+#: cached live-counter handles (resolved once — the admission lock is
+#: held at every tick site, so the tick must stay a dict increment,
+#: not a registry resolution; lazy import keeps this module
+#: import-light for pure-client processes, which never reach a tick)
+_shed_counters: dict = {}
+_admitted_counter = None
+
+
+def _tick_shed(reason: str) -> None:
+  c = _shed_counters.get(reason)
+  if c is None:
+    from ..telemetry.live import live
+    c = _shed_counters[reason] = live.counter(
+        'serving.shed_total', labels={'reason': reason})
+  c.inc()
+
+
+def _tick_admitted() -> None:
+  global _admitted_counter
+  if _admitted_counter is None:
+    from ..telemetry.live import live
+    _admitted_counter = live.counter('serving.admitted_total')
+  _admitted_counter.inc()
+
+
 def deadline_ms_from_env() -> float:
   return _env_pos(DEADLINE_ENV, DEFAULT_DEADLINE_MS, float)
 
@@ -178,6 +203,7 @@ class AdmissionController:
     with self._lock:
       if self._closed:
         self.shed['shutdown'] += 1
+        _tick_shed('shutdown')
         recorder.emit('serving.shed', reason='shutdown', seeds=n,
                       queue_depth=len(self._q))
         raise AdmissionRejected('serving tier is shutting down',
@@ -185,6 +211,7 @@ class AdmissionController:
       if (self.max_request_seeds is not None
           and n > self.max_request_seeds):
         self.shed['too_large'] += 1
+        _tick_shed('too_large')
         recorder.emit('serving.shed', reason='too_large', seeds=n,
                       limit=self.max_request_seeds,
                       queue_depth=len(self._q))
@@ -196,6 +223,7 @@ class AdmissionController:
             queue_depth=len(self._q))
       if len(self._q) >= self.max_queue:
         self.shed['queue_full'] += 1
+        _tick_shed('queue_full')
         recorder.emit('serving.shed', reason='queue_full', seeds=n,
                       queue_depth=len(self._q), limit=self.max_queue)
         raise AdmissionRejected(
@@ -207,6 +235,7 @@ class AdmissionController:
       req = Request(seeds, dl / 1e3)
       self._q.append(req)
       self.admitted += 1
+      _tick_admitted()
       recorder.emit('serving.admit', seeds=n, queue_depth=len(self._q),
                     deadline_ms=dl)
       self._arrived.notify_all()
@@ -219,6 +248,7 @@ class AdmissionController:
     for req in self._q:
       if req.expired(now):
         self.shed['deadline'] += 1
+        _tick_shed('deadline')
         waited = req.waited_ms(now)
         recorder.emit('serving.shed', reason='deadline',
                       seeds=len(req.seeds), queue_depth=len(self._q),
@@ -288,6 +318,7 @@ class AdmissionController:
         from ..telemetry.recorder import recorder
         req = self._q.popleft()
         self.shed['too_large'] += 1
+        _tick_shed('too_large')
         recorder.emit('serving.shed', reason='too_large',
                       seeds=len(req.seeds), limit=max_seeds,
                       queue_depth=len(self._q))
@@ -318,6 +349,7 @@ class AdmissionController:
       while self._q:
         req = self._q.popleft()
         self.shed['shutdown'] += 1
+        _tick_shed('shutdown')
         recorder.emit('serving.shed', reason='shutdown',
                       seeds=len(req.seeds), queue_depth=len(self._q),
                       waited_ms=round(req.waited_ms(), 3))

@@ -33,9 +33,12 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..telemetry import postmortem
+from ..telemetry.live import live
 from ..telemetry.recorder import recorder
+from ..telemetry.slo import SloTracker
 from ..telemetry.spans import span
-from .admission import AdmissionController, Request
+from .admission import AdmissionController, AdmissionRejected, Request
 from .engine import ServingEngine, ServingResult
 
 MAX_WAIT_ENV = 'GLT_SERVING_MAX_WAIT_MS'
@@ -88,6 +91,37 @@ class ServingFrontend:
     self.served_seeds = 0       # guarded-by: self._lock
     self.dispatches = 0         # guarded-by: self._lock
     self.failed = 0             # guarded-by: self._lock
+    # live ops plane (ISSUE 12): typed handles for the hot path
+    # (registration is once, ticking is a dict increment), gauges
+    # evaluated at scrape time, per-bucket latency histograms, and
+    # the SLO tracker (targets from GLT_SERVING_SLO_P99_MS/_QPS).
+    # "Latest frontend wins" for the gauges/health — the contract of
+    # a process that restarts its serving tier.
+    self._m_requests = live.counter('serving.requests_total')
+    self._m_seeds = live.counter('serving.seeds_total')
+    self._m_dispatches = live.counter('serving.dispatches_total')
+    self._m_failed = live.counter('serving.failed_total')
+    # fn-gauges retain self through their callbacks — tracked so
+    # shutdown() can unregister them (fn-identity guarded: a newer
+    # frontend's replacements survive a stale one's shutdown).  The
+    # fill ratio is an fn-gauge over `_last_fill` rather than a
+    # stored value for the same reason: a dead tier must not keep
+    # exporting its final dispatch's fill as live state.
+    self._last_fill: Optional[float] = None
+    _depth_fn = self.admission.depth
+    _in_flight_fn = self._in_flight_snapshot
+    _fill_fn = self._fill_snapshot
+    live.gauge('serving.queue_depth', fn=_depth_fn)
+    live.gauge('serving.in_flight', fn=_in_flight_fn)
+    live.gauge('serving.coalesce_fill_ratio', fn=_fill_fn)
+    self._gauge_regs = [('serving.queue_depth', _depth_fn),
+                        ('serving.in_flight', _in_flight_fn),
+                        ('serving.coalesce_fill_ratio', _fill_fn)]
+    self._lat_hists: dict = {}
+    self.slo = SloTracker(registry=live)
+    # bound method pinned once — unregister compares by identity
+    self._health_fn = self._health
+    live.register_health('serving', self._health_fn)
     if auto_start:
       self.start(warmup=warmup)
 
@@ -95,6 +129,8 @@ class ServingFrontend:
   def start(self, warmup: bool = True) -> None:
     if self._thread is not None:
       return
+    from ..telemetry import opsserver
+    opsserver.maybe_start_from_env()
     if warmup and not all(self.engine.warm.values()):
       self.engine.warmup()
     self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -110,6 +146,10 @@ class ServingFrontend:
     if t is not None:
       t.join(timeout)
     self._thread = None
+    live.unregister_health('serving', fn=self._health_fn)
+    for gname, gfn in self._gauge_regs:
+      live.unregister_gauge(gname, fn=gfn)
+    self.slo.close()
 
   # -- producer side --------------------------------------------------------
   def submit(self, seeds, deadline_ms: Optional[float] = None):
@@ -195,30 +235,58 @@ class ServingFrontend:
       # the error (an RPC handler re-raises it to its client)
       with self._lock:
         self.failed += len(run)
+      self._m_failed.inc(len(run))
       for req in run:
         req.future.set_error(e)
+        lat = req.waited_ms()
+        self.slo.observe(lat, ok=False)
         recorder.emit('serving.request', seeds=len(req.seeds),
                       bucket=cap, coalesced=len(run), ok=False,
-                      latency_ms=round(req.waited_ms(), 3),
+                      latency_ms=round(lat, 3),
                       error=f'{type(e).__name__}: {e}'[:160])
+      if not isinstance(e, AdmissionRejected):
+        # the black box: an executor fault is one of the fatal-ish
+        # conditions an operator wants the last-N window for (typed
+        # sheds are load signals, not faults — no bundle for those)
+        postmortem.dump('serving.executor_fault', error=e,
+                        extra={'bucket': cap, 'requests': len(run)})
       return 0
     off = 0
+    self._last_fill = round(total / cap, 4) if cap else 0.0
+    hist = self._lat_hists.get(cap)
+    if hist is None:
+      hist = self._lat_hists[cap] = live.histogram(
+          'serving.request_latency', labels={'bucket': cap})
     for req, k in zip(run, sizes):
       req.future.set_result(batch.slice(off, off + k))
       off += k
+      lat = req.waited_ms()
+      hist.observe(lat / 1e3)
+      self.slo.observe(lat, ok=True)
       recorder.emit('serving.request', seeds=k, bucket=cap,
                     coalesced=len(run), ok=True,
-                    latency_ms=round(req.waited_ms(), 3))
+                    latency_ms=round(lat, 3))
     with self._lock:
       self.served_requests += len(run)
       self.served_seeds += total
       self.dispatches += 1
+    self._m_requests.inc(len(run))
+    self._m_seeds.inc(total)
+    self._m_dispatches.inc()
     return len(run)
 
   # -- observability --------------------------------------------------------
+  def _in_flight_snapshot(self) -> int:
+    with self._lock:
+      return self.in_flight
+
+  def _fill_snapshot(self) -> Optional[float]:
+    return self._last_fill
+
   def stats(self) -> dict:
     """The heartbeat serving block: queue depth, in-flight batch
-    size, served/shed counters, per-bucket compile status."""
+    size, served/shed counters, per-bucket compile status, SLO
+    window state."""
     with self._lock:
       out = {'in_flight': self.in_flight,
              'served_requests': self.served_requests,
@@ -228,4 +296,18 @@ class ServingFrontend:
     out.update(self.admission.stats())
     out['compile_status'] = self.engine.compile_status()
     out['max_wait_ms'] = round(self.max_wait_s * 1e3, 3)
+    out['slo'] = self.slo.snapshot()
+    return out
+
+  def _health(self) -> dict:
+    """The `/healthz` serving component: the heartbeat block plus a
+    ``healthy`` verdict — unhealthy once closed, or if the executor
+    thread was started and has since died (every queued caller would
+    hang on its future but for the admission deadline)."""
+    out = self.stats()
+    executor_dead = (self._thread is not None
+                     and not self._thread.is_alive())
+    out['executor_alive'] = (self._thread is not None
+                             and self._thread.is_alive())
+    out['healthy'] = not self._closed and not executor_dead
     return out

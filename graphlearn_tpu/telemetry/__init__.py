@@ -57,6 +57,25 @@ xprof integration: :func:`step_annotation` wraps
 steps on the TensorBoard timeline; ``bench.py --trace-dir DIR`` captures
 a trace around the fused session.
 
+The LIVE ops plane (ISSUE 12) sits beside the offline stack:
+
+  * :mod:`~graphlearn_tpu.telemetry.live` — the declared live-metric
+    registry (`LiveRegistry` / the global :data:`live`): counters and
+    log2 histograms writing through the shared `Metrics` store (one
+    vocabulary with the offline artifact and `gather_metrics`), plus
+    scrape-time gauges and health providers.
+  * :mod:`~graphlearn_tpu.telemetry.opsserver` — the per-process HTTP
+    ops endpoint (``/metrics`` Prometheus text, ``/varz`` JSON,
+    ``/healthz``), bound via ``GLT_OPS_PORT`` (0 = disabled, default).
+  * :mod:`~graphlearn_tpu.telemetry.slo` — serving SLO tracking:
+    sliding-window percentiles and multi-window error-budget burn
+    rate vs ``GLT_SERVING_SLO_P99_MS`` / ``GLT_SERVING_SLO_QPS``.
+  * :mod:`~graphlearn_tpu.telemetry.postmortem` — the black box: on
+    `MeshStallError` / irrecoverable peers / executor faults / fatal
+    signals, one timestamped bundle (recorder ring + metrics snapshot
+    + health) to ``GLT_POSTMORTEM_DIR``, rendered by
+    ``report.py --postmortem``.
+
 The low-level counter/timer registry (`Metrics`, the global
 :data:`metrics`, `trace`, `capture`) still lives in
 :mod:`graphlearn_tpu.utils.profiling` and is re-exported here.
@@ -67,15 +86,20 @@ from ..utils.profiling import (Metrics, capture, metrics, start_trace,
                                step_annotation, stop_trace, trace)
 from .aggregate import exchange_summary, gather_metrics, per_hop_padding
 from .histogram import Histogram, from_snapshot
+from .live import LiveRegistry, live, parse_prometheus_text
+from .opsserver import OpsServer, maybe_start_from_env
 from .recorder import EventRecorder, recorder
 from .sink import (artifact_path, append_record, summary_line,
                    write_artifact)
+from .slo import SloTracker
 from .spans import SpanContext, span
 
 __all__ = [
-    'EventRecorder', 'Histogram', 'Metrics', 'SpanContext',
+    'EventRecorder', 'Histogram', 'LiveRegistry', 'Metrics',
+    'OpsServer', 'SloTracker', 'SpanContext',
     'append_record', 'artifact_path', 'capture', 'exchange_summary',
-    'from_snapshot', 'gather_metrics', 'metrics', 'per_hop_padding',
+    'from_snapshot', 'gather_metrics', 'live', 'maybe_start_from_env',
+    'metrics', 'parse_prometheus_text', 'per_hop_padding',
     'recorder', 'span', 'start_trace', 'step_annotation', 'stop_trace',
     'summary_line', 'trace', 'write_artifact',
 ]

@@ -46,14 +46,22 @@ def bucket_upper_edge_secs(idx: int) -> float:
 
 def record(kind: str, secs: float, registry=None) -> None:
   """Tick one latency into ``kind``'s histogram in the metrics
-  registry (the global one by default)."""
+  registry (the global one by default).  The three keys of one
+  observation go through ``inc_many`` (one lock acquisition) so a
+  concurrent snapshot — the live ops scrape — can never see a torn
+  histogram (``count != sum(buckets)``)."""
   if registry is None:
     from ..utils.profiling import metrics
     registry = metrics
   base = f'{KEY_PREFIX}{kind}{HIST_SEP}'
-  registry.inc(f'{base}b{bucket_index(secs):02d}')
-  registry.inc(f'{base}count')
-  registry.inc(f'{base}secs', secs)
+  pairs = ((f'{base}b{bucket_index(secs):02d}', 1.0),
+           (f'{base}count', 1.0), (f'{base}secs', secs))
+  inc_many = getattr(registry, 'inc_many', None)
+  if inc_many is not None:
+    inc_many(pairs)
+  else:                           # bare-Metrics lookalikes in tests
+    for k, v in pairs:
+      registry.inc(k, v)
 
 
 class Histogram:

@@ -104,6 +104,8 @@ class EventRecorder:
     self._file_events = 0
     self._max_file_events = int(max_file_events)
     self._dropped_file_events = 0
+    self._ring_dropped = 0
+    self._overflow_emitted = False
     self.enabled = False
     if path:
       self.enable(path)
@@ -168,9 +170,19 @@ class EventRecorder:
           'kind': kind}
     for k, v in fields.items():
       ev[k] = _jsonable(v)
+    overflow = False
     with self._lock:
       if not self.enabled:        # raced a disable()
         return
+      if len(self._ring) == self._ring.maxlen:
+        # the deque drops its oldest event on this append — count it
+        # (the "did my window silently shrink" question an operator
+        # asks an incident ring) and flag the FIRST drop for the
+        # one-shot overflow event below
+        self._ring_dropped += 1
+        if not self._overflow_emitted:
+          self._overflow_emitted = True
+          overflow = True
       self._ring.append(ev)
       if self._file is not None:
         if self._file_events < self._max_file_events:
@@ -183,6 +195,22 @@ class EventRecorder:
             self._close_file_locked()
         else:
           self._dropped_file_events += 1
+    if overflow:
+      # one-shot, OUTSIDE the lock (this is a recursive emit; the
+      # `_overflow_emitted` flag is already set, so it cannot loop):
+      # the event marks WHEN the ring started losing history — the
+      # cumulative count lives in `stats()['ring_dropped']` and the
+      # `recorder.ring_dropped` live gauge
+      self.emit('recorder.overflow', ring_capacity=self._ring.maxlen)
+
+  @property
+  def dropped_total(self) -> int:
+    """Events lost to in-memory ring overflow since construction or
+    the last `clear` (a cleared ring is a fresh window — stale drop
+    counts would make a later post-mortem claim a partial window it
+    never had)."""
+    with self._lock:
+      return self._ring_dropped
 
   def events(self, kind: Optional[str] = None) -> List[Dict]:
     """Snapshot of the in-memory ring (newest last), optionally
@@ -194,8 +222,13 @@ class EventRecorder:
     return [e for e in evs if e['kind'] == kind]
 
   def clear(self) -> None:
+    """Empty the ring and reset the overflow window: drop count and
+    the one-shot `recorder.overflow` latch re-arm (the next trace's
+    first drop gets its marker again)."""
     with self._lock:
       self._ring.clear()
+      self._ring_dropped = 0
+      self._overflow_emitted = False
 
   def dump(self, path: str) -> int:
     """Write the current ring snapshot as JSONL; returns event count."""
@@ -209,6 +242,7 @@ class EventRecorder:
     with self._lock:
       return {'ring_events': len(self._ring),
               'ring_capacity': self._ring.maxlen,
+              'ring_dropped': self._ring_dropped,
               'file_events': self._file_events,
               'dropped_file_events': self._dropped_file_events}
 

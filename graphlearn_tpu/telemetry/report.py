@@ -19,6 +19,12 @@ Modes:
     `gather_metrics` aggregate dump (``{'aggregate': {...}}`` or the
     flat dict itself) and print the MERGED cross-host histograms —
     the ≥2-process mesh view.
+  * ``--postmortem BUNDLE``: render a post-mortem bundle
+    (`telemetry.postmortem`, ``GLT_POSTMORTEM_DIR``): spans still in
+    flight at dump time, final-window event deltas, the resilience
+    and serving tables over the captured ring, supervision state and
+    the SLO gauges — the after-the-incident view of a process that
+    can no longer be scraped.
 
 Quantiles from ``--metrics-json`` are log2-bucket upper edges (a 2x
 envelope); from a JSONL trace the same bucketing is applied to the raw
@@ -106,6 +112,9 @@ RESILIENCE_KINDS = (
     ('snapshot.save', 'ok'),
     ('snapshot.restore', 'dir'),
     ('mesh.stall', 'scope'),
+    ('slo.burn', 'window_secs'),
+    ('recorder.overflow', ''),
+    ('postmortem.dump', 'reason'),
 )
 
 
@@ -221,6 +230,166 @@ def format_serving_table(events) -> str:
   return '\n'.join(lines)
 
 
+def spans_in_flight(events: List[Dict],
+                    at_mono: Optional[float] = None) -> List[Dict]:
+  """Spans whose ``span.begin`` has no matching ``span.end`` in the
+  event window — at a post-mortem dump, the operations still in
+  flight when the process died (the first thing an operator asks).
+  Returns ``[{name, span_id, pid, age_s}]`` oldest-first; ``age_s``
+  needs ``at_mono`` (the bundle's dump-time monotonic clock)."""
+  open_spans: Dict[tuple, Dict] = {}
+  for e in events:
+    sid = (e.get('pid'), e.get('span_id'))
+    if e.get('kind') == 'span.begin':
+      open_spans[sid] = e
+    elif e.get('kind') == 'span.end':
+      open_spans.pop(sid, None)
+  out = []
+  for (pid, sid), e in open_spans.items():
+    row = {'name': e.get('name'), 'span_id': sid, 'pid': pid}
+    if at_mono is not None and e.get('mono') is not None:
+      row['age_s'] = round(at_mono - float(e['mono']), 3)
+    out.append(row)
+  out.sort(key=lambda r: -(r.get('age_s') or 0))
+  return out
+
+
+def final_window_counts(events: List[Dict], at_mono: float,
+                        window_s: float = 60.0) -> List[List[str]]:
+  """``[kind, last_window, total]`` rows — what ACCELERATED into the
+  crash vs the whole ring (a kind whose count concentrates in the
+  final window is the trajectory of the incident)."""
+  total: Dict[str, int] = {}
+  recent: Dict[str, int] = {}
+  horizon = at_mono - window_s
+  for e in events:
+    k = str(e.get('kind'))
+    total[k] = total.get(k, 0) + 1
+    if float(e.get('mono') or 0.0) >= horizon:
+      recent[k] = recent.get(k, 0) + 1
+  return [[k, str(recent.get(k, 0)), str(total[k])]
+          for k in sorted(total, key=lambda k: -recent.get(k, 0))]
+
+
+def _kv_table(rows: List[List[str]], header: List[str]) -> str:
+  if not rows:
+    return ''
+  widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+            for i in range(len(header))]
+  lines = ['  '.join(h.ljust(w) if i == 0 else h.rjust(w)
+                     for i, (h, w) in enumerate(zip(header, widths)))]
+  for r in rows:
+    lines.append('  '.join(c.ljust(w) if i == 0 else c.rjust(w)
+                           for i, (c, w) in enumerate(zip(r, widths))))
+  return '\n'.join(lines)
+
+
+def format_serving_health(block: Dict) -> str:
+  """Render a heartbeat/healthz serving block (queue, executor,
+  per-bucket compile status, SLO windows) as indented lines."""
+  lines = []
+  for key in ('healthy', 'executor_alive', 'queue_depth', 'max_queue',
+              'in_flight', 'admitted', 'served_requests',
+              'dispatches', 'failed', 'max_wait_ms'):
+    if key in block:
+      lines.append(f'  {key}: {block[key]}')
+  shed = block.get('shed')
+  if isinstance(shed, dict):
+    lines.append('  shed: ' + ', '.join(
+        f'{k}={v}' for k, v in sorted(shed.items())))
+  cs = block.get('compile_status') or {}
+  if cs.get('buckets'):
+    lines.append('  buckets: ' + ', '.join(
+        f'{c}={"warm" if w else "COLD"}'
+        for c, w in sorted(cs['buckets'].items(),
+                           key=lambda kv: int(kv[0]))))
+  slo = block.get('slo') or {}
+  for w in slo.get('windows', []):
+    lines.append(
+        f"  slo[{int(w['window_secs'])}s]: count={w['count']} "
+        f"p50={w['p50_ms']}ms p99={w['p99_ms']}ms qps={w['qps']} "
+        f"burn={w['burn_rate']}"
+        + (f" (target p99 {slo['p99_target_ms']}ms)"
+           if slo.get('p99_target_ms') else ''))
+  return '\n'.join(lines)
+
+
+def render_postmortem(bundle: Dict) -> str:
+  """The ``--postmortem`` view of one bundle: what died, what was in
+  flight, what accelerated into the final window, the resilience /
+  serving tables over the captured ring, supervision state, and the
+  SLO gauge values at dump time."""
+  import datetime
+  events = bundle.get('events', [])
+  out: List[str] = []
+  when = datetime.datetime.fromtimestamp(
+      bundle.get('ts', 0)).isoformat(timespec='seconds')
+  out.append(f"# post-mortem: {bundle.get('reason')} @ {when} "
+             f"(pid {bundle.get('pid')}, {len(events)} ring events)")
+  err = bundle.get('error')
+  if err:
+    detail = ', '.join(f'{k}={v}' for k, v in sorted(err.items())
+                       if k not in ('type', 'message'))
+    out.append(f"error: {err.get('type')}: {err.get('message')}"
+               + (f'  [{detail}]' if detail else ''))
+  if bundle.get('extra'):
+    out.append('context: ' + ', '.join(
+        f'{k}={v}' for k, v in sorted(bundle['extra'].items())))
+  inflight = spans_in_flight(events, at_mono=bundle.get('mono'))
+  out.append('# spans in flight at dump'
+             + (' (none)' if not inflight else ''))
+  for row in inflight[:20]:
+    age = f" open {row['age_s']}s" if row.get('age_s') is not None \
+        else ''
+    out.append(f"  {row['name']}  pid={row['pid']}{age}")
+  if bundle.get('mono') is not None and events:
+    out.append('# event counts, final 60s window vs whole ring')
+    out.append(_kv_table(
+        final_window_counts(events, float(bundle['mono'])),
+        ['kind', 'last_60s', 'total']))
+  res = format_resilience_table(events)
+  if res:
+    out.append('# resilience events')
+    out.append(res)
+  srv = format_serving_table(events)
+  if srv:
+    out.append('# serving request latency percentiles')
+    out.append(srv)
+  health = bundle.get('health') or {}
+  comps = health.get('components') or {}
+  if comps:
+    out.append(f"# health at dump (ok={health.get('ok')})")
+    for name, block in sorted(comps.items()):
+      out.append(f'{name}:')
+      if name == 'serving':
+        out.append(format_serving_health(block))
+      else:
+        for k, v in sorted(block.items()):
+          if k == 'producers' and isinstance(v, dict):
+            for pid, p in sorted(v.items()):
+              out.append(f'  producer {pid}: ' + ', '.join(
+                  f'{kk}={vv}' for kk, vv in sorted(p.items())))
+          else:
+            out.append(f'  {k}: {v}')
+  metrics_snap = bundle.get('metrics') or {}
+  slo_keys = sorted(k for k in metrics_snap
+                    if k.startswith('serving.slo.'))
+  if slo_keys:
+    out.append('# SLO gauges at dump')
+    for k in slo_keys:
+      out.append(f'  {k}: {metrics_snap[k]}')
+  hists = histograms_from_events(events)
+  if hists:
+    out.append('# per-stage span latencies (captured ring)')
+    out.append(format_table(hists))
+  rec = bundle.get('recorder') or {}
+  if rec.get('ring_dropped'):
+    out.append(f"note: the ring dropped {rec['ring_dropped']} "
+               'event(s) before the dump — this window is partial '
+               '(raise GLT_TELEMETRY_EVENTS)')
+  return '\n'.join(out)
+
+
 def histograms_from_metrics_json(path: str) -> Dict[str, Histogram]:
   """Decode a `gather_metrics` dump (the ``aggregate`` dict, or the
   whole result object) into merged histograms."""
@@ -245,9 +414,19 @@ def main(argv: Optional[List[str]] = None) -> int:
   ap.add_argument('--metrics-json', metavar='FILE',
                   help='print merged histograms from a gather_metrics '
                        'aggregate dump instead of a JSONL trace')
+  ap.add_argument('--postmortem', metavar='BUNDLE',
+                  help='render a post-mortem bundle '
+                       '(GLT_POSTMORTEM_DIR output): spans in flight '
+                       'at dump, final-window event deltas, '
+                       'resilience/serving tables, supervision state')
   args = ap.parse_args(argv)
+  if args.postmortem:
+    from .postmortem import load_bundle
+    print(render_postmortem(load_bundle(args.postmortem)))
+    return 0
   if not args.trace and not args.metrics_json:
-    ap.error('need a TRACE.jsonl or --metrics-json FILE')
+    ap.error('need a TRACE.jsonl, --metrics-json FILE, or '
+             '--postmortem BUNDLE')
   if args.metrics_json:
     hists = histograms_from_metrics_json(args.metrics_json)
     print(f'# merged cross-host histograms ({args.metrics_json})')

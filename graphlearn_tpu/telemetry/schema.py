@@ -123,6 +123,20 @@ EVENT_KINDS: Dict[str, str] = {
         'seeds, queue_depth, limit / waited_ms — one per typed '
         'load-shed (the request future resolves with '
         'AdmissionRejected; nothing is silently dropped)',
+    'recorder.overflow':
+        'telemetry.recorder, ONE-SHOT on the first in-memory ring '
+        'drop: ring_capacity — from this point the flight recorder '
+        'is a sliding window, not a full history (cumulative count: '
+        'stats()["ring_dropped"] / the recorder.ring_dropped gauge)',
+    'slo.burn':
+        'telemetry.slo.SloTracker: window_secs, burn_rate, p99_ms, '
+        'target_p99_ms, qps, count — a sliding window started '
+        'consuming latency error budget faster than allotted '
+        '(burn_rate crossed 1.0; re-arms when it recovers)',
+    'postmortem.dump':
+        'telemetry.postmortem.dump: reason, path, events, '
+        'error — a post-mortem bundle (recorder ring + metrics '
+        'snapshot + health) was written to GLT_POSTMORTEM_DIR',
 }
 
 
@@ -175,6 +189,137 @@ SPAN_NAMES: Dict[str, str] = {
         '(device program + tiered host fill) — bucket, requests, '
         'seeds; queue wait is OUTSIDE this span (serving.request '
         'latency_ms minus this span = admission/coalescing wait)',
+}
+
+
+#: live-metric vocabulary (ISSUE 12): every counter/gauge/histogram
+#: registered with the live ops registry (`telemetry.live`) must use a
+#: ``snake.dot`` name from this table — enforced statically by the
+#: glint ``metric-name`` pass, the metric twin of the event-schema
+#: pass above.  The value is ``'<type>: <doc>'`` where type is one of
+#: ``counter`` / ``gauge`` / ``histogram`` (the pass also checks the
+#: registration call matches the declared type).  This table is the
+#: ONE metrics vocabulary the offline artifact, the regression gate
+#: and the fleet `/metrics` scrape share; an undeclared metric is a
+#: dashboard panel nobody can discover.
+METRIC_NAMES: Dict[str, str] = {
+    'ops.scrapes_total':
+        'counter: opsserver — HTTP requests answered by the ops '
+        'endpoint (any of /metrics, /varz, /healthz)',
+    'recorder.ring_dropped':
+        'gauge: EventRecorder.stats()["ring_dropped"] — events lost '
+        'to in-memory ring overflow (nonzero = the flight recorder '
+        'is a sliding window, see the recorder.overflow event)',
+    'serving.queue_depth':
+        'gauge: AdmissionController.depth() at scrape time — '
+        'requests waiting for the coalescing executor',
+    'serving.in_flight':
+        'gauge: requests inside the current coalesced dispatch '
+        '(frontend executor state, read under its lock)',
+    'serving.coalesce_fill_ratio':
+        'gauge: seeds/bucket_capacity of the most recent coalesced '
+        'dispatch — how much of the chosen bucket real traffic '
+        'filled (low = padding-dominated dispatches)',
+    'serving.requests_total':
+        'counter: requests resolved OK by the serving executor',
+    'serving.seeds_total':
+        'counter: seeds served across all resolved requests',
+    'serving.dispatches_total':
+        'counter: coalesced device dispatches the executor ran',
+    'serving.failed_total':
+        'counter: requests resolved with an executor error '
+        '(typed resolve — never a silent drop)',
+    'serving.admitted_total':
+        'counter: requests past admission into the bounded queue',
+    'serving.shed_total':
+        'counter: typed load-sheds, labeled by reason '
+        '(queue_full|deadline|too_large|shutdown)',
+    'serving.shed_rate':
+        'gauge: shed/(admitted+shed) over process lifetime — the '
+        'overload signal the fleet scrape alarms on',
+    'serving.request_latency':
+        'histogram: end-to-end request latency (arrival→resolve, '
+        'seconds; log2 buckets), labeled by serving bucket capacity',
+    'serving.slo.p50_ms':
+        'gauge: SloTracker short-window request latency p50 (ms)',
+    'serving.slo.p99_ms':
+        'gauge: SloTracker short-window request latency p99 (ms)',
+    'serving.slo.qps':
+        'gauge: SloTracker short-window completed-request rate',
+    'serving.slo.qps_ratio':
+        'gauge: short-window qps / GLT_SERVING_SLO_QPS (only '
+        'exported when the target is configured)',
+    'serving.slo.burn_rate':
+        'gauge: latency-SLO error-budget burn rate per sliding '
+        'window (violating_fraction / 1% budget vs '
+        'GLT_SERVING_SLO_P99_MS; >1.0 = budget burning faster than '
+        'allotted), labeled by window seconds',
+    'cache.hits_total':
+        'counter: cold-cache hits, labeled by scope '
+        '(feature|dist|serving) — mirrors the cache.hit events',
+    'cache.misses_total':
+        'counter: cold-cache misses (host-gather work), by scope',
+    'cache.admits_total':
+        'counter: rows admitted into the HBM victim ring, by scope',
+    'cache.evicts_total':
+        'counter: residents displaced by admissions, by scope',
+    'cache.hit_rate':
+        'gauge: hits/(hits+misses) summed across cache scopes — the '
+        'live twin of the bench cache_hit_rate',
+    'cache.hbm_served_rate':
+        'gauge: 1 - cold_misses/lookups from the dist feature '
+        'counters — total fraction of feature lookups served from '
+        'HBM (hot tier + victim cache)',
+    'dist.feature.lookups':
+        'counter: all mesh feature lookups (the hbm_served_rate '
+        'denominator; ticked by ExchangeTelemetry drains)',
+    'dist.feature.cold_lookups':
+        'counter: lookups past the hot tier (the cache_hit_rate '
+        'denominator)',
+    'dist.feature.cold_misses':
+        'counter: cold lookups the host gather served',
+    'dist.feature.cache_hits':
+        'counter: cold lookups the HBM victim cache served',
+    'exchange.padding_waste_pct':
+        'gauge: 100*(1 - sent/slots) over the frontier exchange '
+        'counters — the live padding-waste number the scale '
+        'envelope tracks offline',
+    'fused.compile.hits':
+        'counter: _uncached_jit dispatches served by a warm '
+        'in-memory executable',
+    'fused.compile.misses':
+        'counter: _uncached_jit dispatches that paid an XLA compile '
+        '(nonzero after warmup = a shape escaped bucketing)',
+    'gns.bias_steps_total':
+        'counter: compiled GNS-biased sampler steps built '
+        '(node + link modes)',
+    'gns.sketch_updates_total':
+        'counter: cached-set bitmask refreshes (cache-ring version '
+        'bumps reaching the sampling bias)',
+    'rpc.retries':
+        'counter: transport faults absorbed by the RPC resilience '
+        'layer (one per rpc.retry event)',
+    'rpc.replay_cache_entries':
+        'gauge: live entries across the RPC server replay cache '
+        '(exactly-once occupancy; near the eviction caps = retries '
+        'at risk of ReplayEvictedError)',
+    'producer.restarts_total':
+        'counter: sampling-worker restarts by the producer '
+        'supervisor',
+    'snapshot.saves_total':
+        'counter: durable snapshot publishes (SnapshotManager.save '
+        'ok=True)',
+    'snapshot.save_failures_total':
+        'counter: absorbed snapshot write failures (ok=False)',
+    'snapshot.save_age_seconds':
+        'gauge: seconds since the last successful snapshot save '
+        '(absent until one lands; growing past the cadence = '
+        'durability stalled)',
+    'snapshot.restore_age_seconds':
+        'gauge: seconds since the last snapshot restore (absent '
+        'unless this process resumed/rolled back)',
+    'postmortem.dumps_total':
+        'counter: post-mortem bundles written to GLT_POSTMORTEM_DIR',
 }
 
 

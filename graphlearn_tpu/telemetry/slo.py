@@ -1,0 +1,270 @@
+"""Serving SLO tracking: sliding-window percentiles + burn rate.
+
+A latency SLO of the form "99% of requests under ``T`` ms" carries an
+*error budget*: 1% of requests may exceed ``T``.  The operational
+signal is not "is p99 over T right now" (too noisy at low traffic,
+too slow at high) but the **burn rate** — how fast the window is
+consuming that budget::
+
+    burn = (violating_requests / requests) / 0.01
+
+``burn == 1`` exactly spends the budget; ``burn == 50`` (half of all
+requests violating) exhausts a month of budget in ~14 hours.  Tracking
+it over TWO windows (default 60 s and 300 s) is the standard
+multi-window alerting shape: the short window catches a fast burn
+early, the long window filters blips.
+
+`SloTracker` keeps a bounded deque of ``(mono, latency_ms, ok)``
+samples, exports everything as live gauges (``serving.slo.*`` —
+scrape-time evaluation, so an idle tier costs nothing), and emits a
+one-shot ``slo.burn`` flight-recorder event when a window's burn rate
+crosses 1.0 (re-arming when it recovers — each sustained incident
+logs once, not once per request).
+
+Targets come from ``GLT_SERVING_SLO_P99_MS`` (latency, 0/unset =
+track percentiles but never burn) and ``GLT_SERVING_SLO_QPS``
+(throughput floor, exported as the ``serving.slo.qps_ratio`` gauge —
+deliberately NOT a burn trigger: an idle tier under-serves its QPS
+target legitimately; the latency budget is the alarm).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+SLO_P99_ENV = 'GLT_SERVING_SLO_P99_MS'
+SLO_QPS_ENV = 'GLT_SERVING_SLO_QPS'
+
+#: p99 SLO => 1% of requests may violate
+DEFAULT_BUDGET = 0.01
+DEFAULT_WINDOWS = (60.0, 300.0)
+
+#: hard sample bound: 300 s at ~600 rps — past it the oldest samples
+#: age out early (the burn rate stays right for the traffic it saw)
+_MAX_SAMPLES = 200_000
+
+#: re-evaluate burn on the observe path at most this often (scrapes
+#: always evaluate fresh) — keeps the hot path at an append plus a
+#: comparison, with the periodic eval a SORT-FREE single pass (full
+#: percentile math runs only when a trip actually fires, and at
+#: scrape time on the ops server's own thread)
+_EVAL_INTERVAL_S = 1.0
+
+
+def slo_p99_ms_from_env() -> float:
+  try:
+    return max(float(os.environ.get(SLO_P99_ENV, 0.0)), 0.0)
+  except ValueError:
+    return 0.0
+
+
+def slo_qps_from_env() -> float:
+  try:
+    return max(float(os.environ.get(SLO_QPS_ENV, 0.0)), 0.0)
+  except ValueError:
+    return 0.0
+
+
+class SloTracker:
+  """Sliding-window latency/throughput SLO state for one serving tier.
+
+  Args:
+    p99_target_ms: latency SLO (None = ``GLT_SERVING_SLO_P99_MS``;
+      0 = no latency SLO — percentiles/qps still tracked).
+    qps_target: throughput floor (None = ``GLT_SERVING_SLO_QPS``).
+    windows: (short, long) sliding windows in seconds.
+    budget: allowed violating fraction (0.01 for a p99 SLO).
+    registry: `LiveRegistry` to export gauges on (None = the global
+      one; gauges evaluate lazily at scrape).
+    clock: monotonic time source (tests inject a fake).
+  """
+
+  def __init__(self, p99_target_ms: Optional[float] = None,
+               qps_target: Optional[float] = None,
+               windows: Tuple[float, ...] = DEFAULT_WINDOWS,
+               budget: float = DEFAULT_BUDGET,
+               registry=None, clock=time.monotonic):
+    self.p99_target_ms = (slo_p99_ms_from_env()
+                          if p99_target_ms is None
+                          else max(float(p99_target_ms), 0.0))
+    self.qps_target = (slo_qps_from_env() if qps_target is None
+                       else max(float(qps_target), 0.0))
+    self.windows = tuple(sorted(float(w) for w in windows))
+    self.budget = float(budget)
+    self._clock = clock
+    self._lock = threading.Lock()
+    self._samples: 'collections.deque[Tuple[float, float, bool]]' = \
+        collections.deque(maxlen=_MAX_SAMPLES)
+    #: per-window memo of (now, stats) — one scrape reads up to six
+    #: gauges, and each full evaluation copies + sorts the window;
+    #: within one scrape burst they all share one computation
+    self._stats_cache: Dict[float, Tuple[float, dict]] = {}
+    self._started = clock()
+    self._tripped: Dict[float, bool] = {w: False for w in self.windows}
+    self._last_eval = -1e18
+    if registry is None:
+      from .live import live as registry
+    self._registry = registry
+    self._registered: list = []     # [(name, labels, fn)] for close()
+    self._register_gauges(registry)
+
+  def close(self) -> None:
+    """Unregister this tracker's gauges (callback closures retain the
+    sample window — a closed serving tier must not pin up to 200k
+    samples for process lifetime).  Gauge instances a NEWER tracker
+    already took over are left alone (fn-identity guarded)."""
+    for name, labels, fn in self._registered:
+      self._registry.unregister_gauge(name, labels, fn=fn)
+    self._registered = []
+
+  # -- feeding -------------------------------------------------------------
+  def observe(self, latency_ms: float, ok: bool = True) -> None:
+    """Record one resolved request (failed requests count against the
+    budget regardless of latency).  O(1) amortized; burn evaluation
+    is throttled to `_EVAL_INTERVAL_S`."""
+    now = self._clock()
+    with self._lock:
+      self._samples.append((now, float(latency_ms), bool(ok)))
+      horizon = now - self.windows[-1]
+      while self._samples and self._samples[0][0] < horizon:
+        self._samples.popleft()
+      due = now - self._last_eval >= _EVAL_INTERVAL_S
+      if due:
+        self._last_eval = now
+    if due and self.p99_target_ms > 0:
+      self._evaluate_burn(now)
+
+  # -- window math ---------------------------------------------------------
+  def _window_samples(self, window: float, now: float):
+    horizon = now - window
+    with self._lock:
+      return [s for s in self._samples if s[0] >= horizon]
+
+  def window_stats(self, window: float,
+                   now: Optional[float] = None) -> dict:
+    """count / p50 / p99 (ms, over OK requests) / qps / violations /
+    burn for one window.  ``qps`` divides by the elapsed time when the
+    process is younger than the window (a fresh tier is not "under its
+    QPS floor" for its first five minutes)."""
+    now = self._clock() if now is None else now
+    samples = self._window_samples(window, now)
+    span = max(min(window, now - self._started), 1e-9)
+    ok_lats = sorted(lat for _, lat, ok in samples if ok)
+    violations = sum(1 for _, lat, ok in samples
+                     if not ok or (self.p99_target_ms > 0
+                                   and lat > self.p99_target_ms))
+    count = len(samples)
+    burn = ((violations / count) / self.budget
+            if count and self.p99_target_ms > 0 else 0.0)
+
+    def q(p: float) -> float:
+      if not ok_lats:
+        return 0.0
+      i = min(int(p * (len(ok_lats) - 1) + 0.5), len(ok_lats) - 1)
+      return ok_lats[i]
+
+    return {'window_secs': window, 'count': count,
+            'p50_ms': round(q(0.5), 3), 'p99_ms': round(q(0.99), 3),
+            'qps': round(len(ok_lats) / span, 3),
+            'violations': violations, 'burn_rate': round(burn, 4)}
+
+  def _window_burn(self, window: float, now: float
+                   ) -> Tuple[int, float]:
+    """(count, burn) for one window in a single sort-free pass —
+    the executor-thread evaluation must not pay the percentile sort
+    (at 600 rps the 300 s window holds ~180k samples; sorting them
+    every eval would inflate the very p99 being tracked)."""
+    horizon = now - window
+    count = violations = 0
+    with self._lock:
+      for t, lat, ok in reversed(self._samples):
+        if t < horizon:
+          break                      # deque is time-ordered
+        count += 1
+        if not ok or lat > self.p99_target_ms:
+          violations += 1
+    burn = (violations / count) / self.budget if count else 0.0
+    return count, burn
+
+  def _evaluate_burn(self, now: float) -> None:
+    from .recorder import recorder
+    for w in self.windows:
+      count, burn = self._window_burn(w, now)
+      burning = count > 0 and burn > 1.0
+      if burning and not self._tripped[w]:
+        self._tripped[w] = True
+        # full stats (percentile sort included) only here — once per
+        # incident, not once per eval
+        st = self.window_stats(w, now)
+        recorder.emit('slo.burn', window_secs=w,
+                      burn_rate=st['burn_rate'], p99_ms=st['p99_ms'],
+                      target_p99_ms=self.p99_target_ms,
+                      qps=st['qps'], count=st['count'])
+      elif not burning and self._tripped[w]:
+        self._tripped[w] = False     # re-arm: next incident logs again
+
+  def _cached_stats(self, window: float) -> dict:
+    """`window_stats` memoized across one scrape BURST: the
+    scrape-time gauges (p50/p99/qps/qps_ratio off the short window,
+    burn per window) render within ~a millisecond of each other, so
+    a 20 ms memo collapses their six copy+sort evaluations into at
+    most one per window — while staying far below any real scrape
+    interval, so back-to-back scrapes (and asserts right after a
+    traffic burst) always see fresh samples."""
+    now = self._clock()
+    with self._lock:
+      entry = self._stats_cache.get(window)
+    if entry is not None and now - entry[0] < 0.02:
+      return entry[1]
+    st = self.window_stats(window, now)
+    with self._lock:
+      self._stats_cache[window] = (now, st)
+    return st
+
+  # -- export --------------------------------------------------------------
+  def snapshot(self) -> dict:
+    """Per-window stats + targets (the heartbeat/post-mortem block).
+    Reads through the scrape memo: heartbeat RPCs and /healthz polls
+    must not pay (or serialize observe() behind) a fresh full-window
+    copy+sort each."""
+    return {'p99_target_ms': self.p99_target_ms,
+            'qps_target': self.qps_target,
+            'windows': [self._cached_stats(w) for w in self.windows]}
+
+  def _register_gauges(self, registry) -> None:
+    short = self.windows[0]
+
+    # local `gauge` keeps registration call sites LITERAL (the glint
+    # metric-name pass reads the first string arg of gauge(...) calls)
+    # while also recording each (name, labels, fn) for close()
+    def gauge(name, labels, fn):
+      registry.gauge(name, labels=labels, fn=fn)
+      self._registered.append((name, labels, fn))
+
+    def stat(key: str):
+      def read() -> Optional[float]:
+        st = self._cached_stats(short)
+        return float(st[key]) if st['count'] else None
+      return read
+
+    gauge('serving.slo.p50_ms', None, stat('p50_ms'))
+    gauge('serving.slo.p99_ms', None, stat('p99_ms'))
+    gauge('serving.slo.qps', None, stat('qps'))
+    for w in self.windows:
+      def burn(w=w) -> Optional[float]:
+        st = self._cached_stats(w)
+        if not st['count'] or self.p99_target_ms <= 0:
+          return None
+        return float(st['burn_rate'])
+      gauge('serving.slo.burn_rate', {'window': f'{int(w)}s'}, burn)
+
+    def qps_ratio() -> Optional[float]:
+      if self.qps_target <= 0:
+        return None
+      st = self._cached_stats(short)
+      return (round(st['qps'] / self.qps_target, 4)
+              if st['count'] else None)
+    gauge('serving.slo.qps_ratio', None, qps_ratio)

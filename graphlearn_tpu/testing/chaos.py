@@ -52,6 +52,13 @@ wall clocks involved).  Sites and actions:
       dies server-side; the client sees a typed error, and a
       transport-level retry of the same RPC is answered by the replay
       cache, never re-executed).
+  ``ops.scrape``
+      Seam at the top of the ops-endpoint HTTP handler
+      (`telemetry.opsserver`), ``op`` = route path (``/metrics`` /
+      ``/varz`` / ``/healthz``).  Actions: ``delay`` (a stalled
+      scraper — must never block the serving executor or a fused
+      dispatch), ``drop`` (raise :class:`InjectedFault`; the handler
+      answers HTTP 503).
 
 Plans install three ways: programmatically (:func:`install`), from the
 ``GLT_FAULT_PLAN`` env var (inherited by producer subprocesses and
@@ -93,7 +100,8 @@ FAULT_PLAN_ENV = 'GLT_FAULT_PLAN'
 WORKER_KILL_EXIT = 173
 
 _SITES = ('rpc.request', 'producer.worker', 'checkpoint.io',
-          'fused.dispatch', 'feature.cold_service', 'serving.request')
+          'fused.dispatch', 'feature.cold_service', 'serving.request',
+          'ops.scrape')
 _ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate')
 
 
@@ -356,6 +364,20 @@ def cold_service_check(scope: str = '') -> None:
     if f.action == 'fail':
       raise InjectedFault(
           f'injected cold-tier service failure (scope {scope!r})')
+
+
+def ops_scrape_check(path: str = '') -> None:
+  """Ops-endpoint seam (`telemetry.opsserver`), once per HTTP request
+  with ``op=<route path>``: ``delay`` stalls the scrape handler thread
+  in place (the isolation under test — a wedged scraper must never
+  block the serving executor or a fused dispatch), ``drop`` raises
+  `InjectedFault` (the handler answers 503; the scraper's problem,
+  nobody else's)."""
+  for f in on('ops.scrape', op=path or None):
+    if f.action == 'delay':
+      time.sleep(f.secs)
+    elif f.action == 'drop':
+      raise InjectedFault(f'injected ops scrape drop (path {path!r})')
 
 
 def serving_request_check(op: str = '') -> None:

@@ -325,6 +325,42 @@ class SnapshotManager:
         else snapshot_every_from_env()
     self._save_idx = 0
     self._boundaries = 0
+    # live ops plane: snapshot AGES at scrape time (a save-age gauge
+    # growing past the cadence = durability silently stalled — the
+    # exact condition the absorbed-failure contract can hide).
+    # Latest manager in the process wins the gauge.
+    self._last_save_mono: Optional[float] = None
+    self._last_restore_mono: Optional[float] = None
+    from ..telemetry.live import live
+    # bound methods pinned ONCE: each `self._save_age` access builds
+    # a fresh bound-method object, so close()'s fn-identity check
+    # must compare against the exact objects registered here
+    self._age_fns = (self._save_age, self._restore_age)
+    live.gauge('snapshot.save_age_seconds', fn=self._age_fns[0])
+    live.gauge('snapshot.restore_age_seconds', fn=self._age_fns[1])
+
+  def close(self) -> None:
+    """Unregister this manager's age gauges.  Call when snapshotting
+    legitimately ENDS (training finished): otherwise the save-age
+    keeps growing on a process that stopped saving on purpose — a
+    guaranteed false 'durability stalled' alarm — and the gauge
+    closure pins the manager for process lifetime.  fn-identity
+    guarded: a newer manager's gauges survive an old one's close."""
+    from ..telemetry.live import live
+    live.unregister_gauge('snapshot.save_age_seconds',
+                          fn=self._age_fns[0])
+    live.unregister_gauge('snapshot.restore_age_seconds',
+                          fn=self._age_fns[1])
+
+  def _save_age(self) -> Optional[float]:
+    if self._last_save_mono is None:
+      return None
+    return round(time.monotonic() - self._last_save_mono, 3)
+
+  def _restore_age(self) -> Optional[float]:
+    if self._last_restore_mono is None:
+      return None
+    return round(time.monotonic() - self._last_restore_mono, 3)
 
   @property
   def directory(self) -> Path:
@@ -347,12 +383,16 @@ class SnapshotManager:
       payload['train'] = jax.tree_util.tree_map(np.asarray, train)
     self._save_idx += 1
     t0 = time.perf_counter()
+    from .profiling import metrics
     try:
       self._ckpt.save(self._save_idx, payload)
     except OSError as e:
+      metrics.inc('snapshot.save_failures_total')
       recorder.emit('snapshot.save', index=self._save_idx, ok=False,
                     error=str(e), dir=str(self.directory))
       return False
+    self._last_save_mono = time.monotonic()
+    metrics.inc('snapshot.saves_total')
     recorder.emit('snapshot.save', index=self._save_idx, ok=True,
                   secs=round(time.perf_counter() - t0, 4),
                   dir=str(self.directory),
@@ -385,6 +425,7 @@ class SnapshotManager:
                       dir=str(self.directory), error=repr(e))
         continue
       self._save_idx = step          # later saves continue the index
+      self._last_restore_mono = time.monotonic()
       recorder.emit('snapshot.restore', index=step,
                     secs=round(time.perf_counter() - t0, 4),
                     dir=str(self.directory),

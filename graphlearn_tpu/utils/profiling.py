@@ -41,6 +41,17 @@ class Metrics:
     with self._lock:
       self._counts[name] = self._counts.get(name, 0) + value
 
+  def inc_many(self, pairs) -> None:
+    """Apply several increments under ONE lock acquisition, so a
+    concurrent `snapshot` sees all of them or none.  This is what
+    keeps a multi-key encoding (the log2 histogram's bucket + count +
+    secs triple) tear-free under a live scrape: a snapshot taken
+    between two plain `inc` calls would show ``count != sum(buckets)``.
+    """
+    with self._lock:
+      for name, value in pairs:
+        self._counts[name] = self._counts.get(name, 0) + value
+
   @contextlib.contextmanager
   def timer(self, name: str) -> Iterator[None]:
     t0 = time.perf_counter()

@@ -33,7 +33,8 @@ def test_at_least_six_passes_registered():
   table = all_passes()
   assert len(table) >= 6
   for expected in ('host-sync', 'rng-discipline', 'guarded-by',
-                   'monotonic-clock', 'env-knob-drift', 'event-schema'):
+                   'monotonic-clock', 'env-knob-drift', 'event-schema',
+                   'metric-name'):
     assert expected in table, f'missing pass {expected}'
   for name, cls in table.items():
     assert cls.description, f'{name} has no description'
@@ -353,6 +354,65 @@ def test_event_schema_ignores_non_package_files(tmp_path):
   run = _schema_fixture(tmp_path, kinds={}, spans={})
   src = "def go(r):\n  r.emit('adhoc.test.kind', x=1)\n"
   assert not _live(check_source(src, 'event-schema',
+                                rel='tests/mod.py', run=run))
+
+
+# -- metric-name ---------------------------------------------------------------
+def _metric_fixture(tmp_path, names) -> Run:
+  schema = tmp_path / 'schema.py'
+  table = '{' + ', '.join(f'{k!r}: {v!r}'
+                          for k, v in names.items()) + '}'
+  schema.write_text(f'METRIC_NAMES = {table}\n')
+  return Run(repo=tmp_path, schema_path=schema, pkg_prefix='pkg')
+
+
+def test_metric_name_positive(tmp_path):
+  run = _metric_fixture(tmp_path, {
+      'serving.good_total': 'counter: requests served by the tier',
+      'serving.depth': 'gauge: queue depth at scrape time',
+      'stale.metric_total': 'counter: nothing registers this anymore',
+      'bad.doc_total': 'short',
+  })
+  src = _src('''
+      def wire(live):
+        live.counter('serving.good_total')
+        live.counter('rogue.metric_total')
+        live.counter('NotSnake.Dot')
+        live.histogram('serving.depth')
+        live.gauge('bad.doc_total')
+  ''')
+  found = _live(check_source(src, 'metric-name', rel='pkg/mod.py',
+                             run=run))
+  msgs = '\n'.join(f.render() for f in found)
+  # rogue (undeclared), NotSnake.Dot (shape + undeclared), depth
+  # registered as histogram but declared gauge, bad.doc_total's
+  # declaration malformed, stale.metric_total unregistered
+  assert "counter('rogue.metric_total')" in msgs
+  assert 'not a snake.dot' in msgs
+  assert "declares it 'gauge'" in msgs
+  assert "'stale.metric_total'" in msgs and 'no remaining' in msgs
+  assert "'bad.doc_total'" in msgs and 'scrape contract' in msgs
+  assert len(found) == 6, msgs
+
+
+def test_metric_name_negative(tmp_path):
+  run = _metric_fixture(tmp_path, {
+      'serving.good_total': 'counter: requests served by the tier',
+      'serving.lat': 'histogram: request latency in log2 buckets',
+  })
+  src = _src('''
+      def wire(live, cap):
+        live.counter('serving.good_total', labels={'reason': 'x'})
+        live.histogram('serving.lat', labels={'bucket': cap})
+  ''')
+  assert not _live(check_source(src, 'metric-name', rel='pkg/mod.py',
+                                run=run))
+
+
+def test_metric_name_ignores_non_package_files(tmp_path):
+  run = _metric_fixture(tmp_path, {})
+  src = "def go(reg):\n  reg.counter('adhoc.test_total')\n"
+  assert not _live(check_source(src, 'metric-name',
                                 rel='tests/mod.py', run=run))
 
 

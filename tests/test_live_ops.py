@@ -1,0 +1,511 @@
+"""Live ops plane (ISSUE 12): scrapeable metrics registry + HTTP
+endpoint, SLO burn tracking, post-mortem bundles, and the
+off-by-default byte-identity contract.
+
+Pins, per the issue's test satellite:
+  * concurrent scrape-under-load returns a CONSISTENT snapshot (no
+    torn histogram buckets: ``count == sum(buckets)`` always);
+  * ``/healthz`` flips on an injected worker death;
+  * a post-mortem bundle is produced on an injected `MeshStallError`
+    (the existing ``fused.dispatch`` chaos site) and on a chaos
+    ``producer.worker`` kill, and ``report --postmortem`` renders it;
+  * ``GLT_OPS_PORT=0`` (the default) is byte-identical to having no
+    ops plane at all;
+  * a stalled or dropped ``ops.scrape`` never blocks the serving
+    executor.
+"""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu.data import Dataset
+from graphlearn_tpu.serving import ServingEngine, ServingFrontend
+from graphlearn_tpu.telemetry import (LiveRegistry, Metrics, OpsServer,
+                                      SloTracker, live, recorder)
+from graphlearn_tpu.telemetry import opsserver, postmortem
+from graphlearn_tpu.telemetry.histogram import from_snapshot
+from graphlearn_tpu.telemetry.live import parse_prometheus_text
+from graphlearn_tpu.telemetry.recorder import EventRecorder
+from graphlearn_tpu.testing import chaos
+
+N, D = 64, 6
+FANOUTS = [3, 2]
+BUCKETS = (1, 2, 4)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  chaos.uninstall()
+  postmortem.reset()
+  recorder.enable(None)
+  recorder.clear()
+  yield
+  chaos.uninstall()
+  postmortem.reset()
+  opsserver.stop_global()
+  live.unregister_health('server')
+  live.unregister_health('producer')
+  recorder.clear()
+  recorder.disable()
+
+
+def _dataset():
+  rng = np.random.default_rng(0)
+  rows = np.repeat(np.arange(N), 4)
+  cols = rng.integers(0, N, rows.shape[0])
+  feats = (np.arange(N, dtype=np.float32)[:, None]
+           * np.ones((1, D), np.float32))
+  return (Dataset().init_graph((rows, cols), layout='COO', num_nodes=N)
+          .init_node_features(feats))
+
+
+@pytest.fixture(scope='module')
+def engine():
+  eng = ServingEngine(_dataset(), FANOUTS, seed=7, buckets=BUCKETS)
+  eng.warmup()
+  return eng
+
+
+def _get(url, timeout=10):
+  with urllib.request.urlopen(url, timeout=timeout) as r:
+    return r.status, r.read().decode()
+
+
+# -- registry ---------------------------------------------------------------
+def test_registry_strict_declared_names():
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  with pytest.raises(ValueError, match='not declared'):
+    reg.counter('rogue.metric_total')
+  with pytest.raises(ValueError, match='snake.dot'):
+    reg.counter('NotSnake')
+  # declared under the wrong kind is refused too
+  with pytest.raises(ValueError, match="declared as 'counter'"):
+    reg.gauge('serving.requests_total')
+
+
+def test_counter_gauge_histogram_snapshot_and_prometheus():
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  reg.counter('serving.requests_total').inc(3)
+  reg.gauge('serving.queue_depth', fn=lambda: 5)
+  h = reg.histogram('serving.request_latency', labels={'bucket': 4})
+  h.observe(0.004)
+  h.observe(0.004)
+  snap = reg.snapshot()
+  assert snap['serving.requests_total'] == 3
+  assert snap['serving.queue_depth'] == 5
+  parsed = parse_prometheus_text(reg.prometheus_text())
+  assert parsed['glt_serving_requests_total'] == 3
+  assert parsed['glt_serving_queue_depth'] == 5
+  assert parsed['glt_serving_request_latency_count{bucket="4"}'] == 2
+  # +Inf cumulative bucket equals count (well-formed histogram)
+  assert parsed[
+      'glt_serving_request_latency_bucket{bucket="4",le="+Inf"}'] == 2
+  # a raising gauge drops its sample, never the scrape
+  reg.gauge('serving.in_flight', fn=lambda: 1 / 0)
+  parsed = parse_prometheus_text(reg.prometheus_text())
+  assert 'glt_serving_in_flight' not in parsed
+
+
+def test_parse_prometheus_text_rejects_malformed():
+  with pytest.raises(ValueError, match='malformed sample'):
+    parse_prometheus_text('ok_metric 1\nbroken{ 2\n')
+  with pytest.raises(ValueError, match='malformed comment'):
+    parse_prometheus_text('# not a help line\n')
+
+
+def test_concurrent_scrape_no_torn_histograms():
+  """Scrape-under-load consistency: every snapshot taken while
+  writer threads hammer one histogram must satisfy
+  ``count == sum(buckets)`` — the inc_many single-lock contract."""
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  h = reg.histogram('serving.request_latency')
+  stop = threading.Event()
+
+  def writer(seed):
+    rng = np.random.default_rng(seed)
+    while not stop.is_set():
+      h.observe(float(rng.random()) * 1e-3)
+
+  threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+             for i in range(4)]
+  for t in threads:
+    t.start()
+  try:
+    checked = 0
+    deadline = time.monotonic() + 30.0
+    while checked < 50 and time.monotonic() < deadline:
+      hists = from_snapshot(reg._backing().snapshot())
+      for hist in hists.values():
+        assert sum(hist.buckets) == hist.count, \
+            'torn histogram: bucket sum diverged from count'
+        checked += 1
+      parse_prometheus_text(reg.prometheus_text())  # always valid
+  finally:
+    stop.set()
+    for t in threads:
+      t.join(5)
+  assert checked >= 50, 'writers never produced observable load'
+  final = from_snapshot(reg._backing().snapshot())
+  assert final['serving.request_latency'].count > 0
+
+
+# -- ops endpoint -----------------------------------------------------------
+def test_ops_endpoints_serve_metrics_varz_healthz():
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  reg.counter('serving.requests_total').inc(7)
+  srv = OpsServer(registry=reg, port=0)
+  try:
+    status, txt = _get(f'{srv.url}/metrics')
+    assert status == 200
+    assert parse_prometheus_text(txt)['glt_serving_requests_total'] == 7
+    status, body = _get(f'{srv.url}/varz')
+    varz = json.loads(body)
+    assert varz['metrics']['serving.requests_total'] == 7
+    assert 'ring_capacity' in varz['recorder']
+    status, body = _get(f'{srv.url}/healthz')
+    assert status == 200 and json.loads(body)['ok'] is True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+      _get(f'{srv.url}/nope')
+    assert ei.value.code == 404
+    # the scrape counter itself ticked (the 404 too — it hit the
+    # handler past the chaos seam)
+    assert reg.snapshot()['ops.scrapes_total'] >= 4
+  finally:
+    srv.close()
+
+
+def test_healthz_flips_unhealthy_component():
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  state = {'healthy': True}
+  reg.register_health('producer', lambda: dict(state))
+  srv = OpsServer(registry=reg, port=0)
+  try:
+    status, _ = _get(f'{srv.url}/healthz')
+    assert status == 200
+    state['healthy'] = False
+    with pytest.raises(urllib.error.HTTPError) as ei:
+      _get(f'{srv.url}/healthz')
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())['ok'] is False
+  finally:
+    srv.close()
+
+
+def test_ops_port_zero_is_disabled(monkeypatch):
+  monkeypatch.setenv(opsserver.OPS_PORT_ENV, '0')
+  assert opsserver.maybe_start_from_env() is None
+  monkeypatch.delenv(opsserver.OPS_PORT_ENV)
+  assert opsserver.maybe_start_from_env() is None
+  assert opsserver.global_server() is None
+
+
+def test_ops_plane_byte_identical_to_disabled(monkeypatch, engine):
+  """GLT_OPS_PORT=0 (default): serving output with NO ops plane is
+  byte-identical to serving under a live, actively-scraped one."""
+  seeds = np.asarray([5, 9, 17], np.int64)
+  monkeypatch.setenv(opsserver.OPS_PORT_ENV, '0')
+  fe = ServingFrontend(engine, auto_start=False, warmup=False)
+  fut = fe.submit(seeds)
+  fe.pump_once(block=False)
+  base = fut.result(10)
+  fe.shutdown()
+  srv = OpsServer(port=0)             # live plane + concurrent scrape
+  try:
+    fe2 = ServingFrontend(engine, auto_start=False, warmup=False)
+    fut2 = fe2.submit(seeds)
+    _get(f'{srv.url}/metrics')
+    fe2.pump_once(block=False)
+    _get(f'{srv.url}/varz')
+    out = fut2.result(10)
+    fe2.shutdown()
+  finally:
+    srv.close()
+  assert np.asarray(base.nodes).tobytes() == \
+      np.asarray(out.nodes).tobytes()
+  assert np.asarray(base.x).tobytes() == np.asarray(out.x).tobytes()
+
+
+def test_cache_counters_render_labeled_on_metrics():
+  """emit_cache_events registers LABELED per-scope instances — the
+  /metrics rendering must carry the real counts, not a permanently
+  zero unlabeled twin (review finding on r13)."""
+  from graphlearn_tpu.data.cold_cache import emit_cache_events
+  from graphlearn_tpu.utils.profiling import metrics
+  before = metrics.snapshot().get('cache.hits_total{scope=testscope}',
+                                  0.0)
+  emit_cache_events('testscope', hits=3, misses=2, admits=1, evicts=0)
+  parsed = parse_prometheus_text(live.prometheus_text())
+  assert parsed['glt_cache_hits_total{scope="testscope"}'] \
+      == before + 3
+  assert parsed['glt_cache_misses_total{scope="testscope"}'] >= 2
+  assert 'glt_cache_hits_total' not in parsed  # no zero twin
+
+
+def test_frontend_shutdown_unregisters_gauges(engine):
+  fe = ServingFrontend(engine, auto_start=False, warmup=False)
+  reg_keys = {k for k in live._instances}
+  assert ('gauge', 'serving.queue_depth') in reg_keys
+  # a SECOND frontend takes the gauges over; the FIRST one's
+  # shutdown must not evict the replacement (fn-identity guard)
+  fe2 = ServingFrontend(engine, auto_start=False, warmup=False)
+  fe.shutdown()
+  assert ('gauge', 'serving.queue_depth') in live._instances
+  assert ('gauge', 'serving.slo.p50_ms') in live._instances
+  # the /healthz provider survives the STALE frontend's shutdown too
+  assert 'serving' in live.healthz()['components']
+  fe2.shutdown()
+  assert 'serving' not in live.healthz()['components']
+  assert ('gauge', 'serving.queue_depth') not in live._instances
+  assert ('gauge', 'serving.slo.p50_ms') not in live._instances
+  assert ('gauge', 'serving.slo.burn_rate{window=60s}') \
+      not in live._instances
+
+
+def test_rpc_and_snapshot_gauges_unregister(tmp_path):
+  from graphlearn_tpu.distributed.rpc import RpcServer
+  from graphlearn_tpu.utils.checkpoint import SnapshotManager
+  srv = RpcServer('127.0.0.1', 0)
+  srv.start()                        # shutdown() joins serve_forever
+  assert ('gauge', 'rpc.replay_cache_entries') in live._instances
+  srv.shutdown()
+  assert ('gauge', 'rpc.replay_cache_entries') not in live._instances
+  mgr = SnapshotManager(directory=str(tmp_path / 'snaps'))
+  assert ('gauge', 'snapshot.save_age_seconds') in live._instances
+  mgr.close()
+  assert ('gauge', 'snapshot.save_age_seconds') not in live._instances
+  assert ('gauge', 'snapshot.restore_age_seconds') \
+      not in live._instances
+
+
+# -- chaos: ops.scrape ------------------------------------------------------
+def test_stalled_scrape_never_blocks_executor(engine):
+  chaos.install('ops.scrape:delay:1:secs=0.8:op=/metrics')
+  srv = OpsServer(port=0)             # global registry: serving wired
+  fe = ServingFrontend(engine, auto_start=False, warmup=False)
+  done = {}
+
+  def scrape():
+    t0 = time.monotonic()
+    done['status'], done['body'] = _get(f'{srv.url}/metrics')
+    done['secs'] = time.monotonic() - t0
+
+  t = threading.Thread(target=scrape, daemon=True)
+  try:
+    t.start()
+    time.sleep(0.1)                  # scrape is now inside the delay
+    fut = fe.submit(np.asarray([3]))
+    t0 = time.monotonic()
+    assert fe.pump_once(block=False) == 1
+    fut.result(5)
+    pumped = time.monotonic() - t0
+    assert pumped < 0.5, \
+        f'executor stalled {pumped:.2f}s behind a chaos-delayed scrape'
+    t.join(10)
+    assert done['status'] == 200 and done['secs'] >= 0.8
+    parse_prometheus_text(done['body'])
+  finally:
+    fe.shutdown()
+    srv.close()
+
+
+def test_dropped_scrape_is_503_and_isolated(engine):
+  chaos.install('ops.scrape:drop:1')
+  srv = OpsServer(port=0)
+  fe = ServingFrontend(engine, auto_start=False, warmup=False)
+  try:
+    with pytest.raises(urllib.error.HTTPError) as ei:
+      _get(f'{srv.url}/metrics')
+    assert ei.value.code == 503
+    fut = fe.submit(np.asarray([3]))
+    assert fe.pump_once(block=False) == 1
+    fut.result(5)
+    # the fault fired once; the next scrape is healthy
+    status, _ = _get(f'{srv.url}/metrics')
+    assert status == 200
+  finally:
+    fe.shutdown()
+    srv.close()
+
+
+# -- SLO tracker ------------------------------------------------------------
+def test_slo_burn_trips_once_and_rearms():
+  clock = {'t': 1000.0}
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  tr = SloTracker(p99_target_ms=10.0, qps_target=50.0,
+                  windows=(10.0, 40.0), registry=reg,
+                  clock=lambda: clock['t'])
+  for _ in range(20):                # all violating: burn = 100x
+    clock['t'] += 0.3
+    tr.observe(50.0, ok=True)
+  burns = recorder.events('slo.burn')
+  assert len(burns) == 2, burns      # one per window, once each
+  assert {e['window_secs'] for e in burns} == {10.0, 40.0}
+  assert burns[0]['burn_rate'] > 1.0
+  st = tr.window_stats(10.0)
+  assert st['violations'] == st['count'] > 0
+  parsed = parse_prometheus_text(reg.prometheus_text())
+  assert parsed['glt_serving_slo_burn_rate{window="10s"}'] > 1.0
+  assert parsed['glt_serving_slo_p99_ms'] == 50.0
+  assert 'glt_serving_slo_qps_ratio' in parsed
+  # recovery: fast traffic ages the violations out -> re-armed ->
+  # a NEW burn logs again (one event per incident, not per request)
+  for _ in range(300):
+    clock['t'] += 0.3
+    tr.observe(1.0, ok=True)
+  assert tr.window_stats(10.0)['burn_rate'] == 0.0
+  recorder.clear()
+  for _ in range(20):
+    clock['t'] += 0.3
+    tr.observe(50.0, ok=True)
+  assert recorder.events('slo.burn'), 'burn did not re-arm'
+
+
+def test_slo_failed_requests_count_against_budget():
+  clock = {'t': 0.0}
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  tr = SloTracker(p99_target_ms=1000.0, windows=(10.0, 20.0),
+                  registry=reg, clock=lambda: clock['t'])
+  for _ in range(10):
+    clock['t'] += 0.3
+    tr.observe(1.0, ok=False)        # fast but FAILED
+  assert tr.window_stats(10.0)['violations'] == 10
+
+
+# -- recorder ring drops ----------------------------------------------------
+def test_ring_drop_count_and_one_shot_overflow_event():
+  rec = EventRecorder(max_events=4)
+  rec.enable()
+  for i in range(4):
+    rec.emit('adhoc.fill', i=i)
+  assert rec.dropped_total == 0
+  rec.emit('adhoc.overflowing')      # drops one + the one-shot event
+  assert rec.dropped_total == 2      # the overflow event evicts too
+  kinds = [e['kind'] for e in rec.events()]
+  assert kinds.count('recorder.overflow') == 1
+  for i in range(10):
+    rec.emit('adhoc.more', i=i)
+  kinds = [e['kind'] for e in rec.events()]
+  assert kinds.count('recorder.overflow') == 0  # aged out, not re-emitted
+  assert rec.dropped_total == 12
+  assert rec.stats()['ring_dropped'] == 12
+  # the global registry exports the GLOBAL recorder's drop count
+  assert live.gauge('recorder.ring_dropped').value() == \
+      recorder.stats()['ring_dropped']
+
+
+# -- post-mortem ------------------------------------------------------------
+def _bundles(d):
+  return sorted(p for p in os.listdir(d) if p.startswith('postmortem-'))
+
+
+def test_postmortem_on_injected_mesh_stall_and_report(
+    monkeypatch, tmp_path, capsys):
+  """THE acceptance pin: an injected MeshStallError (existing
+  fused.dispatch chaos site) produces a bundle report --postmortem
+  renders."""
+  from graphlearn_tpu.distributed.resilience import (MeshStallError,
+                                                     run_with_deadline)
+  from graphlearn_tpu.telemetry.report import main as report_main
+  from graphlearn_tpu.telemetry.spans import span
+  pmdir = tmp_path / 'pm'
+  monkeypatch.setenv(postmortem.POSTMORTEM_DIR_ENV, str(pmdir))
+  chaos.install('fused.dispatch:delay:1:secs=1.0')
+
+  def dispatch():
+    with span('fused.dispatch', chunk=0):
+      chaos.fused_dispatch_check(chunk=0, epoch=0)
+
+  with pytest.raises(MeshStallError):
+    run_with_deadline(dispatch, deadline=0.2, scope='fused.dispatch')
+  files = _bundles(pmdir)
+  assert len(files) == 1, files
+  bundle = postmortem.load_bundle(str(pmdir / files[0]))
+  assert bundle['reason'] == 'mesh.stall'
+  assert bundle['error']['type'] == 'MeshStallError'
+  kinds = {e['kind'] for e in bundle['events']}
+  assert {'fault.injected', 'mesh.stall'} <= kinds
+  assert bundle['metrics'], 'metrics snapshot missing from bundle'
+  # a second stall in the same process is one-shot: no second bundle
+  chaos.install('fused.dispatch:delay:1:secs=1.0')
+  with pytest.raises(MeshStallError):
+    run_with_deadline(dispatch, deadline=0.2, scope='fused.dispatch')
+  assert len(_bundles(pmdir)) == 1
+  assert report_main(['--postmortem', str(pmdir / files[0])]) == 0
+  out = capsys.readouterr().out
+  assert 'mesh.stall' in out
+  assert 'spans in flight' in out
+  assert 'fused.dispatch' in out
+  assert 'final 60s window' in out
+
+
+def test_postmortem_on_chaos_producer_worker_kill(monkeypatch,
+                                                  tmp_path):
+  """A chaos producer.worker kill with the restart budget exhausted
+  is an irrecoverable pool -> peer.lost bundle; /healthz flips."""
+  from graphlearn_tpu.distributed import (DistNeighborLoader,
+                                          HostDataset,
+                                          MpDistSamplingWorkerOptions,
+                                          PeerLostError)
+  pmdir = tmp_path / 'pm'
+  monkeypatch.setenv(postmortem.POSTMORTEM_DIR_ENV, str(pmdir))
+  monkeypatch.setenv('GLT_FAULT_PLAN',
+                     'producer.worker:kill:1:worker=0:epoch=0')
+  monkeypatch.setenv('GLT_MAX_WORKER_RESTARTS', '0')
+  n = 24
+  rng = np.random.default_rng(0)
+  rows = np.arange(n).repeat(2)
+  cols = (rows + rng.integers(1, n, rows.shape[0])) % n
+  ds = HostDataset.from_coo(
+      rows, cols, n,
+      node_features=rng.random((n, 4), np.float32).astype(np.float32))
+  loader = DistNeighborLoader(
+      ds, [2], np.arange(n), batch_size=4, shuffle=False,
+      worker_options=MpDistSamplingWorkerOptions(
+          num_workers=2, mp_start_method='spawn'),
+      to_device=False, seed=3)
+  live.register_health('producer', loader._producer.health)
+  assert live.healthz()['ok'] is True
+  with pytest.raises(PeerLostError):
+    for _ in loader:
+      pass
+  health = live.healthz()
+  assert health['ok'] is False, \
+      '/healthz must flip on an irrecoverable worker death'
+  comp = health['components']['producer']
+  assert comp['alive_workers'] < comp['num_workers']
+  assert comp['lost_workers'] == [0]
+  files = _bundles(pmdir)
+  assert len(files) == 1, files
+  bundle = postmortem.load_bundle(str(pmdir / files[0]))
+  assert bundle['reason'] == 'peer.lost'
+  kinds = {e['kind'] for e in bundle['events']}
+  assert 'peer.lost' in kinds
+  loader.shutdown()
+
+
+def test_postmortem_disabled_without_dir(monkeypatch):
+  monkeypatch.delenv(postmortem.POSTMORTEM_DIR_ENV, raising=False)
+  assert postmortem.dump('mesh.stall') is None
+
+
+def test_serving_executor_fault_dumps_bundle(monkeypatch, tmp_path,
+                                             engine):
+  pmdir = tmp_path / 'pm'
+  monkeypatch.setenv(postmortem.POSTMORTEM_DIR_ENV, str(pmdir))
+  chaos.install('serving.request:drop:1:op=dispatch')
+  fe = ServingFrontend(engine, auto_start=False, warmup=False)
+  fut = fe.submit(np.asarray([3]))
+  fe.pump_once(block=False)
+  with pytest.raises(chaos.InjectedFault):
+    fut.result(5)
+  fe.shutdown()
+  files = _bundles(pmdir)
+  assert len(files) == 1, files
+  assert postmortem.load_bundle(
+      str(pmdir / files[0]))['reason'] == 'serving.executor_fault'

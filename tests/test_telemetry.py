@@ -194,9 +194,18 @@ def test_recorder_concurrent_emit_with_both_bounds(tmp_path):
   lines = open(p).read().strip().splitlines()
   assert len(lines) == file_cap            # file bound holds exactly
   parsed = [json.loads(ln) for ln in lines]       # every line intact
-  assert all(pv['kind'] == 't' and 'mono' in pv for pv in parsed)
+  # r13: the FIRST ring drop emits a one-shot recorder.overflow event
+  # (it rides the same bounded file like any other event)
+  assert all(pv['kind'] in ('t', 'recorder.overflow')
+             and 'mono' in pv for pv in parsed)
+  overflow_lines = [pv for pv in parsed
+                    if pv['kind'] == 'recorder.overflow']
+  assert len(overflow_lines) == 1, 'overflow event must be one-shot'
   st = r.stats()
-  assert st['dropped_file_events'] == threads * per - file_cap
+  total_emits = threads * per + 1          # + the overflow event
+  assert st['dropped_file_events'] == total_emits - file_cap
+  # every emit past ring capacity dropped an oldest event — counted
+  assert st['ring_dropped'] == total_emits - ring_cap
   # ring: full at capacity, holding each thread's NEWEST emissions —
   # the oldest-drop contract (per-thread order is preserved by the
   # single append lock, so kept i's are each thread's tail)

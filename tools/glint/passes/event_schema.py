@@ -32,9 +32,13 @@ from ..findings import Finding
 from ..registry import GlintPass, register
 
 
-def registry_tables(schema_path: Path) -> Dict[str, Dict[str, Tuple[int, object]]]:
+def registry_tables(schema_path: Path,
+                    table_names=('EVENT_KINDS', 'SPAN_NAMES')
+                    ) -> Dict[str, Dict[str, Tuple[int, object]]]:
   """``{'EVENT_KINDS': {kind: (line, doc)}, 'SPAN_NAMES': ...}``
-  parsed from the schema module's dict literals."""
+  parsed from the schema module's dict literals (``table_names``
+  selects which — the metric-name pass reuses this for
+  ``METRIC_NAMES``)."""
   tree = ast.parse(Path(schema_path).read_text())
   out: Dict[str, Dict[str, Tuple[int, object]]] = {}
   for node in tree.body:
@@ -48,7 +52,7 @@ def registry_tables(schema_path: Path) -> Dict[str, Dict[str, Tuple[int, object]
     else:
       continue
     for name in targets:
-      if name in ('EVENT_KINDS', 'SPAN_NAMES') \
+      if name in table_names \
           and isinstance(value, ast.Dict):
         table: Dict[str, Tuple[int, object]] = {}
         for k, v in zip(value.keys, value.values):

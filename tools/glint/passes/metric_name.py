@@ -1,0 +1,132 @@
+"""metric-name: live-registry metrics use declared ``snake.dot`` names.
+
+The metric twin of the event-schema pass (ISSUE 12): the live ops
+plane (`telemetry/live.py`) serves every registered metric on the
+``/metrics`` scrape, and dashboards/alerts key off the names — an
+undeclared metric is a panel nobody can discover, a stale declaration
+is a panel that can never fill, and a name outside the ``snake.dot``
+convention breaks the dotted-vocabulary merge with the offline
+artifact.
+
+Call sites are any ``counter('<name>', ...)`` / ``gauge('<name>',
+...)`` / ``histogram('<name>', ...)`` call (terminal callee name)
+whose first argument is a string literal, scoped to the package —
+the registration surface of `LiveRegistry` however the registry
+object is spelled.  Checks, all against the ``METRIC_NAMES`` dict
+literal in ``telemetry/schema.py`` (parsed, not imported — jax-free):
+
+  * every registered name is declared, matches
+    ``snake.dot`` (lowercase segments joined by dots), and is
+    registered with the kind its declaration states (the table value
+    is ``'<type>: <doc>'``);
+  * every declared name still has a registration call site (no rot);
+  * every declaration documents type + meaning (>10 chars after the
+    type prefix).
+
+Dynamic parts (per-bucket capacities, shed reasons, scopes) belong in
+``labels={...}``, never in the name — that is what keeps the
+vocabulary enumerable.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..context import terminal_name as _callee_name
+from ..findings import Finding
+from ..registry import GlintPass, register
+from .event_schema import registry_tables
+
+#: registration callee -> declared-type prefix it must match
+_REGISTRARS = ('counter', 'gauge', 'histogram')
+
+_NAME_RE = re.compile(r'^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$')
+
+
+@register
+class MetricNamePass(GlintPass):
+  name = 'metric-name'
+  description = ('every live-registry counter/gauge/histogram uses a '
+                 'declared snake.dot name from telemetry/schema.py::'
+                 'METRIC_NAMES, with the declared type')
+
+  def begin(self, run):
+    self._schema = run.schema_path
+    self._pkg = run.pkg_prefix.rstrip('/') + '/'
+    #: name -> [(kind, rel, line), ...]
+    self._sites: Dict[str, List[Tuple[str, str, int]]] = {}
+
+  def check_file(self, ctx):
+    if not ctx.rel.startswith(self._pkg):
+      return ()
+    for node in ast.walk(ctx.tree):
+      if (isinstance(node, ast.Call)
+          and _callee_name(node.func) in _REGISTRARS and node.args
+          and isinstance(node.args[0], ast.Constant)
+          and isinstance(node.args[0].value, str)):
+        self._sites.setdefault(node.args[0].value, []).append(
+            (_callee_name(node.func), ctx.rel, node.lineno))
+    return ()
+
+  def finish(self, run):
+    try:
+      table = registry_tables(
+          self._schema, table_names=('METRIC_NAMES',)
+      ).get('METRIC_NAMES', {})
+    except (OSError, SyntaxError) as e:
+      yield Finding(
+          rule=self.name, path=str(self._schema), line=0,
+          message=f'schema registry unreadable ({e}) — nothing to '
+                  'enforce against')
+      return
+    schema_rel = self._schema_rel(run)
+    for name, sites in sorted(self._sites.items()):
+      kind, rel, line = sites[0]
+      if not _NAME_RE.match(name):
+        yield Finding(
+            rule=self.name, path=rel, line=line,
+            message=f'{kind}({name!r}) is not a snake.dot metric '
+                    'name (lowercase segments joined by dots; '
+                    'dynamic parts go in labels={...})')
+      if name not in table:
+        yield Finding(
+            rule=self.name, path=rel, line=line,
+            message=f'{kind}({name!r}) is not declared in '
+                    'telemetry/schema.py::METRIC_NAMES — add it '
+                    "with a '<type>: <doc>' value so the scrape "
+                    'vocabulary stays enumerable')
+        continue
+      doc = table[name][1]
+      declared = (doc.split(':', 1)[0].strip()
+                  if isinstance(doc, str) and ':' in doc else None)
+      for k, r, ln in sites:
+        if declared is not None and k != declared:
+          yield Finding(
+              rule=self.name, path=r, line=ln,
+              message=f'{k}({name!r}) registered as {k!r} but '
+                      f'METRIC_NAMES declares it {declared!r}')
+    for name, (line, doc) in sorted(table.items()):
+      if name not in self._sites:
+        yield Finding(
+            rule=self.name, path=schema_rel, line=line,
+            message=f'METRIC_NAMES[{name!r}] has no remaining '
+                    'registration call site — remove the stale '
+                    'declaration')
+      body = (doc.split(':', 1)[1] if isinstance(doc, str)
+              and ':' in doc else '')
+      if not (isinstance(doc, str)
+              and doc.split(':', 1)[0].strip() in _REGISTRARS
+              and len(body.strip()) > 10):
+        yield Finding(
+            rule=self.name, path=schema_rel, line=line,
+            message=f'METRIC_NAMES[{name!r}] must be '
+                    "'<counter|gauge|histogram>: <doc>' (>10 char "
+                    'doc) — the value IS the scrape contract')
+
+  def _schema_rel(self, run) -> str:
+    try:
+      return self._schema.resolve().relative_to(
+          run.repo.resolve()).as_posix()
+    except ValueError:
+      return str(self._schema)
