@@ -1092,16 +1092,14 @@ def _run_resume_row(timeout: int):
   return None
 
 
-def _run_serving_row(timeout: int):
-  """The `bench_serving.py` online-serving phase (ISSUE 9) in a
-  subprocess: Zipf open-loop traffic against the coalescing tier on a
-  single CPU device — p50/p95/p99 + sustained QPS + shed rate feed
-  the dist.serving.p99_ms / dist.serving.qps regression guards, and
-  the worker exits nonzero if any shape recompiled after warmup.
-  Returns its last JSON row (None on failure/timeout)."""
+def _run_bench_serving(timeout: int, extra_args=()):
+  """Shared `bench_serving.py` subprocess harness for the serving and
+  fleet phases: spawn with forced-CPU env, scan stdout bottom-up for
+  the last JSON line, return (row, returncode) — or None on
+  timeout/no-parseable-output."""
   script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         'benchmarks', 'bench_serving.py')
-  cmd = [sys.executable, script, '--cpu']
+  cmd = [sys.executable, script, '--cpu', *extra_args]
   env = dict(os.environ)
   env.setdefault('JAX_PLATFORMS', 'cpu')
   try:
@@ -1112,23 +1110,61 @@ def _run_serving_row(timeout: int):
   for ln in reversed((out.stdout or '').strip().splitlines()):
     if ln.startswith('{'):
       try:
-        r = json.loads(ln)
+        return json.loads(ln), out.returncode
       except json.JSONDecodeError:
         continue
-      # the worker exits nonzero when ANY phase recompiled after
-      # warmup OR the mid-run live-ops scrape failed validation
-      # (r13: bench_serving runs with the ops endpoint on and
-      # strictly parses /metrics during traffic) — stamp the verdict
-      # into the artifact row so the pin is visible there, not only
-      # in a discarded exit code
-      r['recompile_pin'] = ('ok' if out.returncode == 0
-                            else 'FAILED')
-      if out.returncode != 0:
-        print('serving phase: recompile after warmup or failed '
-              'live-ops scrape (see dist.serving rows / the ops '
-              'block)', file=sys.stderr)
-      return r
   return None
+
+
+def _run_serving_row(timeout: int):
+  """The `bench_serving.py` online-serving phase (ISSUE 9) in a
+  subprocess: Zipf open-loop traffic against the coalescing tier on a
+  single CPU device — p50/p95/p99 + sustained QPS + shed rate feed
+  the dist.serving.p99_ms / dist.serving.qps regression guards, and
+  the worker exits nonzero if any shape recompiled after warmup.
+  Returns its last JSON row (None on failure/timeout)."""
+  got = _run_bench_serving(timeout)
+  if got is None:
+    return None
+  r, returncode = got
+  # the worker exits nonzero when ANY phase recompiled after
+  # warmup OR the mid-run live-ops scrape failed validation
+  # (r13: bench_serving runs with the ops endpoint on and
+  # strictly parses /metrics during traffic) — stamp the verdict
+  # into the artifact row so the pin is visible there, not only
+  # in a discarded exit code
+  r['recompile_pin'] = 'ok' if returncode == 0 else 'FAILED'
+  if returncode != 0:
+    print('serving phase: recompile after warmup or failed '
+          'live-ops scrape (see dist.serving rows / the ops '
+          'block)', file=sys.stderr)
+  return r
+
+
+def _run_fleet_row(timeout: int):
+  """`bench_serving.py --fleet 3` (ISSUE 13): the Zipf open loop
+  spread over 3 in-process replicas behind the `FleetRouter`, with a
+  chaos stall-then-kill on one replica mid-run.  The worker exits
+  nonzero when any request failed/dropped across the failover or the
+  fleet qps recovered to < 0.6x pre-kill — stamped into
+  ``failover_pin`` so the verdict survives in the artifact.  Returns
+  the fleet keys (``fleet_qps`` / ``failover_failed_requests`` /
+  ``recovery_ratio`` / ``redriven`` / ``evictions`` + the full
+  ``fleet`` row) to merge into the dist.serving block."""
+  got = _run_bench_serving(timeout, extra_args=('--fleet', '3'))
+  if got is None or 'fleet' not in got[0]:
+    return None
+  r, returncode = got
+  keys = ('fleet_qps', 'failover_failed_requests',
+          'recovery_ratio', 'redriven', 'evictions')
+  row = {k: r[k] for k in keys if k in r}
+  row['fleet'] = r['fleet']
+  row['failover_pin'] = 'ok' if returncode == 0 else 'FAILED'
+  if returncode != 0:
+    print('fleet phase: failed/dropped requests or qps recovery '
+          'below 0.6x across the mid-run replica kill (see '
+          'dist.serving.fleet)', file=sys.stderr)
+  return row
 
 
 def _aggregate(results, fused_res, dist, hetero=None):
@@ -1487,6 +1523,19 @@ def main():
     if r is not None:
       dist['serving'] = r
       emit()
+    # fleet failover acceptance (ISSUE 13): same Zipf open loop across
+    # 3 replicas behind the FleetRouter with a stall-then-kill on one
+    # — feeds dist.serving.fleet_qps / .failover_failed_requests (the
+    # worker exits nonzero on ANY failed/dropped request or a <0.6x
+    # qps recovery, stamped into failover_pin)
+    if budget_left() > 90:
+      fr = _run_fleet_row(int(min(300, max(budget_left() - 30, 90))))
+      if fr is not None and isinstance(dist.get('serving'), dict):
+        dist['serving'].update(fr)
+        emit()
+      elif fr is not None:
+        dist['serving'] = fr
+        emit()
   elif isinstance(dist, dict) and 'error' not in dist:
     print(f'budget: skipping serving phase ({budget_left():.0f}s left)',
           file=sys.stderr)

@@ -88,28 +88,39 @@ def make_schedule(rate_rps: float, duration_s: float, n: int,
   return plan
 
 
+def pace_schedule(plan, submit):
+  """Open-loop pacing shared by the single-engine and fleet drivers:
+  submit each request at its SCHEDULED offset, never waiting on
+  earlier ones, classifying door refusals typed.  Returns
+  ``([(offset, future | 'shed' | 'error'), ...], t0)`` with ``t0``
+  the monotonic schedule origin (latency = resolve - (t0 + offset))."""
+  from graphlearn_tpu.serving import AdmissionRejected
+  out = []
+  t0 = time.monotonic()
+  for offset, seeds in plan:
+    now = time.monotonic() - t0
+    if offset > now:
+      time.sleep(offset - now)
+    try:
+      out.append((offset, submit(seeds)))
+    except AdmissionRejected:
+      out.append((offset, 'shed'))
+    except Exception:               # noqa: BLE001 — door failure
+      out.append((offset, 'error'))
+  return out, t0
+
+
 def drive_open_loop(frontend, plan):
   """Submit the plan at its scheduled times (open-loop); returns
   per-request (latency_ms | None, outcome) with latency measured from
   the SCHEDULED arrival (the future stamps its resolve time, so the
   driver's collection loop inflates nothing)."""
   from graphlearn_tpu.serving import AdmissionRejected
-  t0 = time.monotonic()              # ServingFuture stamps monotonic
-  pending = []                       # (sched offset, fut-or-marker)
-  for offset, seeds in plan:
-    now = time.monotonic() - t0
-    if offset > now:
-      time.sleep(offset - now)
-    try:
-      fut = frontend.submit(seeds)
-    except AdmissionRejected:
-      pending.append((offset, 'shed_at_door'))
-      continue
-    pending.append((offset, fut))
+  pending, t0 = pace_schedule(plan, frontend.submit)
   out = []
   for offset, fut in pending:
-    if fut == 'shed_at_door':
-      out.append((None, 'shed'))
+    if isinstance(fut, str):
+      out.append((None, fut))
       continue
     try:
       fut.result(30.0)
@@ -243,6 +254,111 @@ def run_phase(label: str, ds, model, params, args, result: dict,
   return row
 
 
+def run_fleet_phase(args, result: dict) -> dict:
+  """Fleet mode (ISSUE 13): the SAME Zipf open-loop schedule spread
+  over N in-process replicas by a `FleetRouter`, with ONE replica
+  chaos-killed mid-run.  The acceptance arithmetic: every submitted
+  request resolves ok or typed-shed (zero failed/dropped/silently
+  lost — redrive exactly-once via the router ledger), and the fleet's
+  completion rate after the kill recovers to >= 0.6x the pre-kill
+  rate within the run.  Feeds ``dist.serving.fleet_qps`` /
+  ``.failover_failed_requests``."""
+  import jax
+  from graphlearn_tpu.serving import (AdmissionRejected, FleetRouter,
+                                      LocalReplica, ServingEngine,
+                                      ServingFrontend)
+  from graphlearn_tpu.testing import chaos
+  n_rep = args.fleet
+  ds = build_dataset(args.nodes, args.dim)
+  n = ds.get_graph().num_nodes
+  replicas = []
+  t0 = time.perf_counter()
+  for i in range(n_rep):
+    # one seed across the fleet: replicas answer byte-identically, so
+    # a redriven request's survivor answer matches the lost replica's
+    eng = ServingEngine(ds, args.fanout, seed=11)
+    # a wider coalescing window than the single-engine phases keeps a
+    # little queue occupancy per replica, so the mid-run kill strands
+    # real in-flight requests for the redrive ledger to move
+    fe = ServingFrontend(eng, auto_start=True, warmup=True,
+                         max_wait_ms=10.0, default_deadline_ms=2000.0)
+    replicas.append(LocalReplica(f'r{i}', fe))
+  warm_s = time.perf_counter() - t0
+  plan = make_schedule(args.rate, args.duration, n, args.zipf_a,
+                       seed=3)
+  # mid-run kill, declared through the chaos plan: replica r0 first
+  # STALLS (every dispatch from its Dth delays — queue backs up with
+  # real in-flight requests, and the router's discriminator sees an
+  # overloaded-not-dead replica), then DIES at its Kth submit arrival
+  # (K = its expected share of the first half of the schedule, so the
+  # kill lands mid-run with requests stranded for the redrive ledger)
+  kill_t = args.duration / 2
+  pre = sum(1 for a, _ in plan if a < kill_t)
+  kill_nth = max(pre // n_rep, 2)
+  # the dispatch seam counts COALESCED runs (~half the submit count
+  # under the 10ms window), so the stall starts around the victim's
+  # half-way dispatch — several stalled runs before the kill
+  stall_nth = max(kill_nth // 2 - 4, 1)
+  chaos.install({'faults': [
+      {'site': 'serving.request', 'action': 'delay', 'op': 'dispatch',
+       'replica': 'r0', 'nth': stall_nth, 'count': 10000,
+       'secs': 0.12},
+      {'site': 'serving.replica', 'action': 'kill', 'op': 'submit',
+       'replica': 'r0', 'nth': kill_nth},
+  ]})
+  router = FleetRouter(replicas, heartbeat_ms=50.0, dead_after=2,
+                       auto_start=True)
+  t_run = time.perf_counter()
+  pending, _ = pace_schedule(plan, router.submit)
+  outcomes = []
+  for offset, fut in pending:
+    if isinstance(fut, str):
+      outcomes.append((offset, fut))
+      continue
+    try:
+      fut.result(30.0)
+      outcomes.append((offset, 'ok'))
+    except AdmissionRejected:
+      outcomes.append((offset, 'shed'))
+    except Exception:               # noqa: BLE001
+      outcomes.append((offset, 'error'))
+  run_s = time.perf_counter() - t_run
+  router_stats = router.stats()
+  router.close(close_replicas=True)
+  chaos.uninstall()
+  ok = sum(1 for _, o in outcomes if o == 'ok')
+  shed = sum(1 for _, o in outcomes if o == 'shed')
+  errors = sum(1 for _, o in outcomes if o == 'error')
+  pre_ok = sum(1 for t, o in outcomes if o == 'ok' and t < kill_t)
+  post_ok = sum(1 for t, o in outcomes if o == 'ok' and t >= kill_t)
+  pre_qps = pre_ok / max(kill_t, 1e-9)
+  post_qps = post_ok / max(args.duration - kill_t, 1e-9)
+  row = {
+      'label': 'fleet', 'replicas': n_rep, 'open_loop': True,
+      'rate_rps': args.rate, 'duration_s': args.duration,
+      'zipf_a': args.zipf_a, 'warmup_secs': round(warm_s, 2),
+      'requests': len(plan), 'completed': ok, 'shed': shed,
+      'errors': errors,
+      'kill_at_s': round(kill_t, 3), 'kill_nth_submit': kill_nth,
+      'fleet_qps': round(ok / max(run_s, 1e-9), 1),
+      'pre_kill_qps': round(pre_qps, 1),
+      'post_kill_qps': round(post_qps, 1),
+      'recovery_ratio': round(post_qps / max(pre_qps, 1e-9), 3),
+      # the acceptance counter: anything but ok/typed-shed is a
+      # failed/dropped request — MUST be 0 (exit nonzero below)
+      'failover_failed_requests': errors,
+      'redriven': router_stats['redriven'],
+      'evictions': router_stats['evictions'],
+      'router': router_stats,
+  }
+  result['fleet'] = row
+  for k in ('fleet_qps', 'failover_failed_requests', 'recovery_ratio',
+            'redriven', 'evictions'):
+    result[k] = row[k]
+  print(json.dumps(result), flush=True)
+  return row
+
+
 def main(argv=None):
   ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
   ap.add_argument('--nodes', type=int, default=20000)
@@ -252,6 +368,11 @@ def main(argv=None):
                   help='open-loop arrival rate, requests/s')
   ap.add_argument('--duration', type=float, default=3.0)
   ap.add_argument('--zipf-a', type=float, default=1.1)
+  ap.add_argument('--fleet', type=int, default=0,
+                  help='N>0: fleet mode — the same open-loop traffic '
+                       'across N replicas behind a FleetRouter with '
+                       'one mid-run chaos kill (replaces the '
+                       'single-engine phases)')
   ap.add_argument('--split-ratio', type=float, default=0.5,
                   help='tiered phase hot fraction (0 skips the phase)')
   ap.add_argument('--ops-port', type=int, default=-1,
@@ -268,12 +389,40 @@ def main(argv=None):
   recorder.enable(None)              # in-memory: serving cache events
   # SLO targets for the burn-rate gauges the scrape check asserts on
   # (operators set their own; the bench only needs the plumbing live)
+  # — set BEFORE either mode so the fleet run exports them too
   os.environ.setdefault('GLT_SERVING_SLO_P99_MS', '100')
   os.environ.setdefault('GLT_SERVING_SLO_QPS', str(args.rate / 2))
   ops = None
   if args.ops_port != 0:
     from graphlearn_tpu.telemetry import OpsServer
     ops = OpsServer(port=max(args.ops_port, 0))
+  if args.fleet > 0:
+    result = {'num_nodes': args.nodes, 'fanout': list(args.fanout),
+              'platform': jax.devices()[0].platform,
+              'ops_enabled': ops is not None}
+    try:
+      row = run_fleet_phase(args, result)
+    finally:
+      if ops is not None:
+        ops.close()
+    if row['failover_failed_requests']:
+      print(f"WARNING: {row['failover_failed_requests']} request(s) "
+            'failed/dropped across the mid-run replica kill — the '
+            'redrive ledger lost traffic', file=sys.stderr)
+      return 1
+    if row['completed'] == 0 or row['post_kill_qps'] <= 0:
+      # an all-shed run has zero errors but served nobody — that must
+      # NOT pass the failover acceptance vacuously
+      print('WARNING: fleet served no requests '
+            f"(completed={row['completed']}, "
+            f"post_kill_qps={row['post_kill_qps']})", file=sys.stderr)
+      return 1
+    if row['recovery_ratio'] < 0.6:
+      print(f"WARNING: fleet qps recovered to only "
+            f"{row['recovery_ratio']:.2f}x pre-kill (< 0.6x bar)",
+            file=sys.stderr)
+      return 1
+    return 0
   model = TreeSAGE(hidden_features=32, out_features=16,
                    num_layers=len(args.fanout))
   result = {'num_nodes': args.nodes, 'fanout': list(args.fanout),

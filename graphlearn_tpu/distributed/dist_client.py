@@ -194,8 +194,45 @@ class DistClient:
                                  deadline_ms=deadline_ms)
     except RpcError as e:
       if getattr(e, 'remote_kind', None) == 'AdmissionRejected':
+        # rebuild the typed rejection FAITHFULLY from the wire's
+        # structured extra field (reason / retry_after_ms / queue
+        # diagnostics) — a fleet router keys its reroute-vs-raise
+        # decision off `reason`, and a draining replica's retry-after
+        # hint must survive the hop
+        extra = getattr(e, 'remote_extra', None) or {}
         raise AdmissionRejected(
-            f'server {server_idx} shed the request: {e}') from e
+            f'server {server_idx} shed the request: {e}',
+            reason=extra.get('reason', ''),
+            queue_depth=extra.get('queue_depth'),
+            limit=extra.get('limit'),
+            waited_ms=extra.get('waited_ms'),
+            retry_after_ms=extra.get('retry_after_ms')) from e
+      raise
+
+  def swap_model(self, params, server_idx: Optional[int] = None,
+                 version: Optional[int] = None) -> dict:
+    """Hot model swap on one server's serving tier (ISSUE 13):
+    ships the candidate params, the server quiesces between coalesced
+    runs, parity-checks against its offline reference, and commits or
+    rolls back.  Typed `SwapParityError` / `SwapValidationError`
+    resurface here as the same classes (wire error-kind field)."""
+    from ..serving.swap import (SwapAbortedError, SwapParityError,
+                                SwapValidationError)
+    if server_idx is None:
+      server_idx = self.rank % self.num_servers
+    try:
+      return self.request_server(server_idx, 'serving_swap', params,
+                                 version=version)
+    except RpcError as e:
+      kind = getattr(e, 'remote_kind', None)
+      if kind == 'SwapParityError':
+        extra = getattr(e, 'remote_extra', None) or {}
+        raise SwapParityError(f'server {server_idx}: {e}',
+                              max_err=extra.get('max_err')) from e
+      if kind == 'SwapValidationError':
+        raise SwapValidationError(f'server {server_idx}: {e}') from e
+      if kind == 'SwapAbortedError':
+        raise SwapAbortedError(f'server {server_idx}: {e}') from e
       raise
 
   def heartbeat(self, server_idx: int, timeout: float = 2.0):

@@ -195,6 +195,22 @@ class DistServer:
       out['logits'] = np.asarray(res.logits)
     return out
 
+  def serving_swap(self, params, version=None):
+    """Drain-free hot model swap RPC (ISSUE 13): validates the
+    candidate against `offline_reference` parity before admitting
+    traffic to it, rolls back on mismatch.  `SwapParityError` /
+    `SwapValidationError` travel back typed via the wire's structured
+    error-kind field (`DistClient.swap_model` resurfaces them as the
+    same classes); runs under the replay cache like every RPC, so a
+    retried swap replays its cached verdict instead of swapping
+    twice."""
+    serving = self._serving
+    if serving is None:
+      from .rpc import RpcError
+      raise RpcError(f'server {self.rank} has no serving tier '
+                     'attached (attach_serving was never called)')
+    return serving.swap_model(params, version=version)
+
   def heartbeat(self) -> dict:
     """Liveness + health snapshot (the slow-peer / dead-peer
     discriminator `DistClient.heartbeat` keys off): which producers
@@ -295,7 +311,7 @@ def init_server(num_servers: int, num_clients: int, rank: int,
   for name in ('get_dataset_meta', 'create_sampling_producer',
                'start_new_epoch_sampling', 'fetch_one_sampled_message',
                'destroy_sampling_producer', 'exit', 'heartbeat',
-               'notify_leave', 'serve_infer'):
+               'notify_leave', 'serve_infer', 'serving_swap'):
     rpc.register(name, getattr(srv, name))
   if getattr(dataset, 'node_pb', None) is not None and \
       not isinstance(getattr(dataset, 'node_pb'), dict):

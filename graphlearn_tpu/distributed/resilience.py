@@ -77,6 +77,31 @@ class ReplayEvictedError(RpcError):
   (raise `REPLAY_ENTRIES_PER_CLIENT` or lower the prefetch fan-out)."""
 
 
+class ReplicaLostError(RuntimeError):
+  """A serving replica is gone (chaos-killed, crashed, or partitioned
+  past the fleet router's eviction threshold).  Raised by replica
+  handles on submit-to-a-dead-replica, and carried as the cause when
+  the `FleetRouter` redrives that replica's in-flight requests onto a
+  survivor.  ``replica`` names the lost handle."""
+
+  def __init__(self, msg: str, *, replica=None):
+    super().__init__(msg)
+    self.replica = replica
+
+
+class FailoverExhausted(RuntimeError):
+  """The fleet router could not place (or re-place) a request: no
+  healthy replica remained, or the request's one redrive was already
+  spent when its second replica died too.  The request's future
+  resolves with THIS — typed, never a silent drop — so the caller can
+  tell a fleet-wide outage from a per-request shed."""
+
+  def __init__(self, msg: str, *, replica=None, redriven: bool = False):
+    super().__init__(msg)
+    self.replica = replica
+    self.redriven = redriven
+
+
 class MeshStallError(RuntimeError):
   """A fused/mesh dispatch exceeded the configured dispatch deadline
   (``GLT_DISPATCH_DEADLINE``) — the signature of a collective whose

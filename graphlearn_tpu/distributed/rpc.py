@@ -150,16 +150,30 @@ class _RemoteError:
   """A handler exception shipped to the caller.  ``kind`` carries the
   original exception type name as a STRUCTURED field so clients can
   classify (e.g. a server-side `PeerLostError`) without sniffing the
-  message text; it resurfaces as ``RpcError.remote_kind``."""
+  message text; it resurfaces as ``RpcError.remote_kind``.  ``extra``
+  carries the exception's scalar attributes (an `AdmissionRejected`'s
+  ``reason``/``retry_after_ms``/``queue_depth`` diagnostics) so the
+  client can REBUILD the typed error faithfully instead of parsing
+  its message."""
 
-  def __init__(self, msg: str, kind: Optional[str] = None):
+  def __init__(self, msg: str, kind: Optional[str] = None,
+               extra: Optional[dict] = None):
     self.msg = msg
     self.kind = kind
+    self.extra = extra
+
+
+def _error_extra(exc: BaseException) -> Optional[dict]:
+  """Scalar attributes of a handler exception, wire-safe."""
+  out = {k: v for k, v in getattr(exc, '__dict__', {}).items()
+         if v is None or isinstance(v, (str, int, float, bool))}
+  return out or None
 
 
 def _remote_to_error(out: '_RemoteError') -> RpcError:
   err = RpcError(out.msg)
   err.remote_kind = getattr(out, 'kind', None)
+  err.remote_extra = getattr(out, 'extra', None)
   return err
 
 
@@ -347,7 +361,8 @@ class RpcServer:
           result = fn(*args, **kwargs)
         except Exception as exc:    # ship the error to the caller
           result = _RemoteError(f'{type(exc).__name__}: {exc}',
-                                kind=type(exc).__name__)
+                                kind=type(exc).__name__,
+                                extra=_error_extra(exc))
         try:
           frame = _encode_obj(result)
         except Exception as exc:    # unencodable result: still a reply

@@ -32,11 +32,20 @@ class BasicGNN(nn.Module):
     raise NotImplementedError
 
   @nn.compact
-  def __call__(self, x, edge_index, edge_mask=None, *, train: bool = False):
+  def __call__(self, x, edge_index, edge_mask=None, *,
+               edge_weight=None, train: bool = False):
     for i in range(self.num_layers):
       last = i == self.num_layers - 1
       out = self.out_features if last else self.hidden_features
-      x = self.make_conv(out, i)(x, edge_index, edge_mask)
+      conv = self.make_conv(out, i)
+      if edge_weight is not None:
+        # GNS 1/q importance weights (Batch.metadata['edge_weight']):
+        # only convs that define an unbiased weighted aggregation
+        # accept them (SAGEConv) — passing to others raises loudly
+        # rather than silently dropping the correction
+        x = conv(x, edge_index, edge_mask, edge_weight=edge_weight)
+      else:
+        x = conv(x, edge_index, edge_mask)
       if not last:
         x = nn.relu(x)
         if self.dropout > 0:

@@ -24,18 +24,28 @@ import jax.numpy as jnp
 
 
 def segment_mean(data: jax.Array, segment_ids: jax.Array,
-                 num_segments: int, mask: Optional[jax.Array] = None
-                 ) -> jax.Array:
+                 num_segments: int, mask: Optional[jax.Array] = None,
+                 weights: Optional[jax.Array] = None) -> jax.Array:
   """Masked mean-aggregation of edge messages into node slots.
 
   Invalid edges (mask False or negative target) are routed to segment
   ``num_segments`` which is out of range and therefore dropped by XLA's
   segment_sum — the standard static-shape trick.
+
+  ``weights`` (``[E]``, the GNS 1/q importance weights from
+  ``Batch.metadata['edge_weight']``) scale the NUMERATOR only while
+  the denominator stays the valid-edge count: the estimator is
+  ``Σ_j w_j·x_j / k``, exactly the form `ops.gns` proves unbiased for
+  the uniform neighbor mean under ANY sampling bias (the weights
+  average to 1 in expectation).  ``weights=None`` is bit-identical to
+  the unweighted path.
   """
   if mask is not None:
     segment_ids = jnp.where(mask, segment_ids, num_segments)
   else:
     segment_ids = jnp.where(segment_ids >= 0, segment_ids, num_segments)
+  if weights is not None:
+    data = data * weights.astype(data.dtype)[:, None]
   tot = jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
   # count in f32: low-precision ones (bf16) saturate near 256 under
   # scatter-add, corrupting hub-node means
@@ -90,6 +100,13 @@ class SAGEConv(nn.Module):
   ``out[v] = W_l · x[v] + W_r · mean_{u→v} x[u]`` — the layer the
   reference's flagship examples use via PyG
   (`examples/train_sage_ogbn_products.py`).
+
+  ``edge_weight`` threads the GNS per-edge 1/q importance weights
+  (``Batch.metadata['edge_weight']``, PR 10) into the aggregation so
+  cache-biased sampling stays unbiased END TO END at the model, not
+  just the estimator (mean: weighted numerator over valid-count
+  denominator; sum: weighted sum).  None = the unweighted path,
+  bit-identical to before.
   """
   out_features: int
   use_bias: bool = True
@@ -99,17 +116,24 @@ class SAGEConv(nn.Module):
 
   @nn.compact
   def __call__(self, x: jax.Array, edge_index: jax.Array,
-               edge_mask: Optional[jax.Array] = None) -> jax.Array:
+               edge_mask: Optional[jax.Array] = None,
+               edge_weight: Optional[jax.Array] = None) -> jax.Array:
     if self.dtype is not None:
       x = x.astype(self.dtype)
     n = x.shape[0]
     src, dst = edge_index[0], edge_index[1]
     msg = x[jnp.clip(src, 0, n - 1)]
     if self.aggr == 'mean':
-      agg = segment_mean(msg, dst, n, edge_mask)
+      agg = segment_mean(msg, dst, n, edge_mask, weights=edge_weight)
     elif self.aggr == 'max':
+      if edge_weight is not None:
+        raise ValueError('edge_weight has no unbiased meaning under '
+                         "max aggregation — use aggr='mean'/'sum' "
+                         'with GNS importance weights')
       agg = segment_max(msg, dst, n, edge_mask)
     elif self.aggr == 'sum':
+      if edge_weight is not None:
+        msg = msg * edge_weight.astype(msg.dtype)[:, None]
       seg = jnp.where(edge_mask, dst, n) if edge_mask is not None else dst
       agg = jax.ops.segment_sum(msg, seg, num_segments=n)
     else:

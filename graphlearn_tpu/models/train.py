@@ -70,12 +70,26 @@ def make_extracted_supervised_step(extract: Callable,
   return step
 
 
+def _apply_with_weights(apply_fn, params, batch):
+  """One definition of "apply the model to a Batch": when the sampler
+  attached GNS 1/q importance weights (``metadata['edge_weight']``,
+  PR 10), thread them into the aggregation so biased sampling stays
+  unbiased at the model (the presence check is static per pytree
+  structure — no retrace churn)."""
+  md = getattr(batch, 'metadata', None) or {}
+  ew = md.get('edge_weight') if isinstance(md, dict) else None
+  if ew is not None:
+    return apply_fn(params, batch.x, batch.edge_index, batch.edge_mask,
+                    edge_weight=ew)
+  return apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+
+
 def make_supervised_step(apply_fn, tx: optax.GradientTransformation,
                          batch_size: int):
   """Build a jitted ``(state, batch) -> (state, loss, correct)`` step."""
 
   def extract(params, batch):
-    logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+    logits = _apply_with_weights(apply_fn, params, batch)
     return logits, batch.y, batch.batch
 
   return jax.jit(make_extracted_supervised_step(extract, tx, batch_size))
@@ -99,7 +113,7 @@ def make_extracted_eval_step(extract: Callable, batch_size: int):
 def make_eval_step(apply_fn, batch_size: int):
 
   def extract(params, batch):
-    logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+    logits = _apply_with_weights(apply_fn, params, batch)
     return logits, batch.y, batch.batch
 
   return jax.jit(make_extracted_eval_step(extract, batch_size))

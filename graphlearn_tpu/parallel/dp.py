@@ -76,7 +76,11 @@ def make_dp_supervised_step(apply_fn: Callable,
     batch = jax.tree_util.tree_map(lambda x: x[0], batch)
 
     def loss_fn(params):
-      logits = apply_fn(params, batch.x, batch.edge_index, batch.edge_mask)
+      from ..models.train import _apply_with_weights
+      # the example SAGE path: GNS batches carry metadata
+      # ['edge_weight'] (PR 10 1/q weights) — threaded into the
+      # aggregation so GNS-on DP training is unbiased at the model
+      logits = _apply_with_weights(apply_fn, params, batch)
       loss = supervised_loss(logits, batch.y, batch.batch, batch_size)
       return loss, logits
 

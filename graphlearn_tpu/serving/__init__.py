@@ -9,17 +9,34 @@ loop (`frontend`).  Wire-up: build a `ServingEngine` over the served
 `DistServer.attach_serving(frontend)` — clients call
 `DistClient.serve`.
 
+Fleet resilience (ISSUE 13): `FleetRouter` spreads traffic over N
+replicas with heartbeat-classified routing and exactly-once request
+redrive on replica loss (`router`); `swap.hot_swap` swaps model
+versions drain-free behind a parity check; `aot_cache` persists
+bucket executables under ``GLT_AOT_CACHE_DIR`` so replacements warm
+from disk instead of recompiling.
+
 Knobs: ``GLT_SERVING_BUCKETS``, ``GLT_SERVING_MAX_WAIT_MS``,
 ``GLT_SERVING_QUEUE_DEPTH``, ``GLT_SERVING_DEADLINE_MS``
-(benchmarks/README "Online serving (r9)").
+(benchmarks/README "Online serving (r9)"); ``GLT_AOT_CACHE_DIR``,
+``GLT_FLEET_HEARTBEAT_MS``, ``GLT_FLEET_OVERLOAD_RATIO``,
+``GLT_SERVING_DRAIN_RETRY_MS`` ("Fleet serving & failover (r14)").
 """
 from .admission import (AdmissionController, AdmissionRejected,
                         ServingFuture)
+from .aot_cache import AotExecutableCache
 from .engine import ServingEngine, ServingResult, resolve_buckets
 from .frontend import ServingFrontend
+from .router import FleetRouter, LocalReplica, RemoteReplica, RouterFuture
+from .swap import (SwapAbortedError, SwapParityError,
+                   SwapValidationError, hot_swap)
 
 __all__ = [
     'AdmissionController', 'AdmissionRejected', 'ServingFuture',
+    'AotExecutableCache',
     'ServingEngine', 'ServingResult', 'resolve_buckets',
     'ServingFrontend',
+    'FleetRouter', 'LocalReplica', 'RemoteReplica', 'RouterFuture',
+    'SwapAbortedError', 'SwapParityError', 'SwapValidationError',
+    'hot_swap',
 ]

@@ -31,12 +31,18 @@ import threading
 import time
 from typing import List, Optional
 
-#: env knobs (documented in benchmarks/README "Online serving (r9)")
+#: env knobs (documented in benchmarks/README "Online serving (r9)" +
+#: "Fleet serving & failover (r14)")
 QUEUE_DEPTH_ENV = 'GLT_SERVING_QUEUE_DEPTH'
 DEADLINE_ENV = 'GLT_SERVING_DEADLINE_MS'
+DRAIN_RETRY_ENV = 'GLT_SERVING_DRAIN_RETRY_MS'
 
 DEFAULT_QUEUE_DEPTH = 256
 DEFAULT_DEADLINE_MS = 200.0
+#: retry-after hint handed out with ``reason='draining'`` rejections —
+#: the hot-swap cutover is a parity check over warm executables, so
+#: tens of milliseconds covers it
+DEFAULT_DRAIN_RETRY_MS = 50.0
 
 
 def _env_pos(name: str, default, cast):
@@ -83,11 +89,17 @@ def deadline_ms_from_env() -> float:
   return _env_pos(DEADLINE_ENV, DEFAULT_DEADLINE_MS, float)
 
 
+def drain_retry_ms_from_env() -> float:
+  return _env_pos(DRAIN_RETRY_ENV, DEFAULT_DRAIN_RETRY_MS, float)
+
+
 class AdmissionRejected(RuntimeError):
   """A request the serving tier refused or shed — a LOAD signal, not a
   crash.  ``reason`` is one of ``queue_full`` (bounded queue at
   capacity on arrival), ``deadline`` (still queued past its deadline),
   ``too_large`` (more seeds than the largest shape bucket),
+  ``draining`` (brief hot-swap cutover — retry after
+  ``retry_after_ms`` and the NEW model version answers),
   ``shutdown`` (tier stopping).  ``queue_depth``/``limit`` carry the
   controller state at refusal time and ``waited_ms`` how long a shed
   request sat queued — the diagnostics an operator needs to size the
@@ -96,12 +108,14 @@ class AdmissionRejected(RuntimeError):
   def __init__(self, msg: str, *, reason: str = '',
                queue_depth: Optional[int] = None,
                limit: Optional[int] = None,
-               waited_ms: Optional[float] = None):
+               waited_ms: Optional[float] = None,
+               retry_after_ms: Optional[float] = None):
     super().__init__(msg)
     self.reason = reason
     self.queue_depth = queue_depth
     self.limit = limit
     self.waited_ms = waited_ms
+    self.retry_after_ms = retry_after_ms
 
 
 class ServingFuture:
@@ -186,10 +200,22 @@ class AdmissionController:
     self._lock = threading.Lock()
     self._arrived = threading.Condition(self._lock)
     self._closed = False
+    #: drain DEPTH, not a boolean: overlapping hot-swap windows (two
+    #: swaps racing on one tier) must not let the first one's exit
+    #: reopen admission while the second still holds the cutover
+    self._draining = 0              # guarded-by: self._lock
+    self.drain_retry_after_ms = drain_retry_ms_from_env()
+    #: optional SLO feed, called as ``slo_feed(reason, waited_ms)``
+    #: for sheds that should BURN latency error budget (queue_full /
+    #: deadline — the tier failing its callers).  INTENTIONAL sheds
+    #: (draining cutover, shutdown, malformed too_large) are exempt:
+    #: a replica mid-hot-swap is not failing, and must not flip its
+    #: burn-rate alarms as if it were (ISSUE 13 satellite).
+    self.slo_feed = None
     #: monotone counters for heartbeat/stats (read under the lock)
     self.admitted = 0
     self.shed = {'queue_full': 0, 'deadline': 0, 'too_large': 0,
-                 'shutdown': 0}
+                 'shutdown': 0, 'draining': 0}
 
   # -- producer side --------------------------------------------------------
   def submit(self, seeds, deadline_ms: Optional[float] = None
@@ -208,6 +234,21 @@ class AdmissionController:
                       queue_depth=len(self._q))
         raise AdmissionRejected('serving tier is shutting down',
                                 reason='shutdown')
+      if self._draining:
+        # the hot-swap cutover window: the tier is quiescing between
+        # coalesced runs (queued requests stay queued — no flush) and
+        # refuses NEW arrivals with a retry-after hint; the retry
+        # lands on the new model version
+        self.shed['draining'] += 1
+        _tick_shed('draining')
+        recorder.emit('serving.shed', reason='draining', seeds=n,
+                      queue_depth=len(self._q),
+                      retry_after_ms=self.drain_retry_after_ms)
+        raise AdmissionRejected(
+            'serving tier is draining for a hot model swap — retry '
+            f'after ~{self.drain_retry_after_ms:.0f}ms',
+            reason='draining', queue_depth=len(self._q),
+            retry_after_ms=self.drain_retry_after_ms)
       if (self.max_request_seeds is not None
           and n > self.max_request_seeds):
         self.shed['too_large'] += 1
@@ -224,6 +265,8 @@ class AdmissionController:
       if len(self._q) >= self.max_queue:
         self.shed['queue_full'] += 1
         _tick_shed('queue_full')
+        if self.slo_feed is not None:
+          self.slo_feed('queue_full', 0.0)
         recorder.emit('serving.shed', reason='queue_full', seeds=n,
                       queue_depth=len(self._q), limit=self.max_queue)
         raise AdmissionRejected(
@@ -250,6 +293,8 @@ class AdmissionController:
         self.shed['deadline'] += 1
         _tick_shed('deadline')
         waited = req.waited_ms(now)
+        if self.slo_feed is not None:
+          self.slo_feed('deadline', waited)
         recorder.emit('serving.shed', reason='deadline',
                       seeds=len(req.seeds), queue_depth=len(self._q),
                       waited_ms=round(waited, 3))
@@ -332,11 +377,28 @@ class AdmissionController:
     with self._lock:
       return len(self._q)
 
+  def set_draining(self, on: bool) -> None:
+    """Enter/leave the hot-swap cutover window: while on, NEW
+    arrivals are refused ``reason='draining'`` with a retry-after
+    hint; requests already queued stay queued (no flush — they are
+    served by whichever version wins the swap).  Reference-counted:
+    each ``True`` must be paired with a ``False``, and admission
+    reopens only when the LAST window closes."""
+    with self._lock:
+      self._draining = max(self._draining + (1 if on else -1), 0)
+      if not self._draining:
+        self._arrived.notify_all()
+
+  def draining(self) -> bool:
+    with self._lock:
+      return self._draining > 0
+
   def stats(self) -> dict:
     with self._lock:
       return {'queue_depth': len(self._q),
               'max_queue': self.max_queue,
               'admitted': self.admitted,
+              'draining': self._draining > 0,
               'shed': dict(self.shed)}
 
   def close(self) -> None:

@@ -150,8 +150,20 @@ class ServingEngine:
                      id2index=(None if self._tiered
                                else feat._id2index_dev))
     self._key = jax.random.key(int(seed))
+    self._seed = int(seed)
     self.level_widths = self._level_widths()
     self.tree_width = sum(self.level_widths)
+    #: bumped by `set_params` (the hot-swap commit); surfaced in
+    #: `compile_status` / heartbeats so fleet routing and swap
+    #: validation can tell which version a replica answers with
+    self.model_version = 0
+    #: AOT executables `warmup` restored from (or published to) the
+    #: persistent cache under GLT_AOT_CACHE_DIR: (program, cap) ->
+    #: callable over the program's dynamic args.  `_dispatch` prefers
+    #: these; empty without a cache dir (the default path unchanged).
+    self._aot = {}
+    self._aot_compiles = 0
+    self._aot_restores = 0
     #: bucket capacity -> True once `warmup` compiled it
     self.warm = {cap: False for cap in self.buckets}
     # every program is chunk-bounded by construction (one bucket =
@@ -265,15 +277,49 @@ class ServingEngine:
     out[:len(seeds)] = np.asarray(seeds, np.int32)
     return jnp.asarray(out)
 
-  def _dispatch(self, padded: jax.Array) -> ServingResult:
+  def _run_prog(self, name: str, cap: int, jit_fn, dyn_args,
+                call_args, statics=()):
+    """Dispatch one bucket program: the AOT-restored executable when
+    `warmup` installed one, else the `_uncached_jit` path.  A restored
+    executable that fails AT CALL TIME (foreign device set, moved jax
+    internals) is dropped and the dispatch falls back to the compile
+    path — skip-to-recompile extends to runtime, not just load.
+    ``statics`` are the CURRENT static-arg values: an AOT executable
+    baked different ones at warmup (GLT_PALLAS toggled since) is
+    bypassed for this call — env knobs keep their documented
+    dispatch-time semantics (`_uncached_jit`)."""
+    entry = self._aot.get((name, cap))
+    if entry is not None:
+      fn, baked = entry
+      if baked != tuple(statics):
+        return jit_fn(*call_args)    # toggle may flip back: keep the
+        # entry, just don't serve this call from it
+      try:
+        return fn(*dyn_args)
+      except Exception:             # noqa: BLE001 — recompile, never
+        # fail the request on a bad cached executable
+        self._aot.pop((name, cap), None)
+        from ..telemetry.recorder import recorder
+        recorder.emit('aot.cache_miss', program=name, bucket=cap,
+                      reason='error')
+    return jit_fn(*call_args)
+
+  def _dispatch(self, padded: jax.Array,
+                params=None) -> ServingResult:
     """One bucket dispatch (``padded`` already at a bucket capacity).
-    Warm after `warmup`: every call is an in-memory executable hit."""
-    if self.model is not None and self.params is None:
+    Warm after `warmup`: every call is an in-memory executable hit.
+    ``params`` overrides the installed model version for THIS dispatch
+    (the hot-swap parity probe validates a candidate this way without
+    admitting traffic to it)."""
+    params = self.params if params is None else params
+    if self.model is not None and params is None:
       raise ValueError(
           'ServingEngine has a model but no params — call '
           'init_params(rng) (or set .params) before serving/warmup')
+    cap = int(padded.shape[0])
     if self._tiered:
-      nodes = self._compiled_collect(padded, self._dev)
+      nodes = self._run_prog('collect', cap, self._compiled_collect,
+                             (padded, self._dev), (padded, self._dev))
       nodes_h = np.asarray(nodes)
       # cross-request cold-id dedup (r11): one coalesced dispatch
       # carries several riders whose trees overlap heavily under
@@ -298,37 +344,47 @@ class ServingEngine:
       x = x.reshape(nodes_h.shape + (x.shape[-1],))
       if self.model is None:
         return ServingResult(nodes=nodes_h, x=np.asarray(x))
-      logits = self._compiled_consume(nodes, jnp.asarray(x),
-                                      self.params)
+      xj = jnp.asarray(x)
+      logits = self._run_prog('consume', cap, self._compiled_consume,
+                              (nodes, xj, params),
+                              (nodes, xj, params))
       return ServingResult(nodes=nodes_h, logits=np.asarray(logits))
     if self.model is None:
-      nodes, x = self._compiled_gather(padded, self._dev,
-                                       pallas_enabled())
+      nodes, x = self._run_prog(
+          'gather', cap, self._compiled_gather, (padded, self._dev),
+          (padded, self._dev, pallas_enabled()),
+          statics=(bool(pallas_enabled()),))
       return ServingResult(nodes=np.asarray(nodes), x=np.asarray(x))
-    nodes, logits = self._compiled_forward(padded, self.params,
-                                           self._dev,
-                                           pallas_enabled())
+    nodes, logits = self._run_prog(
+        'forward', cap, self._compiled_forward,
+        (padded, params, self._dev),
+        (padded, params, self._dev, pallas_enabled()),
+        statics=(bool(pallas_enabled()),))
     return ServingResult(nodes=np.asarray(nodes),
                          logits=np.asarray(logits))
 
-  def infer(self, seeds, cap: Optional[int] = None) -> ServingResult:
+  def infer(self, seeds, cap: Optional[int] = None,
+            params=None) -> ServingResult:
     """Serve one (possibly coalesced) seed batch; results sliced back
     to ``len(seeds)``.  ``cap`` pins the bucket (the frontend picks it
-    once per coalesced dispatch); default = smallest fitting."""
+    once per coalesced dispatch); default = smallest fitting.
+    ``params`` overrides the installed model version for this call
+    (hot-swap validation)."""
     seeds = np.asarray(seeds).reshape(-1)
     cap = self.bucket_for(len(seeds)) if cap is None else cap
-    return self._dispatch(self._pad(seeds, cap)).slice(0, len(seeds))
+    return self._dispatch(self._pad(seeds, cap),
+                          params=params).slice(0, len(seeds))
 
-  def offline_reference(self, seeds,
-                        cap: Optional[int] = None) -> ServingResult:
+  def offline_reference(self, seeds, cap: Optional[int] = None,
+                        params=None) -> ServingResult:
     """The per-seed offline loader twin: every seed served ALONE —
     through the smallest bucket by default, or a pinned ``cap`` —
     the byte-identity reference the coalesced path is tested against
     (and what a non-coalescing baseline deployment would compute).
     See the class docstring's identity fine print for which outputs
     are bitwise vs float-tolerance equal across bucket shapes."""
-    parts = [self.infer(np.asarray([s]), cap=cap) for s in
-             np.asarray(seeds).reshape(-1)]
+    parts = [self.infer(np.asarray([s]), cap=cap, params=params)
+             for s in np.asarray(seeds).reshape(-1)]
     return ServingResult(
         nodes=np.concatenate([p.nodes for p in parts]),
         x=(None if parts[0].x is None
@@ -336,36 +392,169 @@ class ServingEngine:
         logits=(None if parts[0].logits is None
                 else np.concatenate([p.logits for p in parts])))
 
-  def warmup(self) -> dict:
+  def validate_params(self, params) -> None:
+    """Refuse a candidate param tree that cannot ride the warm bucket
+    executables: structure/shape/dtype must match the installed tree
+    leaf-for-leaf (params are program ARGUMENTS, so a conforming tree
+    swaps with zero recompiles and a drifted one would silently
+    recompile every bucket).  Raises ValueError naming the first
+    diverging leaf."""
+    if self.model is None:
+      raise ValueError('validate_params on a model-less engine')
+    if self.params is None:
+      return
+    old_s = jax.tree_util.tree_structure(self.params)
+    new_s = jax.tree_util.tree_structure(params)
+    if old_s != new_s:
+      raise ValueError(
+          f'param tree structure changed ({new_s} vs installed '
+          f'{old_s}) — a hot swap must keep the architecture; '
+          'deploy a new engine for a new architecture')
+    def _dt(x):
+      # dtype off the aval — no device-to-host copy for jax leaves
+      d = getattr(x, 'dtype', None)
+      return d if d is not None else np.asarray(x).dtype
+    for (path, old_leaf), (_, new_leaf) in zip(
+        jax.tree_util.tree_leaves_with_path(self.params),
+        jax.tree_util.tree_leaves_with_path(params)):
+      if (tuple(np.shape(old_leaf)) != tuple(np.shape(new_leaf))
+          or _dt(old_leaf) != _dt(new_leaf)):
+        raise ValueError(
+            f'param leaf {jax.tree_util.keystr(path)} changed '
+            f'shape/dtype ({np.shape(new_leaf)} vs '
+            f'{np.shape(old_leaf)}) — refused (would recompile '
+            'every warm bucket)')
+
+  def set_params(self, params, version: Optional[int] = None) -> int:
+    """Install a new model version (the hot-swap COMMIT — callers go
+    through `serving.swap.hot_swap`, which quiesces and parity-checks
+    first).  Validates via `validate_params`; returns the new
+    ``model_version``."""
+    self.validate_params(params)
+    self.params = params
+    self.model_version = (int(version) if version is not None
+                          else self.model_version + 1)
+    return self.model_version
+
+  # -- persistent AOT executables (ISSUE 13) --------------------------------
+  def _aot_fingerprint(self, program: str, cap: int, dyn_args,
+                       static_args) -> dict:
+    """The cache key material: everything that shapes the compiled
+    bucket program.  The engine seed is included because the serve
+    key is a traced CLOSURE constant — two engines with different
+    seeds compile different programs that would answer differently."""
+    leaves = jax.tree_util.tree_leaves(dyn_args)
+    return {
+        'program': program, 'cap': int(cap),
+        'fanouts': list(self.fanouts),
+        'num_nodes': int(self.num_nodes),
+        'feature': [int(self._feat.feature_dim), str(self._feat.dtype)],
+        'tiered': bool(self._tiered),
+        'model': repr(self.model),
+        'seed': self._seed,
+        'statics': [repr(s) for s in static_args],
+        # .shape/.dtype read the aval — NEVER np.asarray, which would
+        # pull the full graph/feature tables device-to-host just to
+        # name their dtypes (per program per bucket, on the exact
+        # warm-start path the cache exists to make fast)
+        'avals': [f'{tuple(x.shape)}:{x.dtype}' for x in leaves],
+        'jax': jax.__version__,
+        'backend': jax.default_backend(),
+        'devices': [str(d) for d in jax.devices()],
+    }
+
+  def _aot_install(self, cache, name: str, cap: int, jit_fn,
+                   dyn_args, static_args) -> None:
+    """Restore one bucket program from the persistent cache, or AOT
+    lower+compile it and publish the executable for the next replica."""
+    fp = self._aot_fingerprint(name, cap, dyn_args, static_args)
+    fn = cache.load(fp)
+    if fn is None:
+      compiled = jit_fn.jitted.lower(*dyn_args, *static_args).compile()
+      self._aot_compiles += 1
+      cache.save(fp, compiled)
+      fn = compiled
+    else:
+      self._aot_restores += 1
+    self._aot[(name, cap)] = (fn, tuple(static_args))
+
+  def _aot_warm_bucket(self, cache, cap: int,
+                       padded: jax.Array) -> None:
+    """Install every program this engine mode needs at capacity
+    ``cap`` (hot: gather|forward; tiered: collect[+consume])."""
+    use_pallas = bool(pallas_enabled())
+    if self._tiered:
+      self._aot_install(cache, 'collect', cap, self._compiled_collect,
+                        (padded, self._dev), ())
+      if self.model is not None:
+        # consume's avals hang off collect's output: run the (now
+        # AOT) collect once to shape them
+        nodes = self._run_prog('collect', cap, self._compiled_collect,
+                               (padded, self._dev),
+                               (padded, self._dev))
+        x0 = jnp.zeros(tuple(nodes.shape) + (self._feat.feature_dim,),
+                       self._feat.dtype)
+        self._aot_install(cache, 'consume', cap,
+                          self._compiled_consume,
+                          (nodes, x0, self.params), ())
+    elif self.model is None:
+      self._aot_install(cache, 'gather', cap, self._compiled_gather,
+                        (padded, self._dev), (use_pallas,))
+    else:
+      self._aot_install(cache, 'forward', cap, self._compiled_forward,
+                        (padded, self.params, self._dev),
+                        (use_pallas,))
+
+  def warmup(self, aot_cache='env') -> dict:
     """AOT-compile every bucket program at server start (the tiered
     host fill + consume included), so the first real request — and
-    every one after — hits a warm executable.  Returns
-    ``{'buckets': {...}, 'compiles': n, 'secs': wall}``."""
+    every one after — hits a warm executable.  With
+    ``GLT_AOT_CACHE_DIR`` set (or an `AotExecutableCache` passed),
+    bucket executables are restored from the persistent cache instead
+    of recompiling — the warm-from-disk replica-replacement path —
+    and fresh compiles are published back for the next replica.
+    Returns ``{'buckets': {...}, 'compiles': n, 'secs': wall,
+    'aot_restored': k}``."""
     import time
     from ..utils.profiling import metrics
+    if aot_cache == 'env':
+      from . import aot_cache as _aot_mod
+      cache = _aot_mod.from_env()
+    else:
+      cache = aot_cache
     t0 = time.perf_counter()
     n = min(self.num_nodes, 8)
     before = self.compile_count()
+    restores_before = self._aot_restores
     for cap in self.buckets:
       # valid ids (0..n-1 cycled) + one INVALID tail slot when the
       # bucket has room: both the masked and unmasked arms warm up
       seeds = np.arange(cap, dtype=np.int32) % n
       if cap > 1:
         seeds[-1] = INVALID_ID
-      self._dispatch(jnp.asarray(seeds))
+      padded = jnp.asarray(seeds)
+      if cache is not None:
+        self._aot_warm_bucket(cache, cap, padded)
+      self._dispatch(padded)
       self.warm[cap] = True
     secs = time.perf_counter() - t0
     compiles = self.compile_count() - before
     metrics.inc('serving.warmup.secs', secs)
     return {'buckets': dict(self.warm), 'compiles': compiles,
-            'secs': round(secs, 3)}
+            'secs': round(secs, 3),
+            # restores counted by THIS warmup (not a lifetime delta —
+            # a re-warm that restores over a prior compile still
+            # reports its restores)
+            'aot_restored': self._aot_restores - restores_before}
 
   def compile_count(self) -> int:
     """Total compiles across the engine's programs (the
-    `_uncached_jit` per-callable counters) — snapshot before traffic,
+    `_uncached_jit` per-callable counters, plus AOT lower+compiles
+    the persistent cache could not serve) — snapshot before traffic,
     compare after: a nonzero delta after `warmup` means a shape
-    escaped the bucket ladder."""
-    return sum(fn.compiles for fn in (
+    escaped the bucket ladder.  Zero after a warmup that restored
+    every bucket from ``GLT_AOT_CACHE_DIR`` — the warm-start pin."""
+    return self._aot_compiles + sum(fn.compiles for fn in (
         self._compiled_collect, self._compiled_gather,
         self._compiled_forward, self._compiled_consume))
 
@@ -374,4 +563,6 @@ class ServingEngine:
     serving block)."""
     return {'buckets': {str(c): bool(w) for c, w in self.warm.items()},
             'compiles': self.compile_count(),
+            'aot_programs': len(self._aot),
+            'model_version': self.model_version,
             'tiered': self._tiered}

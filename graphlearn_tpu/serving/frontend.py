@@ -73,16 +73,33 @@ class ServingFrontend:
                max_wait_ms: Optional[float] = None,
                max_queue: Optional[int] = None,
                default_deadline_ms: Optional[float] = None,
-               auto_start: bool = True, warmup: bool = True):
+               auto_start: bool = True, warmup: bool = True,
+               name: str = ''):
     self.engine = engine
+    #: fleet identity (set by `router.LocalReplica` when unset):
+    #: rides the executor chaos seam so plans can target one replica
+    self.name = name
     self.max_wait_s = (max_wait_ms if max_wait_ms is not None
                        else max_wait_ms_from_env()) / 1e3
     self.admission = AdmissionController(
         max_queue=max_queue, default_deadline_ms=default_deadline_ms,
         max_request_seeds=engine.max_request_seeds())
     self._closed = False
+    #: crash-simulation hook (`serving.router.LocalReplica.kill` /
+    #: chaos ``serving.replica:kill``): a frozen frontend stops COLD —
+    #: taken runs are dropped unresolved (their futures freeze exactly
+    #: like a killed process's would), nothing sheds typed.  The fleet
+    #: router's redrive is what turns this into zero lost requests.
+    self._frozen = False
     self._thread: Optional[threading.Thread] = None
     self._lock = threading.Lock()
+    #: held by the executor across each coalesced run; `swap.hot_swap`
+    #: acquires it to quiesce BETWEEN runs (the drain-free cutover
+    #: point — no dispatch is ever interrupted, no queue is flushed)
+    self._dispatch_gate = threading.Lock()
+    #: serializes whole hot_swap attempts (two concurrent swaps on
+    #: one tier must not interleave their drain windows or probes)
+    self._swap_lock = threading.Lock()
     #: executor-side counters (heartbeat/stats; executor thread only
     #: writes, readers take the lock for a consistent snapshot —
     #: enforced by glint's guarded-by pass)
@@ -119,6 +136,12 @@ class ServingFrontend:
                         ('serving.coalesce_fill_ratio', _fill_fn)]
     self._lat_hists: dict = {}
     self.slo = SloTracker(registry=live)
+    # budget-burning sheds (queue_full/deadline — the tier failing
+    # its callers) feed the SLO window as failures; INTENTIONAL sheds
+    # (draining cutover, shutdown) are exempt by the admission
+    # controller's feed contract — a replica mid-hot-swap must not
+    # burn error budget or trip burn-rate alarms (ISSUE 13 satellite)
+    self.admission.slo_feed = self._slo_shed_feed
     # bound method pinned once — unregister compares by identity
     self._health_fn = self._health
     live.register_health('serving', self._health_fn)
@@ -146,6 +169,15 @@ class ServingFrontend:
     if t is not None:
       t.join(timeout)
     self._thread = None
+    self._unregister_observability()
+
+  def _unregister_observability(self) -> None:
+    """Drop this frontend's live-registry callbacks (health fn,
+    gauges, SLO tracker) — the closure-pinning cleanup PR 12's gauge
+    lifecycle established.  Shared by `shutdown` and the fleet
+    kill-simulation path (`router.LocalReplica.kill`), which freezes
+    the data plane WITHOUT resolving requests but must still release
+    the registry (a killed process's exporters vanish too)."""
     live.unregister_health('serving', fn=self._health_fn)
     for gname, gfn in self._gauge_regs:
       live.unregister_gauge(gname, fn=gfn)
@@ -184,7 +216,7 @@ class ServingFrontend:
 
   # -- executor side --------------------------------------------------------
   def _loop(self) -> None:
-    while not self._closed:
+    while not self._closed and not self._frozen:
       try:
         self.pump_once()
       except Exception:             # noqa: BLE001 — pump_once resolves
@@ -202,12 +234,20 @@ class ServingFrontend:
     queue instead of waiting."""
     run = self.admission.take(self.engine.max_request_seeds(),
                               self.max_wait_s, block=block)
+    if self._frozen:
+      # simulated process death: the popped run is LOST unresolved —
+      # the dead-replica shape the fleet redrive exists for
+      return 0
     if not run:
       return 0
     with self._lock:
       self.in_flight = len(run)
     try:
-      return self._execute(run)
+      # the hot-swap quiesce point: a swap acquires this gate, so a
+      # run never straddles a version change (and a swap never
+      # interrupts a run)
+      with self._dispatch_gate:
+        return self._execute(run)
     finally:
       with self._lock:
         self.in_flight = 0
@@ -225,7 +265,7 @@ class ServingFrontend:
       # chaos seam (executor flavor): a 'delay' here simulates a slow/
       # stuck dispatch — queued requests behind it expire and shed; a
       # 'drop' kills this dispatch with a typed error on every rider
-      chaos.serving_request_check('dispatch')
+      chaos.serving_request_check('dispatch', replica=self.name)
       with span('serving.infer', bucket=cap, requests=len(run),
                 seeds=total):
         batch = self.engine.infer(
@@ -275,6 +315,19 @@ class ServingFrontend:
     self._m_dispatches.inc()
     return len(run)
 
+  # -- model lifecycle ------------------------------------------------------
+  def swap_model(self, params, version: Optional[int] = None,
+                 **kwargs) -> dict:
+    """Drain-free hot model swap (see `serving.swap.hot_swap`):
+    quiesce between coalesced runs, parity-check the candidate
+    against the offline reference, commit-or-roll-back — zero dropped
+    requests either way."""
+    from .swap import hot_swap
+    return hot_swap(self, params, version=version, **kwargs)
+
+  def _slo_shed_feed(self, reason: str, waited_ms: float) -> None:
+    self.slo.observe(waited_ms, ok=False)
+
   # -- observability --------------------------------------------------------
   def _in_flight_snapshot(self) -> int:
     with self._lock:
@@ -294,7 +347,9 @@ class ServingFrontend:
              'dispatches': self.dispatches,
              'failed': self.failed}
     out.update(self.admission.stats())
+    out['closed'] = self._closed
     out['compile_status'] = self.engine.compile_status()
+    out['model_version'] = self.engine.model_version
     out['max_wait_ms'] = round(self.max_wait_s * 1e3, 3)
     out['slo'] = self.slo.snapshot()
     return out
@@ -309,5 +364,8 @@ class ServingFrontend:
                      and not self._thread.is_alive())
     out['executor_alive'] = (self._thread is not None
                              and self._thread.is_alive())
+    # a DRAINING tier is healthy: the hot-swap cutover sheds typed on
+    # purpose and must not flip /healthz to 503 as if it were failing
+    # (out['draining'] rides in from admission.stats() for routers)
     out['healthy'] = not self._closed and not executor_dead
     return out

@@ -1,0 +1,188 @@
+"""Drain-free hot model swap for the serving tier (ISSUE 13).
+
+A model upgrade on a single-engine tier (PR 9) meant tearing the
+frontend down: every queued request resolved with a shutdown
+rejection and the replacement paid the full warmup before answering.
+`hot_swap` replaces that with a versioned in-place swap that drops
+NOTHING:
+
+  1. **quiesce, don't flush** — admission enters ``draining``: NEW
+     arrivals are refused typed (``reason='draining'`` with a
+     ``retry_after_ms`` hint — a fleet router reroutes them, a bare
+     client retries onto the new version) while requests ALREADY
+     queued stay queued.  The executor finishes its in-flight
+     coalesced run and parks at the dispatch gate — the swap happens
+     BETWEEN runs, never under one.
+  2. **validate before admitting** — the candidate params run a probe
+     batch through the warm coalesced path and are compared against
+     the engine's per-seed `offline_reference` UNDER THE SAME
+     candidate: sampled nodes must match byte-identically and logits
+     to float tolerance (the engine identity fine print).  This
+     proves the candidate answers consistently through every serving
+     path before any caller sees it.
+  3. **commit or roll back** — parity passes: `ServingEngine.
+     set_params` installs the candidate and bumps ``model_version``
+     (tree structure/shape/dtype must match — the warm executables
+     take params as an argument, so a conforming swap is
+     ZERO-recompile).  Parity fails: the prior version keeps serving,
+     the queued requests it still owes are served by it, and the
+     caller gets a typed :class:`SwapParityError` plus a
+     ``serving.swap`` event with ``rolled_back=True``.
+
+Either way the drain window closes and the queue resumes — zero
+dropped requests is the contract, pinned by tests.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..telemetry.recorder import recorder
+
+
+class SwapValidationError(ValueError):
+  """The candidate params cannot ride the warm executables (tree
+  structure / leaf shape / dtype drift) — refused before the drain
+  window even opens."""
+
+
+class SwapParityError(RuntimeError):
+  """The candidate FAILED the offline-reference parity probe: the
+  coalesced path and the per-seed reference disagreed under the new
+  params.  The swap rolled back — the prior version is still serving
+  and nothing was dropped.  ``max_err`` carries the worst logit
+  divergence observed."""
+
+  def __init__(self, msg: str, max_err: Optional[float] = None):
+    super().__init__(msg)
+    self.max_err = max_err
+
+
+class SwapAbortedError(RuntimeError):
+  """The swap never reached its parity probe: the executor failed to
+  quiesce within the gate timeout (a stuck in-flight dispatch).  The
+  prior version was never displaced and keeps serving — but this is
+  an EXECUTOR-health signal, not a model-parity verdict, so it gets
+  its own type (and still one ``serving.swap`` event, per the
+  one-event-per-attempt schema contract)."""
+
+
+def _tick(outcome: str) -> None:
+  from ..telemetry.live import live
+  live.counter('serving.swaps_total',
+               labels={'outcome': outcome}).inc()
+
+
+def _parity_probe(engine, params, probe_seeds, atol: float
+                  ) -> float:
+  """Run the candidate through the coalesced path and the per-seed
+  offline reference; returns the max divergence (raises
+  `SwapParityError` past tolerance).  Sampled nodes must agree
+  BYTE-identically (params cannot change sampling — a mismatch means
+  a broken executable, the exact thing to catch before traffic)."""
+  cand = engine.infer(probe_seeds, params=params)
+  ref = engine.offline_reference(probe_seeds, params=params)
+  if not np.array_equal(cand.nodes, ref.nodes):
+    raise SwapParityError(
+        'candidate sampled different nodes through the coalesced '
+        'path than the per-seed reference — corrupted executable or '
+        'nondeterministic program; rolled back')
+  max_err = 0.0
+  for a, b in ((cand.logits, ref.logits), (cand.x, ref.x)):
+    if a is None or b is None:
+      continue
+    err = float(np.max(np.abs(np.asarray(a, np.float64)
+                              - np.asarray(b, np.float64))))
+    max_err = max(max_err, err)
+    if not np.isfinite(err) or err > atol:
+      raise SwapParityError(
+          f'candidate parity probe diverged (max |Δ| = {err:.3e} > '
+          f'{atol:.1e}) between the coalesced path and the per-seed '
+          'offline reference; rolled back', max_err=err)
+  return max_err
+
+
+def hot_swap(frontend, params, version: Optional[int] = None,
+             probe_seeds=None, atol: float = 1e-4,
+             gate_timeout_s: float = 30.0) -> dict:
+  """Swap the frontend's engine onto new ``params`` without dropping
+  a request.  Returns ``{'version', 'parity_max_err', 'drained_ms'}``
+  on success; raises `SwapValidationError` (bad candidate shape,
+  refused up front) or `SwapParityError` (probe mismatch, rolled
+  back).  ``probe_seeds`` defaults to a small deterministic sample of
+  the node space; ``atol`` is the logit tolerance (the engine's
+  cross-shape identity is numerical, ~1e-6 — see its fine print)."""
+  engine = frontend.engine
+  if engine.model is None:
+    raise SwapValidationError('hot_swap needs a model-serving engine')
+  try:
+    # refuse a malformed candidate BEFORE the drain window opens —
+    # shape drift must cost the caller an error, not the tier a pause
+    engine.validate_params(params)
+  except ValueError as e:
+    raise SwapValidationError(str(e)) from e
+  if probe_seeds is None:
+    n = engine.num_nodes
+    probe_seeds = np.unique(
+        np.linspace(0, n - 1, num=min(4, n)).astype(np.int64))
+  t0 = time.monotonic()
+  admission = frontend.admission
+  # whole-attempt serialization: a second concurrent swap waits here,
+  # outside any drain window — interleaved windows would let the
+  # first swap's exit reopen admission under the second's probe
+  swap_lock = getattr(frontend, '_swap_lock', None)
+  if swap_lock is not None:
+    swap_lock.acquire()
+  admission.set_draining(True)
+  gate_acquired = False
+  try:
+    # the quiesce point: the executor holds this gate across each
+    # coalesced run, so acquiring it means we sit BETWEEN runs
+    gate_acquired = frontend._dispatch_gate.acquire(
+        timeout=gate_timeout_s)
+    if not gate_acquired:
+      drained_ms = 1e3 * (time.monotonic() - t0)
+      recorder.emit('serving.swap', version=version, ok=False,
+                    rolled_back=False, parity_max_err=None,
+                    drained_ms=round(drained_ms, 3),
+                    error=f'executor did not quiesce within '
+                          f'{gate_timeout_s}s')
+      _tick('aborted')
+      raise SwapAbortedError(
+          f'executor did not quiesce within {gate_timeout_s}s '
+          '(in-flight dispatch stuck) — swap aborted, prior version '
+          'still serving')
+    try:
+      max_err = _parity_probe(engine, params, probe_seeds, atol)
+      new_version = engine.set_params(params, version)
+    except Exception as e:          # noqa: BLE001 — ANY probe/commit
+      # failure rolls back: the prior version was never displaced and
+      # keeps serving the queue the moment the drain window closes
+      if not isinstance(e, SwapParityError):
+        e = SwapParityError(
+            f'swap probe failed ({type(e).__name__}: {e}) — rolled '
+            'back, prior version still serving')
+      drained_ms = 1e3 * (time.monotonic() - t0)
+      recorder.emit('serving.swap', version=version, ok=False,
+                    rolled_back=True,
+                    parity_max_err=getattr(e, 'max_err', None),
+                    drained_ms=round(drained_ms, 3),
+                    error=f'{type(e).__name__}: {e}'[:200])
+      _tick('rolled_back')
+      raise e
+  finally:
+    if gate_acquired:
+      frontend._dispatch_gate.release()
+    admission.set_draining(False)
+    if swap_lock is not None:
+      swap_lock.release()
+  drained_ms = 1e3 * (time.monotonic() - t0)
+  recorder.emit('serving.swap', version=new_version, ok=True,
+                rolled_back=False, parity_max_err=round(max_err, 9),
+                drained_ms=round(drained_ms, 3))
+  _tick('ok')
+  return {'version': new_version,
+          'parity_max_err': max_err,
+          'drained_ms': round(drained_ms, 3)}

@@ -90,6 +90,14 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # guard)
     ('dist.serving.p99_ms', 'lower'),
     ('dist.serving.qps', 'higher'),
+    # fleet-failover guard (ISSUE 13): the kill-one-replica-mid-bench
+    # acceptance run — sustained fleet completion rate must hold, and
+    # failed/dropped requests must stay at the baseline (0: a zero
+    # baseline skips here by the ratio rules, so the HARD zero-failure
+    # gate is bench_serving's nonzero exit; this key catches drift
+    # once any baseline records a nonzero count)
+    ('dist.serving.fleet_qps', 'higher'),
+    ('dist.serving.failover_failed_requests', 'lower'),
 )
 
 

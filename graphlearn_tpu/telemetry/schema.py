@@ -119,10 +119,12 @@ EVENT_KINDS: Dict[str, str] = {
         'sketch-selected cold-cache residents ∪ hot split became the '
         'new sampling-bias membership table)',
     'serving.shed':
-        'serving.admission: reason (queue_full|deadline|too_large), '
-        'seeds, queue_depth, limit / waited_ms — one per typed '
-        'load-shed (the request future resolves with '
-        'AdmissionRejected; nothing is silently dropped)',
+        'serving.admission: reason (queue_full|deadline|too_large|'
+        'draining|shutdown), seeds, queue_depth, limit / waited_ms / '
+        'retry_after_ms — one per typed load-shed (the request '
+        'future resolves with AdmissionRejected; nothing is silently '
+        'dropped; draining sheds are intentional and burn no SLO '
+        'budget)',
     'recorder.overflow':
         'telemetry.recorder, ONE-SHOT on the first in-memory ring '
         'drop: ring_capacity — from this point the flight recorder '
@@ -137,6 +139,29 @@ EVENT_KINDS: Dict[str, str] = {
         'telemetry.postmortem.dump: reason, path, events, '
         'error — a post-mortem bundle (recorder ring + metrics '
         'snapshot + health) was written to GLT_POSTMORTEM_DIR',
+    'serving.failover':
+        'serving.router.FleetRouter: replica, event '
+        '(evict|redrive|readmit|exhausted), redriven (in-flight '
+        'requests moved to a survivor on evict), state — one event '
+        'per fleet state transition / redrive wave, so a failover '
+        'reads out of the same stream as the chaos faults that '
+        'caused it',
+    'serving.swap':
+        'serving.swap.hot_swap: version, ok, rolled_back, '
+        'parity_max_err, drained_ms — one event per hot model-swap '
+        'attempt (ok=False carries error; a parity mismatch rolls '
+        'back to the prior version with zero dropped requests; a '
+        'never-quiesced executor aborts with rolled_back=False '
+        'before any probe ran)',
+    'aot.cache_hit':
+        'serving.aot_cache.AotExecutableCache: program, bucket, key, '
+        'secs — a warm executable deserialized from '
+        'GLT_AOT_CACHE_DIR instead of recompiling',
+    'aot.cache_miss':
+        'serving.aot_cache.AotExecutableCache: program, bucket, key, '
+        'reason (absent|stale|corrupt|unreadable|error) — this '
+        'bucket paid a compile; corrupt/stale entries land here too '
+        '(skip-to-recompile, never a crash or a wrong executable)',
 }
 
 
@@ -233,7 +258,7 @@ METRIC_NAMES: Dict[str, str] = {
         'counter: requests past admission into the bounded queue',
     'serving.shed_total':
         'counter: typed load-sheds, labeled by reason '
-        '(queue_full|deadline|too_large|shutdown)',
+        '(queue_full|deadline|too_large|draining|shutdown)',
     'serving.shed_rate':
         'gauge: shed/(admitted+shed) over process lifetime — the '
         'overload signal the fleet scrape alarms on',
@@ -320,6 +345,29 @@ METRIC_NAMES: Dict[str, str] = {
         'unless this process resumed/rolled back)',
     'postmortem.dumps_total':
         'counter: post-mortem bundles written to GLT_POSTMORTEM_DIR',
+    'fleet.replicas':
+        'gauge: FleetRouter replica count by state, labeled '
+        'state=healthy|overloaded|draining|dead (scrape-time '
+        'evaluation off the replica table)',
+    'fleet.redrives_total':
+        'counter: in-flight requests redriven from a lost replica '
+        'onto a survivor (each redriven at most once — the '
+        'exactly-once failover ledger)',
+    'fleet.evictions_total':
+        'counter: replicas evicted from rotation after consecutive '
+        'heartbeat misses (flapped replicas that return are '
+        're-admitted and counted again on a later eviction)',
+    'serving.swaps_total':
+        'counter: hot model-swap attempts, labeled '
+        'outcome=ok|rolled_back|aborted (rolled_back = '
+        'offline_reference parity check refused the new version; '
+        'aborted = executor never quiesced, probe never ran)',
+    'aot.cache_hits_total':
+        'counter: bucket executables restored from the persistent '
+        'AOT cache (GLT_AOT_CACHE_DIR) instead of recompiling',
+    'aot.cache_misses_total':
+        'counter: bucket warmups that paid an XLA compile (absent/'
+        'stale/corrupt cache entries all land here)',
 }
 
 

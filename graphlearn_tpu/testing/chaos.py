@@ -59,6 +59,27 @@ wall clocks involved).  Sites and actions:
       scraper — must never block the serving executor or a fused
       dispatch), ``drop`` (raise :class:`InjectedFault`; the handler
       answers HTTP 503).
+  ``serving.replica``
+      Seam inside a fleet replica handle (`serving.router`), fired on
+      ``op='submit'`` and ``op='heartbeat'`` arrivals; ``replica``
+      filters by replica name.  Actions: ``kill`` (the replica dies
+      for good — its executor stops cold, queued requests freeze, and
+      the `FleetRouter` must evict it and REDRIVE its in-flight
+      requests to a survivor), ``delay`` (a slow replica — heartbeats
+      and submits stall ``secs``; the router keeps it at reduced
+      weight instead of evicting, the overloaded-vs-dead
+      discriminator under test), ``flap`` (unreachable for ``secs``
+      then back — a network partition; shorter than the router's
+      eviction threshold it costs nothing, longer it costs one
+      eviction + redrive and a later re-admission).
+  ``aot.cache``
+      Seam inside the persistent AOT executable cache
+      (`serving.aot_cache`), ``op`` = ``'save'`` / ``'load'``.
+      Actions: ``fail`` (the write/read dies — absorbed: a cache
+      fault must cost a recompile, never an unserved bucket),
+      ``corrupt`` (the payload lands scrambled on disk — a later
+      load must detect the bad checksum and fall back to recompile,
+      never deserialize garbage into a wrong executable).
 
 Plans install three ways: programmatically (:func:`install`), from the
 ``GLT_FAULT_PLAN`` env var (inherited by producer subprocesses and
@@ -101,8 +122,9 @@ WORKER_KILL_EXIT = 173
 
 _SITES = ('rpc.request', 'producer.worker', 'checkpoint.io',
           'fused.dispatch', 'feature.cold_service', 'serving.request',
-          'ops.scrape')
-_ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate')
+          'ops.scrape', 'serving.replica', 'aot.cache')
+_ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate',
+            'flap')
 
 
 class InjectedFault(RuntimeError):
@@ -129,6 +151,7 @@ class Fault:
   op: Optional[str] = None        # rpc.request: handler-name filter
   worker: Optional[int] = None    # producer.worker: rank filter
   epoch: Optional[int] = None     # producer.worker: epoch filter
+  replica: Optional[str] = None   # serving.replica: replica-name filter
   #: producer.worker: restart-generation filter — ``0`` targets only
   #: the ORIGINAL worker incarnation, so a deterministic kill cannot
   #: re-fire inside the supervisor's replacement (whose fresh process
@@ -154,6 +177,8 @@ class Fault:
       return False
     if self.generation is not None and \
         ctx.get('generation') != self.generation:
+      return False
+    if self.replica is not None and ctx.get('replica') != self.replica:
       return False
     return True
 
@@ -380,13 +405,40 @@ def ops_scrape_check(path: str = '') -> None:
       raise InjectedFault(f'injected ops scrape drop (path {path!r})')
 
 
-def serving_request_check(op: str = '') -> None:
+def replica_faults(replica: str, op: str) -> List[Fault]:
+  """Fleet-replica seam (`serving.router` handles), one arrival per
+  ``submit`` / ``heartbeat``.  ``delay`` sleeps in place here (a slow
+  replica — heartbeats stall, the router must classify it overloaded,
+  not dead); ``kill`` and ``flap`` are returned for the HANDLE to
+  apply (it owns the dead/flapping state the router then observes)."""
+  fired = on('serving.replica', replica=replica, op=op)
+  maybe_delay(fired)
+  return fired
+
+
+def aot_cache_faults(op: str) -> List[str]:
+  """AOT-executable-cache seam (`serving.aot_cache`), ``op`` =
+  ``'save'`` / ``'load'``.  ``fail`` raises `InjectedFault` (the
+  caller absorbs it into a recompile); ``corrupt`` is returned so the
+  writer scrambles the payload it is about to publish (the durable
+  bad-entry scenario the checksum must catch on a later load)."""
+  actions = [f.action for f in on('aot.cache', op=op)]
+  if 'fail' in actions:
+    raise InjectedFault(f'injected aot cache failure (op {op!r})')
+  return actions
+
+
+def serving_request_check(op: str = '', replica: str = '') -> None:
   """Serving-plane seam (RPC handler: ``op='serve_infer'``; executor
   dispatch: ``op='dispatch'``): ``delay`` sleeps in place (driving
   deadline sheds behind it), ``drop`` raises `InjectedFault` (a typed
   server-side request loss — the replay cache still answers any
-  transport retry of the same request id verbatim)."""
-  for f in on('serving.request', op=op or None):
+  transport retry of the same request id verbatim).  ``replica``
+  carries the frontend's fleet name (when it has one), so a plan can
+  stall ONE replica's dispatches — how the fleet bench backs its
+  victim up with real in-flight requests before killing it."""
+  for f in on('serving.request', op=op or None,
+              replica=replica or None):
     if f.action == 'delay':
       time.sleep(f.secs)
     elif f.action == 'drop':

@@ -1,0 +1,162 @@
+"""GNS importance weights at the MODEL (ISSUE 13 satellite, ROADMAP
+item 5a): `Batch.metadata['edge_weight']` (PR 10's per-edge 1/q
+correction) threads through the SAGE aggregation so cache-biased
+sampling is unbiased end-to-end — pinned by a weight-of-ones identity,
+a monte-carlo expectation check THROUGH SAGEConv, and a small
+convergence-parity run (biased+weighted trains to the uniform
+optimum; biased-unweighted provably cannot).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from graphlearn_tpu.loader.transform import Batch
+from graphlearn_tpu.models.basic_gnn import GraphSAGE
+from graphlearn_tpu.models.conv import SAGEConv, segment_mean
+from graphlearn_tpu.models.train import (create_train_state,
+                                         make_supervised_step)
+
+# one target row aggregating a pool of neighbors — the estimator shape
+# ops/gns.py proves unbiased: q(v) boosts "cached" neighbors, each
+# edge carries w = p/q = (1/d)/q_v, and sum(w*f)/k recovers the
+# uniform neighbor mean in expectation
+D_NEIGH = 16
+BOOST = 8.0
+
+
+def _pool(seed=0):
+  rng = np.random.default_rng(seed)
+  feats = rng.random(D_NEIGH).astype(np.float32)
+  hot = feats > np.median(feats)        # bias correlated with VALUE:
+  # the worst case — an uncorrected boost shifts the estimate
+  q = 1.0 + BOOST * hot
+  q = q / q.sum()
+  w = (1.0 / D_NEIGH) / q               # p/q importance weights
+  return feats, q, w
+
+
+def test_segment_mean_weight_of_ones_is_bit_identical():
+  rng = np.random.default_rng(1)
+  data = jnp.asarray(rng.random((10, 3), ).astype(np.float32))
+  seg = jnp.asarray(rng.integers(0, 4, 10))
+  mask = jnp.asarray(rng.random(10) > 0.3)
+  base = segment_mean(data, seg, 4, mask)
+  ones = segment_mean(data, seg, 4, mask, weights=jnp.ones(10))
+  np.testing.assert_array_equal(np.asarray(base), np.asarray(ones))
+
+
+def test_sage_conv_weighted_mean_unbiased_monte_carlo():
+  """E[SAGEConv(biased sample, 1/q weights)] == SAGEConv(full
+  neighborhood): the model-level twin of the ops/gns kernel pin.
+  SAGEConv is linear in the aggregation, so the expectation passes
+  through the Dense layers exactly."""
+  feats, q, w = _pool()
+  n = 1 + D_NEIGH                       # node 0 = target, rest = pool
+  x = np.zeros((n, 2), np.float32)
+  x[1:, 0] = feats
+  conv = SAGEConv(out_features=2, aggr='mean')
+  full_src = np.arange(1, n)
+  full_ei = jnp.asarray(np.stack([full_src, np.zeros(D_NEIGH)]), jnp.int32)
+  params = conv.init(jax.random.key(0), jnp.asarray(x), full_ei)
+  ref = conv.apply(params, jnp.asarray(x), full_ei)[0]
+
+  k, trials = 4, 400
+  rng = np.random.default_rng(7)
+  acc = np.zeros_like(np.asarray(ref))
+  for _ in range(trials):
+    draw = rng.choice(D_NEIGH, size=k, p=q)
+    ei = jnp.asarray(np.stack([draw + 1, np.zeros(k)]), jnp.int32)
+    ew = jnp.asarray(w[draw].astype(np.float32))
+    out = conv.apply(params, jnp.asarray(x), ei, None, ew)
+    acc += np.asarray(out[0]) / trials
+  np.testing.assert_allclose(acc, np.asarray(ref), atol=0.02)
+
+
+def _train_sampled(mode: str, steps=300, seed=3):
+  """Train one SAGEConv to regress each target's TRUE neighbor mean
+  from per-step sampled edges; return the full-neighborhood eval MSE.
+  mode: 'uniform' | 'weighted' (biased draw + 1/q weights) |
+  'unweighted' (biased draw, correction dropped)."""
+  feats, q, w = _pool()
+  T, k = 24, 4
+  n = T + D_NEIGH
+  x = np.zeros((n, 1), np.float32)
+  x[T:, 0] = feats
+  y = np.full((T,), feats.mean(), np.float32)   # true uniform mean
+  conv = SAGEConv(out_features=1, aggr='mean')
+  full_src = np.tile(np.arange(D_NEIGH) + T, T)
+  full_dst = np.repeat(np.arange(T), D_NEIGH)
+  full_ei = jnp.asarray(np.stack([full_src, full_dst]), jnp.int32)
+  params = conv.init(jax.random.key(seed), jnp.asarray(x), full_ei)
+  tx = optax.adam(0.05)
+  opt = tx.init(params)
+
+  def loss_fn(p, ei, ew):
+    out = conv.apply(p, jnp.asarray(x), ei, None, ew)
+    return jnp.mean((out[:T, 0] - jnp.asarray(y)) ** 2)
+
+  grad = jax.jit(jax.grad(loss_fn))
+  rng = np.random.default_rng(seed)
+  probs = None if mode == 'uniform' else q
+  for _ in range(steps):
+    draws = rng.choice(D_NEIGH, size=(T, k), p=probs)
+    src = (draws + T).reshape(-1)
+    dst = np.repeat(np.arange(T), k)
+    ei = jnp.asarray(np.stack([src, dst]), jnp.int32)
+    ew = (jnp.asarray(w[draws].reshape(-1).astype(np.float32))
+          if mode == 'weighted' else None)
+    g = grad(params, ei, ew)
+    up, opt = tx.update(g, opt, params)
+    params = optax.apply_updates(params, up)
+  out = conv.apply(params, jnp.asarray(x), full_ei)
+  return float(jnp.mean((out[:T, 0] - jnp.asarray(y)) ** 2))
+
+
+def test_convergence_parity_weighted_matches_uniform():
+  """The satellite pin: GNS-biased sampling WITH the 1/q weights
+  trains to (near) the uniform-sampling optimum; dropping the
+  correction leaves an irreducible bias-squared floor the weighted
+  run does not have."""
+  mse_uniform = _train_sampled('uniform')
+  mse_weighted = _train_sampled('weighted')
+  mse_unweighted = _train_sampled('unweighted')
+  assert mse_uniform < 1e-3
+  assert mse_weighted < 4 * mse_uniform + 1e-3    # parity (variance
+  # of the importance-weighted estimator costs a little, bias none)
+  assert mse_unweighted > 10 * max(mse_weighted, 1e-4), \
+      (mse_uniform, mse_weighted, mse_unweighted)
+
+
+def test_supervised_step_threads_metadata_edge_weight():
+  """`make_supervised_step` feeds metadata['edge_weight'] into the
+  model: weights of ONES reproduce the unweighted loss bit-for-bit,
+  real weights change it (the correction actually reaches the
+  aggregation through the example SAGE path)."""
+  rng = np.random.default_rng(0)
+  n, d, bs, e = 12, 4, 4, 20
+  x = rng.random((n, d)).astype(np.float32)
+  src = rng.integers(0, n, e)
+  dst = rng.integers(0, bs, e)
+  ei = np.stack([src, dst]).astype(np.int32)
+  y = rng.integers(0, 3, n)
+  seeds = np.arange(bs)
+  model = GraphSAGE(hidden_features=8, out_features=3, num_layers=2)
+
+  def batch(md):
+    return Batch(x=jnp.asarray(x), y=jnp.asarray(y),
+                 edge_index=jnp.asarray(ei),
+                 edge_mask=jnp.ones((e,), bool),
+                 batch=jnp.asarray(seeds), batch_size=bs,
+                 metadata=md)
+
+  tx = optax.sgd(0.1)
+  state, _ = create_train_state(model, jax.random.key(0), batch({}), tx)
+  step = make_supervised_step(model.apply, tx, bs)
+  _, loss_plain, _ = step(state, batch({}))
+  _, loss_ones, _ = step(
+      state, batch({'edge_weight': jnp.ones((e,), jnp.float32)}))
+  _, loss_scaled, _ = step(
+      state, batch({'edge_weight': jnp.full((e,), 3.0, jnp.float32)}))
+  assert float(loss_plain) == float(loss_ones)
+  assert float(loss_scaled) != float(loss_plain)

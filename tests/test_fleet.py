@@ -1,0 +1,559 @@
+"""Serving-fleet resilience (ISSUE 13): FleetRouter health
+classification (healthy / overloaded / draining / dead), exactly-once
+request redrive on replica loss, the heartbeat overloaded-vs-dead
+discriminator under chaos delay, drain-free hot model swap with
+offline-reference parity gating + rollback, and the SLO/healthz
+exemption for intentional draining sheds.
+
+Replicas are in-process `LocalReplica` handles; a replica with
+``auto_start=False`` never pumps, so its queued requests sit exactly
+like in-flight traffic on a wedged process — the deterministic way to
+strand requests for the redrive ledger.
+"""
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from graphlearn_tpu.data import Dataset
+from graphlearn_tpu.distributed.resilience import (FailoverExhausted,
+                                                   ReplicaLostError)
+from graphlearn_tpu.models.tree import TreeSAGE
+from graphlearn_tpu.serving import (AdmissionRejected, FleetRouter,
+                                    LocalReplica, ServingEngine,
+                                    ServingFrontend, SwapParityError,
+                                    SwapValidationError, hot_swap)
+from graphlearn_tpu.telemetry import recorder
+from graphlearn_tpu.testing import chaos
+
+N, D = 48, 4
+FANOUTS = [3, 2]
+BUCKETS = (1, 2, 4)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  chaos.uninstall()
+  recorder.enable(None)
+  recorder.clear()
+  yield
+  chaos.uninstall()
+  recorder.clear()
+  recorder.disable()
+
+
+def _dataset():
+  rng = np.random.default_rng(0)
+  rows = np.repeat(np.arange(N), 3)
+  cols = rng.integers(0, N, rows.shape[0])
+  feats = (np.arange(N, dtype=np.float32)[:, None]
+           * np.ones((1, D), np.float32))
+  return (Dataset().init_graph((rows, cols), layout='COO', num_nodes=N)
+          .init_node_features(feats))
+
+
+_WARM = {}
+
+
+def _engine(model=False):
+  m = (TreeSAGE(hidden_features=8, out_features=5,
+                num_layers=len(FANOUTS)) if model else None)
+  eng = ServingEngine(_dataset(), FANOUTS, model=m, seed=7,
+                      buckets=BUCKETS)
+  if model:
+    eng.init_params(jax.random.key(0))
+  return eng
+
+
+def _frontend(auto=True, model=False, **kw):
+  kw.setdefault('max_wait_ms', 1.0)
+  kw.setdefault('default_deadline_ms', 30000.0)
+  return ServingFrontend(_engine(model=model), auto_start=auto,
+                         warmup=True, **kw)
+
+
+def _fleet(n=3, auto=(), model=False, **router_kw):
+  """n local replicas r0..r{n-1}; indices in ``auto`` run their
+  executor, the rest stay manual (queued requests sit — strandable)."""
+  router_kw.setdefault('auto_start', False)
+  router_kw.setdefault('dead_after', 2)
+  reps = [LocalReplica(f'r{i}', _frontend(auto=i in auto, model=model))
+          for i in range(n)]
+  return FleetRouter(reps, **router_kw), reps
+
+
+def _drain_all(router, reps, futs, timeout=20.0):
+  """Pump every live replica until the given futures resolve."""
+  deadline = time.monotonic() + timeout
+  out = []
+  for f in futs:
+    while not f.done():
+      for r in reps:
+        if not r._dead:
+          r.frontend.pump_once(block=False)
+      if time.monotonic() > deadline:
+        raise TimeoutError('fleet futures stuck')
+    out.append(f.result(1.0))
+  return out
+
+
+# -- routing & accounting ----------------------------------------------------
+def test_fleet_spreads_and_resolves_all(request):
+  router, reps = _fleet(3, auto=(0, 1, 2))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  futs = [router.submit([i % N]) for i in range(12)]
+  res = [f.result(20.0) for f in futs]
+  assert len(res) == 12
+  st = router.stats()
+  assert st['submitted'] == 12
+  assert st['resolved'] == {'ok': 12, 'shed': 0, 'error': 0}
+  assert st['in_flight'] == 0
+  # the weighted cycle reaches every replica
+  for r in reps:
+    assert r.frontend.admission.admitted > 0
+
+
+def test_fleet_answers_match_offline_reference(request):
+  """Whichever replica serves (one engine seed fleet-wide), the answer
+  is the per-seed offline reference — the property that makes redrive
+  answers byte-identical too."""
+  router, reps = _fleet(2, auto=(0, 1))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  ref_eng = reps[0].frontend.engine
+  for seed in (3, 11, 7):
+    got = router.infer([seed], timeout=20.0)
+    ref = ref_eng.offline_reference([seed])
+    np.testing.assert_array_equal(got.nodes, ref.nodes)
+
+
+# -- failover: eviction + exactly-once redrive -------------------------------
+def test_dead_replica_evicted_and_stranded_requests_redriven(request):
+  """Kill a replica with queued requests: after eviction every
+  stranded request is redriven to a survivor EXACTLY once and every
+  future resolves ok — zero lost, zero failed (the acceptance
+  arithmetic)."""
+  router, reps = _fleet(3, auto=(1, 2))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  futs = [router.submit([i % N]) for i in range(9)]
+  stranded = reps[0].frontend.admission.depth()
+  assert stranded > 0                  # r0 never pumps: requests sit
+  reps[0].kill()
+  assert router.check_replicas()['r0'] == 'healthy'   # miss 1
+  assert router.check_replicas()['r0'] == 'dead'      # miss 2: evict
+  st = router.stats()
+  assert st['evictions'] == 1
+  assert st['redriven'] == stranded
+  res = _drain_all(router, reps, futs)
+  assert len(res) == 9
+  st = router.stats()
+  assert st['resolved'] == {'ok': 9, 'shed': 0, 'error': 0}
+  assert st['submitted'] == 9 and st['in_flight'] == 0
+  evicts = [e for e in recorder.events('serving.failover')
+            if e.get('event') == 'evict']
+  assert evicts and evicts[0]['redriven'] == stranded
+  redrives = [e for e in recorder.events('serving.failover')
+              if e.get('event') == 'redrive']
+  assert len(redrives) == stranded
+
+
+def test_second_loss_after_redrive_resolves_typed(request):
+  """A request may be redriven at most once: when its survivor dies
+  too, the future resolves with typed FailoverExhausted — never a
+  silent drop, never an endless bounce."""
+  router, reps = _fleet(2, auto=())
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  fut = router.submit([3])
+  first = next(n for n, e in router.stats()['replicas'].items()
+               if reps[int(n[1])].frontend.admission.depth())
+  reps[int(first[1])].kill()
+  router.check_replicas(), router.check_replicas()
+  assert router.stats()['redriven'] == 1
+  second = 'r1' if first == 'r0' else 'r0'
+  reps[int(second[1])].kill()
+  router.check_replicas(), router.check_replicas()
+  with pytest.raises(FailoverExhausted):
+    fut.result(5.0)
+  st = router.stats()
+  assert st['resolved'] == {'ok': 0, 'shed': 0, 'error': 1}
+  assert [e for e in recorder.events('serving.failover')
+          if e.get('event') == 'exhausted']
+
+
+def test_no_replica_accepts_raises_typed(request):
+  router, reps = _fleet(2, auto=())
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  for r in reps:
+    r.kill()
+  router.check_replicas(), router.check_replicas()
+  with pytest.raises(FailoverExhausted):
+    router.submit([1])
+
+
+# -- the overloaded-vs-dead discriminator under chaos delay ------------------
+def test_slow_replica_overloaded_not_evicted_under_chaos_delay(request):
+  """ISSUE 13 satellite: chaos ``delay`` on one replica's heartbeat
+  classifies it OVERLOADED (slow-but-alive) — it keeps serving at
+  reduced weight and is never evicted; its in-flight requests stay
+  put (no redrive)."""
+  chaos.install({'faults': [{'site': 'serving.replica',
+                             'action': 'delay', 'op': 'heartbeat',
+                             'replica': 'r1', 'nth': 1, 'count': 99,
+                             'secs': 0.06}]})
+  router, reps = _fleet(3, auto=(0, 1, 2), slow_ms=30.0)
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  for _ in range(3):
+    states = router.check_replicas()
+  assert states['r1'] == 'overloaded'
+  assert router.stats()['evictions'] == 0
+  futs = [router.submit([i % N]) for i in range(24)]
+  for f in futs:
+    f.result(20.0)
+  counts = {r.name: r.frontend.admission.admitted for r in reps}
+  assert counts['r1'] > 0                      # still serving
+  assert counts['r1'] < counts['r0']           # at reduced weight
+  assert counts['r1'] < counts['r2']
+  assert router.stats()['redriven'] == 0       # nothing moved
+
+
+def test_chaos_kill_evicts_and_redrives_exactly_once(request):
+  """The dead half of the discriminator, driven by the declarative
+  chaos plan: a ``kill`` on the replica seam makes heartbeats miss,
+  the router evicts after ``dead_after`` misses and redrives the
+  stranded in-flight requests exactly once."""
+  router, reps = _fleet(3, auto=(1, 2))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  futs = [router.submit([i % N]) for i in range(9)]
+  stranded = reps[0].frontend.admission.depth()
+  assert stranded > 0
+  chaos.install({'faults': [{'site': 'serving.replica',
+                             'action': 'kill', 'op': 'heartbeat',
+                             'replica': 'r0', 'nth': 1}]})
+  router.check_replicas()                      # kill fires -> miss 1
+  router.check_replicas()                      # miss 2 -> evict
+  assert router.replica_states()['r0'] == 'dead'
+  assert router.stats()['redriven'] == stranded
+  assert len(_drain_all(router, reps, futs)) == 9
+  assert router.stats()['resolved']['error'] == 0
+
+
+def test_flap_below_threshold_costs_nothing(request):
+  """A flap shorter than the eviction threshold: one heartbeat miss,
+  no eviction, no redrive; the replica is healthy again on its next
+  good heartbeat."""
+  router, reps = _fleet(2, auto=(0, 1), dead_after=3)
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  reps[0]._flap_until = time.monotonic() + 0.05
+  assert router.check_replicas()['r0'] == 'healthy'   # miss 1 only
+  assert router.stats()['replicas']['r0']['misses'] == 1
+  time.sleep(0.06)
+  assert router.check_replicas()['r0'] == 'healthy'
+  assert router.stats()['replicas']['r0']['misses'] == 0
+  assert router.stats()['evictions'] == 0
+
+
+def test_flap_past_threshold_evicts_then_readmits(request):
+  router, reps = _fleet(2, auto=(0, 1), dead_after=2)
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  reps[0]._flap_until = time.monotonic() + 0.15
+  router.check_replicas()
+  assert router.check_replicas()['r0'] == 'dead'
+  time.sleep(0.16)
+  assert router.check_replicas()['r0'] == 'healthy'   # re-admitted
+  assert [e for e in recorder.events('serving.failover')
+          if e.get('event') == 'readmit']
+  router.infer([1], timeout=20.0)              # takes traffic again
+
+
+def test_submit_evict_race_still_redrives(request):
+  """The monitor may evict a replica BETWEEN handle.submit and the
+  ledger insert — the eviction's stranded snapshot misses the entry,
+  so submit itself must notice and redrive (else the future freezes:
+  the one way to silently lose a request)."""
+  router, reps = _fleet(2, auto=(1,))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  orig = reps[0].submit
+
+  def racing_submit(seeds, deadline_ms=None):
+    fut = orig(seeds, deadline_ms)
+    router._evict('r0')              # the monitor wins the race
+    return fut
+
+  reps[0].submit = racing_submit
+  fut = router.submit([3])
+  assert router.stats()['redriven'] == 1   # caught by the guard
+  assert fut.result(20.0) is not None
+  assert router.stats()['resolved'] == {'ok': 1, 'shed': 0, 'error': 0}
+
+
+# -- draining routing --------------------------------------------------------
+def test_draining_replica_skipped_not_evicted(request):
+  router, reps = _fleet(2, auto=(0, 1))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  reps[0].frontend.admission.set_draining(True)
+  assert router.check_replicas()['r0'] == 'draining'
+  before = reps[0].frontend.admission.admitted
+  futs = [router.submit([i % N]) for i in range(6)]
+  for f in futs:
+    f.result(20.0)
+  assert reps[0].frontend.admission.admitted == before  # all to r1
+  assert router.stats()['evictions'] == 0
+  assert router._health()['healthy']
+  reps[0].frontend.admission.set_draining(False)
+  assert router.check_replicas()['r0'] == 'healthy'
+
+
+def test_abandoned_futures_swept_from_ledger(request):
+  """A caller that times out and walks away must not grow the ledger
+  (and the /healthz in_flight count) forever: resolved-but-never-
+  collected entries are swept after the grace window."""
+  router, reps = _fleet(2, auto=(0, 1), abandon_grace_s=0.05)
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  fut = router.submit([3])
+  deadline = time.monotonic() + 10
+  while not fut.done():
+    assert time.monotonic() < deadline
+    time.sleep(0.01)
+  time.sleep(0.06)                   # past the grace window
+  router.check_replicas()
+  st = router.stats()
+  assert st['in_flight'] == 0 and st['swept'] == 1
+  with pytest.raises(RuntimeError, match='swept'):
+    fut.result(1.0)
+
+
+def test_malformed_request_raises_without_charging_misses(request):
+  """A bad client input (seed outside the node space) is the CLIENT's
+  ValueError — it must not count heartbeat misses against replicas
+  (two bad inputs must never evict a healthy fleet) nor surface as
+  FailoverExhausted."""
+  router, reps = _fleet(2, auto=(0, 1))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  for _ in range(3):
+    with pytest.raises(ValueError):
+      router.submit([N + 5])
+  st = router.stats()
+  assert st['evictions'] == 0
+  assert all(r['misses'] == 0 for r in st['replicas'].values())
+  router.infer([1], timeout=20.0)    # fleet unharmed
+
+
+def test_shutdown_replica_rerouted_and_rotated_out(request):
+  """A cleanly shut-down replica still answers heartbeats (queue 0,
+  draining False): its typed shutdown rejections must REROUTE to
+  survivors, and its heartbeats count as misses so it leaves
+  rotation — not sit at full weight refusing its traffic share."""
+  router, reps = _fleet(2, auto=(0, 1))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  reps[0].frontend.shutdown()
+  # submits that land on r0 first reroute to r1 — callers never see
+  # the shutdown rejection while a survivor serves
+  for i in range(6):
+    router.infer([i], timeout=20.0)
+  assert router.stats()['resolved']['ok'] == 6
+  router.check_replicas()
+  assert router.check_replicas()['r0'] == 'dead'  # rotated out
+  router.infer([7], timeout=20.0)
+
+
+def test_all_replicas_draining_raises_admission_typed(request):
+  """A coordinated swap (every live replica draining) must surface as
+  the documented AdmissionRejected(reason='draining') with its
+  retry-after hint — NOT as a fleet-wide-outage FailoverExhausted."""
+  router, reps = _fleet(2, auto=(0, 1))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  for r in reps:
+    r.frontend.admission.set_draining(True)
+  router.check_replicas()
+  with pytest.raises(AdmissionRejected) as ei:
+    router.submit([1])
+  assert ei.value.reason == 'draining'
+  assert ei.value.retry_after_ms and ei.value.retry_after_ms > 0
+  for r in reps:
+    r.frontend.admission.set_draining(False)
+  router.check_replicas()
+  router.infer([1], timeout=20.0)    # cutover over
+
+
+def test_overlapping_drain_windows_refcounted():
+  """Two overlapping cutovers: the FIRST one's exit must not reopen
+  admission while the second still drains (depth-counted)."""
+  fe = _frontend(auto=False, model=False)
+  try:
+    fe.admission.set_draining(True)
+    fe.admission.set_draining(True)
+    fe.admission.set_draining(False)     # first window closes
+    assert fe.admission.draining()       # second still open
+    with pytest.raises(AdmissionRejected):
+      fe.submit([1])
+    fe.admission.set_draining(False)
+    assert not fe.admission.draining()
+    fe.submit([1])                       # reopened
+  finally:
+    fe.shutdown()
+
+
+# -- hot model swap ----------------------------------------------------------
+def test_hot_swap_commits_new_version_zero_drops(request):
+  fe = _frontend(auto=True, model=True)
+  request.addfinalizer(fe.shutdown)
+  eng = fe.engine
+  r_before = fe.infer([3])
+  new_params = eng.model.init(
+      jax.random.key(99),
+      [np.zeros((w, D), np.float32) for w in eng.level_widths],
+      [np.ones((w,), bool) for w in eng.level_widths])
+  out = fe.swap_model(new_params, version=7)
+  assert out['version'] == 7 and eng.model_version == 7
+  assert not fe.admission.draining()           # window closed
+  r_after = fe.infer([3])
+  np.testing.assert_array_equal(r_before.nodes, r_after.nodes)
+  assert not np.array_equal(r_before.logits, r_after.logits)
+  ref = eng.offline_reference([3], params=new_params)
+  np.testing.assert_allclose(np.asarray(r_after.logits),
+                             np.asarray(ref.logits), atol=1e-4)
+  ev = [e for e in recorder.events('serving.swap') if e.get('ok')]
+  assert ev and ev[-1]['version'] == 7
+  assert fe.stats()['model_version'] == 7
+
+
+def test_hot_swap_parity_mismatch_rolls_back_typed(request):
+  """atol=0 makes the cross-bucket float-tolerance identity (engine
+  fine print, ~1e-6) register as a parity failure: the swap must roll
+  back typed, keep the prior version serving, and drop nothing."""
+  fe = _frontend(auto=True, model=True)
+  request.addfinalizer(fe.shutdown)
+  eng = fe.engine
+  old_params, old_version = eng.params, eng.model_version
+  r_before = fe.infer([5])
+  new_params = eng.model.init(
+      jax.random.key(99),
+      [np.zeros((w, D), np.float32) for w in eng.level_widths],
+      [np.ones((w,), bool) for w in eng.level_widths])
+  with pytest.raises(SwapParityError):
+    fe.swap_model(new_params, probe_seeds=[0, 9, 17, 25], atol=0.0)
+  assert eng.params is old_params              # rolled back
+  assert eng.model_version == old_version
+  assert not fe.admission.draining()
+  r_after = fe.infer([5])                      # old version serving
+  np.testing.assert_array_equal(np.asarray(r_before.logits),
+                                np.asarray(r_after.logits))
+  ev = [e for e in recorder.events('serving.swap')
+        if e.get('rolled_back')]
+  assert ev and not ev[-1]['ok']
+  assert fe.stats()['shed']['shutdown'] == 0   # nothing flushed
+
+
+def test_swap_validation_refuses_bad_tree_before_drain(request):
+  fe = _frontend(auto=True, model=True)
+  request.addfinalizer(fe.shutdown)
+  other = TreeSAGE(hidden_features=16, out_features=5,
+                   num_layers=len(FANOUTS))
+  eng = fe.engine
+  bad = other.init(jax.random.key(0),
+                   [np.zeros((w, D), np.float32)
+                    for w in eng.level_widths],
+                   [np.ones((w,), bool) for w in eng.level_widths])
+  with pytest.raises(SwapValidationError):
+    fe.swap_model(bad)
+  assert not fe.admission.draining()           # never even drained
+  assert not recorder.events('serving.swap')
+
+
+def test_swap_abort_when_executor_never_quiesces(request):
+  """A wedged in-flight dispatch aborts the swap TYPED as an
+  executor-health signal (SwapAbortedError, not a parity verdict),
+  still emits its one serving.swap event, and leaves the drain window
+  closed and the prior version serving."""
+  from graphlearn_tpu.serving import SwapAbortedError
+  fe = _frontend(auto=True, model=True)
+  request.addfinalizer(fe.shutdown)
+  eng = fe.engine
+  new_params = eng.model.init(
+      jax.random.key(99),
+      [np.zeros((w, D), np.float32) for w in eng.level_widths],
+      [np.ones((w,), bool) for w in eng.level_widths])
+  assert fe._dispatch_gate.acquire(timeout=5.0)   # wedge the gate
+  try:
+    with pytest.raises(SwapAbortedError):
+      fe.swap_model(new_params, gate_timeout_s=0.1)
+  finally:
+    fe._dispatch_gate.release()
+  assert not fe.admission.draining()
+  assert eng.model_version == 0                   # never displaced
+  ev = [e for e in recorder.events('serving.swap') if not e.get('ok')]
+  assert ev and not ev[-1]['rolled_back']
+  fe.infer([3])                                   # still serving
+
+
+def test_swap_needs_model(request):
+  fe = _frontend(auto=True, model=False)
+  request.addfinalizer(fe.shutdown)
+  with pytest.raises(SwapValidationError):
+    hot_swap(fe, {'w': np.ones(3)})
+
+
+def test_draining_rejection_carries_retry_after(request):
+  fe = _frontend(auto=True, model=False)
+  request.addfinalizer(fe.shutdown)
+  fe.admission.set_draining(True)
+  with pytest.raises(AdmissionRejected) as ei:
+    fe.submit([1])
+  assert ei.value.reason == 'draining'
+  assert ei.value.retry_after_ms and ei.value.retry_after_ms > 0
+  fe.admission.set_draining(False)
+  fe.infer([1])                                # window over, serving
+
+
+# -- SLO / healthz during drain (ISSUE 13 satellite) -------------------------
+def test_draining_sheds_do_not_burn_slo_but_real_sheds_do(monkeypatch):
+  monkeypatch.setenv('GLT_SERVING_SLO_P99_MS', '50')
+  fe = _frontend(auto=False, model=False, max_queue=4,
+                 default_deadline_ms=50.0)
+  try:
+    win = fe.slo.windows[0]
+    # intentional draining sheds: NO SLO samples, no budget burned
+    fe.admission.set_draining(True)
+    for _ in range(5):
+      with pytest.raises(AdmissionRejected):
+        fe.submit([1])
+    assert fe.slo.window_stats(win)['count'] == 0
+    assert fe.slo.window_stats(win)['burn_rate'] == 0.0
+    assert fe.admission.stats()['shed']['draining'] == 5
+    # healthz stays green while draining
+    h = fe._health()
+    assert h['healthy'] and h['draining']
+    fe.admission.set_draining(False)
+    # a REAL overload shed (queue_full) burns budget
+    for _ in range(4):
+      fe.submit([1])
+    with pytest.raises(AdmissionRejected):
+      fe.submit([1])                           # queue_full at 4/4
+    st = fe.slo.window_stats(win)
+    assert st['count'] == 1 and st['violations'] == 1
+    assert st['burn_rate'] > 1.0
+    # deadline sheds burn too (queued past deadline, shed at take)
+    time.sleep(0.06)
+    fe.pump_once(block=False)
+    assert fe.slo.window_stats(win)['violations'] >= 2
+  finally:
+    fe.shutdown()
+
+
+def test_fleet_health_component_reports_per_replica(request):
+  from graphlearn_tpu.telemetry.live import live
+  router, reps = _fleet(2, auto=(0, 1))
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  router.check_replicas()
+  h = live.healthz()
+  fleet = h['components']['fleet']
+  assert fleet['healthy']
+  assert set(fleet['replicas']) == {'r0', 'r1'}
+  assert fleet['replicas']['r0']['state'] == 'healthy'
+  # per-replica SLO feed rides the heartbeat serving block
+  assert fleet['replicas']['r0']['slo'] is not None
+  # gauges: replica counts by state
+  reps[0].kill()
+  router.check_replicas(), router.check_replicas()
+  st = router.stats()['replicas']
+  assert st['r0']['state'] == 'dead' and st['r1']['state'] == 'healthy'

@@ -176,3 +176,30 @@ def test_slow_dispatch_sheds_queued_request_typed(serving_cluster):
       'the slow rider itself still completes (picked before deadline)'
   assert any(e['reason'] == 'deadline'
              for e in recorder.events('serving.shed'))
+
+
+def test_draining_rejection_travels_with_reason(serving_cluster):
+  """ISSUE 13: the hot-swap cutover's reason='draining' + retry-after
+  hint survive the wire — rebuilt from the structured extra field,
+  never parsed out of the message text (a fleet router keys its
+  reroute decision off the reason)."""
+  _, client, _, frontend = serving_cluster
+  frontend.admission.set_draining(True)
+  try:
+    with pytest.raises(AdmissionRejected) as ei:
+      client.serve([3])
+    assert ei.value.reason == 'draining'
+    assert ei.value.retry_after_ms and ei.value.retry_after_ms > 0
+  finally:
+    frontend.admission.set_draining(False)
+  out = client.serve([3])            # cutover over, serving again
+  assert out['nodes'].shape[0] == 1
+
+
+def test_swap_validation_error_travels_typed(serving_cluster):
+  """serving_swap on a model-less tier refuses typed; the client sees
+  the same SwapValidationError class (wire error-kind field)."""
+  from graphlearn_tpu.serving.swap import SwapValidationError
+  _, client, _, _ = serving_cluster
+  with pytest.raises(SwapValidationError):
+    client.swap_model({'w': np.ones(3, np.float32)})
