@@ -1092,13 +1092,14 @@ def _run_resume_row(timeout: int):
   return None
 
 
-def _run_bench_serving(timeout: int, extra_args=()):
-  """Shared `bench_serving.py` subprocess harness for the serving and
-  fleet phases: spawn with forced-CPU env, scan stdout bottom-up for
+def _run_bench_serving(timeout: int, extra_args=(),
+                       script_name='bench_serving.py'):
+  """Shared benchmarks/ subprocess harness for the serving, fleet and
+  ingest phases: spawn with forced-CPU env, scan stdout bottom-up for
   the last JSON line, return (row, returncode) — or None on
   timeout/no-parseable-output."""
   script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        'benchmarks', 'bench_serving.py')
+                        'benchmarks', script_name)
   cmd = [sys.executable, script, '--cpu', *extra_args]
   env = dict(os.environ)
   env.setdefault('JAX_PLATFORMS', 'cpu')
@@ -1165,6 +1166,28 @@ def _run_fleet_row(timeout: int):
           'below 0.6x across the mid-run replica kill (see '
           'dist.serving.fleet)', file=sys.stderr)
   return row
+
+
+def _run_ingest_row(timeout: int):
+  """`benchmarks/bench_ingest.py` (ISSUE 14): the freshness-vs-
+  throughput open loop — events/s ingested through the WAL-backed
+  delta-CSR pipeline while the Zipf serving load holds its p99.  The
+  worker exits nonzero on ANY shed/errored request during
+  steady-state ingest, a recompile after warmup, or unapplied lag at
+  the end — stamped into ``ingest_pin``.  Feeds
+  dist.ingest.events_per_sec / dist.ingest.p99_during_ingest_ms."""
+  got = _run_bench_serving(timeout, script_name='bench_ingest.py')
+  if got is None:
+    return None
+  r, returncode = got
+  if 'events_per_sec' not in r:        # died before the final row
+    return None
+  r['ingest_pin'] = 'ok' if returncode == 0 else 'FAILED'
+  if returncode != 0:
+    print('ingest phase: shed/error during steady-state ingest, '
+          'recompile after warmup, or unapplied lag (see '
+          'dist.ingest)', file=sys.stderr)
+  return r
 
 
 def _aggregate(results, fused_res, dist, hetero=None):
@@ -1538,6 +1561,22 @@ def main():
         emit()
   elif isinstance(dist, dict) and 'error' not in dist:
     print(f'budget: skipping serving phase ({budget_left():.0f}s left)',
+          file=sys.stderr)
+
+  # phase 3g — streaming ingestion (ISSUE 14): the freshness-vs-
+  # throughput open loop (events/s through the WAL-backed delta-CSR
+  # pipeline while the Zipf serving p99 holds); feeds
+  # dist.ingest.events_per_sec / .p99_during_ingest_ms, and the
+  # worker's nonzero exit (any shed during ingest / recompile /
+  # unapplied lag) lands in ingest_pin
+  if isinstance(dist, dict) and 'error' not in dist and \
+      budget_left() > 90:
+    r = _run_ingest_row(int(min(300, max(budget_left() - 30, 90))))
+    if r is not None:
+      dist['ingest'] = r
+      emit()
+  elif isinstance(dist, dict) and 'error' not in dist:
+    print(f'budget: skipping ingest phase ({budget_left():.0f}s left)',
           file=sys.stderr)
 
   # phase 4 — extra primary sessions stabilize the per-batch median

@@ -1,0 +1,222 @@
+"""Streaming ingestion bench: freshness vs throughput under live
+serving load (ISSUE 14).
+
+The open-loop question the streaming plane exists to answer: how many
+edge-insert events/s can the WAL → delta-CSR → publish pipeline
+sustain while the Zipf serving tier keeps its p99?  Two phases, same
+seeded open-loop schedule (`bench_serving.make_schedule` — the
+coordinated-omission-resistant protocol):
+
+  1. **baseline** — serving only, no ingest: the p99 reference line.
+  2. **ingest** — the same traffic while an ingest thread drives
+     `IngestPipeline.ingest` open-throttle (durable WAL append +
+     merge + RCU publish per batch).  Reported: applied events/s,
+     serving p50/p95/p99 DURING ingest, versions published, final
+     lag.
+
+Acceptance (the worker exits nonzero otherwise): ZERO sheds and zero
+errors during steady-state ingest, zero recompiles after warmup (the
+stream's ``reserve_edges`` headroom keeps every publish at one shape
+— the ingest thread stops at the capacity fence rather than force a
+mid-run recompile, and reports if it hit it), and zero final lag
+(everything appended was applied).
+
+Feeds ``dist.ingest.events_per_sec`` ('higher') and
+``dist.ingest.p99_during_ingest_ms`` ('lower') through bench.py.
+
+Knobs: CLI flags below; the pipeline reads ``GLT_INGEST_WAL_DIR`` /
+``GLT_INGEST_COMPACT_EVERY`` / ``GLT_INGEST_MAX_LAG``
+(benchmarks/README "Streaming ingestion (r15)").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmarks.bench_serving import (_percentile, drive_open_loop,  # noqa: E402
+                                      make_schedule)
+
+
+def build_streaming_dataset(n: int, dim: int, reserve: int, seed=0):
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.streaming import StreamingGraph
+  rng = np.random.default_rng(seed)
+  deg = 8
+  rows = np.repeat(np.arange(n), deg)
+  cols = rng.integers(0, n, rows.shape[0])
+  feats = rng.random((n, dim), dtype=np.float32)
+  stream = StreamingGraph.from_coo(rows, cols, num_nodes=n,
+                                   reserve_edges=reserve * len(rows))
+  ds = Dataset().init_node_features(feats).attach_stream(stream)
+  return ds, stream
+
+
+def run_serving_phase(label, frontend, engine, plan, result,
+                      warm_compiles):
+  t0 = time.perf_counter()
+  outcomes = drive_open_loop(frontend, plan)
+  run_s = time.perf_counter() - t0
+  lats = sorted(l for l, o in outcomes if o == 'ok' and l is not None)
+  row = {
+      'label': label, 'open_loop': True,
+      'requests': len(plan),
+      'completed': len(lats),
+      'shed': sum(1 for _, o in outcomes if o == 'shed'),
+      'errors': sum(1 for _, o in outcomes if o == 'error'),
+      'p50_ms': round(_percentile(lats, 0.50) or 0.0, 3),
+      'p95_ms': round(_percentile(lats, 0.95) or 0.0, 3),
+      'p99_ms': round(_percentile(lats, 0.99) or 0.0, 3),
+      'qps': round(len(lats) / max(run_s, 1e-9), 1),
+      'recompiles_after_warmup':
+          engine.compile_count() - warm_compiles,
+  }
+  result[label] = row
+  print(json.dumps(result), flush=True)
+  return row
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+  ap.add_argument('--nodes', type=int, default=8000)
+  ap.add_argument('--dim', type=int, default=32)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[5, 3])
+  ap.add_argument('--rate', type=float, default=150.0,
+                  help='open-loop serving arrival rate, requests/s')
+  ap.add_argument('--duration', type=float, default=2.5)
+  ap.add_argument('--zipf-a', type=float, default=1.1)
+  ap.add_argument('--batch-events', type=int, default=256,
+                  help='edges per ingest() call (one WAL record)')
+  ap.add_argument('--reserve', type=int, default=8,
+                  help='edge-capacity headroom factor over the base '
+                       'graph (publishes stay at ONE shape inside it)')
+  ap.add_argument('--wal-dir', default=None,
+                  help='WAL root (default: a fresh temp dir)')
+  ap.add_argument('--cpu', action='store_true')
+  args = ap.parse_args(argv)
+  import jax
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  from graphlearn_tpu.models.tree import TreeSAGE
+  from graphlearn_tpu.serving import ServingFrontend
+  from graphlearn_tpu.serving.engine import ServingEngine
+  from graphlearn_tpu.streaming import IngestPipeline
+  from graphlearn_tpu.telemetry import recorder
+  recorder.enable(None)
+
+  n = args.nodes
+  ds, stream = build_streaming_dataset(n, args.dim, args.reserve)
+  model = TreeSAGE(hidden_features=32, out_features=16,
+                   num_layers=len(args.fanout))
+  eng = ServingEngine(ds, args.fanout, model=model, seed=11)
+  eng.init_params(jax.random.key(0))
+  t0 = time.perf_counter()
+  eng.warmup()
+  warm_secs = time.perf_counter() - t0
+  warm_compiles = eng.compile_count()
+  fe = ServingFrontend(eng, auto_start=True, warmup=False)
+  result = {'num_nodes': n, 'fanout': list(args.fanout),
+            'platform': jax.devices()[0].platform,
+            'warmup_secs': round(warm_secs, 2),
+            'base_edges': stream.num_edges,
+            'edge_capacity': stream.edge_capacity,
+            'base_version': stream.version}
+
+  plan = make_schedule(args.rate, args.duration, n, args.zipf_a,
+                       seed=3)
+  base = run_serving_phase('baseline', fe, eng, plan, result,
+                           warm_compiles)
+
+  wal_dir = args.wal_dir or tempfile.mkdtemp(prefix='glt-ingest-')
+  pipe = IngestPipeline(stream, wal_dir=wal_dir)
+  stop = threading.Event()
+  ing = {'events': 0, 'batches': 0, 'secs': 0.0, 'capacity_fence': 0}
+  rng = np.random.default_rng(17)
+  # the capacity fence: stop before a publish would cross the padded
+  # edge capacity (a shape change would recompile the warm ladder
+  # mid-run — that is a sizing decision, not a latency datum)
+  fence = stream.edge_capacity - 2 * args.batch_events
+
+  def ingest_loop():
+    t0 = time.perf_counter()
+    try:
+      while not stop.is_set():
+        if stream.num_edges >= fence:
+          ing['capacity_fence'] = 1
+          break
+        src = rng.integers(0, n, args.batch_events)
+        dst = rng.integers(0, n, args.batch_events)
+        pipe.ingest(src, dst)
+        ing['events'] += args.batch_events
+        ing['batches'] += 1
+    except Exception as e:               # noqa: BLE001 — reported
+      ing['error'] = f'{type(e).__name__}: {e}'
+    finally:
+      # always stamp the wall: a raise mid-run must not leave 0.0
+      # and turn events/max(secs, 1e-9) into an absurd throughput
+      ing['secs'] = time.perf_counter() - t0
+
+  v0 = stream.version
+  t = threading.Thread(target=ingest_loop, daemon=True)
+  t.start()
+  row = run_serving_phase('ingest', fe, eng, plan, result,
+                          warm_compiles)
+  stop.set()
+  t.join(30.0)
+  fe.shutdown()
+  lag = int(pipe.wal.lifetime_events - pipe.applied_events)
+  ev_s = round(ing['events'] / max(ing['secs'], 1e-9), 1)
+  result.update({
+      'events_per_sec': ev_s,
+      'p99_during_ingest_ms': row['p99_ms'],
+      'p99_baseline_ms': base['p99_ms'],
+      'ingested_events': ing['events'],
+      'ingest_batches': ing['batches'],
+      'versions_published': stream.version - v0,
+      'graph_version': stream.version,
+      'final_lag_events': lag,
+      'capacity_fence_hit': ing['capacity_fence'],
+      'compactions': pipe.stats()['compactions'],
+      'shed': row['shed'], 'errors': row['errors'],
+  })
+  if 'error' in ing:
+    result['ingest_error'] = ing['error']
+  pipe.close()
+  print(json.dumps(result), flush=True)
+  rc = 0
+  if row['shed'] or row['errors']:
+    print(f"WARNING: serving shed {row['shed']} / errored "
+          f"{row['errors']} request(s) during steady-state ingest — "
+          'the serve-during-ingest contract is broken',
+          file=sys.stderr)
+    rc = 1
+  if row['recompiles_after_warmup'] or base['recompiles_after_warmup']:
+    print('WARNING: recompile(s) after warmup — a publish escaped '
+          'the reserved edge capacity', file=sys.stderr)
+    rc = 1
+  if lag != 0:
+    print(f'WARNING: {lag} appended event(s) never applied',
+          file=sys.stderr)
+    rc = 1
+  if ing['events'] == 0:
+    print('WARNING: ingest thread applied nothing — the events/s '
+          'datum is vacuous', file=sys.stderr)
+    rc = 1
+  if 'error' in ing:
+    print(f"WARNING: ingest thread died: {ing['error']}",
+          file=sys.stderr)
+    rc = 1
+  return rc
+
+
+if __name__ == '__main__':
+  sys.exit(main())

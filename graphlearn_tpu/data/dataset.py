@@ -135,6 +135,26 @@ class Dataset:
       self.graph = Graph(topo, mode=graph_mode, device=device)
     return self
 
+  def attach_stream(self, stream) -> 'Dataset':
+    """Back this dataset's (homogeneous) topology with a streaming
+    graph (`streaming.StreamingGraph`, ISSUE 14): ``self.graph``
+    becomes a device `Graph` over the stream's CURRENT pinned view
+    and ``self.stream`` carries the handle version-fencing consumers
+    re-pin from — the `ServingEngine` per coalesced run, the mesh
+    samplers at dispatch/chunk seams.  Static consumers that read
+    ``self.graph`` once keep whatever version was pinned when they
+    read it (a complete graph, never a torn one); call again after a
+    quiesce to re-snapshot."""
+    if self.edge_features is not None:
+      raise NotImplementedError(
+          'attach_stream on a dataset with edge features is not '
+          'supported yet — streamed edges get eids past the frozen '
+          'edge-feature table (and the published device graph '
+          'carries no edge_ids to gather by)')
+    self.stream = stream
+    self.graph = stream.pin().as_graph()
+    return self
+
   # -- features ------------------------------------------------------------
   def init_node_features(self, node_feature_data=None, id2idx=None,
                          sort_func: Optional[Callable] = None,

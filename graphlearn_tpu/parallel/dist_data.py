@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 from ..typing import RangePartitionBook
-from ..utils.topo import coo_to_csr
+from ..utils.topo import coo_to_csr, ptr2ind
 
 
 class DistGraph:
@@ -397,6 +397,56 @@ def build_dist_graph(rows: np.ndarray, cols: np.ndarray,
   return DistGraph(indptr_s, indices_s, eids_s, bounds), old2new
 
 
+def restack_stream_view(view, old2new: np.ndarray, bounds: np.ndarray,
+                        min_edge_width: int = 0):
+  """Re-shard one published streaming `GraphView` by an EXISTING
+  partition book (ISSUE 14: the mesh arm of version fencing).
+
+  The view lives in the original (old) id space; ``old2new`` and
+  ``bounds`` are the dataset's frozen relabel + ownership — features,
+  labels, caches and the GNS hot split are all built against them, so
+  a streamed topology refresh must never move a node.  Edges are
+  recovered in EVENT order (``argsort(edge_ids)`` — edge ids are the
+  global event positions) and pushed through the exact
+  `build_dist_graph` per-partition ``coo_to_csr`` path, so a quiesced
+  streamed mesh graph is byte-identical to `DistDataset.from_full_graph`
+  over the same event sequence (pinned by tests).
+
+  ``min_edge_width`` floors the stacked indices width (the previous
+  stack's width): shapes only GROW, and only to the next power of two
+  — a compiled mesh step recompiles logarithmically over any growth,
+  never per publish.
+  """
+  from ..utils.padding import next_power_of_two
+  bounds = np.asarray(bounds, np.int64)
+  num_parts = len(bounds) - 1
+  counts = np.diff(bounds)
+  max_nodes = int(counts.max()) if num_parts else 0
+  order = np.argsort(np.asarray(view.edge_ids), kind='stable')
+  rows_old = ptr2ind(np.asarray(view.indptr))[order]
+  cols_old = np.asarray(view.indices)[order]
+  eids = np.asarray(view.edge_ids)[order]
+  rows_n = np.asarray(old2new)[rows_old]
+  cols_n = np.asarray(old2new)[cols_old]
+  owner = np.searchsorted(bounds, rows_n, side='right') - 1
+  per_part = np.bincount(owner, minlength=num_parts)
+  width = max(next_power_of_two(max(int(per_part.max(initial=0)), 1)),
+              int(min_edge_width))
+  indptr_s = np.zeros((num_parts, max_nodes + 1), dtype=np.int64)
+  indices_s = np.full((num_parts, width), -1, dtype=np.int32)
+  eids_s = np.full((num_parts, width), -1, dtype=np.int64)
+  for p in range(num_parts):
+    sel = owner == p
+    local_rows = rows_n[sel] - bounds[p]
+    iptr, idx, eid = coo_to_csr(local_rows, cols_n[sel],
+                                int(counts[p]), eids[sel])
+    indptr_s[p, :len(iptr)] = iptr
+    indptr_s[p, len(iptr):] = iptr[-1]
+    indices_s[p, :len(idx)] = idx
+    eids_s[p, :len(eid)] = eid
+  return indptr_s, indices_s, eids_s
+
+
 CACHE_PAD_ID = np.iinfo(np.int32).max  # sorts AFTER every real id
 
 
@@ -583,6 +633,44 @@ class DistDataset:
   @property
   def num_partitions(self) -> int:
     return self.graph.num_partitions
+
+  def attach_stream(self, stream) -> 'DistDataset':
+    """Back this dataset's topology with a streaming graph (ISSUE
+    14).  The stream lives in the ORIGINAL (old) id space; the
+    dataset's relabel/ownership stay frozen (features, caches and the
+    GNS hot split are built against them) and only the per-partition
+    CSR stacks refresh.  Samplers pick the handle up at their
+    dispatch/chunk seams (`DistNeighborSampler.maybe_refresh_stream`)
+    — one published ``graph_version`` per dispatch, never a torn
+    stack.  Single-controller only: the multi-host restack (each host
+    re-sharding its own partitions) is follow-on work."""
+    if self.host_parts is not None:
+      raise NotImplementedError(
+          'streaming refresh of a multi-host (host_parts) layout is '
+          'not supported yet — each host would need to restack its '
+          'own partitions from the stream')
+    if self.edge_features is not None:
+      raise NotImplementedError(
+          'attach_stream on a dataset with edge features is not '
+          'supported yet — streamed edges get eids past the frozen '
+          'edge-feature shards, so collect_edge_features would '
+          'gather wrong rows (growable edge-feature tiers are '
+          'follow-on work)')
+    if self.old2new is None:
+      raise ValueError('attach_stream needs a dataset with an '
+                       'old2new relabel (from_full_graph-style)')
+    self.stream = stream
+    view = stream.pin()
+    g = self.graph
+    indptr_s, indices_s, eids_s = restack_stream_view(
+        view, self.old2new, g.bounds,
+        min_edge_width=int(g.indices.shape[1]))
+    self.graph = DistGraph(indptr_s, indices_s, eids_s, g.bounds)
+    #: the version self.graph's stacks were built from — samplers
+    #: seed their seam fence here so the first dispatch skips a
+    #: redundant restack of the identical graph
+    self.stream_version = view.version
+    return self
 
   @classmethod
   def from_full_graph(cls, num_parts: int, rows, cols, node_feat=None,

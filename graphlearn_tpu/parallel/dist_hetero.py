@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..data.cold_cache import emit_cache_events
 from ..loader.prefetch import PrefetchingLoader
 from ..ops.unique import init_node, induce_next
 from ..sampler.hetero_neighbor_sampler import (_plan_capacities,
@@ -705,6 +706,11 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
         self._feat_lookups += lookups
         self._cold_lookups += misses
         self._cold_misses += misses
+      # surface the no-cache economics LIVE (ISSUE 14 satellite):
+      # cache.misses_total{scope=hetero} ticks with hits pinned at 0,
+      # so `cold_lookups == cold_misses` (ROADMAP item 3's hetero
+      # cold-cache gap) reads off /metrics instead of artifact-only
+      emit_cache_events('hetero', 0, int(misses), 0, 0)
     hp = (self.ds.host_parts if self.ds.host_parts is not None
           else np.arange(self.num_parts))
     # ONE capacity handshake for every owner-served type (ADVICE r4:
@@ -735,6 +741,8 @@ class DistHeteroNeighborSampler(ExchangeTelemetry):
         self._feat_lookups += lookups
         self._cold_lookups += misses
         self._cold_misses += misses
+      # same live accounting for the owner-served arm (see above)
+      emit_cache_events('hetero', 0, int(misses), 0, 0)
     return tuple(out)
 
   def sample_from_nodes(self, input_type: NodeType,

@@ -1258,11 +1258,59 @@ class DistNeighborSampler(ExchangeTelemetry):
     self._step_cnt = 0
     self._steps = {}
     self._device_arrays = None
+    #: streaming ingestion (ISSUE 14): last `graph_version` this
+    #: sampler's stacks were (re)built from.  Seeded from the version
+    #: `attach_stream` restacked ds.graph at, so the first dispatch
+    #: doesn't repeat that restack on an identical graph (None =
+    #: static dataset).
+    self._stream_ver = getattr(dataset, 'stream_version', None)
     self._init_stats()
 
   def _put_stacked(self, arr_local: np.ndarray) -> jax.Array:
     return put_stacked_host_local(self.mesh, self.axis, self.num_parts,
                                   self.ds.host_parts, arr_local)
+
+  def _put_shard(self, a: np.ndarray) -> jax.Array:
+    """One ``[P, ...]`` stack onto the mesh — the same placement
+    `_arrays` uses (host-local stacks on multi-host, a sharded
+    `device_put` under a single controller)."""
+    if getattr(self.ds, 'host_parts', None) is not None:
+      return self._put_stacked(a)
+    return jax.device_put(a, NamedSharding(self.mesh, P(self.axis)))
+
+  def maybe_refresh_stream(self):
+    """Version fence for streaming ingestion (ISSUE 14): when the
+    dataset carries a `streaming.StreamingGraph` handle
+    (`DistDataset.attach_stream`), re-pin the newest published view
+    at this dispatch seam — restack the per-partition CSR by the
+    FROZEN partition book (`restack_stream_view`) and RCU-swap the
+    device-arrays dict, so the dispatch that called `_arrays()` works
+    against exactly one ``graph_version`` end to end.  The cached-set
+    bitmask is invalidated at the same seam (``_gns_ver`` reset):
+    derived structures refresh with the graph they derive from.
+    Returns the pinned version (None without a stream)."""
+    stream = getattr(self.ds, 'stream', None)
+    if stream is None:
+      return None
+    view = stream.pin()
+    if view.version == self._stream_ver:
+      return self._stream_ver
+    from .dist_data import DistGraph, restack_stream_view
+    g = self.ds.graph
+    indptr_s, indices_s, eids_s = restack_stream_view(
+        view, self.ds.old2new, g.bounds,
+        min_edge_width=int(g.indices.shape[1]))
+    self.ds.graph = DistGraph(indptr_s, indices_s, eids_s, g.bounds)
+    if self._device_arrays is not None:
+      arrs = dict(self._device_arrays)   # RCU: in-flight dicts frozen
+      arrs['indptr'] = self._put_shard(indptr_s)
+      arrs['indices'] = self._put_shard(indices_s)
+      arrs['eids'] = self._put_shard(eids_s)
+      self._device_arrays = arrs
+    self._gns_ver = -1                   # version-fenced invalidation
+    self._stream_ver = view.version
+    self.ds.stream_version = view.version  # later samplers seed here
+    return self._stream_ver
 
   def _arrays(self):
     if self._device_arrays is None:
@@ -1315,6 +1363,11 @@ class DistNeighborSampler(ExchangeTelemetry):
           cids=putS(cids), crows=putS(crows),
           efshards=putS(efshards), ebounds=put(ebounds, repl),
           hcounts=put(np.asarray(hcounts, np.int32), repl))
+    # streaming fence: re-pin the newest published graph version at
+    # the dispatch seam (no-op for static datasets).  Callers hold
+    # the RETURNED dict for the whole dispatch — a publish landing
+    # mid-dispatch swaps the attribute, never the dict in flight.
+    self.maybe_refresh_stream()
     return self._device_arrays
 
   def node_capacity(self, batch_size: int) -> int:

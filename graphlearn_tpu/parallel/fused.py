@@ -86,7 +86,13 @@ class _MeshEpochDriver(_SnapshotHooks):
     the freshly refreshed cached-set bitmask.  Called per dispatch so
     a chunk's sampling bias sees the admissions the previous chunk's
     cold service made (`ops.gns`: staleness costs placement, never
-    estimator bias)."""
+    estimator bias).
+
+    Streaming ingestion (ISSUE 14) rides the same seam: `_arrays()`
+    re-pins the newest published ``graph_version`` at each chunk
+    boundary (`DistNeighborSampler.maybe_refresh_stream`), so a
+    whole chunk's scan samples exactly one graph version and the
+    GNS bitmask is invalidated with the graph it derives from."""
     arrs = self.sampler._arrays()
     if getattr(self.sampler, 'gns', False):
       arrs = dict(arrs, gns=self.sampler._gns_arrays())

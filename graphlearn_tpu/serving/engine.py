@@ -48,6 +48,7 @@ grounding: GNS, arXiv 2106.06150).
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -128,7 +129,9 @@ class ServingEngine:
   """
 
   def __init__(self, data: Dataset, num_neighbors: Sequence[int],
-               model=None, params=None, seed: int = 0, buckets=None):
+               model=None, params=None, seed: int = 0, buckets=None,
+               stream=None):
+    import threading
     if data.is_hetero:
       raise ValueError('ServingEngine is homogeneous-only (hetero '
                        'serving parity is ROADMAP item 4)')
@@ -142,10 +145,28 @@ class ServingEngine:
     self.buckets = resolve_buckets(buckets)
     self._tiered = feat.hot_rows < feat.size(0)
     self._feat = feat
-    graph = data.get_graph()
-    self.num_nodes = int(graph.num_nodes)
+    #: streaming ingestion (ISSUE 14): with a `StreamingGraph`
+    #: attached (explicitly or via `Dataset.attach_stream`), every
+    #: dispatch re-pins the newest published `GraphView` FIRST and
+    #: reads topology only through that pinned view — one
+    #: `graph_version` end to end per coalesced run, the
+    #: `model_version`-style accounting the fleet heartbeats carry
+    self._stream = (stream if stream is not None
+                    else getattr(data, 'stream', None))
+    self._pin_lock = threading.Lock()
+    self._pin_holds = 0        # guarded-by: self._pin_lock
+    if self._stream is not None:
+      view = self._stream.pin()
+      self.num_nodes = int(view.num_nodes)
+      self.graph_version = int(view.version)
+      indptr, indices = view.indptr_dev, view.indices_dev
+    else:
+      graph = data.get_graph()
+      self.num_nodes = int(graph.num_nodes)
+      self.graph_version = 0
+      indptr, indices = graph.indptr, graph.indices
     # big tables as jit ARGUMENTS, never closures (`loader.fused`)
-    self._dev = dict(indptr=graph.indptr, indices=graph.indices,
+    self._dev = dict(indptr=indptr, indices=indices,
                      hot=None if self._tiered else feat.hot_tier,
                      id2index=(None if self._tiered
                                else feat._id2index_dev))
@@ -304,22 +325,69 @@ class ServingEngine:
                       reason='error')
     return jit_fn(*call_args)
 
+  def _repin_graph(self) -> None:
+    """Streaming fence: swap in the newest published `GraphView`
+    BEFORE a dispatch starts.  RCU on the `_dev` dict — a dispatch
+    already in flight keeps the dict (and the immutable view arrays)
+    it captured; the swap is one reference assignment, so no reader
+    ever sees half a graph.  Same-shape publishes (the steady state
+    under `reserve_edges`) keep every warm executable warm — topology
+    rides as program ARGUMENTS; a capacity growth changes the aval
+    and recompiles once per doubling."""
+    if self._stream is None:
+      return
+    view = self._stream.pin()
+    if view.version == self.graph_version:
+      return
+    with self._pin_lock:
+      if self._pin_holds > 0:      # hold_graph(): multi-dispatch
+        return                     # comparison in flight, keep the
+      view = self._stream.pin()    # version it started on
+      if view.version == self.graph_version:
+        return
+      dev = dict(self._dev)
+      dev['indptr'] = view.indptr_dev
+      dev['indices'] = view.indices_dev
+      self._dev = dev
+      self.graph_version = int(view.version)
+
+  @contextmanager
+  def hold_graph(self):
+    """Freeze the pinned ``graph_version`` across SEVERAL dispatches.
+    A single dispatch is always torn-read-safe on its own; use this
+    when comparing dispatches against each other — the swap parity
+    probe runs one coalesced candidate against per-seed references,
+    and a publish landing between them would make the byte-identity
+    check span two graphs (a spurious rollback, not a caught bug)."""
+    self._repin_graph()            # newest version, then freeze
+    with self._pin_lock:
+      self._pin_holds += 1
+    try:
+      yield self.graph_version
+    finally:
+      with self._pin_lock:
+        self._pin_holds -= 1
+
   def _dispatch(self, padded: jax.Array,
                 params=None) -> ServingResult:
     """One bucket dispatch (``padded`` already at a bucket capacity).
     Warm after `warmup`: every call is an in-memory executable hit.
     ``params`` overrides the installed model version for THIS dispatch
     (the hot-swap parity probe validates a candidate this way without
-    admitting traffic to it)."""
+    admitting traffic to it).  The graph is PINNED once here (`dev`):
+    a concurrent ingest publish lands in the next dispatch, never
+    mid-run — the no-torn-reads contract."""
     params = self.params if params is None else params
     if self.model is not None and params is None:
       raise ValueError(
           'ServingEngine has a model but no params — call '
           'init_params(rng) (or set .params) before serving/warmup')
+    self._repin_graph()
+    dev = self._dev
     cap = int(padded.shape[0])
     if self._tiered:
       nodes = self._run_prog('collect', cap, self._compiled_collect,
-                             (padded, self._dev), (padded, self._dev))
+                             (padded, dev), (padded, dev))
       nodes_h = np.asarray(nodes)
       # cross-request cold-id dedup (r11): one coalesced dispatch
       # carries several riders whose trees overlap heavily under
@@ -351,14 +419,14 @@ class ServingEngine:
       return ServingResult(nodes=nodes_h, logits=np.asarray(logits))
     if self.model is None:
       nodes, x = self._run_prog(
-          'gather', cap, self._compiled_gather, (padded, self._dev),
-          (padded, self._dev, pallas_enabled()),
+          'gather', cap, self._compiled_gather, (padded, dev),
+          (padded, dev, pallas_enabled()),
           statics=(bool(pallas_enabled()),))
       return ServingResult(nodes=np.asarray(nodes), x=np.asarray(x))
     nodes, logits = self._run_prog(
         'forward', cap, self._compiled_forward,
-        (padded, params, self._dev),
-        (padded, params, self._dev, pallas_enabled()),
+        (padded, params, dev),
+        (padded, params, dev, pallas_enabled()),
         statics=(bool(pallas_enabled()),))
     return ServingResult(nodes=np.asarray(nodes),
                          logits=np.asarray(logits))
@@ -448,6 +516,20 @@ class ServingEngine:
         'program': program, 'cap': int(cap),
         'fanouts': list(self.fanouts),
         'num_nodes': int(self.num_nodes),
+        # graph SHAPE + ingest version (ISSUE 14 satellite): the
+        # padded edge capacity is what the executable's avals bake,
+        # and the graph_version pins which published graph this
+        # entry was warmed against — a mutated graph skips a stale
+        # disk executable into a fresh compile instead of serving
+        # against mismatched statics.  Deliberately conservative:
+        # topology rides as program ARGUMENTS, so a same-capacity
+        # executable would in fact be reusable across versions — the
+        # version key trades warm-restores during LIVE ingest (each
+        # replica warming at a moved version recompiles) for the
+        # guarantee that no entry ever outlives the graph it was
+        # validated against
+        'num_edges': int(self._dev['indices'].shape[0]),
+        'graph_version': int(self.graph_version),
         'feature': [int(self._feat.feature_dim), str(self._feat.dtype)],
         'tiered': bool(self._tiered),
         'model': repr(self.model),
@@ -523,6 +605,7 @@ class ServingEngine:
     else:
       cache = aot_cache
     t0 = time.perf_counter()
+    self._repin_graph()               # warm against the newest version
     n = min(self.num_nodes, 8)
     before = self.compile_count()
     restores_before = self._aot_restores
@@ -565,4 +648,5 @@ class ServingEngine:
             'compiles': self.compile_count(),
             'aot_programs': len(self._aot),
             'model_version': self.model_version,
+            'graph_version': self.graph_version,
             'tiered': self._tiered}

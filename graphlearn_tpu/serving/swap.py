@@ -81,9 +81,13 @@ def _parity_probe(engine, params, probe_seeds, atol: float
   offline reference; returns the max divergence (raises
   `SwapParityError` past tolerance).  Sampled nodes must agree
   BYTE-identically (params cannot change sampling — a mismatch means
-  a broken executable, the exact thing to catch before traffic)."""
-  cand = engine.infer(probe_seeds, params=params)
-  ref = engine.offline_reference(probe_seeds, params=params)
+  a broken executable, the exact thing to catch before traffic).
+  `hold_graph` freezes the streaming graph version across the two
+  paths: under live ingest a publish between them would otherwise
+  fail a good candidate (ISSUE 14)."""
+  with engine.hold_graph():
+    cand = engine.infer(probe_seeds, params=params)
+    ref = engine.offline_reference(probe_seeds, params=params)
   if not np.array_equal(cand.nodes, ref.nodes):
     raise SwapParityError(
         'candidate sampled different nodes through the coalesced '

@@ -1,0 +1,23 @@
+"""Streaming graph ingestion (ISSUE 14): WAL-backed delta-CSR with
+version-fenced, RCU-published graph views — mutation-safe serving and
+sampling while the graph itself is moving.
+
+  * `wal` — checksummed, seqno-stamped write-ahead log (atomic
+    append, torn-tail truncation, idempotent replay);
+  * `delta` — delta-CSR segments merged at chunk seams, published
+    behind a monotone ``graph_version`` (`StreamingGraph.pin` gives a
+    reader one immutable view per dispatch);
+  * `ingest` — the crash-consistent pipeline (log -> apply ->
+    publish -> compact) with live metrics, healthz and post-mortem
+    coverage.
+"""
+from .delta import DeltaSegment, GraphView, StreamingGraph, merge_delta_csr
+from .ingest import IngestPipeline, compact_every_from_env, max_lag_from_env
+from .wal import WalCorruptionError, WalRecord, WriteAheadLog, wal_dir_from_env
+
+__all__ = [
+    'DeltaSegment', 'GraphView', 'StreamingGraph', 'merge_delta_csr',
+    'IngestPipeline', 'compact_every_from_env', 'max_lag_from_env',
+    'WalCorruptionError', 'WalRecord', 'WriteAheadLog',
+    'wal_dir_from_env',
+]

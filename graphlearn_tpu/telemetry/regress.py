@@ -98,6 +98,13 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # once any baseline records a nonzero count)
     ('dist.serving.fleet_qps', 'higher'),
     ('dist.serving.failover_failed_requests', 'lower'),
+    # streaming-ingestion guard (ISSUE 14): the freshness-vs-
+    # throughput open loop — sustained WAL->delta-CSR->publish
+    # events/s must hold, and the serving p99 measured DURING
+    # steady-state ingest must not erode (the zero-shed contract is
+    # bench_ingest's nonzero exit, stamped into ingest_pin)
+    ('dist.ingest.events_per_sec', 'higher'),
+    ('dist.ingest.p99_during_ingest_ms', 'lower'),
 )
 
 

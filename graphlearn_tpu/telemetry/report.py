@@ -115,6 +115,13 @@ RESILIENCE_KINDS = (
     ('slo.burn', 'window_secs'),
     ('recorder.overflow', ''),
     ('postmortem.dump', 'reason'),
+    # streaming ingestion (ISSUE 14): WAL replays, torn-tail
+    # truncations, apply/compact faults and compactions read out of
+    # the same table as the retries and restarts around them
+    ('ingest.replay', 'restored'),
+    ('ingest.wal_truncate', ''),
+    ('ingest.fault', 'site'),
+    ('ingest.compact', 'ok'),
 )
 
 
@@ -377,6 +384,16 @@ def render_postmortem(bundle: Dict) -> str:
   if slo_keys:
     out.append('# SLO gauges at dump')
     for k in slo_keys:
+      out.append(f'  {k}: {metrics_snap[k]}')
+  # streaming ingestion block (ISSUE 14): the WAL/apply/version state
+  # of a process that died mid-ingest — the first thing the operator
+  # asks after an ingestion fault bundle
+  ingest_keys = sorted(k for k in metrics_snap
+                       if k.startswith('ingest.')
+                       or k.startswith('graph.version'))
+  if ingest_keys:
+    out.append('# ingestion at dump')
+    for k in ingest_keys:
       out.append(f'  {k}: {metrics_snap[k]}')
   hists = histograms_from_events(events)
   if hists:

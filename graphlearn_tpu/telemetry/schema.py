@@ -42,12 +42,14 @@ EVENT_KINDS: Dict[str, str] = {
         'cold_lookups (past the hot tier — the cache denominator), '
         'misses (host-served), cache_hits, hit_rate',
     'cache.hit':
-        'data.cold_cache consumers (scope=feature|dist|serving): '
-        'count of cold lookups served from the HBM victim cache this '
-        'overlay',
+        'data.cold_cache consumers (scope=feature|dist|serving|'
+        'hetero): count of cold lookups served from the HBM victim '
+        'cache this overlay',
     'cache.miss':
         'data.cold_cache consumers: count of cold lookups that paid '
-        'the host gather this overlay (admission candidates)',
+        'the host gather this overlay (admission candidates; '
+        'scope=hetero has NO cache yet, so every cold lookup lands '
+        'here — the live twin of cold_lookups == cold_misses)',
     'cache.admit':
         'data.cold_cache consumers: rows written into the HBM ring '
         'this overlay (frequency-ranked winners)',
@@ -162,6 +164,27 @@ EVENT_KINDS: Dict[str, str] = {
         'reason (absent|stale|corrupt|unreadable|error) — this '
         'bucket paid a compile; corrupt/stale entries land here too '
         '(skip-to-recompile, never a crash or a wrong executable)',
+    'ingest.wal_truncate':
+        'streaming.wal.WriteAheadLog.open: path, offset, '
+        'dropped_bytes, last_seqno — a torn tail (kill mid-append) '
+        'was truncated back to the last whole record; replay lands '
+        'exactly the whole-record prefix',
+    'ingest.replay':
+        'streaming.ingest.IngestPipeline.recover: restored (a '
+        'compacted base was loaded), replayed_records/_events, '
+        'skipped_records (<= the base watermark — the idempotence '
+        'that makes a crash between snapshot and WAL reset safe), '
+        'applied_seqno, secs — one event per recovery',
+    'ingest.compact':
+        'streaming.ingest.IngestPipeline.compact: ok, seqno '
+        '(watermark baked into the snapshot), events, secs — ok='
+        'False is an ABSORBED snapshot-write failure (the WAL keeps '
+        'the full history; nothing lost)',
+    'ingest.fault':
+        'streaming.ingest.IngestPipeline: site (apply|compact), '
+        'error — an ingestion fault surfaced typed (and dumped a '
+        'post-mortem bundle) instead of leaving a half-applied '
+        'graph; the WAL replay makes the restart exactly-once',
 }
 
 
@@ -281,7 +304,9 @@ METRIC_NAMES: Dict[str, str] = {
         'allotted), labeled by window seconds',
     'cache.hits_total':
         'counter: cold-cache hits, labeled by scope '
-        '(feature|dist|serving) — mirrors the cache.hit events',
+        '(feature|dist|serving|hetero) — mirrors the cache.hit '
+        'events (scope=hetero is pinned 0: no cache there yet, '
+        'ROADMAP item 3 — visible live, not artifact-only)',
     'cache.misses_total':
         'counter: cold-cache misses (host-gather work), by scope',
     'cache.admits_total':
@@ -368,6 +393,21 @@ METRIC_NAMES: Dict[str, str] = {
     'aot.cache_misses_total':
         'counter: bucket warmups that paid an XLA compile (absent/'
         'stale/corrupt cache entries all land here)',
+    'ingest.events_total':
+        'counter: edge-insert events applied to the delta-CSR by '
+        'this process (WAL replays after a restart included — they '
+        'are real applies this process performed)',
+    'ingest.lag_events':
+        'gauge: WAL events appended but not yet applied (the '
+        'freshness debt; past GLT_INGEST_MAX_LAG the ingestion '
+        'healthz component flips unhealthy)',
+    'ingest.compactions_total':
+        'counter: durable base compactions (snapshot published + '
+        'WAL reset to the surviving suffix)',
+    'graph.version':
+        'gauge: the streaming graph\'s current published version — '
+        'every reader dispatch pins exactly one of these; the value '
+        'moving is ingest reaching the data plane',
 }
 
 

@@ -80,6 +80,28 @@ wall clocks involved).  Sites and actions:
       ``corrupt`` (the payload lands scrambled on disk — a later
       load must detect the bad checksum and fall back to recompile,
       never deserialize garbage into a wrong executable).
+  ``ingest.wal``
+      Seam inside `streaming.wal.WriteAheadLog.append`.  Actions:
+      ``fail`` (the append dies before any byte lands — the caller
+      sees a typed error and the log is unchanged), ``truncate``
+      (a PARTIAL record is written and the process "dies" mid-append
+      — the kill-mid-write scenario; the next open must detect the
+      torn tail by checksum, truncate back to the last whole record,
+      and replay must land exactly the whole-record prefix).
+  ``ingest.apply``
+      Seam inside `streaming.ingest.IngestPipeline` BETWEEN the
+      durable WAL append and the in-memory delta-CSR commit.
+      Actions: ``kill`` (raise :class:`ChaosKilledError` — the
+      process dies with the event logged but not applied; a restart
+      must replay it from the WAL exactly once), ``delay`` (a slow
+      apply — the ``ingest.lag_events`` gauge grows and, past
+      ``GLT_INGEST_MAX_LAG``, flips the ingestion healthz component).
+  ``ingest.compact``
+      Seam inside `streaming.ingest.IngestPipeline.compact`, fired
+      BEFORE the compacted-base snapshot publishes.  Action ``kill``
+      (raise :class:`ChaosKilledError` mid-compaction — the previous
+      snapshot + the full WAL stay the durable truth; a restart
+      replays to the identical graph).
 
 Plans install three ways: programmatically (:func:`install`), from the
 ``GLT_FAULT_PLAN`` env var (inherited by producer subprocesses and
@@ -122,7 +144,8 @@ WORKER_KILL_EXIT = 173
 
 _SITES = ('rpc.request', 'producer.worker', 'checkpoint.io',
           'fused.dispatch', 'feature.cold_service', 'serving.request',
-          'ops.scrape', 'serving.replica', 'aot.cache')
+          'ops.scrape', 'serving.replica', 'aot.cache', 'ingest.wal',
+          'ingest.apply', 'ingest.compact')
 _ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate',
             'flap')
 
@@ -426,6 +449,42 @@ def aot_cache_faults(op: str) -> List[str]:
   if 'fail' in actions:
     raise InjectedFault(f'injected aot cache failure (op {op!r})')
   return actions
+
+
+def ingest_wal_faults(op: str = 'append') -> List[str]:
+  """WAL seam (`streaming.wal`), one arrival per append.  ``fail``
+  raises `InjectedFault` BEFORE any byte is written (the log is
+  unchanged — the caller's retry appends cleanly); ``truncate`` is
+  returned so the WRITER lands a partial record and then raises (the
+  kill-mid-append scenario the torn-tail recovery must absorb)."""
+  actions = [f.action for f in on('ingest.wal', op=op)]
+  if 'fail' in actions:
+    raise InjectedFault(f'injected WAL append failure (op {op!r})')
+  return actions
+
+
+def ingest_apply_check(seqno: int = 0) -> None:
+  """Delta-apply seam (`streaming.ingest`), fired between the durable
+  WAL append and the in-memory commit: ``kill`` raises
+  `ChaosKilledError` (the logged-but-unapplied crash the replay must
+  make exactly-once), ``delay`` sleeps in place (lag grows)."""
+  for f in on('ingest.apply', seqno=int(seqno)):
+    if f.action == 'delay':
+      time.sleep(f.secs)
+    elif f.action == 'kill':
+      raise ChaosKilledError(
+          f'injected ingest apply kill (seqno {seqno})')
+
+
+def ingest_compact_check(seqno: int = 0) -> None:
+  """Compaction seam (`streaming.ingest.IngestPipeline.compact`),
+  fired BEFORE the compacted-base snapshot publishes: ``kill`` raises
+  `ChaosKilledError` mid-compaction — the previous snapshot plus the
+  full WAL stay the durable truth."""
+  for f in on('ingest.compact', seqno=int(seqno)):
+    if f.action == 'kill':
+      raise ChaosKilledError(
+          f'injected ingest compaction kill (seqno {seqno})')
 
 
 def serving_request_check(op: str = '', replica: str = '') -> None:

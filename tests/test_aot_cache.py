@@ -229,6 +229,52 @@ def test_static_toggle_bypasses_baked_executable(tmp_path,
   assert e2.compile_count() == before   # served by the AOT entry
 
 
+def test_mutated_graph_skips_stale_executable(tmp_path):
+  """ISSUE 14 satellite: `_aot_fingerprint` includes the graph shape
+  AND the ingest graph_version, so a replica warming against a
+  MUTATED graph pays a fresh compile instead of restoring an
+  executable fingerprinted against the pre-ingest graph — and a
+  replica at the SAME version still warm-restores."""
+  from graphlearn_tpu.streaming import StreamingGraph
+  cache = AotExecutableCache(tmp_path)
+  rng = np.random.default_rng(0)
+  rows = np.repeat(np.arange(N), 3)
+  cols = rng.integers(0, N, rows.shape[0])
+  feats = (np.arange(N, dtype=np.float32)[:, None]
+           * np.ones((1, D), np.float32))
+  sg = StreamingGraph.from_coo(rows, cols, num_nodes=N,
+                               reserve_edges=4 * len(rows))
+
+  def make():
+    ds = Dataset().init_node_features(feats).attach_stream(sg)
+    return ServingEngine(ds, FANOUTS, seed=7, buckets=BUCKETS)
+
+  e1 = make()
+  e1.warmup(aot_cache=cache)
+  assert e1.compile_count() == len(BUCKETS)
+  n_before = len(cache.entries())
+  # same graph version: a replacement replica warm-restores
+  e2 = make()
+  e2.warmup(aot_cache=cache)
+  assert e2.compile_count() == 0
+  assert e2.graph_version == e1.graph_version
+  # mutate the graph (same padded shape — reserve_edges holds), bump
+  # the version: the old entries must NOT serve the new graph's warmup
+  sg.apply_events(rng.integers(0, N, 10), rng.integers(0, N, 10))
+  recorder.clear()
+  e3 = make()
+  e3.warmup(aot_cache=cache)
+  assert e3.graph_version == sg.version
+  assert e3.compile_count() == len(BUCKETS)   # recompiled, not stale
+  assert len(cache.entries()) == n_before + len(BUCKETS)
+  reasons = [e.get('reason') for e in recorder.events('aot.cache_miss')]
+  assert reasons.count('absent') == len(BUCKETS)
+  # and a fourth replica AT the new version warm-restores again
+  e4 = make()
+  e4.warmup(aot_cache=cache)
+  assert e4.compile_count() == 0
+
+
 def test_runtime_failure_of_restored_exec_recompiles(tmp_path):
   """skip-to-recompile extends to CALL time: a restored executable
   that raises is dropped and the dispatch falls back to the compile
