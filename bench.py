@@ -1052,44 +1052,62 @@ def _run_envelope_row(num_parts: int, batch: int, timeout: int):
   return None
 
 
-def _run_chaos_row(timeout: int):
-  """The `bench_dist_loader.py --chaos` resilience smoke in a
-  subprocess; returns its JSON row (None on failure/timeout)."""
+def _run_dist_loader_row(flags, timeout: int, env=None, pin_key=None):
+  """Shared `benchmarks/bench_dist_loader.py` subprocess harness for
+  the chaos / resume / failover rows: spawn with ``flags``, scan
+  stdout bottom-up for the last JSON line, return the parsed row
+  (None on timeout / no parseable output).  With ``pin_key`` the
+  worker's exit verdict is stamped into that key ('ok'/'FAILED') so
+  the pin survives in the artifact, not only in a discarded code."""
   script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         'benchmarks', 'bench_dist_loader.py')
-  cmd = [sys.executable, script, '--chaos']
+  cmd = [sys.executable, script, *flags]
   try:
-    out = subprocess.run(cmd, capture_output=True, text=True,
+    out = subprocess.run(cmd, capture_output=True, text=True, env=env,
                          timeout=timeout)
   except subprocess.TimeoutExpired:
     return None
   for ln in reversed((out.stdout or '').strip().splitlines()):
     if ln.startswith('{'):
       try:
-        return json.loads(ln)
+        r = json.loads(ln)
       except json.JSONDecodeError:
         continue
+      if pin_key is not None:
+        r[pin_key] = 'ok' if out.returncode == 0 else 'FAILED'
+      return r
   return None
+
+
+def _run_chaos_row(timeout: int):
+  """The `bench_dist_loader.py --chaos` resilience smoke in a
+  subprocess; returns its JSON row (None on failure/timeout)."""
+  return _run_dist_loader_row(('--chaos',), timeout)
 
 
 def _run_resume_row(timeout: int):
   """The `bench_dist_loader.py --resume` preemption-resume smoke in a
   subprocess; returns its JSON row (None on failure/timeout)."""
-  script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        'benchmarks', 'bench_dist_loader.py')
-  cmd = [sys.executable, script, '--resume']
-  try:
-    out = subprocess.run(cmd, capture_output=True, text=True,
-                         timeout=timeout)
-  except subprocess.TimeoutExpired:
-    return None
-  for ln in reversed((out.stdout or '').strip().splitlines()):
-    if ln.startswith('{'):
-      try:
-        return json.loads(ln)
-      except json.JSONDecodeError:
-        continue
-  return None
+  return _run_dist_loader_row(('--resume',), timeout)
+
+
+def _run_failover_row(timeout: int):
+  """The `bench_dist_loader.py --failover` elastic-failover smoke
+  (ISSUE 15) on the 8-device virtual mesh: one partition owner killed
+  mid-epoch with a durable shard under GLT_SHARD_DIR — a survivor
+  adopts, the epoch must complete EXACTLY (completed_ratio 1.0,
+  batches byte-identical to the fault-free run, ONE adoption).  The
+  worker exits nonzero unless the pin holds — stamped into
+  ``failover_pin``.  Feeds the dist.failover.recovery_secs /
+  dist.failover.completed_ratio regression guards."""
+  r = _run_dist_loader_row(('--failover', '--nodes', '5000'), timeout,
+                           env=cpu_mesh_env(8),
+                           pin_key='failover_pin')
+  if r is not None and r['failover_pin'] != 'ok':
+    print('failover phase: epoch not exactly complete / not '
+          'byte-identical / adoption count wrong (see dist.failover)',
+          file=sys.stderr)
+  return r
 
 
 def _run_bench_serving(timeout: int, extra_args=(),
@@ -1578,6 +1596,21 @@ def main():
   elif isinstance(dist, dict) and 'error' not in dist:
     print(f'budget: skipping ingest phase ({budget_left():.0f}s left)',
           file=sys.stderr)
+
+  # phase 3h — elastic partition failover (ISSUE 15): one owner
+  # killed mid-epoch with a durable shard present — adoption, exact
+  # completion, byte-identity; feeds dist.failover.recovery_secs /
+  # .completed_ratio, and the worker's nonzero exit (any completion
+  # or identity violation) lands in failover_pin
+  if isinstance(dist, dict) and 'error' not in dist and \
+      budget_left() > 90:
+    r = _run_failover_row(int(min(300, max(budget_left() - 30, 90))))
+    if r is not None:
+      dist['failover'] = r
+      emit()
+  elif isinstance(dist, dict) and 'error' not in dist:
+    print(f'budget: skipping failover phase ({budget_left():.0f}s '
+          f'left)', file=sys.stderr)
 
   # phase 4 — extra primary sessions stabilize the per-batch median
   while (len(results) < sessions and attempts < sessions + 3

@@ -582,6 +582,118 @@ def resume_smoke(batch: int = 64, num_nodes: int = 2048):
   return row
 
 
+def failover_smoke(batch: int = 64, num_nodes: int = 20_000,
+                   dim: int = 32):
+  """Elastic-failover smoke (ISSUE 15): one partition owner killed
+  mid-epoch on the virtual mesh with a durable shard present under
+  ``GLT_SHARD_DIR`` — a survivor adopts the orphaned shard and the
+  epoch must finish with the EXACT-completion contract: the full
+  expected batch count (``completed_ratio`` 1.0), batches
+  byte-identical to the fault-free run, exactly ONE adoption, and
+  ``recovery_secs`` (classification -> first served batch) gauged —
+  the two ``dist.failover.*`` regression-guarded metrics.  Prints ONE
+  JSON row; the caller exits nonzero unless ``ok``."""
+  import json
+  import os
+  import shutil
+  import tempfile
+  import time
+  import jax
+  from graphlearn_tpu.parallel import (DistDataset, DistNeighborLoader,
+                                       make_mesh)
+  from graphlearn_tpu.telemetry import recorder
+  from graphlearn_tpu.testing import chaos
+
+  num_parts = len(jax.devices())
+  mesh = make_mesh(num_parts)
+  rows, cols = build_graph(num_nodes)
+  feats = np.random.default_rng(0).standard_normal(
+      (num_nodes, dim)).astype(np.float32)
+  labels = (np.arange(num_nodes) % 7).astype(np.int32)
+
+  def make_loader():
+    ds = DistDataset.from_full_graph(num_parts, rows, cols,
+                                     node_feat=feats, node_label=labels,
+                                     num_nodes=num_nodes)
+    seeds = np.random.default_rng(1).permutation(
+        num_nodes)[:batch * num_parts * 10]
+    return ds, DistNeighborLoader(ds, [10, 5], seeds, batch_size=batch,
+                                  shuffle=True, mesh=mesh, seed=0)
+
+  def grab(b):
+    return tuple(np.asarray(jax.device_get(x))
+                 for x in (b.node, b.x, b.y, b.edge_index))
+
+  # -- fault-free reference: epoch 1 is the byte-identity reference
+  # (the shuffle permutation advances per epoch, and the failover run
+  # below is ITS loader's epoch 1 too); epoch 2 is the post-compile
+  # timed line
+  _, ref_loader = make_loader()
+  ref = [grab(b) for b in ref_loader]
+  t0 = time.perf_counter()
+  for b in ref_loader:
+    pass
+  fault_free_secs = time.perf_counter() - t0
+  n_batches = len(ref)
+  kill_step = max(2, n_batches // 2)
+
+  # -- failover epoch: durable shards on, one owner killed mid-epoch --
+  shard_dir = tempfile.mkdtemp(prefix='glt_failover_')
+  saved = {k: os.environ.pop(k, None)
+           for k in ('GLT_SHARD_DIR', 'GLT_DEGRADED_OK')}
+  os.environ['GLT_SHARD_DIR'] = shard_dir
+  victim = num_parts // 2
+  recorder.enable(None)
+  chaos.install(f'partition.owner:kill:{kill_step}:partition={victim}')
+  try:
+    ds, loader = make_loader()
+    t0 = time.perf_counter()
+    got = [grab(b) for b in loader]
+    failover_secs = time.perf_counter() - t0
+    adopts = recorder.events('partition.adopt')
+  finally:
+    chaos.uninstall()
+    recorder.disable()
+    for k, v in saved.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+    shutil.rmtree(shard_dir, ignore_errors=True)
+
+  executed = [e for e in adopts if e.get('phase') is None]
+  recovered = [e for e in adopts if e.get('phase') == 'recovered']
+  byte_identical = len(got) == n_batches and all(
+      all(np.array_equal(a, b) for a, b in zip(r, g))
+      for r, g in zip(ref, got))
+  completed_ratio = round(len(got) / max(n_batches, 1), 4)
+  recovery_secs = recovered[0]['secs'] if recovered else None
+  row = {
+      'metric': 'dist_failover_smoke',
+      'batch': batch, 'num_nodes': num_nodes, 'num_parts': num_parts,
+      'expected_batches': n_batches,
+      'received_batches': len(got),
+      'completed_ratio': completed_ratio,
+      'byte_identical': bool(byte_identical),
+      'adoptions_total': len(executed),
+      'book_version': int(ds.partition_book.version),
+      'killed_partition': victim,
+      'kill_step': kill_step,
+      'recovery_secs': (round(recovery_secs, 4)
+                        if recovery_secs is not None else None),
+      'fault_free_epoch_secs': round(fault_free_secs, 3),
+      'failover_epoch_secs': round(failover_secs, 3),
+      'ok': bool(byte_identical and completed_ratio == 1.0
+                 and len(executed) == 1
+                 and ds.partition_book.version == 1
+                 and recovery_secs is not None and recovery_secs > 0),
+  }
+  print(json.dumps(row), flush=True)
+  from benchmarks.common import tee_record
+  tee_record(row)
+  return row
+
+
 def capacity_sweep(quick: bool):
   import json
   fanout = [15, 10, 5]
@@ -655,6 +767,14 @@ def main():
                        'epoch timing vs the no-snapshot line, then '
                        'kill -> durable restore -> finish with exact '
                        'accounting (dist.resume.* metrics)')
+  ap.add_argument('--failover', action='store_true',
+                  help='elastic-failover smoke (ISSUE 15): kill one '
+                       'partition owner mid-epoch with a durable '
+                       'shard under GLT_SHARD_DIR — exits nonzero '
+                       'unless the epoch completes EXACTLY '
+                       '(completed_ratio 1.0, batches byte-identical '
+                       'to the fault-free run) with ONE adoption; '
+                       'reports the guarded dist.failover.* metrics')
   ap.add_argument('--mode', default='homo')
   ap.add_argument('--epochs', type=int, default=5,
                   help='envelope-worker epochs (the adaptive ladder '
@@ -686,6 +806,12 @@ def main():
   if args.resume:
     resume_smoke(batch=args.batch if args.batch != 1024 else 64,
                  num_nodes=min(args.nodes, 2048))
+    return
+  if args.failover:
+    row = failover_smoke(batch=args.batch if args.batch != 1024 else 64,
+                         num_nodes=min(args.nodes, 20_000))
+    if not row.get('ok'):
+      raise SystemExit(1)
     return
   if args.capacity_sweep:
     capacity_sweep(args.quick)

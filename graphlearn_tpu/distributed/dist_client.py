@@ -25,10 +25,12 @@ class RemoteProducerHandle:
     self._server_idx = server_idx
     self._pid = producer_id
 
-  def start_new_epoch(self, drop_last: bool = False) -> int:
+  def start_new_epoch(self, drop_last: bool = False,
+                      epoch: Optional[int] = None) -> int:
+    kw = {} if epoch is None else {'epoch': int(epoch)}
     return self._client.request_server(
         self._server_idx, 'start_new_epoch_sampling', self._pid,
-        drop_last=drop_last)
+        drop_last=drop_last, **kw)
 
   def fetch(self, src=None):
     # ``src`` is the replacement-fetch routing hint (see
@@ -54,21 +56,97 @@ class MultiProducerHandle:
   """One loader fanned out over several servers (list-valued
   ``server_rank``, reference `dist_options.py:202-258`): each server
   samples a batch-aligned seed slice; fetches round-robin by each
-  server's per-epoch message count."""
+  server's per-epoch message count.
 
-  def __init__(self, handles: List[RemoteProducerHandle]):
+  Elastic failover (ISSUE 15): ``creation_args`` (recorded by
+  `DistClient.create_sampling_producer`) lets `adopt_server`
+  recreate a dead server's producer — its exact seed slice and seed
+  offset — on a SURVIVOR, fast-forwarded to the loader's current
+  epoch, under the SAME handle index (= '#SRC' tag), so the channel's
+  (source, seq) replay dedup + source-routed replacement fetches
+  absorb the re-produced prefix and the epoch finishes with every
+  expected batch, byte-identical."""
+
+  def __init__(self, handles: List[RemoteProducerHandle],
+               creation_args: Optional[List[tuple]] = None):
     self._handles = handles
     self._lock = threading.Lock()
     self._plan: List[int] = []      # handle idx per outstanding message
     self._pos = 0
+    #: per-handle (opts, fanouts, batch_size, seeds, with_edge,
+    #: shuffle, seed, sampling_config) — guarded-by: self._lock
+    self._creation_args = creation_args or []
+    self._epochs_started = 0        # guarded-by: self._lock
+    self._last_drop_last = False    # guarded-by: self._lock
+    self._adopted: dict = {}        # dead server_idx -> survivor idx
 
   @property
   def server_indices(self) -> List[int]:
     return [h._server_idx for h in self._handles]
 
+  def adopt_server(self, client: 'DistClient', server_idx: int,
+                   survivor_idx: Optional[int] = None) -> dict:
+    """Recreate the dead server's producers on a survivor (exact
+    completion instead of `drop_server`'s write-off).  Idempotent per
+    dead server: repeat losses (several in-flight fetches failing in
+    turn) only append the one replacement fetch each fetch consumed.
+    Returns ``{'survivor', 'owed', 'recreated'}``; raises
+    `AdoptionRefusedError` when no creation args were recorded or no
+    survivor remains."""
+    from ..parallel.partition_book import AdoptionRefusedError
+    with self._lock:
+      already = self._adopted.get(server_idx)
+      if already is not None:
+        self._plan.append(already[1])   # the failed fetch's refetch
+        return {'survivor': already[0], 'owed': 1, 'recreated': 0}
+      if not self._creation_args:
+        raise AdoptionRefusedError(
+            'this producer plan recorded no creation args — '
+            'adoption unavailable (single-producer plans have no '
+            'survivor to recreate on)')
+      dead = [i for i, h in enumerate(self._handles)
+              if h._server_idx == server_idx]
+      if not dead:
+        raise AdoptionRefusedError(
+            f'server {server_idx} owns no handle of this plan')
+      live = sorted({h._server_idx for i, h in enumerate(self._handles)
+                     if i not in dead}
+                    - {s for s, _ in self._adopted.values()}
+                    - {server_idx})
+      if survivor_idx is None:
+        if not live:
+          raise AdoptionRefusedError(
+              f'no surviving server to adopt server {server_idx}\'s '
+              'producers (one adoption per survivor)')
+        survivor_idx = live[0]
+      epoch = self._epochs_started - 1
+      drop_last = self._last_drop_last
+      owed = sum(1 for i in self._plan[self._pos:] if i in dead)
+      dead_args = [(j, self._creation_args[j]) for j in dead]
+    # RPCs outside the lock: producer creation + the fast-forwarded
+    # epoch start can take seconds on a big slice
+    recreated = 0
+    for j, args in dead_args:
+      new_h = client._create_one(survivor_idx, *args)
+      new_h.start_new_epoch(drop_last, epoch=max(epoch, 0))
+      with self._lock:
+        self._handles[j] = new_h
+      recreated += 1
+    with self._lock:
+      self._adopted[server_idx] = (survivor_idx, dead[0])
+      # the fetch that surfaced the loss consumed a plan entry whose
+      # message is still owed — put one back, routed at the adopted
+      # handle (the re-produced prefix drains via replay discards +
+      # source-routed replacements)
+      self._plan.append(dead[0])
+    return {'survivor': survivor_idx, 'owed': owed + 1,
+            'recreated': recreated}
+
   def start_new_epoch(self, drop_last: bool = False) -> int:
     counts = [h.start_new_epoch(drop_last) for h in self._handles]
     with self._lock:
+      self._epochs_started += 1
+      self._last_drop_last = bool(drop_last)
       # interleave: h0, h1, ..., h0, h1, ... while counts last
       plan = []
       remaining = list(counts)
@@ -276,14 +354,17 @@ class DistClient:
         seeds = np.asarray(seeds)
         n_batches = (len(seeds) + batch_size - 1) // batch_size
         per = ((n_batches + len(idx) - 1) // len(idx)) * batch_size
-        handles = []
+        handles, creation_args = [], []
         for j, sidx in enumerate(idx):
           sl = seeds[j * per:(j + 1) * per]
           if len(sl):
-            handles.append(self._create_one(
-                sidx, opts, fanouts, batch_size, sl, with_edge,
-                shuffle, seed + j, sampling_config))
-        return MultiProducerHandle(handles)
+            args = (opts, fanouts, batch_size, sl, with_edge,
+                    shuffle, seed + j, sampling_config)
+            handles.append(self._create_one(sidx, *args))
+            # recorded per handle: `adopt_server` recreates the exact
+            # slice + seed offset on a survivor (ISSUE 15)
+            creation_args.append(args)
+        return MultiProducerHandle(handles, creation_args)
     return self._create_one(idx, opts, fanouts, batch_size, seeds,
                             with_edge, shuffle, seed, sampling_config)
 

@@ -278,6 +278,11 @@ class DistLoader:
         except StopIteration:
           raise
         except PeerLostError as e:
+          # the fallback ladder (ISSUE 15): ADOPT the dead server's
+          # producers on a survivor (exact completion) → degraded
+          # write-off (GLT_DEGRADED_OK) → typed raise
+          if self._try_adopt_server(e):
+            continue
           if not degraded_ok() or not hasattr(self._remote,
                                               'drop_server'):
             # single-server loaders have no survivors to finish on —
@@ -357,6 +362,50 @@ class DistLoader:
           continue
         self._seen_seqs.add(seq)
       return msg
+
+  def _try_adopt_server(self, err) -> bool:
+    """Elastic server failover (ISSUE 15, the hetero-parity
+    satellite): a dead sampling server's producers are RECREATED on a
+    survivor — same seed slice, same seed offset, fast-forwarded to
+    the current epoch — so the epoch finishes with EXACTLY the
+    expected batch set, byte-identical (the channel's (source, seq)
+    dedup + source-routed replacement fetches absorb the re-produced
+    prefix).  Opt-in via ``GLT_SHARD_DIR`` (the operator's
+    declaration that every partition is re-loadable at a survivor —
+    replicated host datasets serve it directly); absent that, or
+    without a multi-server plan, returns False and the documented
+    ``GLT_DEGRADED_OK`` ladder applies."""
+    import time as _time
+    from ..parallel.failover import shard_dir_from_env
+    from ..parallel.partition_book import AdoptionRefusedError
+    from ..telemetry.recorder import recorder
+    if (shard_dir_from_env() is None
+        or not hasattr(self._remote, 'adopt_server')
+        or err.peer is None):
+      return False
+    from .dist_client import get_client
+    client = get_client()
+    if client is None:
+      return False
+    t0 = _time.monotonic()
+    try:
+      info = self._remote.adopt_server(client, int(err.peer))
+    except AdoptionRefusedError as e:
+      recorder.emit('peer.lost', peer=err.peer, peer_kind='server',
+                    degraded=False, adopted=False,
+                    refused=str(e)[:200])
+      return False
+    secs = _time.monotonic() - t0
+    if info['recreated']:
+      from ..telemetry.live import live
+      live.counter('partition.adoptions_total').inc()
+      live.gauge('partition.recovery_secs').set(secs)
+      recorder.emit('partition.adopt', partition=int(err.peer),
+                    survivor=int(info['survivor']),
+                    version=len(getattr(self._remote, '_adopted', ())),
+                    owed=int(info['owed']), secs=round(secs, 6),
+                    scope='server')
+    return True
 
   def _probe_servers(self) -> None:
     """Heartbeat every server this loader draws from (remote mode).

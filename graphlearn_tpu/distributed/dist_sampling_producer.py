@@ -243,16 +243,35 @@ class MpSamplingProducer:
   def num_batches(self, num_seeds: int) -> int:
     return (num_seeds + self.batch_size - 1) // self.batch_size
 
-  def produce_all(self, seeds: np.ndarray, drop_last: bool = False) -> int:
+  def fast_forward(self, seeds: np.ndarray, epoch: int) -> None:
+    """Advance this producer's epoch counter AND its shuffle RNG to
+    ``epoch`` by drawing (and discarding) the skipped permutations —
+    the partition-adoption path (ISSUE 15): a producer recreated on a
+    survivor mid-run must produce epoch ``e`` byte-identical to what
+    the dead server's producer would have (batch content is a
+    function of (epoch, seq) + the epoch's permutation, and the
+    permutation is the ``epoch``-th draw from the seeded stream)."""
+    seeds = np.asarray(seeds)
+    while self._epoch < int(epoch):
+      if self.shuffle:
+        self._rng.permutation(seeds)     # axis-0, node AND link mode
+      self._epoch += 1
+
+  def produce_all(self, seeds: np.ndarray, drop_last: bool = False,
+                  epoch: Optional[int] = None) -> int:
     """Dispatch one epoch; returns the number of messages to expect.
     ``drop_last`` truncates *after* the shuffle, so the dropped
     remainder differs per epoch (torch DataLoader semantics).
     ``seeds`` is ``[E]`` node ids, or ``[E, 2|3]`` edge pairs
-    (+labels) in link mode — shuffling/slicing is along axis 0."""
+    (+labels) in link mode — shuffling/slicing is along axis 0.
+    ``epoch`` fast-forwards a freshly created producer to that epoch
+    before producing (`fast_forward` — the adoption path)."""
     from ..utils.checkpoint import pack_rng_state
     seeds = np.asarray(seeds)
     if seeds.ndim == 1:
       seeds = seeds.reshape(-1)
+    if epoch is not None:
+      self.fast_forward(seeds, epoch)
     # pre-shuffle RNG capture: a mid-epoch snapshot restores THIS
     # state so the resumed produce_all re-draws the same permutation
     # (batch content is a function of (epoch, seq) — identical shuffle

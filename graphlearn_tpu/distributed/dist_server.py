@@ -88,9 +88,15 @@ class DistServer:
     return pid
 
   def start_new_epoch_sampling(self, producer_id: int,
-                               drop_last: bool = False) -> int:
+                               drop_last: bool = False,
+                               epoch=None) -> int:
+    # ``epoch`` fast-forwards a freshly ADOPTED producer (ISSUE 15) to
+    # the loader's current epoch so its permutation stream and
+    # (epoch, seq) batch seeds line up byte-identically with what the
+    # dead server's producer would have produced
     return self._producers[producer_id].produce_all(
-        self._seeds[producer_id], drop_last=drop_last)
+        self._seeds[producer_id], drop_last=drop_last,
+        epoch=None if epoch is None else int(epoch))
 
   def fetch_one_sampled_message(self, producer_id: int):
     """Pull of one message (reference `fetch_one_sampled_message`,

@@ -243,14 +243,59 @@ def set_resident_bits(base_bits: np.ndarray, resident_ids: np.ndarray,
   return bits
 
 
-def bitmask_lookup(bits: jax.Array, ids: jax.Array) -> jax.Array:
+def bitmask_lookup(bits: jax.Array, ids: jax.Array,
+                   req: Optional[jax.Array] = None) -> jax.Array:
   """``[...]`` int ids -> uint8 membership (0/1); invalid ids (< 0)
-  read 0.  Pure gathers + shifts — jit/vmap/shard_map friendly."""
+  read 0.  Pure gathers + shifts — jit/vmap/shard_map friendly.
+
+  ``bits`` may be 1-D (one shared mask) or 2-D ``[R, nbytes]``
+  per-requester masks (ISSUE 15): ``req`` (``[B]``, broadcast over
+  the trailing dims of ``ids``) selects the mask row per leading
+  entry — each request is judged by what ITS requester serves
+  locally, never by another device's cache ring."""
   valid = ids >= 0
   idc = jnp.where(valid, ids, 0).astype(jnp.int32)
-  byte = bits[jnp.clip(idc >> 3, 0, bits.shape[0] - 1)]
+  if bits.ndim == 2:
+    if req is None:
+      raise ValueError('per-requester bitmask (2-D bits) needs req')
+    row = jnp.clip(req, 0, bits.shape[0] - 1).astype(jnp.int32)
+    row = row.reshape(row.shape + (1,) * (ids.ndim - row.ndim))
+    byte = bits[row, jnp.clip(idc >> 3, 0, bits.shape[1] - 1)]
+  else:
+    byte = bits[jnp.clip(idc >> 3, 0, bits.shape[0] - 1)]
   bit = (byte >> (idc & 7).astype(jnp.uint8)) & jnp.uint8(1)
   return jnp.where(valid, bit, jnp.uint8(0))
+
+
+def per_requester_bits(num_nodes: int, bounds: np.ndarray,
+                       hot_counts: np.ndarray,
+                       residents_by_device,
+                       base_bits: Optional[np.ndarray] = None
+                       ) -> np.ndarray:
+  """``[R + 1, ceil(N/8)]`` stacked per-requester cached-set masks
+  from `PartitionBook` placement (ISSUE 15): row ``d`` = static hot
+  split ∪ device ``d``'s OWN cold-cache residents; the LAST row is
+  the hot-split-only fallback (used for recv rows whose requester the
+  exchange layout cannot attribute — conservative: a remote-only-
+  resident row gets no boost, never an over-boost).
+
+  ``residents_by_device`` maps device index -> resident-id array;
+  devices absent from the map (e.g. other hosts' shards) get the
+  fallback row — their residency is unknown, so no boost.
+  ``base_bits`` lets a caller reuse an already-packed hot-split mask
+  (the `_gns_hot_bits` cache) instead of repacking O(num_nodes)."""
+  base = (base_bits if base_bits is not None
+          else cached_set_bits(num_nodes, bounds, hot_counts,
+                               np.empty(0, np.int64)))
+  rows = []
+  for d in range(len(hot_counts)):
+    res = residents_by_device.get(d)
+    if res is None or len(res) == 0:
+      rows.append(base)
+    else:
+      rows.append(set_resident_bits(base, res, num_nodes))
+  rows.append(base)
+  return np.stack(rows)
 
 
 @functools.partial(
@@ -266,6 +311,7 @@ def sample_one_hop_gns(
     boost: float,
     edge_ids: Optional[jax.Array] = None,
     *,
+    req: Optional[jax.Array] = None,
     window: Optional[int] = None,
     with_edge_ids: bool = False,
     sort_locality: bool = True,
@@ -276,9 +322,13 @@ def sample_one_hop_gns(
 
   Args:
     bits: bit-packed cached-set membership (`cached_set_bits`),
-      indexed by GLOBAL neighbor id.
+      indexed by GLOBAL neighbor id — or the 2-D per-requester stack
+      (`per_requester_bits`), in which case ``req`` must give each
+      seed row's requester index (ISSUE 15: boost only what THAT
+      requester serves locally).
     boost: additive preference weight — a cached neighbor's draw
       weight is ``1 + boost`` vs 1 (static: part of the compile key).
+    req: ``[B]`` requester index per seed row (2-D ``bits`` only).
 
   Returns an `OneHopResult` whose ``weights`` field (``[B, k]``
   float32) carries the per-edge ``p/q`` correction: the weighted
@@ -290,7 +340,10 @@ def sample_one_hop_gns(
     big = jnp.iinfo(seeds.dtype).max
     order = jnp.argsort(jnp.where(seeds >= 0, seeds, big))
     res = sample_one_hop_gns(indptr, indices, seeds[order], k, key,
-                             bits, boost, edge_ids, window=window,
+                             bits, boost, edge_ids,
+                             req=(req[order] if req is not None
+                                  else None),
+                             window=window,
                              with_edge_ids=with_edge_ids,
                              sort_locality=False)
     inv = jnp.argsort(order)
@@ -325,7 +378,8 @@ def sample_one_hop_gns(
   win_pos = jnp.clip(start[:, None] + wslot[None, :], 0,
                      max(num_edges - 1, 0))
   win_ids = indices[win_pos].astype(jnp.int32)            # [B, W]
-  cached = bitmask_lookup(bits, jnp.where(in_deg, win_ids, -1))
+  cached = bitmask_lookup(bits, jnp.where(in_deg, win_ids, -1),
+                          req=req)
   wgt = jnp.where(in_deg,
                   1.0 + jnp.float32(boost) * cached.astype(jnp.float32),
                   0.0)                                    # [B, W]

@@ -279,12 +279,13 @@ def stack_mod_edge_features(root, host_parts, subpath: str,
     if shards is None:
       de = feats.shape[1] if feats.ndim > 1 else 1
       shards = np.zeros((pl, rows_max, de), feats.dtype)
-    owner = ids % num_parts
+    from .partition_book import edge_local_rows_host, edge_owner_host
+    owner = edge_owner_host(ids, num_parts)
     for p, j in part_set.items():
       sel = owner == p
       if sel.any():
         vals = np.asarray(feats[sel])
-        shards[j, ids[sel] // num_parts] = (
+        shards[j, edge_local_rows_host(ids[sel], num_parts)] = (
             vals if vals.ndim > 1 else vals[:, None])
   if shards is None:
     return None
@@ -428,7 +429,8 @@ def restack_stream_view(view, old2new: np.ndarray, bounds: np.ndarray,
   eids = np.asarray(view.edge_ids)[order]
   rows_n = np.asarray(old2new)[rows_old]
   cols_n = np.asarray(old2new)[cols_old]
-  owner = np.searchsorted(bounds, rows_n, side='right') - 1
+  from .partition_book import range_of_host
+  owner = range_of_host(bounds, rows_n)
   per_part = np.bincount(owner, minlength=num_parts)
   width = max(next_power_of_two(max(int(per_part.max(initial=0)), 1)),
               int(min_edge_width))
@@ -629,10 +631,27 @@ class DistDataset:
     #: `from_partition_dir(host_parts=...)`.  None = all partitions.
     self.host_parts = (np.asarray(host_parts, np.int64)
                        if host_parts is not None else None)
+    self._partition_book = None
+    #: ISSUE 15: durably re-loaded shards parked by `failover.
+    #: adopt_shard`, keyed by the ORPHANED partition index.  Samplers
+    #: build the adopted lane's device arrays from these payloads (the
+    #: bytes that survived, not the dead owner's live memory).
+    self.adopted_shards = {}
 
   @property
   def num_partitions(self) -> int:
     return self.graph.num_partitions
+
+  @property
+  def partition_book(self):
+    """THE routing authority (ISSUE 15): one `PartitionBook` per
+    dataset, shared by every sampler/loader/driver built over it so an
+    adoption observed by one reader is observed by all at their next
+    fence.  Version 0 (identity) compiles the pre-book programs."""
+    if self._partition_book is None:
+      from .partition_book import PartitionBook
+      self._partition_book = PartitionBook(self.graph.bounds)
+    return self._partition_book
 
   def attach_stream(self, stream) -> 'DistDataset':
     """Back this dataset's topology with a streaming graph (ISSUE

@@ -344,9 +344,10 @@ def _build_etype_graph(rows_new: np.ndarray, cols_new: np.ndarray,
   input order.
   """
   from .dist_data import DistGraph
+  from .partition_book import range_of_host
   from ..utils.topo import coo_to_csr
   counts = np.diff(bounds_s)
-  owner = (np.searchsorted(bounds_s, rows_new, side='right') - 1)
+  owner = range_of_host(bounds_s, rows_new, num_parts=num_parts)
   if edge_ids is None:
     edge_ids = np.arange(len(rows_new), dtype=np.int64)
   else:

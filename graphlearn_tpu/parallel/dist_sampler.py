@@ -42,6 +42,9 @@ from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
 from .dist_data import DistDataset
 from .exchange import (MIN_EXCHANGE_CAP, capacity_spec, plan_exchange,
                        resolve_layout)
+from .partition_book import (book_owner_fn, edge_book_owner_fn,
+                             edge_local_rows, edge_owner_fn,
+                             hot_split_host, range_owner_fn)
 
 #: default per-destination exchange capacity, as a multiple of the
 #: balanced share (frontier / P).  2.0 tolerates 2x ownership skew
@@ -136,9 +139,95 @@ def bucket_with_payload(ids: jax.Array, payload: jax.Array,
   return send, send_pl, slot_p, slot_j
 
 
+class _BookPlan:
+  """Adopted-`PartitionBook` exchange: ids bucket to *(owner device,
+  lane)* virtual destinations, ship as one ``[P, S*C]`` all_to_all,
+  and each lane's receive buffer comes out laid exactly as the
+  range's ORIGINAL owner would have seen it (per-range capacity,
+  per-range positions) — the property that makes adopted epochs
+  byte-identical to fault-free runs (`partition_book` module
+  docstring).  Dense-style: post-adoption exchanges rebuild onto this
+  plan whatever layout the identity book ran (documented in
+  benchmarks/README "Elastic failover").
+  """
+
+  layout = 'book'
+
+  def __init__(self, ids, bounds, spec, axis: str,
+               capacity: Optional[int], payload=None,
+               owner_mode: str = 'range'):
+    from .exchange import ExchangeSpec, _bcast
+    self._bcast = _bcast
+    p, s = int(spec.num_parts), int(spec.num_lanes)
+    f = ids.shape[0]
+    if capacity is None:
+      cap = f
+    elif isinstance(capacity, ExchangeSpec):
+      # per-RANGE capacity from the identity plan's slot budget: the
+      # dense cap verbatim (the byte-identity arm — a range's lane
+      # buffer must hold exactly what its original owner's dense row
+      # held); compact/hier budgets flatten to slots/P rounded up,
+      # floored like the dense rule
+      if capacity.layout == 'dense':
+        cap = min(int(capacity.capacity), f)
+      else:
+        cap = min(f, max(int(round_up(-(-capacity.slots // p), 8)),
+                         MIN_EXCHANGE_CAP))
+    else:
+      cap = min(int(capacity), f)
+    if owner_mode == 'mod':
+      owner = edge_book_owner_fn(p, spec)(ids).astype(jnp.int32)
+    else:
+      owner = book_owner_fn(bounds, spec)(ids).astype(jnp.int32)
+    self._p, self._s, self._cap, self._axis = p, s, cap, axis
+    if payload is None:
+      send, self.slot_p, self.slot_j = bucket_by_owner(
+          ids, owner, p * s, None, cap)               # [P*S, cap]
+      recv2 = jax.lax.all_to_all(send.reshape(p, s * cap), axis, 0, 0,
+                                 tiled=True)          # [P_src, S*cap]
+    else:
+      send, send_pl, self.slot_p, self.slot_j = bucket_with_payload(
+          ids, payload, owner, p * s, None, cap)
+      both = jax.lax.all_to_all(
+          jnp.concatenate([send.reshape(p, s * cap),
+                           send_pl.reshape(p, s * cap)], axis=1),
+          axis, 0, 0, tiled=True)
+      recv2, recv_pl = both[:, :s * cap], both[:, s * cap:]
+      self.recv_payload_lanes = recv_pl.reshape(p, s, cap).transpose(
+          1, 0, 2).reshape(s, p * cap)
+    #: lane j's receive buffer ``[P_src * cap]`` — bit-identical to
+    #: the identity-book recv of the range assigned to (me, lane j)
+    self.recv_lanes = recv2.reshape(p, s, cap).transpose(
+        1, 0, 2).reshape(s, p * cap)
+    self.kept = self.slot_j >= 0
+    self.delivered = self.kept
+    valid = ids >= 0
+    offered = jnp.sum(valid.astype(jnp.int32))
+    dropped = jnp.sum((valid & ~self.kept).astype(jnp.int32))
+    self.stats = (offered, dropped, jnp.int32(p * s * cap))
+    #: requester index per lane-recv row (the per-requester GNS mask
+    #: needs the source device of every received frontier id)
+    self.req_of_lane_recv = jnp.repeat(
+        jnp.arange(p, dtype=jnp.int32), cap)
+
+  def reply(self, values_lanes, fill=0):
+    """``[S, P*cap, ...]`` per-lane owner-side values -> ``[F, ...]``
+    in request order; un-kept positions get ``fill``."""
+    p, s, cap = self._p, self._s, self._cap
+    trail = values_lanes.shape[2:]
+    v = values_lanes.reshape((s, p, cap) + trail)
+    v = jnp.moveaxis(v, 0, 1).reshape((p, s * cap) + trail)
+    back = jax.lax.all_to_all(v, self._axis, 0, 0, tiled=True)
+    flat = back.reshape((p * s, cap) + trail)
+    out = flat[self.slot_p, jnp.where(self.kept, self.slot_j, 0)]
+    return jnp.where(self._bcast(self.kept, out), out,
+                     jnp.asarray(fill, out.dtype))
+
+
 def dist_edge_exists(indptr_loc, indices_loc, bounds, rows, cols,
                      axis: str, num_parts: int,
-                     exchange_capacity: Optional[int] = None):
+                     exchange_capacity: Optional[int] = None,
+                     book_spec=None):
   """Distributed membership test over the range-sharded CSR: is
   ``(rows[i], cols[i])`` an edge of the global graph?
 
@@ -151,9 +240,22 @@ def dist_edge_exists(indptr_loc, indices_loc, bounds, rows, cols,
   strict negatives).
   """
   my_idx = jax.lax.axis_index(axis)
+  if book_spec is not None:
+    plan = _BookPlan(rows, bounds, book_spec, axis, exchange_capacity,
+                     payload=cols)
+    slot_ranges = jnp.asarray(book_spec.slot_ranges, jnp.int32)
+    lanes_ex = []
+    for j in range(book_spec.num_lanes):
+      r_j = jnp.clip(slot_ranges[my_idx, j], 0, num_parts - 1)
+      flat_r = plan.recv_lanes[j]
+      local_r = jnp.where(flat_r >= 0, flat_r - bounds[r_j],
+                          INVALID_ID).astype(jnp.int32)
+      lanes_ex.append(edge_in_csr(
+          indptr_loc[j], indices_loc[j], local_r,
+          plan.recv_payload_lanes[j].astype(jnp.int32)))
+    return plan.reply(jnp.stack(lanes_ex), fill=True)
   my_start = bounds[my_idx]
-  owner_fn = lambda v: (jnp.searchsorted(bounds, v, side='right')
-                        - 1).astype(jnp.int32)
+  owner_fn = range_owner_fn(bounds)
   plan = plan_exchange(rows, owner_fn, num_parts, axis,
                        exchange_capacity, payload=cols)
   flat_r = plan.recv
@@ -173,7 +275,8 @@ def dist_sample_negative(indptr_loc, indices_loc, bounds,
                          key, axis: str, num_parts: int,
                          trials: int = NEG_TRIALS,
                          exchange_capacity: Optional[int] = None,
-                         rows_fixed: Optional[jax.Array] = None):
+                         rows_fixed: Optional[jax.Array] = None,
+                         book_spec=None):
   """``req_num`` strict negative pairs over the sharded graph
   (collective analog of `ops.negative.sample_negative`): trials-stacked
   draws, ONE existence exchange for all trials, first-non-edge pick.
@@ -193,7 +296,7 @@ def dist_sample_negative(indptr_loc, indices_loc, bounds,
   exists = dist_edge_exists(
       indptr_loc, indices_loc, bounds, rows.reshape(-1),
       cols.reshape(-1), axis, num_parts,
-      exchange_capacity).reshape(trials, req_num)
+      exchange_capacity, book_spec=book_spec).reshape(trials, req_num)
   ok = ~exists
   any_ok = jnp.any(ok, axis=0)
   pick = jnp.where(any_ok, jnp.argmax(ok, axis=0), trials - 1)
@@ -201,11 +304,65 @@ def dist_sample_negative(indptr_loc, indices_loc, bounds,
   return rows[pick, slot], cols[pick, slot], any_ok
 
 
+def _dist_one_hop_book(indptr_l, indices_l, eids_l, bounds, frontier,
+                       k: int, key, axis: str, num_parts: int,
+                       with_edge: bool, book_spec,
+                       sort_locality: bool = True,
+                       exchange_capacity: Optional[int] = None,
+                       gns_bits=None,
+                       gns_boost: Optional[float] = None):
+  """Adopted-book hop: route per *(owner, lane)*, sample per RANGE.
+
+  Each lane's receive buffer and sampling key are keyed by the range
+  (``fold_in(key, range)``, not the device index), so an adopted
+  shard's draws are bit-identical to what its original owner would
+  have produced — the byte-identity half of the exact-completion
+  contract.  Local arrays carry a leading lane axis (``[S, ...]``).
+  """
+  my_idx = jax.lax.axis_index(axis)
+  plan = _BookPlan(frontier, bounds, book_spec, axis,
+                   exchange_capacity)
+  slot_ranges = jnp.asarray(book_spec.slot_ranges, jnp.int32)
+  outs_n, outs_m, outs_e, outs_w = [], [], [], []
+  for j in range(book_spec.num_lanes):
+    r_j = jnp.clip(slot_ranges[my_idx, j], 0, num_parts - 1)
+    flat = plan.recv_lanes[j]
+    local = jnp.where(flat >= 0, flat - bounds[r_j],
+                      INVALID_ID).astype(jnp.int32)
+    lane_key = jax.random.fold_in(key, r_j)
+    if gns_bits is not None:
+      from ..ops.gns import sample_one_hop_gns
+      res = sample_one_hop_gns(
+          indptr_l[j], indices_l[j], local, k, lane_key, gns_bits,
+          float(gns_boost), eids_l[j] if eids_l is not None else None,
+          req=(plan.req_of_lane_recv if gns_bits.ndim == 2 else None),
+          with_edge_ids=with_edge, sort_locality=sort_locality)
+    else:
+      res = sample_one_hop(
+          indptr_l[j], indices_l[j], local, k, lane_key,
+          eids_l[j] if eids_l is not None else None,
+          with_edge_ids=with_edge, sort_locality=sort_locality)
+    outs_n.append(res.nbrs)
+    outs_m.append(res.mask)
+    if with_edge:
+      outs_e.append(res.eids)
+    if res.weights is not None:
+      outs_w.append(res.weights)
+  out_nbrs = plan.reply(jnp.stack(outs_n), fill=INVALID_ID)
+  out_mask = plan.reply(jnp.stack(outs_m), fill=False)
+  out_eids = (plan.reply(jnp.stack(outs_e), fill=INVALID_ID)
+              if with_edge else None)
+  out_w = (plan.reply(jnp.stack(outs_w), fill=0.0)
+           if outs_w else None)
+  return out_nbrs, out_mask, out_eids, out_w, plan.stats
+
+
 def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
                   k: int, key, axis: str, num_parts: int,
                   with_edge: bool, sort_locality: bool = True,
                   exchange_capacity: Optional[int] = None,
-                  gns_bits=None, gns_boost: Optional[float] = None):
+                  gns_bits=None, gns_boost: Optional[float] = None,
+                  book_spec=None):
   """One distributed hop for this device's ``frontier`` ids.
 
   ``exchange_capacity`` caps the per-destination exchange width
@@ -219,19 +376,34 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   None without GNS; ``stats`` is the (offered, dropped, slots)
   telemetry triple.
   """
+  if book_spec is not None:
+    return _dist_one_hop_book(
+        indptr_loc, indices_loc, eids_loc, bounds, frontier, k, key,
+        axis, num_parts, with_edge, book_spec,
+        sort_locality=sort_locality,
+        exchange_capacity=exchange_capacity, gns_bits=gns_bits,
+        gns_boost=gns_boost)
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
-  owner_fn = lambda v: (jnp.searchsorted(bounds, v, side='right')
-                        - 1).astype(jnp.int32)
+  owner_fn = range_owner_fn(bounds)
   plan = plan_exchange(frontier, owner_fn, num_parts, axis,
                        exchange_capacity)
   flat = plan.recv
   local = jnp.where(flat >= 0, flat - my_start, INVALID_ID).astype(jnp.int32)
   if gns_bits is not None:
     from ..ops.gns import sample_one_hop_gns
+    req = None
+    if gns_bits.ndim == 2:
+      # per-requester masks (ISSUE 15): the plan attributes each recv
+      # row to its source device; layouts that cannot (hier's
+      # two-stage re-bucketing) fall back to the hot-split-only row —
+      # conservative (never over-boosts), still exactly corrected
+      req = getattr(plan, 'requester_of_recv', None)
+      if req is None:
+        req = jnp.full(flat.shape, gns_bits.shape[0] - 1, jnp.int32)
     res = sample_one_hop_gns(indptr_loc, indices_loc, local, k,
                              jax.random.fold_in(key, my_idx), gns_bits,
-                             float(gns_boost), eids_loc,
+                             float(gns_boost), eids_loc, req=req,
                              with_edge_ids=with_edge,
                              sort_locality=sort_locality)
   else:
@@ -247,10 +419,54 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   return out_nbrs, out_mask, out_eids, out_w, plan.stats
 
 
+def _dist_gather_multi_book(shard_locs, bounds, ids, axis: str,
+                            num_parts: int, book_spec,
+                            exchange_capacity: Optional[int] = None,
+                            shard_mode: str = 'range',
+                            hot_counts: Optional[jax.Array] = None):
+  """Adopted-book row gather: tables carry a leading lane axis
+  (``[S, rows, ...]``); requests route per *(owner, lane)* and the
+  hot-tier gate keys on the RANGE's hot count (placement is frozen;
+  only the serving device moved)."""
+  my_idx = jax.lax.axis_index(axis)
+  plan = _BookPlan(ids, bounds, book_spec, axis, exchange_capacity,
+                   owner_mode=shard_mode)
+  slot_ranges = jnp.asarray(book_spec.slot_ranges, jnp.int32)
+  ok = (ids >= 0) & plan.delivered
+  outs = []
+  for t, shard_l in enumerate(shard_locs):
+    lane_rows = []
+    for j in range(book_spec.num_lanes):
+      flat = plan.recv_lanes[j]
+      valid = flat >= 0
+      r_j = jnp.clip(slot_ranges[my_idx, j], 0, num_parts - 1)
+      if shard_mode == 'mod':
+        local = jnp.where(valid, edge_local_rows(flat, num_parts), 0)
+      else:
+        local = jnp.where(valid, flat - bounds[r_j], 0)
+      row_valid = valid
+      if t == 0 and hot_counts is not None:
+        row_valid = valid & (local < hot_counts[r_j])
+      idx = jnp.clip(local, 0, shard_l.shape[1] - 1)
+      rows = shard_l[j][idx]
+      if rows.ndim == 1:
+        rows = jnp.where(row_valid, rows, 0)
+      else:
+        rows = jnp.where(row_valid[:, None], rows, 0)
+      lane_rows.append(rows)
+    out = plan.reply(jnp.stack(lane_rows), fill=0)
+    if out.ndim == 1:
+      outs.append(jnp.where(ok, out, 0))
+    else:
+      outs.append(jnp.where(ok[:, None], out, 0))
+  return tuple(outs), plan.stats
+
+
 def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
                       exchange_capacity: Optional[int] = None,
                       shard_mode: str = 'range',
-                      hot_counts: Optional[jax.Array] = None):
+                      hot_counts: Optional[jax.Array] = None,
+                      book_spec=None):
   """Distributed row gather from several sharded tables that share an
   ownership scheme: ``out_t[i] = table_t[ids[i]]`` (the collective-era
   `DistFeature.async_get`, `distributed/dist_feature.py:134-269`).
@@ -270,19 +486,23 @@ def dist_gather_multi(shard_locs, bounds, ids, axis: str, num_parts: int,
   Returns ``(outs, stats)`` with the (offered, dropped, slots)
   telemetry triple.
   """
+  if book_spec is not None:
+    return _dist_gather_multi_book(
+        shard_locs, bounds, ids, axis, num_parts, book_spec,
+        exchange_capacity=exchange_capacity, shard_mode=shard_mode,
+        hot_counts=hot_counts)
   my_idx = jax.lax.axis_index(axis)
   if shard_mode == 'mod':
-    owner_fn = lambda v: (v % num_parts).astype(jnp.int32)
+    owner_fn = edge_owner_fn(num_parts)
   else:
     my_start = bounds[my_idx]
-    owner_fn = lambda v: (jnp.searchsorted(bounds, v, side='right')
-                          - 1).astype(jnp.int32)
+    owner_fn = range_owner_fn(bounds)
   plan = plan_exchange(ids, owner_fn, num_parts, axis,
                        exchange_capacity)
   flat = plan.recv
   valid = flat >= 0
   if shard_mode == 'mod':
-    local = jnp.where(valid, flat // num_parts, 0)
+    local = jnp.where(valid, edge_local_rows(flat, num_parts), 0)
   else:
     local = jnp.where(valid, flat - my_start, 0)
   ok = (ids >= 0) & plan.delivered
@@ -548,7 +768,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
                         collect_edge_features=False, efshard=None,
                         ebounds=None, ef_shard_mode='mod',
                         hot_counts=None, gns_bits=None,
-                        gns_boost=None):
+                        gns_boost=None, book_spec=None):
   """Per-device multihop expansion + feature/label collection — the
   shared body of the node and link SPMD steps.  When
   ``collect_edge_features`` is set, every sampled edge's feature row is
@@ -577,7 +797,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
         axis, num_parts, with_edge,
         exchange_capacity=_slack_cap(frontier.shape[0], num_parts,
                                      exchange_slack, exchange_layout),
-        gns_bits=gns_bits, gns_boost=gns_boost)
+        gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec)
     fr_stats = fr_stats + jnp.stack(hstats)
     state, rows, cols, prev_cnt = induce_next(
         state, frontier_local, nbrs, mask)
@@ -608,7 +828,7 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
         (efshard,), ebounds, edge, axis, num_parts,
         exchange_capacity=_slack_cap(edge.shape[0], num_parts,
                                      exchange_slack, exchange_layout),
-        shard_mode=ef_shard_mode)
+        shard_mode=ef_shard_mode, book_spec=book_spec)
     ft_stats = ft_stats + jnp.stack(estats)
   tables = (((fshard,) if collect_features else ())
             + ((lshard,) if collect_labels else ()))
@@ -617,7 +837,8 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
         tables, bounds, state.nodes, axis, num_parts,
         exchange_capacity=_slack_cap(node_cap, num_parts,
                                      exchange_slack, exchange_layout),
-        hot_counts=hot_counts if collect_features else None)
+        hot_counts=hot_counts if collect_features else None,
+        book_spec=book_spec)
     got = list(got)
     ft_stats = ft_stats + jnp.stack(gstats)
     if collect_features:
@@ -643,7 +864,8 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     exchange_layout: Optional[str] = None,
                     collect_edge_features: bool = False,
                     ef_shard_mode: str = 'mod', tiered: bool = False,
-                    gns_boost: Optional[float] = None):
+                    gns_boost: Optional[float] = None,
+                    book_spec=None):
   """Build the jitted SPMD sample(+collect) step.
 
   ``exchange_slack``: per-destination exchange capacity as a multiple
@@ -682,7 +904,7 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
         hot_counts=hcounts if tiered else None,
-        gns_bits=gns_bits, gns_boost=gns_boost)
+        gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec)
 
     def lead(v):   # re-add the shard axis for stacked outputs
       return None if v is None else v[None]
@@ -722,7 +944,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                          collect_edge_features: bool = False,
                          ef_shard_mode: str = 'mod',
                          tiered: bool = False,
-                         gns_boost: Optional[float] = None):
+                         gns_boost: Optional[float] = None,
+                         book_spec=None):
   """Build the jitted SPMD LINK sample step: per-device seed edges +
   collective strict negatives + the shared expansion body.
 
@@ -754,7 +977,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
     if neg_mode == 'binary':
       nrows, ncols, neg_ok = dist_sample_negative(
           indptr, indices, bounds, num_nodes, num_nodes, num_neg,
-          neg_key, axis, num_parts, exchange_capacity=cap)
+          neg_key, axis, num_parts, exchange_capacity=cap,
+          book_spec=book_spec)
       seeds = jnp.concatenate([src, dst, nrows, ncols])
     elif neg_mode == 'triplet':
       amount = num_neg // batch
@@ -762,7 +986,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
       _, negs, neg_ok = dist_sample_negative(
           indptr, indices, bounds, num_nodes, num_nodes, num_neg,
           neg_key, axis, num_parts, exchange_capacity=cap,
-          rows_fixed=srcs_rep.astype(jnp.int32))
+          rows_fixed=srcs_rep.astype(jnp.int32),
+          book_spec=book_spec)
       seeds = jnp.concatenate([src, dst, negs])
     else:
       seeds = jnp.concatenate([src, dst])
@@ -785,7 +1010,7 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
         hot_counts=hcounts if tiered else None,
-        gns_bits=gns_bits, gns_boost=gns_boost)
+        gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec)
 
     b = batch
     sl = seed_local
@@ -858,7 +1083,8 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
                              exchange_slack: Optional[float] = None,
                              exchange_layout: Optional[str] = None,
                              tiered: bool = False,
-                             hop_chunk: Optional[int] = None):
+                             hop_chunk: Optional[int] = None,
+                             book_spec=None):
   """Build the jitted SPMD INDUCED-SUBGRAPH step — the device-mesh
   analog of reference ``DistNeighborSampler._subgraph``
   (`distributed/dist_neighbor_sampler.py:456-516`).
@@ -903,7 +1129,7 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
         crows=crows_s[0] if with_cache else None,
         axis=axis, num_parts=num_parts, exchange_slack=exchange_slack,
         exchange_layout=exchange_layout,
-        hot_counts=hcounts if tiered else None)
+        hot_counts=hcounts if tiered else None, book_spec=book_spec)
 
     nodes = state.nodes                              # [node_cap]
     nodes_pad = jnp.concatenate(
@@ -922,7 +1148,8 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
           with_edge,
           exchange_capacity=_slack_cap(chunk, num_parts,
                                        exchange_slack,
-                                       exchange_layout))
+                                       exchange_layout),
+          book_spec=book_spec)
       stats = stats.at[:3].add(jnp.stack(hstats))
       nbrs_parts.append(nb)
       mask_parts.append(mk)
@@ -1258,6 +1485,29 @@ class DistNeighborSampler(ExchangeTelemetry):
     self._step_cnt = 0
     self._steps = {}
     self._device_arrays = None
+    #: ISSUE 15 — the single routing authority.  The sampler compiles
+    #: its steps against one pinned `BookView` per dispatch and fences
+    #: at the `_arrays()` seam: a version bump (adoption) rebuilds the
+    #: device arrays lane-stacked and recompiles the exchange plans
+    #: for the new routing.  The identity book (version 0) compiles
+    #: EXACTLY the pre-book program.
+    self.book = dataset.partition_book
+    self._book_ver = self.book.version
+    self._shard_store = None
+    # degraded write-offs are DATASET state (the stacks are zeroed in
+    # place): the set is shared so every sampler over this dataset
+    # classifies the loss identically, and `maybe_refresh_book` fences
+    # on its size so siblings rebuild from the emptied stacks instead
+    # of serving a stale full view
+    if not hasattr(dataset, 'degraded_partitions'):
+      dataset.degraded_partitions = set()
+    self._degraded_partitions = dataset.degraded_partitions
+    self._degraded_seen = len(self._degraded_partitions)
+    # the load-time durable copy: with GLT_SHARD_DIR set, the shards
+    # are written NOW (idempotent across samplers over one dataset) —
+    # an owner lost later adopts from this copy, and recovery never
+    # pays (or depends on) a serialize of the dead owner's memory
+    self._resolve_shard_store()
     #: streaming ingestion (ISSUE 14): last `graph_version` this
     #: sampler's stacks were (re)built from.  Seeded from the version
     #: `attach_stream` restacked ds.graph at, so the first dispatch
@@ -1300,19 +1550,247 @@ class DistNeighborSampler(ExchangeTelemetry):
     indptr_s, indices_s, eids_s = restack_stream_view(
         view, self.ds.old2new, g.bounds,
         min_edge_width=int(g.indices.shape[1]))
+    # a degraded write-off stays written off: the restack rebuilds
+    # every partition from the stream, which would resurrect the dead
+    # owner's topology against its zeroed feature shard
+    for p in self._degraded_partitions:
+      indptr_s[p] = 0
+      indices_s[p] = -1
+      eids_s[p] = -1
     self.ds.graph = DistGraph(indptr_s, indices_s, eids_s, g.bounds)
+    # adopted lanes track the restacked topology too: the stream owns
+    # the full graph — the parked durable payload was only the
+    # bootstrap copy (feature/label fields stay: topology-only stream)
+    adopted = getattr(self.ds, 'adopted_shards', None)
+    if adopted:
+      for r in list(adopted):
+        adopted[r] = dict(adopted[r], indptr=np.asarray(indptr_s[r]),
+                          indices=np.asarray(indices_s[r]),
+                          eids=np.asarray(eids_s[r]))
     if self._device_arrays is not None:
-      arrs = dict(self._device_arrays)   # RCU: in-flight dicts frozen
-      arrs['indptr'] = self._put_shard(indptr_s)
-      arrs['indices'] = self._put_shard(indices_s)
-      arrs['eids'] = self._put_shard(eids_s)
-      self._device_arrays = arrs
+      if self.book.version or self._degraded_partitions:
+        # lane-stacked arrays (post-adoption) — the in-place [P, W]
+        # patch would drop the lane axis the compiled book steps
+        # expect; rebuild at the seam instead
+        self._device_arrays = None
+        self._steps.clear()
+      else:
+        arrs = dict(self._device_arrays)  # RCU: in-flight dicts frozen
+        arrs['indptr'] = self._put_shard(indptr_s)
+        arrs['indices'] = self._put_shard(indices_s)
+        arrs['eids'] = self._put_shard(eids_s)
+        self._device_arrays = arrs
     self._gns_ver = -1                   # version-fenced invalidation
     self._stream_ver = view.version
     self.ds.stream_version = view.version  # later samplers seed here
     return self._stream_ver
 
+  # -- elastic partition failover (ISSUE 15) -------------------------------
+  def _resolve_shard_store(self):
+    """The durable `failover.ShardStore` under ``GLT_SHARD_DIR``
+    (None = failover off, degraded semantics unchanged).  First
+    resolution WRITES the dataset's shards (the load-time durable
+    copy the tentpole requires) unless the store already covers this
+    partition count — single-controller only; host-local layouts
+    would write other hosts' shards from placeholders."""
+    if self._shard_store is not None:
+      return self._shard_store
+    from .failover import ShardStore, shard_dir_from_env
+    d = shard_dir_from_env()
+    if d is None or getattr(self.ds, 'host_parts', None) is not None:
+      return None
+    store = ShardStore(d)
+    written = getattr(self.ds, '_shards_written', False)
+    meta = store.meta()
+    g = self.ds.graph
+    # a stale store (different graph under the same dir) must be
+    # overwritten, not trusted: shape alone can collide (a regenerated
+    # same-config dataset), so the content fingerprint is checked too;
+    # edge-width growth (streaming reserve) is allowed since adoption
+    # pads narrower durable rows to the live width
+    from .failover import dataset_fingerprint
+    stale = (meta is None
+             or meta.get('num_parts') != self.num_parts
+             or meta.get('num_nodes') != int(g.num_nodes)
+             or meta.get('node_width') != int(g.indptr.shape[1])
+             or int(meta.get('edge_width', 0)) > int(g.indices.shape[1])
+             or meta.get('fingerprint') not in
+             (None, dataset_fingerprint(self.ds)))
+    if not written and stale:
+      store.write_dataset_shards(self.ds)
+    self.ds._shards_written = True
+    self._shard_store = store
+    return store
+
+  def _partition_supervision(self) -> None:
+    """Chaos-seam owner supervision, run at every dispatch seam
+    BEFORE the step counter advances: a planned ``partition.owner``
+    kill classifies that owner dead (the in-process stand-in for the
+    PR 13 heartbeat-miss discriminator; ``delay`` models a slow-but-
+    alive owner and only costs wall clock) and recovery runs the
+    documented ladder — adopt (durable shard present) → degraded
+    (``GLT_DEGRADED_OK=1``) → typed `PartitionLostError`.  After a
+    successful adoption the SAME dispatch proceeds: the key stream
+    never advanced, so the recovered batch is byte-identical to the
+    fault-free one."""
+    from ..testing import chaos
+    from .failover import PartitionLostError
+    try:
+      chaos.partition_owner_check(step=self._step_cnt + 1)
+    except PartitionLostError as e:
+      self._on_partition_lost(e)
+
+  def _on_partition_lost(self, err) -> None:
+    """One owner classified dead: run the fallback ladder."""
+    import time as _time
+    from ..distributed.resilience import degraded_ok
+    from ..telemetry.recorder import recorder
+    from .failover import NoDurableShardError, adopt_shard
+    from .partition_book import AdoptionRefusedError
+    p = int(err.partition or 0)
+    if p in self._degraded_partitions:
+      return                      # already written off (degraded)
+    view = self.book.view()
+    if int(view.owners[p]) != p:
+      return                      # already adopted — reader just fences
+    t0 = _time.monotonic()
+    try:
+      info = adopt_shard(self.ds, self._resolve_shard_store(), p)
+    except (NoDurableShardError, AdoptionRefusedError) as e:
+      # the documented ladder: adoption unavailable (no durable
+      # shard, no eligible survivor, foreign store, adopt timeout) →
+      # degraded when the operator opted in, typed otherwise
+      if not degraded_ok():
+        raise type(err)(
+            f'partition {p} lost and adoption is unavailable '
+            f'({e}); set GLT_SHARD_DIR for elastic failover or '
+            f'GLT_DEGRADED_OK=1 for reduced completion',
+            partition=p) from e
+      self._enter_degraded(p)
+      return
+    self._adopt_pending_t0 = (t0, p, info['survivor'])
+    recorder.emit('peer.lost', peer=p, peer_kind='partition',
+                  degraded=False, adopted=True,
+                  survivor=info['survivor'])
+
+  def _enter_degraded(self, p: int) -> None:
+    """Documented ``GLT_DEGRADED_OK`` fallback: the orphaned shard's
+    nodes VANISH from the epoch (its CSR row and feature shard are
+    emptied) — reduced data, exact accounting, flagged typed in the
+    flight recorder, never a silent wrong answer."""
+    from ..telemetry.recorder import recorder
+    self._degraded_partitions.add(p)
+    g = self.ds.graph
+    g.indptr[p] = 0
+    g.indices[p] = -1
+    g.edge_ids[p] = -1
+    nf = self.ds.node_features
+    if nf is not None:
+      nf.shards[p] = 0
+      if nf.cold_host is not None:
+        b = np.asarray(g.bounds, np.int64)
+        nf.cold_host[b[p]:b[p + 1]] = 0
+    self._device_arrays = None       # rebuild from the emptied stacks
+    self._steps.clear()
+    self._gns_ver = -1
+    self._degraded_seen = len(self._degraded_partitions)
+    recorder.emit('peer.lost', peer=p, peer_kind='partition',
+                  degraded=True, adopted=False)
+
+  def _complete_recovery(self) -> None:
+    """First successful dispatch after an adoption: close the
+    recovery clock (classification → served batch) into the
+    ``partition.recovery_secs`` gauge."""
+    pending = getattr(self, '_adopt_pending_t0', None)
+    if pending is None:
+      return
+    import time as _time
+    from ..telemetry.live import live
+    from ..telemetry.recorder import recorder
+    t0, p, survivor = pending
+    self._adopt_pending_t0 = None
+    secs = _time.monotonic() - t0
+    live.gauge('partition.recovery_secs').set(float(secs))
+    recorder.emit('partition.adopt', partition=p, survivor=survivor,
+                  version=self.book.version, phase='recovered',
+                  secs=round(secs, 6))
+
+  def maybe_refresh_book(self):
+    """Version fence for partition ownership (ISSUE 15) — the same
+    RCU discipline as `maybe_refresh_stream`: when the shared
+    `PartitionBook` published a newer view (an adoption), rebuild the
+    owner-side device arrays LANE-STACKED for the new routing, clear
+    the step cache (the `BookSpec` is a trace-time constant — new
+    routing = new exchange plans and capacity specs) and invalidate
+    the GNS bitmask (derived structures refresh with the placement
+    they derive from).  Readers hold one view per dispatch; a bump
+    mid-dispatch swaps the attribute, never the arrays in flight."""
+    ver = self.book.version
+    ndeg = len(self._degraded_partitions)
+    if ver == self._book_ver and ndeg == self._degraded_seen:
+      return ver
+    self._book_ver = ver
+    self._book_view = self.book.view()
+    self._degraded_seen = ndeg
+    self._device_arrays = None
+    self._steps.clear()
+    self._gns_ver = -1
+    return ver
+
+  @property
+  def book_spec(self):
+    """Hashable static routing tables of the PINNED view (None =
+    identity book: every step compiles the pre-book program)."""
+    view = getattr(self, '_book_view', None)
+    if view is None or view.version != self._book_ver:
+      self._book_view = view = self.book.view()
+    return view.spec()
+
+  def _lane_source(self, r: int) -> dict:
+    """Shard payload serving range ``r``: the durably re-loaded copy
+    for adopted ranges (`failover.adopt_shard` parked it), the live
+    stacks otherwise."""
+    adopted = getattr(self.ds, 'adopted_shards', {})
+    if r in adopted:
+      return adopted[r]
+    g = self.ds.graph
+    out = {'indptr': g.indptr[r], 'indices': g.indices[r],
+           'eids': g.edge_ids[r]}
+    nf = self.ds.node_features
+    if self.collect_features and nf is not None:
+      out['fshard'] = nf.shards[r]
+    if self.collect_labels and self.ds.node_labels is not None:
+      out['lshard'] = np.asarray(self.ds.node_labels)[r]
+    if self.collect_edge_features:
+      out['efshard'] = self.ds.edge_features.shards[r]
+    return out
+
+  def _lane_stacked(self, key: str, template: np.ndarray, fill):
+    """``[P, ...]`` owner-side stack → ``[P, S, ...]`` lane stack:
+    device ``d``'s lane ``j`` holds the shard of range
+    ``slot_ranges[d, j]`` (unassigned lanes hold ``fill``)."""
+    view = self._book_view
+    p, s = view.num_partitions, int(view.num_lanes)
+    out = np.full((p, s) + tuple(template.shape[1:]), fill,
+                  template.dtype)
+    for d in range(p):
+      for j in range(s):
+        r = int(view.slot_ranges[d, j])
+        if r < 0:
+          continue
+        src = self._lane_source(r).get(key)
+        if src is None:
+          continue
+        src = np.asarray(src, template.dtype)
+        sl = tuple(slice(0, n) for n in src.shape)
+        out[(d, j) + sl] = src
+    return out
+
   def _arrays(self):
+    # book fence FIRST: a version bump (adoption) drops the cached
+    # dict and the compiled steps, so this dispatch rebuilds against
+    # exactly one pinned BookView (`maybe_refresh_book`)
+    self.maybe_refresh_book()
     if self._device_arrays is None:
       shard = NamedSharding(self.mesh, P(self.axis))
       repl = NamedSharding(self.mesh, P())
@@ -1355,14 +1833,36 @@ class DistNeighborSampler(ExchangeTelemetry):
         putS = self._put_stacked
       else:
         putS = lambda a: put(a, shard)       # noqa: E731
-      self._device_arrays = dict(
-          indptr=putS(g.indptr), indices=putS(g.indices),
-          eids=putS(g.edge_ids), bounds=put(g.bounds, repl),
-          fshards=putS(np.asarray(fshards)),
-          lshards=putS(np.asarray(lshards)),
-          cids=putS(cids), crows=putS(crows),
-          efshards=putS(efshards), ebounds=put(ebounds, repl),
-          hcounts=put(np.asarray(hcounts, np.int32), repl))
+      spec = self.book_spec
+      if spec is None:
+        # identity book: EXACTLY the pre-book arrays (the fault-free
+        # byte-identity contract — failover compiled in costs nothing)
+        self._device_arrays = dict(
+            indptr=putS(g.indptr), indices=putS(g.indices),
+            eids=putS(g.edge_ids), bounds=put(g.bounds, repl),
+            fshards=putS(np.asarray(fshards)),
+            lshards=putS(np.asarray(lshards)),
+            cids=putS(cids), crows=putS(crows),
+            efshards=putS(efshards), ebounds=put(ebounds, repl),
+            hcounts=put(np.asarray(hcounts, np.int32), repl))
+      else:
+        # adopted book: owner-side stacks grow a lane axis — device
+        # ``d`` lane ``j`` serves range ``slot_ranges[d, j]``, adopted
+        # lanes built from the DURABLE shard payload.  Requester-side
+        # arrays (the offline remote-hot cache) keep their shape.
+        self._device_arrays = dict(
+            indptr=putS(self._lane_stacked('indptr', g.indptr, 0)),
+            indices=putS(self._lane_stacked('indices', g.indices, -1)),
+            eids=putS(self._lane_stacked('eids', g.edge_ids, -1)),
+            bounds=put(g.bounds, repl),
+            fshards=putS(self._lane_stacked('fshard',
+                                            np.asarray(fshards), 0)),
+            lshards=putS(self._lane_stacked('lshard',
+                                            np.asarray(lshards), 0)),
+            cids=putS(cids), crows=putS(crows),
+            efshards=putS(self._lane_stacked('efshard', efshards, 0)),
+            ebounds=put(ebounds, repl),
+            hcounts=put(np.asarray(hcounts, np.int32), repl))
     # streaming fence: re-pin the newest published graph version at
     # the dispatch seam (no-op for static datasets).  Callers hold
     # the RETURNED dict for the whole dispatch — a publish landing
@@ -1392,7 +1892,7 @@ class DistNeighborSampler(ExchangeTelemetry):
             exchange_layout=self.exchange_layout,
             collect_edge_features=self.collect_edge_features,
             ef_shard_mode=self._ef_shard_mode, tiered=self.tiered,
-            gns_boost=self.gns_boost)
+            gns_boost=self.gns_boost, book_spec=self.book_spec)
       if self.gns:
         from ..telemetry.recorder import recorder
         from ..utils.profiling import metrics
@@ -1429,8 +1929,12 @@ class DistNeighborSampler(ExchangeTelemetry):
     """
     from ..telemetry.spans import span
     b = seeds_stacked.shape[1]
-    step = self.step_for_batch(b)
+    # supervision + fence BEFORE step resolution: an adoption here
+    # clears the step cache and the step must compile for the new
+    # routing, with the key stream still un-advanced (byte-identity)
+    self._partition_supervision()
     arrs = self._arrays()
+    step = self.step_for_batch(b)
     self._step_cnt += 1
     if key is None:
       key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -1453,6 +1957,7 @@ class DistNeighborSampler(ExchangeTelemetry):
       ew = outs[11] if self.gns else None
     # outside the span: the every-64th-call drain blocks on the
     # device, and that sync must not masquerade as dispatch latency
+    self._complete_recovery()
     self._accumulate_stats(stats)
     out = dict(node=nodes, node_count=count[..., 0], row=row, col=col,
                edge=edge, seed_local=seed_local, x=x, y=y, ef=ef,
@@ -1528,17 +2033,35 @@ class DistNeighborSampler(ExchangeTelemetry):
     cache = self._ensure_cold_cache()
     ver = cache.version if cache is not None else 0
     if self._gns_bits is None or ver != self._gns_ver:
-      from ..ops.gns import cached_set_bits, set_resident_bits
+      from ..ops.gns import cached_set_bits, per_requester_bits
+      n = self.ds.graph.num_nodes
       if self._gns_hot_bits is None:
         # the static half, packed once: refreshes pay O(bytes) copy
         # + O(residents), not the O(num_nodes) bool rebuild
         self._gns_hot_bits = cached_set_bits(
-            self.ds.graph.num_nodes, self.ds.graph.bounds,
+            n, self.ds.graph.bounds,
             self.ds.node_features.hot_counts, np.empty(0, np.int64))
-      residents = (cache.resident_ids() if cache is not None
-                   else np.empty(0, np.int64))
-      bits = set_resident_bits(self._gns_hot_bits, residents,
-                               self.ds.graph.num_nodes)
+      # PER-REQUESTER masks (ISSUE 15, the PR 10 known-limit fix):
+      # row d = hot split ∪ device d's OWN cache residents, last row
+      # = hot-only fallback for unattributable recv rows.  The union
+      # mask over-boosted rows resident only on another device's ring
+      # — a remote-only resident now gets no boost locally.  Devices
+      # outside this host (host_parts) stay hot-only: unknown
+      # residency must never over-boost (weights keep ANY mask
+      # unbiased; a conservative mask costs placement, not bias).
+      residents_by_dev = {}
+      n_res = 0
+      if cache is not None:
+        hp = (self.ds.host_parts if self.ds.host_parts is not None
+              else np.arange(self.num_parts))
+        for j, sh in enumerate(cache.shards):
+          res = sh.resident_ids()
+          residents_by_dev[int(hp[j])] = res
+          n_res += len(res)
+      bits = per_requester_bits(n, self.ds.graph.bounds,
+                                self.ds.node_features.hot_counts,
+                                residents_by_dev,
+                                base_bits=self._gns_hot_bits)
       self._gns_bits = jax.device_put(
           bits, NamedSharding(self.mesh, P()))
       self._gns_ver = ver
@@ -1547,7 +2070,7 @@ class DistNeighborSampler(ExchangeTelemetry):
       from ..telemetry.recorder import recorder
       if recorder.enabled:
         recorder.emit('gns.sketch_update', scope='dist',
-                      residents=int(len(residents)), version=int(ver),
+                      residents=int(n_res), version=int(ver),
                       mask_bytes=int(bits.nbytes))
     return self._gns_bits
 
@@ -1577,11 +2100,11 @@ class DistNeighborSampler(ExchangeTelemetry):
       # single-controller table: every shard addressable
       nodes_l = np.asarray(jax.device_get(nodes)).astype(np.int64)
       valid = nodes_l >= 0
-      owner = np.clip(
-          np.searchsorted(g.bounds, nodes_l, side='right') - 1, 0,
-          self.num_parts - 1)
-      local = np.where(valid, nodes_l - g.bounds[owner], 0)
-      cold = valid & (local >= nf.hot_counts[owner])
+      # placement reads through the book's frozen-range rule (ISSUE
+      # 15): the hot/cold split keys on the RANGE — adoption moves the
+      # serving device, never a row's tier
+      _rng, local, cold = hot_split_host(g.bounds, nf.hot_counts,
+                                         nodes_l, valid)
       lookups, cold_n = int(valid.sum()), int(cold.sum())
       miss = cold
       if cache is not None:
@@ -1699,10 +2222,8 @@ def overlay_cold_host(x, nodes, bounds, hot_counts, cold_host, mesh,
   if cold_mask is not None:
     cold = cold_mask
   else:
-    owner = np.clip(np.searchsorted(bounds, nodes_h, side='right') - 1,
-                    0, num_parts - 1)
-    local = np.where(valid, nodes_h - bounds[owner], 0)
-    cold = valid & (local >= hot_counts[owner])
+    _rng, _local, cold = hot_split_host(bounds, hot_counts, nodes_h,
+                                        valid)
   lookups = int(valid.sum())
   n_cold = int(cold.sum())
   if n_cold == 0:
@@ -1795,10 +2316,8 @@ def plan_cold_requests(nodes, bounds, hot_counts, host_parts,
   nodes_l = (nodes_host if nodes_host is not None
              else _local_shards_stacked(nodes, hp)).astype(np.int64)
   valid = nodes_l >= 0
-  owner = np.clip(np.searchsorted(bounds, nodes_l, side='right') - 1,
-                  0, num_parts - 1)
-  local = np.where(valid, nodes_l - bounds[owner], 0)
-  cold = valid & (local >= hot_counts[owner])
+  owner, local, cold = hot_split_host(bounds, hot_counts, nodes_l,
+                                      valid)
   if cache_ids is not None:
     # cache-served rows already carry correct values — skip them
     for j in range(nodes_l.shape[0]):
@@ -1897,7 +2416,8 @@ def overlay_cold_owner(x, nodes, bounds, hot_counts, cold_local, mesh,
 def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
                          axis: str = 'data',
                          exchange_slack: Optional[float] = None,
-                         exchange_layout: Optional[str] = None):
+                         exchange_layout: Optional[str] = None,
+                         book_spec=None):
   """Jitted SPMD uniform random walk over the sharded CSR: each step
   is one `_dist_one_hop` with fanout 1 (a uniform neighbor draw
   through the owner exchange) — the distributed arm of
@@ -1915,7 +2435,8 @@ def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
           jax.random.fold_in(key, h), axis, num_parts, False,
           exchange_capacity=_slack_cap(cur.shape[0], num_parts,
                                        exchange_slack,
-                                       exchange_layout))
+                                       exchange_layout),
+          book_spec=book_spec)
       stats = stats + jnp.stack(hstats)
       cur = jnp.where(mask[:, 0], nbrs[:, 0], INVALID_ID).astype(
           jnp.int32)
@@ -1995,6 +2516,8 @@ class DistSubGraphSampler(DistNeighborSampler):
     reference's ``mapping`` metadata."""
     b = seeds_stacked.shape[1]
     node_cap = self.node_capacity(b)
+    self._partition_supervision()
+    arrs = self._arrays()
     cfg = ('subgraph', b)
     if cfg not in self._steps:
       with self._layout_span(batch=b, mode='subgraph'):
@@ -2005,9 +2528,9 @@ class DistSubGraphSampler(DistNeighborSampler):
             exchange_slack=self.exchange_slack,
             exchange_layout=self.exchange_layout, tiered=self.tiered,
             hop_chunk=resolve_hop_chunk(self.hop_chunk, node_cap,
-                                        self.max_degree))
+                                        self.max_degree),
+            book_spec=self.book_spec)
     from ..telemetry.spans import span
-    arrs = self._arrays()
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
     with span('sample.exchange', step=self._step_cnt,
@@ -2021,6 +2544,7 @@ class DistSubGraphSampler(DistNeighborSampler):
                            arrs['fshards'], arrs['lshards'],
                            arrs['cids'], arrs['crows'],
                            arrs['hcounts'], key)
+    self._complete_recovery()
     self._accumulate_stats(stats)
     x = self._maybe_overlay_cold(x, nodes)
     return dict(node=nodes, node_count=count[..., 0], row=row, col=col,
@@ -2064,13 +2588,15 @@ class DistRandomWalker(DistNeighborSampler):
     """``starts_stacked``: ``[P, B]`` per-device start nodes (relabeled
     space, -1 padded).  Returns ``[P, B, walk_length + 1]``."""
     b = starts_stacked.shape[1]
+    self._partition_supervision()
+    arrs = self._arrays()
     cfg = ('walk', b)
     if cfg not in self._steps:
       with self._layout_span(batch=b, mode='walk'):
         self._steps[cfg] = _make_dist_walk_step(
             self.mesh, self.num_parts, self.walk_length, self.axis,
-            self.exchange_slack, self.exchange_layout)
-    arrs = self._arrays()
+            self.exchange_slack, self.exchange_layout,
+            book_spec=self.book_spec)
     self._step_cnt += 1
     key = jax.random.fold_in(self._base_key, self._step_cnt)
     starts = jax.device_put(
@@ -2078,6 +2604,7 @@ class DistRandomWalker(DistNeighborSampler):
         NamedSharding(self.mesh, P(self.axis)))
     walks, stats = self._steps[cfg](arrs['indptr'], arrs['indices'],
                                     arrs['bounds'], starts, key)
+    self._complete_recovery()
     self._accumulate_stats(stats)
     return walks
 
@@ -2464,7 +2991,7 @@ class DistLinkNeighborSampler(DistNeighborSampler):
             exchange_layout=self.exchange_layout,
             collect_edge_features=self.collect_edge_features,
             ef_shard_mode=self._ef_shard_mode, tiered=self.tiered,
-            gns_boost=self.gns_boost)
+            gns_boost=self.gns_boost, book_spec=self.book_spec)
       if self.gns:
         from ..telemetry.recorder import recorder
         from ..utils.profiling import metrics
@@ -2484,8 +3011,9 @@ class DistLinkNeighborSampler(DistNeighborSampler):
     half)."""
     from ..telemetry.spans import span
     p, b = pairs_stacked.shape[:2]
-    step = self.step_for_pairs(b, pairs_stacked.shape[2])
+    self._partition_supervision()
     arrs = self._arrays()
+    step = self.step_for_pairs(b, pairs_stacked.shape[2])
     self._step_cnt += 1
     if key is None:
       key = jax.random.fold_in(self._base_key, self._step_cnt)
@@ -2505,6 +3033,7 @@ class DistLinkNeighborSampler(DistNeighborSampler):
       ew = outs[11] if self.gns else None
       (eli, elab, elab_mask, src_idx, dst_pos, dst_neg) = \
           outs[12:] if self.gns else outs[11:]
+    self._complete_recovery()
     self._accumulate_stats(stats)
     md = link_step_metadata(self.neg_mode, seed_local, eli, elab,
                             elab_mask, src_idx, dst_pos, dst_neg)

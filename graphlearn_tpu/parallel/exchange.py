@@ -339,6 +339,12 @@ class _DensePlan:
       self.recv_payload = self._sub.recv_payload
     self.kept = self._sub.kept
     self.delivered = self._sub.kept
+    #: source device of each recv row (``recv`` is the flattened
+    #: [P_src, cap] buffer) — the per-requester GNS mask attribution
+    #: (ISSUE 15): owners bias each request by what ITS requester can
+    #: serve locally, not by the union of every device's cache
+    self.requester_of_recv = jnp.repeat(
+        jnp.arange(num_parts, dtype=jnp.int32), self._sub.cap)
     self.stats = (self._sub.offered, self._sub.dropped,
                   jnp.int32(num_parts * self._sub.cap))
 
@@ -419,6 +425,16 @@ class _CompactPlan:
       self.recv = pool_all[:, 0].reshape(-1)      # [P * V]
       if payload is not None:
         self.recv_payload = pool_all[:, 1].reshape(-1)
+
+    # requester attribution (per-requester GNS masks, ISSUE 15): base
+    # recv is the flattened [P_src, cap] buffer; the pool is an
+    # all_gather whose row p holds device p's overflow ids verbatim
+    src = jnp.arange(p, dtype=jnp.int32)
+    if cap > 0:
+      self.requester_of_recv = jnp.concatenate(
+          [jnp.repeat(src, cap), jnp.repeat(src, v)])
+    else:
+      self.requester_of_recv = jnp.repeat(src, v)
 
     # inverse maps back to request order
     inv = lambda x, fill: jnp.full((f,), fill, jnp.int32).at[perm].set(x)

@@ -1,0 +1,320 @@
+"""Elastic partition failover: durable shards + ownership adoption.
+
+ISSUE 15.  The data-plane half of the `PartitionBook` story: each
+partition's CSR + feature shard is durably re-loadable (`ShardStore`,
+atomic tmp→rename publishes — the PR 6 `SnapshotManager` discipline —
+written at load time and refreshed at ingest-compaction seams), and
+when supervision classifies an owner dead (the chaos
+``partition.owner`` site in-process; `PeerLostError` / heartbeat
+misses through the PR 13 overloaded-vs-dead discriminator in the
+server world) a designated survivor **adopts** the orphaned shard:
+
+  1. `adopt_shard` loads the durable shard (missing →
+     `NoDurableShardError`, the caller falls back to the documented
+     ``GLT_DEGRADED_OK`` path), validates it against the dataset's
+     frozen widths, and parks it on ``dataset.adopted_shards``;
+  2. the book version bumps (`PartitionBook.adopt` — double adoption
+     refused typed);
+  3. readers fence at their ``_arrays()`` / ``_chunk_arrs`` seams:
+     the sampler rebuilds its device arrays lane-stacked, exchange
+     plans and capacity specs recompile for the new routing, and the
+     epoch resumes with the **exact-completion contract** — every
+     expected seed served, batches byte-identical to the fault-free
+     run where the schedule is deterministic.
+
+Knobs: ``GLT_SHARD_DIR`` (durable shard directory; unset = no
+failover, degraded semantics unchanged), ``GLT_ADOPT_TIMEOUT_S``
+(budget for the shard load + rebuild; a hung disk surfaces typed
+instead of wedging recovery).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+import numpy as np
+
+from .partition_book import AdoptionRefusedError, PartitionBook
+
+SHARD_DIR_ENV = 'GLT_SHARD_DIR'
+ADOPT_TIMEOUT_ENV = 'GLT_ADOPT_TIMEOUT_S'
+
+#: default adoption budget (seconds): shard load + array rebuild for
+#: one partition — generous for host-DRAM-sized shards, small enough
+#: that a wedged disk fails the adoption instead of the epoch SLO.
+DEFAULT_ADOPT_TIMEOUT_S = 120.0
+
+
+class PartitionLostError(RuntimeError):
+  """A partition owner was classified dead mid-epoch (chaos
+  ``partition.owner`` kill in-process; heartbeat-miss /
+  `PeerLostError` classification in the server world)."""
+
+  def __init__(self, msg: str, partition: Optional[int] = None):
+    super().__init__(msg)
+    self.partition = partition
+
+
+class NoDurableShardError(RuntimeError):
+  """Adoption was requested but the shard store holds no durable copy
+  of the orphaned partition — the documented fallback ladder applies
+  (adopt → rollback → degraded, ``GLT_DEGRADED_OK``)."""
+
+
+def dataset_fingerprint(ds) -> int:
+  """Cheap content fingerprint of a `DistDataset` (strided CRC over
+  the topology + per-partition edge counts + bounds): a regenerated
+  SAME-SHAPED dataset reusing ``GLT_SHARD_DIR`` must not be served
+  another graph's durable shards — shape metadata alone collides.
+  Strided (≤64K sampled indices) so load-time validation stays O(1)
+  in graph size; a collision needs identical shape AND an identical
+  sample, which regeneration does not produce in practice."""
+  import zlib
+  g = ds.graph
+  idx = np.ascontiguousarray(np.asarray(g.indices).ravel())
+  stride = max(1, idx.size // 65536)
+  h = zlib.crc32(np.ascontiguousarray(idx[::stride]).tobytes())
+  h = zlib.crc32(np.ascontiguousarray(
+      np.asarray(g.indptr)[:, -1]).tobytes(), h)
+  h = zlib.crc32(np.ascontiguousarray(
+      np.asarray(g.bounds, np.int64)).tobytes(), h)
+  return int(h)
+
+
+def adopt_timeout_s() -> float:
+  try:
+    return float(os.environ.get(ADOPT_TIMEOUT_ENV,
+                                DEFAULT_ADOPT_TIMEOUT_S))
+  except ValueError:
+    return DEFAULT_ADOPT_TIMEOUT_S
+
+
+def shard_dir_from_env() -> Optional[str]:
+  return os.environ.get(SHARD_DIR_ENV) or None
+
+
+class ShardStore:
+  """Durable per-partition shard snapshots.
+
+  One ``shard{p}.npz`` per partition plus a ``SHARDS.json`` meta
+  (partition count, array widths — the adoption-time validation
+  fingerprint).  Every publish is atomic (tmp → rename, the
+  `SnapshotManager` discipline): a kill mid-write leaves the previous
+  durable shard as the latest, never a torn file.
+  """
+
+  def __init__(self, root):
+    self.root = Path(root)
+    self.root.mkdir(parents=True, exist_ok=True)
+
+  def _shard_path(self, p: int) -> Path:
+    return self.root / f'shard{int(p)}.npz'
+
+  def _meta_path(self) -> Path:
+    return self.root / 'SHARDS.json'
+
+  def _publish(self, path: Path, write_fn) -> None:
+    tmp = path.with_name(path.name + '.tmp')
+    with open(tmp, 'wb') as f:
+      write_fn(f)
+      f.flush()
+      os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+  def save_shard(self, p: int, payload: Dict[str, np.ndarray]) -> None:
+    arrays = {k: np.asarray(v) for k, v in payload.items()
+              if v is not None}
+    self._publish(self._shard_path(p),
+                  lambda f: np.savez(f, **arrays))
+
+  def save_meta(self, meta: Dict) -> None:
+    data = json.dumps(meta, sort_keys=True).encode()
+    self._publish(self._meta_path(), lambda f: f.write(data))
+
+  def meta(self) -> Optional[Dict]:
+    try:
+      with open(self._meta_path()) as f:
+        return json.load(f)
+    except (OSError, ValueError):
+      return None
+
+  def has_shard(self, p: int) -> bool:
+    return self._shard_path(p).exists()
+
+  def load_shard(self, p: int) -> Dict[str, np.ndarray]:
+    path = self._shard_path(p)
+    if not path.exists():
+      raise NoDurableShardError(
+          f'no durable shard for partition {int(p)} under '
+          f'{self.root} — adoption unavailable; the documented '
+          f'fallback is GLT_DEGRADED_OK=1 (reduced completion)')
+    with np.load(path, allow_pickle=False) as z:
+      return {k: z[k] for k in z.files}
+
+  def partitions(self):
+    return sorted(int(f.stem[len('shard'):])
+                  for f in self.root.glob('shard*.npz'))
+
+  # -- dataset integration -------------------------------------------------
+  def write_dataset_shards(self, ds) -> int:
+    """Write one durable shard per partition of a `DistDataset` —
+    called at load time (and re-called at ingest-compaction seams via
+    `refresh_cb`, so a streamed topology's durable copy tracks the
+    compacted base).  Returns the number of shards written."""
+    g = ds.graph
+    p = g.num_partitions
+    nf = ds.node_features
+    bounds = np.asarray(g.bounds, np.int64)
+    for r in range(p):
+      payload = {
+          'indptr': g.indptr[r], 'indices': g.indices[r],
+          'eids': g.edge_ids[r],
+      }
+      if nf is not None:
+        payload['fshard'] = nf.shards[r]
+        payload['hot_count'] = np.asarray([nf.hot_counts[r]], np.int64)
+        if nf.cold_host is not None:
+          payload['cold'] = nf.cold_host[bounds[r]:bounds[r + 1]]
+      if ds.node_labels is not None:
+        payload['lshard'] = np.asarray(ds.node_labels)[r]
+      if ds.edge_features is not None:
+        payload['efshard'] = ds.edge_features.shards[r]
+      self.save_shard(r, payload)
+    self.save_meta({
+        'num_parts': int(p),
+        'num_nodes': int(g.num_nodes),
+        'node_width': int(g.indptr.shape[1]),
+        'edge_width': int(g.indices.shape[1]),
+        'fingerprint': dataset_fingerprint(ds),
+    })
+    return p
+
+  def refresh_cb(self, ds):
+    """Compaction-seam refresh hook for `streaming.IngestPipeline`
+    (``shard_refresh=store.refresh_cb(ds)``): after each durable base
+    compaction the shard snapshots are rewritten from the dataset's
+    CURRENT stacks, so an adoption after a long ingest run loads the
+    streamed topology, not the load-time one."""
+    def _refresh() -> None:
+      self.write_dataset_shards(ds)
+    return _refresh
+
+
+def _load_with_deadline(store: 'ShardStore', lost: int,
+                        timeout_s: float) -> Dict[str, np.ndarray]:
+  """`load_shard` in a worker thread under the adoption budget: a
+  WEDGED store (hung NFS read) fails the adoption typed instead of
+  wedging recovery — the stuck daemon thread is abandoned and the
+  caller proceeds down the fallback ladder."""
+  import threading
+  box: Dict = {}
+
+  def _run():
+    try:
+      box['payload'] = store.load_shard(lost)
+    except BaseException as e:        # noqa: BLE001 — re-raised below
+      box['err'] = e
+
+  t = threading.Thread(target=_run, daemon=True,
+                       name=f'glt-adopt-load-p{int(lost)}')
+  t.start()
+  t.join(max(timeout_s, 0.001))
+  if t.is_alive():
+    raise AdoptionRefusedError(
+        f'adoption of partition {int(lost)} exceeded '
+        f'GLT_ADOPT_TIMEOUT_S={adopt_timeout_s():g}s loading the '
+        f'durable shard (wedged store?)')
+  if 'err' in box:
+    raise box['err']
+  return box['payload']
+
+
+def _pad_to(arr: np.ndarray, width: int, fill) -> np.ndarray:
+  """Widen a loaded shard row to the dataset's current stack width
+  (streaming `reserve_edges` may have grown the stacks after the
+  durable copy was written)."""
+  if arr.shape[0] >= width:
+    return arr[:width] if arr.shape[0] > width else arr
+  out = np.full((width,) + arr.shape[1:], fill, arr.dtype)
+  out[:arr.shape[0]] = arr
+  return out
+
+
+def adopt_shard(ds, store: Optional[ShardStore], lost: int,
+                survivor: Optional[int] = None) -> Dict:
+  """Execute one ownership transfer: load the durable shard, validate,
+  park it on the dataset, bump the book.  Returns an info dict
+  (``survivor``, ``version``, ``load_secs``).  Raises
+  `NoDurableShardError` (no durable copy — fall back to degraded) or
+  `AdoptionRefusedError` (double adoption / no survivor) without
+  mutating anything."""
+  from ..telemetry.live import live
+  from ..telemetry.recorder import recorder
+  if store is None:
+    d = shard_dir_from_env()
+    if d is None:
+      raise NoDurableShardError(
+          'no shard store configured (GLT_SHARD_DIR unset) — '
+          'adoption unavailable; GLT_DEGRADED_OK=1 is the documented '
+          'fallback')
+    store = ShardStore(d)
+  book: PartitionBook = ds.partition_book
+  lost = int(lost)
+  t0 = time.monotonic()
+  deadline = t0 + adopt_timeout_s()
+  if survivor is None:
+    survivor = book.pick_survivor(lost)
+  payload = _load_with_deadline(store, lost,
+                                deadline - time.monotonic())
+  meta = store.meta() or {}
+  if meta.get('num_parts') not in (None, book.num_partitions):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} was written for "
+        f"{meta.get('num_parts')} partitions, this dataset has "
+        f'{book.num_partitions}')
+  g = ds.graph
+  # the durable copy must be THIS graph's: num_parts can collide
+  # across graphs, so the frozen shape fingerprint is checked too —
+  # a mismatched store adopted silently would serve another graph's
+  # topology/features for the orphaned range
+  if meta.get('num_nodes') not in (None, int(g.num_nodes)):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} was written for "
+        f"{meta.get('num_nodes')} nodes, this dataset has "
+        f'{int(g.num_nodes)}')
+  if meta.get('node_width') not in (None, int(g.indptr.shape[1])):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} node width "
+        f"{meta.get('node_width')} != dataset {int(g.indptr.shape[1])}"
+        f' (different bounds — not this graph)')
+  if int(meta.get('edge_width') or 0) > int(g.indices.shape[1]):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} edge width "
+        f"{meta.get('edge_width')} exceeds the dataset's "
+        f'{int(g.indices.shape[1])} — truncation would corrupt the '
+        f'adopted CSR')
+  payload['indptr'] = _pad_to(
+      np.asarray(payload['indptr']), g.indptr.shape[1],
+      int(np.asarray(payload['indptr'])[-1]))
+  payload['indices'] = _pad_to(np.asarray(payload['indices']),
+                               g.indices.shape[1], -1)
+  payload['eids'] = _pad_to(np.asarray(payload['eids']),
+                            g.edge_ids.shape[1], -1)
+  if time.monotonic() > deadline:
+    raise AdoptionRefusedError(
+        f'adoption of partition {lost} exceeded GLT_ADOPT_TIMEOUT_S='
+        f'{adopt_timeout_s():g}s while loading the durable shard')
+  if not hasattr(ds, 'adopted_shards'):
+    ds.adopted_shards = {}
+  view = book.adopt(lost, int(survivor))  # typed refusals raise here
+  ds.adopted_shards[lost] = payload
+  secs = time.monotonic() - t0
+  live.counter('partition.adoptions_total').inc()
+  recorder.emit('partition.adopt', partition=lost,
+                survivor=int(survivor), version=view.version,
+                secs=round(secs, 6))
+  return {'survivor': int(survivor), 'version': view.version,
+          'load_secs': secs}

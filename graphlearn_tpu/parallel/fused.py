@@ -50,8 +50,9 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..loader.fused import (_SnapshotHooks, _uncached_jit,
-                            driver_compile_count, resolve_cold_chunk)
+from ..loader.fused import (_COMPILED_ATTRS, _SnapshotHooks,
+                            _uncached_jit, driver_compile_count,
+                            resolve_cold_chunk)
 from ..models.train import TrainState
 from .dist_data import DistDataset
 from .dist_sampler import (DistLinkNeighborSampler, DistNeighborSampler,
@@ -92,11 +93,43 @@ class _MeshEpochDriver(_SnapshotHooks):
     re-pins the newest published ``graph_version`` at each chunk
     boundary (`DistNeighborSampler.maybe_refresh_stream`), so a
     whole chunk's scan samples exactly one graph version and the
-    GNS bitmask is invalidated with the graph it derives from."""
+    GNS bitmask is invalidated with the graph it derives from.
+
+    Partition failover (ISSUE 15) fences here too: owner supervision
+    runs before the dispatch, and a book-version bump (adoption)
+    rebuilds the lane-stacked arrays inside `_arrays()` and
+    re-resolves the driver's captured dist step — the changed array
+    shapes retrace the compiled scan against the new routing."""
+    # the previous chunk's dispatch has been consumed by the time the
+    # NEXT chunk asks for arrays — close a pending adoption's recovery
+    # clock at this boundary (an adoption in the final chunk closes at
+    # the next epoch's first boundary)
+    self.sampler._complete_recovery()
+    self.sampler._partition_supervision()
     arrs = self.sampler._arrays()
+    ver = self.sampler._book_ver
+    if getattr(self, '_driver_book_ver', 0) != ver:
+      self._driver_book_ver = ver
+      if hasattr(self, '_dist_step'):
+        self._dist_step = self._resolve_dist_step()
+      # the outer scan programs bake `book_spec` as a trace-time
+      # closure constant, and jax.jit keys executables on avals only:
+      # a bump that keeps every aval unchanged (a SECOND adoption at
+      # the same lane count) would hit the stale in-memory executable
+      # and route through the old owners — drop the program caches so
+      # the next dispatch retraces against the new routing
+      for name in _COMPILED_ATTRS:
+        jitted = getattr(getattr(self, name, None), 'jitted', None)
+        if jitted is not None and hasattr(jitted, 'clear_cache'):
+          jitted.clear_cache()
     if getattr(self.sampler, 'gns', False):
       arrs = dict(arrs, gns=self.sampler._gns_arrays())
     return arrs
+
+  def _resolve_dist_step(self):
+    """Re-resolve the captured SPMD step after a book bump (the link
+    driver overrides with its pair-step resolver)."""
+    return self.sampler.step_for_batch(self.batch_size)
 
   # -- snapshot hooks (mesh-shaped overrides of _SnapshotHooks) -----------
   def data_plane_state(self) -> dict:
@@ -786,6 +819,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     levels, frontier = [seeds], seeds
     w_levels = [jnp.ones(seeds.shape, jnp.float32)]
     fstats = jnp.zeros((3,), jnp.int32)
+    book_spec = self.sampler.book_spec   # trace-time routing constant
     for h, k in enumerate(self.fanouts):
       nbrs, mask, _, hw, st = _dist_one_hop(
           indptr_s, indices_s, None, bounds, frontier, int(k),
@@ -793,7 +827,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
           False, sort_locality=False,
           exchange_capacity=_slack_cap(frontier.shape[0],
                                        self.num_parts, slack, layout),
-          gns_bits=gns_bits, gns_boost=boost)
+          gns_bits=gns_bits, gns_boost=boost, book_spec=book_spec)
       fstats = fstats + jnp.stack(st)
       nxt = jnp.where(mask, nbrs, -1).reshape(-1)
       levels.append(nxt)
@@ -806,7 +840,7 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         self.num_parts,
         exchange_capacity=_slack_cap(all_ids.shape[0], self.num_parts,
                                      slack, layout),
-        hot_counts=hcounts)
+        hot_counts=hcounts, book_spec=book_spec)
     stats7 = jnp.concatenate(
         [fstats, jnp.stack(gst), jnp.zeros((1,), jnp.int32)])
     hop_counts = jnp.stack(
@@ -1120,6 +1154,8 @@ class FusedDistLinkEpoch(_MeshEpochDriver):
     self._dp_step = make_dp_unsupervised_step(step_apply, tx, self.mesh,
                                               axis)
     self._dist_step = self.sampler.step_for_pairs(
+        self.batch_size, self.pairs.shape[1])
+    self._resolve_dist_step = lambda: self.sampler.step_for_pairs(
         self.batch_size, self.pairs.shape[1])
     self._apply = apply_fn            # un-remat'd: evaluate() is fwd-only
     self._compiled = _uncached_jit(       # see FusedDistEpoch note

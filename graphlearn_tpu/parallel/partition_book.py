@@ -1,0 +1,345 @@
+"""Versioned, transferable partition ownership — THE routing authority.
+
+ISSUE 15 (ROADMAP item 4's named refactor unlock): until now four
+independent conventions answered "who owns id ``g``?" — the inline
+``searchsorted(bounds, g)`` lambdas of the hop exchanges, the
+``eid % P`` rule of the mod-sharded edge-feature tables, the cold
+overlay's host-side owner recompute, and the GNS bitmask's implicit
+"owner == device" assumption.  All four were frozen at load time, so
+a dead partition owner could only mean reduced data (degraded
+completion) or a rollback — the orphaned shard's nodes vanished from
+the epoch.
+
+`PartitionBook` makes ownership a first-class, monotone-versioned,
+RCU-published mapping:
+
+  * **ranges stay frozen** — the contiguous relabel (``bounds``) is
+    the id space every feature shard, seed split and hot/cold
+    placement was built against, and never moves;
+  * **owners move** — ``owners[r]`` names the mesh position serving
+    range ``r``.  At version 0 the book is the identity
+    (``owners[r] == r``) and every consumer compiles EXACTLY the
+    pre-book program (the fault-free byte-identity contract);
+  * **adoption** (`adopt`) reassigns an orphaned range to a survivor,
+    bumps the version, and publishes a new immutable `BookView`.
+    Readers pin one view per dispatch (the same RCU discipline as the
+    streaming `GraphView`, ISSUE 14) and fence at their existing
+    ``_arrays()`` / ``_chunk_arrs`` seams — a bump mid-dispatch never
+    tears a compiled program.
+
+The four consumers all read ownership through this module:
+hop routing (`range_owner_fn` / `book_owner_fn` + the lane plan in
+`dist_sampler._BookPlan`), feature hot/cold placement
+(`hot_split_host`), cold-cache admission (the overlay planners feed
+admission from the same split), and the GNS cached-set bitmask
+(`ops.gns.per_requester_bits` builds one mask row per requesting
+device from the same placement).  The mod-sharded
+edge-feature rule lives here too (`edge_owner_*` / `edge_local_*`) so
+no ``% P`` routing convention remains outside this module — enforced
+by a grep test in ``tests/test_partition_failover.py``.
+
+**Lanes.**  After adoption one device serves several ranges.  The
+compiled SPMD steps route by *(device, lane)*: range ``r`` maps to
+virtual destination ``owners[r] * num_lanes + lane_of_range[r]``, and
+each device's local arrays grow a leading lane axis holding one
+shard per lane.  Because requests are bucketed per RANGE (capacity,
+positions and the sampling key all keyed by the range, not the
+device), a lane's receive buffer is bit-identical to what the range's
+original owner would have received — which is what makes an adopted
+epoch's batches byte-identical to the fault-free run.  The identity
+book has one lane and compiles the original program.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class AdoptionRefusedError(RuntimeError):
+  """A partition-adoption request that must not proceed: the range is
+  already adopted (double adoption would fork the routing authority),
+  the survivor is itself dead, or the survivor already carries an
+  adopted lane (v1 supports one adopted shard per survivor)."""
+
+
+class BookView(NamedTuple):
+  """One immutable published snapshot of the book (RCU: readers pin a
+  view per dispatch; `PartitionBook.adopt` publishes a new one)."""
+  version: int
+  bounds: np.ndarray          # [P+1] frozen ownership ranges
+  owners: np.ndarray          # [P] mesh position serving each range
+  lane_of_range: np.ndarray   # [P] lane index of each range at owner
+  slot_ranges: np.ndarray     # [P, S] range served by (device, lane),
+                              # -1 = unassigned
+  num_lanes: int
+
+  @property
+  def num_partitions(self) -> int:
+    return len(self.bounds) - 1
+
+  @property
+  def is_identity(self) -> bool:
+    return self.version == 0
+
+  def spec(self) -> Optional['BookSpec']:
+    """Hashable static form for compile keys — None when identity, so
+    the identity book's steps compile exactly the pre-book program."""
+    if self.is_identity:
+      return None
+    return BookSpec(
+        version=int(self.version),
+        num_parts=self.num_partitions,
+        num_lanes=int(self.num_lanes),
+        owners=tuple(int(o) for o in self.owners),
+        lane_of_range=tuple(int(x) for x in self.lane_of_range),
+        slot_ranges=tuple(tuple(int(x) for x in row)
+                          for row in self.slot_ranges))
+
+
+class BookSpec(NamedTuple):
+  """Static (trace-time constant) routing tables baked into compiled
+  steps — part of the step-cache key, so a version bump recompiles
+  the exchange plans for the new routing."""
+  version: int
+  num_parts: int
+  num_lanes: int
+  owners: Tuple[int, ...]
+  lane_of_range: Tuple[int, ...]
+  slot_ranges: Tuple[Tuple[int, ...], ...]
+
+
+class PartitionBook:
+  """Monotone-versioned node→owner table over frozen contiguous
+  ranges (`dist_data.relabel_by_partition`).
+
+  Thread-safe: mutations happen under ``_lock`` and publish a fresh
+  immutable `BookView`; readers call `view()` (one atomic attribute
+  read) and never observe a torn table.
+  """
+
+  def __init__(self, bounds: np.ndarray):
+    bounds = np.asarray(bounds, np.int64)
+    assert bounds.ndim == 1 and len(bounds) >= 2
+    p = len(bounds) - 1
+    self._lock = threading.Lock()
+    #: the version table — guarded-by: self._lock
+    self._version = 0
+    #: range -> serving mesh position — guarded-by: self._lock
+    self._owners = np.arange(p, dtype=np.int32)
+    #: the adoption ledger (one record per ownership transfer) —
+    #: guarded-by: self._lock
+    self._adoptions: List[Dict] = []
+    self._bounds = bounds
+    self._published = self._build_view_locked()
+
+  # -- publication ---------------------------------------------------------
+  def _build_view_locked(self) -> BookView:
+    """Assemble the immutable view from the guarded tables (call with
+    ``_lock`` held — or from ``__init__`` before the book escapes)."""
+    p = len(self._bounds) - 1
+    per_dev: List[List[int]] = [[] for _ in range(p)]
+    lane = np.zeros(p, np.int32)
+    # own range first (lane 0 == the device's own shard, so every
+    # non-survivor keeps exactly its identity layout), adopted ranges
+    # in range order after it
+    for r in range(p):
+      if int(self._owners[r]) == r:
+        lane[r] = len(per_dev[r])
+        per_dev[r].append(r)
+    for r in range(p):
+      o = int(self._owners[r])
+      if o != r:
+        lane[r] = len(per_dev[o])
+        per_dev[o].append(r)
+    s = max((len(d) for d in per_dev), default=1) or 1
+    slots = np.full((p, s), -1, np.int32)
+    for d in range(p):
+      for j, r in enumerate(per_dev[d]):
+        slots[d, j] = r
+    return BookView(version=self._version, bounds=self._bounds,
+                    owners=self._owners.copy(), lane_of_range=lane,
+                    slot_ranges=slots, num_lanes=s)
+
+  def view(self) -> BookView:
+    """Pin the current published view (lock-free read)."""
+    return self._published
+
+  @property
+  def version(self) -> int:
+    return self._published.version
+
+  @property
+  def bounds(self) -> np.ndarray:
+    return self._bounds
+
+  @property
+  def num_partitions(self) -> int:
+    return len(self._bounds) - 1
+
+  def adoptions(self) -> List[Dict]:
+    with self._lock:
+      return [dict(a) for a in self._adoptions]
+
+  # -- ownership transfer --------------------------------------------------
+  def adopt(self, lost: int, survivor: int) -> BookView:
+    """Transfer range ``lost`` to mesh position ``survivor``; bump the
+    version and publish.  Typed refusals (`AdoptionRefusedError`)
+    never mutate the book."""
+    p = self.num_partitions
+    lost, survivor = int(lost), int(survivor)
+    if not 0 <= lost < p or not 0 <= survivor < p:
+      raise AdoptionRefusedError(
+          f'partition out of range: lost={lost} survivor={survivor} '
+          f'(P={p})')
+    if lost == survivor:
+      raise AdoptionRefusedError(
+          f'partition {lost} cannot adopt itself')
+    with self._lock:
+      if int(self._owners[lost]) != lost:
+        raise AdoptionRefusedError(
+            f'partition {lost} is already adopted (owner '
+            f'{int(self._owners[lost])}, version {self._version}) — '
+            'a second adoption would fork the routing authority')
+      if int(self._owners[survivor]) != survivor:
+        raise AdoptionRefusedError(
+            f'survivor {survivor} is itself dead (owned by '
+            f'{int(self._owners[survivor])})')
+      if int(np.sum(self._owners == survivor)) > 1:
+        raise AdoptionRefusedError(
+            f'survivor {survivor} already carries an adopted shard '
+            '(one adopted lane per survivor in v1) — pick another')
+      self._owners[lost] = survivor
+      self._version += 1
+      self._adoptions.append({'lost': lost, 'survivor': survivor,
+                              'version': self._version})
+      self._published = self._build_view_locked()
+      view = self._published
+    from ..telemetry.live import live
+    from ..telemetry.recorder import recorder
+    live.gauge('partition.book_version').set(float(view.version))
+    recorder.emit('partition.book_version', version=view.version,
+                  lost=lost, survivor=survivor,
+                  num_lanes=view.num_lanes)
+    return view
+
+  def live_partitions(self) -> np.ndarray:
+    """Mesh positions still serving their own range (adoption-eligible
+    survivors)."""
+    v = self.view()
+    p = v.num_partitions
+    own = np.asarray([int(v.owners[r]) == r for r in range(p)])
+    return np.nonzero(own)[0]
+
+  def pick_survivor(self, lost: int) -> int:
+    """Deterministic survivor choice: the lowest-indexed live device
+    serving only its own shard (fewest lanes first, then index)."""
+    v = self.view()
+    counts = np.bincount(np.asarray(v.owners),
+                         minlength=v.num_partitions)
+    for d in sorted(range(v.num_partitions),
+                    key=lambda d: (int(counts[d]), d)):
+      if d == int(lost):
+        continue
+      if int(v.owners[d]) == d and int(counts[d]) == 1:
+        return d
+    raise AdoptionRefusedError(
+        f'no eligible survivor for partition {lost}: every live '
+        'device already carries an adopted shard')
+
+
+# -- ownership arithmetic (device + host forms) -----------------------------
+#
+# These small functions are the ONLY place the two ownership rules
+# (range searchsorted, mod-strided edge ids) are written down; every
+# routing site in parallel/ calls through them.
+
+def range_of(bounds, ids):
+  """Device form: id -> frozen range index (``searchsorted`` rule)."""
+  import jax.numpy as jnp
+  return (jnp.searchsorted(bounds, ids, side='right') - 1).astype(
+      jnp.int32)
+
+
+def range_of_host(bounds, ids, num_parts: Optional[int] = None):
+  """Host form of `range_of`, clipped to valid ranges."""
+  p = (int(num_parts) if num_parts is not None else len(bounds) - 1)
+  return np.clip(
+      np.searchsorted(bounds, np.asarray(ids), side='right') - 1,
+      0, p - 1).astype(np.int32)
+
+
+def range_owner_fn(bounds):
+  """The identity-book owner function of the hop/gather exchanges —
+  owner == range.  Byte-identical to the pre-book inline lambdas."""
+  def owner_fn(v):
+    return range_of(bounds, v)
+  return owner_fn
+
+
+def book_owner_fn(bounds, spec: BookSpec):
+  """Adopted-book VIRTUAL owner function: range ``r`` routes to
+  destination-lane ``owners[r] * S + lane_of_range[r]``."""
+  import jax.numpy as jnp
+  owners = jnp.asarray(spec.owners, jnp.int32)
+  lanes = jnp.asarray(spec.lane_of_range, jnp.int32)
+  s = int(spec.num_lanes)
+
+  def owner_fn(v):
+    r = jnp.clip(range_of(bounds, v), 0, spec.num_parts - 1)
+    return owners[r] * s + lanes[r]
+  return owner_fn
+
+
+def edge_owner_fn(num_parts: int):
+  """Device owner function of MOD-sharded (strided) edge-feature
+  tables: owner = ``eid mod P`` (`build_dist_edge_feature`)."""
+  import jax.numpy as jnp
+
+  def owner_fn(v):
+    return (v % num_parts).astype(jnp.int32)
+  return owner_fn
+
+
+def edge_book_owner_fn(num_parts: int, spec: BookSpec):
+  """Adopted-book virtual owner function for mod-sharded tables."""
+  import jax.numpy as jnp
+  owners = jnp.asarray(spec.owners, jnp.int32)
+  lanes = jnp.asarray(spec.lane_of_range, jnp.int32)
+  s = int(spec.num_lanes)
+
+  def owner_fn(v):
+    r = (v % num_parts).astype(jnp.int32)
+    return owners[r] * s + lanes[r]
+  return owner_fn
+
+
+def edge_local_rows(ids, num_parts: int):
+  """Device local-row rule of mod-sharded tables (eid -> shard row)."""
+  return ids // num_parts
+
+
+def edge_owner_host(ids, num_parts: int) -> np.ndarray:
+  return (np.asarray(ids) % int(num_parts)).astype(np.int32)
+
+
+def edge_local_rows_host(ids, num_parts: int) -> np.ndarray:
+  return np.asarray(ids) // int(num_parts)
+
+
+def hot_split_host(bounds, hot_counts, ids, valid=None):
+  """THE host-side hot/cold placement read (feature store + cold-cache
+  admission): returns ``(rng, local, cold)`` where ``rng`` is the
+  frozen range of each id, ``local`` its row within the range, and
+  ``cold`` marks rows past the range's hot count (host-tier service).
+  Placement keys on the RANGE, never the serving device — adoption
+  moves the server, not the split."""
+  ids = np.asarray(ids)
+  if valid is None:
+    valid = ids >= 0
+  hot_counts = np.asarray(hot_counts)
+  rng = range_of_host(bounds, ids, num_parts=len(hot_counts))
+  local = np.where(valid, ids - np.asarray(bounds)[rng], 0)
+  cold = valid & (local >= hot_counts[rng])
+  return rng, local, cold

@@ -119,10 +119,10 @@ def rdma_gather(shard_loc, bounds, ids, axis: str, num_parts: int,
     # movement (hardware semaphores count bytes, matching the
     # symmetric waits natively)
     interpret = pltpu.InterpretParams(dma_execution_mode='eager')
+  from .partition_book import range_of
   my_idx = jax.lax.axis_index(axis)
   my_start = bounds[my_idx]
-  owner = (jnp.searchsorted(bounds, ids, side='right') - 1).astype(
-      jnp.int32)
+  owner = range_of(bounds, ids)
   send, slot_p, slot_j = bucket_by_owner(
       ids, owner, num_parts, my_idx,
       _dense_request_cap(exchange_capacity, num_parts))

@@ -85,7 +85,8 @@ class IngestPipeline:
                wal_dir: Optional[str] = None,
                compact_every: Optional[int] = None,
                max_lag: Optional[int] = None,
-               recover: bool = True):
+               recover: bool = True,
+               shard_refresh=None):
     from ..utils.checkpoint import SnapshotManager
     wal_dir = wal_dir or wal_dir_from_env()
     if wal_dir is None:
@@ -97,6 +98,13 @@ class IngestPipeline:
                           else compact_every_from_env())
     self.max_lag = (int(max_lag) if max_lag is not None
                     else max_lag_from_env())
+    #: compaction-seam hook (ISSUE 15): called after each durable
+    #: base compaction so the failover `ShardStore`'s per-partition
+    #: snapshots track the compacted topology — an adoption after a
+    #: long ingest run loads the STREAMED graph, not the load-time
+    #: one (`failover.ShardStore.refresh_cb`).  Failures are absorbed
+    #: like a failed snapshot write: the previous durable shards win.
+    self._shard_refresh = shard_refresh
     self._snap = SnapshotManager(
         os.path.join(str(wal_dir), 'base'), every=1)
     # one writer at a time: ingest/compact/recover hold this across
@@ -264,6 +272,14 @@ class IngestPipeline:
         self._applies_since_compact = 0
         if ok:
           self._compactions += 1
+      if ok and self._shard_refresh is not None:
+        # refresh the durable failover shards at the compaction seam
+        # (still under the writer lock: the shards must snapshot the
+        # exact compacted state, not a concurrently advancing one)
+        try:
+          self._shard_refresh()
+        except Exception as e:            # noqa: BLE001 — absorbed
+          self._record_fault('shard_refresh', e)
     if ok:
       self._compact_ctr.inc()
     recorder.emit('ingest.compact', ok=bool(ok),

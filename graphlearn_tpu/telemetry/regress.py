@@ -105,6 +105,13 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # bench_ingest's nonzero exit, stamped into ingest_pin)
     ('dist.ingest.events_per_sec', 'higher'),
     ('dist.ingest.p99_during_ingest_ms', 'lower'),
+    # elastic-failover guard (ISSUE 15): classification -> first
+    # served batch must stay fast after a mid-epoch owner kill, and
+    # the epoch must stay EXACTLY complete (1.0 — the hard
+    # byte-identity/one-adoption gate is the worker's nonzero exit,
+    # stamped into failover_pin)
+    ('dist.failover.recovery_secs', 'lower'),
+    ('dist.failover.completed_ratio', 'higher'),
 )
 
 

@@ -181,10 +181,18 @@ EVENT_KINDS: Dict[str, str] = {
         'False is an ABSORBED snapshot-write failure (the WAL keeps '
         'the full history; nothing lost)',
     'ingest.fault':
-        'streaming.ingest.IngestPipeline: site (apply|compact), '
+        'streaming.ingest.IngestPipeline: site (apply|compact|'
+        'shard_refresh), '
         'error — an ingestion fault surfaced typed (and dumped a '
         'post-mortem bundle) instead of leaving a half-applied '
         'graph; the WAL replay makes the restart exactly-once',
+    'partition.adopt':
+        'failover.adopt_shard + the reader recovery seams: '
+        'partition, survivor, version, secs (phase=recovered rows '
+        'carry the classification→served-batch recovery clock)',
+    'partition.book_version':
+        'PartitionBook.adopt: version, lost, survivor, num_lanes — '
+        'one per ownership transfer, the routing authority moving',
 }
 
 
@@ -408,6 +416,18 @@ METRIC_NAMES: Dict[str, str] = {
         'gauge: the streaming graph\'s current published version — '
         'every reader dispatch pins exactly one of these; the value '
         'moving is ingest reaching the data plane',
+    'partition.adoptions_total':
+        'counter: partition-ownership transfers executed '
+        '(failover.adopt_shard: durable shard loaded, book version '
+        'bumped, survivor serving the orphaned range)',
+    'partition.book_version':
+        'gauge: the PartitionBook\'s current published version (0 = '
+        'identity ownership; each adoption bumps it and every '
+        'reader re-fences at its next dispatch seam)',
+    'partition.recovery_secs':
+        'gauge: classification→first-served-batch wall time of the '
+        'most recent partition adoption (shard load + lane rebuild '
+        '+ exchange-plan recompile)',
 }
 
 

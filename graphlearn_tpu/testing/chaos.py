@@ -145,7 +145,7 @@ WORKER_KILL_EXIT = 173
 _SITES = ('rpc.request', 'producer.worker', 'checkpoint.io',
           'fused.dispatch', 'feature.cold_service', 'serving.request',
           'ops.scrape', 'serving.replica', 'aot.cache', 'ingest.wal',
-          'ingest.apply', 'ingest.compact')
+          'ingest.apply', 'ingest.compact', 'partition.owner')
 _ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate',
             'flap')
 
@@ -175,6 +175,10 @@ class Fault:
   worker: Optional[int] = None    # producer.worker: rank filter
   epoch: Optional[int] = None     # producer.worker: epoch filter
   replica: Optional[str] = None   # serving.replica: replica-name filter
+  #: partition.owner: the VICTIM partition (a kill here classifies
+  #: that owner dead at the next dispatch seam); also filters when the
+  #: seam names one
+  partition: Optional[int] = None
   #: producer.worker: restart-generation filter — ``0`` targets only
   #: the ORIGINAL worker incarnation, so a deterministic kill cannot
   #: re-fire inside the supervisor's replacement (whose fresh process
@@ -202,6 +206,9 @@ class Fault:
         ctx.get('generation') != self.generation:
       return False
     if self.replica is not None and ctx.get('replica') != self.replica:
+      return False
+    if (self.partition is not None and 'partition' in ctx
+        and ctx.get('partition') != self.partition):
       return False
     return True
 
@@ -282,7 +289,8 @@ def _parse_compact(part: str) -> Fault:
     if '=' not in tok:
       raise ValueError(f'bad compact fault field {tok!r} in {part!r}')
     k, v = tok.split('=', 1)
-    if k in ('nth', 'count', 'worker', 'epoch', 'generation'):
+    if k in ('nth', 'count', 'worker', 'epoch', 'generation',
+             'partition'):
       kw[k] = int(v)
     elif k == 'secs':
       kw[k] = float(v)
@@ -426,6 +434,26 @@ def ops_scrape_check(path: str = '') -> None:
       time.sleep(f.secs)
     elif f.action == 'drop':
       raise InjectedFault(f'injected ops scrape drop (path {path!r})')
+
+
+def partition_owner_check(step: int = 0) -> None:
+  """Partition-owner seam (ISSUE 15), one arrival per mesh dispatch
+  (called BEFORE the sampler's key stream advances, so a recovered
+  dispatch replays byte-identically).  ``delay`` models a slow-but-
+  alive owner (sleeps in place — the epoch slows, nothing is
+  reclassified: the PR 13 overloaded-vs-dead discriminator); ``kill``
+  classifies the fault's ``partition`` dead and raises the typed
+  `PartitionLostError` the recovery ladder consumes (adopt →
+  degraded → typed)."""
+  fired = on('partition.owner', step=step)
+  maybe_delay(fired)
+  for f in fired:
+    if f.action == 'kill':
+      from ..parallel.failover import PartitionLostError
+      p = int(f.partition or 0)
+      raise PartitionLostError(
+          f'injected partition.owner kill: partition {p} classified '
+          f'dead at dispatch step {step}', partition=p)
 
 
 def replica_faults(replica: str, op: str) -> List[Fault]:

@@ -364,3 +364,71 @@ def test_remote_hetero_degraded_drops_dead_server(monkeypatch):
     for p in procs:
       p.join(timeout=60)
       assert not p.is_alive()
+
+
+def test_remote_hetero_adoption_exact_completion(monkeypatch,
+                                                 tmp_path):
+  """ISSUE 15 hetero parity: the SAME dead-server classification now
+  routes through the adoption path — with ``GLT_SHARD_DIR`` set (the
+  failover opt-in) the dead server's producer is recreated on the
+  survivor and the epoch finishes with the FULL expected batch set
+  (every seed exactly once — not the reduced degraded contract), one
+  ``partition.adopt`` event, ``partition.adoptions_total == 1``."""
+  from graphlearn_tpu.distributed.dist_loader import DistLoader
+  from graphlearn_tpu.telemetry import recorder
+  monkeypatch.setenv('GLT_SHARD_DIR', str(tmp_path / 'shards'))
+  # degraded stays OFF: adoption must carry the epoch alone
+  monkeypatch.delenv('GLT_DEGRADED_OK', raising=False)
+  monkeypatch.setattr(DistLoader, 'RECV_POLL_SECS', 1.0)
+  recorder.enable(None)
+  recorder.clear()
+  ctx = mp.get_context('spawn')
+  procs, ports = [], []
+  for rank in range(2):
+    q = ctx.Queue()
+    plan = ('producer.worker:kill:2:worker=0:epoch=0'
+            if rank == 1 else '')
+    p = ctx.Process(target=_degraded_server_proc,
+                    args=(q, rank, plan), daemon=False)
+    p.start()
+    procs.append(p)
+    ports.append(q.get(timeout=120))
+
+  from graphlearn_tpu.distributed import (
+      DistNeighborLoader, RemoteDistSamplingWorkerOptions, init_client,
+      shutdown_client)
+  init_client([('127.0.0.1', pt) for pt in ports], rank=0,
+              num_clients=1)
+  _, edge_set, _, _ = _bipartite()
+  loader = DistNeighborLoader(
+      None, {ET: [2, 2], REV: [2, 2]}, ('u', np.arange(NU)),
+      batch_size=8, shuffle=False,
+      worker_options=RemoteDistSamplingWorkerOptions(
+          server_rank=[0, 1], num_workers=1, prefetch_size=1),
+      to_device=False)
+  try:
+    batches = []
+    for batch in loader:
+      _check_batch(batch, edge_set)
+      batches.append(batch)
+    adopts = [e for e in recorder.events('partition.adopt')]
+    assert len(adopts) == 1, adopts
+    assert adopts[0]['scope'] == 'server'
+    # EXACT completion: the full seed set, every seed exactly once
+    seeds = np.concatenate(
+        [np.asarray(b.batch_dict['u']) for b in batches])
+    seeds = seeds[seeds >= 0]
+    assert len(seeds) == NU, f'{len(seeds)} != {NU} (reduced?)'
+    assert len(set(seeds.tolist())) == NU
+    assert len(batches) == loader._expected
+    # no degraded write-off happened
+    assert not [e for e in recorder.events('peer.lost')
+                if e.get('degraded')]
+  finally:
+    loader.shutdown()
+    shutdown_client()
+    recorder.clear()
+    recorder.disable()
+    for p in procs:
+      p.join(timeout=60)
+      assert not p.is_alive()

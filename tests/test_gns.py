@@ -434,3 +434,63 @@ def test_serving_cold_dedup_pays_unique_ids_only():
   # 8 riders x tree width would be 8 * (1 + 3 + 6) = 80 lookups; the
   # deduped run pays the distinct ids of TWO trees (plus pow2 pad)
   assert dedup_lookups < 8 * eng.tree_width / 2, dedup_lookups
+
+
+# -- per-requester masks (ISSUE 15: the PR 10 known-limit fix) --------------
+
+def test_per_requester_mask_no_remote_boost():
+  """A row resident ONLY on another device's cache ring gets no boost
+  locally: the kernel judged by requester 1's mask must not favor a
+  node only requester 0 caches — while requester 0's draws do."""
+  from graphlearn_tpu.ops.gns import per_requester_bits
+  n = 64
+  # one seed with a wide neighborhood, far above fanout
+  deg = 32
+  indptr = jnp.asarray(np.asarray([0, deg], np.int64))
+  nbrs = np.arange(deg, dtype=np.int32)
+  indices = jnp.asarray(nbrs)
+  hot = np.zeros(1, np.int64)           # nothing statically hot
+  bounds = np.asarray([0, n], np.int64)
+  special = 7
+  bits2 = per_requester_bits(n, bounds, hot,
+                             {0: np.asarray([special], np.int64)})
+  assert bits2.shape[0] == 1 + 1        # P=1 device row + hot-only fallback
+  k, boost = 4, 1000.0
+  seeds = jnp.zeros(1, jnp.int32)
+  hits = {0: 0, 1: 0}
+  for req_dev in (0, 1):
+    cnt = 0
+    for trial in range(30):
+      res = sample_one_hop_gns(
+          indptr, indices, seeds, k, jax.random.key(trial),
+          jnp.asarray(bits2), boost,
+          req=jnp.full((1,), req_dev, jnp.int32),
+          sort_locality=False)
+      cnt += int(np.sum(np.asarray(res.nbrs) == special))
+    hits[req_dev] = cnt
+  # requester 0 (caches `special`): the 1000x boost dominates every
+  # draw; requester 1: uniform over 32 neighbors
+  assert hits[0] > 60, hits
+  assert hits[1] <= 20, hits
+
+
+def test_per_requester_rows_follow_device_rings():
+  """`DistNeighborSampler._gns_arrays` builds one mask row per
+  device from ITS shard's residents (+ the hot-only fallback row):
+  a resident planted in device 0's ring sets the bit in row 0 only."""
+  ds = _uniform_dataset(16 * P, split_ratio=0.5)
+  sampler = DistNeighborSampler(ds, [2], gns=True,
+                                cold_cache_rows=4)
+  cache = sampler._ensure_cold_cache()
+  assert cache is not None
+  # plant a cold resident in device 0's ring only
+  hot0 = int(ds.node_features.hot_counts[0])
+  cold_id = int(ds.graph.bounds[0]) + hot0     # first cold row of p0
+  cache.shards[0].commit(np.asarray([cold_id], np.int64),
+                         np.asarray([0], np.int32))
+  bits = np.asarray(jax.device_get(sampler._gns_arrays()))
+  assert bits.ndim == 2 and bits.shape[0] == P + 1
+  byte, bit = cold_id >> 3, cold_id & 7
+  assert bits[0, byte] >> bit & 1 == 1         # requester 0 boosts it
+  for row in range(1, P + 1):
+    assert bits[row, byte] >> bit & 1 == 0, row  # nobody else does

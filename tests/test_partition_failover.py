@@ -1,0 +1,421 @@
+"""Elastic partition failover (ISSUE 15): PartitionBook-routed
+ownership transfer with exact-completion recovery.
+
+The contract stack, bottom-up: the book's RCU versioning and typed
+adoption refusals; durable-shard adoption byte-identity (a quiesced
+adopted shard serves exactly what the statically loaded one would);
+exact completion under a mid-epoch owner kill (full expected seed
+count, batches byte-identical to the fault-free run, one adoption);
+the GNS bitmask invalidating on a book-version bump; the documented
+degraded fallback when no durable shard exists; and the repo-wide
+"no `% P` routing convention outside partition_book" grep pin.
+"""
+import os
+import re
+import tempfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu.parallel.dist_data import DistDataset
+from graphlearn_tpu.parallel.dist_sampler import (DistLinkNeighborLoader,
+                                                  DistNeighborLoader)
+from graphlearn_tpu.parallel.failover import (NoDurableShardError,
+                                              PartitionLostError,
+                                              ShardStore, adopt_shard)
+from graphlearn_tpu.parallel.partition_book import (AdoptionRefusedError,
+                                                    PartitionBook,
+                                                    hot_split_host)
+from graphlearn_tpu.testing import chaos
+
+P = 8
+N, E = 200, 1200
+
+
+def _graph(seed=0):
+  rng = np.random.default_rng(seed)
+  rows = rng.integers(0, N, E)
+  cols = rng.integers(0, N, E)
+  feat = (np.arange(N)[:, None] + np.zeros((1, 6))).astype(np.float32)
+  lab = (np.arange(N) % 4).astype(np.int64)
+  return rows, cols, feat, lab
+
+
+def _dataset(split_ratio=1.0, seed=0):
+  rows, cols, feat, lab = _graph(seed)
+  return DistDataset.from_full_graph(P, rows, cols, feat, lab,
+                                     split_ratio=split_ratio)
+
+
+def _loader(ds, **kw):
+  kw.setdefault('batch_size', 4)
+  kw.setdefault('shuffle', True)
+  kw.setdefault('seed', 0)
+  return DistNeighborLoader(ds, [3, 2], np.arange(N), **kw)
+
+
+def _assert_batches_equal(ref, got, what=''):
+  assert len(ref) == len(got), f'{what}: {len(got)} != {len(ref)}'
+  for i, (a, b) in enumerate(zip(ref, got)):
+    assert np.array_equal(np.asarray(a.node), np.asarray(b.node)), \
+        f'{what}: node differs at batch {i}'
+    assert np.array_equal(np.asarray(a.x), np.asarray(b.x)), \
+        f'{what}: x differs at batch {i}'
+    assert np.array_equal(np.asarray(a.y), np.asarray(b.y)), \
+        f'{what}: y differs at batch {i}'
+    assert np.array_equal(np.asarray(a.edge_index),
+                          np.asarray(b.edge_index)), \
+        f'{what}: edge_index differs at batch {i}'
+
+
+# -- the book ---------------------------------------------------------------
+
+def test_book_rcu_version_fencing():
+  book = PartitionBook(np.arange(P + 1) * 10)
+  v0 = book.view()
+  assert v0.version == 0 and v0.is_identity and v0.spec() is None
+  assert v0.num_lanes == 1
+  v1 = book.adopt(3, 5)
+  # RCU: the pinned old view is untouched; the new view reroutes
+  assert v0.version == 0 and int(v0.owners[3]) == 3
+  assert v1.version == 1 and int(v1.owners[3]) == 5
+  assert int(v1.lane_of_range[3]) == 1 and v1.num_lanes == 2
+  assert int(v1.slot_ranges[5, 0]) == 5
+  assert int(v1.slot_ranges[5, 1]) == 3
+  spec = v1.spec()
+  assert spec is not None and spec.version == 1
+  assert book.view() is v1 or book.view().version == 1
+  ledger = book.adoptions()
+  assert ledger == [{'lost': 3, 'survivor': 5, 'version': 1}]
+
+
+def test_book_typed_refusals():
+  book = PartitionBook(np.arange(P + 1))
+  book.adopt(1, 2)
+  # double adoption forks the routing authority -> typed refusal
+  with pytest.raises(AdoptionRefusedError, match='already adopted'):
+    book.adopt(1, 4)
+  # the dead partition cannot be a survivor
+  with pytest.raises(AdoptionRefusedError, match='itself dead'):
+    book.adopt(3, 1)
+  # one adopted lane per survivor in v1
+  with pytest.raises(AdoptionRefusedError, match='already carries'):
+    book.adopt(3, 2)
+  # self-adoption and out-of-range are refused before any mutation
+  with pytest.raises(AdoptionRefusedError):
+    book.adopt(4, 4)
+  with pytest.raises(AdoptionRefusedError):
+    book.adopt(99, 0)
+  assert book.version == 1    # refusals never mutated the book
+  # deterministic survivor pick skips the loaded survivor
+  assert book.pick_survivor(3) == 0
+
+
+def test_hot_split_host_keys_on_range():
+  bounds = np.asarray([0, 10, 30, 60])
+  hot = np.asarray([5, 10, 10])
+  ids = np.asarray([-1, 0, 7, 12, 25, 35, 55])
+  rng, local, cold = hot_split_host(bounds, hot, ids)
+  assert rng.tolist()[1:] == [0, 0, 1, 1, 2, 2]
+  assert local.tolist() == [0, 0, 7, 2, 15, 5, 25]
+  assert cold.tolist() == [False, False, True, False, True, False,
+                           True]
+
+
+# -- durable shards + adoption ----------------------------------------------
+
+def test_adopted_shard_byte_identity_vs_static(tmp_path):
+  """The durable payload loaded by `adopt_shard` is byte-identical to
+  the statically loaded shard, and the quiesced adopted epoch equals
+  the fault-free epoch batch-for-batch."""
+  ds, loader = _dataset(), None
+  store = ShardStore(tmp_path / 'shards')
+  store.write_dataset_shards(ds)
+  payload = store.load_shard(2)
+  assert np.array_equal(payload['indptr'], ds.graph.indptr[2])
+  assert np.array_equal(payload['indices'], ds.graph.indices[2])
+  assert np.array_equal(payload['eids'], ds.graph.edge_ids[2])
+  assert np.array_equal(payload['fshard'], ds.node_features.shards[2])
+  assert np.array_equal(payload['lshard'],
+                        np.asarray(ds.node_labels)[2])
+
+  ref_loader = _loader(_dataset())
+  ref = [b for b in ref_loader]
+
+  ds2 = _dataset()
+  loader = _loader(ds2)
+  info = adopt_shard(ds2, store, 2)
+  assert info['version'] == 1 and 2 in ds2.adopted_shards
+  got = [b for b in loader]     # whole epoch under the adopted book
+  _assert_batches_equal(ref, got, 'adopted quiesced epoch')
+
+
+def test_exact_completion_mid_epoch_kill(tmp_path, monkeypatch):
+  """THE acceptance pin: owner killed mid-epoch with a durable shard
+  present -> the epoch finishes with the FULL expected seed count,
+  batches byte-identical to the fault-free run, adoptions_total == 1,
+  recovery_secs gauged."""
+  from graphlearn_tpu.telemetry.recorder import recorder
+  ref = [b for b in _loader(_dataset())]
+
+  monkeypatch.setenv('GLT_SHARD_DIR', str(tmp_path / 'shards'))
+  monkeypatch.delenv('GLT_DEGRADED_OK', raising=False)
+  ds = _dataset()
+  loader = _loader(ds)
+  recorder.enable(None)
+  recorder.clear()
+  chaos.install('partition.owner:kill:4:partition=3')
+  try:
+    got = [b for b in loader]
+  finally:
+    chaos.uninstall()
+    recorder.disable()
+  _assert_batches_equal(ref, got, 'mid-epoch kill')
+  assert ds.partition_book.version == 1
+  adopts = recorder.events('partition.adopt')
+  kinds = [e.get('phase') for e in adopts]
+  assert kinds.count(None) == 1          # ONE adoption executed
+  assert kinds.count('recovered') == 1   # and its recovery clock closed
+  rec = [e for e in adopts if e.get('phase') == 'recovered'][0]
+  assert rec['secs'] > 0
+  recorder.clear()
+
+
+def test_exact_completion_link_loader_kill(tmp_path, monkeypatch):
+  """Mesh parity: the link loader runs the same ladder (its dispatch
+  seam shares `_partition_supervision`)."""
+  rows, cols, _f, _l = _graph()
+  pairs = (rows[:160], cols[:160])
+
+  def build():
+    ds = _dataset()
+    return ds, DistLinkNeighborLoader(
+        ds, [2, 2], pairs, neg_sampling='binary', batch_size=4,
+        shuffle=True, seed=0, input_space='new')
+
+  _, ref_loader = build()
+  ref = [b for b in ref_loader]
+  monkeypatch.setenv('GLT_SHARD_DIR', str(tmp_path / 'shards'))
+  ds, loader = build()
+  chaos.install('partition.owner:kill:3:partition=6')
+  try:
+    got = [b for b in loader]
+  finally:
+    chaos.uninstall()
+  assert len(got) == len(ref)
+  for i, (a, b) in enumerate(zip(ref, got)):
+    assert np.array_equal(np.asarray(a.node), np.asarray(b.node)), i
+    assert np.array_equal(np.asarray(a.x), np.asarray(b.x)), i
+  assert ds.partition_book.version == 1
+
+
+def test_exact_completion_resumed_from_snapshot(tmp_path, monkeypatch):
+  """Owner killed mid-epoch in a RESUMED epoch (the r6 snapshot
+  path): kill -> snapshot restore in a fresh loader -> the chaos kill
+  fires during the resumed remainder -> adoption -> the resumed
+  epoch's remaining batches are byte-identical and complete."""
+  monkeypatch.setenv('GLT_SHARD_DIR', str(tmp_path / 'shards'))
+  ref = [b for b in _loader(_dataset())]
+
+  ds = _dataset()
+  loader = _loader(ds)
+  it = iter(loader)
+  got = [next(it) for _ in range(3)]
+  state = loader.state_dict()
+
+  # fresh loader (the restarted process), resume, then the kill fires
+  ds2 = _dataset()
+  loader2 = _loader(ds2)
+  loader2.load_state_dict(state)
+  chaos.install('partition.owner:kill:2:partition=1')
+  try:
+    got += [b for b in loader2.resume_epoch()]
+  finally:
+    chaos.uninstall()
+  _assert_batches_equal(ref, got, 'resumed epoch')
+  assert ds2.partition_book.version == 1
+
+
+def test_gns_bitmask_invalidated_on_book_bump(tmp_path):
+  """A book-version bump must rebuild the cached-set bitmask at the
+  same fence that rebuilds the arrays (derived structures refresh
+  with the placement they derive from)."""
+  ds = _dataset(split_ratio=0.5)
+  loader = _loader(ds, gns=True)
+  s = loader.sampler
+  assert s.gns
+  _ = [b for b in loader]
+  bits_before = s._gns_bits
+  assert bits_before is not None
+  assert s._gns_ver >= 0
+  store = ShardStore(tmp_path / 'shards')
+  store.write_dataset_shards(ds)
+  adopt_shard(ds, store, 4)
+  s.maybe_refresh_book()
+  assert s._gns_ver == -1        # invalidated at the fence
+  _ = [b for b in loader]        # next epoch rebuilds
+  assert s._gns_ver >= 0
+
+
+def test_no_durable_shard_falls_back_degraded(monkeypatch):
+  """The documented ladder tail: no GLT_SHARD_DIR -> degraded when
+  opted in (reduced data: the orphaned shard's nodes vanish; the
+  loss is flagged peer.lost degraded=true), typed raise otherwise."""
+  from graphlearn_tpu.telemetry.recorder import recorder
+  monkeypatch.delenv('GLT_SHARD_DIR', raising=False)
+  monkeypatch.delenv('GLT_DEGRADED_OK', raising=False)
+  loader = _loader(_dataset())
+  chaos.install('partition.owner:kill:2:partition=5')
+  try:
+    with pytest.raises(PartitionLostError, match='GLT_SHARD_DIR'):
+      _ = [b for b in loader]
+  finally:
+    chaos.uninstall()
+
+  monkeypatch.setenv('GLT_DEGRADED_OK', '1')
+  ds = _dataset()
+  loader = _loader(ds)
+  recorder.enable(None)
+  recorder.clear()
+  chaos.install('partition.owner:kill:2:partition=5')
+  try:
+    got = [b for b in loader]
+  finally:
+    chaos.uninstall()
+    recorder.disable()
+  assert len(got) == len(loader)     # exact accounting, reduced data
+  lost = [e for e in recorder.events('peer.lost') if e.get('degraded')]
+  assert lost and lost[0]['peer'] == 5
+  assert ds.partition_book.version == 0     # nothing adopted
+  # the write-off's data effect, pinned at the stacks AND in served
+  # batches: partition 5's CSR row is emptied (its expansions vanish
+  # from the epoch; seeds can still name p5 ids) and every p5 node a
+  # batch still carries reads a zeroed feature row
+  bounds = np.asarray(ds.graph.bounds, np.int64)
+  assert not np.asarray(ds.graph.indptr)[5].any()
+  assert np.all(np.asarray(ds.graph.indices)[5] == -1)
+  found_p5 = False
+  for b in got[2:]:                # post-kill batches (kill at step 2)
+    node = np.asarray(b.node)
+    x = np.asarray(b.x)
+    p5 = (node >= bounds[5]) & (node < bounds[6])
+    found_p5 = found_p5 or bool(p5.any())
+    assert np.all(x[p5] == 0)
+  assert found_p5, 'no batch named a p5 node — the pin is vacuous'
+  recorder.clear()
+
+
+def test_double_kill_second_adoption_runs_or_refuses(tmp_path,
+                                                     monkeypatch):
+  """Two distinct owners lost: both adopt (different survivors), and
+  a third loss of an ALREADY-adopted partition is a no-op fence, not
+  a re-adoption."""
+  monkeypatch.setenv('GLT_SHARD_DIR', str(tmp_path / 'shards'))
+  ref = [b for b in _loader(_dataset())]
+  ds = _dataset()
+  loader = _loader(ds)
+  chaos.install('partition.owner:kill:2:partition=3;'
+                'partition.owner:kill:5:partition=6')
+  try:
+    got = [b for b in loader]
+  finally:
+    chaos.uninstall()
+  _assert_batches_equal(ref, got, 'double adoption')
+  assert ds.partition_book.version == 2
+  lanes = ds.partition_book.view()
+  assert int(lanes.owners[3]) != 3 and int(lanes.owners[6]) != 6
+
+
+def test_adopt_timeout_and_missing_shard_typed(tmp_path):
+  ds = _dataset()
+  store = ShardStore(tmp_path / 'empty')
+  with pytest.raises(NoDurableShardError, match='GLT_DEGRADED_OK'):
+    adopt_shard(ds, store, 1)
+  # a store written for another partition count is refused typed
+  store2 = ShardStore(tmp_path / 'other')
+  store2.save_meta({'num_parts': 4})
+  store2.save_shard(1, {'indptr': np.zeros(3, np.int64),
+                        'indices': np.zeros(2, np.int32),
+                        'eids': np.zeros(2, np.int64)})
+  with pytest.raises(AdoptionRefusedError, match='partitions'):
+    adopt_shard(ds, store2, 1)
+
+
+# -- the routing-convention pin ---------------------------------------------
+
+def test_no_mod_p_routing_convention_outside_book():
+  """Acceptance criterion: every ownership read in `parallel/` goes
+  through partition_book — no inline `searchsorted(bounds...)` owner
+  lambdas, no `% num_parts` / `// num_parts` routing arithmetic in
+  non-comment code outside the module (construction-time ceil-divs
+  and the book's own definitions excepted)."""
+  import io
+  import tokenize
+  root = Path(__file__).resolve().parents[1] / 'graphlearn_tpu'
+  owner_pat = re.compile(
+      r'searchsorted\((?:g\.)?bounds\w*,')
+  mod_pat = re.compile(r'[-\w\])]\s*%\s*(?:num_parts|self\.num_parts|P\b)')
+  offenders = []
+  for f in sorted((root / 'parallel').glob('*.py')):
+    if f.name == 'partition_book.py':
+      continue
+    src = f.read_text()
+    lines = src.splitlines()
+    # blank out strings/comments token-wise so docstrings that QUOTE
+    # the conventions don't trip the code-only pin
+    code_lines = list(lines)
+    for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+      if tok.type in (tokenize.STRING, tokenize.COMMENT):
+        (r0, c0), (r1, c1) = tok.start, tok.end
+        for r in range(r0, r1 + 1):
+          line = code_lines[r - 1]
+          lo = c0 if r == r0 else 0
+          hi = c1 if r == r1 else len(line)
+          code_lines[r - 1] = line[:lo] + ' ' * (hi - lo) + line[hi:]
+    for ln, code in enumerate(code_lines, 1):
+      if owner_pat.search(code) or mod_pat.search(code):
+        offenders.append(f'{f.name}:{ln}: {lines[ln - 1].strip()}')
+  assert not offenders, (
+      'ownership arithmetic outside partition_book.py (route through '
+      'range_of/range_owner_fn/edge_owner_* / hot_split_host):\n'
+      + '\n'.join(offenders))
+
+
+# -- shard refresh at the ingest compaction seam ----------------------------
+
+def test_shard_refresh_at_compaction_seam(tmp_path):
+  """`ShardStore.refresh_cb` wired as the IngestPipeline's
+  compaction hook rewrites the durable shards from the dataset's
+  CURRENT stacks — an adoption after ingest loads the streamed
+  topology."""
+  from graphlearn_tpu.streaming.delta import StreamingGraph
+  from graphlearn_tpu.streaming.ingest import IngestPipeline
+  rows, cols, feat, lab = _graph()
+  ds = DistDataset.from_full_graph(P, rows, cols, feat, lab)
+  store = ShardStore(tmp_path / 'shards')
+  store.write_dataset_shards(ds)
+  before = store.load_shard(0)
+
+  stream = StreamingGraph.from_coo(rows, cols, num_nodes=N,
+                                   device=False)
+  ds.attach_stream(stream)
+  pipe = IngestPipeline(stream, wal_dir=str(tmp_path / 'wal'),
+                        compact_every=1, recover=False,
+                        shard_refresh=store.refresh_cb(ds))
+  try:
+    rng = np.random.default_rng(7)
+    pipe.ingest(rng.integers(0, N, 20), rng.integers(0, N, 20))
+    # the loader seam restacks ds.graph from the stream; ingest then
+    # compacts again and the refresh must snapshot the NEW stacks
+    loader = _loader(ds, shuffle=False)
+    _ = next(iter(loader))
+    pipe.ingest(rng.integers(0, N, 20), rng.integers(0, N, 20))
+  finally:
+    pipe.close()
+  after = store.load_shard(0)
+  assert not np.array_equal(before['indptr'],
+                            after['indptr'][:len(before['indptr'])]) \
+      or not np.array_equal(before['indices'],
+                            after['indices'][:len(before['indices'])])
+  assert store.meta()['num_parts'] == P
