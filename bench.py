@@ -1525,6 +1525,23 @@ def main():
         env_rows.append(r)
     if env_rows:
       dist['scale_envelope'] = env_rows
+      # lift the P=16 row's traffic attribution to a stable dotted
+      # address (ISSUE 16): the regress gate guards
+      # dist.attribution.cross_partition_bytes_frac (lower) and
+      # dist.attribution.hot_range_coverage (higher)
+      att = next((r['attribution'] for r in env_rows
+                  if r.get('num_parts') == 16
+                  and isinstance(r.get('attribution'), dict)), None)
+      if att:
+        dist['attribution'] = {
+            'num_parts': att.get('num_parts'),
+            'cross_partition_bytes_frac': att.get(
+                'cross_partition_bytes_frac'),
+            'cross_partition_ids_frac': att.get(
+                'cross_partition_ids_frac'),
+            'hot_range_coverage': att.get('hot_range_coverage'),
+            'hotness_source': att.get('hotness_source'),
+        }
       emit()
 
   # phase 3d — resilience smoke (ISSUE 4): the host server->client

@@ -296,6 +296,15 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
       exchange_layout=resolve_layout(
           getattr(loader.sampler, 'exchange_layout', None), num_parts))
   if mode == 'homo':
+    # per-partition traffic attribution (ISSUE 16): the P×P exchange
+    # byte matrix + hot-range table from the run above — the envelope
+    # is where locality regressions are cheapest to catch, and the
+    # regress gate guards the P=16 row's headline fractions
+    try:
+      out['attribution'] = loader.sampler.attribution_stats(
+          tick_metrics=False)
+    except Exception as e:          # never sink the envelope row
+      out['attribution_error'] = f'{type(e).__name__}: {e}'
     # dense-vs-compacted-vs-hierarchical at the same static slack:
     # one epoch each, fresh loader (fresh compile) per layout
     comparison = {}

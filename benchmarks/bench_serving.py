@@ -254,7 +254,37 @@ def run_phase(label: str, ds, model, params, args, result: dict,
   return row
 
 
-def run_fleet_phase(args, result: dict) -> dict:
+def federation_watch(scraper, ops, stop, out: dict) -> None:
+  """Mid-traffic ``/fleet`` validation loop (ISSUE 16 acceptance):
+  while the open-loop drive runs, repeatedly scrape the fleet and
+  strict-parse the federated exposition — through the HTTP route when
+  an ops server is up (the exact bytes an operator's scraper reads),
+  else directly.  Any parse failure or a merge that never federates
+  >= 2 replicas fails the bench (nonzero exit in `main`)."""
+  import re
+  import urllib.request
+  from graphlearn_tpu.telemetry import parse_prometheus_text
+  while not stop.is_set():
+    try:
+      scraper.scrape()
+      if ops is not None:
+        with urllib.request.urlopen(f'{ops.url}/fleet',
+                                    timeout=5) as r:
+          text = r.read().decode('utf-8')
+      else:
+        text = scraper.prometheus_text()
+      parse_prometheus_text(text)     # strict: raises on junk
+      seen = len(set(re.findall(r'replica="([^"]+)"', text)))
+      out['scrapes'] = out.get('scrapes', 0) + 1
+      out['max_replicas_federated'] = max(
+          out.get('max_replicas_federated', 0), seen)
+    except Exception as e:            # noqa: BLE001 — every failure
+      out['parse_failures'] = out.get('parse_failures', 0) + 1
+      out.setdefault('errors', []).append(f'{type(e).__name__}: {e}')
+    stop.wait(0.15)
+
+
+def run_fleet_phase(args, result: dict, ops=None) -> dict:
   """Fleet mode (ISSUE 13): the SAME Zipf open-loop schedule spread
   over N in-process replicas by a `FleetRouter`, with ONE replica
   chaos-killed mid-run.  The acceptance arithmetic: every submitted
@@ -262,11 +292,19 @@ def run_fleet_phase(args, result: dict) -> dict:
   lost — redrive exactly-once via the router ledger), and the fleet's
   completion rate after the kill recovers to >= 0.6x the pre-kill
   rate within the run.  Feeds ``dist.serving.fleet_qps`` /
-  ``.failover_failed_requests``."""
+  ``.failover_failed_requests``.
+
+  Fleet signal plane (ISSUE 16): a `FleetScraper` federates every
+  replica (the scraping process's own registry rides along as
+  ``self``) and a watcher thread strict-parses the merged ``/fleet``
+  exposition for the whole drive — the federation acceptance runs
+  against live traffic, not a quiesced fleet."""
+  import threading
   import jax
   from graphlearn_tpu.serving import (AdmissionRejected, FleetRouter,
                                       LocalReplica, ServingEngine,
                                       ServingFrontend)
+  from graphlearn_tpu.telemetry.live import live
   from graphlearn_tpu.testing import chaos
   n_rep = args.fleet
   ds = build_dataset(args.nodes, args.dim)
@@ -308,6 +346,19 @@ def run_fleet_phase(args, result: dict) -> dict:
   ]})
   router = FleetRouter(replicas, heartbeat_ms=50.0, dead_after=2,
                        auto_start=True)
+  # the signal plane: every replica federates under its own
+  # replica= label; the driver process's registry (SLO gauges,
+  # admission depth — the frontends all write into it) joins as
+  # 'self' so per-process and per-replica views merge in one scrape
+  scraper = router.make_scraper(registry=live)
+  if ops is not None:
+    ops.attach_fleet(scraper)
+  fed = {}
+  fed_stop = threading.Event()
+  watcher = threading.Thread(target=federation_watch,
+                             args=(scraper, ops, fed_stop, fed),
+                             daemon=True)
+  watcher.start()
   t_run = time.perf_counter()
   pending, _ = pace_schedule(plan, router.submit)
   outcomes = []
@@ -323,6 +374,9 @@ def run_fleet_phase(args, result: dict) -> dict:
     except Exception:               # noqa: BLE001
       outcomes.append((offset, 'error'))
   run_s = time.perf_counter() - t_run
+  fed_stop.set()
+  watcher.join(10.0)
+  scraper.close()
   router_stats = router.stats()
   router.close(close_replicas=True)
   chaos.uninstall()
@@ -350,6 +404,13 @@ def run_fleet_phase(args, result: dict) -> dict:
       'redriven': router_stats['redriven'],
       'evictions': router_stats['evictions'],
       'router': router_stats,
+      # the ISSUE 16 federation acceptance: every mid-traffic /fleet
+      # exposition strict-parsed, and the merge federated >= 2
+      # replicas at least once while traffic flowed
+      'fleet_scrapes': fed.get('scrapes', 0),
+      'fleet_parse_failures': fed.get('parse_failures', 0),
+      'fleet_replicas_federated': fed.get('max_replicas_federated', 0),
+      'fleet_scrape_errors': fed.get('errors', [])[:5],
   }
   result['fleet'] = row
   for k in ('fleet_qps', 'failover_failed_requests', 'recovery_ratio',
@@ -401,10 +462,19 @@ def main(argv=None):
               'platform': jax.devices()[0].platform,
               'ops_enabled': ops is not None}
     try:
-      row = run_fleet_phase(args, result)
+      row = run_fleet_phase(args, result, ops=ops)
     finally:
       if ops is not None:
         ops.close()
+    if (row['fleet_parse_failures']
+        or row['fleet_scrapes'] == 0
+        or row['fleet_replicas_federated'] < 2):
+      print('WARNING: /fleet federation failed mid-traffic '
+            f"validation (scrapes={row['fleet_scrapes']}, "
+            f"parse_failures={row['fleet_parse_failures']}, "
+            f"replicas_federated={row['fleet_replicas_federated']}) "
+            f"errors={row['fleet_scrape_errors']}", file=sys.stderr)
+      return 1
     if row['failover_failed_requests']:
       print(f"WARNING: {row['failover_failed_requests']} request(s) "
             'failed/dropped across the mid-run replica kill — the '

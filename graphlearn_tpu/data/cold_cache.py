@@ -97,13 +97,15 @@ class ClockShardCache:
   so the ranking change is invisible outside hit rates.
   """
 
-  def __init__(self, capacity: int):
+  def __init__(self, capacity: int, bounds=None):
     from ..ops.gns import DecayedSketch
     self.capacity = int(capacity)
     self.ids = np.full(self.capacity, -1, np.int64)
     self.ref = np.zeros(self.capacity, np.uint8)
     self.hand = 0
-    self.sketch = DecayedSketch()
+    # with PartitionBook bounds attached the sketch also keeps the
+    # decayed per-range visit histogram (gns.range_hotness export)
+    self.sketch = DecayedSketch(bounds=bounds)
     #: bumped on every committed admission wave — consumers (the GNS
     #: bitmask refresh) rebuild derived state only when this moved
     self.version = 0
@@ -458,14 +460,23 @@ class MeshColdCache:
   """
 
   def __init__(self, capacity: int, dim: int, dtype, num_local: int,
-               mesh, axis: str, put_stacked):
+               mesh, axis: str, put_stacked, bounds=None):
     self.capacity = int(capacity)
     self.mesh, self.axis = mesh, axis
     self._put = put_stacked
-    self.shards = [ClockShardCache(capacity) for _ in range(num_local)]
+    self.shards = [ClockShardCache(capacity, bounds=bounds)
+                   for _ in range(num_local)]
     self.rows = put_stacked(
         np.zeros((num_local, max(self.capacity, 1), int(dim)), dtype))
     self.stats = CacheStats()
+    self._hotness_fns = ()
+    if bounds is not None:
+      # the sketches' decayed range mass becomes the live top-K
+      # gns.range_hotness{partition=} gauges (evaluated at scrape)
+      from ..ops.gns import register_hotness_gauges
+      self._hotness_fns = register_hotness_gauges(
+          lambda: [sh.sketch for sh in self.shards],
+          max(len(np.asarray(bounds)) - 1, 1))
 
   @property
   def enabled(self) -> bool:

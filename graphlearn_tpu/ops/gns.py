@@ -73,7 +73,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -160,10 +160,19 @@ class DecayedSketch:
   """
 
   def __init__(self, slots: Optional[int] = None,
-               decay: Optional[float] = None):
+               decay: Optional[float] = None, bounds=None):
     self.slots = resolve_sketch_slots(slots)
     self.decay = resolve_decay(decay)
     self.scores = np.zeros(self.slots, np.float32)
+    # optional per-range attribution (ISSUE 16): with PartitionBook
+    # bounds attached, every update also folds the batch into a
+    # decayed per-RANGE visit histogram — exact (no hashing), P+1
+    # floats — exported as the gns.range_hotness top-K gauges
+    self.bounds = (None if bounds is None
+                   else np.asarray(bounds, np.int64))
+    self.range_mass = (None if bounds is None else
+                       np.zeros(max(len(self.bounds) - 1, 1),
+                                np.float32))
 
   def _slot(self, ids: np.ndarray) -> np.ndarray:
     mixed = ids.astype(np.uint64) * _HASH_MULT        # wraps mod 2^64
@@ -176,6 +185,8 @@ class DecayedSketch:
     sel = ids >= 0
     ids = ids[sel]
     self.scores *= self.decay
+    if self.range_mass is not None:
+      self.range_mass *= self.decay
     if len(ids) == 0:
       return 0
     if counts is None:
@@ -183,7 +194,29 @@ class DecayedSketch:
     else:
       add = np.asarray(counts, np.float32).reshape(-1)[sel]
     np.add.at(self.scores, self._slot(ids), add)
+    if self.range_mass is not None:
+      rng = np.clip(
+          np.searchsorted(self.bounds, ids, side='right') - 1,
+          0, len(self.range_mass) - 1)
+      np.add.at(self.range_mass, rng, add)
     return len(ids)
+
+  def hot_ranges(self, top_k: Optional[int] = None
+                 ) -> List[Tuple[int, float]]:
+    """``[(range_idx, share), ...]`` of the top-K ranges by decayed
+    visit mass (share of total; empty when no bounds attached or no
+    mass yet) — the hot-range table the locality-aware partitioner
+    (ROADMAP item 4) ranks migration candidates from."""
+    if self.range_mass is None:
+      return []
+    total = float(self.range_mass.sum())
+    if total <= 0:
+      return []
+    p = len(self.range_mass)
+    k = min(max(1, p // 4) if top_k is None else int(top_k), p)
+    order = np.argsort(-self.range_mass, kind='stable')[:k]
+    return [(int(r), float(self.range_mass[r] / total))
+            for r in order]
 
   def score(self, ids) -> np.ndarray:
     ids = np.asarray(ids, np.int64).reshape(-1)
@@ -192,8 +225,11 @@ class DecayedSketch:
 
   # -- DataPlaneState leaf (rides the owning ClockShardCache) -------------
   def state_dict(self) -> dict:
-    return {'scores': self.scores.copy(),
-            'decay': np.float32(self.decay)}
+    out = {'scores': self.scores.copy(),
+           'decay': np.float32(self.decay)}
+    if self.range_mass is not None:
+      out['range_mass'] = self.range_mass.copy()
+    return out
 
   def load_state_dict(self, state: dict) -> None:
     scores = np.asarray(state['scores'], np.float32)
@@ -204,6 +240,54 @@ class DecayedSketch:
           f'{SKETCH_ENV} the snapshot was taken under')
     self.scores = scores.copy()
     self.decay = float(np.asarray(state['decay']))
+    if self.range_mass is not None and 'range_mass' in state:
+      rm = np.asarray(state['range_mass'], np.float32)
+      if rm.shape == self.range_mass.shape:
+        # older snapshots (or a repartitioned mesh) restart the range
+        # histogram cold — residency/scores still restore
+        self.range_mass = rm.copy()
+
+
+def register_hotness_gauges(get_sketches, num_parts: int,
+                            registry=None) -> list:
+  """Register the ``gns.range_hotness{partition=p}`` fn-gauges: one
+  per range, reading the decayed per-range visit mass aggregated over
+  ``get_sketches()`` (a zero-arg callable — the cache's shard list).
+  Only the top-K (``K = max(1, P // 4)``) hottest ranges sample a
+  value at scrape time; the rest return None and drop, so /metrics
+  carries exactly the hot-range table (bounded label cardinality:
+  ``partition`` ranges over ``0..P-1``).  Returns the callbacks (for
+  fn-guarded unregistration)."""
+  if registry is None:
+    from ..telemetry.live import live as registry
+
+  def make(p: int):
+    def read() -> Optional[float]:
+      mass = None
+      for sk in get_sketches():
+        if sk.range_mass is None:
+          continue
+        mass = (sk.range_mass.copy() if mass is None
+                else mass + sk.range_mass)
+      if mass is None:
+        return None
+      total = float(mass.sum())
+      if total <= 0:
+        return None
+      k = min(max(1, num_parts // 4), len(mass))
+      hot = np.argsort(-mass, kind='stable')[:k]
+      if p >= len(mass) or p not in hot:
+        return None
+      return round(float(mass[p] / total), 6)
+    return read
+
+  fns = []
+  for p in range(int(num_parts)):
+    fn = make(p)
+    registry.gauge('gns.range_hotness', labels={'partition': str(p)},
+                   fn=fn)
+    fns.append(fn)
+  return fns
 
 
 def cached_set_bits(num_nodes: int, bounds: np.ndarray,

@@ -40,8 +40,8 @@ from ..ops.neighbor import sample_one_hop
 from ..ops.unique import init_node, induce_next
 from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
 from .dist_data import DistDataset
-from .exchange import (MIN_EXCHANGE_CAP, capacity_spec, plan_exchange,
-                       resolve_layout)
+from .exchange import (MIN_EXCHANGE_CAP, capacity_spec, dest_histogram,
+                       plan_exchange, resolve_layout)
 from .partition_book import (book_owner_fn, edge_book_owner_fn,
                              edge_local_rows, edge_owner_fn,
                              hot_split_host, range_owner_fn)
@@ -790,8 +790,17 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
   hop_counts = [state.count]
   fr_stats = jnp.zeros((3,), jnp.int32)
   ft_stats = jnp.zeros((3,), jnp.int32)
+  # per-(src->dst)-RANGE traffic attribution (ISSUE 16): histogram the
+  # ids each wire stage offers by their PartitionBook range owner —
+  # this device's row of the fleet's P x P matrix.  Keyed by the RANGE
+  # (identity book), so a row keeps meaning "ids in range r" even
+  # after an adopted book remaps which physical device serves r.
+  attr_owner = range_owner_fn(bounds)
+  attr_fr = jnp.zeros((num_parts,), jnp.int32)
+  attr_ft = jnp.zeros((num_parts,), jnp.int32)
   for h, k in enumerate(fanouts):
     hop_key = jax.random.fold_in(key, h)
+    attr_fr = attr_fr + dest_histogram(frontier, attr_owner, num_parts)
     nbrs, mask, e, hw, hstats = _dist_one_hop(
         indptr, indices, eids, bounds, frontier, int(k), hop_key,
         axis, num_parts, with_edge,
@@ -830,6 +839,9 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
                                      exchange_slack, exchange_layout),
         shard_mode=ef_shard_mode, book_spec=book_spec)
     ft_stats = ft_stats + jnp.stack(estats)
+    ef_owner = (edge_owner_fn(num_parts) if ef_shard_mode == 'mod'
+                else range_owner_fn(ebounds))
+    attr_ft = attr_ft + dest_histogram(edge, ef_owner, num_parts)
   tables = (((fshard,) if collect_features else ())
             + ((lshard,) if collect_labels else ()))
   if tables:
@@ -841,6 +853,9 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
         book_spec=book_spec)
     got = list(got)
     ft_stats = ft_stats + jnp.stack(gstats)
+    attr_ft = attr_ft + dest_histogram(
+        state.nodes, attr_owner, num_parts,
+        valid=jnp.arange(node_cap, dtype=jnp.int32) < state.count)
     if collect_features:
       x = got.pop(0)
       if with_cache:
@@ -852,7 +867,11 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
       y = got.pop(0)
   cum = jnp.stack(hop_counts)
   nsn = jnp.concatenate([cum[:1], cum[1:] - cum[:-1]]).astype(jnp.int32)
-  stats = jnp.concatenate([fr_stats, ft_stats, jnp.zeros((1,), jnp.int32)])
+  # stats layout: [7] scalar triple pairs + negative.lost slot, then
+  # the [2P] attribution rows (frontier dests, feature dests) — see
+  # `ExchangeTelemetry._accumulate_stats` for the host-side split
+  stats = jnp.concatenate([fr_stats, ft_stats, jnp.zeros((1,), jnp.int32),
+                           attr_fr, attr_ft])
   return state, row, col, edge, seed_local, x, y, ef, nsn, stats, ew
 
 
@@ -1151,6 +1170,10 @@ def _make_dist_subgraph_step(mesh: Mesh, num_parts: int,
                                        exchange_layout),
           book_spec=book_spec)
       stats = stats.at[:3].add(jnp.stack(hstats))
+      # full-window hops are frontier traffic too: extend this
+      # device's src->dst attribution row (stats[7:7+P])
+      stats = stats.at[7:7 + num_parts].add(
+          dest_histogram(frontier_c, range_owner_fn(bounds), num_parts))
       nbrs_parts.append(nb)
       mask_parts.append(mk)
       if with_edge:
@@ -1218,6 +1241,13 @@ class ExchangeTelemetry:
     self._stats_acc = jnp.zeros((len(EXCHANGE_STAT_NAMES),), jnp.int32)
     self._stats_total = np.zeros(len(EXCHANGE_STAT_NAMES), np.int64)
     self._stats_pending = 0
+    # per-(src device -> dst range) traffic attribution (ISSUE 16):
+    # the step's stats vector carries [2P] histogram tails (frontier
+    # dests, feature dests) per device; they accumulate UN-summed —
+    # row = src device — into the device-resident [P, 2P] matrix
+    self._attr_acc = None
+    self._attr_total: Optional[np.ndarray] = None
+    self._attr_reported = (0, 0)
     # host-side cold-tier counters (tiered feature stores only).
     # Definitions (benchmarks/README "Cold-tier metrics"):
     #   lookups      = valid node-table feature lookups;
@@ -1236,8 +1266,14 @@ class ExchangeTelemetry:
     self._cold_reported = (0,) * 6
 
   def _accumulate_stats(self, stats_stacked) -> None:
+    n = len(EXCHANGE_STAT_NAMES)
+    base = stats_stacked[:, :n]
+    attr = stats_stacked[:, n:]
     with self._stats_lock:
-      self._stats_acc = self._stats_acc + jnp.sum(stats_stacked, axis=0)
+      self._stats_acc = self._stats_acc + jnp.sum(base, axis=0)
+      if attr.shape[1]:
+        self._attr_acc = (attr if self._attr_acc is None
+                          else self._attr_acc + attr)
       self._stats_pending += 1
       drain = self._stats_pending >= self.STATS_DRAIN_INTERVAL
     if drain:
@@ -1254,19 +1290,35 @@ class ExchangeTelemetry:
       cold = (self._feat_lookups, self._cold_lookups,
               self._cold_misses, self._cache_hits, self._cache_admits,
               self._cache_evicts)
-      return np.concatenate([self._stats_total,
-                             np.asarray(cold, np.int64)])
+      parts = [self._stats_total, np.asarray(cold, np.int64)]
+      if self._attr_total is not None:
+        # the [P, 2P] attribution matrix rides flattened at the tail;
+        # shape reconstructs from the size (2P^2) alone
+        parts.append(self._attr_total.reshape(-1))
+      return np.concatenate(parts)
 
   def _load_stats_state(self, packed) -> None:
     arr = np.asarray(packed, np.int64)
     n = len(EXCHANGE_STAT_NAMES)
     with self._stats_lock:
       self._stats_acc = jnp.zeros_like(self._stats_acc)
+      self._attr_acc = None
       self._stats_pending = 0
       self._stats_total = arr[:n].copy()
       (self._feat_lookups, self._cold_lookups, self._cold_misses,
        self._cache_hits, self._cache_admits,
        self._cache_evicts) = (int(v) for v in arr[n:n + 6])
+      tail = arr[n + 6:]
+      if tail.size:
+        # rows = device count, cols = 2P; prefer the sampler's own
+        # num_parts (rows == cols/2 only when mesh size == P)
+        cols = 2 * getattr(self, 'num_parts',
+                           int(round(np.sqrt(tail.size / 2))))
+        self._attr_total = tail.reshape(-1, cols).copy()
+      else:
+        # pre-attribution snapshot: counters restore, the matrix
+        # restarts cold (documented fallback)
+        self._attr_total = None
       # the registry watermark must never exceed the rewound counters
       # (a negative delta would tick the global metrics backwards)
       self._cold_reported = tuple(
@@ -1288,9 +1340,17 @@ class ExchangeTelemetry:
     with self._stats_lock:
       acc = self._stats_acc
       self._stats_acc = jnp.zeros_like(acc)
+      attr_acc = self._attr_acc
+      self._attr_acc = None
       self._stats_pending = 0
       delta = np.asarray(jax.device_get(acc), np.int64)
       self._stats_total += delta
+      if attr_acc is not None:
+        a = np.asarray(jax.device_get(attr_acc), np.int64)
+        if (self._attr_total is None
+            or self._attr_total.shape != a.shape):
+          self._attr_total = np.zeros_like(a)
+        self._attr_total += a
       totals = self._stats_total.copy()
       cold_now = (self._feat_lookups, self._cold_lookups,
                   self._cold_misses, self._cache_hits,
@@ -1348,6 +1408,106 @@ class ExchangeTelemetry:
                       hit_rate=round(
                           1.0 - cold_delta[2] / cold_delta[1], 6))
     return out
+
+  def attribution_matrices(self) -> Tuple[np.ndarray, np.ndarray]:
+    """``(frontier, feature)`` — two ``[P, P]`` int64 id-count
+    matrices, row = SRC device, column = DST range (`PartitionBook`
+    identity ranges, so columns keep meaning "range r" under adopted
+    books).  Drains the device accumulator (one sync)."""
+    self.exchange_stats(tick_metrics=False)
+    with self._stats_lock:
+      tot = self._attr_total
+      if tot is None:
+        p = int(getattr(self, 'num_parts', 0) or 0)
+        z = np.zeros((p, p), np.int64)
+        return z, z.copy()
+      p = tot.shape[1] // 2
+      return tot[:, :p].copy(), tot[:, p:].copy()
+
+  def attribution_stats(self, top_k: Optional[int] = None,
+                        feature_row_bytes: Optional[int] = None,
+                        tick_metrics: bool = True) -> dict:
+    """Traffic attribution rollup (`report.py --attribution` input).
+
+    Bytes: frontier ids weigh 4 B (int32 on the wire), feature ids
+    weigh one feature row (inferred from the node-feature store when
+    not given).  ``hot_ranges`` prefers the GNS sketches' decayed
+    range mass (the learned hotness); without an active sketch it
+    falls back to the attribution matrix's column mass — measured
+    demand per range (benchmarks/README "Fleet signal plane").
+    """
+    fr, ft = self.attribution_matrices()
+    p = int(fr.shape[0])
+    if feature_row_bytes is None:
+      feature_row_bytes = 4
+      try:
+        sh = self.ds.node_features.shards
+        feature_row_bytes = int(sh.shape[-1]) * int(
+            np.dtype(sh.dtype).itemsize)
+      except Exception:               # noqa: BLE001 — no feature
+        pass                          # store on this sampler
+    ids = fr + ft
+    bytes_m = fr * 4 + ft * int(feature_row_bytes)
+    total_ids = int(ids.sum())
+    local_ids = int(np.trace(ids))
+    cross_ids = total_ids - local_ids
+    total_bytes = int(bytes_m.sum())
+    cross_bytes = total_bytes - int(np.trace(bytes_m))
+
+    mass = None
+    source = 'exchange'
+    cache = getattr(self, '_cold_cache', None)
+    if cache is not None and getattr(cache, 'shards', None):
+      ms = [sh.sketch.range_mass for sh in cache.shards
+            if sh.sketch.range_mass is not None]
+      if ms:
+        agg = np.sum(ms, axis=0)
+        if float(agg.sum()) > 0 and len(agg) == p:
+          mass, source = agg.astype(np.float64), 'gns_sketch'
+    if mass is None:
+      mass = ids.sum(axis=0).astype(np.float64)   # demand per range
+    total_mass = float(mass.sum())
+    k = min(max(1, p // 4) if top_k is None else max(int(top_k), 1),
+            max(p, 1))
+    hot = []
+    coverage = 0.0
+    if p and total_mass > 0:
+      order = np.argsort(-mass, kind='stable')[:k]
+      hot = [{'partition': int(r),
+              'share': round(float(mass[r] / total_mass), 6)}
+             for r in order]
+      coverage = round(float(mass[order].sum() / total_mass), 6)
+
+    if tick_metrics:
+      from ..telemetry.live import live
+      d_local = max(local_ids - self._attr_reported[0], 0)
+      d_cross = max(cross_ids - self._attr_reported[1], 0)
+      self._attr_reported = (local_ids, cross_ids)
+      if d_local:
+        live.counter('exchange.local_ids_total').inc(d_local)
+      if d_cross:
+        live.counter('exchange.cross_ids_total').inc(d_cross)
+
+    return {
+        'num_parts': p,
+        'feature_row_bytes': int(feature_row_bytes),
+        'frontier_ids': fr.tolist(),
+        'feature_ids': ft.tolist(),
+        'bytes_matrix': bytes_m.tolist(),
+        'local_ids': local_ids,
+        'cross_ids': cross_ids,
+        'cross_partition_ids_frac': (
+            round(cross_ids / total_ids, 6) if total_ids else 0.0),
+        'total_bytes': total_bytes,
+        'cross_partition_bytes': cross_bytes,
+        'cross_partition_bytes_frac': (
+            round(cross_bytes / total_bytes, 6) if total_bytes
+            else 0.0),
+        'hotness_source': source,
+        'top_k': k if p else 0,
+        'hot_ranges': hot,
+        'hot_range_coverage': coverage,
+    }
 
   def cluster_exchange_stats(self) -> dict:
     """CLUSTER-wide exchange health: raw totals plus the derived
@@ -2015,7 +2175,7 @@ class DistNeighborSampler(ExchangeTelemetry):
               else (lambda a: jax.device_put(a, shard)))
       self._cold_cache = MeshColdCache(
           cap, nf.shards.shape[-1], nf.shards.dtype, num_local,
-          self.mesh, self.axis, putS)
+          self.mesh, self.axis, putS, bounds=self.ds.graph.bounds)
     return self._cold_cache
 
   def _gns_arrays(self) -> jax.Array:
@@ -2429,7 +2589,10 @@ def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
     cur = starts_s[0].astype(jnp.int32)
     path = [cur]
     stats = jnp.zeros((3,), jnp.int32)
+    attr_owner = range_owner_fn(bounds)
+    attr_fr = jnp.zeros((num_parts,), jnp.int32)
     for h in range(walk_length):
+      attr_fr = attr_fr + dest_histogram(cur, attr_owner, num_parts)
       nbrs, mask, _, _w, hstats = _dist_one_hop(
           indptr_s[0], indices_s[0], None, bounds, cur, 1,
           jax.random.fold_in(key, h), axis, num_parts, False,
@@ -2443,7 +2606,8 @@ def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
       path.append(cur)
     walks = jnp.stack(path, axis=1)             # [B, L+1]
     full = jnp.concatenate(
-        [stats, jnp.zeros((4,), jnp.int32)])
+        [stats, jnp.zeros((4,), jnp.int32), attr_fr,
+         jnp.zeros((num_parts,), jnp.int32)])
     return walks[None], full[None]
 
   specs_in = (P(axis), P(axis), P(), P(axis), P())

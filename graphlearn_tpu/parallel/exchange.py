@@ -266,6 +266,28 @@ def capacity_spec(n: int, num_parts: int, slack: Optional[float],
   return dense
 
 
+def dest_histogram(ids: jax.Array, owner_fn: Callable,
+                   num_parts: int, valid=None) -> jax.Array:
+  """[P] int32 count of valid ids per destination partition — the
+  attribution row one device contributes to the fleet's P×P src→dst
+  traffic matrix (`ExchangeTelemetry.attribution_matrices`).
+
+  Keyed by ``owner_fn`` — callers pass the `PartitionBook` RANGE owner
+  (`partition_book.range_owner_fn`), so a row means "ids in range r"
+  even after an adopted book remaps which physical device serves r.
+  Traceable (runs inside the compiled step); invalid ids route to a
+  dropped overflow bin, never a partition.
+  """
+  if valid is None:
+    valid = ids >= 0
+  owner = jnp.where(valid, owner_fn(ids).astype(jnp.int32),
+                    jnp.int32(num_parts))
+  owner = jnp.clip(owner, 0, num_parts)
+  return jax.ops.segment_sum(
+      jnp.ones(ids.shape, jnp.int32), owner,
+      num_segments=num_parts + 1)[:num_parts]
+
+
 def _bcast(mask: jax.Array, values: jax.Array) -> jax.Array:
   """Broadcast a [F] mask over the trailing dims of [F, ...]."""
   return mask.reshape(mask.shape + (1,) * (values.ndim - 1))

@@ -374,8 +374,10 @@ class AdmissionController:
       return run
 
   def depth(self) -> int:
-    with self._lock:
-      return len(self._q)
+    # lock-free: len() of a deque is atomic in CPython, and the
+    # queue-depth gauge is sampled by the time-series cadence loop —
+    # a scrape or sweep must never contend with submit() for _lock
+    return len(self._q)
 
   def set_draining(self, on: bool) -> None:
     """Enter/leave the hot-swap cutover window: while on, NEW

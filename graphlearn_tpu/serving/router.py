@@ -681,6 +681,32 @@ class FleetRouter:
           'evictions': self.evictions,
       }
 
+  def make_scraper(self, registry=None, include_self: bool = True,
+                   scrape_ms: Optional[float] = None):
+    """A `telemetry.federation.FleetScraper` pre-populated with this
+    router's replica handles (`LocalReplica`s federate through their
+    heartbeats; `RemoteReplica`s through their ops endpoints when
+    they expose ``ops_url``) — one call wires ``/fleet`` for any
+    router-holding process (`OpsServer.attach_fleet`).  With
+    ``include_self`` the hosting process's own registry joins as
+    replica ``self``, so fleet aggregates cover the router's SLO /
+    admission gauges too."""
+    from ..telemetry.federation import FleetScraper
+    scraper = FleetScraper(scrape_ms=scrape_ms)
+    with self._lock:
+      handles = [(n, e['handle']) for n, e in self._replicas.items()]
+    for name, handle in handles:
+      url = getattr(handle, 'ops_url', None)
+      if url:
+        scraper.add_url(name, url)
+      else:
+        scraper.add_local_replica(name, handle)
+    if include_self:
+      if registry is None:
+        from ..telemetry.live import live as registry
+      scraper.add_registry('self', registry)
+    return scraper
+
   def _health(self) -> dict:
     """The `/healthz` fleet component: healthy while ANY replica can
     take traffic; carries each replica's state and its last heartbeat

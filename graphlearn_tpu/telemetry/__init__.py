@@ -73,8 +73,20 @@ The LIVE ops plane (ISSUE 12) sits beside the offline stack:
   * :mod:`~graphlearn_tpu.telemetry.postmortem` — the black box: on
     `MeshStallError` / irrecoverable peers / executor faults / fatal
     signals, one timestamped bundle (recorder ring + metrics snapshot
-    + health) to ``GLT_POSTMORTEM_DIR``, rendered by
-    ``report.py --postmortem``.
+    + health + time-series rings) to ``GLT_POSTMORTEM_DIR``, rendered
+    by ``report.py --postmortem``.
+
+The fleet signal plane (ISSUE 16) completes the live stack:
+
+  * :mod:`~graphlearn_tpu.telemetry.timeseries` — `TimeSeriesStore`:
+    fixed-cadence samples of every live gauge/counter into bounded
+    rings (counters become ``:rate`` series), served at
+    ``/timeseries`` and attached to post-mortem bundles.
+  * :mod:`~graphlearn_tpu.telemetry.federation` — `FleetScraper`:
+    polls replica ops endpoints / in-process registries, re-labels
+    each sample with ``replica=`` and merges ``glt_fleet_*``
+    aggregates, served at ``/fleet``
+    (``FleetRouter.make_scraper()`` wires a serving fleet up).
 
 The low-level counter/timer registry (`Metrics`, the global
 :data:`metrics`, `trace`, `capture`) still lives in
@@ -85,6 +97,7 @@ from __future__ import annotations
 from ..utils.profiling import (Metrics, capture, metrics, start_trace,
                                step_annotation, stop_trace, trace)
 from .aggregate import exchange_summary, gather_metrics, per_hop_padding
+from .federation import FleetScraper
 from .histogram import Histogram, from_snapshot
 from .live import LiveRegistry, live, parse_prometheus_text
 from .opsserver import OpsServer, maybe_start_from_env
@@ -93,10 +106,12 @@ from .sink import (artifact_path, append_record, summary_line,
                    write_artifact)
 from .slo import SloTracker
 from .spans import SpanContext, span
+from .timeseries import TimeSeriesStore
 
 __all__ = [
-    'EventRecorder', 'Histogram', 'LiveRegistry', 'Metrics',
-    'OpsServer', 'SloTracker', 'SpanContext',
+    'EventRecorder', 'FleetScraper', 'Histogram', 'LiveRegistry',
+    'Metrics', 'OpsServer', 'SloTracker', 'SpanContext',
+    'TimeSeriesStore',
     'append_record', 'artifact_path', 'capture', 'exchange_summary',
     'from_snapshot', 'gather_metrics', 'live', 'maybe_start_from_env',
     'metrics', 'parse_prometheus_text', 'per_hop_padding',

@@ -292,6 +292,14 @@ class LiveRegistry:
     return {'ok': ok, 'pid': os.getpid(), 'ts': round(time.time(), 3),
             'components': components}
 
+  def instruments(self) -> List[Tuple[str, _Metric]]:
+    """``[(kind, metric), ...]`` snapshot of every registered
+    instance — the declared sampling surface the time-series cadence
+    loop walks (counters become rates, gauges are evaluated; see
+    `telemetry.timeseries`)."""
+    with self._lock:
+      return [(kind, m) for (kind, _), m in self._instances.items()]
+
   # -- renderings ----------------------------------------------------------
   def _gauge_items(self) -> List[Tuple[Gauge, float]]:
     with self._lock:

@@ -12,6 +12,15 @@
     (producer/worker supervision state, per-bucket compile status,
     serving queue).  HTTP 200 when every component is healthy, 503
     otherwise — load-balancer-pollable.
+``/timeseries``
+    Windowed JSON history from the process `TimeSeriesStore` rings
+    (``?names=a,b`` filters by key/prefix, ``?window_s=60`` bounds
+    the lookback) — what a controller plots instead of point samples.
+``/fleet``
+    Federated exposition from an attached `FleetScraper` (per-replica
+    ``replica=`` labels + ``glt_fleet_*`` aggregates); ``?format=json``
+    returns the per-replica healthz rollup instead.  404 until a
+    scraper is attached with `OpsServer.attach_fleet`.
 
 Serving model: a `ThreadingHTTPServer` with daemon threads, so a
 slow, stalled or chaos-delayed scrape occupies ITS OWN thread and can
@@ -33,7 +42,7 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 OPS_PORT_ENV = 'GLT_OPS_PORT'
 OPS_HOST_ENV = 'GLT_OPS_HOST'
@@ -58,7 +67,9 @@ class _OpsHandler(BaseHTTPRequestHandler):
   def do_GET(self):                 # noqa: N802 — http.server API
     from ..testing import chaos
     registry = self.server.registry           # type: ignore[attr-defined]
-    path = urlparse(self.path).path
+    parsed = urlparse(self.path)
+    path = parsed.path
+    query = parse_qs(parsed.query)
     try:
       # chaos seam: a 'delay' stalls THIS handler thread (the
       # serving/fused hot paths must not notice), a 'drop' turns the
@@ -80,9 +91,51 @@ class _OpsHandler(BaseHTTPRequestHandler):
                 + '\n').encode('utf-8')
         ctype = 'application/json'
         status = 200 if health.get('ok') else 503
+      elif path == '/timeseries':
+        from . import timeseries
+        store = timeseries.global_store()
+        if store is None:
+          body = ('no time-series store in this process — set '
+                  'GLT_OPS_PORT via maybe_start_from_env or call '
+                  'timeseries.ensure_global()\n').encode('utf-8')
+          ctype = 'text/plain'
+          status = 404
+        else:
+          names = None
+          if query.get('names'):
+            names = [n for n in query['names'][0].split(',') if n]
+          window_s = None
+          if query.get('window_s'):
+            try:
+              window_s = float(query['window_s'][0])
+            except ValueError:
+              window_s = None
+          body = (json.dumps(store.query(names=names,
+                                         window_s=window_s),
+                             indent=1) + '\n').encode('utf-8')
+          ctype = 'application/json'
+          status = 200
+      elif path == '/fleet':
+        fleet = getattr(self.server, 'fleet', None)
+        if fleet is None:
+          body = ('no fleet scraper attached — call '
+                  'OpsServer.attach_fleet(FleetScraper(...))\n'
+                  ).encode('utf-8')
+          ctype = 'text/plain'
+          status = 404
+        elif query.get('format', ['prom'])[0] == 'json':
+          rollup = fleet.fleet_json()
+          body = (json.dumps(rollup, default=repr, indent=1)
+                  + '\n').encode('utf-8')
+          ctype = 'application/json'
+          status = 200 if rollup.get('ok') else 503
+        else:
+          body = fleet.prometheus_text().encode('utf-8')
+          ctype = 'text/plain; version=0.0.4; charset=utf-8'
+          status = 200
       else:
         body = (f'no such route {path!r} — try /metrics, /varz, '
-                '/healthz\n').encode('utf-8')
+                '/healthz, /timeseries, /fleet\n').encode('utf-8')
         ctype = 'text/plain'
         status = 404
     except chaos.InjectedFault as e:
@@ -119,10 +172,20 @@ class OpsServer:
     self._httpd.daemon_threads = True
     self._httpd.registry = registry           # type: ignore[attr-defined]
     self._httpd.scrapes = registry.counter('ops.scrapes_total')  # type: ignore[attr-defined]
+    self._httpd.fleet = None                  # type: ignore[attr-defined]
     self._thread = threading.Thread(
         target=self._httpd.serve_forever, daemon=True,
         name='glt-ops-server')
     self._thread.start()
+
+  def attach_fleet(self, scraper) -> None:
+    """Expose a `federation.FleetScraper` on the ``/fleet`` route
+    (pass None to detach)."""
+    self._httpd.fleet = scraper               # type: ignore[attr-defined]
+
+  @property
+  def fleet(self):
+    return getattr(self._httpd, 'fleet', None)
 
   @property
   def port(self) -> int:
@@ -161,6 +224,10 @@ def maybe_start_from_env() -> Optional[OpsServer]:
     if _global is None:
       try:
         _global = OpsServer(port=port)
+        # any process with an ops endpoint gets history for free —
+        # the /timeseries route and postmortem rings read this store
+        from . import timeseries
+        timeseries.ensure_global()
       except OSError as e:
         # observability plumbing must never take the data plane down:
         # a bind failure (EADDRINUSE — two processes inheriting one
@@ -184,3 +251,5 @@ def stop_global() -> None:
     if _global is not None:
       _global.close()
       _global = None
+      from . import timeseries
+      timeseries.stop_global()

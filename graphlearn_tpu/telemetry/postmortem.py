@@ -123,6 +123,15 @@ def _write_bundle(directory: str, reason: str,
   except Exception as e:            # noqa: BLE001 — a broken gauge
     # callback must not cost the operator the event ring
     bundle['metrics_error'] = f'{type(e).__name__}: {e}'
+  try:
+    # the history rings: a crash dump shows burn-rate / queue depth /
+    # ingest lag leading INTO the incident, not just the final sample
+    from . import timeseries
+    store = timeseries.global_store()
+    if store is not None:
+      bundle['timeseries'] = store.query()
+  except Exception as e:            # noqa: BLE001 — same contract
+    bundle['timeseries_error'] = f'{type(e).__name__}: {e}'
   bundle['recorder'] = rec_stats
   bundle['events'] = events
   os.makedirs(directory, exist_ok=True)

@@ -112,6 +112,14 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # stamped into failover_pin)
     ('dist.failover.recovery_secs', 'lower'),
     ('dist.failover.completed_ratio', 'higher'),
+    # traffic-attribution guard (ISSUE 16): the cross-partition byte
+    # share at the P=16 envelope must not creep up (locality erosion
+    # is invisible in throughput until it is not), and the top-K
+    # hot-range coverage the GNS/exchange hotness export sees must
+    # not collapse (a flat histogram means the sketch export lost the
+    # skew signal the cold-tier placement feeds on)
+    ('dist.attribution.cross_partition_bytes_frac', 'lower'),
+    ('dist.attribution.hot_range_coverage', 'higher'),
 )
 
 

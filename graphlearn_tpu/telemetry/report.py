@@ -12,7 +12,16 @@ go" without leaving the terminal:
 Modes:
   * ``--diff OTHER.jsonl``: second trace as baseline; the table gains
     a ``Δmean%`` column per stage (positive = this trace is slower) —
-    the two-trace regression hunt.
+    the two-trace regression hunt.  When BOTH files are ``/varz``
+    JSON snapshots (``{'ts', 'metrics': {...}}``) the diff is a
+    counter/gauge delta table instead — changed keys with Δ and
+    per-second rate over the snapshots' wall-clock gap.
+  * ``--attribution FILE``: render per-partition traffic attribution
+    (`DistNeighborSampler.attribution_stats` JSON, a bench envelope
+    row carrying an ``attribution`` block, or a records JSONL holding
+    one): the P×P src-device → dst-range byte matrix, the locality
+    summary, padding-waste-by-layout when the envelope's ``layouts``
+    comparison rides along, and the top-K hot-range table.
   * ``--chrome OUT.json``: also write the Perfetto-loadable Chrome
     trace (`telemetry.export`).
   * ``--metrics-json FILE``: instead of a JSONL trace, read a
@@ -321,6 +330,191 @@ def format_serving_health(block: Dict) -> str:
   return '\n'.join(lines)
 
 
+def load_varz_snapshot(path: str) -> Optional[Dict]:
+  """Load ``path`` if it is a ``/varz`` JSON snapshot (a single JSON
+  object with a ``metrics`` dict); None when it is anything else
+  (e.g. a recorder JSONL trace)."""
+  try:
+    with open(path) as f:
+      obj = json.load(f)
+  except (OSError, ValueError):
+    return None
+  if isinstance(obj, dict) and isinstance(obj.get('metrics'), dict):
+    return obj
+  return None
+
+
+def format_varz_diff(cur: Dict, base: Dict) -> str:
+  """Two-``/varz``-snapshot delta table: every key whose value
+  changed (plus appeared/removed keys), with Δ and Δ/s over the
+  snapshots' wall-clock gap.  Flat-encoded histogram bucket keys are
+  rolled up to their ``count``/``secs`` totals to keep the table
+  readable."""
+  from . import histogram as _hist
+  cm, bm = dict(cur['metrics']), dict(base['metrics'])
+  dt = float(cur.get('ts', 0)) - float(base.get('ts', 0))
+  for snap in (cm, bm):
+    for k in [k for k in snap if _hist.HIST_SEP in k]:
+      tail = k.rsplit(_hist.HIST_SEP, 1)[1]
+      if tail.startswith('b'):
+        snap.pop(k)
+  rows = []
+  for key in sorted(set(cm) | set(bm)):
+    b, c = bm.get(key), cm.get(key)
+    if b == c:
+      continue
+    d = (float(c) - float(b)) if (b is not None and c is not None) \
+        else None
+    rows.append([key,
+                 '-' if b is None else f'{float(b):g}',
+                 '-' if c is None else f'{float(c):g}',
+                 '-' if d is None else f'{d:+g}',
+                 '-' if d is None or dt <= 0 else f'{d / dt:.3g}'])
+  head = (f"# /varz diff: pid {base.get('pid')} @ {base.get('ts')} -> "
+          f"pid {cur.get('pid')} @ {cur.get('ts')} "
+          f"({dt:.1f}s apart)")
+  if not rows:
+    return head + '\n(no changed keys)'
+  return head + '\n' + _kv_table(
+      rows, ['key', 'baseline', 'current', 'Δ', 'Δ/s'])
+
+
+def _fmt_bytes(n: float) -> str:
+  for unit in ('B', 'KB', 'MB', 'GB'):
+    if abs(n) < 1024 or unit == 'GB':
+      return f'{n:.0f}{unit}' if unit == 'B' else f'{n:.1f}{unit}'
+    n /= 1024.0
+  return f'{n:.1f}GB'
+
+
+def find_attribution(path: str):
+  """Locate an attribution block in ``path``: the
+  `attribution_stats` dict itself, an envelope row carrying
+  ``attribution``, or a records JSONL holding such rows (the
+  highest-P row wins).  Returns ``(stats, layouts_or_None)``."""
+  def from_obj(obj):
+    if not isinstance(obj, dict):
+      return None
+    if 'bytes_matrix' in obj:
+      return obj, None
+    att = obj.get('attribution')
+    if isinstance(att, dict) and 'bytes_matrix' in att:
+      return att, obj.get('layouts')
+    return None
+  try:
+    with open(path) as f:
+      found = from_obj(json.load(f))
+    if found:
+      return found
+  except ValueError:
+    pass
+  best, best_p = None, -1
+  with open(path) as f:
+    for line in f:
+      line = line.strip()
+      if not line:
+        continue
+      try:
+        hit = from_obj(json.loads(line))
+      except ValueError:
+        continue
+      if hit and int(hit[0].get('num_parts', 0)) > best_p:
+        best, best_p = hit, int(hit[0].get('num_parts', 0))
+  if best is None:
+    raise SystemExit(f'no attribution block found in {path!r} — '
+                     'expected attribution_stats JSON, an envelope '
+                     'row with "attribution", or a records JSONL')
+  return best
+
+
+def format_attribution(stats: Dict,
+                       layouts: Optional[Dict] = None) -> str:
+  """Render one attribution block: locality summary, the P×P
+  src-device → dst-range byte matrix, the layout padding-waste
+  comparison (when present), and the hot-range table."""
+  p = int(stats.get('num_parts', 0))
+  out = [f"# traffic attribution (P={p}, "
+         f"feature_row_bytes={stats.get('feature_row_bytes')})"]
+  out.append(
+      f"  ids: local={stats.get('local_ids')} "
+      f"cross={stats.get('cross_ids')} "
+      f"cross_frac={stats.get('cross_partition_ids_frac')}")
+  out.append(
+      f"  bytes: total={_fmt_bytes(float(stats.get('total_bytes', 0)))} "
+      f"cross={_fmt_bytes(float(stats.get('cross_partition_bytes', 0)))} "
+      f"cross_frac={stats.get('cross_partition_bytes_frac')}")
+  mat = stats.get('bytes_matrix') or []
+  if mat:
+    out.append('# bytes by (src device -> dst range); '
+               'diagonal = partition-local')
+    rows = [[f'src{i}'] + [_fmt_bytes(float(v)) for v in r]
+            for i, r in enumerate(mat)]
+    out.append(_kv_table(rows, ['', *(f'r{j}' for j in
+                                      range(len(mat[0])))]))
+  if layouts:
+    out.append('# padding waste by exchange layout (same static '
+               'slack, one epoch each)')
+    lrows = [[name,
+              f"{blk.get('padding_waste_pct', '-')}",
+              f"{blk.get('drop_rate_pct', '-')}",
+              f"{blk.get('frontier_slots', '-')}",
+              f"{blk.get('frontier_offered', '-')}"]
+             for name, blk in sorted(layouts.items())]
+    out.append(_kv_table(lrows, ['layout', 'waste_pct', 'drop_pct',
+                                 'slots', 'offered']))
+  hot = stats.get('hot_ranges') or []
+  if hot:
+    out.append(f"# hot ranges (top-{stats.get('top_k')}, "
+               f"source={stats.get('hotness_source')}, "
+               f"coverage={stats.get('hot_range_coverage')})")
+    hrows = [[f"r{h['partition']}", f"{100.0 * h['share']:.1f}%"]
+             for h in hot]
+    out.append(_kv_table(hrows, ['range', 'share']))
+  return '\n'.join(out)
+
+
+_SPARK = ' ._-=+*#%@'
+
+
+def _sparkline(vals: List[float], width: int = 48) -> str:
+  """Coarse ASCII sparkline (min-max normalized, downsampled to
+  ``width`` columns) — enough to see a burn-rate ramp or a queue
+  flood in a terminal post-mortem."""
+  if not vals:
+    return ''
+  if len(vals) > width:
+    step = len(vals) / width
+    vals = [vals[int(i * step)] for i in range(width)]
+  lo, hi = min(vals), max(vals)
+  if hi <= lo:
+    return _SPARK[1] * len(vals)
+  scale = (len(_SPARK) - 1) / (hi - lo)
+  return ''.join(_SPARK[int((v - lo) * scale)] for v in vals)
+
+
+def format_timeseries(block: Dict) -> str:
+  """Render a `TimeSeriesStore.query` block (as attached to
+  post-mortem bundles): per-series span, last/min/max and a
+  sparkline — the "what was trending when it died" view."""
+  series = block.get('series') or {}
+  if not series:
+    return ''
+  out = [f"# time-series rings ({block.get('cadence_ms')}ms cadence, "
+         f"{block.get('retention_s')}s retention)"]
+  for key in sorted(series):
+    s = series[key]
+    pts = s.get('points') or []
+    if not pts:
+      continue
+    vals = [float(v) for _, v in pts]
+    span = float(pts[-1][0]) - float(pts[0][0])
+    out.append(f"  {key} [{s.get('kind')}] n={len(pts)} "
+               f"span={span:.0f}s last={vals[-1]:g} "
+               f"min={min(vals):g} max={max(vals):g}")
+    out.append(f'    |{_sparkline(vals)}|')
+  return '\n'.join(out)
+
+
 def render_postmortem(bundle: Dict) -> str:
   """The ``--postmortem`` view of one bundle: what died, what was in
   flight, what accelerated into the final window, the resilience /
@@ -395,6 +589,14 @@ def render_postmortem(bundle: Dict) -> str:
     out.append('# ingestion at dump')
     for k in ingest_keys:
       out.append(f'  {k}: {metrics_snap[k]}')
+  ts_block = bundle.get('timeseries')
+  if isinstance(ts_block, dict):
+    ts = format_timeseries(ts_block)
+    if ts:
+      out.append(ts)
+  elif bundle.get('timeseries_error'):
+    out.append('note: time-series rings unavailable: '
+               + str(bundle['timeseries_error']))
   hists = histograms_from_events(events)
   if hists:
     out.append('# per-stage span latencies (captured ring)')
@@ -436,14 +638,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                        '(GLT_POSTMORTEM_DIR output): spans in flight '
                        'at dump, final-window event deltas, '
                        'resilience/serving tables, supervision state')
+  ap.add_argument('--attribution', metavar='FILE',
+                  help='render per-partition traffic attribution '
+                       '(attribution_stats JSON, a bench envelope '
+                       'row, or a records JSONL): P×P byte matrix, '
+                       'padding-waste-by-layout, hot-range table')
   args = ap.parse_args(argv)
   if args.postmortem:
     from .postmortem import load_bundle
     print(render_postmortem(load_bundle(args.postmortem)))
     return 0
+  if args.attribution:
+    stats, layouts = find_attribution(args.attribution)
+    print(format_attribution(stats, layouts))
+    return 0
   if not args.trace and not args.metrics_json:
-    ap.error('need a TRACE.jsonl, --metrics-json FILE, or '
-             '--postmortem BUNDLE')
+    ap.error('need a TRACE.jsonl, --metrics-json FILE, '
+             '--attribution FILE, or --postmortem BUNDLE')
   if args.metrics_json:
     hists = histograms_from_metrics_json(args.metrics_json)
     print(f'# merged cross-host histograms ({args.metrics_json})')
@@ -454,6 +665,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                  'argument (a metrics aggregate has no events to '
                  'export or diff)')
       return 0
+  if args.trace and args.diff:
+    cur_varz = load_varz_snapshot(args.trace)
+    base_varz = load_varz_snapshot(args.diff)
+    if cur_varz is not None and base_varz is not None:
+      print(format_varz_diff(cur_varz, base_varz))
+      return 0
+    if (cur_varz is None) != (base_varz is None):
+      ap.error('--diff mixes a /varz JSON snapshot with a JSONL '
+               'trace — both sides must be the same kind')
   events = load_events(args.trace)
   hists = histograms_from_events(events)
   base = histograms_from_trace(args.diff) if args.diff else None

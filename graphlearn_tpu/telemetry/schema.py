@@ -428,6 +428,72 @@ METRIC_NAMES: Dict[str, str] = {
         'gauge: classification→first-served-batch wall time of the '
         'most recent partition adoption (shard load + lane rebuild '
         '+ exchange-plan recompile)',
+    'timeseries.samples_total':
+        'counter: cadence-sampler sweeps completed by the '
+        'TimeSeriesStore (one per GLT_TS_CADENCE_MS tick; a stalled '
+        'counter here means the history rings have stopped filling)',
+    'timeseries.series':
+        'gauge: ring-buffered series currently held by the '
+        'TimeSeriesStore (gauges plus counters-as-rates)',
+    'fleet.scrapes_total':
+        'counter: FleetScraper sweeps over the replica target set '
+        '(one per GLT_FLEET_SCRAPE_MS tick or explicit scrape)',
+    'fleet.scrape_errors_total':
+        'counter: replica scrapes that failed (unreachable '
+        'endpoint, malformed exposition), labeled by replica',
+    'fleet.replicas_up':
+        'gauge: replicas whose most recent scrape succeeded and '
+        'whose /healthz rollup reported ok — the federation\'s own '
+        'liveness view of the fleet',
+    'gns.range_hotness':
+        'gauge: decayed visit mass of one PartitionBook range from '
+        'the GNS DecayedSketch top-K export, labeled by partition '
+        '(only the K hottest ranges are exported)',
+    'exchange.local_ids_total':
+        'counter: exchange ids (frontier + feature) whose '
+        'destination range was the requesting device\'s own — the '
+        'attribution matrix diagonal, ticked at attribution drains',
+    'exchange.cross_ids_total':
+        'counter: exchange ids routed to a NON-self partition range '
+        '(off-diagonal attribution mass — what locality-aware '
+        'partitioning exists to shrink)',
+}
+
+
+#: closed label-key vocabulary of the live metric plane.  Every
+#: ``labels={...}`` at a counter/gauge/histogram registration site
+#: must draw its KEYS from this table (enforced statically by the
+#: glint ``metric-label-cardinality`` pass) and each entry documents
+#: the closed/bounded VALUE set — the property that keeps scrape
+#: cardinality enumerable (a label whose values are unbounded is a
+#: time-series leak: every new value mints a family member forever).
+METRIC_LABELS: Dict[str, str] = {
+    'scope':
+        'cold-cache scope: feature|dist|serving|hetero (the four '
+        'cache flavors — see cache.*_total)',
+    'bucket':
+        'serving bucket capacity: one of the GLT_SERVING_BUCKETS '
+        'ladder seeds (default 1,2,4,8,16 — bounded by the ladder '
+        'length)',
+    'state':
+        'FleetRouter replica state: healthy|overloaded|draining|'
+        'dead (fixed four-state machine)',
+    'reason':
+        'admission shed reason: queue_full|deadline|too_large|'
+        'draining|shutdown (the typed rejection vocabulary)',
+    'outcome':
+        'hot-swap outcome: ok|rolled_back|aborted (fixed three-way '
+        'verdict of serving.swaps_total)',
+    'window':
+        'SLO sliding window: one of SloTracker.windows rendered as '
+        '"<seconds>s" (default 60s|300s — bounded by the '
+        'configured window tuple)',
+    'replica':
+        'fleet replica name: bounded by the fleet size (the '
+        'FleetScraper target set / FleetRouter replica table)',
+    'partition':
+        'partition/range index: 0..P-1, bounded by the mesh '
+        'num_parts (PartitionBook range ids)',
 }
 
 

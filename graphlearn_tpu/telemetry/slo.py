@@ -213,15 +213,20 @@ class SloTracker:
     a 20 ms memo collapses their six copy+sort evaluations into at
     most one per window — while staying far below any real scrape
     interval, so back-to-back scrapes (and asserts right after a
-    traffic burst) always see fresh samples."""
+    traffic burst) always see fresh samples.
+
+    Memo HITS are lock-free: the cache dict is only ever read/written
+    whole-entry (CPython dict get/set are atomic), so the time-series
+    cadence loop sampling these gauges at high rate never contends
+    with `observe()` on the tracker lock — only the one fresh
+    `window_stats` per 20 ms burst pays it (pinned by the
+    lock-acquisition test in ``tests/test_timeseries.py``)."""
     now = self._clock()
-    with self._lock:
-      entry = self._stats_cache.get(window)
+    entry = self._stats_cache.get(window)
     if entry is not None and now - entry[0] < 0.02:
       return entry[1]
     st = self.window_stats(window, now)
-    with self._lock:
-      self._stats_cache[window] = (now, st)
+    self._stats_cache[window] = (now, st)
     return st
 
   # -- export --------------------------------------------------------------

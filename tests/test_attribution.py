@@ -1,0 +1,221 @@
+"""Traffic-attribution tests (ISSUE 16 leg 3): the per-(src, dst)
+exchange matrices accumulated by the mesh engine, the byte/hotness
+rollup feeding ``report.py --attribution`` and the regress guards,
+snapshot round-trips (including pre-attribution state compat), the
+GNS per-range hotness sketch, and the report renderings.
+
+Same virtual 8-device CPU mesh + deterministic ring graph as
+test_dist_sampler.py, so every expected count is derivable by hand:
+with the interleaved partition book (owner = v mod 4) and fanout
+[2], node v's neighbors v+1 and v+2 land in ranges (v+1)%4 and
+(v+2)%4 — mostly remote by construction.
+"""
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from graphlearn_tpu.ops.gns import DecayedSketch, register_hotness_gauges
+from graphlearn_tpu.parallel import DistNeighborSampler, make_mesh
+from graphlearn_tpu.parallel.exchange import dest_histogram
+from graphlearn_tpu.telemetry import LiveRegistry, Metrics
+from graphlearn_tpu.telemetry.report import (find_attribution,
+                                             format_attribution,
+                                             format_varz_diff,
+                                             load_varz_snapshot)
+
+from test_dist_sampler import _ring_dist_dataset
+
+
+def _sampled_ring_sampler():
+  ds = _ring_dist_dataset(4)
+  s = DistNeighborSampler(ds, [2], mesh=make_mesh(4), seed=0)
+  seeds = ds.old2new[np.arange(16).reshape(4, 4)]
+  s.sample_from_nodes(seeds)
+  return ds, s
+
+
+def test_attribution_matrices_ring_exact():
+  _, s = _sampled_ring_sampler()
+  fr, ft = s.attribution_matrices()
+  # frontier exchange: each device requests one id from every range
+  np.testing.assert_array_equal(fr, np.ones((4, 4), np.int64))
+  # feature exchange: each device gathers its 4 seeds + 2 unique
+  # frontier nodes, and the interleaved book spreads every device's
+  # gather [2, 2, 1, 1] across ranges 0..3
+  np.testing.assert_array_equal(ft, np.tile([2, 2, 1, 1], (4, 1)))
+  assert ft.dtype == np.int64 and np.trace(ft) == 6
+  # draining twice without sampling again returns the SAME totals
+  fr2, ft2 = s.attribution_matrices()
+  np.testing.assert_array_equal(fr, fr2)
+  np.testing.assert_array_equal(ft, ft2)
+
+
+def test_attribution_stats_rollup():
+  _, s = _sampled_ring_sampler()
+  st = s.attribution_stats(tick_metrics=False)
+  assert st['num_parts'] == 4
+  assert st['feature_row_bytes'] == 16        # 4 float32 features
+  ids = np.asarray(st['frontier_ids']) + np.asarray(st['feature_ids'])
+  assert st['local_ids'] == int(np.trace(ids))
+  assert st['cross_ids'] == int(ids.sum() - np.trace(ids))
+  assert st['cross_partition_ids_frac'] == pytest.approx(0.75)
+  assert st['cross_partition_bytes_frac'] == pytest.approx(0.75)
+  # byte weighting: frontier ids 4 B, feature ids one 16 B row
+  bm = np.asarray(st['bytes_matrix'])
+  np.testing.assert_array_equal(
+      bm, np.asarray(st['frontier_ids']) * 4
+      + np.asarray(st['feature_ids']) * 16)
+  # no GNS sketch on this sampler: hotness falls back to measured
+  # column mass, K = max(1, P // 4) = 1
+  assert st['hotness_source'] == 'exchange'
+  assert st['top_k'] == 1 and len(st['hot_ranges']) == 1
+  assert st['hot_range_coverage'] == pytest.approx(
+      st['hot_ranges'][0]['share'])
+  json.dumps(st)                      # pure-Python, JSON-safe
+
+
+def test_attribution_snapshot_roundtrip():
+  ds, s = _sampled_ring_sampler()
+  fr, ft = s.attribution_matrices()
+  packed = s._stats_state()
+  s2 = DistNeighborSampler(ds, [2], mesh=make_mesh(4), seed=0)
+  s2._load_stats_state(packed)
+  fr2, ft2 = s2.attribution_matrices()
+  np.testing.assert_array_equal(fr, fr2)
+  np.testing.assert_array_equal(ft, ft2)
+
+
+def test_pre_attribution_snapshot_restores_cold():
+  """A snapshot taken before the attribution tail existed (13 int64s:
+  7 exchange counters + 6 cold-tier counters) restores the counters
+  and restarts the matrix cold — never a reshape crash."""
+  ds, s = _sampled_ring_sampler()
+  old = np.arange(13, dtype=np.int64)
+  s._load_stats_state(old)
+  fr, ft = s.attribution_matrices()
+  np.testing.assert_array_equal(fr, np.zeros((4, 4), np.int64))
+  np.testing.assert_array_equal(ft, np.zeros((4, 4), np.int64))
+
+
+def test_attribution_tick_metrics_watermark():
+  """`attribution_stats(tick_metrics=True)` ticks the global
+  exchange.{local,cross}_ids_total counters by the DELTA since the
+  last report — calling twice must not double-count."""
+  from graphlearn_tpu.telemetry.live import live
+  _, s = _sampled_ring_sampler()
+  c_local = live.counter('exchange.local_ids_total')
+  c_cross = live.counter('exchange.cross_ids_total')
+  base = (c_local.value(), c_cross.value())
+  st = s.attribution_stats()
+  assert c_local.value() - base[0] == st['local_ids']
+  assert c_cross.value() - base[1] == st['cross_ids']
+  s.attribution_stats()               # watermarked: no new ticks
+  assert c_local.value() - base[0] == st['local_ids']
+  assert c_cross.value() - base[1] == st['cross_ids']
+
+
+def test_dest_histogram_matches_numpy():
+  bounds = np.array([0, 16, 32, 48, 64], np.int64)
+
+  def owner(ids):
+    return jnp.searchsorted(jnp.asarray(bounds), ids, side='right') - 1
+
+  ids = jnp.array([0, 5, 17, 33, 50, 63, -1, -1], jnp.int32)
+  h = np.asarray(dest_histogram(ids, owner, 4))
+  ref = np.bincount(
+      np.searchsorted(bounds, [0, 5, 17, 33, 50, 63], side='right') - 1,
+      minlength=4)
+  np.testing.assert_array_equal(h, ref)
+  assert h.sum() == 6                 # invalid ids route to no range
+
+
+def test_gns_sketch_range_mass_and_hot_ranges():
+  bounds = np.array([0, 16, 32, 48, 64], np.int64)
+  sk = DecayedSketch(slots=64, decay=0.5, bounds=bounds)
+  sk.update(np.array([1, 2, 3, 17, 50], np.int64))       # 3/1/0/1
+  sk.update(np.array([4, 5], np.int64))                  # decayed +2
+  assert sk.range_mass is not None and len(sk.range_mass) == 4
+  # round 1 decayed once: [3, 1, 0, 1] * 0.5 + [2, 0, 0, 0]
+  np.testing.assert_allclose(sk.range_mass, [3.5, 0.5, 0.0, 0.5])
+  hot = sk.hot_ranges(2)
+  assert hot[0][0] == 0 and hot[0][1] == pytest.approx(3.5 / 4.5)
+  # state round-trip carries the mass; an OLD state without the
+  # range_mass key restores with the mass intact (no crash)
+  st = sk.state_dict()
+  sk2 = DecayedSketch(slots=64, decay=0.5, bounds=bounds)
+  sk2.load_state_dict(st)
+  np.testing.assert_allclose(sk2.range_mass, sk.range_mass)
+  del st['range_mass']
+  sk2.load_state_dict(st)             # pre-attribution state: ok
+
+
+def test_register_hotness_gauges_top_k_only():
+  bounds = np.array([0, 16, 32, 48, 64], np.int64)
+  sk = DecayedSketch(slots=64, decay=1.0, bounds=bounds)
+  sk.update(np.array([1, 2, 3, 17], np.int64))           # 3/1/0/0
+
+  reg = LiveRegistry(store=Metrics(), strict=True)
+  fns = register_hotness_gauges(lambda: [sk], 4, registry=reg)
+  assert len(fns) == 4
+  text = reg.prometheus_text()
+  # only the top-K (K = max(1, 4 // 4) = 1) ranges sample a value
+  assert 'glt_gns_range_hotness{partition="0"} 0.75' in text
+  assert text.count('glt_gns_range_hotness{') == 1
+
+
+def test_report_attribution_render_and_find(tmp_path):
+  _, s = _sampled_ring_sampler()
+  st = s.attribution_stats(tick_metrics=False)
+  # whole-file JSON with an 'attribution' key (the bench artifact lift)
+  art = tmp_path / 'row.json'
+  art.write_text(json.dumps(
+      {'num_parts': 4, 'attribution': st,
+       'layouts': {'dense': {'padding_waste_pct': 12.5,
+                             'drop_rate_pct': 0.0}}}))
+  stats, layouts = find_attribution(str(art))
+  assert stats['num_parts'] == 4 and layouts
+  text = format_attribution(stats, layouts)
+  assert 'traffic attribution (P=4' in text
+  assert 'cross_frac=0.75' in text
+  assert 'src0' in text and 'r3' in text
+  assert 'hot ranges' in text and 'source=exchange' in text
+  # JSONL line-scan path: the highest-P envelope row wins
+  rows = tmp_path / 'records.jsonl'
+  small = dict(st, num_parts=2)
+  rows.write_text(
+      json.dumps({'attribution': small}) + '\n'
+      + json.dumps({'attribution': st}) + '\n')
+  stats2, _ = find_attribution(str(rows))
+  assert stats2['num_parts'] == 4
+  with pytest.raises(SystemExit):
+    empty = tmp_path / 'none.jsonl'
+    empty.write_text('{"no": "attribution"}\n')
+    find_attribution(str(empty))
+
+
+def test_report_varz_diff(tmp_path):
+  base = {'ts': 1.0, 'metrics': {
+      'dist.exchange.cross_ids': 10.0, 'span.step.hist.count': 4.0,
+      'span.step.hist.b03': 2.0, 'span.step.hist.secs': 0.5}}
+  cur = {'ts': 11.0, 'metrics': {
+      'dist.exchange.cross_ids': 30.0, 'span.step.hist.count': 8.0,
+      'span.step.hist.b03': 6.0, 'span.step.hist.secs': 1.0,
+      'dist.new_metric': 1.0}}
+  b = tmp_path / 'base.json'
+  c = tmp_path / 'cur.json'
+  b.write_text(json.dumps(base))
+  c.write_text(json.dumps(cur))
+  assert load_varz_snapshot(str(b)) == base
+  text = format_varz_diff(load_varz_snapshot(str(c)),
+                          load_varz_snapshot(str(b)))
+  assert 'dist.exchange.cross_ids' in text and '+20' in text
+  assert 'dist.new_metric' in text
+  # per-bucket histogram keys roll up — count/secs survive
+  assert 'b03' not in text
+  assert 'span.step.hist.count' in text
+  # a JSONL trace is NOT a varz snapshot
+  j = tmp_path / 'trace.jsonl'
+  j.write_text('{"kind": "x"}\n{"kind": "y"}\n')
+  assert load_varz_snapshot(str(j)) is None

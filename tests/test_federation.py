@@ -1,0 +1,302 @@
+"""Fleet federation tests (ISSUE 16 leg 2): per-replica merge under
+the ``replica=`` label, fleet aggregates (counter sum / gauge max /
+histogram quantile-merge), the ``/fleet`` route, healthz rollup, and
+the acceptance gate — a strict `parse_prometheus_text` round-trip of
+the federated exposition with ≥2 replicas under concurrent traffic."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from graphlearn_tpu.telemetry import (LiveRegistry, Metrics, OpsServer,
+                                      parse_prometheus_text)
+from graphlearn_tpu.telemetry.federation import (FleetScraper,
+                                                 LocalReplicaTarget,
+                                                 ReplicaTarget,
+                                                 parse_exposition)
+
+
+def _reg():
+  # each in-process "replica" needs its OWN backing store — a shared
+  # process-global Metrics would double-count the fleet sums
+  return LiveRegistry(store=Metrics(), strict=True)
+
+
+def _scraper(**kw):
+  return FleetScraper(registry=_reg(), **kw)
+
+
+def _two_replica_fleet():
+  r1, r2 = _reg(), _reg()
+  r1.counter('serving.requests_total').inc(3)
+  r2.counter('serving.requests_total').inc(7)
+  r1.gauge('serving.queue_depth', fn=lambda: 2.0)
+  r2.gauge('serving.queue_depth', fn=lambda: 9.0)
+  for v in (0.001, 0.002, 0.004):
+    r1.histogram('serving.request_latency').observe(v)
+  for v in (0.05, 0.1):
+    r2.histogram('serving.request_latency').observe(v)
+  fs = _scraper()
+  fs.add_registry('a', r1)
+  fs.add_registry('b', r2)
+  return fs, r1, r2
+
+
+def test_parse_exposition_structure():
+  text = ('# HELP glt_x a thing\n# TYPE glt_x counter\n'
+          'glt_x{replica="a"} 3\nglt_x 1\n'
+          '# TYPE glt_h histogram\n'
+          'glt_h_bucket{le="+Inf"} 2\nglt_h_sum 0.5\nglt_h_count 2\n')
+  fams = parse_exposition(text)
+  assert fams['glt_x']['type'] == 'counter'
+  assert fams['glt_x']['help'] == 'a thing'
+  assert (('glt_x', [('replica', 'a')], 3.0)
+          in fams['glt_x']['samples'])
+  # _bucket/_sum/_count samples all group under the histogram family
+  assert fams['glt_h']['type'] == 'histogram'
+  names = {s[0] for s in fams['glt_h']['samples']}
+  assert names == {'glt_h_bucket', 'glt_h_sum', 'glt_h_count'}
+
+
+def test_merge_counter_sum_gauge_max_histogram_quantiles():
+  fs, _, _ = _two_replica_fleet()
+  fs.scrape()
+  text = fs.prometheus_text()
+  metrics = parse_prometheus_text(text)   # strict: raises on junk
+  # per-replica samples survive under the replica label
+  assert metrics['glt_serving_requests_total{replica="a"}'] == 3.0
+  assert metrics['glt_serving_requests_total{replica="b"}'] == 7.0
+  # aggregates: counters sum, gauges max
+  assert metrics['glt_fleet_serving_requests_total'] == 10.0
+  assert metrics['glt_fleet_serving_queue_depth'] == 9.0
+  # histogram: bucket-vector sum + nearest-rank merged quantiles
+  assert metrics['glt_fleet_serving_request_latency_count'] == 5.0
+  assert metrics['glt_fleet_serving_request_latency_p50_secs'] == \
+      pytest.approx(0.004096)
+  assert metrics['glt_fleet_serving_request_latency_p99_secs'] == \
+      pytest.approx(0.131072)
+
+
+def test_fleet_json_rollup_and_error_entry():
+  fs, _, _ = _two_replica_fleet()
+
+  class Dead(ReplicaTarget):
+    def scrape(self):
+      raise OSError('connection refused')
+
+  fs.add_target(Dead('c'))
+  fs.scrape()
+  roll = fs.fleet_json()
+  assert roll['schema'] == 'glt.fleet.v1'
+  assert roll['ok'] is False          # one unscrapeable replica
+  assert roll['replicas_up'] == 2
+  assert 'OSError' in roll['replicas']['c']['error']
+  assert roll['replicas']['a']['ok'] and roll['replicas']['b']['ok']
+  # the per-replica scrape-error counter ticked for c only
+  assert fs._err_counters['c'].value() >= 1.0
+  assert fs._err_counters['a'].value() == 0.0
+
+
+def test_malformed_replica_is_refused_not_merged():
+  fs = _scraper()
+
+  class Junk(ReplicaTarget):
+    def scrape(self):
+      return 'glt_x this-is-not-a-number\n', {'ok': True}
+
+  fs.add_target(Junk('bad'))
+  good = _reg()
+  good.counter('serving.requests_total').inc(1)
+  fs.add_registry('good', good)
+  last = fs.scrape()
+  assert not last['bad']['ok'] and last['bad']['error']
+  # the merged exposition still strict-parses — junk never leaks in
+  parse_prometheus_text(fs.prometheus_text())
+  assert fs.fleet_json()['ok'] is False
+
+
+def test_http_target_scrapes_real_ops_server():
+  reg = _reg()
+  reg.counter('serving.requests_total').inc(4)
+  srv = OpsServer(registry=reg, port=0)
+  try:
+    fs = _scraper()
+    fs.add_url('web', srv.url)
+    last = fs.scrape()
+    assert last['web']['ok'], last['web']['error']
+    metrics = parse_prometheus_text(fs.prometheus_text())
+    assert metrics['glt_serving_requests_total{replica="web"}'] == 4.0
+    assert metrics['glt_fleet_serving_requests_total'] == 4.0
+  finally:
+    srv.close()
+
+
+def test_local_replica_target_renders_heartbeat_gauges():
+  class FakeReplica:
+    def heartbeat(self):
+      return {'serving': {'inflight': 3, 'healthy': True},
+              'epoch': 7}
+
+  t = LocalReplicaTarget('r0', FakeReplica())
+  text, health = t.scrape()
+  metrics = parse_prometheus_text(text)
+  assert metrics['glt_serving_inflight'] == 3.0
+  assert metrics['glt_epoch'] == 7.0
+  assert 'glt_serving_healthy' not in metrics   # bools are skipped
+  assert health['ok'] is True
+  fs = _scraper()
+  fs.add_local_replica('r0', FakeReplica())
+  fs.scrape()
+  merged = parse_prometheus_text(fs.prometheus_text())
+  assert merged['glt_serving_inflight{replica="r0"}'] == 3.0
+
+
+def test_fleet_route_prom_and_json():
+  fs, _, _ = _two_replica_fleet()
+  fs.scrape()
+  reg = _reg()
+  srv = OpsServer(registry=reg, port=0)
+  try:
+    srv.attach_fleet(fs)
+    with urllib.request.urlopen(f'{srv.url}/fleet', timeout=10) as r:
+      body = r.read().decode('utf-8')
+      assert r.status == 200
+    metrics = parse_prometheus_text(body)
+    assert metrics['glt_fleet_serving_requests_total'] == 10.0
+    with urllib.request.urlopen(f'{srv.url}/fleet?format=json',
+                                timeout=10) as r:
+      roll = json.loads(r.read())
+    assert roll['schema'] == 'glt.fleet.v1'
+    assert roll['ok'] is True and roll['replicas_up'] == 2
+  finally:
+    srv.close()
+
+
+def test_fleet_route_503_when_replica_down_and_404_unattached():
+  srv = OpsServer(registry=_reg(), port=0)
+  try:
+    with pytest.raises(urllib.error.HTTPError) as ei:
+      urllib.request.urlopen(f'{srv.url}/fleet', timeout=10)
+    assert ei.value.code == 404
+  finally:
+    srv.close()
+  fs = _scraper()
+
+  class Dead(ReplicaTarget):
+    def scrape(self):
+      raise OSError('down')
+
+  fs.add_target(Dead('c'))
+  fs.scrape()
+  srv = OpsServer(registry=_reg(), port=0)
+  try:
+    srv.attach_fleet(fs)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+      urllib.request.urlopen(f'{srv.url}/fleet?format=json',
+                             timeout=10)
+    assert ei.value.code == 503
+    roll = json.loads(ei.value.read())
+    assert roll['ok'] is False
+  finally:
+    srv.close()
+
+
+def test_strict_roundtrip_under_concurrent_traffic():
+  """The acceptance gate in miniature: two live replicas take writes
+  from worker threads while the scraper repeatedly federates; every
+  single exposition must strict-parse and the fleet counter sum must
+  equal the per-replica sum WITHIN that exposition (the merge is a
+  consistent view of whatever the scrape saw)."""
+  r1, r2 = _reg(), _reg()
+  c1 = r1.counter('serving.requests_total')
+  c2 = r2.counter('serving.requests_total')
+  r1.histogram('serving.request_latency')
+  r2.histogram('serving.request_latency')
+  fs = _scraper()
+  fs.add_registry('a', r1)
+  fs.add_registry('b', r2)
+  stop = threading.Event()
+
+  def writer(c, reg):
+    h = reg.histogram('serving.request_latency')
+    while not stop.is_set():
+      c.inc()
+      h.observe(0.002)
+
+  threads = [threading.Thread(target=writer, args=args, daemon=True)
+             for args in ((c1, r1), (c2, r2))]
+  for t in threads:
+    t.start()
+  try:
+    deadline = time.monotonic() + 10.0
+    rounds = 0
+    while rounds < 40 and time.monotonic() < deadline:
+      fs.scrape()
+      metrics = parse_prometheus_text(fs.prometheus_text())  # strict
+      total = metrics['glt_fleet_serving_requests_total']
+      per = (metrics['glt_serving_requests_total{replica="a"}']
+             + metrics['glt_serving_requests_total{replica="b"}'])
+      assert total == per
+      rounds += 1
+  finally:
+    stop.set()
+    for t in threads:
+      t.join(5)
+  assert rounds >= 10
+  assert parse_prometheus_text(
+      fs.prometheus_text())['glt_fleet_serving_requests_total'] > 0
+
+
+def test_router_make_scraper_federates_replicas():
+  """`FleetRouter.make_scraper` is the one-call wiring: every replica
+  handle becomes a target (LocalReplica → heartbeat gauges) and the
+  hosting registry joins as ``self``."""
+  from graphlearn_tpu.serving.router import FleetRouter
+
+  class FakeReplica:
+    def __init__(self, name, inflight):
+      self.name = name
+      self._inflight = inflight
+
+    def heartbeat(self):
+      return {'serving': {'inflight': self._inflight}}
+
+    def reachable(self):
+      return True
+
+  host = _reg()
+  host.counter('serving.requests_total').inc(5)
+  router = FleetRouter([FakeReplica('r0', 1), FakeReplica('r1', 4)],
+                       auto_start=False)
+  fs = router.make_scraper(registry=host)
+  try:
+    fs.scrape()
+    metrics = parse_prometheus_text(fs.prometheus_text())
+    assert metrics['glt_serving_inflight{replica="r0"}'] == 1.0
+    assert metrics['glt_serving_inflight{replica="r1"}'] == 4.0
+    assert metrics['glt_fleet_serving_inflight'] == 4.0      # gauge max
+    assert metrics['glt_serving_requests_total{replica="self"}'] == 5.0
+    assert fs.fleet_json()['replicas_up'] == 3
+  finally:
+    fs.close()
+    router.close()
+
+
+def test_scrape_loop_start_close():
+  fs = _scraper(scrape_ms=10)
+  reg = _reg()
+  reg.counter('serving.requests_total').inc(2)
+  fs.add_registry('a', reg)
+  fs.start()
+  try:
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+      if fs._latest().get('a', {}).get('ok'):
+        break
+      time.sleep(0.02)
+    assert fs._latest()['a']['ok']
+  finally:
+    fs.close()

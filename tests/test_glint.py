@@ -416,6 +416,75 @@ def test_metric_name_ignores_non_package_files(tmp_path):
                                 rel='tests/mod.py', run=run))
 
 
+# -- metric-label-cardinality --------------------------------------------------
+def _label_fixture(tmp_path, labels) -> Run:
+  schema = tmp_path / 'schema.py'
+  table = '{' + ', '.join(f'{k!r}: {v!r}'
+                          for k, v in labels.items()) + '}'
+  schema.write_text(f'METRIC_LABELS = {table}\n')
+  return Run(repo=tmp_path, schema_path=schema, pkg_prefix='pkg')
+
+
+def test_metric_label_positive(tmp_path):
+  run = _label_fixture(tmp_path, {
+      'stale_key': 'nothing labels with this anymore',
+      'short_doc': 'tiny',
+  })
+  src = _src('''
+      mystery = compute_labels()
+
+      def wire(live, key):
+        live.counter('a.b_total', labels={'rogue': 'x'})
+        live.counter('a.c_total', labels={key: 'x'})
+        live.gauge('a.d', labels=mystery)
+        live.counter('a.e_total', labels={'short_doc': 'x'})
+  ''')
+  found = _live(check_source(src, 'metric-label-cardinality',
+                             rel='pkg/mod.py', run=run))
+  msgs = '\n'.join(f.render() for f in found)
+  # rogue undeclared, {key: ...} non-constant key, `mystery` neither
+  # a param nor a unique dict assignment, stale_key unregistered,
+  # short_doc's doc too short to state the bounded domain
+  assert "'rogue'" in msgs and 'not declared' in msgs
+  assert 'non-string-constant' in msgs
+  assert "'mystery'" in msgs and 'unique dict literal' in msgs
+  assert "'stale_key'" in msgs and 'no remaining' in msgs
+  assert "'short_doc'" in msgs and 'cardinality contract' in msgs
+  assert len(found) == 5, msgs
+
+
+def test_metric_label_negative(tmp_path):
+  run = _label_fixture(tmp_path, {
+      'scope': 'cache scope: one of four fixed cache flavors',
+      'bucket': 'bucket capacity: bounded by the serving ladder',
+      'window': 'SLO window: bounded by the configured tuple',
+  })
+  # the four clean conventions: literal dict (dynamic VALUE is fine),
+  # positional dict, a forwarding helper whose labels is a parameter,
+  # and a bare name bound once to a dict literal in the same file
+  src = _src('''
+      def helper(live, name, labels, fn):
+        live.gauge(name, labels=labels, fn=fn)
+
+      def wire(live, cap, scope):
+        live.histogram('a.lat', labels={'bucket': cap})
+        live.gauge('a.burn', {'window': '60s'}, lambda: 1.0)
+        live.counter('a.plain_total', labels=None)
+        labels = {'scope': scope}
+        live.counter('a.hits_total', labels=labels)
+        helper(live, 'a.g', {'window': '300s'}, lambda: 2.0)
+  ''')
+  assert not _live(check_source(src, 'metric-label-cardinality',
+                                rel='pkg/mod.py', run=run))
+
+
+def test_metric_label_ignores_non_package_files(tmp_path):
+  run = _label_fixture(tmp_path, {})
+  src = "def go(reg):\n  reg.counter('x.y_total', labels={'z': 1})\n"
+  assert not _live(check_source(src, 'metric-label-cardinality',
+                                rel='tests/mod.py', run=run))
+
+
 # -- suppressions --------------------------------------------------------------
 def test_inline_suppression_trailing_and_standalone():
   src = _src('''

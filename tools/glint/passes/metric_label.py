@@ -1,0 +1,190 @@
+"""metric-label-cardinality: metric labels draw KEYS from a closed set.
+
+The label twin of the metric-name pass (ISSUE 16): a metric NAME
+outside the vocabulary is one undiscoverable panel, but a label KEY
+outside the vocabulary is worse — every distinct value mints a new
+time series forever, so an unbounded label is a scrape-cardinality
+leak that grows until the fleet scraper (`telemetry/federation.py`)
+chokes on it.  The ``METRIC_LABELS`` dict literal in
+``telemetry/schema.py`` is the closed key set, and each entry
+documents why the VALUE domain is bounded.
+
+Call sites are the same registration surface the metric-name pass
+scans — ``counter(...)`` / ``gauge(...)`` / ``histogram(...)``
+(terminal callee name) inside the package — restricted to those that
+pass labels at all.  The labels value resolves statically:
+
+  * ``labels={...}`` keyword or a positional dict literal (the
+    `SloTracker` helper convention) — checked directly;
+  * a bare name bound by a UNIQUE dict-literal assignment somewhere
+    in the same file (the `cold_cache` shared-labels convention) —
+    checked through the assignment;
+  * a bare name that is a parameter of an enclosing function (a
+    forwarding helper like `SloTracker._register_gauges.gauge`) —
+    skipped: the helper's own call sites are scanned instead;
+  * ``None`` (no labels) — skipped.
+
+Anything else is flagged as statically unresolvable — pass a dict
+literal.  Every resolved key must be a string constant declared in
+``METRIC_LABELS``; `finish` also flags stale declarations (no
+remaining use site) and entries whose doc does not state the bounded
+value domain (>10 chars).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..context import terminal_name as _callee_name
+from ..findings import Finding
+from ..registry import GlintPass, register
+from .event_schema import registry_tables
+
+_REGISTRARS = ('counter', 'gauge', 'histogram')
+
+
+def _labels_value(call: ast.Call) -> Optional[ast.AST]:
+  """The AST node carrying the call's labels, or None when the call
+  passes none: the ``labels=`` keyword wins; otherwise the first
+  positional arg past the name that is a dict literal or an explicit
+  ``None`` (the positional-labels helper convention)."""
+  for kw in call.keywords:
+    if kw.arg == 'labels':
+      return kw.value
+  for arg in call.args[1:]:
+    if isinstance(arg, ast.Dict):
+      return arg
+    if isinstance(arg, ast.Constant) and arg.value is None:
+      return arg
+  return None
+
+
+def _is_param(ctx, call: ast.Call, name: str) -> bool:
+  """True when ``name`` is a parameter of a function enclosing the
+  call — a forwarding helper whose OWN call sites carry the dict."""
+  fn = ctx.enclosing_function(call)
+  while fn is not None:
+    a = fn.args
+    params = [p.arg for p in
+              (a.posonlyargs + a.args + a.kwonlyargs)]
+    for extra in (a.vararg, a.kwarg):
+      if extra is not None:
+        params.append(extra.arg)
+    if name in params:
+      return True
+    fn = ctx.enclosing_function(fn)
+  return False
+
+
+def _unique_dict_assign(ctx, name: str) -> Optional[ast.Dict]:
+  """The dict literal a bare labels name resolves to, when the file
+  binds it by EXACTLY one simple ``<name> = {...}`` assignment."""
+  hits: List[ast.Dict] = []
+  for node in ast.walk(ctx.tree):
+    if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+        and isinstance(node.targets[0], ast.Name) \
+        and node.targets[0].id == name:
+      if not isinstance(node.value, ast.Dict):
+        return None                 # rebound to something opaque
+      hits.append(node.value)
+  return hits[0] if len(hits) == 1 else None
+
+
+@register
+class MetricLabelPass(GlintPass):
+  name = 'metric-label-cardinality'
+  description = ('every labels={...} at a metric registration site '
+                 'draws its keys from telemetry/schema.py::'
+                 'METRIC_LABELS (the closed, cardinality-bounded '
+                 'label vocabulary)')
+
+  def begin(self, run):
+    self._schema = run.schema_path
+    self._pkg = run.pkg_prefix.rstrip('/') + '/'
+    #: label key -> first (rel, line) use site
+    self._used: Dict[str, Tuple[str, int]] = {}
+
+  def check_file(self, ctx):
+    if not ctx.rel.startswith(self._pkg):
+      return
+    for node in ast.walk(ctx.tree):
+      if not (isinstance(node, ast.Call)
+              and _callee_name(node.func) in _REGISTRARS):
+        continue
+      val = _labels_value(node)
+      if val is None or (isinstance(val, ast.Constant)
+                         and val.value is None):
+        continue
+      kind = _callee_name(node.func)
+      if isinstance(val, ast.Name):
+        if _is_param(ctx, node, val.id):
+          continue                  # forwarding helper — see its
+        d = _unique_dict_assign(ctx, val.id)   # callers instead
+        if d is None:
+          yield Finding(
+              rule=self.name, path=ctx.rel, line=node.lineno,
+              message=f'{kind}(...) labels={val.id!r} does not '
+                      'resolve to a unique dict literal in this '
+                      'file — pass a dict literal so the label '
+                      'keys are statically checkable')
+          continue
+        val = d
+      if not isinstance(val, ast.Dict):
+        yield Finding(
+            rule=self.name, path=ctx.rel, line=node.lineno,
+            message=f'{kind}(...) labels value is not a dict '
+                    'literal (or a name bound to one) — label keys '
+                    'must be statically enumerable')
+        continue
+      for k in val.keys:
+        if not (isinstance(k, ast.Constant)
+                and isinstance(k.value, str)):
+          yield Finding(
+              rule=self.name, path=ctx.rel, line=node.lineno,
+              message=f'{kind}(...) has a non-string-constant '
+                      'label KEY — keys are the closed vocabulary; '
+                      'only values may be dynamic')
+          continue
+        self._used.setdefault(k.value, (ctx.rel, node.lineno))
+
+  def finish(self, run):
+    try:
+      table = registry_tables(
+          self._schema, table_names=('METRIC_LABELS',)
+      ).get('METRIC_LABELS', {})
+    except (OSError, SyntaxError) as e:
+      yield Finding(
+          rule=self.name, path=str(self._schema), line=0,
+          message=f'schema registry unreadable ({e}) — nothing to '
+                  'enforce against')
+      return
+    schema_rel = self._schema_rel(run)
+    for key, (rel, line) in sorted(self._used.items()):
+      if key not in table:
+        yield Finding(
+            rule=self.name, path=rel, line=line,
+            message=f'label key {key!r} is not declared in '
+                    'telemetry/schema.py::METRIC_LABELS — declare '
+                    'it with a doc stating its BOUNDED value set, '
+                    'or fold the dimension into the metric name')
+    for key, (line, doc) in sorted(table.items()):
+      if key not in self._used:
+        yield Finding(
+            rule=self.name, path=schema_rel, line=line,
+            message=f'METRIC_LABELS[{key!r}] has no remaining '
+                    'labeled registration site — remove the stale '
+                    'declaration')
+      if not (isinstance(doc, str) and len(doc.strip()) > 10):
+        yield Finding(
+            rule=self.name, path=schema_rel, line=line,
+            message=f'METRIC_LABELS[{key!r}] needs a doc (>10 '
+                    'chars) stating why the value domain is '
+                    'bounded — that statement IS the cardinality '
+                    'contract')
+
+  def _schema_rel(self, run) -> str:
+    try:
+      return self._schema.resolve().relative_to(
+          run.repo.resolve()).as_posix()
+    except ValueError:
+      return str(self._schema)
