@@ -1175,14 +1175,18 @@ def _run_fleet_row(timeout: int):
     return None
   r, returncode = got
   keys = ('fleet_qps', 'failover_failed_requests',
-          'recovery_ratio', 'redriven', 'evictions')
+          'recovery_ratio', 'redriven', 'evictions',
+          'traced_tail_count', 'traced_tail_max_spans',
+          'fleet_headroom_qps')
   row = {k: r[k] for k in keys if k in r}
   row['fleet'] = r['fleet']
   row['failover_pin'] = 'ok' if returncode == 0 else 'FAILED'
   if returncode != 0:
-    print('fleet phase: failed/dropped requests or qps recovery '
-          'below 0.6x across the mid-run replica kill (see '
-          'dist.serving.fleet)', file=sys.stderr)
+    print('fleet phase: failed/dropped requests, qps recovery below '
+          '0.6x across the mid-run replica kill, or the tracing '
+          'acceptance (>=1 slow-tail trace with >=5 spans + a live '
+          'headroom gauge) failed (see dist.serving.fleet)',
+          file=sys.stderr)
   return row
 
 

@@ -133,6 +133,42 @@ def drive_open_loop(frontend, plan):
   return out
 
 
+def measure_tracing_overhead(frontend, n_nodes: int,
+                             requests: int = 150, reps: int = 2):
+  """Tracing-cost acceptance (ISSUE 17): drive the SAME closed-loop
+  single-seed schedule with tracing off (sample=0 — the byte-identical
+  fast path) and fully on (sample=1 — every request minted, span
+  recording + ring retention + exemplar stamping all active), and
+  return the traced/untraced wall-time ratio.  Best-of-``reps`` per
+  mode damps scheduler noise; regress.py pins the ratio <= 1.05
+  against a FIXED 1.0 baseline."""
+  from graphlearn_tpu.telemetry import tracer
+  rng = np.random.default_rng(7)
+  seed_list = [np.asarray([s], dtype=np.int64)
+               for s in rng.integers(0, n_nodes, size=requests)]
+
+  def drive_once():
+    t0 = time.perf_counter()
+    for s in seed_list:
+      frontend.submit(s).result(30.0)
+    return time.perf_counter() - t0
+
+  try:
+    best = {}
+    for rep in range(reps + 1):
+      for mode, sample in (('untraced', 0), ('traced', 1)):
+        tracer.configure(sample=sample, slow_ms=1e9, buffer=None)
+        took = drive_once()
+        if rep == 0:
+          continue                    # warmup lap for both modes
+        if mode not in best or took < best[mode]:
+          best[mode] = took
+    return best['traced'] / max(best['untraced'], 1e-9)
+  finally:
+    tracer.configure()                # back to the env-declared knobs
+    tracer.clear()
+
+
 def scrape_ops(ops, at_s: float, out: dict, require_cache=False):
   """Mid-run scrape thread body: after ``at_s`` seconds, pull
   /metrics + /varz off the live ops server and STRICTLY validate the
@@ -208,6 +244,13 @@ def run_phase(label: str, ds, model, params, args, result: dict,
   run_s = time.perf_counter() - t_run
   if scraper is not None:
     scraper.join(timeout=30.0)
+  overhead = None
+  if label == 'hot':
+    # tracing-cost ratio on the HEADLINE engine, measured after the
+    # open-loop window so the two closed-loop laps see a warm, idle
+    # tier (feeds dist.serving.tracing_overhead_ratio)
+    overhead = measure_tracing_overhead(
+        fe, ds.get_graph().num_nodes)
   fe.shutdown()
   lats = sorted(l for l, o in outcomes if o == 'ok' and l is not None)
   shed = sum(1 for _, o in outcomes if o == 'shed')
@@ -240,15 +283,18 @@ def run_phase(label: str, ds, model, params, args, result: dict,
   }
   if scrape:
     row['ops'] = scrape
+  if overhead is not None:
+    row['tracing_overhead_ratio'] = round(overhead, 4)
   if cache_hits or cache_misses:
     row['cache_hit_rate'] = round(
         cache_hits / max(cache_hits + cache_misses, 1), 4)
   result[label] = row
   # flat twins of the guarded dotted keys at the top level (the
-  # regress gate reads dist.serving.p99_ms / .qps / .shed_rate from
-  # the HEADLINE fully-hot phase)
+  # regress gate reads dist.serving.p99_ms / .qps / .shed_rate /
+  # .tracing_overhead_ratio from the HEADLINE fully-hot phase)
   if label == 'hot':
-    for k in ('p50_ms', 'p95_ms', 'p99_ms', 'qps', 'shed_rate'):
+    for k in ('p50_ms', 'p95_ms', 'p99_ms', 'qps', 'shed_rate',
+              'tracing_overhead_ratio'):
       result[k] = row[k]
   print(json.dumps(result), flush=True)
   return row
@@ -304,16 +350,26 @@ def run_fleet_phase(args, result: dict, ops=None) -> dict:
   from graphlearn_tpu.serving import (AdmissionRejected, FleetRouter,
                                       LocalReplica, ServingEngine,
                                       ServingFrontend)
+  from graphlearn_tpu.telemetry import tracer
   from graphlearn_tpu.telemetry.live import live
   from graphlearn_tpu.testing import chaos
   n_rep = args.fleet
-  ds = build_dataset(args.nodes, args.dim)
-  n = ds.get_graph().num_nodes
-  replicas = []
+  # the fleet serves the TIERED path: every traced request then owns
+  # the full five-span tree (route -> queue_wait -> dispatch_slice ->
+  # {sample_collect, cold_fill}) the mid-run tracing acceptance below
+  # asserts on
+  sr = args.split_ratio if 0.0 < args.split_ratio < 1.0 else 0.5
+  n = args.nodes
+  replicas, frontends = [], []
   t0 = time.perf_counter()
   for i in range(n_rep):
     # one seed across the fleet: replicas answer byte-identically, so
-    # a redriven request's survivor answer matches the lost replica's
+    # a redriven request's survivor answer matches the lost replica's.
+    # Each replica owns its OWN dataset instance (same build seed):
+    # the tiered feature holds live device buffers (cold-cache rows)
+    # that the killed replica's teardown deletes — a shared instance
+    # would yank them out from under the survivors mid-redrive
+    ds = build_dataset(args.nodes, args.dim, split_ratio=sr)
     eng = ServingEngine(ds, args.fanout, seed=11)
     # a wider coalescing window than the single-engine phases keeps a
     # little queue occupancy per replica, so the mid-run kill strands
@@ -321,7 +377,17 @@ def run_fleet_phase(args, result: dict, ops=None) -> dict:
     fe = ServingFrontend(eng, auto_start=True, warmup=True,
                          max_wait_ms=10.0, default_deadline_ms=2000.0)
     replicas.append(LocalReplica(f'r{i}', fe))
+    frontends.append(fe)
   warm_s = time.perf_counter() - t0
+  # request tracing ON for the whole fleet drive (ISSUE 17): every
+  # request carries a context, 1-in-10 head-sampled, and anything
+  # slower than the SLO p99 (the chaos stall guarantees some) is
+  # tail-retained — the acceptance below demands >=1 such slow-tail
+  # trace with the full >=5-span tree captured mid-run
+  trace_slow_ms = float(os.environ.get('GLT_SERVING_SLO_P99_MS',
+                                       '100') or 100)
+  tracer.configure(sample=10, slow_ms=trace_slow_ms, buffer=None)
+  tracer.clear()
   plan = make_schedule(args.rate, args.duration, n, args.zipf_a,
                        seed=3)
   # mid-run kill, declared through the chaos plan: replica r0 first
@@ -333,10 +399,13 @@ def run_fleet_phase(args, result: dict, ops=None) -> dict:
   kill_t = args.duration / 2
   pre = sum(1 for a, _ in plan if a < kill_t)
   kill_nth = max(pre // n_rep, 2)
-  # the dispatch seam counts COALESCED runs (~half the submit count
-  # under the 10ms window), so the stall starts around the victim's
-  # half-way dispatch — several stalled runs before the kill
-  stall_nth = max(kill_nth // 2 - 4, 1)
+  # the dispatch seam counts COALESCED runs, and the tiered path's
+  # coalescing ratio is load-dependent — stall from the victim's
+  # FIRST dispatch so the overload window deterministically precedes
+  # the kill (the discriminator sees overloaded-not-dead for the
+  # whole first half, and the stalled riders are the guaranteed
+  # slow-tail traces the tracing acceptance below asserts on)
+  stall_nth = 1
   chaos.install({'faults': [
       {'site': 'serving.request', 'action': 'delay', 'op': 'dispatch',
        'replica': 'r0', 'nth': stall_nth, 'count': 10000,
@@ -376,10 +445,33 @@ def run_fleet_phase(args, result: dict, ops=None) -> dict:
   run_s = time.perf_counter() - t_run
   fed_stop.set()
   watcher.join(10.0)
+  # the tracing acceptance reads the ring BEFORE teardown: slow-tail
+  # traces (latency past the SLO p99 — retained regardless of the
+  # 1-in-10 head sample) and the deepest captured span tree
+  trace_index = tracer.traces()
+  tail = [t for t in trace_index
+          if (t.get('latency_ms') or 0.0) >= tracer.slow_ms]
+  traced_tail_count = len(tail)
+  traced_tail_max_spans = max((t['spans'] for t in tail), default=0)
+  trace_stats = tracer.stats()
+  # the capacity signal: per-replica EWMA headroom, summed over the
+  # replicas still publishing one (the killed replica may be torn
+  # down) — regress.py guards PRESENCE of this key whenever the
+  # fleet phase ran
+  headrooms = []
+  for fe in frontends:
+    try:
+      h = fe.stats().get('headroom_qps')
+    except Exception:                 # noqa: BLE001 — killed replica
+      h = None
+    if isinstance(h, (int, float)):
+      headrooms.append(float(h))
+  fleet_headroom = round(sum(headrooms), 1) if headrooms else None
   scraper.close()
   router_stats = router.stats()
   router.close(close_replicas=True)
   chaos.uninstall()
+  tracer.configure()                  # back to the env-declared knobs
   ok = sum(1 for _, o in outcomes if o == 'ok')
   shed = sum(1 for _, o in outcomes if o == 'shed')
   errors = sum(1 for _, o in outcomes if o == 'error')
@@ -411,10 +503,20 @@ def run_fleet_phase(args, result: dict, ops=None) -> dict:
       'fleet_parse_failures': fed.get('parse_failures', 0),
       'fleet_replicas_federated': fed.get('max_replicas_federated', 0),
       'fleet_scrape_errors': fed.get('errors', [])[:5],
+      # the ISSUE 17 tracing acceptance inputs: slow-tail traces
+      # captured mid-run + the deepest span tree among them, and the
+      # fleet's summed capacity headroom (presence-guarded)
+      'split_ratio': sr,
+      'traced_tail_count': traced_tail_count,
+      'traced_tail_max_spans': traced_tail_max_spans,
+      'traces_minted': trace_stats['minted'],
+      'traces_retained': trace_stats['retained'],
+      'fleet_headroom_qps': fleet_headroom,
   }
   result['fleet'] = row
   for k in ('fleet_qps', 'failover_failed_requests', 'recovery_ratio',
-            'redriven', 'evictions'):
+            'redriven', 'evictions', 'traced_tail_count',
+            'traced_tail_max_spans', 'fleet_headroom_qps'):
     result[k] = row[k]
   print(json.dumps(result), flush=True)
   return row
@@ -490,6 +592,24 @@ def main(argv=None):
     if row['recovery_ratio'] < 0.6:
       print(f"WARNING: fleet qps recovered to only "
             f"{row['recovery_ratio']:.2f}x pre-kill (< 0.6x bar)",
+            file=sys.stderr)
+      return 1
+    # tracing acceptance (ISSUE 17): the mid-run drive must have
+    # captured at least one slow-tail trace carrying the full
+    # >=5-span tree (route -> rpc-less local queue_wait ->
+    # dispatch_slice -> sample_collect + cold_fill) — an empty ring
+    # here means the tail-retention path silently broke under load
+    if (row['traced_tail_count'] < 1
+        or row['traced_tail_max_spans'] < 5):
+      print('WARNING: no slow-tail trace with >=5 spans captured '
+            f"mid-run (tail={row['traced_tail_count']}, "
+            f"max_spans={row['traced_tail_max_spans']}, "
+            f"minted={row['traces_minted']}, "
+            f"retained={row['traces_retained']})", file=sys.stderr)
+      return 1
+    if row['fleet_headroom_qps'] is None:
+      print('WARNING: no replica exported fleet.headroom_qps — the '
+            'capacity model never observed a dispatch',
             file=sys.stderr)
       return 1
     return 0

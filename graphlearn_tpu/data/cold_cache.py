@@ -357,6 +357,11 @@ class DeviceColdCache:
     self.rows = (jax.device_put(rows, device) if device is not None
                  else rows)
     self.stats = CacheStats()
+    # memory accounting (ISSUE 17): the row ring is the cache's whole
+    # HBM bill (policy state is host-side numpy, negligible)
+    from ..telemetry.memaccount import register_tier
+    register_tier('cold_cache',
+                  lambda r=self.rows: int(getattr(r, 'nbytes', 0)))
 
   @property
   def capacity(self) -> int:
@@ -469,6 +474,9 @@ class MeshColdCache:
     self.rows = put_stacked(
         np.zeros((num_local, max(self.capacity, 1), int(dim)), dtype))
     self.stats = CacheStats()
+    from ..telemetry.memaccount import register_tier
+    register_tier('cold_cache',
+                  lambda r=self.rows: int(getattr(r, 'nbytes', 0)))
     self._hotness_fns = ()
     if bounds is not None:
       # the sketches' decayed range mass becomes the live top-K

@@ -249,7 +249,8 @@ class DistClient:
       raise
 
   def serve(self, seeds, server_idx: Optional[int] = None,
-            deadline_ms: Optional[float] = None) -> dict:
+            deadline_ms: Optional[float] = None,
+            trace: Optional[dict] = None) -> dict:
     """One online inference request against a server's serving tier
     (ISSUE 9): ``seeds`` (a few node ids) -> ``{'nodes': [k, W], 'x':
     [k, W, D] | 'logits': [k, C]}`` numpy arrays, byte-identical to
@@ -262,14 +263,16 @@ class DistClient:
     `serving.admission.AdmissionRejected` (wire error-kind field,
     never message-text sniffing), so callers can tell overload (back
     off / reroute) from failure.  Default server = ``rank %
-    num_servers``, the producer round-robin convention."""
+    num_servers``, the producer round-robin convention.  ``trace``
+    (a `telemetry.tracing` context dict) rides the RPC frame so the
+    server's per-request spans join the caller's trace tree."""
     from ..serving.admission import AdmissionRejected
     if server_idx is None:
       server_idx = self.rank % self.num_servers
     seeds = np.asarray(seeds, np.int64).reshape(-1)
     try:
       return self.request_server(server_idx, 'serve_infer', seeds,
-                                 deadline_ms=deadline_ms)
+                                 deadline_ms=deadline_ms, trace=trace)
     except RpcError as e:
       if getattr(e, 'remote_kind', None) == 'AdmissionRejected':
         # rebuild the typed rejection FAITHFULLY from the wire's

@@ -171,14 +171,18 @@ class DistServer:
     dead discriminator for serving clients."""
     self._serving = frontend
 
-  def serve_infer(self, seeds, deadline_ms=None):
+  def serve_infer(self, seeds, deadline_ms=None, trace=None):
     """One online inference request (RPC handler).  Exactly-once:
     this handler runs under the replay cache like every RPC, so a
     retried request replays the cached reply instead of re-executing
     (and the engine's per-seed determinism makes even a hypothetical
     re-execution byte-identical).  `AdmissionRejected` travels back
     typed via the wire's structured error-kind field —
-    `DistClient.serve` resurfaces it as the same class."""
+    `DistClient.serve` resurfaces it as the same class.  ``trace``
+    is the caller's request-trace context: this handler's span
+    (``serving.rpc``) is the cross-process edge under the router's
+    root, and the frontend's per-request spans parent under it."""
+    from ..telemetry.tracing import _new_id, child_ctx, tracer
     from ..testing import chaos
     chaos.serving_request_check('serve_infer')
     serving = self._serving
@@ -186,14 +190,34 @@ class DistServer:
       from .rpc import RpcError
       raise RpcError(f'server {self.rank} has no serving tier '
                      'attached (attach_serving was never called)')
-    fut = serving.submit(np.asarray(seeds), deadline_ms)
-    # wait on the REQUEST's deadline (+ execution grace), not the
-    # tier default: a caller that paid for a long deadline must not be
-    # timed out at the default by its own server (the in-process
-    # `ServingFrontend.infer` uses the same arithmetic)
-    dl = (float(deadline_ms) if deadline_ms is not None
-          else serving.admission.default_deadline_ms)
-    res = fut.result(dl / 1e3 + 30.0)
+    # pre-mint the rpc span id so the frontend's child spans (queue
+    # wait / dispatch slice) parent under a span recorded only after
+    # the future resolves (spans are recorded on completion)
+    rpc_sid = _new_id() if trace else None
+    t0 = time.monotonic()
+    try:
+      fut = serving.submit(np.asarray(seeds), deadline_ms,
+                           trace=child_ctx(trace, rpc_sid))
+      # wait on the REQUEST's deadline (+ execution grace), not the
+      # tier default: a caller that paid for a long deadline must not
+      # be timed out at the default by its own server (the in-process
+      # `ServingFrontend.infer` uses the same arithmetic)
+      dl = (float(deadline_ms) if deadline_ms is not None
+            else serving.admission.default_deadline_ms)
+      res = fut.result(dl / 1e3 + 30.0)
+    except Exception as e:          # noqa: BLE001 — record, re-raise
+      dur = time.monotonic() - t0
+      if trace:
+        tracer.span('serving.rpc', trace, span_id=rpc_sid, t0=t0,
+                    dur=dur, rank=self.rank,
+                    error=f'{type(e).__name__}: {e}'[:160])
+        tracer.resolve(trace, outcome='error', latency_ms=dur * 1e3)
+      raise
+    dur = time.monotonic() - t0
+    if trace:
+      tracer.span('serving.rpc', trace, span_id=rpc_sid, t0=t0,
+                  dur=dur, rank=self.rank)
+      tracer.resolve(trace, outcome='ok', latency_ms=dur * 1e3)
     out = {'nodes': np.asarray(res.nodes)}
     if res.x is not None:
       out['x'] = np.asarray(res.x)

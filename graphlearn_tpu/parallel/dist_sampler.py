@@ -2225,6 +2225,12 @@ class DistNeighborSampler(ExchangeTelemetry):
       self._gns_bits = jax.device_put(
           bits, NamedSharding(self.mesh, P()))
       self._gns_ver = ver
+      # memory accounting (ISSUE 17): the replicated bitmask is the
+      # GNS tier's whole bill; re-registered on each rebuild so the
+      # gauge tracks the live array
+      from ..telemetry.memaccount import register_tier
+      register_tier(
+          'gns', lambda b=self._gns_bits: int(getattr(b, 'nbytes', 0)))
       from ..utils.profiling import metrics
       metrics.inc('gns.sketch_updates_total')
       from ..telemetry.recorder import recorder

@@ -158,13 +158,15 @@ class Request:
   its absolute ``deadline`` (monotonic seconds), arrival time, and the
   future its caller is waiting on."""
 
-  __slots__ = ('seeds', 'arrived', 'deadline', 'future')
+  __slots__ = ('seeds', 'arrived', 'deadline', 'future', 'trace')
 
-  def __init__(self, seeds, deadline_s: float):
+  def __init__(self, seeds, deadline_s: float,
+               trace: Optional[dict] = None):
     self.seeds = seeds
     self.arrived = time.monotonic()
     self.deadline = self.arrived + deadline_s
     self.future = ServingFuture()
+    self.trace = trace               # request-trace context (tracing)
 
   def expired(self, now: Optional[float] = None) -> bool:
     return (now if now is not None else time.monotonic()) > self.deadline
@@ -218,11 +220,14 @@ class AdmissionController:
                  'shutdown': 0, 'draining': 0}
 
   # -- producer side --------------------------------------------------------
-  def submit(self, seeds, deadline_ms: Optional[float] = None
-             ) -> Request:
+  def submit(self, seeds, deadline_ms: Optional[float] = None,
+             trace: Optional[dict] = None) -> Request:
     """Admit one request or raise typed.  ``seeds`` is a sequence of
-    int node ids; ``deadline_ms`` overrides the default SLO budget."""
+    int node ids; ``deadline_ms`` overrides the default SLO budget;
+    ``trace`` is the request-trace context riding the serve path
+    (a door shed resolves it failed — shed traces are tail-retained)."""
     from ..telemetry.recorder import recorder
+    from ..telemetry.tracing import tracer
     n = len(seeds)
     dl = float(deadline_ms if deadline_ms is not None
                else self.default_deadline_ms)
@@ -232,6 +237,7 @@ class AdmissionController:
         _tick_shed('shutdown')
         recorder.emit('serving.shed', reason='shutdown', seeds=n,
                       queue_depth=len(self._q))
+        tracer.resolve(trace, outcome='shed')
         raise AdmissionRejected('serving tier is shutting down',
                                 reason='shutdown')
       if self._draining:
@@ -244,6 +250,7 @@ class AdmissionController:
         recorder.emit('serving.shed', reason='draining', seeds=n,
                       queue_depth=len(self._q),
                       retry_after_ms=self.drain_retry_after_ms)
+        tracer.resolve(trace, outcome='shed')
         raise AdmissionRejected(
             'serving tier is draining for a hot model swap — retry '
             f'after ~{self.drain_retry_after_ms:.0f}ms',
@@ -256,6 +263,7 @@ class AdmissionController:
         recorder.emit('serving.shed', reason='too_large', seeds=n,
                       limit=self.max_request_seeds,
                       queue_depth=len(self._q))
+        tracer.resolve(trace, outcome='shed')
         raise AdmissionRejected(
             f'request carries {n} seeds; the largest serving bucket '
             f'holds {self.max_request_seeds} — split the request or '
@@ -269,13 +277,14 @@ class AdmissionController:
           self.slo_feed('queue_full', 0.0)
         recorder.emit('serving.shed', reason='queue_full', seeds=n,
                       queue_depth=len(self._q), limit=self.max_queue)
+        tracer.resolve(trace, outcome='shed')
         raise AdmissionRejected(
             f'serving queue at capacity ({len(self._q)}/'
             f'{self.max_queue} requests waiting) — overload; retry '
             'with backoff or raise GLT_SERVING_QUEUE_DEPTH',
             reason='queue_full', queue_depth=len(self._q),
             limit=self.max_queue)
-      req = Request(seeds, dl / 1e3)
+      req = Request(seeds, dl / 1e3, trace=trace)
       self._q.append(req)
       self.admitted += 1
       _tick_admitted()
@@ -287,6 +296,7 @@ class AdmissionController:
   # -- executor side --------------------------------------------------------
   def _shed_expired_locked(self, now: float) -> None:
     from ..telemetry.recorder import recorder
+    from ..telemetry.tracing import tracer
     kept: 'collections.deque[Request]' = collections.deque()
     for req in self._q:
       if req.expired(now):
@@ -303,6 +313,7 @@ class AdmissionController:
             '(executor saturated — shed, not silently dropped)',
             reason='deadline', waited_ms=waited,
             queue_depth=len(self._q)))
+        tracer.resolve(req.trace, outcome='shed', latency_ms=waited)
       else:
         kept.append(req)
     self._q = kept
@@ -408,6 +419,7 @@ class AdmissionController:
     a stopping tier still answers everyone (one ``serving.shed`` per
     drained request, like every other typed shed)."""
     from ..telemetry.recorder import recorder
+    from ..telemetry.tracing import tracer
     with self._lock:
       self._closed = True
       while self._q:
@@ -420,4 +432,6 @@ class AdmissionController:
         req.future.set_error(AdmissionRejected(
             'serving tier shut down before dispatch',
             reason='shutdown'))
+        tracer.resolve(req.trace, outcome='shed',
+                       latency_ms=req.waited_ms())
       self._arrived.notify_all()

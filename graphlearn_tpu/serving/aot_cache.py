@@ -89,6 +89,18 @@ class AotExecutableCache:
   def __init__(self, root):
     self.root = Path(root)
     self.root.mkdir(parents=True, exist_ok=True)
+    # memory accounting (ISSUE 17): on-disk executable bytes,
+    # re-walked at scrape time (entries come and go between scrapes)
+    from ..telemetry.memaccount import register_tier
+
+    def _aot_bytes():
+      try:
+        return sum(p.stat().st_size
+                   for p in self.root.glob('*.aotx'))
+      except OSError:
+        return 0
+
+    register_tier('aot', _aot_bytes)
 
   def _path(self, key: str) -> Path:
     return self.root / f'{key}.aotx'

@@ -145,6 +145,22 @@ class ServingEngine:
     self.buckets = resolve_buckets(buckets)
     self._tiered = feat.hot_rows < feat.size(0)
     self._feat = feat
+    # memory accounting (ISSUE 17): the hot tier is the engine's HBM
+    # bill — resident bytes when materialised, the would-be bill
+    # (rows x dim x itemsize) before lazy_init
+    from ..telemetry.memaccount import register_tier
+
+    def _hot_bytes(f=feat):
+      h = getattr(f, '_hot', None)
+      if h is not None:
+        return int(getattr(h, 'nbytes', 0))
+      try:
+        return (int(f.hot_rows) * int(f.feature_dim)
+                * int(np.dtype(f.dtype).itemsize))
+      except Exception:
+        return 0
+
+    self._unregister_hot_tier = register_tier('hot', _hot_bytes)
     #: streaming ingestion (ISSUE 14): with a `StreamingGraph`
     #: attached (explicitly or via `Dataset.attach_stream`), every
     #: dispatch re-pins the newest published `GraphView` FIRST and
@@ -386,8 +402,15 @@ class ServingEngine:
     dev = self._dev
     cap = int(padded.shape[0])
     if self._tiered:
+      import time as _time
+      _sc0 = _time.monotonic()
       nodes = self._run_prog('collect', cap, self._compiled_collect,
                              (padded, dev), (padded, dev))
+      #: (monotonic t0, dur) of THIS dispatch's neighbor-sampling
+      #: collect program — the frontend reads it to attach a
+      #: `serving.sample_collect` span under each traced rider's
+      #: dispatch slice (sampling vs feature-fill cost split)
+      self.last_collect = (_sc0, _time.monotonic() - _sc0)
       nodes_h = np.asarray(nodes)
       # cross-request cold-id dedup (r11): one coalesced dispatch
       # carries several riders whose trees overlap heavily under
@@ -407,7 +430,13 @@ class ServingEngine:
       uniq_p[:len(uniq)] = uniq
       # the per-request tiered lookup: hot split + HBM cold-cache +
       # host-served misses, 'serving' telemetry scope
+      import time as _time
+      _cf0 = _time.monotonic()
       x_u = self._feat.get(uniq_p, scope='serving')
+      #: (monotonic t0, dur) of THIS dispatch's tiered fill — the
+      #: frontend reads it to attach a `serving.cold_fill` span under
+      #: each traced rider's dispatch slice
+      self.last_cold_fill = (_cf0, _time.monotonic() - _cf0)
       x = jnp.take(x_u, jnp.asarray(inverse.astype(np.int32)), axis=0)
       x = x.reshape(nodes_h.shape + (x.shape[-1],))
       if self.model is None:

@@ -35,9 +35,11 @@ import numpy as np
 
 from ..telemetry import postmortem
 from ..telemetry.live import live
+from ..telemetry.memaccount import CapacityModel
 from ..telemetry.recorder import recorder
 from ..telemetry.slo import SloTracker
 from ..telemetry.spans import span
+from ..telemetry.tracing import tracer
 from .admission import AdmissionController, AdmissionRejected, Request
 from .engine import ServingEngine, ServingResult
 
@@ -135,7 +137,14 @@ class ServingFrontend:
                         ('serving.in_flight', _in_flight_fn),
                         ('serving.coalesce_fill_ratio', _fill_fn)]
     self._lat_hists: dict = {}
+    #: per-request admission→pickup wait (always on — the metrics
+    #: plane is not the data plane; byte-identity concerns results
+    #: and the exemplar-free /metrics text)
+    self._m_queue_wait = live.histogram('serving.queue_wait')
     self.slo = SloTracker(registry=live)
+    #: per-bucket EWMA serve-cost → fleet.headroom_qps (the ROADMAP
+    #: item 3 admission signal; fed after every coalesced dispatch)
+    self.capacity = CapacityModel(slo=self.slo, registry=live)
     # budget-burning sheds (queue_full/deadline — the tier failing
     # its callers) feed the SLO window as failures; INTENTIONAL sheds
     # (draining cutover, shutdown) are exempt by the admission
@@ -181,16 +190,21 @@ class ServingFrontend:
     live.unregister_health('serving', fn=self._health_fn)
     for gname, gfn in self._gauge_regs:
       live.unregister_gauge(gname, fn=gfn)
+    self.capacity.close()
     self.slo.close()
 
   # -- producer side --------------------------------------------------------
-  def submit(self, seeds, deadline_ms: Optional[float] = None):
+  def submit(self, seeds, deadline_ms: Optional[float] = None,
+             trace: Optional[dict] = None):
     """Admit one request; returns its `ServingFuture` (raises
     `AdmissionRejected` at the door when the queue is at bound, and
     `ValueError` for a MALFORMED request — empty, or seed ids outside
     ``[0, num_nodes)``; the engine's gathers CLAMP out-of-range ids,
     so without this check a bogus id would come back as a plausible
-    answer for the wrong node instead of an error)."""
+    answer for the wrong node instead of an error).  ``trace`` is the
+    request-trace context minted by the router (or the RPC handler's
+    child context) — it rides the queued request so the executor can
+    attribute queue wait / dispatch slice / cold fill per request."""
     seeds = np.asarray(seeds, np.int64).reshape(-1)
     if seeds.size == 0:
       raise ValueError('a serving request needs at least one seed')
@@ -200,7 +214,8 @@ class ServingFrontend:
           f'seed id(s) {bad[:8].tolist()} outside [0, '
           f'{self.engine.num_nodes}) — refused (a clamped gather '
           'would silently answer for a different node)')
-    return self.admission.submit(seeds, deadline_ms).future
+    return self.admission.submit(seeds, deadline_ms,
+                                 trace=trace).future
 
   def infer(self, seeds, deadline_ms: Optional[float] = None,
             timeout: Optional[float] = None) -> ServingResult:
@@ -261,6 +276,15 @@ class ServingFrontend:
     recorder.emit('serving.coalesce', requests=len(run), seeds=total,
                   bucket=cap,
                   waited_ms=round(1e3 * (now - run[0].arrived), 3))
+    for req in run:
+      # admission enqueue → coalesce pickup, per request: the wait
+      # the coalescing executor imposed (histogram always; a span
+      # only when the request carries a trace context)
+      wait_s = max(now - req.arrived, 0.0)
+      self._m_queue_wait.observe(wait_s)
+      if req.trace is not None:
+        tracer.span('serving.queue_wait', req.trace, t0=req.arrived,
+                    dur=wait_s)
     try:
       # chaos seam (executor flavor): a 'delay' here simulates a slow/
       # stuck dispatch — queued requests behind it expire and shed; a
@@ -277,8 +301,14 @@ class ServingFrontend:
         self.failed += len(run)
       self._m_failed.inc(len(run))
       for req in run:
-        req.future.set_error(e)
         lat = req.waited_ms()
+        if req.trace is not None:
+          tracer.span('serving.dispatch_slice', req.trace, t0=now,
+                      dur=time.monotonic() - now, bucket=cap,
+                      requests=len(run),
+                      error=f'{type(e).__name__}: {e}'[:160])
+          tracer.resolve(req.trace, outcome='error', latency_ms=lat)
+        req.future.set_error(e)
         self.slo.observe(lat, ok=False)
         recorder.emit('serving.request', seeds=len(req.seeds),
                       bucket=cap, coalesced=len(run), ok=False,
@@ -293,19 +323,47 @@ class ServingFrontend:
       return 0
     off = 0
     self._last_fill = round(total / cap, 4) if cap else 0.0
+    cold = getattr(self.engine, 'last_cold_fill', None)
+    coll = getattr(self.engine, 'last_collect', None)
     hist = self._lat_hists.get(cap)
     if hist is None:
       hist = self._lat_hists[cap] = live.histogram(
           'serving.request_latency', labels={'bucket': cap})
     for req, k in zip(run, sizes):
+      lat = req.waited_ms()
+      if req.trace is not None:
+        # record + resolve BEFORE the future fires: when a caller
+        # (the RPC handler, the router) wakes, this request's spans
+        # are already retained — /trace right after a serve returns
+        # the complete tree, no eventual-consistency window
+        end = time.monotonic()
+        sid = tracer.span('serving.dispatch_slice', req.trace,
+                          t0=now, dur=end - now, bucket=cap,
+                          requests=len(run))
+        if coll is not None and coll[0] >= now:
+          # the engine's neighbor-sampling collect inside THIS
+          # dispatch — with cold_fill below it splits the dispatch
+          # into sampling cost vs feature-fill cost per trace
+          tracer.span('serving.sample_collect', req.trace,
+                      parent_id=sid, t0=coll[0], dur=coll[1])
+        if cold is not None and cold[0] >= now:
+          # the engine's tiered host fill inside THIS dispatch, one
+          # view per traced rider (each tree stays self-contained)
+          tracer.span('serving.cold_fill', req.trace, parent_id=sid,
+                      t0=cold[0], dur=cold[1])
+        tracer.resolve(req.trace, outcome='ok', latency_ms=lat)
       req.future.set_result(batch.slice(off, off + k))
       off += k
-      lat = req.waited_ms()
-      hist.observe(lat / 1e3)
+      # the trace_id lands as this bucket's OpenMetrics exemplar —
+      # report.py jumps from the p99 bucket to the captured trace
+      hist.observe(lat / 1e3,
+                   exemplar=(req.trace['t'] if req.trace is not None
+                             else None))
       self.slo.observe(lat, ok=True)
       recorder.emit('serving.request', seeds=k, bucket=cap,
                     coalesced=len(run), ok=True,
                     latency_ms=round(lat, 3))
+    self.capacity.observe(cap, len(run), time.monotonic() - now)
     with self._lock:
       self.served_requests += len(run)
       self.served_seeds += total
@@ -351,6 +409,9 @@ class ServingFrontend:
     out['compile_status'] = self.engine.compile_status()
     out['model_version'] = self.engine.model_version
     out['max_wait_ms'] = round(self.max_wait_s * 1e3, 3)
+    hr = self.capacity._headroom()
+    if hr is not None:
+      out['headroom_qps'] = hr     # the heartbeat copy of the gauge
     out['slo'] = self.slo.snapshot()
     return out
 

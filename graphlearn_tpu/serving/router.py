@@ -49,6 +49,7 @@ import numpy as np
 
 from ..distributed.resilience import FailoverExhausted, ReplicaLostError
 from ..telemetry.recorder import recorder
+from ..telemetry.tracing import tracer
 from .admission import AdmissionRejected, ServingFuture
 from .engine import ServingResult
 
@@ -115,13 +116,13 @@ class LocalReplica(_ChaosReplicaMixin):
       frontend.name = name           # thread the fleet identity into
       # the executor chaos seam (replica-targeted dispatch faults)
 
-  def submit(self, seeds,
-             deadline_ms: Optional[float] = None) -> ServingFuture:
+  def submit(self, seeds, deadline_ms: Optional[float] = None,
+             trace: Optional[dict] = None) -> ServingFuture:
     self._chaos('submit')
     if not self.reachable():
       raise ReplicaLostError(f'replica {self.name!r} is unreachable',
                              replica=self.name)
-    return self.frontend.submit(seeds, deadline_ms)
+    return self.frontend.submit(seeds, deadline_ms, trace=trace)
 
   def heartbeat(self) -> Optional[dict]:
     self._chaos('heartbeat')
@@ -161,8 +162,8 @@ class RemoteReplica(_ChaosReplicaMixin):
     self._client = client
     self._idx = int(server_idx)
 
-  def submit(self, seeds,
-             deadline_ms: Optional[float] = None) -> ServingFuture:
+  def submit(self, seeds, deadline_ms: Optional[float] = None,
+             trace: Optional[dict] = None) -> ServingFuture:
     self._chaos('submit')
     if not self.reachable():
       raise ReplicaLostError(f'replica {self.name!r} is unreachable',
@@ -173,7 +174,8 @@ class RemoteReplica(_ChaosReplicaMixin):
     def run():
       try:
         out = self._client.serve(seeds, server_idx=self._idx,
-                                 deadline_ms=deadline_ms)
+                                 deadline_ms=deadline_ms,
+                                 trace=trace)
         fut.set_result(ServingResult(nodes=out['nodes'],
                                      x=out.get('x'),
                                      logits=out.get('logits')))
@@ -198,10 +200,11 @@ class _LedgerEntry:
   """One routed, unresolved request."""
 
   __slots__ = ('rid', 'seeds', 'deadline_ms', 'replica', 'inner',
-               'redriven', 'generation', 'error', 'error_at')
+               'redriven', 'generation', 'error', 'error_at',
+               'trace', 't0')
 
   def __init__(self, rid: int, seeds, deadline_ms, replica: str,
-               inner: ServingFuture):
+               inner: ServingFuture, trace: Optional[dict] = None):
     self.rid = rid
     self.seeds = seeds
     self.deadline_ms = deadline_ms
@@ -211,6 +214,8 @@ class _LedgerEntry:
     self.generation = 0
     self.error: Optional[BaseException] = None
     self.error_at: Optional[float] = None
+    self.trace = trace
+    self.t0 = time.monotonic()
 
   def set_error(self, err: BaseException) -> None:
     self.error = err
@@ -420,6 +425,7 @@ class FleetRouter:
     counted a miss and skipped.  Raises the last typed rejection (or
     `FailoverExhausted`) only when EVERY replica refused."""
     last_err: Optional[BaseException] = None
+    trace = tracer.mint()            # None when tracing is off
     for name in self._pick_order():
       with self._lock:
         ent = self._replicas.get(name)
@@ -427,7 +433,7 @@ class FleetRouter:
       if handle is None:
         continue
       try:
-        inner = handle.submit(seeds, deadline_ms)
+        inner = handle.submit(seeds, deadline_ms, trace=trace)
       except AdmissionRejected as e:
         if e.reason in ('queue_full', 'draining', 'shutdown'):
           last_err = e
@@ -450,7 +456,7 @@ class FleetRouter:
         rid = self._next_rid
         self._next_rid += 1
         entry = _LedgerEntry(rid, np.asarray(seeds), deadline_ms,
-                             name, inner)
+                             name, inner, trace=trace)
         self._ledger[rid] = entry
         self.submitted += 1
         # close the submit/evict race: if the replica was evicted
@@ -493,8 +499,19 @@ class FleetRouter:
 
   def _finish(self, rid: int, outcome: str) -> None:
     with self._lock:
-      if self._ledger.pop(rid, None) is not None:
+      entry = self._ledger.pop(rid, None)
+      if entry is not None:
         self.resolved[outcome] += 1
+    if entry is not None and entry.trace is not None:
+      # the request-trace ROOT: span_id == trace_id, so every child
+      # recorded under the minted context parents here (span() nulls
+      # the self-parent into a proper root)
+      dur = time.monotonic() - entry.t0
+      tracer.span('serving.route', entry.trace,
+                  span_id=entry.trace['t'], t0=entry.t0, dur=dur,
+                  replica=entry.replica, outcome=outcome)
+      tracer.resolve(entry.trace, outcome=outcome,
+                     latency_ms=dur * 1e3)
 
   # -- health classification ------------------------------------------------
   def _note_miss(self, name: str) -> None:
@@ -630,7 +647,8 @@ class FleetRouter:
       if handle is None:
         continue
       try:
-        inner = handle.submit(entry.seeds, entry.deadline_ms)
+        inner = handle.submit(entry.seeds, entry.deadline_ms,
+                              trace=entry.trace)
       except Exception:             # noqa: BLE001 — try the next
         continue
       with self._lock:

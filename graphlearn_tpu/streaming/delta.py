@@ -198,6 +198,20 @@ class StreamingGraph:
     self._num_events = len(indices)          # guarded-by: self._lock
     self._view: GraphView = self._build_view(
         1, indptr, np.asarray(indices), np.asarray(edge_ids, np.int64))
+    # memory accounting (ISSUE 17): host CSR arrays of the published
+    # view + the padded device twins (reads the LIVE view, so tier
+    # bytes track publishes without any hook in the write path)
+    from ..telemetry.memaccount import register_tier
+
+    def _stream_bytes():
+      v = self._view
+      total = 0
+      for arr in (v.indptr, v.indices, v.edge_ids,
+                  v.indptr_dev, v.indices_dev):
+        total += int(getattr(arr, 'nbytes', 0) or 0)
+      return total
+
+    register_tier('streaming', _stream_bytes)
 
   def _build_view(self, version: int, indptr, indices, eids
                   ) -> GraphView:

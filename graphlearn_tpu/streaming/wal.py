@@ -150,6 +150,11 @@ class WriteAheadLog:
     self._end_offset = 0       # guarded-by: self._lock
     self._truncations = 0      # guarded-by: self._lock
     self.open()
+    # memory accounting (ISSUE 17): the durable bill is the cursor
+    # position (valid bytes), not the file size — a torn tail awaiting
+    # truncation is not retained state
+    from ..telemetry.memaccount import register_tier
+    register_tier('wal', lambda: int(self._end_offset))
 
   # -- recovery scan --------------------------------------------------------
   def open(self) -> None:

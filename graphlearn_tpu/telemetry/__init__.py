@@ -88,6 +88,22 @@ The fleet signal plane (ISSUE 16) completes the live stack:
     aggregates, served at ``/fleet``
     (``FleetRouter.make_scraper()`` wires a serving fleet up).
 
+Request-scoped fleet tracing (ISSUE 17) rides the live stack:
+
+  * :mod:`~graphlearn_tpu.telemetry.tracing` — the request
+    `Tracer` (global :data:`tracer`): the router mints a trace
+    context that rides the serve RPC, every hop records completed
+    spans, and tail-based retention (slow / failed / 1-in-N) keeps
+    the interesting traces in a bounded ring served at ``/traces``
+    and ``/trace?trace_id=`` (``?format=chrome`` =
+    Perfetto-loadable; `FleetScraper.fetch_trace` reassembles the
+    cross-process tree first).  Live histograms attach the last
+    trace id per bucket as an OpenMetrics EXEMPLAR on ``/metrics``.
+  * :mod:`~graphlearn_tpu.telemetry.memaccount` — per-tier byte
+    accounting (``memory.tier_bytes{tier=}`` + peaks over
+    :data:`~graphlearn_tpu.telemetry.memaccount.TIERS`) and the
+    `CapacityModel` EWMA cost model behind ``fleet.headroom_qps``.
+
 The low-level counter/timer registry (`Metrics`, the global
 :data:`metrics`, `trace`, `capture`) still lives in
 :mod:`graphlearn_tpu.utils.profiling` and is re-exported here.
@@ -99,7 +115,9 @@ from ..utils.profiling import (Metrics, capture, metrics, start_trace,
 from .aggregate import exchange_summary, gather_metrics, per_hop_padding
 from .federation import FleetScraper
 from .histogram import Histogram, from_snapshot
-from .live import LiveRegistry, live, parse_prometheus_text
+from .live import (LiveRegistry, live, parse_prometheus_text,
+                   split_exemplar)
+from .memaccount import TIERS, CapacityModel, register_tier
 from .opsserver import OpsServer, maybe_start_from_env
 from .recorder import EventRecorder, recorder
 from .sink import (artifact_path, append_record, summary_line,
@@ -107,14 +125,17 @@ from .sink import (artifact_path, append_record, summary_line,
 from .slo import SloTracker
 from .spans import SpanContext, span
 from .timeseries import TimeSeriesStore
+from .tracing import Tracer, child_ctx, spans_to_events, tracer
 
 __all__ = [
-    'EventRecorder', 'FleetScraper', 'Histogram', 'LiveRegistry',
-    'Metrics', 'OpsServer', 'SloTracker', 'SpanContext',
-    'TimeSeriesStore',
-    'append_record', 'artifact_path', 'capture', 'exchange_summary',
-    'from_snapshot', 'gather_metrics', 'live', 'maybe_start_from_env',
-    'metrics', 'parse_prometheus_text', 'per_hop_padding',
-    'recorder', 'span', 'start_trace', 'step_annotation', 'stop_trace',
-    'summary_line', 'trace', 'write_artifact',
+    'CapacityModel', 'EventRecorder', 'FleetScraper', 'Histogram',
+    'LiveRegistry', 'Metrics', 'OpsServer', 'SloTracker',
+    'SpanContext', 'TIERS', 'TimeSeriesStore', 'Tracer',
+    'append_record', 'artifact_path', 'capture', 'child_ctx',
+    'exchange_summary', 'from_snapshot', 'gather_metrics', 'live',
+    'maybe_start_from_env', 'metrics', 'parse_prometheus_text',
+    'per_hop_padding', 'recorder', 'register_tier', 'span',
+    'spans_to_events', 'split_exemplar', 'start_trace',
+    'step_annotation', 'stop_trace', 'summary_line', 'trace',
+    'tracer', 'write_artifact',
 ]

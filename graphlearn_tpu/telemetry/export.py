@@ -108,6 +108,24 @@ def to_chrome_trace(events: List[Dict],
           'args': {k: v for k, v in ev.items()
                    if k not in ('kind', 'ts', 'mono', 'pid', 'tid')},
       })
+  # cross-process causality: a child slice whose parent slice lives
+  # on a DIFFERENT pid gets a flow arrow (ph 's' at the parent, ph
+  # 'f' binding to the end of the child's enclosing slice) — the RPC
+  # edge Perfetto cannot infer from same-track nesting
+  slices = {e['args'].get('span_id'): e for e in out
+            if e.get('ph') == 'X' and e['args'].get('span_id')}
+  flows: List[Dict] = []
+  for sid, sl in slices.items():
+    parent = slices.get(sl['args'].get('parent_id'))
+    if parent is None or parent['pid'] == sl['pid']:
+      continue
+    flows.append({'name': 'rpc', 'ph': 's', 'cat': 'flow',
+                  'id': str(sid), 'ts': parent['ts'],
+                  'pid': parent['pid'], 'tid': parent['tid']})
+    flows.append({'name': 'rpc', 'ph': 'f', 'bp': 'e', 'cat': 'flow',
+                  'id': str(sid), 'ts': sl['ts'],
+                  'pid': sl['pid'], 'tid': sl['tid']})
+  out.extend(flows)
   out.sort(key=lambda e: e['ts'])
   return {'traceEvents': out, 'displayTimeUnit': 'ms'}
 

@@ -38,7 +38,7 @@ import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
-from .live import parse_prometheus_text
+from .live import parse_prometheus_text, split_exemplar
 
 FLEET_SCRAPE_ENV = 'GLT_FLEET_SCRAPE_MS'
 DEFAULT_SCRAPE_MS = 1000.0
@@ -114,6 +114,9 @@ def parse_exposition(text: str) -> Dict[str, dict]:
       continue
     if line.startswith('#'):
       continue
+    # an OpenMetrics exemplar suffix owns the line's LAST '}' — strip
+    # it before the rpartition below, or the label body swallows it
+    line, _ = split_exemplar(line)
     name, _, rest = line.partition('{') if '{' in line.split(' ', 1)[0] \
         else (line.split(' ', 1)[0], '', '')
     if rest:
@@ -416,6 +419,42 @@ class FleetScraper:
         lines.append(f'{agg_fam}_p99_secs{_render_labels(labels)} '
                      f'{_fmt(p99)}')
     return lines
+
+  # -- fleet trace assembly -------------------------------------------------
+  def fetch_trace(self, trace_id: str) -> List[dict]:
+    """One request's spans from EVERY replica — the process-global
+    tracer (in-process replicas all record there) plus each remote
+    replica's ``/trace`` route — deduped by span_id and time-ordered.
+    Unreachable replicas contribute nothing (the assembled tree is
+    still served; a missing subtree IS the diagnostic)."""
+    from .tracing import tracer
+    spans: Dict[str, dict] = {
+        s['span_id']: s for s in tracer.spans_of(trace_id)}
+    with self._lock:
+      targets = list(self._targets)
+    for t in targets:
+      if not isinstance(t, HttpTarget):
+        continue                      # in-process: global tracer above
+      try:
+        status, body = t._get(f'/trace?trace_id={trace_id}')
+        if status != 200:
+          continue
+        payload = json.loads(body.decode('utf-8'))
+        for s in payload.get('spans', ()):
+          if s.get('span_id'):
+            spans.setdefault(s['span_id'], s)
+      except Exception:               # noqa: BLE001 — a down replica
+        continue
+    return sorted(spans.values(),
+                  key=lambda s: float(s.get('ts', 0.0)))
+
+  def trace_chrome(self, trace_id: str) -> dict:
+    """The assembled trace as a Chrome trace-event object (Perfetto-
+    loadable; cross-process parent→child edges become flow arrows)."""
+    from . import export
+    from .tracing import spans_to_events
+    return export.to_chrome_trace(
+        spans_to_events(self.fetch_trace(trace_id)))
 
   def fleet_json(self) -> dict:
     """Healthz rollup: fleet ``ok`` is the AND over scrapeable

@@ -151,8 +151,16 @@ class LiveHistogram(_Metric):
   (``span.<key>.hist.*`` in the backing store — the exact layout
   `gather_metrics` merges and ``report --metrics-json`` decodes)."""
 
-  def observe(self, secs: float) -> None:
+  def observe(self, secs: float,
+              exemplar: Optional[str] = None) -> None:
+    """Record one sample; with ``exemplar`` (a trace_id), remember it
+    as the landing bucket's last exemplar — ``/metrics`` renders it
+    in OpenMetrics exemplar syntax, the sanctioned trace_id channel
+    (a trace_id LABEL would mint unbounded series)."""
     _hist.record(self.key, secs, registry=self.registry._backing())
+    if exemplar is not None:
+      self.registry._note_exemplar(self.key, _hist.bucket_index(secs),
+                                   exemplar, secs)
 
 
 class LiveRegistry:
@@ -177,6 +185,10 @@ class LiveRegistry:
     self.strict = strict
     self._instances: Dict[Tuple[str, str], _Metric] = {}
     self._health: Dict[str, Callable[[], dict]] = {}
+    #: (hist flat key, bucket index) -> (trace_id, value secs, ts) —
+    #: last exemplar per bucket (bounded by buckets × instances)
+    self._exemplars: Dict[Tuple[str, int],
+                          Tuple[str, float, float]] = {}
 
   # -- backing store -------------------------------------------------------
   def _backing(self):
@@ -233,6 +245,20 @@ class LiveRegistry:
                 ) -> LiveHistogram:
     return self._get('histogram', name, labels,
                      lambda: LiveHistogram(self, name, labels))
+
+  def _note_exemplar(self, hist_key: str, bucket: int,
+                     trace_id: str, value_secs: float) -> None:
+    with self._lock:
+      self._exemplars[(hist_key, bucket)] = (
+          str(trace_id), float(value_secs), time.time())
+
+  def exemplar_of(self, hist_key: str, bucket: int
+                  ) -> Optional[Tuple[str, float, float]]:
+    """The (trace_id, value_secs, ts) exemplar last recorded in one
+    histogram bucket, if any — `report.py` uses it to jump from a
+    p99 bucket to a captured trace."""
+    with self._lock:
+      return self._exemplars.get((hist_key, bucket))
 
   def unregister_gauge(self, name: str,
                        labels: Optional[Dict[str, object]] = None,
@@ -339,6 +365,7 @@ class LiveRegistry:
       by_family: Dict[Tuple[str, str], List[_Metric]] = {}
       for (kind, _), m in self._instances.items():
         by_family.setdefault((m.name, kind), []).append(m)
+      exemplars = dict(self._exemplars)
     lines: List[str] = []
     for (name, kind) in sorted(by_family):
       doc = METRIC_NAMES.get(name, '')
@@ -363,10 +390,18 @@ class LiveRegistry:
           for i in range(_hist.NUM_BUCKETS):
             run += float(snap.get(f'{base}b{i:02d}', 0.0))
             le = _hist.bucket_upper_edge_secs(i)
-            lines.append(
-                f'{fam}_bucket'
-                f'{_prom_labels(m.labels, [("le", repr(le))])} '
-                f'{_fmt(run)}')
+            line = (f'{fam}_bucket'
+                    f'{_prom_labels(m.labels, [("le", repr(le))])} '
+                    f'{_fmt(run)}')
+            ex = exemplars.get((m.key, i))
+            if ex is not None:
+              # OpenMetrics exemplar: the bucket's last trace_id —
+              # absent entirely when tracing never attached one, so
+              # GLT_TRACE_SAMPLE=0 output is byte-identical
+              tid, val, ts = ex
+              line += (f' # {{trace_id="{tid}"}} {_fmt(val)} '
+                       f'{round(ts, 3)}')
+            lines.append(line)
           lines.append(f'{fam}_bucket'
                        f'{_prom_labels(m.labels, [("le", "+Inf")])} '
                        f'{_fmt(snap.get(base + "count", 0.0))}')
@@ -383,13 +418,38 @@ _SAMPLE_RE = re.compile(
     r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+'
     r'([+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN))$')
 
+#: OpenMetrics exemplar chunk (the part after ``# ``): a label set,
+#: a value, an optional timestamp
+_EXEMPLAR_RE = re.compile(
+    r'^\{[^{}]*\}\s+[+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN)'
+    r'(?:\s+[0-9]+(?:\.[0-9]+)?)?$')
+
+
+def split_exemplar(line: str) -> Tuple[str, Optional[str]]:
+  """``(sample_part, exemplar_or_None)`` for one exposition line.
+  Only a WELL-FORMED OpenMetrics exemplar suffix
+  (``... # {trace_id="…"} value [ts]``) is split off; anything else
+  is returned untouched so the strict sample regex still rejects it
+  loudly.  Shared by `parse_prometheus_text` and the federation
+  strict parser — without this, every exemplar-emitting replica
+  would be quarantined as malformed."""
+  idx = line.find(' # {')
+  if idx < 0:
+    return line, None
+  chunk = line[idx + 3:].strip()
+  if _EXEMPLAR_RE.match(chunk):
+    return line[:idx].rstrip(), chunk
+  return line, None
+
 
 def parse_prometheus_text(text: str) -> Dict[str, float]:
   """Strictly parse a Prometheus text exposition into
   ``{sample_name_with_labels: value}``; raises ``ValueError`` on the
   first malformed line.  The acceptance validator for the ops
   endpoint (and the bench's mid-run scrape check) — deliberately
-  small, not a Prometheus client."""
+  small, not a Prometheus client.  OpenMetrics exemplar suffixes on
+  bucket samples are accepted (and dropped — exemplars are trace
+  pointers, not sample values)."""
   out: Dict[str, float] = {}
   for n, raw in enumerate(text.splitlines(), 1):
     line = raw.strip()
@@ -399,6 +459,7 @@ def parse_prometheus_text(text: str) -> Dict[str, float]:
       if not (line.startswith('# HELP ') or line.startswith('# TYPE ')):
         raise ValueError(f'line {n}: malformed comment {raw!r}')
       continue
+    line, _ = split_exemplar(line)
     m = _SAMPLE_RE.match(line)
     if m is None:
       raise ValueError(f'line {n}: malformed sample {raw!r}')

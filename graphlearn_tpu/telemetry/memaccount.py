@@ -1,0 +1,158 @@
+"""Per-tier memory accounting + the serve-capacity headroom model.
+
+ROADMAP item 3 (SLO-driven elastic autoscaling) needs two signals
+that existed nowhere: **where the bytes are** and **how much traffic
+this replica could still absorb**.  This module declares both on the
+live registry so the fleet scraper federates them for free:
+
+  * `register_tier` — each memory owner (hot feature shards, the
+    cold-cache HBM ring, the streaming delta-CSR reserve, GNS bitmask
+    replication, the AOT executable cache on disk, the ingestion WAL)
+    registers a zero-argument byte callback under a fixed ``tier=``
+    label.  Two gauges per tier: ``memory.tier_bytes`` (scrape-time
+    occupancy) and ``memory.tier_peak_bytes`` (high-watermark since
+    registration — watermarks are tracked at scrape, so an idle
+    process pays nothing).  Re-registering a tier replaces the
+    callback ("latest instance wins", the registry's gauge contract).
+  * `CapacityModel` — a per-bucket EWMA of coalesced-dispatch service
+    cost (seconds per request, fed by the serving frontend after
+    every dispatch).  Traffic-weighting the per-bucket costs gives
+    the replica's sustainable capacity for its CURRENT mix; minus the
+    SLO tracker's observed short-window QPS that is the
+    ``fleet.headroom_qps`` gauge — the admission signal an autoscaler
+    (or the router's placement policy) consumes per replica.
+
+Everything here is scrape-time pull: byte callbacks and the headroom
+division run on the ops server's thread, never on the serve path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+#: the closed tier vocabulary (the ``tier=`` label's value domain)
+TIERS = ('hot', 'cold_cache', 'streaming', 'gns', 'aot', 'wal')
+
+#: EWMA smoothing for per-bucket dispatch cost (≈ the last ~10
+#: dispatches dominate — fast enough to track a mix shift, slow
+#: enough to ride out one cold-fill outlier)
+_ALPHA = 0.2
+
+
+def register_tier(tier: str, fn: Callable[[], Optional[float]],
+                  registry=None) -> Callable[[], None]:
+  """Export ``fn()`` bytes as the ``tier=<tier>`` occupancy gauge
+  (plus its peak twin); returns an unregister callable for the owner's
+  close path.  ``fn`` returning None (owner mid-teardown) drops the
+  sample from that scrape — and leaves the peak standing."""
+  if tier not in TIERS:
+    raise ValueError(
+        f'unknown memory tier {tier!r} — the closed vocabulary is '
+        f'{TIERS} (extend memaccount.TIERS and the schema label doc '
+        'together)')
+  if registry is None:
+    from .live import live as registry
+  state = {'peak': None}
+
+  def current() -> Optional[float]:
+    v = fn()
+    if v is None:
+      return None
+    v = float(v)
+    if state['peak'] is None or v > state['peak']:
+      state['peak'] = v
+    return v
+
+  def peak() -> Optional[float]:
+    current()
+    return state['peak']
+
+  registry.gauge('memory.tier_bytes', labels={'tier': tier},
+                 fn=current)
+  registry.gauge('memory.tier_peak_bytes', labels={'tier': tier},
+                 fn=peak)
+
+  def unregister() -> None:
+    registry.unregister_gauge('memory.tier_bytes', {'tier': tier},
+                              fn=current)
+    registry.unregister_gauge('memory.tier_peak_bytes',
+                              {'tier': tier}, fn=peak)
+  return unregister
+
+
+class CapacityModel:
+  """Per-bucket EWMA serve-cost model → ``fleet.headroom_qps``.
+
+  Args:
+    slo: the frontend's `SloTracker` (its short-window QPS is the
+      "traffic already carried" term; None = headroom equals raw
+      capacity).
+    registry: `LiveRegistry` to export on (None = the global one).
+
+  The serving executor is serial, so with per-request service cost
+  ``c_b`` for bucket ``b`` and observed request mix ``w_b``, the
+  sustainable rate is ``1 / Σ (w_b/Σw) · c_b`` — capacity for the
+  mix actually being served, not a best-case single-bucket number.
+  """
+
+  def __init__(self, slo=None, registry=None):
+    if registry is None:
+      from .live import live as registry
+    self._registry = registry
+    self._slo = slo
+    self._lock = threading.Lock()
+    self._cost: Dict[int, float] = {}     # bucket -> EWMA secs/request
+    self._weight: Dict[int, float] = {}   # bucket -> requests seen
+    # ONE bound-method object, pinned: the registry's fn-identity
+    # unregister guard compares with `is`, and each `self._headroom`
+    # access would mint a fresh bound method
+    self._headroom_fn = self._headroom
+    registry.gauge('fleet.headroom_qps', fn=self._headroom_fn)
+
+  def observe(self, bucket: int, requests: int, secs: float) -> None:
+    """Fold one coalesced dispatch (``requests`` riders served in
+    ``secs`` of executor wall time) into the bucket's cost EWMA."""
+    if requests <= 0 or secs < 0:
+      return
+    per_req = float(secs) / float(requests)
+    with self._lock:
+      prev = self._cost.get(bucket)
+      self._cost[bucket] = (per_req if prev is None
+                            else prev + _ALPHA * (per_req - prev))
+      self._weight[bucket] = \
+          self._weight.get(bucket, 0.0) + float(requests)
+
+  def capacity_qps(self) -> Optional[float]:
+    """Traffic-weighted sustainable request rate (None until the
+    first dispatch lands)."""
+    with self._lock:
+      total_w = sum(self._weight.values())
+      if not total_w:
+        return None
+      mean_cost = sum(self._weight[b] * self._cost[b]
+                      for b in self._cost) / total_w
+    if mean_cost <= 0:
+      return None
+    return 1.0 / mean_cost
+
+  def _headroom(self) -> Optional[float]:
+    cap = self.capacity_qps()
+    if cap is None:
+      return None
+    carried = 0.0
+    if self._slo is not None:
+      st = self._slo._cached_stats(self._slo.windows[0])
+      if st['count']:
+        carried = float(st['qps'])
+    return round(max(cap - carried, 0.0), 3)
+
+  def snapshot(self) -> dict:
+    with self._lock:
+      return {'cost_secs_per_request': dict(self._cost),
+              'requests_seen': dict(self._weight)}
+
+  def close(self) -> None:
+    """Unregister the headroom gauge (fn-identity guarded: a closed
+    frontend must not evict its replacement's gauge)."""
+    self._registry.unregister_gauge('fleet.headroom_qps',
+                                    fn=self._headroom_fn)

@@ -21,6 +21,13 @@
     ``replica=`` labels + ``glt_fleet_*`` aggregates); ``?format=json``
     returns the per-replica healthz rollup instead.  404 until a
     scraper is attached with `OpsServer.attach_fleet`.
+``/traces``
+    Index of tail-retained request traces from the process tracer
+    (`telemetry.tracing` — slow/failed/sampled requests only).
+``/trace?trace_id=``
+    One trace's spans.  With a fleet scraper attached the spans are
+    assembled across EVERY replica; ``?format=chrome`` renders the
+    Perfetto-loadable Chrome trace-event object instead of raw spans.
 
 Serving model: a `ThreadingHTTPServer` with daemon threads, so a
 slow, stalled or chaos-delayed scrape occupies ITS OWN thread and can
@@ -133,9 +140,43 @@ class _OpsHandler(BaseHTTPRequestHandler):
           body = fleet.prometheus_text().encode('utf-8')
           ctype = 'text/plain; version=0.0.4; charset=utf-8'
           status = 200
+      elif path == '/traces':
+        from .tracing import tracer
+        body = (json.dumps({'traces': tracer.traces(),
+                            'stats': tracer.stats()},
+                           indent=1) + '\n').encode('utf-8')
+        ctype = 'application/json'
+        status = 200
+      elif path == '/trace':
+        from .tracing import tracer
+        tid = (query.get('trace_id') or [''])[0]
+        fleet = getattr(self.server, 'fleet', None)
+        if fleet is not None:
+          spans = fleet.fetch_trace(tid)
+        else:
+          spans = tracer.spans_of(tid)
+        if not tid or not spans:
+          body = (f'no retained trace {tid!r} — see /traces for the '
+                  'index (only slow/failed/sampled requests are '
+                  'kept)\n').encode('utf-8')
+          ctype = 'text/plain'
+          status = 404
+        elif query.get('format', ['json'])[0] == 'chrome':
+          from . import export
+          from .tracing import spans_to_events
+          trace = export.to_chrome_trace(spans_to_events(spans))
+          body = (json.dumps(trace) + '\n').encode('utf-8')
+          ctype = 'application/json'
+          status = 200
+        else:
+          body = (json.dumps({'trace_id': tid, 'spans': spans},
+                             indent=1) + '\n').encode('utf-8')
+          ctype = 'application/json'
+          status = 200
       else:
         body = (f'no such route {path!r} — try /metrics, /varz, '
-                '/healthz, /timeseries, /fleet\n').encode('utf-8')
+                '/healthz, /timeseries, /fleet, /traces, '
+                '/trace?trace_id=\n').encode('utf-8')
         ctype = 'text/plain'
         status = 404
     except chaos.InjectedFault as e:

@@ -31,11 +31,20 @@ THRESHOLD_ENV = 'GLT_REGRESS_THRESHOLD'
 DEFAULT_BASELINE = 'BENCH_BASELINE.json'
 DEFAULT_THRESHOLD = 0.2
 
-#: headline metrics the gate tracks: (dotted key, direction).
+#: headline metrics the gate tracks: (dotted key, direction[, opts]).
 #: 'lower' = smaller is better (times), 'higher' = bigger is better
-#: (rates).  Keys absent from either side are SKIPPED, not failed —
-#: phases degrade day to day and a missing phase is not a regression.
-METRICS: Tuple[Tuple[str, str], ...] = (
+#: (rates), 'present' = the key must exist as a number.  Keys absent
+#: from either side are SKIPPED, not failed — phases degrade day to
+#: day and a missing phase is not a regression.  The optional third
+#: element is a per-metric options dict:
+#:   'threshold'    — override the global slowdown tolerance
+#:   'pin_baseline' — compare against a FIXED value instead of the
+#:                    recorded baseline (absolute acceptance lines
+#:                    that must not drift with the trajectory)
+#:   'when'         — ('present' only) the guard applies only when
+#:                    this other dotted key exists in the artifact
+#:                    (i.e. the owning phase actually ran)
+METRICS: Tuple[Tuple, ...] = (
     ('value', 'lower'),                       # the headline epoch time
     ('fused_epoch_secs', 'lower'),
     ('fused_epoch_secs_bf16', 'lower'),
@@ -120,6 +129,20 @@ METRICS: Tuple[Tuple[str, str], ...] = (
     # skew signal the cold-tier placement feeds on)
     ('dist.attribution.cross_partition_bytes_frac', 'lower'),
     ('dist.attribution.hot_range_coverage', 'higher'),
+    # request-tracing guard (ISSUE 17): tracing-ON serve cost over
+    # tracing-OFF on the same closed-loop schedule.  Pinned against a
+    # FIXED 1.0 baseline with a 5% tolerance, so the gate reads
+    # exactly "ratio <= 1.05" — a drifting recorded baseline must
+    # never ratchet the acceptance line upward
+    ('dist.serving.tracing_overhead_ratio', 'lower',
+     {'threshold': 0.05, 'pin_baseline': 1.0}),
+    # capacity-signal guard (ISSUE 17): whenever the fleet phase ran
+    # at all, the replicas' EWMA capacity model must have exported a
+    # live fleet.headroom_qps — the gauge's VALUE swings with load
+    # (ungateable by ratio), but its absence means the autoscaler's
+    # admission signal silently died
+    ('dist.serving.fleet_headroom_qps', 'present',
+     {'when': 'dist.serving.fleet_qps'}),
 )
 
 
@@ -166,8 +189,29 @@ def compare(artifact: Dict, baseline: Dict,
   """
   rows: List[Dict] = []
   regressed: List[str] = []
-  for key, direction in METRICS:
-    cur, base = _get(artifact, key), _get(baseline, key)
+  for entry in METRICS:
+    key, direction = entry[0], entry[1]
+    opts = entry[2] if len(entry) > 2 else {}
+    thr = opts.get('threshold', threshold)
+    cur = _get(artifact, key)
+    if direction == 'present':
+      gate = opts.get('when')
+      if gate is not None and _get(artifact, gate) is None:
+        rows.append({'key': key, 'direction': direction,
+                     'current': cur, 'baseline': _get(baseline, key),
+                     'change_pct': None, 'status': 'skipped'})
+        continue
+      status = 'ok' if cur is not None else 'regressed'
+      if status == 'regressed':
+        regressed.append(key)
+      rows.append({'key': key, 'direction': direction, 'current': cur,
+                   'baseline': _get(baseline, key),
+                   'change_pct': 0.0 if cur is not None else 100.0,
+                   'status': status})
+      continue
+    base = opts.get('pin_baseline')
+    if base is None:
+      base = _get(baseline, key)
     if cur is None or base is None or base == 0:
       rows.append({'key': key, 'direction': direction, 'current': cur,
                    'baseline': base, 'change_pct': None,
@@ -181,7 +225,7 @@ def compare(artifact: Dict, baseline: Dict,
       # token in the artifact would make the whole file unparseable —
       # the exact failure mode the sink exists to prevent)
       slowdown = min(base / cur - 1.0 if cur else 1e4, 1e4)
-    status = 'regressed' if slowdown > threshold else 'ok'
+    status = 'regressed' if slowdown > thr else 'ok'
     if status == 'regressed':
       regressed.append(key)
     rows.append({'key': key, 'direction': direction, 'current': cur,
@@ -216,6 +260,12 @@ def format_report(verdict: Dict) -> str:
     if m['status'] == 'skipped':
       lines.append(f"  [skip] {m['key']}: missing on one side "
                    f"(current={m['current']}, baseline={m['baseline']})")
+      continue
+    if m['direction'] == 'present':
+      tag = 'FAIL' if m['status'] == 'regressed' else ' ok '
+      state = ('MISSING (required while its phase ran)'
+               if m['current'] is None else f"present ({m['current']})")
+      lines.append(f"  [{tag}] {m['key']}: {state}")
       continue
     tag = 'FAIL' if m['status'] == 'regressed' else ' ok '
     lines.append(
@@ -287,7 +337,7 @@ def check(artifact, baseline: Optional[str] = None,
     # holes, and compare() SKIPS keys missing from either side — name
     # the uncovered metrics loudly so the hole is a choice, not a
     # surprise (re-bootstrap from a complete run to close it)
-    missing = [k for k, _ in METRICS if _get(art, k) is None]
+    missing = [e[0] for e in METRICS if _get(art, e[0]) is None]
     return ({'status': 'PASS', 'baseline_created': True,
              'baseline_path': bp, 'threshold': thr, 'metrics': [],
              'regressed': [], 'unguarded': missing}, 0)

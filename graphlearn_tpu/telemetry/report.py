@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import Dict, List, Optional
 
@@ -609,6 +610,45 @@ def render_postmortem(bundle: Dict) -> str:
   return '\n'.join(out)
 
 
+_BUCKET_RE = re.compile(
+    r'^(?P<name>[A-Za-z_:][\w:]*)_bucket\{(?P<labels>[^}]*)\}\s')
+
+
+def format_exemplars(text: str) -> str:
+  """The p99→trace jump (ISSUE 17): for each histogram family in a
+  saved ``/metrics`` exposition, the HIGHEST bucket carrying an
+  OpenMetrics exemplar — its trace id is a retained trace of a
+  request that LANDED in that bucket, fetchable at
+  ``/trace?trace_id=<id>`` (``&format=chrome`` for Perfetto)."""
+  from .live import split_exemplar
+  best: Dict[str, tuple] = {}
+  for line in text.splitlines():
+    sample, ex = split_exemplar(line)
+    if ex is None:
+      continue
+    m = _BUCKET_RE.match(sample.strip())
+    if m is None:
+      continue
+    labels = m.group('labels')
+    le_m = re.search(r'le="([^"]+)"', labels)
+    le = le_m.group(1) if le_m else '+Inf'
+    le_v = float('inf') if le == '+Inf' else float(le)
+    tid_m = re.search(r'trace_id="([^"]+)"', ex)
+    if tid_m is None:
+      continue
+    rest = ','.join(kv for kv in labels.split(',')
+                    if not kv.startswith('le=') and kv)
+    key = m.group('name') + (f'{{{rest}}}' if rest else '')
+    if key not in best or le_v > best[key][0]:
+      best[key] = (le_v, le, tid_m.group(1))
+  if not best:
+    return ''
+  rows = [[key, le, tid, f'/trace?trace_id={tid}']
+          for key, (_, le, tid) in sorted(best.items())]
+  return _kv_table(rows, ['histogram', 'top bucket le',
+                          'exemplar trace', 'fetch'])
+
+
 def histograms_from_metrics_json(path: str) -> Dict[str, Histogram]:
   """Decode a `gather_metrics` dump (the ``aggregate`` dict, or the
   whole result object) into merged histograms."""
@@ -643,7 +683,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                        '(attribution_stats JSON, a bench envelope '
                        'row, or a records JSONL): P×P byte matrix, '
                        'padding-waste-by-layout, hot-range table')
+  ap.add_argument('--exemplars', metavar='METRICS_TXT',
+                  help='render the p99→trace jump table from a '
+                       'saved /metrics exposition: per histogram, '
+                       'the top exemplar-carrying bucket and its '
+                       '/trace?trace_id= fetch')
   args = ap.parse_args(argv)
+  if args.exemplars:
+    with open(args.exemplars) as f:
+      table = format_exemplars(f.read())
+    print('# exemplar → trace jumps '
+          f'({args.exemplars})')
+    print(table if table else
+          '(no exemplars in the exposition — tracing off, or no '
+          'traced request has landed in any bucket yet)')
+    return 0
   if args.postmortem:
     from .postmortem import load_bundle
     print(render_postmortem(load_bundle(args.postmortem)))

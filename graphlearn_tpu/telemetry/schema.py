@@ -245,6 +245,33 @@ SPAN_NAMES: Dict[str, str] = {
         '(device program + tiered host fill) — bucket, requests, '
         'seeds; queue wait is OUTSIDE this span (serving.request '
         'latency_ms minus this span = admission/coalescing wait)',
+    'serving.route':
+        'FleetRouter (request-trace root): one routed serve request '
+        'submit→resolve, spanning the replica RPC + coalesced '
+        'dispatch — replica, outcome; span_id == trace_id '
+        '(recorded via telemetry.tracing, tail-retained)',
+    'serving.rpc':
+        'DistServer.serve_infer: one serve RPC on the server process '
+        '(submit→future resolve) — the cross-process edge under '
+        'serving.route (telemetry.tracing)',
+    'serving.queue_wait':
+        'serving frontend, per request: admission enqueue → '
+        'coalesce pickup (the wait the coalescing executor imposed; '
+        'also a live histogram under the same name)',
+    'serving.dispatch_slice':
+        'serving frontend, per request: this request\'s share of one '
+        'coalesced dispatch (pickup → demux resolve) — bucket, '
+        'requests riding the same dispatch (telemetry.tracing)',
+    'serving.sample_collect':
+        'serving engine, per dispatch: the neighbor-sampling collect '
+        'program inside a tiered dispatch, parented under the '
+        'dispatch slice — with serving.cold_fill it splits sampling '
+        'cost from feature-fill cost (telemetry.tracing)',
+    'serving.cold_fill':
+        'serving engine, per dispatch: the tiered host cold-path '
+        'feature fill inside the dispatch (cache serve + host '
+        'gather), parented under the dispatch slice '
+        '(telemetry.tracing)',
 }
 
 
@@ -457,6 +484,26 @@ METRIC_NAMES: Dict[str, str] = {
         'counter: exchange ids routed to a NON-self partition range '
         '(off-diagonal attribution mass — what locality-aware '
         'partitioning exists to shrink)',
+    'serving.queue_wait':
+        'histogram: per-request admission enqueue → coalesce pickup '
+        'wait (seconds; log2 buckets) — overload diagnosis without '
+        'inferring waits from shed diagnostics',
+    'serving.traces_retained_total':
+        'counter: request traces kept by the tail-retention verdict '
+        '(slow/failed/sampled — telemetry.tracing; the /traces ring '
+        'is bounded, this counts total captures)',
+    'memory.tier_bytes':
+        'gauge: bytes currently held by one memory tier, labeled '
+        'tier=hot|cold_cache|streaming|gns|aot|wal (scrape-time '
+        'callback from each owner — telemetry.memaccount)',
+    'memory.tier_peak_bytes':
+        'gauge: high-watermark of memory.tier_bytes since the '
+        'owner registered (tracked at scrape time, by tier)',
+    'fleet.headroom_qps':
+        'gauge: sustainable request rate minus carried short-window '
+        'QPS for this replica (traffic-weighted per-bucket EWMA '
+        'serve-cost model — telemetry.memaccount.CapacityModel; '
+        'the admission signal for SLO-driven autoscaling)',
 }
 
 
@@ -494,6 +541,10 @@ METRIC_LABELS: Dict[str, str] = {
     'partition':
         'partition/range index: 0..P-1, bounded by the mesh '
         'num_parts (PartitionBook range ids)',
+    'tier':
+        'memory accounting tier: hot|cold_cache|streaming|gns|aot|'
+        'wal (the closed memaccount.TIERS vocabulary — six fixed '
+        'byte-gauge families, never per-object)',
 }
 
 

@@ -200,6 +200,7 @@ def _server_chaos_proc(port_q, jsonl, worker_plan):
   wait_and_shutdown_server(timeout=180)
 
 
+@pytest.mark.slow
 def test_remote_chaos_epoch_exact(monkeypatch, tmp_path):
   """The acceptance scenario: one worker kill (server side) + one
   connection drop + one delayed fetch in a single epoch -> exact batch

@@ -274,8 +274,8 @@ def test_submit_evict_race_still_redrives(request):
   request.addfinalizer(lambda: router.close(close_replicas=True))
   orig = reps[0].submit
 
-  def racing_submit(seeds, deadline_ms=None):
-    fut = orig(seeds, deadline_ms)
+  def racing_submit(seeds, deadline_ms=None, trace=None):
+    fut = orig(seeds, deadline_ms, trace=trace)
     router._evict('r0')              # the monitor wins the race
     return fut
 

@@ -478,6 +478,39 @@ def test_metric_label_negative(tmp_path):
                                 rel='pkg/mod.py', run=run))
 
 
+def test_metric_label_forbidden_trace_keys(tmp_path):
+  # trace_id/span_id are forbidden regardless of schema declarations
+  # — a per-request id label mints one series per request, the exact
+  # leak exemplars exist to avoid (ISSUE 17)
+  run = _label_fixture(tmp_path, {})
+  src = _src('''
+      def wire(live, tid, sid):
+        live.histogram('a.lat', labels={'trace_id': tid})
+        live.counter('a.spans_total', labels={'span_id': sid})
+  ''')
+  found = _live(check_source(src, 'metric-label-cardinality',
+                             rel='pkg/mod.py', run=run))
+  msgs = '\n'.join(f.render() for f in found)
+  assert "'trace_id'" in msgs and 'forbidden label key' in msgs
+  assert "'span_id'" in msgs and 'exemplars' in msgs
+  assert len(found) == 2, msgs
+
+
+def test_metric_label_forbidden_keys_negative(tmp_path):
+  # exemplar plumbing that never makes trace_id a label KEY is clean:
+  # the id rides `observe(..., exemplar=tid)`, not the series space
+  run = _label_fixture(tmp_path, {
+      'bucket': 'bucket capacity: bounded by the serving ladder',
+  })
+  src = _src('''
+      def wire(live, cap, tid):
+        h = live.histogram('a.lat', labels={'bucket': cap})
+        h.observe(0.25, exemplar=tid)
+  ''')
+  assert not _live(check_source(src, 'metric-label-cardinality',
+                                rel='pkg/mod.py', run=run))
+
+
 def test_metric_label_ignores_non_package_files(tmp_path):
   run = _label_fixture(tmp_path, {})
   src = "def go(reg):\n  reg.counter('x.y_total', labels={'z': 1})\n"

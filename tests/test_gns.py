@@ -340,6 +340,7 @@ def test_gns_breaks_hit_rate_ceiling():
   assert rates[True] > 1.5 * rates[False], (rates, ceiling)
 
 
+@pytest.mark.slow
 def test_gns_fused_tiered_trains_and_off_is_identical():
   """FusedDistEpoch on a tiered store: GLT_GNS=0 epochs are
   bit-identical to the default driver, and a GNS-on epoch trains to

@@ -63,6 +63,7 @@ def _model_tx():
           optax.adam(1e-2))
 
 
+@pytest.mark.slow
 def test_learns_through_per_batch_loader():
   rows, cols, x, y = _planted()
   train_idx, test_idx = _splits()
@@ -89,6 +90,7 @@ def test_learns_through_per_batch_loader():
   assert acc > BAR, f'per-batch path accuracy {acc:.3f} <= {BAR}'
 
 
+@pytest.mark.slow
 def test_learns_through_fused_epoch():
   rows, cols, x, y = _planted()
   train_idx, test_idx = _splits()
@@ -112,6 +114,7 @@ def test_learns_through_fused_epoch():
   assert acc > BAR, f'fused path accuracy {acc:.3f} <= {BAR}'
 
 
+@pytest.mark.slow
 def test_learns_through_dist_loader():
   from graphlearn_tpu.parallel import (DistNeighborLoader,
                                        local_batch_piece,

@@ -4,6 +4,7 @@ Mirrors the intent of reference `test/python/test_link_loader.py` on
 the TPU padding contract.
 """
 import numpy as np
+import pytest
 
 from graphlearn_tpu.data import Dataset
 from graphlearn_tpu.loader import LinkNeighborLoader
@@ -111,6 +112,7 @@ def test_padded_tail_batch_masks():
   assert not mask[2:8].any()
 
 
+@pytest.mark.slow
 def test_unsupervised_training_decreases_loss():
   import jax
   import optax

@@ -175,6 +175,7 @@ def test_bf16_hub_degree_counts_not_saturated():
   assert rel < 0.05, rel
 
 
+@pytest.mark.slow
 def test_dgcnn_learns_graph_label():
   """DGCNN separates graphs by structure: dense cliques vs sparse
   rings (graph-level task, static sort-pool)."""

@@ -36,6 +36,7 @@ def _products_schema_npz(path, n=4000, d=100, classes=12, seed=0):
            test_idx=idx[int(n * .8):n - 5].astype(np.int64))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize('split_ratio', ['1.0', '0.5'])
 def test_train_sage_on_products_schema_npz(tmp_path, split_ratio):
   npz = tmp_path / 'products_schema.npz'

@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 
 def _run_with_full_passes(*test_ids: str):
   env = dict(os.environ, GLT_TEST_NO_FAST_XLA='1')
@@ -27,6 +29,7 @@ def _run_with_full_passes(*test_ids: str):
       f'{out.stdout[-2000:]}\n{out.stderr[-1000:]}')
 
 
+@pytest.mark.slow
 def test_parity_under_production_passes():
   _run_with_full_passes(
       'tests/test_fused_epoch.py::test_fused_step_matches_manual_batch',

@@ -32,6 +32,7 @@ def _server_proc(port_q):
   wait_and_shutdown_server(timeout=60)
 
 
+@pytest.mark.slow
 def test_multi_server_fanout():
   """List-valued server_rank spreads one loader across servers."""
   ctx = mp.get_context('forkserver')

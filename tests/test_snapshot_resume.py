@@ -219,6 +219,7 @@ def _fused(ds, apply_fn, tx, **kw):
   return FusedEpoch(ds, [4, 3], np.arange(90), apply_fn, tx, **kw)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize('split_ratio', [1.0, 0.5],
                          ids=['resident', 'tiered'])
 def test_fused_epoch_kill_resume_byte_identical(tmp_path, monkeypatch,
@@ -555,6 +556,7 @@ def _host_key(b):
           np.asarray(b.node).tobytes(), np.asarray(b.x).tobytes())
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(
     not __import__('graphlearn_tpu').native.available(),
     reason='native lib unavailable')

@@ -250,6 +250,7 @@ def test_sweep_reads_slo_through_memo_without_tracker_lock():
     store.close()
 
 
+@pytest.mark.slow
 def test_concurrent_observe_and_sample_consistent():
   """observe() writers hammer the tracker while the sweep samples at
   full speed: no exception, every query parses, and the final window

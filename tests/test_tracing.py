@@ -1,0 +1,441 @@
+"""Request-scoped fleet tracing + memory/capacity accounting
+(ISSUE 17): tail-based retention rules, the in-process router trace
+tree, exemplar round-trips through the strict exposition parsers and
+the federation merge, the ``GLT_TRACE_SAMPLE=0`` byte-identity
+contract, per-tier memory gauges vs actual nbytes, the capacity/
+headroom model — and the acceptance gate: one serve request routed
+over the REAL 2-process DistServer RPC yields one assembled trace
+with ≥5 spans across ≥2 pids, fetchable at ``/trace?trace_id=`` and
+Perfetto-exportable with cross-process flow events.
+"""
+import json
+import multiprocessing as mp
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from graphlearn_tpu.telemetry import Metrics
+from graphlearn_tpu.telemetry.live import (LiveRegistry,
+                                           parse_prometheus_text,
+                                           split_exemplar)
+from graphlearn_tpu.telemetry.memaccount import (TIERS, CapacityModel,
+                                                 register_tier)
+from graphlearn_tpu.telemetry.tracing import (Tracer, child_ctx,
+                                              spans_to_events, tracer)
+
+N, D = 48, 4
+FANOUTS = [2, 2]
+BUCKETS = (1, 2, 4)
+
+
+def _reg():
+  return LiveRegistry(store=Metrics(), strict=True)
+
+
+def _tiered_dataset():
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.data.feature import Feature
+  rng = np.random.default_rng(0)
+  rows = np.repeat(np.arange(N), 4)
+  cols = rng.integers(0, N, rows.shape[0])
+  feats = (np.arange(N, dtype=np.float32)[:, None]
+           * np.ones((1, D), np.float32))
+  ds = Dataset().init_graph((rows, cols), layout='COO', num_nodes=N)
+  # tiered store (hot split + cold cache): the serve path pays a
+  # host cold fill, so traced requests grow a `serving.cold_fill` leg
+  ds.node_features = Feature(feats, split_ratio=0.5, cold_cache_rows=8)
+  return ds
+
+
+@pytest.fixture(autouse=True)
+def _trace_clean():
+  yield
+  tracer.configure(sample=0, slow_ms=0.0, buffer=None)
+  tracer.clear()
+
+
+# -- tracer unit behavior ------------------------------------------------------
+def test_tail_retention_rules():
+  tr = Tracer(sample=2, slow_ms=50.0, buffer=4)
+  c1, c2 = tr.mint(), tr.mint()
+  assert c1['k'] == 1 and c2['k'] == 0     # 1-in-2 head sample
+  tr.span('serving.route', c2, dur=0.001)
+  # fast + ok + unsampled -> dropped (and its pending spans freed)
+  assert not tr.resolve(c2, outcome='ok', latency_ms=1.0)
+  assert tr.spans_of(c2['t']) == []
+  # head-sampled -> retained even when fast
+  assert tr.resolve(c1, outcome='ok', latency_ms=1.0)
+  c3, c4 = tr.mint(), tr.mint()
+  assert c4['k'] == 0
+  # slow tail -> retained without the sample bit
+  assert tr.resolve(c4, outcome='ok', latency_ms=60.0)
+  # failed/shed -> retained regardless of speed and sampling
+  c5, c6 = tr.mint(), tr.mint()
+  assert c6['k'] == 0
+  assert tr.resolve(c6, outcome='shed', latency_ms=0.1)
+  idx = tr.traces()
+  assert [e['outcome'] for e in idx] == ['shed', 'ok', 'ok']
+  assert idx[0]['trace_id'] == c6['t']      # newest first
+
+
+def test_resolve_merge_is_idempotent():
+  tr = Tracer(sample=1, slow_ms=0.0, buffer=8)
+  ctx = tr.mint()
+  tr.span('serving.queue_wait', ctx, dur=0.001)
+  assert tr.resolve(ctx, outcome='ok', latency_ms=2.0)
+  # a late span (the rpc wrapper closing after the frontend resolved)
+  # merges into the retained tree, and a second resolve upgrades the
+  # outcome/latency instead of double-retaining
+  tr.span('serving.rpc', ctx, dur=0.002)
+  assert tr.resolve(ctx, outcome='error', latency_ms=5.0)
+  assert len(tr.traces()) == 1
+  entry = tr.traces()[0]
+  assert entry['outcome'] == 'error'
+  assert entry['latency_ms'] == 5.0
+  assert {s['name'] for s in tr.spans_of(ctx['t'])} == \
+      {'serving.queue_wait', 'serving.rpc'}
+
+
+def test_retained_ring_is_bounded():
+  tr = Tracer(sample=1, slow_ms=0.0, buffer=3)
+  tids = []
+  for _ in range(5):
+    ctx = tr.mint()
+    tids.append(ctx['t'])
+    tr.resolve(ctx, outcome='ok')
+  idx = [e['trace_id'] for e in tr.traces()]
+  assert idx == list(reversed(tids[-3:]))   # oldest evicted first
+
+
+def test_chrome_export_flow_events_across_pids():
+  """Cross-process parent→child edges become Perfetto flow arrows
+  ('s'/'f' pairs), and every span exports as one balanced X slice."""
+  from graphlearn_tpu.telemetry.export import to_chrome_trace
+  root = {'kind': 'span', 'name': 'serving.route', 'trace_id': 'T',
+          'span_id': 'a', 'parent_id': None, 'pid': 100, 'tid': 1,
+          'ts': 1000.0, 'dur': 0.05}
+  child = {'kind': 'span', 'name': 'serving.rpc', 'trace_id': 'T',
+           'span_id': 'b', 'parent_id': 'a', 'pid': 200, 'tid': 2,
+           'ts': 1000.01, 'dur': 0.03}
+  events = spans_to_events([root, child])
+  assert all('mono' not in e for e in events)   # wall-clock timebase
+  trace = to_chrome_trace(events)
+  evs = trace['traceEvents']
+  xs = [e for e in evs if e.get('ph') == 'X']
+  assert len(xs) == 2
+  starts = [e for e in evs if e.get('ph') == 's']
+  finishes = [e for e in evs if e.get('ph') == 'f']
+  assert len(starts) == 1 and len(finishes) == 1
+  assert starts[0]['pid'] == 100 and finishes[0]['pid'] == 200
+  assert starts[0]['id'] == finishes[0]['id']
+
+
+# -- exemplars -----------------------------------------------------------------
+def test_exemplar_roundtrip_render_parse_federate():
+  from graphlearn_tpu.telemetry.federation import (FleetScraper,
+                                                   parse_exposition)
+  r1, r2 = _reg(), _reg()
+  h1 = r1.histogram('serving.request_latency', labels={'bucket': 4})
+  h1.observe(0.2, exemplar='aaaa00000000000b')
+  h1.observe(0.004)                 # exemplar-free bucket stays bare
+  h2 = r2.histogram('serving.request_latency', labels={'bucket': 4})
+  h2.observe(0.1, exemplar='cccc00000000000d')
+  text = r1.prometheus_text()
+  ex_lines = [ln for ln in text.splitlines() if ' # {' in ln]
+  assert len(ex_lines) == 1
+  assert '# {trace_id="aaaa00000000000b"}' in ex_lines[0]
+  assert '_bucket{' in ex_lines[0]
+  sample, ex = split_exemplar(ex_lines[0])
+  assert ' # {' not in sample and 'trace_id="aaaa00000000000b"' in ex
+  # both strict parsers accept-and-strip the exemplar suffix
+  flat = parse_prometheus_text(text)
+  assert any(k.startswith('glt_serving_request_latency_bucket{')
+             for k in flat)
+  fams = parse_exposition(text)
+  assert 'glt_serving_request_latency' in fams
+  # federation merge over exemplar-carrying expositions stays exact
+  fs = FleetScraper(registry=_reg())
+  fs.add_registry('a', r1)
+  fs.add_registry('b', r2)
+  fs.scrape()
+  merged = parse_prometheus_text(fs.prometheus_text())
+  assert merged[
+      'glt_fleet_serving_request_latency_bucket{bucket="4",le="+Inf"}'
+  ] == 3.0
+
+
+def test_exemplar_of_and_report_jump():
+  from graphlearn_tpu.telemetry.histogram import bucket_index
+  from graphlearn_tpu.telemetry.report import format_exemplars
+  reg = _reg()
+  h = reg.histogram('serving.request_latency', labels={'bucket': 2})
+  h.observe(0.2, exemplar='feedfacefeedface')
+  assert reg.exemplar_of(h.key, bucket_index(0.2))[0] == \
+      'feedfacefeedface'
+  table = format_exemplars(reg.prometheus_text())
+  assert 'feedfacefeedface' in table
+  assert '/trace?trace_id=feedfacefeedface' in table
+
+
+# -- memory + capacity accounting ----------------------------------------------
+def test_memaccount_gauges_match_nbytes():
+  reg = _reg()
+  arrays = {'streaming': np.zeros((100, 8), np.float32),
+            'cold_cache': np.zeros((16, 4), np.float32),
+            'wal': np.zeros(333, np.uint8)}
+  unregs = [register_tier(t, lambda a=a: a.nbytes, registry=reg)
+            for t, a in arrays.items()]
+  snap = parse_prometheus_text(reg.prometheus_text())
+  total = 0
+  for t, a in arrays.items():
+    assert snap[f'glt_memory_tier_bytes{{tier="{t}"}}'] == a.nbytes
+    assert snap[f'glt_memory_tier_peak_bytes{{tier="{t}"}}'] == \
+        a.nbytes
+    total += a.nbytes
+  assert sum(v for k, v in snap.items()
+             if k.startswith('glt_memory_tier_bytes{')) == total
+  for u in unregs:
+    u()
+  assert 'glt_memory_tier_bytes' not in reg.prometheus_text()
+
+
+def test_memaccount_peak_watermark_and_closed_vocabulary():
+  reg = _reg()
+  state = {'n': 4096}
+  register_tier('gns', lambda: state['n'], registry=reg)
+  snap = parse_prometheus_text(reg.prometheus_text())
+  assert snap['glt_memory_tier_peak_bytes{tier="gns"}'] == 4096
+  state['n'] = 128                  # occupancy shrinks, peak stands
+  snap = parse_prometheus_text(reg.prometheus_text())
+  assert snap['glt_memory_tier_bytes{tier="gns"}'] == 128
+  assert snap['glt_memory_tier_peak_bytes{tier="gns"}'] == 4096
+  with pytest.raises(ValueError):
+    register_tier('scratch', lambda: 1, registry=reg)
+  assert 'scratch' not in TIERS
+
+
+def test_capacity_model_headroom():
+  reg = _reg()
+  cm = CapacityModel(slo=None, registry=reg)
+  assert cm.capacity_qps() is None  # no dispatches yet -> no claim
+  # header declared, but no SAMPLE until the first dispatch lands
+  assert '\nglt_fleet_headroom_qps ' not in reg.prometheus_text()
+  cm.observe(bucket=4, requests=2, secs=0.2)   # 0.1 s/request
+  assert cm.capacity_qps() == pytest.approx(10.0)
+  snap = parse_prometheus_text(reg.prometheus_text())
+  assert snap['glt_fleet_headroom_qps'] == pytest.approx(10.0)
+  # the EWMA tracks a cost shift; weights follow the traffic mix
+  for _ in range(50):
+    cm.observe(bucket=4, requests=1, secs=0.05)
+  assert cm.capacity_qps() == pytest.approx(20.0, rel=0.15)
+  cm.close()
+  assert '\nglt_fleet_headroom_qps ' not in reg.prometheus_text()
+
+
+# -- the serve plane, in process -----------------------------------------------
+@pytest.fixture(scope='module')
+def local_fleet():
+  from graphlearn_tpu.serving import ServingEngine, ServingFrontend
+  from graphlearn_tpu.serving.router import FleetRouter, LocalReplica
+  engine = ServingEngine(_tiered_dataset(), FANOUTS, seed=7,
+                         buckets=BUCKETS)
+  frontend = ServingFrontend(engine, auto_start=True, warmup=True,
+                             max_wait_ms=1.0,
+                             default_deadline_ms=4000.0)
+  router = FleetRouter([LocalReplica('r0', frontend)],
+                       auto_start=False)
+  yield router, frontend, engine
+  router.close()
+  frontend.shutdown()
+
+
+def test_local_router_trace_tree_and_exemplar(local_fleet):
+  from graphlearn_tpu.telemetry.live import live
+  router, frontend, _ = local_fleet
+  tracer.configure(sample=1, slow_ms=0.0, buffer=64)
+  tracer.clear()
+  router.infer([3, 5], timeout=60)
+  idx = tracer.traces()
+  assert len(idx) == 1 and idx[0]['outcome'] == 'ok'
+  tid = idx[0]['trace_id']
+  spans = tracer.spans_of(tid)
+  by_name = {s['name']: s for s in spans}
+  assert {'serving.route', 'serving.queue_wait',
+          'serving.dispatch_slice', 'serving.sample_collect',
+          'serving.cold_fill'} <= set(by_name)
+  root = by_name['serving.route']
+  assert root['span_id'] == tid and root['parent_id'] is None
+  assert by_name['serving.queue_wait']['parent_id'] == tid
+  for leaf in ('serving.sample_collect', 'serving.cold_fill'):
+    assert by_name[leaf]['parent_id'] == \
+        by_name['serving.dispatch_slice']['span_id']
+  # the trace id landed as the latency histogram's bucket exemplar
+  ex = [ln for ln in live.prometheus_text().splitlines()
+        if f'trace_id="{tid}"' in ln]
+  assert ex and all('glt_serving_request_latency_bucket{' in ln
+                    for ln in ex)
+  # the capacity model saw the dispatch -> headroom is exported
+  assert 'headroom_qps' in frontend.stats()
+
+
+def test_sample_zero_is_byte_identical(local_fleet):
+  from graphlearn_tpu.telemetry.live import live
+  router, _, _ = local_fleet
+  seeds = [7, 11, 13]
+  tracer.configure(sample=1, slow_ms=0.0, buffer=64)
+  tracer.clear()
+  traced = router.infer(seeds, timeout=60)
+  tracer.configure(sample=0, slow_ms=0.0, buffer=64)
+  tracer.clear()
+  before = dict(live._exemplars)
+  untraced = router.infer(seeds, timeout=60)
+  # the data plane is byte-identical with tracing off...
+  assert untraced.nodes.tobytes() == traced.nodes.tobytes()
+  assert untraced.x.tobytes() == traced.x.tobytes()
+  # ...and nothing was minted, retained, or exemplar-stamped
+  st = tracer.stats()
+  assert st['minted'] == 0 and st['retained'] == 0 \
+      and st['pending'] == 0
+  assert dict(live._exemplars) == before
+
+
+def test_shed_trace_is_retained(local_fleet):
+  from graphlearn_tpu.serving import AdmissionRejected
+  router, _, _ = local_fleet
+  tracer.configure(sample=1000000, slow_ms=0.0, buffer=64)
+  tracer.clear()
+  tracer.mint()                     # burn the 1-in-N head-sample slot
+  with pytest.raises(AdmissionRejected):
+    router.infer(list(range(BUCKETS[-1] + 1)), timeout=60)
+  # the shed request was NOT head-sampled, yet its trace is
+  # tail-retained (outcome != ok is always interesting)
+  idx = tracer.traces()
+  assert len(idx) == 1 and idx[0]['outcome'] == 'shed'
+  assert idx[0]['sampled'] == 0
+
+
+# -- the acceptance gate: 2-process trace assembly -----------------------------
+class _StubHostDataset:
+  """`DistServer` wants a dataset for the PRODUCER path; serving
+  tests never touch producers (the test_serving_rpc stub)."""
+  num_nodes = N
+  num_edges = N * 4
+  node_features = None
+  node_labels = None
+
+
+def _traced_server_proc(q):
+  """Child: serving tier + RPC server + ops endpoint; exits when the
+  parent client leaves."""
+  from graphlearn_tpu.distributed import (init_server,
+                                          wait_and_shutdown_server)
+  from graphlearn_tpu.serving import ServingEngine, ServingFrontend
+  from graphlearn_tpu.telemetry.opsserver import OpsServer
+  engine = ServingEngine(_tiered_dataset(), FANOUTS, seed=7,
+                         buckets=BUCKETS)
+  frontend = ServingFrontend(engine, auto_start=True, warmup=True,
+                             max_wait_ms=1.0,
+                             default_deadline_ms=8000.0)
+  srv = init_server(num_servers=1, num_clients=1, rank=0,
+                    dataset=_StubHostDataset(), host='127.0.0.1',
+                    port=0)
+  srv.attach_serving(frontend)
+  ops = OpsServer(port=0)
+  q.put((srv.port, ops.url))
+  wait_and_shutdown_server(timeout=300)
+
+
+@pytest.mark.slow
+def test_cross_process_trace_assembly():
+  """One routed serve request through FleetRouter → RemoteReplica →
+  the real serve RPC → coalesced dispatch → tiered cold fill yields
+  ONE assembled trace: ≥5 spans, ≥2 processes, correct parentage,
+  fetchable via the coordinator's ``/trace?trace_id=`` and exported
+  as a Perfetto-loadable Chrome trace with flow events."""
+  from graphlearn_tpu.distributed import init_client
+  from graphlearn_tpu.serving.router import FleetRouter, RemoteReplica
+  from graphlearn_tpu.telemetry.federation import FleetScraper
+  from graphlearn_tpu.telemetry.opsserver import OpsServer
+
+  ctx_mp = mp.get_context('forkserver')
+  q = ctx_mp.Queue()
+  # non-daemonic: the server process owns its own threads/executors
+  proc = ctx_mp.Process(target=_traced_server_proc, args=(q,),
+                        daemon=False)
+  proc.start()
+  client = router = None
+  try:
+    port, ops_url = q.get(timeout=240)
+    client = init_client([('127.0.0.1', port)], rank=0,
+                         num_clients=1)
+    tracer.configure(sample=1, slow_ms=0.0, buffer=64)
+    tracer.clear()
+    router = FleetRouter([RemoteReplica('r0', client, 0)],
+                         auto_start=False)
+    out = router.infer([3, 5], timeout=120)
+    assert out.nodes.shape[0] == 2
+
+    idx = tracer.traces()
+    assert len(idx) == 1
+    tid = idx[0]['trace_id']
+    # this process only saw the routing leg...
+    assert {s['name'] for s in tracer.spans_of(tid)} == \
+        {'serving.route'}
+    # ...the fleet scraper reassembles the full cross-process tree
+    fs = FleetScraper(registry=_reg())
+    fs.add_url('r0', ops_url)
+    spans = fs.fetch_trace(tid)
+    by_name = {s['name']: s for s in spans}
+    assert {'serving.route', 'serving.rpc', 'serving.queue_wait',
+            'serving.dispatch_slice', 'serving.sample_collect',
+            'serving.cold_fill'} <= set(by_name)
+    assert len(spans) >= 5
+    assert len({s['pid'] for s in spans}) >= 2
+    root = by_name['serving.route']
+    rpc = by_name['serving.rpc']
+    assert root['parent_id'] is None and root['span_id'] == tid
+    assert rpc['parent_id'] == root['span_id']
+    assert rpc['pid'] != root['pid']
+    for child in ('serving.queue_wait', 'serving.dispatch_slice'):
+      assert by_name[child]['parent_id'] == rpc['span_id']
+      assert by_name[child]['pid'] == rpc['pid']
+    for leaf in ('serving.sample_collect', 'serving.cold_fill'):
+      assert by_name[leaf]['parent_id'] == \
+          by_name['serving.dispatch_slice']['span_id']
+
+    # the ops routes serve the assembled trace
+    ops = OpsServer(registry=_reg(), port=0)
+    ops.attach_fleet(fs)
+    try:
+      with urllib.request.urlopen(
+          f'{ops.url}/trace?trace_id={tid}', timeout=15) as r:
+        payload = json.loads(r.read().decode('utf-8'))
+      assert payload['trace_id'] == tid
+      assert len(payload['spans']) >= 5
+      with urllib.request.urlopen(
+          f'{ops.url}/trace?trace_id={tid}&format=chrome',
+          timeout=15) as r:
+        chrome = json.loads(r.read().decode('utf-8'))
+      xs = [e for e in chrome['traceEvents'] if e.get('ph') == 'X']
+      assert len(xs) == len(spans)     # balanced: every span a slice
+      assert any(e.get('ph') == 's' for e in chrome['traceEvents'])
+      assert any(e.get('ph') == 'f' for e in chrome['traceEvents'])
+    finally:
+      ops.close()
+
+    # the child's own /traces index lists the retained trace
+    with urllib.request.urlopen(f'{ops_url}/traces', timeout=15) as r:
+      listing = json.loads(r.read().decode('utf-8'))
+    assert any(e['trace_id'] == tid for e in listing['traces'])
+  finally:
+    if router is not None:
+      router.close()
+    if client is not None:
+      client.shutdown()
+    proc.join(timeout=120)
+    if proc.is_alive():
+      proc.terminate()
+      proc.join(timeout=30)
+  assert proc.exitcode == 0

@@ -42,6 +42,12 @@ from .event_schema import registry_tables
 
 _REGISTRARS = ('counter', 'gauge', 'histogram')
 
+#: per-request identifiers may NEVER be label keys — every request
+#: would mint a fresh time series (the worst possible cardinality
+#: leak).  Traces attach to metrics via exemplars (ISSUE 17), which
+#: annotate a bucket sample without widening the series space.
+_FORBIDDEN_KEYS = frozenset({'trace_id', 'span_id'})
+
 
 def _labels_value(call: ast.Call) -> Optional[ast.AST]:
   """The AST node carrying the call's labels, or None when the call
@@ -144,6 +150,14 @@ class MetricLabelPass(GlintPass):
               message=f'{kind}(...) has a non-string-constant '
                       'label KEY — keys are the closed vocabulary; '
                       'only values may be dynamic')
+          continue
+        if k.value in _FORBIDDEN_KEYS:
+          yield Finding(
+              rule=self.name, path=ctx.rel, line=node.lineno,
+              message=f'{kind}(...) uses forbidden label key '
+                      f'{k.value!r} — a per-request id as a label '
+                      'mints one time series per request; attach '
+                      'traces to metrics via exemplars instead')
           continue
         self._used.setdefault(k.value, (ctx.rel, node.lineno))
 
