@@ -1212,7 +1212,36 @@ def _run_ingest_row(timeout: int):
   return r
 
 
-def _aggregate(results, fused_res, dist, hetero=None):
+def _run_pallas_row(timeout: int):
+  """`benchmarks/bench_pallas_sample.py` (ISSUE 18): FusedEpoch step
+  time through the r19 `sample_one_hop_auto` dispatcher with the knob
+  OFF (the threading must cost the default path nothing), the
+  pinned-host cold gather at split<1 against the FIXED 1.355 GB/s
+  untiered XLA line, and the delta-CSR merge rate.  Runs on whatever
+  accelerator the driver sees — the kernel-ON rows are hardware-only
+  and skip cleanly on CPU (interpret-mode walls measure the
+  interpreter, not the lowering).  Feeds pallas.fused_step_ms /
+  pallas.feature_lookup_gbps / pallas.delta_merge_events_per_sec."""
+  script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'benchmarks', 'bench_pallas_sample.py')
+  cmd = [sys.executable, script, '--quick']
+  try:
+    out = subprocess.run(cmd, capture_output=True, text=True,
+                         timeout=timeout)
+  except subprocess.TimeoutExpired:
+    return None
+  for ln in reversed((out.stdout or '').strip().splitlines()):
+    if ln.startswith('{'):
+      try:
+        r = json.loads(ln)
+      except json.JSONDecodeError:
+        continue
+      if r.get('metric') == 'pallas_sample':   # per-row emit lines
+        return r                               # also start with '{'
+  return None
+
+
+def _aggregate(results, fused_res, dist, hetero=None, pallas=None):
   """The full artifact schema from whatever phases have completed so
   far.  The HEADLINE `value` is the fused whole-epoch time when the
   fused session has landed (and passed its floor check), else the
@@ -1319,6 +1348,7 @@ def _aggregate(results, fused_res, dist, hetero=None):
       'session_modes': [r['mode'] for r in results],
       'steps_per_epoch': results[0]['steps'] if results else None,
       'dist': dist,
+      'pallas': pallas,
   }
 
 
@@ -1447,14 +1477,16 @@ def main():
     return total_budget - (time.monotonic() - t_start)
 
   results, fused_res, dist, hetero = [], None, None, None
+  pallas_row = [None]
   last_art = [None]
 
   def emit():
     """The indestructible-artifact contract: full cumulative
     aggregate to the artifact FILE after every completed phase;
     stdout gets only the bounded summary line."""
-    if results or fused_res or dist or hetero:
-      last_art[0] = _aggregate(results, fused_res, dist, hetero)
+    if results or fused_res or dist or hetero or pallas_row[0]:
+      last_art[0] = _aggregate(results, fused_res, dist, hetero,
+                               pallas_row[0])
       print(_emit_artifact(last_art[0]), flush=True)
 
   # phase 1 — one primary session (epochs + sampling + roofline).
@@ -1632,6 +1664,21 @@ def main():
   elif isinstance(dist, dict) and 'error' not in dist:
     print(f'budget: skipping failover phase ({budget_left():.0f}s '
           f'left)', file=sys.stderr)
+
+  # phase 3i — Pallas fused-pipeline rows (ISSUE 18): dispatcher-
+  # threaded FusedEpoch step time (knob OFF), pinned-host cold-gather
+  # GB/s at split<1 (hardware-only, 1.355 GB/s pin), delta-merge
+  # events/s; feeds the pallas.* regression guards.  Unlike the dist
+  # phases this row does NOT need the dist section — it measures
+  # single-process paths and attaches at the artifact top level
+  if budget_left() > 120:
+    r = _run_pallas_row(int(min(420, max(budget_left() - 30, 90))))
+    if r is not None:
+      pallas_row[0] = r
+      emit()
+  else:
+    print(f'budget: skipping pallas rows ({budget_left():.0f}s left)',
+          file=sys.stderr)
 
   # phase 4 — extra primary sessions stabilize the per-batch median
   while (len(results) < sessions and attempts < sessions + 3

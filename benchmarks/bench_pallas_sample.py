@@ -1,0 +1,272 @@
+"""Pallas fused sampling pipeline microbench (ISSUE 18, r19):
+FusedEpoch step time through the `sample_one_hop_auto` dispatcher,
+pinned-host cold-gather GB/s at split<1, delta-CSR merge events/s.
+
+Three guarded rows (telemetry/regress.py "pallas." block):
+
+  * ``fused_step_ms`` — knob-OFF FusedEpoch ms/step on the dispatcher-
+    threaded path.  The r19 threading (window-table staging in the
+    epoch's `_dev` dict, the trace-time dispatch) must cost the
+    DEFAULT path nothing; this row is the watchdog.
+  * ``feature_lookup_gbps`` — the pinned-host zero-copy cold gather at
+    split_ratio 0.25, pinned against the FIXED 1.355 GB/s untiered
+    XLA line (ROADMAP r18 roofline).  HARDWARE-ONLY: the pin is a TPU
+    number, so the guarded key is stamped only when a TPU is attached;
+    under JAX_PLATFORMS=cpu the row carries the raw CPU numbers
+    unguarded (`*_cpu` keys) and the guard skips cleanly.
+  * ``delta_merge_events_per_sec`` — the host delta-CSR merge rate
+    (platform-independent; the device kernel row is TPU-only).
+
+Kernel-ON timings (``fused_step_ms_kernel``, the device merge) are
+likewise TPU-only: on CPU the kernels run in Pallas interpret mode,
+whose walls measure the interpreter, not the lowering — a number
+worse than meaningless in a trajectory.  The dispatch LADDER however
+is platform-free and always reported: one tiny knob-ON trace with the
+flight recorder on, counting ``pallas.dispatch`` / ``pallas.fallback``
+events (plus one forced-fallback probe pinning the reason string).
+
+Usage::
+
+    python benchmarks/bench_pallas_sample.py [--cpu] [--quick]
+
+Emits per-row `common.emit` lines; the LAST stdout line is the full
+JSON row (bench.py's pallas-phase subprocess parses it bottom-up,
+same salvage contract as every other phase).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import Timer, build_graph, emit
+
+#: the r18 untiered XLA feature-gather line (GB/s) the pinned path is
+#: measured against on hardware — also the regress pin_baseline
+XLA_UNTIERED_GBPS = 1.355
+
+
+def _dispatch_ladder(jax, jnp):
+  """Knob-ON dispatch accounting on a toy graph: one supported trace
+  (-> pallas.dispatch) and one replace=True probe (-> pallas.fallback
+  with the 'replace-arm' reason).  Pure tracing discipline — valid on
+  every platform, CPU included (interpret mode makes the toy shapes
+  cheap)."""
+  from graphlearn_tpu.ops.pallas_sample import sample_one_hop_auto
+  from graphlearn_tpu.telemetry.recorder import recorder
+  rng = np.random.default_rng(0)
+  n = 512
+  deg = rng.poisson(10, n)
+  indptr = np.zeros(n + 1, np.int64)
+  np.cumsum(deg, out=indptr[1:])
+  indices = jnp.asarray(
+      rng.integers(0, n, int(indptr[-1])).astype(np.int32))
+  indptr = jnp.asarray(indptr)
+  seeds = jnp.asarray(rng.integers(0, n, 64).astype(np.int32))
+  key = jax.random.PRNGKey(0)
+  os.environ['GLT_PALLAS_SAMPLE'] = '1'
+  was = recorder.enabled
+  recorder.enable()
+  try:
+    recorder.clear()
+    sample_one_hop_auto(indptr, indices, seeds, 8, key)
+    sample_one_hop_auto(indptr, indices, seeds, 8, key, replace=True)
+    evs = recorder.events()
+    ladder = {
+        'dispatch': sum(e['kind'] == 'pallas.dispatch' for e in evs),
+        'fallback': sum(e['kind'] == 'pallas.fallback' for e in evs),
+        'fallback_reasons': sorted({e['reason'] for e in evs
+                                    if e['kind'] == 'pallas.fallback'}),
+    }
+  finally:
+    recorder.clear()
+    if not was:
+      recorder.disable()
+    os.environ.pop('GLT_PALLAS_SAMPLE', None)
+  return ladder
+
+
+def _fused_step_row(jax, jnp, row, n, on_tpu, quick):
+  """FusedEpoch ms/step, knob OFF (guarded) and — on hardware — knob
+  ON (the fused kernel path; rebuilt because the knob resolves at
+  epoch __init__)."""
+  import optax
+  from graphlearn_tpu.data import Dataset
+  from graphlearn_tpu.loader import FusedEpoch, NeighborLoader
+  from graphlearn_tpu.models import (GraphSAGE, create_train_state,
+                                     make_supervised_step)  # noqa: F401
+
+  dim, classes, batch = 64, 16, 256
+  fanouts = [10, 5]
+  rows, cols = build_graph(n)
+  rng = np.random.default_rng(0)
+  feats = rng.standard_normal((n, dim)).astype(np.float32)
+  labels = (np.arange(n) % classes).astype(np.int32)
+  ds = (Dataset()
+        .init_graph((rows, cols), layout='COO', num_nodes=n)
+        .init_node_features(feats, split_ratio=1.0)
+        .init_node_labels(labels))
+  steps = 4 if quick else 8
+  train_idx = rng.permutation(n)[:batch * steps]
+  loader = NeighborLoader(ds, fanouts, train_idx[:batch],
+                          batch_size=batch, shuffle=False, seed=0)
+  first = next(iter(loader))
+  model = GraphSAGE(hidden_features=64, out_features=classes,
+                    num_layers=2)
+  tx = optax.adam(1e-3)
+  state, apply_fn = create_train_state(
+      model, jax.random.key(0), first, tx)
+
+  def timed_epoch(knob):
+    if knob:
+      os.environ['GLT_PALLAS_SAMPLE'] = '1'
+    else:
+      os.environ.pop('GLT_PALLAS_SAMPLE', None)
+    try:
+      ep = FusedEpoch(ds, fanouts, train_idx, apply_fn, tx,
+                      batch_size=batch, shuffle=True, seed=0,
+                      max_steps_per_program=steps)
+      st = state
+      st, _ = ep.run(st)            # compile + first epoch
+      jax.tree_util.tree_leaves(st.params)[0].block_until_ready()
+      t0 = time.perf_counter()
+      st, _ = ep.run(st)
+      jax.tree_util.tree_leaves(st.params)[0].block_until_ready()
+      return 1000.0 * (time.perf_counter() - t0) / len(ep)
+    finally:
+      os.environ.pop('GLT_PALLAS_SAMPLE', None)
+
+  row['fused_step_ms'] = round(timed_epoch(False), 3)
+  emit('pallas_fused_step_ms', row['fused_step_ms'], 'ms/step',
+       impl='xla-dispatcher', steps=steps, batch=batch)
+  if on_tpu:
+    row['fused_step_ms_kernel'] = round(timed_epoch(True), 3)
+    emit('pallas_fused_step_ms', row['fused_step_ms_kernel'],
+         'ms/step', impl='pallas', steps=steps, batch=batch)
+  else:
+    row['fused_step_ms_kernel'] = None
+    row['fused_kernel_skipped'] = 'cpu-interpret'
+
+
+def _cold_gather_row(jax, row, n, on_tpu, quick):
+  """Feature-lookup GB/s at split_ratio 0.25: compact host path vs
+  the pinned-host zero-copy gather, same id sets, cache OFF so the
+  rows measure the miss path the pinned buffer serves."""
+  from graphlearn_tpu.data import Feature
+  dim = 128
+  rng = np.random.default_rng(1)
+  feats = rng.standard_normal((n, dim)).astype(np.float32)
+  iters = 5 if quick else 20
+  id_sets = [rng.integers(0, n, 4096).astype(np.int64)
+             for _ in range(iters)]
+
+  os.environ['GLT_COLD_CACHE_ROWS'] = '0'
+  gbps = {}
+  try:
+    for impl, knob in (('xla', False), ('pinned', True)):
+      if knob:
+        os.environ['GLT_PALLAS_COLD'] = '1'
+      else:
+        os.environ.pop('GLT_PALLAS_COLD', None)
+      f = Feature(feats, split_ratio=0.25)
+      for ids in id_sets:
+        f[ids].block_until_ready()          # warm / build the buffer
+      nbytes = 0
+      with Timer() as t:
+        res = None
+        for ids in id_sets:
+          res = f[ids]
+          nbytes += res.size * res.dtype.itemsize
+        res.block_until_ready()
+      gbps[impl] = nbytes / t.dt / 1e9
+      emit('feature_lookup_gbps', gbps[impl], 'GB/s',
+           split_ratio=0.25, impl=impl,
+           baseline=XLA_UNTIERED_GBPS if on_tpu else None,
+           platform=jax.devices()[0].platform)
+  finally:
+    os.environ.pop('GLT_PALLAS_COLD', None)
+    os.environ.pop('GLT_COLD_CACHE_ROWS', None)
+  if on_tpu:
+    # the guarded key: pinned-path GB/s vs the FIXED 1.355 line
+    row['feature_lookup_gbps'] = round(gbps['pinned'], 4)
+    row['feature_lookup_gbps_xla_tiered'] = round(gbps['xla'], 4)
+  else:
+    row['feature_lookup_gbps'] = None
+    row['feature_lookup_gbps_cpu'] = round(gbps['pinned'], 4)
+    row['feature_lookup_gbps_xla_tiered_cpu'] = round(gbps['xla'], 4)
+    row['cold_gather_skipped'] = 'cpu (1.355 pin is a TPU line)'
+
+
+def _delta_merge_row(jax, row, n, on_tpu, quick):
+  """Delta-CSR merge events/s: host merge always (guarded), the
+  Pallas rank-kernel merge on hardware only."""
+  from graphlearn_tpu.streaming.delta import DeltaSegment, merge_delta_csr
+  rng = np.random.default_rng(2)
+  deg = rng.poisson(8, n)
+  indptr = np.zeros(n + 1, np.int64)
+  np.cumsum(deg, out=indptr[1:])
+  e = int(indptr[-1])
+  indices = np.concatenate(
+      [np.sort(rng.integers(0, n, d)) for d in deg if d]
+  ).astype(np.int64) if e else np.zeros(0, np.int64)
+  eids = np.arange(e, dtype=np.int64)
+  events = 2048 if quick else 8192
+  seg = DeltaSegment(src=rng.integers(0, n, events).astype(np.int64),
+                     dst=rng.integers(0, n, events).astype(np.int64),
+                     eids=(np.arange(events) + e).astype(np.int64))
+  reps = 3 if quick else 5
+  merge_delta_csr(indptr, indices, eids, seg)       # warm allocators
+  with Timer() as t:
+    for _ in range(reps):
+      merge_delta_csr(indptr, indices, eids, seg)
+  row['delta_merge_events_per_sec'] = round(reps * events / t.dt, 1)
+  emit('delta_merge_events_per_sec', row['delta_merge_events_per_sec'],
+       'events/s', impl='host', events=events)
+  if on_tpu:
+    from graphlearn_tpu.ops.pallas_delta import merge_delta_csr_device
+    out = merge_delta_csr_device(indptr, indices, eids, seg,
+                                 interpret=False)   # compile
+    with Timer() as t:
+      for _ in range(reps):
+        out = merge_delta_csr_device(indptr, indices, eids, seg,
+                                     interpret=False)
+    del out
+    row['delta_merge_device_events_per_sec'] = round(
+        reps * events / t.dt, 1)
+    emit('delta_merge_events_per_sec',
+         row['delta_merge_device_events_per_sec'], 'events/s',
+         impl='pallas', events=events)
+  else:
+    row['delta_merge_device_events_per_sec'] = None
+    row['delta_merge_device_skipped'] = 'cpu-interpret'
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--cpu', action='store_true')
+  ap.add_argument('--quick', action='store_true')
+  args = ap.parse_args()
+
+  import jax
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  import jax.numpy as jnp
+  on_tpu = jax.default_backend() == 'tpu'
+  n = 20_000 if args.quick else 100_000
+
+  row = {'metric': 'pallas_sample', 'platform': jax.devices()[0].platform,
+         'nodes': n}
+  row['dispatch_ladder'] = _dispatch_ladder(jax, jnp)
+  _fused_step_row(jax, jnp, row, n, on_tpu, args.quick)
+  _cold_gather_row(jax, row, n, on_tpu, args.quick)
+  _delta_merge_row(jax, row, n, on_tpu, args.quick)
+  print(json.dumps(row), flush=True)
+
+
+if __name__ == '__main__':
+  main()

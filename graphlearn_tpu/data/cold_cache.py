@@ -424,6 +424,118 @@ class DeviceColdCache:
         next(iter(self.rows.devices())))
 
 
+# -- pinned-host zero-copy cold gather (r19, ISSUE 18) ---------------------
+
+_PINNED_ENV = 'GLT_PALLAS_COLD'
+
+
+def pinned_cold_enabled() -> bool:
+  """Re-read ``GLT_PALLAS_COLD`` on every mixed-path build (kill
+  switch, the `pallas_gather.pallas_enabled` discipline)."""
+  return os.environ.get(_PINNED_ENV, '').strip().lower() in (
+      '1', 'true', 'on', 'yes')
+
+
+def _host_memory_sharding(dev):
+  """Best available host-side memory placement for ``dev``:
+  ``pinned_host`` where the backend has it (TPU — device-initiated
+  DMA reads the buffer without a host staging copy), else the
+  backend's plain host kind (CPU tier-1: the gather program is the
+  exact functional twin, just without the zero-copy property).
+  Returns ``(sharding, kind)``."""
+  from jax.sharding import SingleDeviceSharding
+  kinds = {m.kind for m in dev.addressable_memories()}
+  for kind in ('pinned_host', 'unpinned_host'):
+    if kind in kinds:
+      return SingleDeviceSharding(dev, memory_kind=kind), kind
+  return SingleDeviceSharding(dev), 'device'
+
+
+class PinnedColdBuffer:
+  """Cold-tier feature rows resident in pinned HOST memory, served by
+  a device-initiated jitted gather (PyTorch-Direct / GIDS style —
+  PAPERS.md arXiv 2101.07956, 2306.16384).
+
+  The PR 5 overlay's cold fill is ``np.take`` on the host followed by
+  a full-batch transfer — the host CPU touches every cold byte twice
+  (gather + copy into the transfer buffer).  Here the cold rows are
+  device_put ONCE into the accelerator-visible host memory kind and
+  every per-batch fill is one compiled ``take`` whose output lands in
+  device memory: the irregular access moves into the gather program
+  (device-initiated DMA over PCIe/ICI on TPU), the host stops
+  touching feature bytes per batch.  Byte parity with the ``np.take``
+  path is exact — same rows, same dtype cast (applied once at build
+  instead of per batch) — and pinned by tests/test_pallas_sample.py.
+
+  Owns the ``pinned_host`` memaccount tier: the buffer is that
+  tier's whole bill, so ``memory.tier_bytes{tier=pinned_host}``
+  tracks it live on /metrics.
+
+  Roofline note (r19): the fill is bandwidth-bound on the host link
+  (PCIe gen3 ~12 GB/s practical per direction; ICI-attached hosts
+  more), so the ceiling is link bandwidth x batch cold bytes — the
+  ``np.take`` path it replaces was never near that line because the
+  per-batch host gather + staging copy are latency/dispatch-bound
+  (the r18 roofline's 1.355 GB/s untiered-XLA comparison point).
+  The guarded bench row (`benchmarks/bench_pallas_sample.py`,
+  ``pallas.feature_lookup_gbps``) holds the pinned path above that
+  line on hardware; CPU tier-1 pins byte parity only."""
+
+  def __init__(self, rows_np: np.ndarray, dim: int, dtype,
+               device: Optional[jax.Device] = None):
+    dev = device if device is not None else jax.devices()[0]
+    arr = np.ascontiguousarray(rows_np)
+    if dtype is not None:
+      arr = arr.astype(dtype, copy=False)
+    if arr.ndim != 2 or arr.shape[1] != int(dim):
+      raise ValueError(f'expected [rows, {dim}] cold block, got '
+                       f'{arr.shape}')
+    sharding, self.memory_kind = _host_memory_sharding(dev)
+    self.rows = jax.device_put(arr, sharding)
+    from jax.sharding import SingleDeviceSharding
+    self._gather = jax.jit(
+        lambda rows, idx: jnp.take(rows, idx, axis=0),
+        out_shardings=SingleDeviceSharding(dev))
+    # capability probe: run one tiny gather end-to-end NOW so a
+    # backend that cannot lower host-memory gathers fails here, at
+    # build, where the caller can fall back — never per batch
+    np.asarray(self._gather(self.rows, jnp.zeros((1,), jnp.int32)))
+    from ..telemetry.memaccount import register_tier
+    register_tier('pinned_host',
+                  lambda r=self.rows: int(getattr(r, 'nbytes', 0)))
+
+  def gather(self, idx: np.ndarray) -> jax.Array:
+    """``[B] -> [B, D]`` device rows; indices are buffer-relative
+    (caller subtracts the hot-row base) and must be in range."""
+    return self._gather(self.rows, jnp.asarray(
+        np.ascontiguousarray(idx, np.int32)))
+
+
+def make_pinned_cold_buffer(rows_np, dim: int, dtype,
+                            device=None) -> Optional[PinnedColdBuffer]:
+  """`PinnedColdBuffer` when ``GLT_PALLAS_COLD`` is on and the
+  backend can serve it, else None (the caller keeps the host
+  ``np.take`` path — transparent fallback, byte-identical output).
+  Emits the kernel dispatch/fallback event once, at build."""
+  from ..telemetry.recorder import recorder
+  if not pinned_cold_enabled():
+    return None
+  try:
+    buf = PinnedColdBuffer(rows_np, dim, dtype, device=device)
+  except ValueError:
+    raise                          # contract errors surface as-is
+  except Exception as ex:
+    if recorder.enabled:
+      recorder.emit('pallas.fallback', kernel='cold_gather',
+                    reason=type(ex).__name__)
+    return None
+  if recorder.enabled:
+    recorder.emit('pallas.dispatch', kernel='cold_gather',
+                  rows=int(buf.rows.shape[0]),
+                  memory_kind=str(buf.memory_kind))
+  return buf
+
+
 # -- mesh flavor (dist samplers + tiered fused epochs) ---------------------
 
 @functools.lru_cache(maxsize=None)

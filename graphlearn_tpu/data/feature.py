@@ -135,6 +135,8 @@ class Feature:
       self.hot_rows = feats.shape[0]
       self._cache_rows = 0
       self._cold_cache = None
+      self._pinned_cold = None
+      self._pinned_failed = False
       self.cold_stats = {'lookups': 0, 'cold_lookups': 0}
       return
     feats = convert_to_array(feature_array)
@@ -159,6 +161,8 @@ class Feature:
         resolve_cache_rows(cold_cache_rows, n - self.hot_rows)
         if 0 < self.hot_rows < n else 0)
     self._cold_cache = None     # DeviceColdCache (lazy, see lazy_init)
+    self._pinned_cold = None    # PinnedColdBuffer (lazy, env-gated)
+    self._pinned_failed = False
     #: host-side cold accounting: lookups = valid ids per __getitem__,
     #: cold_lookups = ids past the hot tier (the cache denominator)
     self.cold_stats = {'lookups': 0, 'cold_lookups': 0}
@@ -290,15 +294,26 @@ class Feature:
       hit = slot = None
       miss_sel = cold_sel
     n_miss = int(miss_sel.sum())
-    cold_pad = next_power_of_two(n_miss)
-    compact = np.zeros((cold_pad, d), dtype=self._host_feats.dtype)
-    compact[:n_miss] = self._host_feats[idx[miss_sel]]
-    if self._dtype is not None:
-      compact = compact.astype(self._dtype)
-    # rank[i] = position of row i's value in the compact buffer
-    rank = np.cumsum(miss_sel) - 1
-    rank = np.where(miss_sel, rank, 0).astype(np.int32)
-    cold_rows = jnp.take(jnp.asarray(compact), jnp.asarray(rank), axis=0)
+    pinned = self._pinned_buffer()
+    if pinned is not None:
+      # r19 zero-copy path (ISSUE 18): the cold block already lives in
+      # the accelerator-visible host memory kind; one device-initiated
+      # compiled gather replaces host np.take + per-batch transfer.
+      # Same rows, same dtype cast (paid once at build) — the output
+      # is byte-identical to the compact path below.
+      rel = np.where(miss_sel, idx - self.hot_rows, 0).astype(np.int32)
+      cold_rows = pinned.gather(rel)
+    else:
+      cold_pad = next_power_of_two(n_miss)
+      compact = np.zeros((cold_pad, d), dtype=self._host_feats.dtype)
+      compact[:n_miss] = self._host_feats[idx[miss_sel]]
+      if self._dtype is not None:
+        compact = compact.astype(self._dtype)
+      # rank[i] = position of row i's value in the compact buffer
+      rank = np.cumsum(miss_sel) - 1
+      rank = np.where(miss_sel, rank, 0).astype(np.int32)
+      cold_rows = jnp.take(jnp.asarray(compact), jnp.asarray(rank),
+                           axis=0)
     hot_ok = jnp.asarray(valid & ~cold_sel)[:, None]
     cold_ok = jnp.asarray(miss_sel)[:, None]
     x = jnp.where(hot_ok, out, jnp.where(cold_ok, cold_rows, 0))
@@ -314,6 +329,24 @@ class Feature:
     """All-device gather (fully-hot tables, device ids): no host sync."""
     return _device_gather(self._hot, ids, self._id2index_dev,
                           use_pallas=pallas_enabled())
+
+  def _pinned_buffer(self):
+    """The lazily built `data.cold_cache.PinnedColdBuffer` over the
+    cold block, or None — ``GLT_PALLAS_COLD`` is re-read per batch
+    (kill switch), the build/probe runs at most once (a backend that
+    failed the probe falls back to the compact host path for the
+    process lifetime, never re-probing per batch)."""
+    from .cold_cache import make_pinned_cold_buffer, pinned_cold_enabled
+    if not pinned_cold_enabled():
+      return None
+    if self._pinned_cold is None and not self._pinned_failed:
+      dev = self._device or jax.devices()[0]
+      self._pinned_cold = make_pinned_cold_buffer(
+          self._host_feats[self.hot_rows:], self.feature_dim,
+          self._dtype, dev)
+      if self._pinned_cold is None:
+        self._pinned_failed = True
+    return self._pinned_cold
 
   # -- DataPlaneState (utils.checkpoint): the dynamic cache only ----------
   # (the hot tier and host table are reconstructed from the dataset —

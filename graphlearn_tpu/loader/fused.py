@@ -47,6 +47,8 @@ from ..models.train import (TrainState, make_extracted_eval_step,
                             make_extracted_supervised_step)
 from ..ops.negative import sample_negative
 from ..ops.pallas_gather import pallas_enabled
+from ..ops.pallas_sample import fused_sample_enabled
+from ..ops.pallas_window import prepare_window_table
 from ..sampler.base import NegativeSampling
 from ..sampler.neighbor_sampler import (NeighborSampler, _multihop_sample,
                                         _triplet_neg_dst)
@@ -262,6 +264,10 @@ class _SnapshotHooks:
   by the single-chip classes here and the mesh drivers in
   `parallel.fused`, so the save/restore contracts cannot drift.
 
+  Also hosts `_init_fused_sampling`, the r19 Pallas fused-sampler
+  resolution shared by the homo/link drivers (hetero stays on the
+  XLA path).
+
   Lifecycle::
 
       snap = fused.attach_snapshots()        # GLT_SNAPSHOT_DIR, or
@@ -284,6 +290,22 @@ class _SnapshotHooks:
 
   _snap = None
   _resume_progress = None
+  _use_fused = False
+  _win_e = 0
+
+  def _init_fused_sampling(self, graph) -> None:
+    """Resolve GLT_PALLAS_SAMPLE once per driver (the epoch programs
+    compile once, so the dispatch is baked per driver — value-
+    identical either way) and stage the O(E) window repack into the
+    jit-argument dict so the kernel's DMA table rides the same
+    no-closure discipline as the other big tables."""
+    self._use_fused = fused_sample_enabled()
+    self._win_e = 0
+    self._dev['win2d'] = None
+    if self._use_fused:
+      win2d, e = prepare_window_table(graph.indices)
+      self._dev['win2d'] = win2d
+      self._win_e = int(e)
 
   def attach_snapshots(self, manager=None):
     """Attach a `SnapshotManager` (``None`` builds one from
@@ -736,6 +758,7 @@ class FusedEpoch(_SupervisedScanEpoch):
                      id2index=(None if self._tiered
                                else feat._id2index_dev),
                      labels=labels)
+    self._init_fused_sampling(graph)
 
     # identical capacity arithmetic to the per-batch sampler, so fused
     # and per-batch programs see the same static shapes
@@ -784,9 +807,10 @@ class FusedEpoch(_SupervisedScanEpoch):
     from the cache-aware Feature between dispatches)."""
     (nodes, _count, row, col, _edge, emask, seed_local, _nsn,
      _nse) = _multihop_sample(
-         dev['indptr'], dev['indices'], None, seeds, key,
+         dev['indptr'], dev['indices'], None, seeds, key, dev['win2d'],
          fanouts=self.fanouts, node_cap=self._node_cap,
-         with_edge=False, sort_locality=self.sort_locality)
+         with_edge=False, sort_locality=self.sort_locality,
+         use_fused=self._use_fused, win_e=self._win_e)
     return Batch(
         x=None,
         y=_gather_labels(dev['labels'], nodes),
@@ -813,9 +837,10 @@ class FusedEpoch(_SupervisedScanEpoch):
     `data/feature.py:39-40`)."""
     (nodes, _count, row, col, _edge, emask, seed_local, _nsn,
      _nse) = _multihop_sample(
-         dev['indptr'], dev['indices'], None, seeds, key,
+         dev['indptr'], dev['indices'], None, seeds, key, dev['win2d'],
          fanouts=self.fanouts, node_cap=self._node_cap,
-         with_edge=False, sort_locality=self.sort_locality)
+         with_edge=False, sort_locality=self.sort_locality,
+         use_fused=self._use_fused, win_e=self._win_e)
     return Batch(
         x=_device_gather(dev['hot'], nodes, dev['id2index'],
                          use_pallas=use_pallas),
@@ -1042,6 +1067,7 @@ class FusedLinkEpoch(_SnapshotHooks):
                      id2index=(None if self._tiered
                                else feat._id2index_dev),
                      labels=data.get_node_label_device())
+    self._init_fused_sampling(graph)
 
     rows, cols = _as_edge_pairs(edge_label_index)
     self._batcher = EdgeSeedBatcher(rows, cols, edge_label,
@@ -1271,9 +1297,10 @@ class FusedLinkEpoch(_SnapshotHooks):
   def _expand(self, seeds: jax.Array, key: jax.Array, dev: dict):
     (nodes, _count, row, col, _edge, emask, seed_local, _nsn,
      _nse) = _multihop_sample(
-         dev['indptr'], dev['indices'], None, seeds, key,
+         dev['indptr'], dev['indices'], None, seeds, key, dev['win2d'],
          fanouts=self.fanouts, node_cap=self._node_cap,
-         with_edge=False, sort_locality=self.sort_locality)
+         with_edge=False, sort_locality=self.sort_locality,
+         use_fused=self._use_fused, win_e=self._win_e)
     return seed_local, (nodes, row, col, emask)
 
   def _epoch_fn(self, state: TrainState, srcs: jax.Array,

@@ -54,8 +54,8 @@ import optax
 from ..data.dataset import Dataset
 from ..data.feature import _device_gather
 from ..models.train import TrainState
-from ..ops.neighbor import sample_one_hop
 from ..ops.pallas_gather import pallas_enabled
+from ..ops.pallas_sample import sample_one_hop_auto
 from .fused import _SupervisedScanEpoch, _uncached_jit
 from .node_loader import SeedBatcher
 from .transform import _gather_labels
@@ -74,9 +74,12 @@ def expand_tree_levels(indptr, indices, seeds, key, fanouts, *,
   levels, masks = [seeds], [seeds >= 0]
   frontier = seeds
   for i, k in enumerate(fanouts):
-    res = sample_one_hop(indptr, indices, frontier, k,
-                         jax.random.fold_in(key, i),
-                         sort_locality=sort_locality)
+    # `sample_one_hop_auto` re-reads GLT_PALLAS_SAMPLE at trace time;
+    # the epoch drivers compile once per config so the choice is baked
+    # per program (value-identical either way)
+    res = sample_one_hop_auto(indptr, indices, frontier, k,
+                              jax.random.fold_in(key, i),
+                              sort_locality=sort_locality)
     nxt = jnp.where(res.mask, res.nbrs, -1).reshape(-1)
     levels.append(nxt)
     masks.append(nxt >= 0)

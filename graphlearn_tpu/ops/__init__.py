@@ -2,10 +2,16 @@
 reference's C++/CUDA kernel layer (`graphlearn_torch/csrc/`)."""
 from .neighbor import (OneHopResult, cal_nbr_prob, default_window,
                        lookup_degree, sample_one_hop)
-from .gns import (DecayedSketch, bitmask_lookup, cached_set_bits,
-                  gns_enabled, sample_one_hop_gns)
+from .gns import (DecayedSketch, bitmask_lookup, bits_table,
+                  cached_set_bits, dedup_requester_bits,
+                  fallback_req_index, gns_enabled, is_per_requester,
+                  sample_one_hop_gns)
 from .negative import NegativeSampleResult, edge_in_csr, sample_negative
 from .pallas_gather import gather_rows, pallas_enabled
+from .pallas_sample import (fused_sample_enabled, fused_sample_supported,
+                            sample_one_hop_auto, sample_one_hop_fused)
+from .pallas_delta import (DeltaMergeUnsupported, delta_merge_enabled,
+                           merge_delta_csr_device)
 from .random_walk import node2vec_walk, random_walk, walk_edges
 from .subgraph import SubGraphResult, induced_subgraph
 from .unique import (InducerState, UniqueResult, induce_next, init_node,

@@ -327,24 +327,65 @@ def set_resident_bits(base_bits: np.ndarray, resident_ids: np.ndarray,
   return bits
 
 
-def bitmask_lookup(bits: jax.Array, ids: jax.Array,
+def is_per_requester(bits) -> bool:
+  """True when ``bits`` carries per-requester rows (the deduped
+  ``(table, row_index)`` tuple or the legacy replicated 2-D stack)
+  and therefore needs ``req`` at lookup time."""
+  if isinstance(bits, tuple):
+    return True
+  return getattr(bits, 'ndim', 1) == 2
+
+
+def fallback_req_index(bits) -> int:
+  """The requester index whose mask is the conservative hot-split-
+  only fallback (unattributable recv rows map here) — the LAST
+  logical requester row under both bitmask encodings."""
+  if isinstance(bits, tuple):
+    return int(bits[1].shape[0] - 1)
+  return int(bits.shape[0] - 1)
+
+
+def bits_table(bits) -> jax.Array:
+  """The physical ``[T, nbytes]`` byte table behind any bitmask
+  encoding: the dedup tuple's table, a replicated 2-D stack as-is, a
+  1-D shared mask viewed as one row.  (The Pallas fused kernel DMAs
+  this block into VMEM whole — dedup is what keeps T at O(distinct
+  caches) instead of O(P).)"""
+  if isinstance(bits, tuple):
+    return bits[0]
+  if getattr(bits, 'ndim', 1) == 2:
+    return bits
+  return bits.reshape(1, -1)
+
+
+def bitmask_lookup(bits, ids: jax.Array,
                    req: Optional[jax.Array] = None) -> jax.Array:
   """``[...]`` int ids -> uint8 membership (0/1); invalid ids (< 0)
   read 0.  Pure gathers + shifts — jit/vmap/shard_map friendly.
 
-  ``bits`` may be 1-D (one shared mask) or 2-D ``[R, nbytes]``
-  per-requester masks (ISSUE 15): ``req`` (``[B]``, broadcast over
-  the trailing dims of ``ids``) selects the mask row per leading
-  entry — each request is judged by what ITS requester serves
-  locally, never by another device's cache ring."""
+  ``bits`` may be 1-D (one shared mask), 2-D ``[R, nbytes]``
+  per-requester masks (ISSUE 15), or the deduped ``(table
+  [T, nbytes], row_index [R])`` tuple (ISSUE 18: T distinct mask
+  CONTENTS, one small int row per requester — the P-fold replication
+  collapses to O(distinct caches) bytes).  For the per-requester
+  forms ``req`` (``[B]``, broadcast over the trailing dims of
+  ``ids``) selects the mask per leading entry — each request is
+  judged by what ITS requester serves locally, never by another
+  device's cache ring."""
   valid = ids >= 0
   idc = jnp.where(valid, ids, 0).astype(jnp.int32)
-  if bits.ndim == 2:
+  if is_per_requester(bits):
     if req is None:
       raise ValueError('per-requester bitmask (2-D bits) needs req')
-    row = jnp.clip(req, 0, bits.shape[0] - 1).astype(jnp.int32)
+    if isinstance(bits, tuple):
+      table, row_index = bits
+      row = jnp.clip(req, 0, row_index.shape[0] - 1).astype(jnp.int32)
+      row = row_index[row].astype(jnp.int32)
+    else:
+      table = bits
+      row = jnp.clip(req, 0, table.shape[0] - 1).astype(jnp.int32)
     row = row.reshape(row.shape + (1,) * (ids.ndim - row.ndim))
-    byte = bits[row, jnp.clip(idc >> 3, 0, bits.shape[1] - 1)]
+    byte = table[row, jnp.clip(idc >> 3, 0, table.shape[1] - 1)]
   else:
     byte = bits[jnp.clip(idc >> 3, 0, bits.shape[0] - 1)]
   bit = (byte >> (idc & 7).astype(jnp.uint8)) & jnp.uint8(1)
@@ -380,6 +421,40 @@ def per_requester_bits(num_nodes: int, bounds: np.ndarray,
       rows.append(set_resident_bits(base, res, num_nodes))
   rows.append(base)
   return np.stack(rows)
+
+
+def dedup_requester_bits(num_nodes: int, bounds: np.ndarray,
+                         hot_counts: np.ndarray,
+                         residents_by_device,
+                         base_bits: Optional[np.ndarray] = None
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+  """Deduped encoding of `per_requester_bits` (the PR 15 deferred
+  item): ``(table [T, ceil(N/8)], row_index [R + 1])`` where
+  ``row_index[r]`` names the table row holding requester ``r``'s
+  mask and row ``row_index[-1]`` is the hot-split-only fallback.
+
+  `per_requester_bits` replicates the base mask P+1 times even though
+  most hosts contribute NO residents (other hosts' shards, cold
+  start, single-shard meshes) — at 100M nodes and P=64 that is
+  812 MB of identical bytes on every device.  Here the table holds
+  each DISTINCT mask content once: row 0 is always the shared base;
+  devices with residents get their own row; devices without (and the
+  fallback) all point at row 0.  ``T <= 1 + #devices-with-residents``
+  — the equivalence `bitmask_lookup(dedup) == bitmask_lookup(
+  replicated)` and the T << R+1 memory drop are pinned in
+  tests/test_pallas_sample.py."""
+  base = (base_bits if base_bits is not None
+          else cached_set_bits(num_nodes, bounds, hot_counts,
+                               np.empty(0, np.int64)))
+  rows = [base]
+  row_index = np.zeros(len(hot_counts) + 1, np.int32)
+  for d in range(len(hot_counts)):
+    res = residents_by_device.get(d)
+    if res is None or len(res) == 0:
+      continue                     # shares row 0 (the base mask)
+    row_index[d] = len(rows)
+    rows.append(set_resident_bits(base, res, num_nodes))
+  return np.stack(rows), row_index
 
 
 @functools.partial(

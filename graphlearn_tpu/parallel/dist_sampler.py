@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..loader.prefetch import PrefetchingLoader
 from ..ops.negative import edge_in_csr
 from ..ops.neighbor import sample_one_hop
+from ..ops.pallas_sample import sample_one_hop_auto
 from ..ops.unique import init_node, induce_next
 from ..utils.padding import INVALID_ID, max_sampled_nodes, round_up
 from .dist_data import DistDataset
@@ -330,15 +331,20 @@ def _dist_one_hop_book(indptr_l, indices_l, eids_l, bounds, frontier,
     local = jnp.where(flat >= 0, flat - bounds[r_j],
                       INVALID_ID).astype(jnp.int32)
     lane_key = jax.random.fold_in(key, r_j)
+    # sample_one_hop_auto resolves the GLT_PALLAS_SAMPLE dispatch at
+    # trace time (value-identical either way — the gns.bias build-
+    # time-event precedent); the dedup bits tuple flows as a pytree
     if gns_bits is not None:
-      from ..ops.gns import sample_one_hop_gns
-      res = sample_one_hop_gns(
-          indptr_l[j], indices_l[j], local, k, lane_key, gns_bits,
-          float(gns_boost), eids_l[j] if eids_l is not None else None,
-          req=(plan.req_of_lane_recv if gns_bits.ndim == 2 else None),
+      from ..ops.gns import is_per_requester
+      res = sample_one_hop_auto(
+          indptr_l[j], indices_l[j], local, k, lane_key,
+          eids_l[j] if eids_l is not None else None,
+          bits=gns_bits, boost=float(gns_boost),
+          req=(plan.req_of_lane_recv if is_per_requester(gns_bits)
+               else None),
           with_edge_ids=with_edge, sort_locality=sort_locality)
     else:
-      res = sample_one_hop(
+      res = sample_one_hop_auto(
           indptr_l[j], indices_l[j], local, k, lane_key,
           eids_l[j] if eids_l is not None else None,
           with_edge_ids=with_edge, sort_locality=sort_locality)
@@ -391,26 +397,30 @@ def _dist_one_hop(indptr_loc, indices_loc, eids_loc, bounds, frontier,
   flat = plan.recv
   local = jnp.where(flat >= 0, flat - my_start, INVALID_ID).astype(jnp.int32)
   if gns_bits is not None:
-    from ..ops.gns import sample_one_hop_gns
+    from ..ops.gns import fallback_req_index, is_per_requester
     req = None
-    if gns_bits.ndim == 2:
+    if is_per_requester(gns_bits):
       # per-requester masks (ISSUE 15): the plan attributes each recv
       # row to its source device; layouts that cannot (hier's
       # two-stage re-bucketing) fall back to the hot-split-only row —
-      # conservative (never over-boosts), still exactly corrected
+      # conservative (never over-boosts), still exactly corrected.
+      # r19 carries the masks as the dedup (table, row_index) tuple —
+      # O(distinct caches) VMEM instead of O(P) replication
       req = getattr(plan, 'requester_of_recv', None)
       if req is None:
-        req = jnp.full(flat.shape, gns_bits.shape[0] - 1, jnp.int32)
-    res = sample_one_hop_gns(indptr_loc, indices_loc, local, k,
-                             jax.random.fold_in(key, my_idx), gns_bits,
-                             float(gns_boost), eids_loc, req=req,
-                             with_edge_ids=with_edge,
-                             sort_locality=sort_locality)
+        req = jnp.full(flat.shape, fallback_req_index(gns_bits),
+                       jnp.int32)
+    res = sample_one_hop_auto(indptr_loc, indices_loc, local, k,
+                              jax.random.fold_in(key, my_idx),
+                              eids_loc, bits=gns_bits,
+                              boost=float(gns_boost), req=req,
+                              with_edge_ids=with_edge,
+                              sort_locality=sort_locality)
   else:
-    res = sample_one_hop(indptr_loc, indices_loc, local, k,
-                         jax.random.fold_in(key, my_idx), eids_loc,
-                         with_edge_ids=with_edge,
-                         sort_locality=sort_locality)
+    res = sample_one_hop_auto(indptr_loc, indices_loc, local, k,
+                              jax.random.fold_in(key, my_idx),
+                              eids_loc, with_edge_ids=with_edge,
+                              sort_locality=sort_locality)
   out_nbrs = plan.reply(res.nbrs, fill=INVALID_ID)
   out_mask = plan.reply(res.mask, fill=False)
   out_eids = plan.reply(res.eids, fill=INVALID_ID) if with_edge else None
@@ -2193,7 +2203,7 @@ class DistNeighborSampler(ExchangeTelemetry):
     cache = self._ensure_cold_cache()
     ver = cache.version if cache is not None else 0
     if self._gns_bits is None or ver != self._gns_ver:
-      from ..ops.gns import cached_set_bits, per_requester_bits
+      from ..ops.gns import cached_set_bits, dedup_requester_bits
       n = self.ds.graph.num_nodes
       if self._gns_hot_bits is None:
         # the static half, packed once: refreshes pay O(bytes) copy
@@ -2218,26 +2228,35 @@ class DistNeighborSampler(ExchangeTelemetry):
           res = sh.resident_ids()
           residents_by_dev[int(hp[j])] = res
           n_res += len(res)
-      bits = per_requester_bits(n, self.ds.graph.bounds,
-                                self.ds.node_features.hot_counts,
-                                residents_by_dev,
-                                base_bits=self._gns_hot_bits)
-      self._gns_bits = jax.device_put(
-          bits, NamedSharding(self.mesh, P()))
+      # r19 dedup: devices sharing a mask row (no residents of their
+      # own, plus the fallback) point at ONE shared row through the
+      # int32 indirection map — [T, N/8] + [R+1] instead of the
+      # [R+1, N/8] replication, consumed identically by the XLA and
+      # Pallas bias paths (equivalence pinned in
+      # tests/test_pallas_sample.py)
+      table, row_index = dedup_requester_bits(
+          n, self.ds.graph.bounds,
+          self.ds.node_features.hot_counts, residents_by_dev,
+          base_bits=self._gns_hot_bits)
+      repl = NamedSharding(self.mesh, P())
+      self._gns_bits = (jax.device_put(table, repl),
+                        jax.device_put(row_index, repl))
       self._gns_ver = ver
+      mask_bytes = int(table.nbytes) + int(row_index.nbytes)
       # memory accounting (ISSUE 17): the replicated bitmask is the
       # GNS tier's whole bill; re-registered on each rebuild so the
-      # gauge tracks the live array
+      # gauge tracks the live arrays
       from ..telemetry.memaccount import register_tier
       register_tier(
-          'gns', lambda b=self._gns_bits: int(getattr(b, 'nbytes', 0)))
+          'gns', lambda b=self._gns_bits: sum(
+              int(getattr(a, 'nbytes', 0)) for a in b))
       from ..utils.profiling import metrics
       metrics.inc('gns.sketch_updates_total')
       from ..telemetry.recorder import recorder
       if recorder.enabled:
         recorder.emit('gns.sketch_update', scope='dist',
                       residents=int(n_res), version=int(ver),
-                      mask_bytes=int(bits.nbytes))
+                      mask_bytes=mask_bytes)
     return self._gns_bits
 
   def _overlay_cold_traced(self, x, nodes):

@@ -31,6 +31,8 @@ import numpy as np
 
 from ..data.graph import Graph
 from ..ops.neighbor import sample_one_hop, cal_nbr_prob
+from ..ops.pallas_sample import fused_sample_enabled, sample_one_hop_auto
+from ..ops.pallas_window import prepare_window_table
 from ..ops.negative import edge_in_csr, sample_negative
 from ..ops.subgraph import induced_subgraph
 from ..ops.unique import InducerState, induce_next, init_node
@@ -41,18 +43,22 @@ from .base import (BaseSampler, EdgeSamplerInput, NegativeSampling,
 
 @functools.partial(
     jax.jit,
-    static_argnames=('fanouts', 'node_cap', 'with_edge', 'sort_locality'))
+    static_argnames=('fanouts', 'node_cap', 'with_edge', 'sort_locality',
+                     'use_fused', 'win_e'))
 def _multihop_sample(
     indptr: jax.Array,
     indices: jax.Array,
     edge_ids: Optional[jax.Array],
     seeds: jax.Array,
     key: jax.Array,
+    win_table: Optional[jax.Array] = None,
     *,
     fanouts: Tuple[int, ...],
     node_cap: int,
     with_edge: bool,
     sort_locality: bool = True,
+    use_fused: bool = False,
+    win_e: int = 0,
 ):
   """One fused multi-hop sample. Returns raw pytree pieces.
 
@@ -82,9 +88,14 @@ def _multihop_sample(
 
   for i, k in enumerate(fanouts):
     hop_key = jax.random.fold_in(key, i)
-    res = sample_one_hop(indptr, indices, frontier, int(k), hop_key,
-                         edge_ids, with_edge_ids=with_edge,
-                         sort_locality=sort_locality)
+    # dispatch resolves at trace time: use_fused is a static arg, so
+    # flipping GLT_PALLAS_SAMPLE recompiles onto the Pallas kernel
+    # (value-identical draws either way — see ops/pallas_sample.py)
+    res = sample_one_hop_auto(
+        indptr, indices, frontier, int(k), hop_key, edge_ids,
+        with_edge_ids=with_edge, sort_locality=sort_locality,
+        table=((win_table, win_e) if win_table is not None else None),
+        use_fused=use_fused)
     new_cap = min(cap + f_cap * int(k), node_cap)
     if new_cap > cap:
       state = InducerState(
@@ -187,12 +198,25 @@ class NeighborSampler(BaseSampler):
     self.sort_locality = bool(sort_locality)
     self._base_key = jax.random.key(seed)
     self._step = 0
+    self._win_table = None   # lazy prepare_window_table cache (r19)
 
   # -- helpers --------------------------------------------------------------
 
   def _next_key(self) -> jax.Array:
     self._step += 1
     return jax.random.fold_in(self._base_key, self._step)
+
+  def _fused_state(self):
+    """``(use_fused, win_table, win_e)`` for `_multihop_sample` —
+    GLT_PALLAS_SAMPLE is re-read per call (kill switch; the static
+    arg makes a flip recompile onto/off the kernel), and the O(E)
+    window repack is cached once per sampler."""
+    if not fused_sample_enabled():
+      return False, None, 0
+    if self._win_table is None:
+      self._win_table = prepare_window_table(self.graph.indices)
+    tbl, e = self._win_table
+    return True, tbl, int(e)
 
   def node_capacity(self, batch_size: int) -> int:
     cap = max_sampled_nodes(batch_size, self.num_neighbors)
@@ -207,13 +231,15 @@ class NeighborSampler(BaseSampler):
     seeds = jnp.asarray(np.asarray(inputs.node, dtype=np.int32))
     b = seeds.shape[0]
     node_cap = self.node_capacity(b)
+    use_fused, win_table, win_e = self._fused_state()
     (nodes, count, row, col, edge, emask, seed_local, nsn,
      nse) = _multihop_sample(
          self.graph.indptr, self.graph.indices,
          self.graph.edge_ids if self.with_edge else None,
-         seeds, self._next_key(),
+         seeds, self._next_key(), win_table,
          fanouts=self.num_neighbors, node_cap=node_cap,
-         with_edge=self.with_edge, sort_locality=self.sort_locality)
+         with_edge=self.with_edge, sort_locality=self.sort_locality,
+         use_fused=use_fused, win_e=win_e)
     return SamplerOutput(
         node=nodes, node_count=count, row=row, col=col, edge=edge,
         edge_mask=emask, batch=seeds,
@@ -323,12 +349,14 @@ class NeighborSampler(BaseSampler):
     seeds = jnp.asarray(np.asarray(inputs.node, dtype=np.int32))
     b = seeds.shape[0]
     node_cap = self.node_capacity(b)
+    use_fused, win_table, win_e = self._fused_state()
     (nodes, count, _row, _col, _edge, _emask, seed_local, nsn,
      _nse) = _multihop_sample(
          self.graph.indptr, self.graph.indices, None,
-         seeds, self._next_key(),
+         seeds, self._next_key(), win_table,
          fanouts=self.num_neighbors, node_cap=node_cap, with_edge=False,
-         sort_locality=self.sort_locality)
+         sort_locality=self.sort_locality,
+         use_fused=use_fused, win_e=win_e)
     max_deg = max(int(max_degree) if max_degree else self.graph.max_degree, 1)
     sub = induced_subgraph(
         self.graph.indptr, self.graph.indices, nodes,

@@ -60,7 +60,9 @@ from ..data.dataset import Dataset
 from ..data.feature import _device_gather
 from ..loader.fused import _uncached_jit
 from ..loader.fused_tree import expand_tree_levels
+from ..data.cold_cache import pinned_cold_enabled
 from ..ops.pallas_gather import pallas_enabled
+from ..ops.pallas_sample import fused_sample_enabled
 from ..utils.padding import INVALID_ID
 
 BUCKETS_ENV = 'GLT_SERVING_BUCKETS'
@@ -569,6 +571,13 @@ class ServingEngine:
         # name their dtypes (per program per bucket, on the exact
         # warm-start path the cache exists to make fast)
         'avals': [f'{tuple(x.shape)}:{x.dtype}' for x in leaves],
+        # r19 kernel toggles: dispatch resolves at trace time, so a
+        # program compiled with a kernel ON must never be restored
+        # into a process running with it OFF (same avals, different
+        # lowering)
+        'kernels': [bool(pallas_enabled()),
+                    bool(fused_sample_enabled()),
+                    bool(pinned_cold_enabled())],
         'jax': jax.__version__,
         'backend': jax.default_backend(),
         'devices': [str(d) for d in jax.devices()],

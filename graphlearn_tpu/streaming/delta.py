@@ -276,13 +276,44 @@ class StreamingGraph:
           src=src, dst=dst,
           eids=np.arange(self._num_events,
                          self._num_events + len(src), dtype=np.int64))
-      new_indptr, new_indices, new_eids = merge_delta_csr(
-          prev.indptr, prev.indices, prev.edge_ids, seg)
+      merged = self._merge_device(prev, seg)
+      if merged is None:
+        merged = merge_delta_csr(
+            prev.indptr, prev.indices, prev.edge_ids, seg)
+      new_indptr, new_indices, new_eids = merged
       view = self._build_view(prev.version + 1, new_indptr,
                               new_indices, new_eids)
       self._num_events += len(src)
       self._view = view
       return view
+
+  def _merge_device(self, prev: GraphView, seg: DeltaSegment):
+    """The r19 Pallas merge path: ``GLT_PALLAS_DELTA`` gates the
+    rank-kernel merge (`ops.pallas_delta`), byte-identical to
+    `merge_delta_csr` by contract; any disqualifying shape or
+    lowering gap falls back to the host merge (``None`` return) with
+    a ``pallas.fallback`` event — the fault-free default path never
+    imports jax from here."""
+    import os
+    if os.environ.get('GLT_PALLAS_DELTA', '').strip().lower() not in (
+        '1', 'true', 'on', 'yes'):
+      return None
+    from ..telemetry.recorder import recorder
+    try:
+      from ..ops.pallas_delta import merge_delta_csr_device
+      merged = merge_delta_csr_device(
+          prev.indptr, prev.indices, prev.edge_ids, seg)
+    except ValueError:
+      raise                        # contract errors surface as-is
+    except Exception as ex:
+      if recorder.enabled:
+        recorder.emit('pallas.fallback', kernel='delta_merge',
+                      reason=type(ex).__name__, events=seg.count)
+      return None
+    if recorder.enabled:
+      recorder.emit('pallas.dispatch', kernel='delta_merge',
+                    events=seg.count, version=prev.version + 1)
+    return merged
 
   # -- DataPlaneState (utils.checkpoint): the compacted base ----------------
   def state_dict(self) -> dict:

@@ -30,8 +30,12 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
-#: the closed tier vocabulary (the ``tier=`` label's value domain)
-TIERS = ('hot', 'cold_cache', 'streaming', 'gns', 'aot', 'wal')
+#: the closed tier vocabulary (the ``tier=`` label's value domain);
+#: ``pinned_host`` (r19) is the zero-copy cold feature buffer
+#: (`data.cold_cache.PinnedColdBuffer`) — host-side bytes, but
+#: accelerator-visible and part of the feature plane's budget
+TIERS = ('hot', 'cold_cache', 'streaming', 'gns', 'aot', 'wal',
+         'pinned_host')
 
 #: EWMA smoothing for per-bucket dispatch cost (≈ the last ~10
 #: dispatches dominate — fast enough to track a mix shift, slow

@@ -143,6 +143,21 @@ METRICS: Tuple[Tuple, ...] = (
     # admission signal silently died
     ('dist.serving.fleet_headroom_qps', 'present',
      {'when': 'dist.serving.fleet_qps'}),
+    # Pallas fused-pipeline guards (ISSUE 18, bench_pallas_sample.py):
+    # the dispatcher-threaded FusedEpoch step with the kernels OFF —
+    # the r19 threading (window-table staging, trace-time dispatch)
+    # must not tax the default path
+    ('pallas.fused_step_ms', 'lower'),
+    # the pinned-host zero-copy cold gather at split<1, pinned against
+    # the FIXED untiered XLA gather line (r18 roofline: 1.355 GB/s) —
+    # the tiered store must not fall back behind the line the pinned
+    # buffer exists to beat.  Hardware-only: the bench stamps the key
+    # None on CPU, so the guard skips cleanly there
+    ('pallas.feature_lookup_gbps', 'higher', {'pin_baseline': 1.355}),
+    # the host delta-CSR merge rate (platform-independent; the device
+    # kernel row is reported alongside, unguarded until a TPU baseline
+    # lands)
+    ('pallas.delta_merge_events_per_sec', 'higher'),
 )
 
 

@@ -193,6 +193,21 @@ EVENT_KINDS: Dict[str, str] = {
     'partition.book_version':
         'PartitionBook.adopt: version, lost, survivor, num_lanes — '
         'one per ownership transfer, the routing authority moving',
+    'pallas.dispatch':
+        'r19 kernel gates (ops.pallas_sample.sample_one_hop_auto, '
+        'data.cold_cache.make_pinned_cold_buffer, streaming.delta.'
+        'StreamingGraph._merge_device): kernel (fused_sample|'
+        'cold_gather|delta_merge) + per-kernel fields (mode/batch/k, '
+        'rows/memory_kind, events/version) — one event per '
+        'trace/build that took the Pallas path, so a perf run reads '
+        'which arms actually ran the kernel out of the same stream '
+        'as its step timings',
+    'pallas.fallback':
+        'r19 kernel gates (same three sites): kernel, reason '
+        '(unsupported-shape strings or trace-error:<ExcType>) + the '
+        'same per-kernel fields — the knob was ON but this call '
+        'fell back to the XLA/host path at byte parity; contract '
+        'errors (ValueError) re-raise instead of landing here',
 }
 
 
@@ -494,8 +509,9 @@ METRIC_NAMES: Dict[str, str] = {
         'is bounded, this counts total captures)',
     'memory.tier_bytes':
         'gauge: bytes currently held by one memory tier, labeled '
-        'tier=hot|cold_cache|streaming|gns|aot|wal (scrape-time '
-        'callback from each owner — telemetry.memaccount)',
+        'tier=hot|cold_cache|streaming|gns|aot|wal|pinned_host '
+        '(scrape-time callback from each owner — '
+        'telemetry.memaccount)',
     'memory.tier_peak_bytes':
         'gauge: high-watermark of memory.tier_bytes since the '
         'owner registered (tracked at scrape time, by tier)',
@@ -543,8 +559,8 @@ METRIC_LABELS: Dict[str, str] = {
         'num_parts (PartitionBook range ids)',
     'tier':
         'memory accounting tier: hot|cold_cache|streaming|gns|aot|'
-        'wal (the closed memaccount.TIERS vocabulary — six fixed '
-        'byte-gauge families, never per-object)',
+        'wal|pinned_host (the closed memaccount.TIERS vocabulary — '
+        'seven fixed byte-gauge families, never per-object)',
 }
 
 

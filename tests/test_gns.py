@@ -478,7 +478,10 @@ def test_per_requester_mask_no_remote_boost():
 def test_per_requester_rows_follow_device_rings():
   """`DistNeighborSampler._gns_arrays` builds one mask row per
   device from ITS shard's residents (+ the hot-only fallback row):
-  a resident planted in device 0's ring sets the bit in row 0 only."""
+  a resident planted in device 0's ring sets the bit in row 0 only.
+  r19: the rows arrive as the dedup (table, row_index) tuple —
+  requester r's row is table[row_index[r]], and devices with empty
+  rings collapse onto the shared base row instead of replicating it."""
   ds = _uniform_dataset(16 * P, split_ratio=0.5)
   sampler = DistNeighborSampler(ds, [2], gns=True,
                                 cold_cache_rows=4)
@@ -489,9 +492,15 @@ def test_per_requester_rows_follow_device_rings():
   cold_id = int(ds.graph.bounds[0]) + hot0     # first cold row of p0
   cache.shards[0].commit(np.asarray([cold_id], np.int64),
                          np.asarray([0], np.int32))
-  bits = np.asarray(jax.device_get(sampler._gns_arrays()))
+  table, row_index = (np.asarray(a) for a in
+                      jax.device_get(sampler._gns_arrays()))
+  assert row_index.shape == (P + 1,)     # P requesters + hot fallback
+  bits = table[row_index]                # the replicated PR 15 view
   assert bits.ndim == 2 and bits.shape[0] == P + 1
   byte, bit = cold_id >> 3, cold_id & 7
   assert bits[0, byte] >> bit & 1 == 1         # requester 0 boosts it
   for row in range(1, P + 1):
     assert bits[row, byte] >> bit & 1 == 0, row  # nobody else does
+  # the dedup the tuple exists for: only device 0 diverges from the
+  # base row, so 2 distinct rows carry all P + 1 requester views
+  assert table.shape[0] == 2

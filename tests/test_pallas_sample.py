@@ -1,0 +1,500 @@
+"""Pallas fused sampling pipeline parity (`ops/pallas_sample.py`,
+`ops/pallas_delta.py`, the pinned cold gather — ISSUE 18, r19).
+
+The contract under test is BYTE/VALUE PARITY, not speed: every r19
+kernel is a drop-in lowering of an existing XLA/host path, so
+flipping its knob must never change a result —
+
+  * **fused sampler** — `sample_one_hop_fused` (interpret mode on
+    CPU) equals `sample_one_hop` / `sample_one_hop_gns` exactly:
+    uniform and GNS-biased arms, per-requester masks (replicated 2-D
+    AND the dedup tuple), the deg<=k take-all arm and the deg>W hub
+    arm, with and without edge ids / sort_locality;
+  * **GNS dedup** — `dedup_requester_bits`' (table, row_index)
+    encoding answers `bitmask_lookup` identically to the replicated
+    [R+1, N/8] stack and drops mask memory;
+  * **delta merge** — `merge_delta_csr_device` is byte-identical to
+    `streaming.delta.merge_delta_csr` (dtypes included), ties,
+    empty-segment and empty-base corners pinned;
+  * **pinned cold gather** — the mixed-tier `Feature.get` with
+    GLT_PALLAS_COLD=1 returns byte-identical batches to the host
+    `np.take` path at cache budgets {0, tiny};
+  * **dispatch discipline** — `sample_one_hop_auto` with the knob
+    OFF routes to the XLA kernels (the fault-free default path), and
+    unsupported shapes fall back transparently with a
+    ``pallas.fallback`` event.
+"""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from graphlearn_tpu.ops.gns import (bitmask_lookup, bits_table,
+                                    cached_set_bits,
+                                    dedup_requester_bits,
+                                    fallback_req_index,
+                                    is_per_requester,
+                                    per_requester_bits,
+                                    sample_one_hop_gns)
+from graphlearn_tpu.ops.neighbor import default_window, sample_one_hop
+from graphlearn_tpu.ops.pallas_sample import (fused_sample_supported,
+                                              sample_one_hop_auto,
+                                              sample_one_hop_fused)
+
+K = 8
+BOOST = 16.0
+
+
+def _csr(n=220, mean_deg=10, seed=0, *, zero=(3,), hub=(9,)):
+  """Poisson-degree CSR with forced empty rows and beyond-window hubs
+  (deg > default_window(K)) so every sampling arm is exercised."""
+  rng = np.random.default_rng(seed)
+  deg = rng.poisson(mean_deg, n)
+  for z in zero:
+    deg[z] = 0
+  for h in hub:
+    deg[h] = default_window(K) * 3 + 5
+  indptr = np.zeros(n + 1, np.int64)
+  np.cumsum(deg, out=indptr[1:])
+  e = int(indptr[-1])
+  indices = rng.integers(0, n, e).astype(np.int32)
+  eids = np.arange(e, dtype=np.int64)
+  return (jnp.asarray(indptr), jnp.asarray(indices), jnp.asarray(eids),
+          n, e)
+
+
+def _seeds(n, b=32, seed=1, *, pad=True, include=()):
+  rng = np.random.default_rng(seed)
+  s = rng.integers(0, n, b).astype(np.int32)
+  for i, v in enumerate(include):
+    s[i] = v
+  if pad:
+    s[-2:] = -1                    # INVALID_ID-padded tail slots
+  return jnp.asarray(s)
+
+
+def _assert_onehop_equal(ref, got):
+  np.testing.assert_array_equal(np.asarray(ref.nbrs),
+                                np.asarray(got.nbrs))
+  np.testing.assert_array_equal(np.asarray(ref.mask),
+                                np.asarray(got.mask))
+  assert (ref.eids is None) == (got.eids is None)
+  if ref.eids is not None:
+    np.testing.assert_array_equal(np.asarray(ref.eids),
+                                  np.asarray(got.eids))
+  assert (ref.weights is None) == (got.weights is None)
+  if ref.weights is not None:
+    np.testing.assert_array_equal(np.asarray(ref.weights),
+                                  np.asarray(got.weights))
+
+
+# -- fused sampler: uniform arms ------------------------------------------
+
+@pytest.mark.parametrize('sort_locality', [False, True])
+@pytest.mark.parametrize('with_edge', [False, True])
+def test_fused_uniform_exact(sort_locality, with_edge):
+  indptr, indices, eids, n, e = _csr()
+  seeds = _seeds(n, include=(3, 9))   # empty row + hub in-batch
+  key = jax.random.PRNGKey(42)
+  ref = sample_one_hop(indptr, indices, seeds, K, key,
+                       eids if with_edge else None,
+                       with_edge_ids=with_edge,
+                       sort_locality=sort_locality)
+  got = sample_one_hop_fused(indptr, indices, seeds, K, key,
+                             eids if with_edge else None,
+                             with_edge_ids=with_edge,
+                             sort_locality=sort_locality,
+                             interpret=True)
+  _assert_onehop_equal(ref, got)
+
+
+def test_fused_take_all_arm_exact():
+  # every degree <= K: the kernel's take-all select must reproduce
+  # the XLA slot identity (off = slot), not a draw
+  indptr, indices, eids, n, _ = _csr(mean_deg=3, hub=())
+  seeds = _seeds(n, include=(3,))
+  key = jax.random.PRNGKey(7)
+  ref = sample_one_hop(indptr, indices, seeds, K, key, eids,
+                       with_edge_ids=True)
+  got = sample_one_hop_fused(indptr, indices, seeds, K, key, eids,
+                             with_edge_ids=True, interpret=True)
+  _assert_onehop_equal(ref, got)
+
+
+# -- fused sampler: GNS-biased arms ---------------------------------------
+
+def _shared_bits(n, seed=2):
+  rng = np.random.default_rng(seed)
+  bounds = np.array([0, n // 2, n], np.int64)
+  hot = np.array([12, 12], np.int64)
+  return jnp.asarray(cached_set_bits(
+      n, bounds, hot, rng.integers(0, n, 60).astype(np.int64)))
+
+
+@pytest.mark.parametrize('sort_locality', [False, True])
+def test_fused_gns_shared_bits_exact(sort_locality):
+  indptr, indices, eids, n, _ = _csr(seed=3)
+  seeds = _seeds(n, include=(3, 9))
+  bits = _shared_bits(n)
+  key = jax.random.PRNGKey(11)
+  ref = sample_one_hop_gns(indptr, indices, seeds, K, key, bits,
+                           BOOST, eids, with_edge_ids=True,
+                           sort_locality=sort_locality)
+  got = sample_one_hop_fused(indptr, indices, seeds, K, key, eids,
+                             bits=bits, boost=BOOST,
+                             with_edge_ids=True,
+                             sort_locality=sort_locality,
+                             interpret=True)
+  _assert_onehop_equal(ref, got)
+
+
+def _dedup_fixture(n, seed=4, parts=4):
+  rng = np.random.default_rng(seed)
+  bounds = np.linspace(0, n, parts + 1).astype(np.int64)
+  hot = np.full(parts, 10, np.int64)
+  residents = {0: rng.integers(0, n, 24).astype(np.int64),
+               2: rng.integers(0, n, 12).astype(np.int64)}
+  return bounds, hot, residents
+
+
+def test_fused_gns_per_requester_exact():
+  """The dedup tuple through BOTH bias paths == the replicated 2-D
+  stack: XLA-tuple, fused-tuple and fused-2-D all byte-match the
+  XLA-2-D reference."""
+  indptr, indices, eids, n, _ = _csr(seed=5)
+  parts = 4
+  bounds, hot, residents = _dedup_fixture(n, parts=parts)
+  table, row_index = dedup_requester_bits(n, bounds, hot, residents)
+  rep = np.asarray(table)[np.asarray(row_index)]   # the PR 15 layout
+  bits_t = (jnp.asarray(table), jnp.asarray(row_index))
+  seeds = _seeds(n, include=(3, 9))
+  req = jnp.asarray(np.random.default_rng(6).integers(
+      0, parts + 1, seeds.shape[0]).astype(np.int32))
+  key = jax.random.PRNGKey(13)
+  ref = sample_one_hop_gns(indptr, indices, seeds, K, key,
+                           jnp.asarray(rep), BOOST, eids, req=req,
+                           with_edge_ids=True)
+  for got in (
+      sample_one_hop_gns(indptr, indices, seeds, K, key, bits_t,
+                         BOOST, eids, req=req, with_edge_ids=True),
+      sample_one_hop_fused(indptr, indices, seeds, K, key, eids,
+                           bits=bits_t, boost=BOOST, req=req,
+                           with_edge_ids=True, interpret=True),
+      sample_one_hop_fused(indptr, indices, seeds, K, key, eids,
+                           bits=jnp.asarray(rep), boost=BOOST,
+                           req=req, with_edge_ids=True,
+                           interpret=True)):
+    _assert_onehop_equal(ref, got)
+
+
+def test_fused_per_requester_needs_req():
+  indptr, indices, eids, n, _ = _csr(seed=5)
+  bounds, hot, residents = _dedup_fixture(n)
+  table, row_index = dedup_requester_bits(n, bounds, hot, residents)
+  bits_t = (jnp.asarray(table), jnp.asarray(row_index))
+  with pytest.raises(ValueError, match='req'):
+    sample_one_hop_fused(jnp.asarray(indptr), jnp.asarray(indices),
+                         _seeds(n), K, jax.random.PRNGKey(0),
+                         bits=bits_t, boost=BOOST, interpret=True)
+
+
+# -- GNS dedup encoding ----------------------------------------------------
+
+def test_dedup_bits_lookup_equivalence_and_memory_drop():
+  n, parts = 4096, 16
+  rng = np.random.default_rng(8)
+  bounds = np.linspace(0, n, parts + 1).astype(np.int64)
+  hot = np.full(parts, 32, np.int64)
+  # only 3 of 16 devices own residents -> 4 distinct rows (base + 3)
+  residents = {1: rng.integers(0, n, 50).astype(np.int64),
+               5: rng.integers(0, n, 50).astype(np.int64),
+               11: rng.integers(0, n, 50).astype(np.int64)}
+  rep = per_requester_bits(n, bounds, hot, residents)
+  table, row_index = dedup_requester_bits(n, bounds, hot, residents)
+  bits_t = (jnp.asarray(table), jnp.asarray(row_index))
+
+  assert is_per_requester(bits_t) and is_per_requester(rep)
+  assert fallback_req_index(bits_t) == fallback_req_index(rep) == parts
+  assert bits_table(bits_t).shape == table.shape
+  # exact row equivalence through the indirection
+  np.testing.assert_array_equal(table[row_index], rep)
+  # distinct-row count: base + devices-with-residents, NOT P+1
+  assert table.shape[0] == 1 + len(residents)
+  # the memory drop the dedup exists for (here 17 rows -> 4)
+  assert table.nbytes + row_index.nbytes < rep.nbytes / 3
+
+  ids = jnp.asarray(rng.integers(0, n, 256).astype(np.int32))
+  req = jnp.asarray(rng.integers(0, parts + 1, 256).astype(np.int32))
+  np.testing.assert_array_equal(
+      np.asarray(bitmask_lookup(jnp.asarray(rep), ids, req)),
+      np.asarray(bitmask_lookup(bits_t, ids, req)))
+  # no-req callers resolve the base row (row 0 == hot-split ∪ nothing)
+  np.testing.assert_array_equal(
+      np.asarray(bitmask_lookup(jnp.asarray(table[0]), ids)),
+      np.asarray(bitmask_lookup(
+          bits_t, ids, jnp.zeros_like(ids))))
+
+
+# -- the auto dispatcher ---------------------------------------------------
+
+def test_auto_knob_off_is_the_xla_path():
+  """Fault-free default: with GLT_PALLAS_SAMPLE unset the dispatcher
+  IS `sample_one_hop` — byte-identical, no kernel anywhere."""
+  os.environ.pop('GLT_PALLAS_SAMPLE', None)
+  indptr, indices, eids, n, _ = _csr()
+  seeds = _seeds(n)
+  key = jax.random.PRNGKey(21)
+  ref = sample_one_hop(indptr, indices, seeds, K, key, eids,
+                       with_edge_ids=True)
+  got = sample_one_hop_auto(indptr, indices, seeds, K, key, eids,
+                            with_edge_ids=True)
+  _assert_onehop_equal(ref, got)
+
+
+def test_auto_knob_on_matches_and_unsupported_falls_back(monkeypatch):
+  monkeypatch.setenv('GLT_PALLAS_SAMPLE', '1')
+  indptr, indices, eids, n, _ = _csr()
+  seeds = _seeds(n)
+  key = jax.random.PRNGKey(22)
+  ref = sample_one_hop(indptr, indices, seeds, K, key, eids,
+                       with_edge_ids=True)
+  got = sample_one_hop_auto(indptr, indices, seeds, K, key, eids,
+                            with_edge_ids=True)
+  _assert_onehop_equal(ref, got)
+  # replace=True has no window arm -> transparent XLA fallback
+  ref_r = sample_one_hop(indptr, indices, seeds, K, key, eids,
+                         with_edge_ids=True, replace=True)
+  got_r = sample_one_hop_auto(indptr, indices, seeds, K, key, eids,
+                              with_edge_ids=True, replace=True)
+  _assert_onehop_equal(ref_r, got_r)
+
+
+def test_fused_supported_reasons():
+  w = default_window(K)
+  assert fused_sample_supported(32, K, w, jnp.int32,
+                                num_edges=100) is None
+  assert fused_sample_supported(32, K, w, jnp.int32,
+                                replace=True) == 'replace-arm'
+  assert fused_sample_supported(32, K, w, jnp.int32,
+                                num_edges=0) == 'empty'
+  assert fused_sample_supported(32, K, 4, jnp.int32,
+                                num_edges=100) == 'k>window'
+  assert fused_sample_supported(32, K, 256, jnp.int32,
+                                num_edges=100).startswith('window>')
+  assert fused_sample_supported(32, K, w, jnp.int64,
+                                num_edges=100) == 'indices-dtype'
+
+
+def test_fallback_event_emitted(monkeypatch):
+  from graphlearn_tpu.telemetry.recorder import recorder
+  monkeypatch.setenv('GLT_PALLAS_SAMPLE', '1')
+  indptr, indices, eids, n, _ = _csr()
+  was = recorder.enabled
+  recorder.enable()
+  try:
+    recorder.clear()
+    sample_one_hop_auto(indptr, indices, _seeds(n), K,
+                        jax.random.PRNGKey(0), eids,
+                        with_edge_ids=True, replace=True)
+    kinds = [e['kind'] for e in recorder.events()]
+    assert 'pallas.fallback' in kinds
+    fb = [e for e in recorder.events()
+          if e['kind'] == 'pallas.fallback'][0]
+    assert fb['kernel'] == 'fused_sample'
+    assert fb['reason'] == 'replace-arm'
+    recorder.clear()
+    sample_one_hop_auto(indptr, indices, _seeds(n), K,
+                        jax.random.PRNGKey(0), eids,
+                        with_edge_ids=True)
+    kinds = [e['kind'] for e in recorder.events()]
+    assert 'pallas.dispatch' in kinds
+  finally:
+    recorder.clear()
+    if not was:
+      recorder.disable()
+
+
+# -- the NeighborSampler / fused-epoch threading ---------------------------
+
+def test_neighbor_sampler_knob_parity(monkeypatch):
+  from graphlearn_tpu.data.graph import Graph
+  from graphlearn_tpu.sampler.base import NodeSamplerInput
+  from graphlearn_tpu.sampler.neighbor_sampler import NeighborSampler
+  indptr, indices, _, n, _ = _csr(seed=9)
+  g = Graph.from_device_arrays(indptr, indices)
+  seeds = np.asarray(_seeds(n))
+
+  def run():
+    s = NeighborSampler(g, [5, 3], with_edge=True, seed=17)
+    return s.sample_from_nodes(NodeSamplerInput(node=seeds))
+
+  monkeypatch.delenv('GLT_PALLAS_SAMPLE', raising=False)
+  a = run()
+  monkeypatch.setenv('GLT_PALLAS_SAMPLE', '1')
+  b = run()
+  for f in ('node', 'node_count', 'row', 'col', 'edge',
+            'num_sampled_nodes', 'num_sampled_edges'):
+    np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                  np.asarray(getattr(b, f)))
+
+
+# -- delta-CSR merge kernel ------------------------------------------------
+
+def _delta_fixture(n=60, seed=12, events=41):
+  from graphlearn_tpu.streaming.delta import DeltaSegment
+  rng = np.random.default_rng(seed)
+  deg = rng.poisson(6, n)
+  indptr = np.zeros(n + 1, np.int64)
+  np.cumsum(deg, out=indptr[1:])
+  e = int(indptr[-1])
+  indices = (np.concatenate([np.sort(rng.integers(0, n, d))
+                             for d in deg])
+             if e else np.zeros(0, np.int64))
+  eids = rng.permutation(e).astype(np.int64)
+  seg = DeltaSegment(src=rng.integers(0, n, events).astype(np.int64),
+                     dst=rng.integers(0, n, events).astype(np.int64),
+                     eids=(np.arange(events) + e).astype(np.int64))
+  return indptr, indices, eids, seg
+
+
+def _assert_merge_equal(a, b):
+  for x, y, name in zip(a, b, ('indptr', 'indices', 'eids')):
+    assert x.dtype == y.dtype, name
+    np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+def test_delta_merge_device_byte_identity():
+  from graphlearn_tpu.ops.pallas_delta import merge_delta_csr_device
+  from graphlearn_tpu.streaming.delta import merge_delta_csr
+  indptr, indices, eids, seg = _delta_fixture()
+  _assert_merge_equal(
+      merge_delta_csr(indptr, indices, eids, seg),
+      merge_delta_csr_device(indptr, indices, eids, seg,
+                             interpret=True))
+
+
+def test_delta_merge_device_corners():
+  from graphlearn_tpu.ops.pallas_delta import merge_delta_csr_device
+  from graphlearn_tpu.streaming.delta import (DeltaSegment,
+                                              merge_delta_csr)
+  indptr, indices, eids, seg = _delta_fixture(seed=13)
+  empty = DeltaSegment(src=seg.src[:0], dst=seg.dst[:0],
+                       eids=seg.eids[:0])
+  _assert_merge_equal(
+      merge_delta_csr(indptr, indices, eids, empty),
+      merge_delta_csr_device(indptr, indices, eids, empty,
+                             interpret=True))
+  n = len(indptr) - 1
+  ip0 = np.zeros(n + 1, np.int64)
+  _assert_merge_equal(
+      merge_delta_csr(ip0, indices[:0], eids[:0], seg),
+      merge_delta_csr_device(ip0, indices[:0], eids[:0], seg,
+                             interpret=True))
+  # heavy duplicate columns: the stable base-first tie-break
+  ties = DeltaSegment(src=np.full(20, 7, np.int64),
+                      dst=np.array([3] * 10 + [5] * 10, np.int64),
+                      eids=np.arange(20, dtype=np.int64) + 1000)
+  _assert_merge_equal(
+      merge_delta_csr(indptr, indices, eids, ties),
+      merge_delta_csr_device(indptr, indices, eids, ties,
+                             interpret=True))
+
+
+def test_delta_merge_range_check_matches_host():
+  from graphlearn_tpu.ops.pallas_delta import merge_delta_csr_device
+  from graphlearn_tpu.streaming.delta import DeltaSegment
+  indptr, indices, eids, _ = _delta_fixture()
+  bad = DeltaSegment(src=np.array([len(indptr)], np.int64),
+                     dst=np.array([0], np.int64),
+                     eids=np.array([0], np.int64))
+  with pytest.raises(ValueError, match='out of range'):
+    merge_delta_csr_device(indptr, indices, eids, bad, interpret=True)
+
+
+def test_streaming_graph_knob_parity(monkeypatch):
+  """`StreamingGraph.apply_events` publishes byte-identical versions
+  with GLT_PALLAS_DELTA on and off (and keeps the fault-free default
+  path jax-free)."""
+  from graphlearn_tpu.streaming.delta import StreamingGraph
+
+  def build_and_apply(seed):
+    rng = np.random.default_rng(seed)
+    n = 40
+    deg = rng.poisson(4, n)
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(deg, out=indptr[1:])
+    e = int(indptr[-1])
+    indices = (np.concatenate([np.sort(rng.integers(0, n, d))
+                               for d in deg])
+               if e else np.zeros(0, np.int64))
+    g = StreamingGraph(indptr, indices, np.arange(e, dtype=np.int64))
+    v = None
+    for wave in range(3):
+      m = 17 + wave
+      v = g.apply_events(rng.integers(0, n, m).astype(np.int64),
+                         rng.integers(0, n, m).astype(np.int64))
+    return (np.asarray(v.indptr), np.asarray(v.indices),
+            np.asarray(v.edge_ids))
+
+  monkeypatch.delenv('GLT_PALLAS_DELTA', raising=False)
+  a = build_and_apply(31)
+  monkeypatch.setenv('GLT_PALLAS_DELTA', '1')
+  b = build_and_apply(31)
+  for x, y in zip(a, b):
+    assert x.dtype == y.dtype
+    np.testing.assert_array_equal(x, y)
+
+
+# -- pinned-host zero-copy cold gather ------------------------------------
+
+def _tiered_feature(budget, monkeypatch=None):
+  from graphlearn_tpu.data import Feature
+  n, d = 64, 8
+  feats = (np.arange(n, dtype=np.float32)[:, None]
+           * np.ones((1, d), np.float32))
+  return Feature(feats, split_ratio=0.25, cold_cache_rows=budget)
+
+
+@pytest.mark.parametrize('budget', [0, 4])
+def test_pinned_cold_fill_byte_identity(budget, monkeypatch):
+  ids = np.array([1, 9, 7, 30, 0, 63, -1, 9, 40], np.int64)
+  monkeypatch.delenv('GLT_PALLAS_COLD', raising=False)
+  ref_f = _tiered_feature(budget)
+  refs = [np.asarray(ref_f[ids]) for _ in range(3)]  # admits mutate
+  monkeypatch.setenv('GLT_PALLAS_COLD', '1')
+  got_f = _tiered_feature(budget)
+  assert got_f._pinned_buffer() is not None
+  for i in range(3):
+    got = np.asarray(got_f[ids])
+    assert got.dtype == refs[i].dtype
+    np.testing.assert_array_equal(got, refs[i])
+
+
+def test_pinned_cold_kill_switch(monkeypatch):
+  """GLT_PALLAS_COLD is re-read per batch: flipping it off mid-life
+  reverts to the compact host path with identical values."""
+  ids = np.array([2, 33, 8, 61], np.int64)
+  monkeypatch.setenv('GLT_PALLAS_COLD', '1')
+  f = _tiered_feature(0)
+  on = np.asarray(f[ids])
+  assert f._pinned_cold is not None
+  monkeypatch.delenv('GLT_PALLAS_COLD', raising=False)
+  off = np.asarray(f[ids])
+  np.testing.assert_array_equal(on, off)
+
+
+def test_pinned_buffer_registers_memaccount_tier(monkeypatch):
+  from graphlearn_tpu.data.cold_cache import make_pinned_cold_buffer
+  from graphlearn_tpu.telemetry.live import live
+  monkeypatch.setenv('GLT_PALLAS_COLD', '1')
+  rows = np.random.default_rng(0).standard_normal((32, 8))
+  buf = make_pinned_cold_buffer(rows, 8, np.float32)
+  assert buf is not None
+  text = live.prometheus_text()
+  assert 'glt_memory_tier_bytes{tier="pinned_host"}' in text
+  # dtype cast applied once at build == per-batch astype
+  idx = np.array([3, 0, 31], np.int32)
+  np.testing.assert_array_equal(
+      np.asarray(buf.gather(idx)), rows[idx].astype(np.float32))

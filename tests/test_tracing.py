@@ -184,7 +184,9 @@ def test_memaccount_gauges_match_nbytes():
   reg = _reg()
   arrays = {'streaming': np.zeros((100, 8), np.float32),
             'cold_cache': np.zeros((16, 4), np.float32),
-            'wal': np.zeros(333, np.uint8)}
+            'wal': np.zeros(333, np.uint8),
+            # r19: the zero-copy cold feature buffer's tier
+            'pinned_host': np.zeros((64, 16), np.float32)}
   unregs = [register_tier(t, lambda a=a: a.nbytes, registry=reg)
             for t, a in arrays.items()]
   snap = parse_prometheus_text(reg.prometheus_text())
