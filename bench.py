@@ -1111,15 +1111,16 @@ def _run_failover_row(timeout: int):
 
 
 def _run_bench_serving(timeout: int, extra_args=(),
-                       script_name='bench_serving.py'):
-  """Shared benchmarks/ subprocess harness for the serving, fleet and
-  ingest phases: spawn with forced-CPU env, scan stdout bottom-up for
-  the last JSON line, return (row, returncode) — or None on
-  timeout/no-parseable-output."""
+                       script_name='bench_serving.py', env=None):
+  """Shared benchmarks/ subprocess harness for the serving, fleet,
+  ingest and autoscale phases: spawn with forced-CPU env (optionally a
+  caller-supplied one, e.g. cpu_mesh_env for phases that need a
+  virtual device mesh), scan stdout bottom-up for the last JSON line,
+  return (row, returncode) — or None on timeout/no-parseable-output."""
   script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         'benchmarks', script_name)
   cmd = [sys.executable, script, '--cpu', *extra_args]
-  env = dict(os.environ)
+  env = dict(env if env is not None else os.environ)
   env.setdefault('JAX_PLATFORMS', 'cpu')
   try:
     out = subprocess.run(cmd, capture_output=True, text=True, env=env,
@@ -1209,6 +1210,34 @@ def _run_ingest_row(timeout: int):
     print('ingest phase: shed/error during steady-state ingest, '
           'recompile after warmup, or unapplied lag (see '
           'dist.ingest)', file=sys.stderr)
+  return r
+
+
+def _run_autoscale_row(timeout: int):
+  """`benchmarks/bench_autoscale.py` (ISSUE 19): the diurnal open
+  loop against the `ElasticController` — sinusoidal arrivals over a
+  1→3-replica fleet with a chaos-failed first spawn (typed rollback)
+  and a mid-epoch planned partition handoff on the 8-device virtual
+  mesh.  The worker exits nonzero unless the fleet scaled out AND
+  back in, every request completed, the burn stayed < 1 outside the
+  chaos incident, the elastic p99 held vs the static baseline, and
+  the handoff produced zero degraded batches with exactly one
+  PartitionBook bump — stamped into ``autoscale_pin``.  Feeds
+  dist.autoscale.p99_held_ms / .burn_max /
+  .handoff_degraded_batches."""
+  got = _run_bench_serving(timeout, script_name='bench_autoscale.py',
+                           env=cpu_mesh_env(8))
+  if got is None:
+    return None
+  r, returncode = got
+  if 'p99_held_ms' not in r:           # died before the final row
+    return None
+  r['autoscale_pin'] = 'ok' if returncode == 0 else 'FAILED'
+  if returncode != 0:
+    print('autoscale phase: fleet failed to scale out+in, a request '
+          'failed, burn >= 1 outside the chaos incident, elastic p99 '
+          'regressed vs static, or the handoff degraded a batch (see '
+          'dist.autoscale)', file=sys.stderr)
   return r
 
 
@@ -1679,6 +1708,23 @@ def main():
   else:
     print(f'budget: skipping pallas rows ({budget_left():.0f}s left)',
           file=sys.stderr)
+
+  # phase 3j — closed-loop elastic autoscaling + planned handoff
+  # (ISSUE 19): the diurnal open loop drives ElasticController
+  # scale-out/in with a chaos-faulted first spawn, then a planned
+  # mid-epoch partition handoff; feeds dist.autoscale.p99_held_ms /
+  # .burn_max / .handoff_degraded_batches, and the worker's nonzero
+  # exit (missed scale event, failed request, burn >= 1 outside the
+  # incident, degraded handoff batch) lands in autoscale_pin
+  if isinstance(dist, dict) and 'error' not in dist and \
+      budget_left() > 90:
+    r = _run_autoscale_row(int(min(300, max(budget_left() - 30, 90))))
+    if r is not None:
+      dist['autoscale'] = r
+      emit()
+  elif isinstance(dist, dict) and 'error' not in dist:
+    print(f'budget: skipping autoscale phase ({budget_left():.0f}s '
+          f'left)', file=sys.stderr)
 
   # phase 4 — extra primary sessions stabilize the per-batch median
   while (len(results) < sessions and attempts < sessions + 3

@@ -1,0 +1,459 @@
+"""Elastic autoscaling + planned handoff bench (ISSUE 19).
+
+Drives a DIURNAL open-loop schedule — a sinusoidal arrival rate,
+trough -> peak -> trough, the compressed shape of a day of serving
+traffic — twice:
+
+  A. **static baseline**: one replica, fixed, the whole cycle.  The
+     peak overloads it; its p99 is what an unmanaged fleet pays.
+  B. **elastic drive**: the same schedule against a
+     1..``--max-replicas`` fleet sized by the `ElasticController`
+     (short SLO windows + widened budget — the bench compresses the
+     diurnal cycle, so the burn windows compress with it).  A chaos
+     ``scale.spawn`` fault fails the FIRST spawn attempt mid-ramp:
+     the controller must roll back typed and re-arm (cooldown not
+     spent), so capacity still lands one evaluation later.
+
+A watcher samples the controller's signal plane through the drive;
+the burn acceptance excludes the chaos incident window (from the
+rolled-back decision until one short-window past the recovering
+scale-out — the fault's spike is the INJECTED cost, the bar is what
+the controller does about it).
+
+  C. **planned handoff**: a P=8 `DistDataset` epoch with a mid-epoch
+     `parallel.handoff` ownership move — the epoch must complete
+     byte-identical to the no-handoff reference with ZERO degraded
+     batches and exactly ONE book bump (needs an 8-device host mesh:
+     run via bench.py, or set XLA_FLAGS --xla_force_host_platform_device_count=8).
+
+Acceptance (WARNING + exit 1 on any miss):
+  * >= 1 scale-out AND >= 1 scale-in (the fleet tracked the load);
+  * the chaos spawn fault rolled back typed (>= 1 rolled_back);
+  * elastic p99 holds vs the static baseline;
+  * max burn OUTSIDE the incident window < 1.0;
+  * zero failed requests (typed sheds excluded — drain sheds are
+    resubmitted after ``retry_after_ms``);
+  * handoff: 0 degraded batches, exactly 1 book bump.
+
+Feeds ``dist.autoscale.p99_held_ms`` / ``.burn_max`` /
+``.handoff_degraded_batches`` (regress.py, phase 3j).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bench_serving import _percentile, build_dataset, pace_schedule
+
+#: compressed SLO windows for the compressed diurnal cycle: the
+#: controller's short/long windows must fit inside a seconds-long
+#: bench the way 60 s / 300 s windows fit inside a day
+BENCH_SLO_WINDOWS = (1.0, 3.0)
+#: widened budget (p90-style): burn 1.0 = 10% of a window violating
+BENCH_SLO_BUDGET = 0.1
+#: injected per-dispatch cost: with the bench's 8-seed bucket ladder
+#: this pins single-replica capacity near ``(1/DISPATCH_DELAY_S) *
+#: (8 / avg seeds per request)`` requests/s REGARDLESS of machine
+#: speed — the diurnal peak deterministically overloads one replica
+#: and two absorb it, so the controller's behavior (not the host's
+#: CPU) decides the acceptance
+DISPATCH_DELAY_S = 0.05
+
+
+def make_diurnal_schedule(peak_rps: float, trough_rps: float,
+                          duration_s: float, n: int, zipf_a: float,
+                          seed: int):
+  """Non-homogeneous Poisson arrivals by thinning: rate(t) rides one
+  sinusoidal cycle trough -> peak -> trough.  Seeds are Zipf ranks
+  through a fixed permutation, sizes skewed small — the bench_serving
+  traffic shape on a diurnal envelope."""
+  rng = np.random.default_rng(seed)
+  arrivals, t = [], 0.0
+  while True:
+    t += rng.exponential(1.0 / peak_rps)
+    if t >= duration_s:
+      break
+    rate = trough_rps + (peak_rps - trough_rps) * 0.5 * (
+        1.0 - np.cos(2.0 * np.pi * t / duration_s))
+    if rng.random() < rate / peak_rps:
+      arrivals.append(t)
+  perm = rng.permutation(n)
+  plan = []
+  for a in arrivals:
+    k = int(rng.choice([1, 1, 1, 1, 2, 2, 4], 1)[0])
+    ranks = (rng.zipf(zipf_a, k) - 1) % n
+    plan.append((a, perm[ranks].astype(np.int64)))
+  return plan
+
+
+def _shrink_slo(frontend) -> None:
+  """Compress the frontend's SLO tracker to the bench windows (the
+  snapshot/burn paths read ``windows``/``budget`` live)."""
+  frontend.slo.windows = BENCH_SLO_WINDOWS
+  frontend.slo.budget = BENCH_SLO_BUDGET
+  frontend.slo._tripped = {w: False for w in BENCH_SLO_WINDOWS}
+
+
+def make_replica(name: str, args):
+  """One serving replica: own dataset instance (same build seed —
+  byte-identical answers fleet-wide), engine warmed through the
+  shared ``GLT_AOT_CACHE_DIR`` (a spawn restores instead of
+  compiling — the controller's warm pin), bench SLO windows."""
+  from graphlearn_tpu.serving import (LocalReplica, ServingEngine,
+                                      ServingFrontend)
+  sr = args.split_ratio if 0.0 < args.split_ratio < 1.0 else 0.5
+  ds = build_dataset(args.nodes, args.dim, split_ratio=sr)
+  eng = ServingEngine(ds, args.fanout, seed=11)
+  fe = ServingFrontend(eng, auto_start=True, warmup=True,
+                       max_wait_ms=8.0, default_deadline_ms=2000.0)
+  _shrink_slo(fe)
+  return LocalReplica(name, fe)
+
+
+def collect(pending, t0):
+  """Resolve the paced futures: (sorted ok-latencies ms, counts,
+  first error repr — the diagnosable face of a nonzero count)."""
+  from graphlearn_tpu.serving import AdmissionRejected
+  lats, ok, shed, errors, first = [], 0, 0, 0, None
+  for offset, fut in pending:
+    if isinstance(fut, str):
+      shed += fut == 'shed'
+      errors += fut == 'error'
+      if fut == 'error' and first is None:
+        first = 'door failure (see pace_schedule)'
+      continue
+    try:
+      fut.result(30.0)
+      lats.append(max(
+          1e3 * ((fut.done_monotonic or 0.0) - (t0 + offset)), 0.0))
+      ok += 1
+    except AdmissionRejected:
+      shed += 1
+    except Exception as e:          # noqa: BLE001 — executor fault
+      errors += 1
+      if first is None:
+        first = f'{type(e).__name__}: {e}'
+  lats.sort()
+  return lats, ok, shed, errors, first
+
+
+def run_static_phase(args, plan) -> dict:
+  """Phase A: ONE fixed replica through the whole diurnal cycle —
+  the unmanaged baseline the elastic p99 is held against."""
+  from graphlearn_tpu.serving import FleetRouter
+  from graphlearn_tpu.testing import chaos
+  rep = make_replica('s0', args)
+  router = FleetRouter([rep], heartbeat_ms=40.0, dead_after=3,
+                       auto_start=True)
+  chaos.install({'faults': [
+      {'site': 'serving.request', 'action': 'delay', 'op': 'dispatch',
+       'nth': 1, 'count': 10**9, 'secs': DISPATCH_DELAY_S},
+  ]})
+  t_run = time.perf_counter()
+  try:
+    pending, t0 = pace_schedule(plan, router.submit)
+    lats, ok, shed, errors, first = collect(pending, t0)
+  finally:
+    chaos.uninstall()
+  run_s = time.perf_counter() - t_run
+  router.close(close_replicas=True)
+  return {'label': 'static', 'replicas': 1, 'requests': len(plan),
+          'completed': ok, 'shed': shed, 'errors': errors,
+          'first_error': first,
+          'qps': round(ok / max(run_s, 1e-9), 1),
+          'p50_ms': round(_percentile(lats, 0.50) or 0.0, 2),
+          'p99_ms': round(_percentile(lats, 0.99) or 0.0, 2)}
+
+
+def signal_watch(controller, stop, out):
+  """Sample the controller's signal plane through the drive: (t,
+  worst-window burn, live replicas) — the burn acceptance and the
+  replica-tracking gate read this tape."""
+  while not stop.is_set():
+    try:
+      sig = controller.signals()
+      out.append((time.monotonic(),
+                  max(sig['short_burn'], sig['long_burn']),
+                  sig['replicas']))
+    except Exception:               # noqa: BLE001 — a mid-teardown
+      pass                          # sample is not a bench failure
+    stop.wait(0.05)
+
+
+def incident_windows(decisions):
+  """The chaos exclusion intervals: each rolled-back scale-out opens
+  an incident at its decision stamp minus one short window (the spike
+  that triggered it is already in the window) and closes one short
+  window after the NEXT successful scale-out (the recovery capacity
+  needs a window-length to flush the spike out of the burn
+  denominator)."""
+  w = BENCH_SLO_WINDOWS[0]
+  outs = [d for d in decisions if d['dir'] == 'out']
+  spans = []
+  for i, d in enumerate(outs):
+    if d['outcome'] != 'rolled_back':
+      continue
+    end = d['at'] + 3.0             # fallback: no recovery seen
+    for nxt in outs[i + 1:]:
+      if nxt['outcome'] == 'ok':
+        end = nxt['at'] + w
+        break
+    spans.append((d['at'] - w, end + w))
+  return spans
+
+
+def run_elastic_phase(args, plan) -> dict:
+  """Phase B: the same cycle against the closed loop — min 1 replica,
+  scale-out on burn/queue, scale-in at the trough, first spawn
+  chaos-failed mid-ramp."""
+  import threading
+  from graphlearn_tpu.serving import ElasticController, FleetRouter
+  from graphlearn_tpu.testing import chaos
+  counter = {'n': 0}
+
+  def spawn():
+    counter['n'] += 1
+    return make_replica(f'e{counter["n"]}', args)
+
+  router = FleetRouter([make_replica('e0', args)], heartbeat_ms=40.0,
+                       dead_after=3, auto_start=True)
+  chaos.install({'faults': [
+      # the same deterministic per-dispatch cost as the static phase
+      # (spawned replicas pay it too — capacity scales linearly)
+      {'site': 'serving.request', 'action': 'delay', 'op': 'dispatch',
+       'nth': 1, 'count': 10**9, 'secs': DISPATCH_DELAY_S},
+      # the mid-run fault: the FIRST spawn attempt dies — the
+      # controller must roll back typed, re-arm, and land capacity on
+      # the next evaluation
+      {'site': 'scale.spawn', 'action': 'fail', 'nth': 1},
+  ]})
+  controller = ElasticController(
+      router, spawn, min_replicas=1, max_replicas=args.max_replicas,
+      eval_s=0.12, cooldown_s=(0.5, 1.5), out_burn=0.5, in_burn=0.15,
+      # ~10 queued requests (two dispatches of backlog at the 8-seed
+      # ladder): capacity lands BEFORE the queue wait approaches the
+      # SLO target — the leading-indicator half of the hysteresis
+      queue_ratio=0.15, quiesce_timeout_s=8.0, auto_start=True)
+  samples = []
+  stop = threading.Event()
+  watcher = threading.Thread(target=signal_watch,
+                             args=(controller, stop, samples),
+                             daemon=True)
+  watcher.start()
+  t_run = time.perf_counter()
+  try:
+    pending, t0 = pace_schedule(plan, router.submit)
+    lats, ok, shed, errors, first = collect(pending, t0)
+    run_s = time.perf_counter() - t_run
+    # the post-cycle trough: traffic ended, the long burn window
+    # drains, fresh/idle replicas read burn 0 (the SloTracker idle
+    # contract) — the scale-in decision must land HERE, inside a
+    # bounded grace window, not "eventually"
+    grace_deadline = time.monotonic() + 6.0
+    while time.monotonic() < grace_deadline:
+      if any(d['dir'] == 'in' and d['outcome'] == 'ok'
+             for d in controller.decisions()):
+        break
+      time.sleep(0.1)
+  finally:
+    stop.set()
+    watcher.join(5.0)
+    controller.close()
+    chaos.uninstall()
+  decisions = controller.decisions()
+  router.close(close_replicas=True)
+  outs = sum(1 for d in decisions
+             if d['dir'] == 'out' and d['outcome'] == 'ok')
+  ins = sum(1 for d in decisions
+            if d['dir'] == 'in' and d['outcome'] == 'ok')
+  rolled = sum(1 for d in decisions if d['outcome'] == 'rolled_back')
+  outcomes = {}
+  for d in decisions:
+    key = f"{d['dir']}:{d['outcome']}"
+    outcomes[key] = outcomes.get(key, 0) + 1
+  spans = incident_windows(decisions)
+  outside = [b for t, b, _ in samples
+             if not any(s <= t <= e for s, e in spans)]
+  reps = [r for _, _, r in samples]
+  return {'label': 'elastic', 'requests': len(plan), 'completed': ok,
+          'shed': shed, 'errors': errors, 'first_error': first,
+          'qps': round(ok / max(run_s, 1e-9), 1),
+          'p50_ms': round(_percentile(lats, 0.50) or 0.0, 2),
+          'p99_ms': round(_percentile(lats, 0.99) or 0.0, 2),
+          'scale_outs': outs, 'scale_ins': ins,
+          'rolled_back': rolled,
+          'decisions_total': len(decisions),
+          'decision_outcomes': outcomes,
+          'replicas_min': min(reps) if reps else 0,
+          'replicas_max': max(reps) if reps else 0,
+          'burn_max': round(max(outside), 4) if outside else 0.0,
+          'burn_samples': len(samples),
+          'incident_windows': len(spans),
+          'spawned': counter['n']}
+
+
+def run_handoff_phase() -> dict:
+  """Phase C: the planned-handoff acceptance on a P=8 mesh — a
+  mid-epoch ownership move with zero degraded batches, one bump."""
+  import jax
+  if len(jax.devices()) < 8:
+    return {'error': f'needs an 8-device host mesh '
+                     f'(have {len(jax.devices())})'}
+  from graphlearn_tpu.parallel.dist_data import DistDataset
+  from graphlearn_tpu.parallel.dist_sampler import DistNeighborLoader
+  from graphlearn_tpu.parallel.failover import ShardStore
+  from graphlearn_tpu.parallel.handoff import handoff
+  P, N, E = 8, 200, 1200
+  rng = np.random.default_rng(0)
+  rows = rng.integers(0, N, E)
+  cols = rng.integers(0, N, E)
+  feat = (np.arange(N)[:, None] + np.zeros((1, 6))).astype(np.float32)
+  lab = (np.arange(N) % 4).astype(np.int64)
+
+  def dataset():
+    return DistDataset.from_full_graph(P, rows, cols, feat, lab)
+
+  def loader(ds):
+    return DistNeighborLoader(ds, [3, 2], np.arange(N), batch_size=4,
+                              shuffle=True, seed=0)
+
+  ref = [b for b in loader(dataset())]
+  ds = dataset()
+  it = iter(loader(ds))
+  got = [next(it) for _ in range(3)]   # mid-epoch: the move lands
+  t0 = time.perf_counter()
+  with tempfile.TemporaryDirectory() as d:
+    info = handoff(ds, 3, 5, store=ShardStore(d))
+  secs = time.perf_counter() - t0
+  got += list(it)                      # the rest fences + completes
+  degraded = abs(len(ref) - len(got))
+  for a, b in zip(ref, got):
+    same = (np.array_equal(np.asarray(a.node), np.asarray(b.node))
+            and np.array_equal(np.asarray(a.x), np.asarray(b.x))
+            and np.array_equal(np.asarray(a.y), np.asarray(b.y))
+            and np.array_equal(np.asarray(a.edge_index),
+                               np.asarray(b.edge_index)))
+    degraded += not same
+  return {'label': 'handoff', 'batches': len(got),
+          'degraded_batches': int(degraded),
+          'book_bumps': int(ds.partition_book.version),
+          'transfers': len(ds.partition_book.transfers()),
+          'frm': info['frm'], 'to': info['to'],
+          'secs': round(secs, 3)}
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__.split('\n')[0])
+  ap.add_argument('--nodes', type=int, default=20000)
+  ap.add_argument('--dim', type=int, default=32)
+  ap.add_argument('--fanout', type=int, nargs='+', default=[5, 3])
+  ap.add_argument('--rate', type=float, default=160.0,
+                  help='diurnal PEAK arrival rate, requests/s')
+  ap.add_argument('--trough', type=float, default=20.0,
+                  help='diurnal trough arrival rate, requests/s')
+  ap.add_argument('--duration', type=float, default=9.0,
+                  help='one diurnal cycle, seconds')
+  ap.add_argument('--zipf-a', type=float, default=1.1)
+  ap.add_argument('--max-replicas', type=int, default=3)
+  ap.add_argument('--split-ratio', type=float, default=0.5)
+  ap.add_argument('--cpu', action='store_true')
+  args = ap.parse_args(argv)
+  import jax
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  from graphlearn_tpu.telemetry import recorder
+  recorder.enable(None)
+  # the capacity model rides the injected dispatch cost: an 8-seed
+  # bucket ladder bounds coalescing (a dispatch carries a handful of
+  # requests, so the DISPATCH_DELAY_S injection caps per-replica
+  # throughput deterministically), a small queue makes queue_frac a
+  # leading indicator, and the latency SLO separates the regimes —
+  # uncontended traffic (~coalesce wait + one dispatch delay, with
+  # occasional host-scheduling spikes) clears 500 ms, a saturated
+  # queue does not.  The QUEUE is the leading
+  # indicator (a couple of dispatches of backlog trips scale-out
+  # before latency ever reaches the target); burn is the lagging
+  # confirmation and the acceptance gate
+  os.environ.setdefault('GLT_SERVING_BUCKETS', '8')
+  os.environ.setdefault('GLT_SERVING_QUEUE_DEPTH', '64')
+  os.environ.setdefault('GLT_SERVING_SLO_P99_MS', '500')
+  os.environ.setdefault('GLT_SERVING_SLO_QPS', str(args.rate / 2))
+  result = {'num_nodes': args.nodes, 'fanout': list(args.fanout),
+            'platform': jax.devices()[0].platform,
+            'peak_rps': args.rate, 'trough_rps': args.trough,
+            'duration_s': args.duration}
+  plan = make_diurnal_schedule(args.rate, args.trough, args.duration,
+                               args.nodes, args.zipf_a, seed=5)
+  with tempfile.TemporaryDirectory() as aot_dir:
+    # one shared AOT cache for the whole bench: the static replica
+    # compiles + publishes, every elastic spawn warm-restores (the
+    # controller's compile_count()==0 admission pin)
+    os.environ['GLT_AOT_CACHE_DIR'] = aot_dir
+    try:
+      static = run_static_phase(args, plan)
+      result['static'] = static
+      print(json.dumps(result), flush=True)
+      elastic = run_elastic_phase(args, plan)
+      result['elastic'] = elastic
+      print(json.dumps(result), flush=True)
+    finally:
+      os.environ.pop('GLT_AOT_CACHE_DIR', None)
+  hand = run_handoff_phase()
+  result['handoff'] = hand
+
+  result['p99_static_ms'] = static['p99_ms']
+  result['p99_held_ms'] = elastic['p99_ms']
+  result['burn_max'] = elastic['burn_max']
+  result['scale_outs'] = elastic['scale_outs']
+  result['scale_ins'] = elastic['scale_ins']
+  result['rolled_back'] = elastic['rolled_back']
+  result['errors'] = static['errors'] + elastic['errors']
+  result['handoff_degraded_batches'] = hand.get('degraded_batches')
+  result['handoff_book_bumps'] = hand.get('book_bumps')
+  print(json.dumps(result), flush=True)
+
+  failures = []
+  if elastic['completed'] == 0:
+    failures.append('elastic drive served no requests')
+  if elastic['errors'] or static['errors']:
+    failures.append(f"failed requests (static={static['errors']}, "
+                    f"elastic={elastic['errors']}) — must be 0")
+  if elastic['scale_outs'] < 1 or elastic['scale_ins'] < 1:
+    failures.append(f"fleet did not track the load (scale_outs="
+                    f"{elastic['scale_outs']}, scale_ins="
+                    f"{elastic['scale_ins']} — need >=1 each)")
+  if elastic['rolled_back'] < 1:
+    failures.append('the chaos scale.spawn fault never rolled back '
+                    'typed (rolled_back == 0)')
+  if elastic['burn_max'] >= 1.0:
+    failures.append(f"burn {elastic['burn_max']} >= 1.0 outside the "
+                    'chaos incident window — the controller let the '
+                    'SLO budget burn through')
+  if static['p99_ms'] > 0 and \
+      elastic['p99_ms'] > static['p99_ms'] * 1.05 + 5.0:
+    failures.append(f"elastic p99 {elastic['p99_ms']}ms did not hold "
+                    f"vs static baseline {static['p99_ms']}ms")
+  if 'error' in hand:
+    failures.append(f"handoff phase: {hand['error']}")
+  elif hand['degraded_batches'] != 0 or hand['book_bumps'] != 1:
+    failures.append(f"handoff degraded_batches="
+                    f"{hand['degraded_batches']} (need 0), "
+                    f"book_bumps={hand['book_bumps']} (need 1)")
+  if failures:
+    for f in failures:
+      print(f'WARNING: {f}', file=sys.stderr)
+    return 1
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

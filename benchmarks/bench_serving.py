@@ -88,25 +88,58 @@ def make_schedule(rate_rps: float, duration_s: float, n: int,
   return plan
 
 
-def pace_schedule(plan, submit):
+def pace_schedule(plan, submit, honor_retry_after=True, max_retries=8):
   """Open-loop pacing shared by the single-engine and fleet drivers:
   submit each request at its SCHEDULED offset, never waiting on
   earlier ones, classifying door refusals typed.  Returns
   ``([(offset, future | 'shed' | 'error'), ...], t0)`` with ``t0``
-  the monotonic schedule origin (latency = resolve - (t0 + offset))."""
+  the monotonic schedule origin (latency = resolve - (t0 + offset)).
+
+  ``reason='draining'`` refusals carry a ``retry_after_ms`` hint — a
+  drain is a planned, bounded unavailability, not capacity loss — so
+  the client resubmits after the hint instead of counting a shed
+  (ISSUE 19).  Latency stays measured from the ORIGINAL offset: the
+  wait behind the drain is real, client-visible time.  ``queue_full``
+  and deadline refusals stay terminal sheds; after ``max_retries``
+  drain bounces the request is a shed too."""
+  import heapq
   from graphlearn_tpu.serving import AdmissionRejected
   out = []
+  retryq = []   # (due_rel, seq, orig_offset, seeds, attempt)
+  seq = 0
   t0 = time.monotonic()
+
+  def attempt(orig_offset, seeds, tries):
+    nonlocal seq
+    try:
+      out.append((orig_offset, submit(seeds)))
+    except AdmissionRejected as e:
+      hint = getattr(e, 'retry_after_ms', None)
+      if (honor_retry_after and getattr(e, 'reason', '') == 'draining'
+          and hint is not None and tries < max_retries):
+        due = (time.monotonic() - t0) + float(hint) / 1e3
+        heapq.heappush(retryq, (due, seq, orig_offset, seeds, tries + 1))
+        seq += 1
+      else:
+        out.append((orig_offset, 'shed'))
+    except Exception:               # noqa: BLE001 — door failure
+      out.append((orig_offset, 'error'))
+
   for offset, seeds in plan:
+    # Drain any retries that came due before this scheduled arrival.
+    while retryq and retryq[0][0] <= time.monotonic() - t0:
+      _, _, o, s, tries = heapq.heappop(retryq)
+      attempt(o, s, tries)
     now = time.monotonic() - t0
     if offset > now:
       time.sleep(offset - now)
-    try:
-      out.append((offset, submit(seeds)))
-    except AdmissionRejected:
-      out.append((offset, 'shed'))
-    except Exception:               # noqa: BLE001 — door failure
-      out.append((offset, 'error'))
+    attempt(offset, seeds, 0)
+  while retryq:                     # flush stragglers after the plan
+    due, _, o, s, tries = heapq.heappop(retryq)
+    now = time.monotonic() - t0
+    if due > now:
+      time.sleep(due - now)
+    attempt(o, s, tries)
   return out, t0
 
 

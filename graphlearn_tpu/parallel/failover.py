@@ -164,32 +164,10 @@ class ShardStore:
     called at load time (and re-called at ingest-compaction seams via
     `refresh_cb`, so a streamed topology's durable copy tracks the
     compacted base).  Returns the number of shards written."""
-    g = ds.graph
-    p = g.num_partitions
-    nf = ds.node_features
-    bounds = np.asarray(g.bounds, np.int64)
+    p = ds.graph.num_partitions
     for r in range(p):
-      payload = {
-          'indptr': g.indptr[r], 'indices': g.indices[r],
-          'eids': g.edge_ids[r],
-      }
-      if nf is not None:
-        payload['fshard'] = nf.shards[r]
-        payload['hot_count'] = np.asarray([nf.hot_counts[r]], np.int64)
-        if nf.cold_host is not None:
-          payload['cold'] = nf.cold_host[bounds[r]:bounds[r + 1]]
-      if ds.node_labels is not None:
-        payload['lshard'] = np.asarray(ds.node_labels)[r]
-      if ds.edge_features is not None:
-        payload['efshard'] = ds.edge_features.shards[r]
-      self.save_shard(r, payload)
-    self.save_meta({
-        'num_parts': int(p),
-        'num_nodes': int(g.num_nodes),
-        'node_width': int(g.indptr.shape[1]),
-        'edge_width': int(g.indices.shape[1]),
-        'fingerprint': dataset_fingerprint(ds),
-    })
+      self.save_shard(r, shard_payload(ds, r))
+    self.save_meta(dataset_meta(ds))
     return p
 
   def refresh_cb(self, ds):
@@ -201,6 +179,92 @@ class ShardStore:
     def _refresh() -> None:
       self.write_dataset_shards(ds)
     return _refresh
+
+
+def shard_payload(ds, r: int) -> Dict[str, np.ndarray]:
+  """One partition's durable payload, built from the dataset's
+  CURRENT stacks — shared by the load-time/compaction-seam bulk write
+  (`ShardStore.write_dataset_shards`) and the planned handoff's
+  snapshot phase (`parallel.handoff`), so both sides serialize the
+  identical shard shape."""
+  g = ds.graph
+  r = int(r)
+  nf = ds.node_features
+  bounds = np.asarray(g.bounds, np.int64)
+  payload = {
+      'indptr': g.indptr[r], 'indices': g.indices[r],
+      'eids': g.edge_ids[r],
+  }
+  if nf is not None:
+    payload['fshard'] = nf.shards[r]
+    payload['hot_count'] = np.asarray([nf.hot_counts[r]], np.int64)
+    if nf.cold_host is not None:
+      payload['cold'] = nf.cold_host[bounds[r]:bounds[r + 1]]
+  if ds.node_labels is not None:
+    payload['lshard'] = np.asarray(ds.node_labels)[r]
+  if ds.edge_features is not None:
+    payload['efshard'] = ds.edge_features.shards[r]
+  return payload
+
+
+def dataset_meta(ds) -> Dict:
+  """The `ShardStore` meta record for a dataset — the adoption-time
+  validation fingerprint (`validate_shard_payload` checks against
+  it)."""
+  g = ds.graph
+  return {
+      'num_parts': int(g.num_partitions),
+      'num_nodes': int(g.num_nodes),
+      'node_width': int(g.indptr.shape[1]),
+      'edge_width': int(g.indices.shape[1]),
+      'fingerprint': dataset_fingerprint(ds),
+  }
+
+
+def validate_shard_payload(ds, store: 'ShardStore',
+                           payload: Dict[str, np.ndarray],
+                           ) -> Dict[str, np.ndarray]:
+  """The shared load-side validation ladder (crash adoption AND
+  planned handoff): check the store meta against the dataset's frozen
+  shape, then widen the CSR rows to the dataset's current stack
+  widths.  Typed `AdoptionRefusedError` on any mismatch; returns the
+  padded payload."""
+  book: PartitionBook = ds.partition_book
+  meta = store.meta() or {}
+  if meta.get('num_parts') not in (None, book.num_partitions):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} was written for "
+        f"{meta.get('num_parts')} partitions, this dataset has "
+        f'{book.num_partitions}')
+  g = ds.graph
+  # the durable copy must be THIS graph's: num_parts can collide
+  # across graphs, so the frozen shape fingerprint is checked too —
+  # a mismatched store adopted silently would serve another graph's
+  # topology/features for the orphaned range
+  if meta.get('num_nodes') not in (None, int(g.num_nodes)):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} was written for "
+        f"{meta.get('num_nodes')} nodes, this dataset has "
+        f'{int(g.num_nodes)}')
+  if meta.get('node_width') not in (None, int(g.indptr.shape[1])):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} node width "
+        f"{meta.get('node_width')} != dataset {int(g.indptr.shape[1])}"
+        f' (different bounds — not this graph)')
+  if int(meta.get('edge_width') or 0) > int(g.indices.shape[1]):
+    raise AdoptionRefusedError(
+        f"shard store {store.root} edge width "
+        f"{meta.get('edge_width')} exceeds the dataset's "
+        f'{int(g.indices.shape[1])} — truncation would corrupt the '
+        f'adopted CSR')
+  payload['indptr'] = _pad_to(
+      np.asarray(payload['indptr']), g.indptr.shape[1],
+      int(np.asarray(payload['indptr'])[-1]))
+  payload['indices'] = _pad_to(np.asarray(payload['indices']),
+                               g.indices.shape[1], -1)
+  payload['eids'] = _pad_to(np.asarray(payload['eids']),
+                            g.edge_ids.shape[1], -1)
+  return payload
 
 
 def _load_with_deadline(store: 'ShardStore', lost: int,
@@ -269,40 +333,7 @@ def adopt_shard(ds, store: Optional[ShardStore], lost: int,
     survivor = book.pick_survivor(lost)
   payload = _load_with_deadline(store, lost,
                                 deadline - time.monotonic())
-  meta = store.meta() or {}
-  if meta.get('num_parts') not in (None, book.num_partitions):
-    raise AdoptionRefusedError(
-        f"shard store {store.root} was written for "
-        f"{meta.get('num_parts')} partitions, this dataset has "
-        f'{book.num_partitions}')
-  g = ds.graph
-  # the durable copy must be THIS graph's: num_parts can collide
-  # across graphs, so the frozen shape fingerprint is checked too —
-  # a mismatched store adopted silently would serve another graph's
-  # topology/features for the orphaned range
-  if meta.get('num_nodes') not in (None, int(g.num_nodes)):
-    raise AdoptionRefusedError(
-        f"shard store {store.root} was written for "
-        f"{meta.get('num_nodes')} nodes, this dataset has "
-        f'{int(g.num_nodes)}')
-  if meta.get('node_width') not in (None, int(g.indptr.shape[1])):
-    raise AdoptionRefusedError(
-        f"shard store {store.root} node width "
-        f"{meta.get('node_width')} != dataset {int(g.indptr.shape[1])}"
-        f' (different bounds — not this graph)')
-  if int(meta.get('edge_width') or 0) > int(g.indices.shape[1]):
-    raise AdoptionRefusedError(
-        f"shard store {store.root} edge width "
-        f"{meta.get('edge_width')} exceeds the dataset's "
-        f'{int(g.indices.shape[1])} — truncation would corrupt the '
-        f'adopted CSR')
-  payload['indptr'] = _pad_to(
-      np.asarray(payload['indptr']), g.indptr.shape[1],
-      int(np.asarray(payload['indptr'])[-1]))
-  payload['indices'] = _pad_to(np.asarray(payload['indices']),
-                               g.indices.shape[1], -1)
-  payload['eids'] = _pad_to(np.asarray(payload['eids']),
-                            g.edge_ids.shape[1], -1)
+  payload = validate_shard_payload(ds, store, payload)
   if time.monotonic() > deadline:
     raise AdoptionRefusedError(
         f'adoption of partition {lost} exceeded GLT_ADOPT_TIMEOUT_S='

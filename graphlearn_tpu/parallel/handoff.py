@@ -1,0 +1,184 @@
+"""Planned `PartitionBook` handoff — move ownership, zero degraded window.
+
+ISSUE 19.  The *scheduled* twin of crash adoption (`failover.py`):
+load rebalancing and rolling maintenance move a range between live
+devices through the SAME PartitionBook authority as failover, but
+because nothing died, the move can be fenced — the source keeps
+serving the range until the destination has durably acked the shard,
+and the cutover is ONE RCU book bump.  No request is ever routed to a
+device that does not hold the range's bytes, so the epoch completes
+byte-identical to the no-handoff run (the PR 15 exact-completion
+contract, now without a kill) with zero degraded batches and zero
+lost/duplicated seeds.
+
+The seam ladder (each phase is a ``handoff.transfer`` chaos seam with
+``op`` = the seam name, and each emits one ``handoff.transfer``
+flight-recorder event):
+
+  1. **snapshot** — write the range's durable shard from the source's
+     CURRENT stacks (`failover.shard_payload`, atomic publish);
+  2. **transfer** — the destination loads the durable shard under the
+     adoption deadline and validates it against the dataset's frozen
+     shape (`failover.validate_shard_payload`);
+  3. **fence** — the destination ack: the loaded payload must be
+     byte-identical to what the source serves *right now*; only then
+     is it STAGED on ``dataset.adopted_shards``.  The book — the
+     routing authority — is still untouched: readers keep routing the
+     range to the source;
+  4. **cutover** — `PartitionBook.transfer`: one version bump,
+     published RCU.  Readers fence at their next dispatch
+     (``maybe_refresh_book``) and rebuild lane-stacked arrays that
+     serve the staged shard from the destination;
+  5. **drain** — the source's in-flight lane finishes naturally (its
+     pinned pre-bump view stays valid for dispatches already cut);
+     a fault HERE is post-cutover and is ABSORBED: the destination
+     already owns the range.
+
+A fault at any seam **before** cutover unwinds to clean source
+retention: the staged shard is dropped, the book is untouched, and a
+typed `HandoffAbortedError` names the seam — never two owners, never
+a half-moved range.  The decision ledger of who-asked lives with the
+caller (the ElasticController's `scale.decision` / an operator's
+runbook); this module owns only the move.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .failover import (NoDurableShardError, ShardStore, adopt_timeout_s,
+                       shard_payload, shard_dir_from_env, dataset_meta,
+                       validate_shard_payload, _load_with_deadline)
+from .partition_book import AdoptionRefusedError, PartitionBook
+
+#: the seam ladder, in execution order (chaos plans target these via
+#: ``handoff.transfer:<action>:1:op=<seam>``)
+SEAMS = ('snapshot', 'transfer', 'fence', 'cutover', 'drain')
+
+
+class HandoffAbortedError(RuntimeError):
+  """A planned handoff unwound before cutover: the source cleanly
+  retains ownership (book untouched, staged shard dropped).  ``seam``
+  names where the ladder stopped."""
+
+  def __init__(self, msg: str, seam: Optional[str] = None,
+               partition: Optional[int] = None):
+    super().__init__(msg)
+    self.seam = seam
+    self.partition = partition
+
+
+def _ack_payload(ds, rng: int, payload: Dict[str, np.ndarray]) -> None:
+  """The fence's destination ack: every array in the transferred
+  payload must be byte-identical to what the source serves from its
+  live stacks RIGHT NOW — a stale or torn shard refuses here, before
+  anything is staged."""
+  live_now = shard_payload(ds, rng)
+  for key, want in live_now.items():
+    got = payload.get(key)
+    if got is None or not np.array_equal(np.asarray(got),
+                                         np.asarray(want)):
+      raise HandoffAbortedError(
+          f'destination ack failed for partition {int(rng)}: '
+          f'transferred shard field {key!r} is not byte-identical to '
+          'the live range (stale durable copy?)', seam='fence',
+          partition=int(rng))
+
+
+def handoff(ds, rng: int, to: int, store: Optional[ShardStore] = None,
+            frm: Optional[int] = None) -> Dict:
+  """Move range ``rng`` from its current owner to device ``to``
+  through the fenced seam ladder.  Returns an info dict (``frm``,
+  ``to``, ``version``, ``secs``, ``drain_fault``).  Raises typed —
+  `HandoffAbortedError` / `AdoptionRefusedError` /
+  `NoDurableShardError` — with the book untouched and nothing staged
+  whenever the ladder stops before cutover."""
+  from ..telemetry.recorder import recorder
+  from ..testing import chaos
+  book: PartitionBook = ds.partition_book
+  rng, to = int(rng), int(to)
+  if frm is None:
+    frm = int(book.view().owners[rng])
+  frm = int(frm)
+  if store is None:
+    d = shard_dir_from_env()
+    if d is None:
+      raise NoDurableShardError(
+          'no shard store configured (GLT_SHARD_DIR unset) — a '
+          'planned handoff needs the durable-shard transfer path')
+    store = ShardStore(d)
+
+  t0 = time.monotonic()
+  staged = False
+  seam = 'snapshot'
+
+  def _emit(phase: str, **extra) -> None:
+    recorder.emit('handoff.transfer', partition=rng, frm=frm, to=to,
+                  phase=phase, version=book.version,
+                  secs=round(time.monotonic() - t0, 6), **extra)
+
+  try:
+    # 1. snapshot — durable copy of the range from the source's stacks
+    chaos.handoff_transfer_check('snapshot', partition=rng)
+    store.save_shard(rng, shard_payload(ds, rng))
+    store.save_meta(dataset_meta(ds))
+    _emit('snapshot')
+
+    # 2. transfer — destination loads the durable shard (deadline-
+    # bounded: a wedged store aborts the handoff, not the epoch)
+    seam = 'transfer'
+    chaos.handoff_transfer_check('transfer', partition=rng)
+    payload = _load_with_deadline(store, rng, adopt_timeout_s())
+    payload = validate_shard_payload(ds, store, payload)
+    _emit('transfer')
+
+    # 3. fence — destination ack + staging; the book (and therefore
+    # every router/reader) still points the range at the source
+    seam = 'fence'
+    chaos.handoff_transfer_check('fence', partition=rng)
+    _ack_payload(ds, rng, payload)
+    if not hasattr(ds, 'adopted_shards'):
+      ds.adopted_shards = {}
+    if rng in ds.adopted_shards:
+      raise HandoffAbortedError(
+          f'range {rng} already carries a staged/adopted shard — '
+          'refusing to overwrite a prior ownership move',
+          seam='fence', partition=rng)
+    ds.adopted_shards[rng] = payload
+    staged = True
+    _emit('fence')
+
+    # 4. cutover — ONE RCU bump; the only mutation of the routing
+    # authority in the whole ladder (the chaos check sits BEFORE it,
+    # so a cutover-seam kill still unwinds to source retention)
+    seam = 'cutover'
+    chaos.handoff_transfer_check('cutover', partition=rng)
+    view = book.transfer(rng, frm, to)
+    _emit('cutover')
+  except BaseException as e:
+    if staged:
+      ds.adopted_shards.pop(rng, None)
+    _emit('rollback', error=f'{type(e).__name__}: {e}', at_seam=seam)
+    if isinstance(e, (AdoptionRefusedError, NoDurableShardError,
+                      HandoffAbortedError)):
+      raise
+    raise HandoffAbortedError(
+        f'handoff of partition {rng} to {to} aborted at the {seam} '
+        f'seam ({type(e).__name__}: {e}) — source retains ownership',
+        seam=seam, partition=rng) from e
+
+  # 5. drain — post-cutover: the destination already owns the range,
+  # so a fault here is ABSORBED (recorded, not raised) and the move
+  # stands; the source's in-flight lane finishes on its pinned view
+  drain_fault = None
+  try:
+    chaos.handoff_transfer_check('drain', partition=rng)
+  except Exception as e:              # noqa: BLE001 — absorbed by design
+    drain_fault = f'{type(e).__name__}: {e}'
+  secs = time.monotonic() - t0
+  _emit('drain', error=drain_fault)
+  return {'partition': rng, 'frm': frm, 'to': to,
+          'version': int(view.version), 'secs': secs,
+          'drain_fault': drain_fault}

@@ -131,6 +131,10 @@ class PartitionBook:
     #: the adoption ledger (one record per ownership transfer) —
     #: guarded-by: self._lock
     self._adoptions: List[Dict] = []
+    #: the planned-handoff ledger (ISSUE 19) — separate from
+    #: ``_adoptions`` so the crash-adoption record shape stays frozen —
+    #: guarded-by: self._lock
+    self._transfers: List[Dict] = []
     self._bounds = bounds
     self._published = self._build_view_locked()
 
@@ -182,6 +186,11 @@ class PartitionBook:
     with self._lock:
       return [dict(a) for a in self._adoptions]
 
+  def transfers(self) -> List[Dict]:
+    """The planned-handoff ledger (one record per `transfer` cutover)."""
+    with self._lock:
+      return [dict(t) for t in self._transfers]
+
   # -- ownership transfer --------------------------------------------------
   def adopt(self, lost: int, survivor: int) -> BookView:
     """Transfer range ``lost`` to mesh position ``survivor``; bump the
@@ -221,6 +230,55 @@ class PartitionBook:
     live.gauge('partition.book_version').set(float(view.version))
     recorder.emit('partition.book_version', version=view.version,
                   lost=lost, survivor=survivor,
+                  num_lanes=view.num_lanes)
+    return view
+
+  def transfer(self, rng: int, frm: int, to: int) -> BookView:
+    """Planned ownership handoff (ISSUE 19): move range ``rng`` from
+    its current owner ``frm`` to ``to`` in ONE version bump — the
+    cutover step of `parallel.handoff.handoff`.  Shares `adopt`'s v1
+    lane constraints (the destination must serve its own range and
+    carry no extra lane) but records into the SEPARATE ``_transfers``
+    ledger, leaving the crash-adoption ledger shape untouched.  Typed
+    refusals (`AdoptionRefusedError`) never mutate the book."""
+    p = self.num_partitions
+    rng, frm, to = int(rng), int(frm), int(to)
+    if not 0 <= rng < p or not 0 <= to < p:
+      raise AdoptionRefusedError(
+          f'partition out of range: rng={rng} to={to} (P={p})')
+    if to == frm:
+      raise AdoptionRefusedError(
+          f'handoff of partition {rng} from {frm} to itself')
+    with self._lock:
+      if int(self._owners[rng]) != frm:
+        raise AdoptionRefusedError(
+            f'stale handoff source: range {rng} is owned by '
+            f'{int(self._owners[rng])}, not {frm} (version '
+            f'{self._version}) — refusing a cutover that would fork '
+            'the routing authority')
+      if int(self._owners[rng]) != rng:
+        raise AdoptionRefusedError(
+            f'range {rng} is already served off-owner (by {frm}) — '
+            'one moved lane per range in v1; restore identity first')
+      if int(self._owners[to]) != to:
+        raise AdoptionRefusedError(
+            f'destination {to} is itself dead (owned by '
+            f'{int(self._owners[to])})')
+      if int(np.sum(self._owners == to)) > 1:
+        raise AdoptionRefusedError(
+            f'destination {to} already carries an extra lane '
+            '(one moved shard per device in v1) — pick another')
+      self._owners[rng] = to
+      self._version += 1
+      self._transfers.append({'range': rng, 'frm': frm, 'to': to,
+                              'version': self._version})
+      self._published = self._build_view_locked()
+      view = self._published
+    from ..telemetry.live import live
+    from ..telemetry.recorder import recorder
+    live.gauge('partition.book_version').set(float(view.version))
+    recorder.emit('partition.book_version', version=view.version,
+                  lost=rng, survivor=to, planned=True,
                   num_lanes=view.num_lanes)
     return view
 

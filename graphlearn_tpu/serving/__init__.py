@@ -16,15 +16,24 @@ versions drain-free behind a parity check; `aot_cache` persists
 bucket executables under ``GLT_AOT_CACHE_DIR`` so replacements warm
 from disk instead of recompiling.
 
+Closed-loop elasticity (ISSUE 19): `ElasticController` sizes the
+fleet from the SLO-burn/queue/headroom signal plane (scale-out
+admits only warm, verified replicas; scale-in drains and retires the
+coldest), and `parallel.handoff` moves partition ownership planned —
+fence then one-bump cutover, zero degraded window.
+
 Knobs: ``GLT_SERVING_BUCKETS``, ``GLT_SERVING_MAX_WAIT_MS``,
 ``GLT_SERVING_QUEUE_DEPTH``, ``GLT_SERVING_DEADLINE_MS``
 (benchmarks/README "Online serving (r9)"); ``GLT_AOT_CACHE_DIR``,
 ``GLT_FLEET_HEARTBEAT_MS``, ``GLT_FLEET_OVERLOAD_RATIO``,
-``GLT_SERVING_DRAIN_RETRY_MS`` ("Fleet serving & failover (r14)").
+``GLT_SERVING_DRAIN_RETRY_MS`` ("Fleet serving & failover (r14)");
+``GLT_SCALE_*``, ``GLT_FLEET_FLAP_WINDOW_S`` ("Elastic autoscaling &
+planned handoff (r20)").
 """
 from .admission import (AdmissionController, AdmissionRejected,
                         ServingFuture)
 from .aot_cache import AotExecutableCache
+from .autoscaler import ElasticController, ScaleAbortedError
 from .engine import ServingEngine, ServingResult, resolve_buckets
 from .frontend import ServingFrontend
 from .router import FleetRouter, LocalReplica, RemoteReplica, RouterFuture
@@ -34,6 +43,7 @@ from .swap import (SwapAbortedError, SwapParityError,
 __all__ = [
     'AdmissionController', 'AdmissionRejected', 'ServingFuture',
     'AotExecutableCache',
+    'ElasticController', 'ScaleAbortedError',
     'ServingEngine', 'ServingResult', 'resolve_buckets',
     'ServingFrontend',
     'FleetRouter', 'LocalReplica', 'RemoteReplica', 'RouterFuture',

@@ -386,6 +386,14 @@ class ServingFrontend:
   def _slo_shed_feed(self, reason: str, waited_ms: float) -> None:
     self.slo.observe(waited_ms, ok=False)
 
+  def quiesced(self) -> bool:
+    """No queued work and no in-flight coalesced run — the drain
+    point a planned retirement (elastic scale-in, ISSUE 19) waits for
+    after flipping the admission door to draining: past it, shutdown
+    resolves nothing but the already-empty queue."""
+    return self.admission.depth() == 0 \
+        and self._in_flight_snapshot() == 0
+
   # -- observability --------------------------------------------------------
   def _in_flight_snapshot(self) -> int:
     with self._lock:

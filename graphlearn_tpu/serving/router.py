@@ -14,7 +14,12 @@ invisible to callers:
     weight, because a slow replica still serves) / ``draining``
     (mid-hot-swap — skipped for new traffic, NOT evicted) / ``dead``
     (consecutive heartbeat misses — evicted).  A replica that comes
-    back (a flap) is re-admitted on its next good heartbeat.
+    back (a flap) is re-admitted on its next good heartbeat — unless
+    it flapped dead→healthy ≥3 times inside
+    ``GLT_FLEET_FLAP_WINDOW_S``, in which case it is ``quarantined``
+    (weight 0, typed in ``stats()['quarantined']``) and re-admitted
+    only after an exponential backoff: a flapping heartbeat must not
+    keep absorbing redrives it will lose again (ISSUE 19).
   * **exactly-once redrive** — every routed request sits in an
     in-flight ledger until its future resolves.  When a replica is
     evicted, its unresolved requests are REDRIVEN onto a survivor —
@@ -37,7 +42,9 @@ kill-one-replica-mid-bench acceptance run (`bench_serving --fleet`).
 
 Knobs: ``GLT_FLEET_HEARTBEAT_MS`` (monitor cadence),
 ``GLT_FLEET_OVERLOAD_RATIO`` (queue-depth fraction classified
-overloaded) — benchmarks/README "Fleet serving & failover (r14)".
+overloaded) — benchmarks/README "Fleet serving & failover (r14)" —
+and ``GLT_FLEET_FLAP_WINDOW_S`` (the flap-damping window,
+benchmarks/README "Elastic autoscaling & planned handoff (r20)").
 """
 from __future__ import annotations
 
@@ -55,22 +62,34 @@ from .engine import ServingResult
 
 HEARTBEAT_ENV = 'GLT_FLEET_HEARTBEAT_MS'
 OVERLOAD_ENV = 'GLT_FLEET_OVERLOAD_RATIO'
+FLAP_WINDOW_ENV = 'GLT_FLEET_FLAP_WINDOW_S'
 
 DEFAULT_HEARTBEAT_MS = 200.0
 DEFAULT_OVERLOAD_RATIO = 0.8
+DEFAULT_FLAP_WINDOW_S = 10.0
+
+#: dead→healthy readmits inside the flap window before quarantine
+_FLAP_QUARANTINE_COUNT = 3
 
 #: replica states (the classification vocabulary of `check_replicas`)
-REPLICA_STATES = ('healthy', 'overloaded', 'draining', 'dead')
+REPLICA_STATES = ('healthy', 'overloaded', 'draining', 'quarantined',
+                  'dead')
 
 #: scheduling weight per state: healthy replicas are picked 4x as
-#: often as overloaded ones; draining/dead get no new traffic
+#: often as overloaded ones; draining/quarantined/dead get no new
+#: traffic
 _STATE_WEIGHT = {'healthy': 4, 'overloaded': 1, 'draining': 0,
-                 'dead': 0}
+                 'quarantined': 0, 'dead': 0}
 
 
 def heartbeat_ms_from_env() -> float:
   from .admission import _env_pos
   return _env_pos(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_MS, float)
+
+
+def flap_window_s_from_env() -> float:
+  from .admission import _env_pos
+  return _env_pos(FLAP_WINDOW_ENV, DEFAULT_FLAP_WINDOW_S, float)
 
 
 def overload_ratio_from_env() -> float:
@@ -236,13 +255,19 @@ class RouterFuture:
   if the router redrives the request onto a survivor mid-wait, the
   wait transparently moves to the new replica's future; a terminal
   router decision (`FailoverExhausted`) raises typed.  Resolves
-  exactly once from the caller's point of view."""
+  exactly once from the caller's point of view.
 
-  __slots__ = ('_router', '_rid')
+  ``done_monotonic`` mirrors `ServingFuture`'s resolve stamp so
+  open-loop drivers measure scheduled-arrival latency through the
+  router too; it must be CAPTURED at resolve (`result` consumes the
+  ledger entry — the inner future is unreachable afterwards)."""
+
+  __slots__ = ('_router', '_rid', 'done_monotonic')
 
   def __init__(self, router: 'FleetRouter', rid: int):
     self._router = router
     self._rid = rid
+    self.done_monotonic: Optional[float] = None
 
   def done(self) -> bool:
     entry = self._router._entry(self._rid)
@@ -275,6 +300,8 @@ class RouterFuture:
       except BaseException:
         self._router._finish(self._rid, 'error')
         raise
+      self.done_monotonic = (getattr(entry.inner, 'done_monotonic',
+                                     None) or time.monotonic())
       self._router._finish(self._rid, 'ok')
       return res
 
@@ -292,6 +319,11 @@ class FleetRouter:
       overloaded (alive but struggling — reduced weight, not evicted:
       the overloaded-vs-dead discriminator).
     dead_after: consecutive heartbeat misses before eviction.
+    flap_window_s: sliding window for flap damping (≥3 dead→healthy
+      readmits inside it quarantines the replica; else
+      ``GLT_FLEET_FLAP_WINDOW_S``).
+    quarantine_backoff_s: base of the exponential re-admit backoff
+      (doubles per quarantine of the same replica).
     auto_start: run the heartbeat monitor thread.  Tests pass False
       and pump `check_replicas` deterministically.
   """
@@ -300,16 +332,17 @@ class FleetRouter:
                overload_ratio: Optional[float] = None,
                slow_ms: float = 250.0, dead_after: int = 2,
                abandon_grace_s: float = 300.0,
+               flap_window_s: Optional[float] = None,
+               quarantine_backoff_s: float = 1.0,
                auto_start: bool = True):
     if not replicas:
       raise ValueError('FleetRouter needs at least one replica')
     self._lock = threading.Lock()
     #: replica table: name -> {'handle', 'state', 'misses', 'hb',
-    #: 'hb_ms'} (the router's one source of routing truth)
+    #: 'hb_ms', 'readmits', 'quarantines', 'quarantine_until'} (the
+    #: router's one source of routing truth)
     self._replicas: Dict[str, dict] = {  # guarded-by: self._lock
-        r.name: {'handle': r, 'state': 'healthy', 'misses': 0,
-                 'hb': None, 'hb_ms': None}
-        for r in replicas}
+        r.name: self._new_entry(r) for r in replicas}
     if len(self._replicas) != len(replicas):
       raise ValueError('replica names must be unique')
     #: in-flight redrive ledger: rid -> _LedgerEntry, pruned on
@@ -336,6 +369,10 @@ class FleetRouter:
     self.resolved = {'ok': 0, 'shed': 0, 'error': 0}
     self.redriven = 0               # guarded-by: self._lock
     self.evictions = 0              # guarded-by: self._lock
+    self.quarantines = 0            # guarded-by: self._lock
+    self.flap_window_s = (flap_window_s if flap_window_s is not None
+                          else flap_window_s_from_env())
+    self.quarantine_backoff_s = float(quarantine_backoff_s)
     self._rebuild_cycle_locked()
     self._closed = False
     self._monitor: Optional[threading.Thread] = None
@@ -345,6 +382,7 @@ class FleetRouter:
     from ..telemetry.live import live
     self._m_redrives = live.counter('fleet.redrives_total')
     self._m_evictions = live.counter('fleet.evictions_total')
+    self._m_quarantines = live.counter('fleet.quarantines_total')
     self._gauge_regs = []
     for st in REPLICA_STATES:
       fn = self._state_count_fn(st)
@@ -382,6 +420,46 @@ class FleetRouter:
           h.close()
         except Exception:           # noqa: BLE001 — best-effort
           pass
+
+  @staticmethod
+  def _new_entry(handle) -> dict:
+    return {'handle': handle, 'state': 'healthy', 'misses': 0,
+            'hb': None, 'hb_ms': None, 'readmits': [],
+            'quarantines': 0, 'quarantine_until': 0.0}
+
+  # -- elastic membership ---------------------------------------------------
+  def add_replica(self, handle) -> None:
+    """Admit a new replica into rotation (the elastic scale-out seam,
+    ISSUE 19).  The caller verifies health/warmth FIRST — the
+    `ElasticController` only calls this after a good heartbeat and
+    the ``compile_count()==0`` warm pin — so the replica enters the
+    cycle at full weight immediately."""
+    with self._lock:
+      if handle.name in self._replicas:
+        raise ValueError(f'replica {handle.name!r} already registered')
+      self._replicas[handle.name] = self._new_entry(handle)
+      self._rebuild_cycle_locked()
+
+  def remove_replica(self, name: str):
+    """Retire a replica from rotation (elastic scale-in): pops its
+    table entry and redrives anything still stranded in its lane onto
+    survivors (a properly quiesced drain leaves nothing).  Returns
+    the handle (the caller owns shutdown), None if unknown."""
+    with self._lock:
+      ent = self._replicas.pop(name, None)
+      if ent is None:
+        return None
+      self._rebuild_cycle_locked()
+      stranded = [e for e in self._ledger.values()
+                  if e.replica == name and e.error is None
+                  and not e.inner.done()]
+    moved = 0
+    for entry in stranded:
+      if self._redrive(entry, lost=name):
+        moved += 1
+    recorder.emit('serving.failover', replica=name, event='retire',
+                  state='removed', redriven=moved)
+    return ent['handle']
 
   def _monitor_loop(self) -> None:
     while not self._closed:
@@ -459,12 +537,13 @@ class FleetRouter:
                              name, inner, trace=trace)
         self._ledger[rid] = entry
         self.submitted += 1
-        # close the submit/evict race: if the replica was evicted
-        # BETWEEN handle.submit and this insert, the eviction's
-        # stranded snapshot missed the entry — redrive it ourselves
-        # (outside the lock), or its future would freeze forever
+        # close the submit/evict race: if the replica was evicted (or
+        # elastically REMOVED) BETWEEN handle.submit and this insert,
+        # the eviction's stranded snapshot missed the entry — redrive
+        # it ourselves (outside the lock), or its future would freeze
+        # forever
         ent = self._replicas.get(name)
-        evicted_in_window = ent is not None and ent['state'] == 'dead'
+        evicted_in_window = ent is None or ent['state'] == 'dead'
       if evicted_in_window and not inner.done():
         self._redrive(entry, lost=name)
       return RouterFuture(self, rid)
@@ -572,6 +651,7 @@ class FleetRouter:
         # eviction's redrive sweep finds nothing stranded).
         self._note_miss(name)
         continue
+      now = time.monotonic()
       with self._lock:
         ent = self._replicas.get(name)
         if ent is None:
@@ -580,10 +660,40 @@ class FleetRouter:
         ent['hb'] = hb
         ent['hb_ms'] = round(hb_ms, 3)
         was = ent['state']
+        if was == 'quarantined' and now < ent['quarantine_until']:
+          continue                   # backoff running: a good beat
+          # does NOT re-admit yet — that free readmit is the flap
+          # churn the damper exists to stop
         ent['state'] = self._classify_locked(ent, hb, hb_ms)
+        readmitted = was in ('dead', 'quarantined') \
+            and ent['state'] != 'dead'
+        quarantined = False
+        if readmitted and was == 'dead':
+          # flap damping (ISSUE 19): count dead→live readmits in the
+          # sliding window; at the threshold, quarantine with an
+          # exponential backoff (doubling per quarantine).  The
+          # readmit history is NOT cleared on quarantine — window
+          # pruning ages it out, so a replica that flaps again right
+          # after re-admission re-quarantines immediately, backing
+          # off further each time.
+          ent['readmits'] = [t for t in ent['readmits']
+                             if now - t <= self.flap_window_s]
+          ent['readmits'].append(now)
+          if len(ent['readmits']) >= _FLAP_QUARANTINE_COUNT:
+            ent['state'] = 'quarantined'
+            ent['quarantines'] += 1
+            ent['quarantine_until'] = now + self.quarantine_backoff_s \
+                * (2 ** (ent['quarantines'] - 1))
+            self.quarantines += 1
+            quarantined = True
+            readmitted = False
         self._rebuild_cycle_locked()
-        readmitted = was == 'dead' and ent['state'] != 'dead'
-      if readmitted:
+      if quarantined:
+        self._m_quarantines.inc()
+        recorder.emit('serving.failover', replica=name,
+                      event='quarantine', state='quarantined',
+                      redriven=0)
+      elif readmitted:
         recorder.emit('serving.failover', replica=name,
                       event='readmit', state=ent['state'],
                       redriven=0)
@@ -602,6 +712,22 @@ class FleetRouter:
   def replica_states(self) -> Dict[str, str]:
     with self._lock:
       return {n: e['state'] for n, e in self._replicas.items()}
+
+  def heartbeats(self) -> Dict[str, dict]:
+    """Per-replica state + last heartbeat ``serving`` block — the
+    `ElasticController`'s signal feed (SLO burn windows, queue depth,
+    headroom) read off the monitor's existing polls, no extra RPCs."""
+    with self._lock:
+      return {n: {'state': e['state'],
+                  'serving': (e['hb'] or {}).get('serving')}
+              for n, e in self._replicas.items()}
+
+  def get_replica(self, name: str):
+    """The named replica's handle (None if unknown) — the scale-in
+    path drains/retires through it."""
+    with self._lock:
+      ent = self._replicas.get(name)
+      return ent['handle'] if ent else None
 
   # -- failover -------------------------------------------------------------
   def _evict(self, name: str) -> None:
@@ -697,6 +823,7 @@ class FleetRouter:
           'swept': self.swept,
           'redriven': self.redriven,
           'evictions': self.evictions,
+          'quarantined': self.quarantines,
       }
 
   def make_scraper(self, registry=None, include_self: bool = True,

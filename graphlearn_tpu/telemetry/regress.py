@@ -158,6 +158,23 @@ METRICS: Tuple[Tuple, ...] = (
     # kernel row is reported alongside, unguarded until a TPU baseline
     # lands)
     ('pallas.delta_merge_events_per_sec', 'higher'),
+    # elastic-autoscaling guards (ISSUE 19, bench_autoscale.py): the
+    # diurnal open loop's p99 with the ElasticController closing the
+    # loop must not erode vs its own history (the hold-vs-static gate
+    # is the worker's nonzero exit, stamped into autoscale_pin)
+    ('dist.autoscale.p99_held_ms', 'lower'),
+    # SLO burn outside the chaos incident window, pinned against the
+    # FIXED burn budget of 1.0 with zero tolerance — the gate reads
+    # exactly "burn_max < 1 outside the incident", never a drifting
+    # recorded baseline
+    ('dist.autoscale.burn_max', 'lower',
+     {'threshold': 0.0, 'pin_baseline': 1.0}),
+    # planned-handoff degraded window, pinned to ZERO: cur/0.5 - 1
+    # > 0 the moment even one batch degrades across the cutover —
+    # the whole point of fence-then-one-bump is that this is 0, not
+    # merely small
+    ('dist.autoscale.handoff_degraded_batches', 'lower',
+     {'threshold': 0.0, 'pin_baseline': 0.5}),
 )
 
 

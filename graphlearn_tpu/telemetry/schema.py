@@ -143,7 +143,8 @@ EVENT_KINDS: Dict[str, str] = {
         'snapshot + health) was written to GLT_POSTMORTEM_DIR',
     'serving.failover':
         'serving.router.FleetRouter: replica, event '
-        '(evict|redrive|readmit|exhausted), redriven (in-flight '
+        '(evict|redrive|readmit|exhausted|quarantine|retire), '
+        'redriven (in-flight '
         'requests moved to a survivor on evict), state — one event '
         'per fleet state transition / redrive wave, so a failover '
         'reads out of the same stream as the chaos faults that '
@@ -191,8 +192,22 @@ EVENT_KINDS: Dict[str, str] = {
         'partition, survivor, version, secs (phase=recovered rows '
         'carry the classification→served-batch recovery clock)',
     'partition.book_version':
-        'PartitionBook.adopt: version, lost, survivor, num_lanes — '
-        'one per ownership transfer, the routing authority moving',
+        'PartitionBook.adopt/.transfer: version, lost, survivor, '
+        'num_lanes, planned (True = scheduled handoff cutover, not a '
+        'crash adoption) — one per ownership transfer, the routing '
+        'authority moving',
+    'handoff.transfer':
+        'parallel.handoff.handoff: partition, frm, to, phase '
+        '(snapshot|transfer|fence|cutover|drain|rollback), version, '
+        'secs, error (rollback cause / absorbed drain fault) — one '
+        'event per seam of a planned ownership move, so a handoff '
+        'reads out of the flight recorder end to end',
+    'scale.decision':
+        'serving.autoscaler.ElasticController: dir (out|in), outcome '
+        '(ok|rolled_back|held:cooldown|held:bounds|held:no_victim), '
+        'replica, error, plus the signal snapshot that justified it '
+        '(replicas, short_burn, long_burn, queue_frac, headroom_qps) '
+        '— every considered scaling decision, acted or held',
     'pallas.dispatch':
         'r19 kernel gates (ops.pallas_sample.sample_one_hop_auto, '
         'data.cold_cache.make_pinned_cold_buffer, streaming.delta.'
@@ -422,8 +437,8 @@ METRIC_NAMES: Dict[str, str] = {
         'counter: post-mortem bundles written to GLT_POSTMORTEM_DIR',
     'fleet.replicas':
         'gauge: FleetRouter replica count by state, labeled '
-        'state=healthy|overloaded|draining|dead (scrape-time '
-        'evaluation off the replica table)',
+        'state=healthy|overloaded|draining|quarantined|dead '
+        '(scrape-time evaluation off the replica table)',
     'fleet.redrives_total':
         'counter: in-flight requests redriven from a lost replica '
         'onto a survivor (each redriven at most once — the '
@@ -432,6 +447,15 @@ METRIC_NAMES: Dict[str, str] = {
         'counter: replicas evicted from rotation after consecutive '
         'heartbeat misses (flapped replicas that return are '
         're-admitted and counted again on a later eviction)',
+    'fleet.quarantines_total':
+        'counter: replicas quarantined by the flap damper (≥3 '
+        'dead→healthy readmits inside GLT_FLEET_FLAP_WINDOW_S) — '
+        're-admission waits out an exponential backoff, doubling '
+        'per quarantine of the same replica',
+    'scale.replicas':
+        'counter: ElasticController scaling actions executed, '
+        'labeled dir=out|in (each tick = one replica admitted to / '
+        'retired from rotation; rolled-back decisions do not tick)',
     'serving.swaps_total':
         'counter: hot model-swap attempts, labeled '
         'outcome=ok|rolled_back|aborted (rolled_back = '
@@ -540,7 +564,10 @@ METRIC_LABELS: Dict[str, str] = {
         'length)',
     'state':
         'FleetRouter replica state: healthy|overloaded|draining|'
-        'dead (fixed four-state machine)',
+        'quarantined|dead (fixed five-state machine)',
+    'dir':
+        'ElasticController scale direction: out|in (the two-way '
+        'vocabulary of scale.replicas)',
     'reason':
         'admission shed reason: queue_full|deadline|too_large|'
         'draining|shutdown (the typed rejection vocabulary)',

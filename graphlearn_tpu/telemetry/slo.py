@@ -157,8 +157,13 @@ class SloTracker:
                      if not ok or (self.p99_target_ms > 0
                                    and lat > self.p99_target_ms))
     count = len(samples)
+    # empty/idle windows and zero-budget trackers read burn 0.0, never
+    # NaN or a division error — the ElasticController's first
+    # evaluation after admitting a fresh replica depends on it
+    # (ISSUE 19: an idle replica must not look like it is burning)
     burn = ((violations / count) / self.budget
-            if count and self.p99_target_ms > 0 else 0.0)
+            if count and self.p99_target_ms > 0 and self.budget > 0
+            else 0.0)
 
     def q(p: float) -> float:
       if not ok_lats:
@@ -186,7 +191,8 @@ class SloTracker:
         count += 1
         if not ok or lat > self.p99_target_ms:
           violations += 1
-    burn = (violations / count) / self.budget if count else 0.0
+    burn = ((violations / count) / self.budget
+            if count and self.budget > 0 else 0.0)
     return count, burn
 
   def _evaluate_burn(self, now: float) -> None:
@@ -223,7 +229,11 @@ class SloTracker:
     lock-acquisition test in ``tests/test_timeseries.py``)."""
     now = self._clock()
     entry = self._stats_cache.get(window)
-    if entry is not None and now - entry[0] < 0.02:
+    # the entry is stale both past 20 ms AND when the clock moved
+    # BACKWARDS (an injected test clock rewound, or a new tracker
+    # reusing the memo after its predecessor): a frozen entry from
+    # the future would otherwise be served forever
+    if entry is not None and 0 <= now - entry[0] < 0.02:
       return entry[1]
     st = self.window_stats(window, now)
     self._stats_cache[window] = (now, st)

@@ -102,6 +102,29 @@ wall clocks involved).  Sites and actions:
       (raise :class:`ChaosKilledError` mid-compaction — the previous
       snapshot + the full WAL stay the durable truth; a restart
       replays to the identical graph).
+  ``scale.spawn``
+      Seam inside the ElasticController's scale-out path
+      (`serving.autoscaler`), fired once per spawn attempt BEFORE the
+      replica factory runs.  Actions: ``delay`` (a slow provision —
+      sleeps in place, the evaluation loop stalls but nothing is
+      admitted half-built), ``fail`` (raise :class:`InjectedFault` —
+      provisioning died), ``kill`` (raise :class:`ChaosKilledError` —
+      the spawn died mid-flight).  Either raise must roll the decision
+      back typed (no partial replica in rotation) and re-arm: the
+      cooldown is NOT spent on a failed decision.
+  ``handoff.transfer``
+      Seam inside the planned partition handoff (`parallel.handoff`),
+      fired once per phase with ``op`` = the seam name (``snapshot`` /
+      ``transfer`` / ``fence`` / ``cutover`` / ``drain``) and
+      ``partition`` = the moving range.  Actions: ``delay`` (sleeps in
+      place — the source keeps serving throughout, that is the zero-
+      degraded-window contract), ``fail`` (raise
+      :class:`InjectedFault`), ``kill`` (raise
+      :class:`ChaosKilledError`).  A raise at any seam BEFORE
+      ``cutover`` unwinds to clean source retention (book untouched,
+      staged shard dropped, typed `HandoffAbortedError`); at ``drain``
+      the cutover has already published, so the destination owns the
+      range — never two owners either way.
 
 Plans install three ways: programmatically (:func:`install`), from the
 ``GLT_FAULT_PLAN`` env var (inherited by producer subprocesses and
@@ -145,7 +168,8 @@ WORKER_KILL_EXIT = 173
 _SITES = ('rpc.request', 'producer.worker', 'checkpoint.io',
           'fused.dispatch', 'feature.cold_service', 'serving.request',
           'ops.scrape', 'serving.replica', 'aot.cache', 'ingest.wal',
-          'ingest.apply', 'ingest.compact', 'partition.owner')
+          'ingest.apply', 'ingest.compact', 'partition.owner',
+          'scale.spawn', 'handoff.transfer')
 _ACTIONS = ('drop', 'delay', 'corrupt', 'kill', 'fail', 'truncate',
             'flap')
 
@@ -513,6 +537,45 @@ def ingest_compact_check(seqno: int = 0) -> None:
     if f.action == 'kill':
       raise ChaosKilledError(
           f'injected ingest compaction kill (seqno {seqno})')
+
+
+def scale_spawn_check(replica: str = '') -> None:
+  """Elastic scale-out seam (`serving.autoscaler`), fired once per
+  spawn attempt before the replica factory runs: ``delay`` sleeps in
+  place (a slow provision), ``fail`` raises `InjectedFault`, ``kill``
+  raises `ChaosKilledError` — both raises must surface as a typed
+  rolled-back `scale.decision` that leaves the fleet unchanged and
+  the cooldown unspent."""
+  fired = on('scale.spawn', replica=replica or None)
+  maybe_delay(fired)
+  for f in fired:
+    if f.action == 'fail':
+      raise InjectedFault(
+          f'injected scale.spawn provisioning failure '
+          f'(replica {replica!r})')
+    if f.action == 'kill':
+      raise ChaosKilledError(
+          f'injected scale.spawn kill (replica {replica!r})')
+
+
+def handoff_transfer_check(seam: str, partition: int = 0) -> None:
+  """Planned-handoff seam (`parallel.handoff`), fired once per phase
+  with ``op`` = the seam name (snapshot/transfer/fence/cutover/drain)
+  and ``partition`` = the moving range: ``delay`` sleeps in place (the
+  source keeps serving — the handoff just takes longer), ``fail``
+  raises `InjectedFault`, ``kill`` raises `ChaosKilledError`.  The
+  caller's rollback ladder turns a pre-cutover raise into clean
+  source retention and absorbs a post-cutover (drain) raise as a
+  completed move — the single-owner invariant either way."""
+  fired = on('handoff.transfer', op=seam, partition=int(partition))
+  maybe_delay(fired)
+  for f in fired:
+    if f.action == 'fail':
+      raise InjectedFault(
+          f'injected handoff {seam} failure (partition {partition})')
+    if f.action == 'kill':
+      raise ChaosKilledError(
+          f'injected handoff {seam} kill (partition {partition})')
 
 
 def serving_request_check(op: str = '', replica: str = '') -> None:

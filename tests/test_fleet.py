@@ -557,3 +557,67 @@ def test_fleet_health_component_reports_per_replica(request):
   router.check_replicas(), router.check_replicas()
   st = router.stats()['replicas']
   assert st['r0']['state'] == 'dead' and st['r1']['state'] == 'healthy'
+
+
+# -- flap damping (ISSUE 19) -------------------------------------------------
+def _flap_once(router, reps, i=0):
+  """One full dead→healthy flap: miss past dead_after, then answer
+  again — returns the state map of the re-admission pass."""
+  reps[i]._flap_until = time.monotonic() + 30.0
+  router.check_replicas()
+  router.check_replicas()                      # dead at dead_after=2
+  reps[i]._flap_until = 0.0
+  return router.check_replicas()
+
+
+def test_three_flaps_quarantine_with_backoff(request):
+  """≥3 dead→healthy readmits inside GLT_FLEET_FLAP_WINDOW_S: the
+  replica is quarantined (zero routing weight, typed in stats), a
+  good heartbeat during the backoff does NOT re-admit it, and after
+  the backoff it returns to rotation.  The readmit history is NOT
+  cleared on quarantine, so an immediate re-flap re-quarantines at a
+  DOUBLED backoff."""
+  from graphlearn_tpu.telemetry.live import live
+  base = live.counter('fleet.quarantines_total').value()
+  router, reps = _fleet(2, auto=(0, 1), flap_window_s=60.0,
+                        quarantine_backoff_s=0.2)
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  assert _flap_once(router, reps)['r0'] == 'healthy'     # flap 1
+  assert _flap_once(router, reps)['r0'] == 'healthy'     # flap 2
+  assert _flap_once(router, reps)['r0'] == 'quarantined'  # flap 3
+  assert router.stats()['quarantined'] == 1
+  assert live.counter('fleet.quarantines_total').value() == base + 1
+  assert [e for e in recorder.events('serving.failover')
+          if e.get('event') == 'quarantine']
+  # zero routing weight: every request lands on the survivor
+  before = reps[0].frontend.admission.admitted
+  futs = [router.submit([i % N]) for i in range(6)]
+  for f in futs:
+    f.result(20.0)
+  assert reps[0].frontend.admission.admitted == before
+  # a good heartbeat during the backoff does NOT re-admit — that
+  # free readmit is the churn the damper exists to stop
+  assert router.check_replicas()['r0'] == 'quarantined'
+  time.sleep(0.25)                            # backoff 0.2s expires
+  assert router.check_replicas()['r0'] == 'healthy'
+  assert [e for e in recorder.events('serving.failover')
+          if e.get('event') == 'readmit']
+  # re-flap right after re-admission: the aged-in history
+  # re-quarantines immediately, backing off twice as long
+  assert _flap_once(router, reps)['r0'] == 'quarantined'
+  assert router.stats()['quarantined'] == 2
+  time.sleep(0.25)                            # 0.4s backoff now
+  assert router.check_replicas()['r0'] == 'quarantined'
+  time.sleep(0.25)
+  assert router.check_replicas()['r0'] == 'healthy'
+
+
+def test_slow_flaps_outside_window_never_quarantine(request):
+  """Flaps the window has aged out cost nothing: each one re-admits
+  free, exactly the pre-damping behavior."""
+  router, reps = _fleet(2, auto=(0, 1), flap_window_s=0.01)
+  request.addfinalizer(lambda: router.close(close_replicas=True))
+  for _ in range(4):
+    assert _flap_once(router, reps)['r0'] == 'healthy'
+    time.sleep(0.02)
+  assert router.stats()['quarantined'] == 0

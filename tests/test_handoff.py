@@ -1,0 +1,190 @@
+"""Planned PartitionBook handoff (ISSUE 19): move ownership with a
+zero degraded window.
+
+The contract stack: `book.transfer`'s one-bump cutover and typed
+refusal ladder (the SEPARATE ``_transfers`` ledger leaves the
+crash-adoption ledger shape untouched); the fenced seam ladder of
+`parallel.handoff.handoff` — a mid-epoch handoff completes the epoch
+byte-identical to the no-handoff run with EXACTLY one book bump; a
+chaos kill at any pre-cutover seam unwinds to clean source retention
+(book untouched, nothing staged, the epoch still exact); a drain-seam
+fault is post-cutover and is absorbed.
+"""
+import numpy as np
+import pytest
+
+from graphlearn_tpu.parallel.dist_data import DistDataset
+from graphlearn_tpu.parallel.dist_sampler import DistNeighborLoader
+from graphlearn_tpu.parallel.failover import (NoDurableShardError,
+                                              ShardStore)
+from graphlearn_tpu.parallel.handoff import (SEAMS, HandoffAbortedError,
+                                             handoff)
+from graphlearn_tpu.parallel.partition_book import (AdoptionRefusedError,
+                                                    PartitionBook)
+from graphlearn_tpu.testing import chaos
+
+P = 8
+N, E = 200, 1200
+
+
+def _graph(seed=0):
+  rng = np.random.default_rng(seed)
+  rows = rng.integers(0, N, E)
+  cols = rng.integers(0, N, E)
+  feat = (np.arange(N)[:, None] + np.zeros((1, 6))).astype(np.float32)
+  lab = (np.arange(N) % 4).astype(np.int64)
+  return rows, cols, feat, lab
+
+
+def _dataset(seed=0):
+  rows, cols, feat, lab = _graph(seed)
+  return DistDataset.from_full_graph(P, rows, cols, feat, lab)
+
+
+def _loader(ds, **kw):
+  kw.setdefault('batch_size', 4)
+  kw.setdefault('shuffle', True)
+  kw.setdefault('seed', 0)
+  return DistNeighborLoader(ds, [3, 2], np.arange(N), **kw)
+
+
+def _assert_batches_equal(ref, got, what=''):
+  assert len(ref) == len(got), f'{what}: {len(got)} != {len(ref)}'
+  for i, (a, b) in enumerate(zip(ref, got)):
+    assert np.array_equal(np.asarray(a.node), np.asarray(b.node)), \
+        f'{what}: node differs at batch {i}'
+    assert np.array_equal(np.asarray(a.x), np.asarray(b.x)), \
+        f'{what}: x differs at batch {i}'
+    assert np.array_equal(np.asarray(a.y), np.asarray(b.y)), \
+        f'{what}: y differs at batch {i}'
+    assert np.array_equal(np.asarray(a.edge_index),
+                          np.asarray(b.edge_index)), \
+        f'{what}: edge_index differs at batch {i}'
+
+
+# -- the cutover primitive: book.transfer -----------------------------------
+
+def test_book_transfer_one_bump_separate_ledger():
+  book = PartitionBook(np.arange(P + 1) * 10)
+  v0 = book.view()
+  v1 = book.transfer(3, 3, 5)
+  # RCU: the pinned old view is untouched; ONE version bump total
+  assert v0.version == 0 and int(v0.owners[3]) == 3
+  assert v1.version == 1 and int(v1.owners[3]) == 5
+  assert book.version == 1
+  # the planned move records into its OWN ledger — the crash-adoption
+  # ledger shape (test-frozen) stays untouched
+  assert book.transfers() == [{'range': 3, 'frm': 3, 'to': 5,
+                               'version': 1}]
+  assert book.adoptions() == []
+
+
+def test_book_transfer_refusal_ladder():
+  book = PartitionBook(np.arange(P + 1))
+  # out-of-range / self-handoff refuse before any mutation
+  with pytest.raises(AdoptionRefusedError, match='out of range'):
+    book.transfer(99, 99, 0)
+  with pytest.raises(AdoptionRefusedError, match='itself'):
+    book.transfer(3, 5, 5)
+  # stale source: the caller's claimed owner must BE the owner
+  with pytest.raises(AdoptionRefusedError, match='stale handoff'):
+    book.transfer(3, 4, 5)
+  # a range already served off-owner cannot move again in v1
+  book.adopt(3, 5)
+  with pytest.raises(AdoptionRefusedError, match='off-owner'):
+    book.transfer(3, 5, 6)
+  # the destination must be alive ...
+  with pytest.raises(AdoptionRefusedError, match='itself dead'):
+    book.transfer(1, 1, 3)
+  # ... and must not already carry an extra lane
+  with pytest.raises(AdoptionRefusedError, match='already carries'):
+    book.transfer(1, 1, 5)
+  assert book.version == 1          # refusals never mutated the book
+  assert book.transfers() == []
+
+
+def test_handoff_requires_durable_store(monkeypatch):
+  monkeypatch.delenv('GLT_SHARD_DIR', raising=False)
+  ds = _dataset()
+  with pytest.raises(NoDurableShardError, match='GLT_SHARD_DIR'):
+    handoff(ds, 3, 5)
+  assert ds.partition_book.version == 0
+
+
+# -- the fenced seam ladder -------------------------------------------------
+
+def test_mid_epoch_handoff_byte_identical(tmp_path):
+  """The tentpole pin: a handoff fired mid-epoch completes the epoch
+  byte-identical to the fault-free run, with EXACTLY one book bump
+  and one seam event per ladder phase — zero degraded window."""
+  from graphlearn_tpu.telemetry.recorder import recorder
+  ref = list(_loader(_dataset()))
+
+  ds = _dataset()
+  loader = _loader(ds)
+  it = iter(loader)
+  got = [next(it) for _ in range(3)]
+  recorder.enable(None)
+  recorder.clear()
+  try:
+    info = handoff(ds, 3, 5, store=ShardStore(tmp_path / 'shards'))
+  finally:
+    events = recorder.events('handoff.transfer')
+    recorder.disable()
+    recorder.clear()
+  got.extend(it)
+
+  _assert_batches_equal(ref, got, 'mid-epoch handoff')
+  assert info['frm'] == 3 and info['to'] == 5
+  assert info['version'] == 1 and info['drain_fault'] is None
+  book = ds.partition_book
+  assert book.version == 1                     # EXACTLY one bump
+  assert int(book.view().owners[3]) == 5
+  assert book.transfers() == [{'range': 3, 'frm': 3, 'to': 5,
+                               'version': 1}]
+  assert book.adoptions() == []                # not a crash adoption
+  assert 3 in ds.adopted_shards                # staged shard serves
+  assert [e['phase'] for e in events] == list(SEAMS)
+
+
+@pytest.mark.parametrize('seam', ('snapshot', 'transfer', 'fence',
+                                  'cutover'))
+def test_pre_cutover_kill_unwinds_to_source(tmp_path, seam):
+  """A chaos kill at any seam BEFORE cutover aborts typed with the
+  book untouched and nothing staged — and the epoch then completes
+  byte-identical on the retained source."""
+  ref = list(_loader(_dataset()))
+  ds = _dataset()
+  loader = _loader(ds)
+  it = iter(loader)
+  got = [next(it) for _ in range(3)]
+  chaos.install(f'handoff.transfer:kill:1:op={seam}')
+  try:
+    with pytest.raises(HandoffAbortedError) as ei:
+      handoff(ds, 3, 5, store=ShardStore(tmp_path / 'shards'))
+  finally:
+    chaos.uninstall()
+  assert ei.value.seam == seam
+  book = ds.partition_book
+  assert book.version == 0                     # book untouched
+  assert int(book.view().owners[3]) == 3       # source retains
+  assert book.transfers() == []
+  assert not getattr(ds, 'adopted_shards', {})  # nothing staged
+  got.extend(it)
+  _assert_batches_equal(ref, got, f'{seam}-seam abort')
+
+
+def test_drain_fault_absorbed(tmp_path):
+  """A drain-seam fault is post-cutover: the destination already owns
+  the range, so the move STANDS and the fault is recorded, not
+  raised."""
+  ds = _dataset()
+  chaos.install('handoff.transfer:fail:1:op=drain')
+  try:
+    info = handoff(ds, 3, 5, store=ShardStore(tmp_path / 'shards'))
+  finally:
+    chaos.uninstall()
+  assert info['version'] == 1
+  assert 'InjectedFault' in info['drain_fault']
+  assert ds.partition_book.version == 1
+  assert int(ds.partition_book.view().owners[3]) == 5
