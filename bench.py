@@ -1607,6 +1607,34 @@ def main():
             'hot_range_coverage': att.get('hot_range_coverage'),
             'hotness_source': att.get('hotness_source'),
         }
+      # lift the P=16 row's locality comparison (ISSUE 20) the same
+      # way: dist.locality.cross_partition_bytes_frac (lower) and
+      # dist.locality.seeds_per_sec (higher) are regression-guarded,
+      # each with `same: dist.locality.partitioner` so a partitioner
+      # change resets the baseline instead of tripping the gate
+      loc = next((r['locality'] for r in env_rows
+                  if r.get('num_parts') == 16
+                  and isinstance(r.get('locality'), dict)
+                  and isinstance(r['locality'].get('locality'), dict)),
+                 None)
+      if loc:
+        arm = loc['locality']
+        dist['locality'] = {
+            'num_parts': 16,
+            'partitioner': arm.get('partitioner'),
+            'cross_partition_bytes_frac': arm.get(
+                'cross_partition_bytes_frac'),
+            'cross_partition_ids_frac': arm.get(
+                'cross_partition_ids_frac'),
+            'locally_served_ids': arm.get('locally_served_ids'),
+            'seeds_per_sec': arm.get('seeds_per_sec'),
+            'drop_rate_pct': arm.get('drop_rate_pct'),
+            'range_cross_partition_bytes_frac': loc.get(
+                'range', {}).get('cross_partition_bytes_frac'),
+            'locality_over_range_speedup': loc.get(
+                'locality_over_range_speedup'),
+            'rename_equivalent': loc.get('rename_equivalent'),
+        }
       emit()
 
   # phase 3d — resilience smoke (ISSUE 4): the host server->client

@@ -199,6 +199,138 @@ def _epoch_exchange_rows(loader, epochs: int, batch: int,
   return n_seeds, rows
 
 
+def _locality_comparison(num_parts: int, rows, cols, num_nodes: int,
+                         batch: int, mesh, rng, epochs: int = 4,
+                         dim: int = 256):
+  """Locality-aware partitioning x exchange co-design probe (ISSUE 20).
+
+  The envelope's headline homo run is featureless (frontier exchange
+  only), so it cannot see the feature plane the locality work targets.
+  This sub-run re-runs the same graph FEATURED (``collect_features=
+  True`` — the feature attribution matrix ticks) under two arms that
+  differ ONLY in the partitioner:
+
+    * ``range``    — the historical seeded round-robin placement;
+    * ``locality`` — the streaming edge-cut minimizer plus the full
+      co-design: replica cache (hot remote rows served locally) and
+      EWMA capacity retune at the epoch seam.
+
+  Per-arm ``cross_partition_bytes_frac`` / ``seeds_per_sec`` are what
+  the ``dist.locality.*`` regression guards read (headline = final
+  epoch, after the EWMA retune recompile has settled).  The
+  ``rename_equivalent`` bool replays the locality arm's relabel as an
+  explicit-``node_pb`` build in the renamed id space and checks one
+  epoch of batches byte-identical — the pure-rename contract.
+  """
+  import os
+  import time
+  import jax
+  from graphlearn_tpu.parallel import DistDataset, DistNeighborLoader
+  feats = np.random.default_rng(2).standard_normal(
+      (num_nodes, dim)).astype(np.float32)
+  seeds = rng.integers(0, num_nodes, batch * num_parts * 8)
+  res = {}
+  ds_loc = None
+  for arm in ('range', 'locality'):
+    saved = {k: os.environ.pop(k, None)
+             for k in ('GLT_EXCHANGE_EWMA', 'GLT_PARTITIONER',
+                       'GLT_LOCALITY_REPLICA_FRAC')}
+    os.environ['GLT_EXCHANGE_EWMA'] = '1'   # both arms: same config
+    try:
+      ds = DistDataset.from_full_graph(
+          num_parts, rows, cols, node_feat=feats, num_nodes=num_nodes,
+          partitioner=arm,
+          replica_frac=(0.35 if arm == 'locality' else None))
+      loader = DistNeighborLoader(ds, [5, 5], seeds, batch_size=batch,
+                                  shuffle=True, mesh=mesh,
+                                  collect_features=True, seed=0,
+                                  exchange_slack=1.25)
+      if arm == 'locality':
+        ds_loc = ds
+      rates = []
+      last = None
+      nb = 0
+      for ep in range(epochs):
+        t0 = time.perf_counter()
+        nb = 0
+        for b in loader:
+          last = b
+          nb += 1
+        jax.block_until_ready(last)
+        rates.append(round(nb * batch * num_parts
+                           / (time.perf_counter() - t0), 1))
+      # headline rate: one re-timed window over the FINAL capacity
+      # program (the early epochs pay compiles + the EWMA retune
+      # recompiles; per-epoch batch counts are small enough that a
+      # single epoch is noisy)
+      t0 = time.perf_counter()
+      for _ in range(2):
+        for b in loader:
+          last = b
+      jax.block_until_ready(last)
+      steady = round(2 * nb * batch * num_parts
+                     / (time.perf_counter() - t0), 1)
+      att = loader.sampler.attribution_stats(tick_metrics=False)
+      st = loader.sampler.exchange_stats(tick_metrics=False)
+      res[arm] = {
+          'partitioner': getattr(ds, 'partitioner', arm),
+          'cross_partition_bytes_frac':
+              att['cross_partition_bytes_frac'],
+          'cross_partition_ids_frac': att['cross_partition_ids_frac'],
+          'locally_served_ids': att.get('locally_served_ids', 0),
+          'seeds_per_sec': steady,
+          'seeds_per_sec_by_epoch': rates,
+          'drop_rate_pct': round(
+              100.0 * st['dist.frontier.dropped']
+              / max(st['dist.frontier.offered'], 1), 3),
+          'feature_drop_rate_pct': round(
+              100.0 * st['dist.feature.dropped']
+              / max(st['dist.feature.offered'], 1), 3),
+      }
+    finally:
+      for k, v in saved.items():
+        if v is None:
+          os.environ.pop(k, None)
+        else:
+          os.environ[k] = v
+  res['locality_over_range_speedup'] = round(
+      res['locality']['seeds_per_sec']
+      / max(res['range']['seeds_per_sec'], 1e-9), 3)
+  # pure-rename contract: rebuild the locality arm's placement as an
+  # explicit node_pb over the ALREADY-relabeled edge list — the
+  # relabel must come out the identity and one epoch byte-identical
+  o2n, n2o = ds_loc.old2new, ds_loc.new2old
+  pb_new = (np.searchsorted(ds_loc.graph.bounds, np.arange(num_nodes),
+                            'right') - 1).astype(np.int32)
+  # the twin must carry the SAME replica cache (hotness = in-degree,
+  # expressed in its own id space): the masked gather changes which
+  # ids compete for exchange slots, so a cache-less twin can drop
+  # rows the replica arm serves locally
+  ds_ren = DistDataset.from_full_graph(
+      num_parts, o2n[rows], o2n[cols], node_feat=feats[n2o],
+      num_nodes=num_nodes, node_pb=pb_new, replica_frac=0.35,
+      hotness=np.bincount(o2n[cols], minlength=num_nodes))
+  la = DistNeighborLoader(ds_loc, [5, 5], seeds, batch_size=batch,
+                          shuffle=True, mesh=mesh,
+                          collect_features=True, seed=0,
+                          exchange_slack=1.25)
+  lb = DistNeighborLoader(ds_ren, [5, 5], o2n[seeds], batch_size=batch,
+                          shuffle=True, mesh=mesh,
+                          collect_features=True, seed=0,
+                          exchange_slack=1.25)
+  equivalent = bool(np.array_equal(ds_ren.old2new,
+                                   np.arange(num_nodes)))
+  for ba, bb in zip(la, lb):
+    for f in ('node', 'x', 'edge_index', 'batch'):
+      if not np.array_equal(np.asarray(jax.device_get(getattr(ba, f))),
+                            np.asarray(jax.device_get(getattr(bb, f)))):
+        equivalent = False
+    if not equivalent:
+      break
+  res['rename_equivalent'] = equivalent
+  return res
+
+
 def envelope_worker(num_parts: int, mode: str, batch: int,
                     num_nodes: int, epochs: int = 5):
   """Scale-envelope probe at ``num_parts`` VIRTUAL devices (VERDICT r3
@@ -280,6 +412,11 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
   st = loader.sampler.exchange_stats(tick_metrics=False)
   sent = st['dist.frontier.offered'] - st['dist.frontier.dropped']
   out.update(
+      # the active partitioner rides on every envelope row so regress
+      # baselines are never compared across a partitioner change
+      # (ISSUE 20; the `same:` opt on the dist.locality.* guards)
+      partitioner=getattr(getattr(loader, 'ds', None), 'partitioner',
+                          None),
       seeds_per_sec=round(n_seeds / dt, 1),
       # headline = converged (final-epoch) exchange state; the
       # trajectory + run-cumulative figures follow
@@ -319,6 +456,15 @@ def envelope_worker(num_parts: int, mode: str, batch: int,
           'frontier_offered': lst['dist.frontier.offered'],
       }
     out['layouts'] = comparison
+    # locality-aware partitioning x exchange co-design (ISSUE 20):
+    # range-vs-locality on the SAME graph, featured so the feature
+    # attribution plane ticks — feeds the dist.locality.* guards
+    try:
+      out['locality'] = _locality_comparison(num_parts, rows, cols,
+                                             num_nodes, batch, mesh,
+                                             rng)
+    except Exception as e:          # never sink the envelope row
+      out['locality_error'] = f'{type(e).__name__}: {e}'
   # the BASELINE north-star memory check rides along on every
   # envelope row (VERDICT r4 #9)
   out['memory_envelope_v5p128'] = memory_envelope(128)

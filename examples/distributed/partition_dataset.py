@@ -12,6 +12,14 @@ Usage::
 
     python examples/distributed/partition_dataset.py \
         --out /tmp/parts --num-parts 4 [--frequency] [--data graph.npz]
+
+``--mesh-demo`` additionally bridges the offline assignment into the
+MESH plane: the written ``node_pb.npy`` is fed straight to
+``DistDataset.from_full_graph(partitioner=node_pb)`` — the same
+placement then drives the collective-exchange sampler, and the demo
+prints its edge-cut against the mesh plane's own ``range`` and
+``locality`` partitioners (``GLT_PARTITIONER`` selects those at
+dataset build, no offline step needed).
 """
 import argparse
 import sys
@@ -42,6 +50,12 @@ def main():
                   help='hotness-driven partitioning + feature caching')
   ap.add_argument('--cache-ratio', type=float, default=0.1)
   ap.add_argument('--fanout', type=int, nargs='+', default=[15, 10, 5])
+  ap.add_argument('--mesh-demo', action='store_true',
+                  help='after partitioning, build the mesh-plane '
+                       'DistDataset from the written node_pb (both '
+                       'planes share one placement) and print its '
+                       'edge-cut vs the in-memory range/locality '
+                       'partitioners')
   args = ap.parse_args()
 
   if args.data:
@@ -77,6 +91,29 @@ def main():
   sizes = [int((pb == i).sum()) for i in range(args.num_parts)]
   print(f'wrote {args.num_parts} partitions to {args.out}; '
         f'sizes {sizes}')
+
+  if args.mesh_demo:
+    # offline -> mesh bridge (ISSUE 20): the SAME node_pb drives the
+    # collective-exchange plane.  An explicit array short-circuits the
+    # partitioner selection, so the offline FrequencyPartitioner's
+    # hotness-aware placement carries over 1:1 (batches still surface
+    # original ids via old2new/new2old).
+    from graphlearn_tpu.parallel import DistDataset
+    from graphlearn_tpu.parallel.locality import (edge_cut_frac,
+                                                  locality_partition)
+    ds = DistDataset.from_full_graph(args.num_parts, rows, cols,
+                                     node_feat=feats, node_label=labels,
+                                     num_nodes=n, partitioner=pb)
+    pb_loc, _ = locality_partition(rows, cols, n, args.num_parts)
+    rng = np.random.default_rng(0)
+    pb_rand = rng.integers(0, args.num_parts, n).astype(np.int32)
+    print(f'mesh-plane dataset: partitioner={ds.partitioner}, '
+          f'{ds.num_partitions} shards, '
+          f'bounds={np.diff(ds.graph.bounds).tolist()}')
+    for name, assign in (('offline', pb), ('locality', pb_loc),
+                         ('random', pb_rand)):
+      print(f'  edge_cut[{name}] = '
+            f'{edge_cut_frac(rows, cols, assign):.4f}')
 
 
 if __name__ == '__main__':

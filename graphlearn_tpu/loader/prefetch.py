@@ -40,13 +40,22 @@ class PrefetchingLoader:
 
   def __iter__(self):
     ctl = getattr(self, '_adaptive', None)
-    if ctl is not None:
+    sampler = getattr(self, 'sampler', None)
+    ewma = (sampler is not None
+            and getattr(sampler, '_ewma_model', None) is not None)
+    if ctl is not None or ewma:
       # join any still-live prefetch worker BEFORE retuning: a worker
       # mid-_produce must not trace against the new capacity while
       # the finished epoch's telemetry is being attributed to the old
       self.close()
       if getattr(self, '_epoch_count', 0) > 0:
-        ctl.on_epoch_end()
+        if ctl is not None:
+          ctl.on_epoch_end()
+        if ewma:
+          # EWMA capacity retune (ISSUE 20c) shares the epoch seam:
+          # observed attribution deltas resize the per-destination
+          # exchange capacities before the next epoch compiles
+          sampler.capacity_retune()
       self._epoch_count = getattr(self, '_epoch_count', 0) + 1
     return self._start_epoch(iter(self._batcher))
 

@@ -486,7 +486,8 @@ class DistFeature:
 
   def __init__(self, shards, bounds, cache_ids=None, cache_rows=None,
                mod_sharded: bool = False, hot_counts=None,
-               cold_host=None, cold_local=None):
+               cold_host=None, cold_local=None,
+               cache_local: bool = False):
     self.shards = np.asarray(shards)
     self.bounds = np.asarray(bounds, dtype=np.int64)
     self.hot_counts = (np.asarray(hot_counts, np.int32)
@@ -504,6 +505,13 @@ class DistFeature:
     #: True = strided ownership (owner = id % P, row = id // P) —
     #: `build_dist_edge_feature`; False = range ownership by `bounds`.
     self.mod_sharded = mod_sharded
+    #: True = the cache is the ISSUE 20 read-only replica set: the
+    #: sampler's feature lookup treats cached rows as LOCAL (they are
+    #: masked out of the exchange request and overlaid from the
+    #: replica, and the attribution credits them to the diagonal).
+    #: False (offline cache plans) keeps the post-exchange-overlay
+    #: semantics — identical exchanged bytes.
+    self.cache_local = cache_local
 
   @property
   def feature_dim(self) -> int:
@@ -537,6 +545,54 @@ def build_feature_cache(cache_ids_old, cache_feats, old2new, num_parts):
     ids[p, :len(cid)] = new[order]
     rows[p, :len(cid)] = np.asarray(cache_feats[p])[order]
   return ids, rows
+
+
+def build_replica_cache(feats_new: np.ndarray, bounds: np.ndarray,
+                        hotness_new: np.ndarray, frac: float):
+  """Mesh-plane `cat_feature_cache` analog (ISSUE 20): replicate the
+  globally hottest rows read-only into every partition's cache so the
+  PartitionBook-routed feature lookup can serve them locally.
+
+  Each partition caches the top ``ceil(frac * N)`` hottest rows it
+  does NOT own (its own rows are already local); ``hotness_new`` ranks
+  in the RELABELED id space (a `DecayedSketch` export or in-degree).
+  Returns ``(cache_ids [P, C] sorted CACHE_PAD_ID-padded,
+  cache_rows [P, C, D])`` or ``(None, None)`` at a zero budget.
+  """
+  bounds = np.asarray(bounds, np.int64)
+  num_parts = len(bounds) - 1
+  n = int(bounds[-1])
+  c = int(np.ceil(float(frac) * n))
+  if c <= 0 or n == 0:
+    return None, None
+  feats_new = np.asarray(feats_new)
+  if feats_new.ndim == 1:
+    feats_new = feats_new[:, None]
+  hot = np.asarray(hotness_new, np.float64)
+  order = np.argsort(-hot, kind='stable')        # hottest first, stable
+  ids = np.full((num_parts, c), CACHE_PAD_ID, np.int32)
+  rows = np.zeros((num_parts, c, feats_new.shape[1]), feats_new.dtype)
+  for p in range(num_parts):
+    remote = order[(order < bounds[p]) | (order >= bounds[p + 1])][:c]
+    remote = np.sort(remote)
+    ids[p, :len(remote)] = remote
+    rows[p, :len(remote)] = feats_new[remote]
+  from ..telemetry.live import live
+  live.gauge('partition.replicated_rows').set(float(c))
+  return ids, rows
+
+
+def replica_budget_frac(replica_frac=None) -> float:
+  """Resolve the replication budget: argument wins, else the
+  ``GLT_LOCALITY_REPLICA_FRAC`` knob (fraction of ALL nodes each
+  device replicates; 0 = no replica cache, the default)."""
+  import os
+  if replica_frac is not None:
+    return float(replica_frac)
+  try:
+    return float(os.environ.get('GLT_LOCALITY_REPLICA_FRAC', 0.0))
+  except ValueError:
+    return 0.0
 
 
 def build_dist_feature(feats: np.ndarray, old2new: np.ndarray,
@@ -631,6 +687,10 @@ class DistDataset:
     #: `from_partition_dir(host_parts=...)`.  None = all partitions.
     self.host_parts = (np.asarray(host_parts, np.int64)
                        if host_parts is not None else None)
+    #: placement identity ('range' | 'locality' | 'custom' |
+    #: 'explicit') — benchmark artifacts record it so regression
+    #: baselines never compare rows across partitioner changes.
+    self.partitioner = 'explicit'
     self._partition_book = None
     #: ISSUE 15: durably re-loaded shards parked by `failover.
     #: adopt_shard`, keyed by the ORPHANED partition index.  Samplers
@@ -697,7 +757,9 @@ class DistDataset:
                       node_pb: Optional[np.ndarray] = None,
                       seed: int = 0, edge_feat=None,
                       split_ratio: float = 1.0,
-                      hotness: Optional[np.ndarray] = None
+                      hotness: Optional[np.ndarray] = None,
+                      partitioner=None,
+                      replica_frac: Optional[float] = None
                       ) -> 'DistDataset':
     """In-memory partition + shard (testing & single-host path).
 
@@ -705,17 +767,55 @@ class DistDataset:
     host-DRAM cold, see `build_dist_feature`); ``hotness`` defaults to
     in-degree so the HBM tier keeps the most-gathered rows
     (`sort_by_in_degree` policy, reference `data/reorder.py:19-31`).
+
+    ``partitioner`` (ISSUE 20) selects node placement when ``node_pb``
+    is not given: ``'range'`` (default / ``GLT_PARTITIONER`` unset) is
+    the historical seeded random round-robin, byte-identical to the
+    pre-locality path; ``'locality'`` runs the
+    `locality.locality_partition` streaming edge-cut minimizer
+    (hotness-weighted when ``hotness`` — an array or a `DecayedSketch`
+    — is supplied); an array is taken as a precomputed ``node_pb``
+    (e.g. the offline `FrequencyPartitioner` output); a callable is
+    invoked as ``partitioner(rows, cols, num_nodes, num_parts)``.
+    Every mode relabels through the same `build_dist_graph` path and
+    the dataset carries ``old2new``/``new2old`` so batches, labels and
+    served predictions surface original ids.
+
+    ``replica_frac > 0`` (or ``GLT_LOCALITY_REPLICA_FRAC``) builds the
+    read-only replica cache (`build_replica_cache`): each device
+    additionally holds the top ``ceil(frac * N)`` hottest REMOTE
+    feature rows and the sampler serves them as local.
     """
+    from .locality import resolve_partitioner
     rows = np.asarray(rows)
     cols = np.asarray(cols)
     n = int(num_nodes if num_nodes is not None
             else max(rows.max(initial=-1), cols.max(initial=-1)) + 1)
+    if hotness is not None and hasattr(hotness, 'score'):
+      hotness = hotness.score(np.arange(n))    # DecayedSketch export
+    part_identity = 'explicit'
     if node_pb is None:
-      rng = np.random.default_rng(seed)
-      node_pb = np.empty(n, dtype=np.int32)
-      perm = rng.permutation(n)
-      for p in range(num_parts):
-        node_pb[perm[p::num_parts]] = p
+      part = resolve_partitioner(partitioner)
+      if isinstance(part, str) and part == 'range':
+        part_identity = 'range'
+        rng = np.random.default_rng(seed)
+        node_pb = np.empty(n, dtype=np.int32)
+        perm = rng.permutation(n)
+        for p in range(num_parts):
+          node_pb[perm[p::num_parts]] = p
+      elif isinstance(part, str):              # 'locality'
+        from .locality import locality_partition
+        part_identity = 'locality'
+        if hotness is None:
+          hotness = np.bincount(cols, minlength=n)   # in-degree
+        node_pb, _ = locality_partition(rows, cols, n, num_parts,
+                                        seed=seed, hotness=hotness)
+      elif callable(part):
+        part_identity = 'custom'
+        node_pb = np.asarray(part(rows, cols, n, num_parts))
+      else:
+        part_identity = 'custom'
+        node_pb = part
     if split_ratio < 1.0 and hotness is None:
       hotness = np.bincount(cols, minlength=n)       # in-degree
     g, old2new = build_dist_graph(rows, cols, node_pb, n,
@@ -723,6 +823,22 @@ class DistDataset:
     nf = (build_dist_feature(node_feat, old2new, g.bounds,
                              split_ratio=split_ratio)
           if node_feat is not None else None)
+    rep = replica_budget_frac(replica_frac)
+    if nf is not None and rep > 0:
+      feats = np.asarray(node_feat)
+      if feats.ndim == 1:
+        feats = feats[:, None]
+      feats_new = np.empty_like(feats)
+      feats_new[old2new] = feats
+      rank = (np.asarray(hotness) if hotness is not None
+              else np.bincount(cols, minlength=n))
+      rank_new = np.empty(n, np.float64)
+      rank_new[old2new] = rank
+      cids, crows = build_replica_cache(feats_new, g.bounds, rank_new,
+                                        rep)
+      if cids is not None:
+        nf.cache_ids, nf.cache_rows = cids, crows
+        nf.cache_local = True
     nl = None
     if node_label is not None:
       # build_dist_feature preserves dtype — no float round-trip.
@@ -730,7 +846,9 @@ class DistDataset:
       nl = build_dist_feature(lab, old2new, g.bounds).shards[..., 0]
     ef = (build_dist_edge_feature(edge_feat, num_parts)
           if edge_feat is not None else None)
-    return cls(g, nf, nl, old2new, edge_features=ef)
+    ds = cls(g, nf, nl, old2new, edge_features=ef)
+    ds.partitioner = part_identity
+    return ds
 
   @classmethod
   def from_partition_dir(cls, root, num_parts: Optional[int] = None,

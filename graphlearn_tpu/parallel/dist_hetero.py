@@ -98,14 +98,23 @@ class DistHeteroDataset:
                       num_nodes_dict=None, node_pb_dict=None,
                       seed: int = 0, edge_feat_dict=None,
                       edge_ids_dict=None,
-                      split_ratio: float = 1.0) -> 'DistHeteroDataset':
+                      split_ratio: float = 1.0,
+                      partitioner=None) -> 'DistHeteroDataset':
     """In-memory partition + shard (testing & single-host path) — the
     hetero analog of `DistDataset.from_full_graph`.  ``edge_ids_dict``
     preserves caller-global edge ids (``edge_feat_dict`` rows index by
     them); defaults to input order per etype.  ``split_ratio < 1``
     tiers every node-type feature store (HBM hot / host-DRAM cold,
     hotness = cross-etype in-degree) — the IGBH-scale lever
-    (`build_dist_feature`)."""
+    (`build_dist_feature`).
+
+    ``partitioner`` (or ``GLT_PARTITIONER``): ``'locality'`` runs the
+    ISSUE 20 streaming partitioner over the DISJOINT UNION of all node
+    types (one joint stream, so an etype's endpoints co-locate across
+    types) and splits the joint assignment back per type; the balance
+    bound then holds on the union, not per type.  Unset/'range' keeps
+    the historical seeded round-robin byte-for-byte.  An explicit
+    ``node_pb_dict`` entry always wins for its type."""
     node_feat_dict = node_feat_dict or {}
     node_label_dict = node_label_dict or {}
     num_nodes_dict = dict(num_nodes_dict or {})
@@ -130,6 +139,27 @@ class DistHeteroDataset:
 
     rng = np.random.default_rng(seed)
     node_pb_dict = dict(node_pb_dict or {})
+    from .locality import locality_partition, resolve_partitioner
+    part_kind = resolve_partitioner(partitioner)
+    missing = [nt for nt in ntypes if nt not in node_pb_dict]
+    if missing and isinstance(part_kind, str) and part_kind == 'locality':
+      # joint stream over the disjoint union: offset each type's id
+      # space, partition once, split the assignment back per type
+      off, tot = {}, 0
+      for nt in ntypes:
+        off[nt] = tot
+        tot += num_nodes_dict[nt]
+      g_rows = [off[s] + np.asarray(r, np.int64)
+                for (s, _, d), (r, c) in edge_index_dict.items()]
+      g_cols = [off[d] + np.asarray(c, np.int64)
+                for (s, _, d), (r, c) in edge_index_dict.items()]
+      pb_joint, _ = locality_partition(
+          np.concatenate(g_rows) if g_rows else np.empty(0, np.int64),
+          np.concatenate(g_cols) if g_cols else np.empty(0, np.int64),
+          tot, num_parts, seed=seed)
+      for nt in missing:
+        node_pb_dict[nt] = pb_joint[off[nt]:off[nt]
+                                    + num_nodes_dict[nt]].copy()
     old2new, bounds = {}, {}
     for nt in ntypes:
       n = num_nodes_dict[nt]

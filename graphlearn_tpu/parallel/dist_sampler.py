@@ -761,13 +761,17 @@ class AdaptiveSlack:
 
 def _slack_cap(n: int, num_parts: int,
                exchange_slack: Optional[float],
-               exchange_layout: Optional[str] = None):
+               exchange_layout: Optional[str] = None, caps=None):
   """Capacity plan for one ``n``-id exchange: None = exact, else an
   `exchange.ExchangeSpec` under the sampler's layout (the dense spec
   reproduces the original ``max(ceil(n/P * slack), MIN_EXCHANGE_CAP)``
-  rounded cap bit-for-bit)."""
+  rounded cap bit-for-bit).  ``caps``: the `EwmaCapacityModel`'s
+  quantized ``(dest_cap, traffic_cap)`` for this channel (None keeps
+  the uniform-share plan)."""
+  d, t = caps if caps is not None else (None, None)
   return capacity_spec(n, num_parts, exchange_slack,
-                       layout=exchange_layout)
+                       layout=exchange_layout, dest_cap=d,
+                       traffic_cap=t)
 
 
 def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
@@ -778,7 +782,8 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
                         collect_edge_features=False, efshard=None,
                         ebounds=None, ef_shard_mode='mod',
                         hot_counts=None, gns_bits=None,
-                        gns_boost=None, book_spec=None):
+                        gns_boost=None, book_spec=None,
+                        cache_local=False, fr_caps=None, ft_caps=None):
   """Per-device multihop expansion + feature/label collection — the
   shared body of the node and link SPMD steps.  When
   ``collect_edge_features`` is set, every sampled edge's feature row is
@@ -815,7 +820,8 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
         indptr, indices, eids, bounds, frontier, int(k), hop_key,
         axis, num_parts, with_edge,
         exchange_capacity=_slack_cap(frontier.shape[0], num_parts,
-                                     exchange_slack, exchange_layout),
+                                     exchange_slack, exchange_layout,
+                                     caps=fr_caps),
         gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec)
     fr_stats = fr_stats + jnp.stack(hstats)
     state, rows, cols, prev_cnt = induce_next(
@@ -846,7 +852,8 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
     (ef,), estats = dist_gather_multi(
         (efshard,), ebounds, edge, axis, num_parts,
         exchange_capacity=_slack_cap(edge.shape[0], num_parts,
-                                     exchange_slack, exchange_layout),
+                                     exchange_slack, exchange_layout,
+                                     caps=ft_caps),
         shard_mode=ef_shard_mode, book_spec=book_spec)
     ft_stats = ft_stats + jnp.stack(estats)
     ef_owner = (edge_owner_fn(num_parts) if ef_shard_mode == 'mod'
@@ -854,18 +861,52 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
     attr_ft = attr_ft + dest_histogram(edge, ef_owner, num_parts)
   tables = (((fshard,) if collect_features else ())
             + ((lshard,) if collect_labels else ()))
+  replica_hits = jnp.zeros((1,), jnp.int32)
   if tables:
+    node_valid = jnp.arange(node_cap, dtype=jnp.int32) < state.count
+    gather_ids = state.nodes
+    if with_cache and cache_local:
+      # ISSUE 20 replica mode: rows replicated into this device's
+      # cache are LOCAL — mask them out of the exchange request (the
+      # overlay below fills them), and credit them to the attribution
+      # diagonal via the dedicated stats slot.  This is what turns
+      # hot-range coverage into avoided exchange bytes; the plain
+      # offline cache plan (cache_local=False) keeps the byte-
+      # identical post-exchange overlay.
+      c = cids.shape[0]
+      pos = jnp.clip(jnp.searchsorted(cids, state.nodes), 0, c - 1)
+      local_hit = (cids[pos] == state.nodes) & (state.nodes >= 0) \
+          & node_valid
+      if hot_counts is None and book_spec is None:
+        # owner bypass: rows THIS device already owns never need the
+        # round trip either — serve them by a direct local-shard take
+        # below.  With the diagonal off the wire, the EWMA capacity
+        # model sizes the feature lanes from true REMOTE demand (the
+        # diagonal otherwise pins `dest_cap`: locality partitioning
+        # makes self-traffic the busiest cell).  Gated to the
+        # full-resident store under the identity book — a tiered
+        # shard holds only hot rows, and an adopted/remapped book
+        # means the local shard no longer spans [bounds[p],
+        # bounds[p+1]).
+        my = jax.lax.axis_index(axis)
+        lo = jnp.take(jnp.asarray(bounds), my)
+        hi = jnp.take(jnp.asarray(bounds), my + 1)
+        local_hit = local_hit | ((state.nodes >= lo)
+                                 & (state.nodes < hi) & node_valid)
+      gather_ids = jnp.where(local_hit, INVALID_ID, state.nodes)
+      replica_hits = jnp.sum(local_hit.astype(jnp.int32))[None]
     got, gstats = dist_gather_multi(
-        tables, bounds, state.nodes, axis, num_parts,
+        tables, bounds, gather_ids, axis, num_parts,
         exchange_capacity=_slack_cap(node_cap, num_parts,
-                                     exchange_slack, exchange_layout),
+                                     exchange_slack, exchange_layout,
+                                     caps=ft_caps),
         hot_counts=hot_counts if collect_features else None,
         book_spec=book_spec)
     got = list(got)
     ft_stats = ft_stats + jnp.stack(gstats)
     attr_ft = attr_ft + dest_histogram(
-        state.nodes, attr_owner, num_parts,
-        valid=jnp.arange(node_cap, dtype=jnp.int32) < state.count)
+        gather_ids, attr_owner, num_parts,
+        valid=node_valid & (gather_ids >= 0))
     if collect_features:
       x = got.pop(0)
       if with_cache:
@@ -873,15 +914,27 @@ def _expand_and_collect(indptr, indices, eids, bounds, seeds, key, *,
         # `cache_overlay` for why this is an overlay, not a
         # miss-only exchange)
         x = cache_overlay(x, state.nodes, cids, crows)
+        if cache_local and hot_counts is None and book_spec is None:
+          # owner-bypass fill: the ids masked out above as self-owned
+          # come straight from the resident shard
+          my = jax.lax.axis_index(axis)
+          lo = jnp.take(jnp.asarray(bounds), my)
+          hi = jnp.take(jnp.asarray(bounds), my + 1)
+          own = (state.nodes >= lo) & (state.nodes < hi) & node_valid
+          rowsl = jnp.take(
+              fshard, jnp.clip(state.nodes - lo, 0,
+                               fshard.shape[0] - 1), axis=0)
+          x = jnp.where(own[:, None], rowsl, x)
     if collect_labels:
       y = got.pop(0)
   cum = jnp.stack(hop_counts)
   nsn = jnp.concatenate([cum[:1], cum[1:] - cum[:-1]]).astype(jnp.int32)
   # stats layout: [7] scalar triple pairs + negative.lost slot, then
-  # the [2P] attribution rows (frontier dests, feature dests) — see
-  # `ExchangeTelemetry._accumulate_stats` for the host-side split
+  # the [2P + 1] attribution tail (frontier dests, feature dests,
+  # replica-hit count) — see `ExchangeTelemetry._accumulate_stats`
+  # for the host-side split
   stats = jnp.concatenate([fr_stats, ft_stats, jnp.zeros((1,), jnp.int32),
-                           attr_fr, attr_ft])
+                           attr_fr, attr_ft, replica_hits])
   return state, row, col, edge, seed_local, x, y, ef, nsn, stats, ew
 
 
@@ -894,7 +947,8 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
                     collect_edge_features: bool = False,
                     ef_shard_mode: str = 'mod', tiered: bool = False,
                     gns_boost: Optional[float] = None,
-                    book_spec=None):
+                    book_spec=None, cache_local: bool = False,
+                    ewma_caps=None):
   """Build the jitted SPMD sample(+collect) step.
 
   ``exchange_slack``: per-destination exchange capacity as a multiple
@@ -933,7 +987,10 @@ def _make_dist_step(mesh: Mesh, num_parts: int, fanouts: Tuple[int, ...],
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
         hot_counts=hcounts if tiered else None,
-        gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec)
+        gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec,
+        cache_local=cache_local,
+        fr_caps=ewma_caps.get('frontier') if ewma_caps else None,
+        ft_caps=ewma_caps.get('feature') if ewma_caps else None)
 
     def lead(v):   # re-add the shard axis for stacked outputs
       return None if v is None else v[None]
@@ -974,7 +1031,8 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
                          ef_shard_mode: str = 'mod',
                          tiered: bool = False,
                          gns_boost: Optional[float] = None,
-                         book_spec=None):
+                         book_spec=None, cache_local: bool = False,
+                         ewma_caps=None):
   """Build the jitted SPMD LINK sample step: per-device seed edges +
   collective strict negatives + the shared expansion body.
 
@@ -1039,7 +1097,10 @@ def _make_dist_link_step(mesh: Mesh, num_parts: int,
         efshard=efshard_s[0] if collect_edge_features else None,
         ebounds=ebounds, ef_shard_mode=ef_shard_mode,
         hot_counts=hcounts if tiered else None,
-        gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec)
+        gns_bits=gns_bits, gns_boost=gns_boost, book_spec=book_spec,
+        cache_local=cache_local,
+        fr_caps=ewma_caps.get('frontier') if ewma_caps else None,
+        ft_caps=ewma_caps.get('feature') if ewma_caps else None)
 
     b = batch
     sl = seed_local
@@ -1320,10 +1381,13 @@ class ExchangeTelemetry:
        self._cache_evicts) = (int(v) for v in arr[n:n + 6])
       tail = arr[n + 6:]
       if tail.size:
-        # rows = device count, cols = 2P; prefer the sampler's own
-        # num_parts (rows == cols/2 only when mesh size == P)
-        cols = 2 * getattr(self, 'num_parts',
-                           int(round(np.sqrt(tail.size / 2))))
+        # rows = device count, cols = 2P+1 (frontier dests, feature
+        # dests, replica-hit count — ISSUE 20) or 2P for pre-replica
+        # snapshots; prefer the sampler's own num_parts (rows ==
+        # cols/2 only when mesh size == P)
+        p = getattr(self, 'num_parts',
+                    int(round(np.sqrt(tail.size / 2))))
+        cols = (2 * p + 1) if tail.size % (2 * p + 1) == 0 else 2 * p
         self._attr_total = tail.reshape(-1, cols).copy()
       else:
         # pre-attribution snapshot: counters restore, the matrix
@@ -1431,8 +1495,21 @@ class ExchangeTelemetry:
         p = int(getattr(self, 'num_parts', 0) or 0)
         z = np.zeros((p, p), np.int64)
         return z, z.copy()
+      # cols = 2P (pre-replica) or 2P+1 (trailing replica-hit count)
       p = tot.shape[1] // 2
-      return tot[:, :p].copy(), tot[:, p:].copy()
+      return tot[:, :p].copy(), tot[:, p:2 * p].copy()
+
+  def replica_hits(self) -> int:
+    """Cumulative feature lookups served WITHOUT riding the exchange
+    (ISSUE 20b): replica-set hits plus the owner bypass's self-owned
+    rows — everything the masked gather kept OFF the wire.  0 when
+    the stats tail predates the replica slot or no replicas exist."""
+    self.exchange_stats(tick_metrics=False)
+    with self._stats_lock:
+      tot = self._attr_total
+      if tot is None or tot.shape[1] % 2 == 0:
+        return 0
+      return int(tot[:, -1].sum())
 
   def attribution_stats(self, top_k: Optional[int] = None,
                         feature_row_bytes: Optional[int] = None,
@@ -1458,11 +1535,33 @@ class ExchangeTelemetry:
         pass                          # store on this sampler
     ids = fr + ft
     bytes_m = fr * 4 + ft * int(feature_row_bytes)
-    total_ids = int(ids.sum())
-    local_ids = int(np.trace(ids))
+    # "local" is BOOK-OWNER-aware: cell (src device, dst range) costs
+    # no wire bytes when the book routes range dst to device src —
+    # under the identity book this is exactly the diagonal, and after
+    # an adoption/rebalance the migrated range's column flips local on
+    # its new owner's row (the matrices stay range-keyed).
+    local_mask = np.eye(ids.shape[0], ids.shape[1], dtype=bool)
+    book = getattr(self, 'book', None)
+    if book is not None:
+      try:
+        owners = np.asarray(book.view().owners)
+        if owners.shape[0] == ids.shape[1]:
+          local_mask = (owners[None, :]
+                        == np.arange(ids.shape[0])[:, None])
+      except Exception:               # noqa: BLE001 — identity
+        pass                          # fallback (no live view)
+    # locally-served hits (ISSUE 20b) are feature rows the masked
+    # gather served device-locally (replica copies + the owner
+    # bypass's self-owned rows): they never reach the wire-truth
+    # matrices, so credit them back as LOCAL demand.
+    rep = self.replica_hits()
+    total_ids = int(ids.sum()) + rep
+    local_ids = int(ids[local_mask].sum()) + rep
     cross_ids = total_ids - local_ids
-    total_bytes = int(bytes_m.sum())
-    cross_bytes = total_bytes - int(np.trace(bytes_m))
+    rep_bytes = rep * int(feature_row_bytes)
+    total_bytes = int(bytes_m.sum()) + rep_bytes
+    cross_bytes = total_bytes - (int(bytes_m[local_mask].sum())
+                                 + rep_bytes)
 
     mass = None
     source = 'exchange'
@@ -1505,6 +1604,7 @@ class ExchangeTelemetry:
         'feature_ids': ft.tolist(),
         'bytes_matrix': bytes_m.tolist(),
         'local_ids': local_ids,
+        'locally_served_ids': rep,
         'cross_ids': cross_ids,
         'cross_partition_ids_frac': (
             round(cross_ids / total_ids, 6) if total_ids else 0.0),
@@ -1518,6 +1618,51 @@ class ExchangeTelemetry:
         'hot_ranges': hot,
         'hot_range_coverage': coverage,
     }
+
+  def _ewma_caps(self):
+    """Per-channel ``(dest_cap, traffic_cap)`` dict for the step
+    builders, or None when the EWMA model is off (the default — the
+    compiled programs are then byte-identical to uniform shares)."""
+    m = getattr(self, '_ewma_model', None)
+    if m is None:
+      return None
+    caps = {c: m.caps(c) for c in m.CHANNELS}
+    return caps if any(v != (None, None) for v in caps.values()) else None
+
+  def capacity_retune(self) -> bool:
+    """Epoch-end seam for the EWMA capacity co-design (ISSUE 20c):
+    feed the attribution-matrix delta since the last retune into the
+    `EwmaCapacityModel`; when a quantized cap moves, clear the step
+    cache so the next dispatch compiles `capacity_spec(dest_cap=...)`
+    sized to the OBSERVED per-destination traffic instead of uniform
+    shares.  Returns True when the caps (and hence the programs)
+    changed.  No-op unless GLT_EXCHANGE_EWMA is on."""
+    m = getattr(self, '_ewma_model', None)
+    if m is None:
+      return False
+    steps = int(self._step_cnt)
+    d_steps = steps - self._ewma_last_steps
+    if d_steps <= 0:
+      return False
+    fr, ft = self.attribution_matrices()
+    last = self._ewma_last
+    d_fr = fr - last[0] if last is not None else fr
+    d_ft = ft - last[1] if last is not None else ft
+    self._ewma_last = (fr, ft)
+    self._ewma_last_steps = steps
+    changed = m.observe('frontier', d_fr, d_steps)
+    changed = m.observe('feature', d_ft, d_steps) or changed
+    if changed:
+      from ..telemetry.recorder import recorder
+      caps = {c: m.caps(c) for c in m.CHANNELS}
+      self._steps.clear()
+      recorder.emit(
+          'exchange.retune', steps=d_steps,
+          frontier_dest_cap=caps['frontier'][0],
+          frontier_traffic_cap=caps['frontier'][1],
+          feature_dest_cap=caps['feature'][0],
+          feature_traffic_cap=caps['feature'][1])
+    return changed
 
   def cluster_exchange_stats(self) -> dict:
     """CLUSTER-wide exchange health: raw totals plus the derived
@@ -1614,6 +1759,17 @@ class DistNeighborSampler(ExchangeTelemetry):
                   and dataset.edge_features.mod_sharded) else 'range')
     self.with_cache = (self.collect_features
                        and dataset.node_features.has_cache)
+    # ISSUE 20 replica set (`from_full_graph(replica_frac=)`): the
+    # cached rows are exact copies of remote rows, so the gather can
+    # MASK them out of the exchange (served by the overlay) instead of
+    # fetching them twice.  Offline cache plans (`cache_local=False`)
+    # keep the historical overlay-after-gather semantics byte-for-byte.
+    # Label collection shares the gathered id vector, so masking is
+    # only sound when labels aren't gathered alongside.
+    self.cache_local = bool(
+        self.with_cache
+        and getattr(dataset.node_features, 'cache_local', False)
+        and self.collect_features and not self.collect_labels)
     # tiered store: HBM shards hold only each partition's hot rows;
     # cold rows live in host DRAM and are overlaid post-step
     # (`_maybe_overlay_cold`) — VERDICT r2 item 1 / reference
@@ -1651,6 +1807,16 @@ class DistNeighborSampler(ExchangeTelemetry):
     # 'ragged' select explicitly (env GLT_EXCHANGE_LAYOUT overrides
     # 'auto' only).  Exact exchanges (slack None) always run dense.
     self.exchange_layout = exchange_layout or 'auto'
+    # ISSUE 20 exchange co-design: per-destination capacity from an
+    # EWMA of the attribution matrices (GLT_EXCHANGE_EWMA=1).  The
+    # model observes matrix deltas at `capacity_retune()` (epoch end)
+    # and its power-of-two caps feed `capacity_spec(dest_cap=...)`;
+    # off (default) compiles exactly the uniform-share programs.
+    from .exchange import EwmaCapacityModel, ewma_enabled
+    self._ewma_model = (EwmaCapacityModel(self.num_parts)
+                        if ewma_enabled() else None)
+    self._ewma_last = None
+    self._ewma_last_steps = 0
     self._base_key = jax.random.key(seed)
     self._step_cnt = 0
     self._steps = {}
@@ -2062,7 +2228,8 @@ class DistNeighborSampler(ExchangeTelemetry):
             exchange_layout=self.exchange_layout,
             collect_edge_features=self.collect_edge_features,
             ef_shard_mode=self._ef_shard_mode, tiered=self.tiered,
-            gns_boost=self.gns_boost, book_spec=self.book_spec)
+            gns_boost=self.gns_boost, book_spec=self.book_spec,
+            cache_local=self.cache_local, ewma_caps=self._ewma_caps())
       if self.gns:
         from ..telemetry.recorder import recorder
         from ..utils.profiling import metrics
@@ -2632,7 +2799,7 @@ def _make_dist_walk_step(mesh: Mesh, num_parts: int, walk_length: int,
     walks = jnp.stack(path, axis=1)             # [B, L+1]
     full = jnp.concatenate(
         [stats, jnp.zeros((4,), jnp.int32), attr_fr,
-         jnp.zeros((num_parts,), jnp.int32)])
+         jnp.zeros((num_parts + 1,), jnp.int32)])
     return walks[None], full[None]
 
   specs_in = (P(axis), P(axis), P(), P(axis), P())
@@ -3180,7 +3347,8 @@ class DistLinkNeighborSampler(DistNeighborSampler):
             exchange_layout=self.exchange_layout,
             collect_edge_features=self.collect_edge_features,
             ef_shard_mode=self._ef_shard_mode, tiered=self.tiered,
-            gns_boost=self.gns_boost, book_spec=self.book_spec)
+            gns_boost=self.gns_boost, book_spec=self.book_spec,
+            cache_local=self.cache_local, ewma_caps=self._ewma_caps())
       if self.gns:
         from ..telemetry.recorder import recorder
         from ..utils.profiling import metrics

@@ -199,7 +199,9 @@ class ExchangeSpec:
 
 def capacity_spec(n: int, num_parts: int, slack: Optional[float],
                   layout: Optional[str] = None,
-                  floor: int = MIN_EXCHANGE_CAP
+                  floor: int = MIN_EXCHANGE_CAP,
+                  dest_cap: Optional[int] = None,
+                  traffic_cap: Optional[int] = None
                   ) -> Optional[ExchangeSpec]:
   """Plan the static capacities of one ``n``-id exchange.
 
@@ -208,6 +210,17 @@ def capacity_spec(n: int, num_parts: int, slack: Optional[float],
   means EXACT — per-destination width ``n`` under the dense layout,
   which can never drop an id (callers needing exactness — walkers,
   induced subgraphs — rely on this returning None unchanged).
+
+  ``dest_cap`` / ``traffic_cap`` (ISSUE 20, exchange co-design): the
+  `EwmaCapacityModel`'s measured per-step demand — ``dest_cap``
+  replaces the UNIFORM balanced share ``n / P`` with the measured
+  busiest-destination id count, and ``traffic_cap`` bounds the total
+  per-step wire traffic so the compact layout's overflow pool shrinks
+  when locality/replication skews traffic local.  Both are quantized
+  by the model (powers of two) so recompiles stay logarithmic.  None
+  keeps the uniform plan bit-for-bit.  The hierarchical layout keeps
+  uniform stage shares (its buckets aggregate destinations, so a
+  per-destination measurement does not map onto its caps).
   """
   if slack is None:
     return None
@@ -215,6 +228,8 @@ def capacity_spec(n: int, num_parts: int, slack: Optional[float],
   num_parts = int(num_parts)
   name = resolve_layout(layout, num_parts)
   lam = n / num_parts * float(slack)
+  if dest_cap is not None and name != 'hier':
+    lam = min(n, int(dest_cap)) * float(slack)
   if name == 'hier':
     rows, cols = mesh_factors(num_parts)
     # per-stage caps: slack times the stage's balanced share PLUS an
@@ -254,8 +269,12 @@ def capacity_spec(n: int, num_parts: int, slack: Optional[float],
       return ExchangeSpec('compact', num_parts, capacity=0,
                           pool=int(round_up(max(n, 1), 8)))
     base = int(np.ceil(lam))
+    # the pool absorbs GLOBAL skew: its budget scales with the ids
+    # that actually ride the wire per step (measured `traffic_cap`
+    # when the EWMA model supplies one) rather than the request width
+    wire = n if traffic_cap is None else min(n, int(traffic_cap))
     pool = int(round_up(
-        min(n, max(MIN_POOL, int(np.ceil(n * _pool_frac())))), 8))
+        min(n, max(MIN_POOL, int(np.ceil(wire * _pool_frac())))), 8))
     compact = ExchangeSpec('compact', num_parts,
                            capacity=min(base, n), pool=pool)
     # compact's whole win is reclaiming the dense FLOOR padding; when
@@ -286,6 +305,103 @@ def dest_histogram(ids: jax.Array, owner_fn: Callable,
   return jax.ops.segment_sum(
       jnp.ones(ids.shape, jnp.int32), owner,
       num_segments=num_parts + 1)[:num_parts]
+
+
+_ENV_EWMA = 'GLT_EXCHANGE_EWMA'
+
+
+def ewma_enabled(flag=None) -> bool:
+  """``GLT_EXCHANGE_EWMA=1`` turns on measured (EWMA) capacity sizing;
+  default OFF — the uniform-share plans stay byte-identical."""
+  if flag is not None:
+    return bool(flag)
+  return os.environ.get(_ENV_EWMA, '').lower() in ('1', 'true', 'on')
+
+
+def _quantize_pow2(x: float) -> int:
+  """Next power of two >= x (>= 1): the capacity ladder that bounds
+  recompiles to log2 steps over any traffic trajectory."""
+  v = max(int(np.ceil(x)), 1)
+  return int(1 << (v - 1).bit_length())
+
+
+class EwmaCapacityModel:
+  """EWMA of measured exchange demand -> quantized capacity caps
+  (ISSUE 20 exchange co-design).
+
+  Fed per-channel (``'frontier'`` / ``'feature'``) attribution-matrix
+  DELTAS at epoch boundaries: the busiest (src, dst) cell per step
+  becomes the per-destination demand (replacing the uniform ``n / P``
+  share in `capacity_spec`), and the busiest src row per step bounds
+  total wire traffic (shrinking the compact pool).  Both are EWMA'd
+  (``GLT_EXCHANGE_EWMA_ALPHA``), padded by a headroom multiplier
+  (``GLT_EXCHANGE_EWMA_HEADROOM``) and quantized to powers of two so a
+  capacity change — and therefore a recompile — happens at most
+  logarithmically often.  `AdaptiveSlack` keeps guarding drops on top:
+  an under-measured epoch that drops ids widens the slack rung the
+  usual way.
+  """
+
+  CHANNELS = ('frontier', 'feature')
+
+  def __init__(self, num_parts: int, alpha: Optional[float] = None,
+               headroom: Optional[float] = None):
+    def _f(env: str, default: float) -> float:
+      try:
+        return float(os.environ.get(env, default))
+      except ValueError:
+        return default
+    self.num_parts = int(num_parts)
+    self.alpha = (_f('GLT_EXCHANGE_EWMA_ALPHA', 0.5)
+                  if alpha is None else float(alpha))
+    self.headroom = (_f('GLT_EXCHANGE_EWMA_HEADROOM', 1.3)
+                     if headroom is None else float(headroom))
+    self._dest: dict = {}
+    self._traffic: dict = {}
+    self._caps: dict = {}
+
+  def observe(self, channel: str, matrix_delta, steps: int) -> bool:
+    """Fold one epoch's [P, P] id-count matrix delta (``steps`` step
+    dispatches) into the model.  Returns True when the QUANTIZED caps
+    moved — the caller must recompile (clear its step cache)."""
+    if steps <= 0:
+      return False
+    m = np.asarray(matrix_delta, np.float64)
+    if m.size == 0 or m.sum() <= 0:
+      return False
+    dest = float(m.max()) / steps
+    traffic = float(m.sum(axis=1).max()) / steps
+    a = self.alpha
+    self._dest[channel] = (a * dest + (1 - a) * self._dest[channel]
+                           if channel in self._dest else dest)
+    self._traffic[channel] = (
+        a * traffic + (1 - a) * self._traffic[channel]
+        if channel in self._traffic else traffic)
+    caps = (_quantize_pow2(self._dest[channel] * self.headroom),
+            _quantize_pow2(self._traffic[channel] * self.headroom))
+    changed = self._caps.get(channel) != caps
+    self._caps[channel] = caps
+    return changed
+
+  def caps(self, channel: str):
+    """``(dest_cap, traffic_cap)`` for `capacity_spec`, or
+    ``(None, None)`` before the first observation (uniform plan)."""
+    return self._caps.get(channel, (None, None))
+
+  def state_dict(self) -> dict:
+    return {f'{c}_{k}': float(d[c])
+            for k, d in (('dest', self._dest), ('traffic', self._traffic))
+            for c in d}
+
+  def load_state_dict(self, state: dict) -> None:
+    for key, val in state.items():
+      c, k = key.rsplit('_', 1)
+      (self._dest if k == 'dest' else self._traffic)[c] = float(
+          np.asarray(val))
+    for c in set(self._dest) & set(self._traffic):
+      self._caps[c] = (
+          _quantize_pow2(self._dest[c] * self.headroom),
+          _quantize_pow2(self._traffic[c] * self.headroom))
 
 
 def _bcast(mask: jax.Array, values: jax.Array) -> jax.Array:

@@ -812,6 +812,8 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     features by it so TreeSAGE's masked means stay unbiased."""
     from .dist_sampler import (_dist_one_hop, _slack_cap,
                                dist_gather_multi)
+    from .exchange import dest_histogram
+    from .partition_book import range_owner_fn
     slack = self.sampler.exchange_slack
     layout = self.sampler.exchange_layout
     gns = gns_bits is not None
@@ -820,7 +822,15 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
     w_levels = [jnp.ones(seeds.shape, jnp.float32)]
     fstats = jnp.zeros((3,), jnp.int32)
     book_spec = self.sampler.book_spec   # trace-time routing constant
+    # src->dst range attribution (ISSUE 16/20): the fused tree path
+    # must tick the SAME [2P + 1] tail as the dedup sampler — this was
+    # the dead feature counter (frontier_ids populated, feature_ids
+    # all-zero) on every tiered envelope epoch
+    attr_owner = range_owner_fn(bounds)
+    attr_fr = jnp.zeros((self.num_parts,), jnp.int32)
     for h, k in enumerate(self.fanouts):
+      attr_fr = attr_fr + dest_histogram(frontier, attr_owner,
+                                         self.num_parts)
       nbrs, mask, _, hw, st = _dist_one_hop(
           indptr_s, indices_s, None, bounds, frontier, int(k),
           jax.random.fold_in(key, h), self.axis, self.num_parts,
@@ -841,8 +851,10 @@ class FusedDistTreeEpoch(_MeshEpochDriver):
         exchange_capacity=_slack_cap(all_ids.shape[0], self.num_parts,
                                      slack, layout),
         hot_counts=hcounts, book_spec=book_spec)
+    attr_ft = dest_histogram(all_ids, attr_owner, self.num_parts)
     stats7 = jnp.concatenate(
-        [fstats, jnp.stack(gst), jnp.zeros((1,), jnp.int32)])
+        [fstats, jnp.stack(gst), jnp.zeros((1,), jnp.int32),
+         attr_fr, attr_ft, jnp.zeros((1,), jnp.int32)])
     hop_counts = jnp.stack(
         [jnp.sum((lvl >= 0).astype(jnp.int32)) for lvl in levels])
     y = labels[:self.batch_size]

@@ -44,6 +44,12 @@ DEFAULT_THRESHOLD = 0.2
 #:   'when'         — ('present' only) the guard applies only when
 #:                    this other dotted key exists in the artifact
 #:                    (i.e. the owning phase actually ran)
+#:   'same'         — dotted context key (or tuple of keys) that must
+#:                    hold the SAME value in artifact and baseline for
+#:                    the comparison to apply; any mismatch SKIPS the
+#:                    metric (e.g. rows measured under different
+#:                    partitioners are not comparable — re-bootstrap
+#:                    the baseline to re-arm the guard)
 METRICS: Tuple[Tuple, ...] = (
     ('value', 'lower'),                       # the headline epoch time
     ('fused_epoch_secs', 'lower'),
@@ -129,6 +135,17 @@ METRICS: Tuple[Tuple, ...] = (
     # skew signal the cold-tier placement feeds on)
     ('dist.attribution.cross_partition_bytes_frac', 'lower'),
     ('dist.attribution.hot_range_coverage', 'higher'),
+    # locality co-design guard (ISSUE 20): the GLT_PARTITIONER=
+    # locality envelope arm — the cross-partition byte share bought by
+    # the relabel + replica set must not creep back toward random, and
+    # the locality arm's throughput must hold its line.  Both guards
+    # only compare rows measured under the SAME partitioner identity
+    # ('same'): a baseline recorded under a different placement is
+    # skipped, never silently ratcheted against
+    ('dist.locality.cross_partition_bytes_frac', 'lower',
+     {'same': 'dist.locality.partitioner'}),
+    ('dist.locality.seeds_per_sec', 'higher',
+     {'same': 'dist.locality.partitioner'}),
     # request-tracing guard (ISSUE 17): tracing-ON serve cost over
     # tracing-OFF on the same closed-loop schedule.  Pinned against a
     # FIXED 1.0 baseline with a 5% tolerance, so the gate reads
@@ -189,7 +206,7 @@ def threshold_from_env(default: float = DEFAULT_THRESHOLD) -> float:
     return default
 
 
-def _get(obj: Dict, dotted: str):
+def _walk(obj: Dict, dotted: str):
   cur = obj
   for part in dotted.split('.'):
     if isinstance(cur, list):
@@ -204,7 +221,19 @@ def _get(obj: Dict, dotted: str):
     if not isinstance(cur, dict):
       return None
     cur = cur.get(part)
+  return cur
+
+
+def _get(obj: Dict, dotted: str):
+  cur = _walk(obj, dotted)
   return cur if isinstance(cur, (int, float)) else None
+
+
+def _context(obj: Dict, dotted: str):
+  """A 'same'-clause context value: any scalar (strings included —
+  partitioner identities are the motivating case)."""
+  cur = _walk(obj, dotted)
+  return cur if isinstance(cur, (str, int, float, bool)) else None
 
 
 def compare(artifact: Dict, baseline: Dict,
@@ -226,6 +255,15 @@ def compare(artifact: Dict, baseline: Dict,
     opts = entry[2] if len(entry) > 2 else {}
     thr = opts.get('threshold', threshold)
     cur = _get(artifact, key)
+    same = opts.get('same')
+    if same is not None:
+      same_keys = (same,) if isinstance(same, str) else tuple(same)
+      if any(_context(artifact, k) != _context(baseline, k)
+             for k in same_keys):
+        rows.append({'key': key, 'direction': direction,
+                     'current': cur, 'baseline': _get(baseline, key),
+                     'change_pct': None, 'status': 'skipped'})
+        continue
     if direction == 'present':
       gate = opts.get('when')
       if gate is not None and _get(artifact, gate) is None:

@@ -202,6 +202,22 @@ EVENT_KINDS: Dict[str, str] = {
         'secs, error (rollback cause / absorbed drain fault) — one '
         'event per seam of a planned ownership move, so a handoff '
         'reads out of the flight recorder end to end',
+    'partition.relabel':
+        'parallel.locality.locality_partition: partitioner, '
+        'num_parts, num_nodes, seed, edge_cut_frac, max_part_frac, '
+        'hotness_weighted — one event per locality relabel build '
+        '(the placement decision a dataset was constructed under)',
+    'partition.rebalance':
+        'parallel.locality.execute_rebalance: partition, frm, to, '
+        'demand, version, secs — one event per planned hot-range '
+        'migration (each move is a fenced handoff.transfer ladder; '
+        'this is the demand-driven WHY on top of it)',
+    'exchange.retune':
+        'parallel.dist_sampler.ExchangeTelemetry.capacity_retune: '
+        'steps, frontier_dest_cap, frontier_traffic_cap, '
+        'feature_dest_cap, feature_traffic_cap — the EWMA capacity '
+        'model moved a quantized cap and the step cache was cleared '
+        '(next dispatch compiles measured per-destination shares)',
     'scale.decision':
         'serving.autoscaler.ElasticController: dir (out|in), outcome '
         '(ok|rolled_back|held:cooldown|held:bounds|held:no_victim), '
@@ -523,6 +539,15 @@ METRIC_NAMES: Dict[str, str] = {
         'counter: exchange ids routed to a NON-self partition range '
         '(off-diagonal attribution mass — what locality-aware '
         'partitioning exists to shrink)',
+    'partition.replicated_rows':
+        'gauge: per-device rows of the read-only remote-row replica '
+        'cache (`dist_data.build_replica_cache`) — the hot-row '
+        'budget the masked gather serves locally instead of '
+        'exchanging (0 = replication off)',
+    'locality.edge_cut_frac':
+        'gauge: fraction of edges crossing partitions under the '
+        'most recent locality_partition run — the streaming '
+        'partitioner\'s objective, measured on its own output',
     'serving.queue_wait':
         'histogram: per-request admission enqueue → coalesce pickup '
         'wait (seconds; log2 buckets) — overload diagnosis without '
